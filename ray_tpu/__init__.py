@@ -1,0 +1,65 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+The public surface mirrors the reference's core API (``ray.init / remote /
+get / put / wait`` + actors + placement groups) while the ML layers
+(``ray_tpu.data/train/tune/serve/rl``) are built TPU-first on JAX/XLA.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.worker import (  # noqa: F401
+    cancel,
+    get,
+    init,
+    is_initialized,
+    kill,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+
+def nodes():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs.nodes()
+
+
+def cluster_resources():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs.cluster_resources()
+
+
+def available_resources():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs.available_resources()
+
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,454 @@
+"""In-process execution backend: resource-aware scheduler + worker threads.
+
+This is the single-node substrate the public API runs on by default. It
+reproduces the *semantics* of the reference's raylet + core-worker pair —
+dependency-gated dispatch (``LocalTaskManager``, reference
+``src/ray/raylet/local_task_manager.cc:91``), resource accounting, ordered
+per-actor queues (``direct_actor_task_submitter.h``), blocked-worker CPU
+release (the block/unblock notifications in ``raylet_client.h``) — with
+threads in one process instead of forked worker processes. The multiprocess
+node (``cluster.py``) layers real process isolation and the shared-memory
+object store on the same TaskSpec/scheduling interfaces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import queue
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID
+from ray_tpu._private.resources import MILLI, ResourceSet, to_milli
+from ray_tpu._private.task_spec import (
+    PlacementGroupSchedulingStrategy,
+    TaskKind,
+    TaskSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _BlockedState(threading.local):
+    """Per-thread record of resources released while blocked in get()."""
+
+    def __init__(self):
+        self.stack = []
+
+
+class ActorState:
+    ALIVE = "ALIVE"
+    DEAD = "DEAD"
+    RESTARTING = "RESTARTING"
+    PENDING = "PENDING_CREATION"
+
+
+class _Actor:
+    """Server side of one actor: mailbox + executor thread(s)."""
+
+    def __init__(self, backend: "LocalBackend", spec: TaskSpec):
+        self.backend = backend
+        self.spec = spec
+        self.actor_id: ActorID = spec.actor_id
+        self.state = ActorState.PENDING
+        self.instance: Any = None
+        self.mailbox: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self.death_cause = ""
+        self.num_restarts = 0
+        # Guards state transitions vs. mailbox puts (kill/submit race).
+        self.mb_lock = threading.Lock()
+        self.is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(type(spec.func) if not inspect.isclass(spec.func) else spec.func,
+                                           predicate=inspect.isfunction)
+        ) if inspect.isclass(spec.func) else False
+        self._threads: list[threading.Thread] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def start(self):
+        n = max(1, self.spec.max_concurrency) if not self.is_async else 1
+        for i in range(n):
+            t = threading.Thread(
+                target=self._run_loop, name=f"actor-{self.actor_id.hex()[:8]}-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _construct(self) -> bool:
+        """Run the constructor; returns True on success."""
+        spec = self.spec
+        try:
+            self.instance = spec.func(*spec.args, **spec.kwargs)
+            self.state = ActorState.ALIVE
+            self.backend.worker.store_task_outputs(spec, [None])
+            return True
+        except BaseException as e:  # noqa: BLE001 - constructor error kills actor
+            self.state = ActorState.DEAD
+            self.death_cause = f"constructor raised {type(e).__name__}: {e}"
+            err = exc.TaskError(e, spec.describe())
+            self.backend.worker.store_task_outputs(spec, None, error=err)
+            self.backend._on_actor_death(self, err)
+            return False
+
+    def _run_loop(self):
+        # Only the first thread constructs; others wait until alive.
+        is_primary = threading.current_thread() is self._threads[0] if self._threads else True
+        if is_primary or self.state == ActorState.PENDING:
+            with self.backend._actor_ctor_lock:
+                if self.state == ActorState.PENDING:
+                    if not self._construct():
+                        return
+        if self.is_async:
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+        while True:
+            item = self.mailbox.get()
+            if item is None:
+                return
+            if self.state == ActorState.DEAD:
+                self.backend.worker.store_task_outputs(
+                    item, None,
+                    error=exc.ActorDiedError(self.actor_id.hex()[:8], self.death_cause),
+                )
+                continue
+            self.backend._execute_actor_task(self, item)
+
+    def stop(self, cause: str = "killed") -> list:
+        """Transition to DEAD; returns specs that were still queued.
+
+        Under mb_lock so no submit can slip a spec in between the drain and
+        the shutdown sentinels (which would leave its caller hanging).
+        """
+        with self.mb_lock:
+            already_dead = self.state == ActorState.DEAD
+            self.state = ActorState.DEAD
+            self.death_cause = self.death_cause if already_dead else cause
+            drained = []
+            try:
+                while True:
+                    item = self.mailbox.get_nowait()
+                    if item is not None:
+                        drained.append(item)
+            except queue.Empty:
+                pass
+            if not already_dead:
+                for _ in (self._threads or [None]):
+                    self.mailbox.put(None)
+        return drained
+
+
+class LocalBackend:
+    """One node's scheduler and execution engine, in-process."""
+
+    def __init__(self, worker, resources: Dict[str, float],
+                 node_id: Optional[NodeID] = None):
+        self.worker = worker
+        self.node_id = node_id or NodeID.from_random()
+        self.resources = ResourceSet(resources)
+        self._pending_deps: dict[ObjectID, list[TaskSpec]] = {}
+        self._dep_counts: dict[bytes, int] = {}  # task_id binary -> remaining deps
+        self._ready: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._waiting_for_resources: list[TaskSpec] = []
+        self._actors: dict[ActorID, _Actor] = {}
+        self._cancelled: set[bytes] = set()
+        self._lock = threading.Lock()
+        self._actor_ctor_lock = threading.Lock()
+        self._blocked = _BlockedState()
+        self._shutdown = threading.Event()
+        # Per-bundle resource sets for placement groups: (pg_id, index) -> ResourceSet
+        self.bundle_resources: dict[tuple, ResourceSet] = {}
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="raylet-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------
+    # Submission
+    # ------------------------------------------------------------------
+
+    def submit(self, spec: TaskSpec) -> None:
+        if spec.kind == TaskKind.ACTOR_TASK:
+            self._submit_actor_task(spec)
+            return
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            # Register the mailbox immediately so method calls submitted
+            # before the creation task is dispatched are queued, mirroring
+            # the reference's client-side queueing while an actor is
+            # PENDING_CREATION (direct_actor_task_submitter.h).
+            self._actors[spec.actor_id] = _Actor(self, spec)
+        deps = spec.dependencies()
+        unresolved = [d for d in deps if not self.worker.memory_store.contains(d)]
+        with self._lock:
+            self._dep_counts[spec.task_id.binary()] = len(unresolved)
+            if unresolved:
+                for d in unresolved:
+                    self._pending_deps.setdefault(d, []).append(spec)
+        if unresolved:
+            for d in unresolved:
+                self.worker.memory_store.on_ready(d, self._on_dep_ready)
+        else:
+            self._ready.put(spec)
+
+    def _on_dep_ready(self, object_id: ObjectID) -> None:
+        now_ready = []
+        with self._lock:
+            for spec in self._pending_deps.pop(object_id, []):
+                key = spec.task_id.binary()
+                self._dep_counts[key] -= 1
+                if self._dep_counts[key] == 0:
+                    del self._dep_counts[key]
+                    now_ready.append(spec)
+        for spec in now_ready:
+            self._ready.put(spec)
+
+    def _submit_actor_task(self, spec: TaskSpec) -> None:
+        actor = self._actors.get(spec.actor_id)
+        if actor is None:
+            self.worker.store_task_outputs(
+                spec, None,
+                error=exc.ActorDiedError(
+                    spec.actor_id.hex()[:8], "actor handle refers to unknown actor"
+                ),
+            )
+            return
+        # State check and enqueue are atomic w.r.t. stop(): otherwise a kill
+        # between the check and the put leaves this caller hanging forever.
+        with actor.mb_lock:
+            if actor.state != ActorState.DEAD:
+                # Dependencies still gate execution; ordering is preserved by
+                # the mailbox (the actor thread blocks on unresolved deps at
+                # dequeue time).
+                actor.mailbox.put(spec)
+                return
+            cause = actor.death_cause
+        self.worker.store_task_outputs(
+            spec, None, error=exc.ActorDiedError(spec.actor_id.hex()[:8], cause)
+        )
+
+    # ------------------------------------------------------------------
+    # Dispatch loop (normal tasks + actor creations)
+    # ------------------------------------------------------------------
+
+    def _resource_pool_for(self, spec: TaskSpec) -> ResourceSet:
+        strat = spec.scheduling_strategy
+        if isinstance(strat, PlacementGroupSchedulingStrategy) and strat.placement_group is not None:
+            idx = strat.placement_group_bundle_index
+            pg_id = strat.placement_group.id
+            if idx >= 0:
+                pool = self.bundle_resources.get((pg_id, idx))
+                if pool is None:
+                    raise exc.PlacementGroupSchedulingError(
+                        f"bundle {idx} of placement group {pg_id} is not reserved on this node"
+                    )
+                return pool
+            # index -1: any bundle; pick first that can fit
+            request = to_milli(spec.resources)
+            for (gid, _i), pool in sorted(self.bundle_resources.items()):
+                if gid == pg_id and pool.can_fit_total(request):
+                    return pool
+            raise exc.PlacementGroupSchedulingError(
+                f"no bundle of placement group {pg_id} fits {spec.resources}"
+            )
+        return self.resources
+
+    def _dispatch_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                spec = self._ready.get(timeout=0.1)
+            except queue.Empty:
+                spec = None
+            with self._lock:
+                candidates = self._waiting_for_resources
+                self._waiting_for_resources = []
+            if spec is not None:
+                candidates.append(spec)
+            still_waiting = []
+            for s in candidates:
+                if s.task_id.binary() in self._cancelled:
+                    self.worker.store_task_outputs(
+                        s, None, error=exc.TaskCancelledError(s.describe())
+                    )
+                    continue
+                try:
+                    pool = self._resource_pool_for(s)
+                    request = to_milli(s.resources)
+                except Exception as e:  # malformed spec must not kill dispatch
+                    self.worker.store_task_outputs(
+                        s, None,
+                        error=e if isinstance(e, exc.RayTpuError)
+                        else exc.RayTpuError(f"failed to schedule {s.describe()}: {e}"),
+                    )
+                    continue
+                if not pool.can_fit_total(request):
+                    self.worker.store_task_outputs(
+                        s, None, error=exc.RayTpuError(
+                            f"task {s.describe()} requests {s.resources} which can "
+                            f"never be satisfied by this node (total: {pool.total})"
+                        )
+                    )
+                    continue
+                if pool.try_acquire(request):
+                    self._launch(s, pool, request)
+                else:
+                    still_waiting.append(s)
+            if still_waiting:
+                with self._lock:
+                    self._waiting_for_resources = still_waiting + self._waiting_for_resources
+                if spec is None:
+                    # nothing new arrived; wait for a release instead of spinning
+                    self.resources.wait_for_change(timeout=0.05)
+
+    def _launch(self, spec: TaskSpec, pool: ResourceSet, request: Dict[str, int]):
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            actor = self._actors[spec.actor_id]
+            if actor.state == ActorState.DEAD:  # killed while pending
+                pool.release(request)
+                return
+            actor._held_pool = pool
+            actor._held_request = request
+            actor.start()
+        else:
+            t = threading.Thread(
+                target=self._execute_normal_task, args=(spec, pool, request),
+                name=f"worker-{spec.task_id.hex()[:8]}", daemon=True,
+            )
+            t.start()
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+
+    def _execute_normal_task(self, spec: TaskSpec, pool: ResourceSet,
+                             request: Dict[str, int]):
+        ctx = self.worker.task_context
+        ctx.push(task_spec=spec, node_id=self.node_id, pool=pool, request=request)
+        try:
+            args, kwargs = self.worker.resolve_args(spec)
+            result = spec.func(*args, **kwargs)
+            self.worker.store_task_outputs(spec, self._split_returns(spec, result))
+        except BaseException as e:  # noqa: BLE001 - any user failure → object error
+            self._handle_task_failure(spec, e)
+        finally:
+            ctx.pop()
+            pool.release(request)
+
+    def _execute_actor_task(self, actor: _Actor, spec: TaskSpec):
+        ctx = self.worker.task_context
+        ctx.push(task_spec=spec, node_id=self.node_id, pool=None, request=None)
+        try:
+            args, kwargs = self.worker.resolve_args(spec)
+            method = getattr(actor.instance, spec.func)
+            if inspect.iscoroutinefunction(method):
+                result = actor._loop.run_until_complete(method(*args, **kwargs)) \
+                    if actor._loop else asyncio.run(method(*args, **kwargs))
+            else:
+                result = method(*args, **kwargs)
+            self.worker.store_task_outputs(spec, self._split_returns(spec, result))
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
+            self.worker.store_task_outputs(spec, None, error=err)
+        finally:
+            ctx.pop()
+
+    def _split_returns(self, spec: TaskSpec, result: Any) -> list:
+        if spec.num_returns == 1:
+            return [result]
+        if spec.num_returns == 0:
+            return []
+        if not isinstance(result, (tuple, list)) or len(result) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.describe()} declared num_returns={spec.num_returns} "
+                f"but returned {type(result).__name__}"
+            )
+        return list(result)
+
+    def _handle_task_failure(self, spec: TaskSpec, e: BaseException):
+        retryable = False
+        if spec.retry_exceptions is True:
+            retryable = True
+        elif isinstance(spec.retry_exceptions, (list, tuple)):
+            retryable = isinstance(e, tuple(spec.retry_exceptions))
+        if retryable and spec.max_retries != 0:
+            spec.max_retries -= 1
+            logger.warning(
+                "task %s failed with %s, retrying (%s retries left)",
+                spec.describe(), type(e).__name__, spec.max_retries,
+            )
+            self.submit(spec)
+            return
+        # Errors arriving from a dependency are already TaskErrors; propagate
+        # them unchanged so the original cause surfaces at every get() site.
+        err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
+        self.worker.store_task_outputs(spec, None, error=err)
+
+    def _on_actor_death(self, actor: _Actor, error: BaseException):
+        # Idempotent: release lifetime resources exactly once.
+        pool = getattr(actor, "_held_pool", None)
+        if pool is not None:
+            actor._held_pool = None
+            pool.release(actor._held_request)
+        # Free the actor's name for reuse (a dead actor must not poison it).
+        self.worker.gcs.remove_named_actor_by_id(actor.actor_id)
+        # Fail everything that was still queued at death.
+        drained = actor.stop(actor.death_cause or "actor died")
+        for item in drained:
+            self.worker.store_task_outputs(
+                item, None,
+                error=exc.ActorDiedError(actor.actor_id.hex()[:8], actor.death_cause),
+            )
+
+    # ------------------------------------------------------------------
+    # Control operations
+    # ------------------------------------------------------------------
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        drained = actor.stop("killed via kill()")
+        for item in drained:
+            self.worker.store_task_outputs(
+                item, None,
+                error=exc.ActorDiedError(actor_id.hex()[:8], actor.death_cause),
+            )
+        self._on_actor_death(actor, exc.ActorDiedError(actor_id.hex()[:8], "killed"))
+
+    def actor_state(self, actor_id: ActorID) -> str:
+        actor = self._actors.get(actor_id)
+        return actor.state if actor else ActorState.DEAD
+
+    def cancel(self, task_id) -> None:
+        self._cancelled.add(task_id.binary())
+
+    # -- blocked-worker resource release (block/unblock protocol) --------
+
+    def notify_blocked(self):
+        """Called when a worker thread blocks in get(): temporarily release
+        its CPU share so other tasks can run (avoids nested-get deadlock)."""
+        ctx = self.worker.task_context.current()
+        if ctx is None or ctx.get("pool") is None:
+            return
+        request = ctx.get("request") or {}
+        cpu_part = {k: v for k, v in request.items() if k == "CPU" and v > 0}
+        if cpu_part:
+            ctx["pool"].release(cpu_part)
+            self._blocked.stack.append((ctx["pool"], cpu_part))
+
+    def notify_unblocked(self):
+        if not getattr(self._blocked, "stack", None):
+            return
+        pool, cpu_part = self._blocked.stack.pop()
+        # Reacquire before continuing; spin on the condition variable.
+        while not pool.try_acquire(cpu_part):
+            pool.wait_for_change(timeout=0.05)
+
+    def shutdown(self):
+        self._shutdown.set()
+        for actor in list(self._actors.values()):
+            actor.stop("node shutdown")
+        self._dispatcher.join(timeout=1.0)

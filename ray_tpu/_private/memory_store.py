@@ -1,0 +1,153 @@
+"""In-process object store: the future/value table behind ObjectRefs.
+
+Equivalent in role to the reference's CoreWorker memory store
+(``src/ray/core_worker/store_provider/memory_store/memory_store.h``): it
+holds resolved values (or errors) for object IDs owned by this process and
+lets callers block or register callbacks on unresolved ones. Values are
+stored as Python objects (zero-copy; jax/numpy arrays are immutable in
+practice), with promotion to the shared-memory store handled a level up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+@dataclass
+class _Entry:
+    event: threading.Event = field(default_factory=threading.Event)
+    value: Any = None
+    error: Optional[BaseException] = None
+    ready: bool = False
+    callbacks: list = field(default_factory=list)
+    # number of ObjectRef handles alive in this process (best-effort GC)
+    local_refs: int = 0
+
+
+class MemoryStore:
+    def __init__(self):
+        # RLock: ObjectRef.__del__ can fire from GC while this process holds
+        # the lock (allocation inside _entry triggers collection), re-entering
+        # remove_local_ref on the same thread.
+        self._lock = threading.RLock()
+        self._entries: dict[ObjectID, _Entry] = {}
+
+    def _entry(self, object_id: ObjectID) -> _Entry:
+        entry = self._entries.get(object_id)
+        if entry is None:
+            entry = _Entry()
+            self._entries[object_id] = entry
+        return entry
+
+    def put(self, object_id: ObjectID, value: Any,
+            error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            entry = self._entry(object_id)
+            if entry.ready:
+                return  # immutable once written
+            entry.value = value
+            entry.error = error
+            entry.ready = True
+            callbacks = entry.callbacks
+            entry.callbacks = []
+        entry.event.set()
+        for cb in callbacks:
+            cb(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.ready
+
+    def on_ready(self, object_id: ObjectID, callback: Callable[[ObjectID], None]) -> None:
+        """Invoke callback when object resolves (immediately if already done)."""
+        with self._lock:
+            entry = self._entry(object_id)
+            if not entry.ready:
+                entry.callbacks.append(callback)
+                return
+        callback(object_id)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        """Block for and return the value; raises the stored error if any."""
+        with self._lock:
+            entry = self._entry(object_id)
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(
+                f"get() timed out after {timeout}s waiting for {object_id}"
+            )
+        if entry.error is not None:
+            raise entry.error
+        return entry.value
+
+    def peek(self, object_id: ObjectID):
+        """Return (ready, value, error) without blocking."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready:
+                return False, None, None
+            return True, entry.value, entry.error
+
+    def wait(self, object_ids: list[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> tuple[list[ObjectID], list[ObjectID]]:
+        """Block until ``num_returns`` of ``object_ids`` are ready.
+
+        Returns (ready, not_ready) preserving input order, matching the
+        semantics of ``ray.wait`` (reference ``_private/worker.py:2565``).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        cond = threading.Condition()
+        ready_set: set[ObjectID] = set()
+
+        def _on_ready(oid: ObjectID):
+            with cond:
+                ready_set.add(oid)
+                cond.notify_all()
+
+        for oid in object_ids:
+            self.on_ready(oid, _on_ready)
+
+        with cond:
+            while len(ready_set) < min(num_returns, len(object_ids)):
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                cond.wait(remaining)
+            ready = [oid for oid in object_ids if oid in ready_set]
+        not_ready = [oid for oid in object_ids if oid not in ready_set]
+        return ready, not_ready
+
+    # -- local reference counting (process-lifetime GC) ------------------
+
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entry(object_id).local_refs += 1
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return
+            entry.local_refs -= 1
+            if entry.local_refs <= 0 and entry.ready:
+                del self._entries[object_id]
+
+    def free(self, object_ids: list[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is not None and entry.ready:
+                    entry.value = None
+                    entry.error = ObjectLostError(oid.hex(), f"object {oid} was freed")
+
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,119 @@
+"""Global control state: the in-process GCS.
+
+Role-equivalent to the reference GCS server's managers
+(``src/ray/gcs/gcs_server/``): named-actor registry (GcsActorManager's
+by-name index), internal KV (``gcs_kv_manager.h``), node table, and
+placement-group table. In cluster mode this state lives in the head
+process and is accessed over the control-plane RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+
+
+class GlobalState:
+    def __init__(self, worker):
+        self._worker = worker
+        self._lock = threading.Lock()
+        # (namespace, name) -> actor handle info
+        self._named_actors: Dict[tuple, Any] = {}
+        self._kv: Dict[tuple, bytes] = {}
+        self._placement_groups: Dict[PlacementGroupID, Any] = {}
+
+    # -- named actors ----------------------------------------------------
+
+    def register_named_actor(self, name: str, namespace: Optional[str],
+                             handle) -> None:
+        key = (namespace or self._worker.namespace, name)
+        with self._lock:
+            if key in self._named_actors:
+                raise ValueError(
+                    f"Actor name {name!r} already taken in namespace {key[0]!r}"
+                )
+            self._named_actors[key] = handle
+
+    def get_named_actor(self, name: str, namespace: Optional[str]):
+        key = (namespace or self._worker.namespace, name)
+        with self._lock:
+            handle = self._named_actors.get(key)
+        if handle is None:
+            raise ValueError(f"Failed to look up actor {name!r}")
+        return handle
+
+    def list_named_actors(self, all_namespaces: bool = False):
+        with self._lock:
+            if all_namespaces:
+                return [
+                    {"name": n, "namespace": ns} for (ns, n) in self._named_actors
+                ]
+            return [
+                n for (ns, n) in self._named_actors
+                if ns == self._worker.namespace
+            ]
+
+    def remove_named_actor_by_id(self, actor_id: ActorID) -> None:
+        with self._lock:
+            for key, handle in list(self._named_actors.items()):
+                if handle._actor_id == actor_id:
+                    del self._named_actors[key]
+
+    # -- internal KV (reference: gcs_kv_manager.h) -----------------------
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: Optional[bytes] = None) -> bool:
+        k = (namespace or b"", key)
+        with self._lock:
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = value
+            return True
+
+    def kv_get(self, key: bytes, namespace: Optional[bytes] = None) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get((namespace or b"", key))
+
+    def kv_del(self, key: bytes, namespace: Optional[bytes] = None) -> None:
+        with self._lock:
+            self._kv.pop((namespace or b"", key), None)
+
+    def kv_keys(self, prefix: bytes, namespace: Optional[bytes] = None) -> list:
+        ns = namespace or b""
+        with self._lock:
+            return [k for (n, k) in self._kv if n == ns and k.startswith(prefix)]
+
+    # -- placement groups ------------------------------------------------
+
+    def register_placement_group(self, pg) -> None:
+        with self._lock:
+            self._placement_groups[pg.id] = pg
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            self._placement_groups.pop(pg_id, None)
+
+    def placement_group_table(self) -> dict:
+        with self._lock:
+            return dict(self._placement_groups)
+
+    # -- cluster introspection -------------------------------------------
+
+    def nodes(self) -> list:
+        b = self._worker.backend
+        return [
+            {
+                "NodeID": b.node_id.hex(),
+                "Alive": True,
+                "Resources": b.resources.total,
+                "Labels": getattr(b, "labels", {}),
+            }
+        ]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._worker.backend.resources.total
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._worker.backend.resources.available

@@ -1,0 +1,122 @@
+"""Task and actor specifications: the unit handed to the scheduler.
+
+Role-equivalent to the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h:182``): everything the execution backend
+needs to place and run one invocation — function payload, arguments (inline
+values and ObjectRef dependencies), resource request, retry policy, and
+scheduling strategy.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskKind(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base marker; concrete strategies below.
+
+    Mirrors ``python/ray/util/scheduling_strategies.py``.
+    """
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: Any = None  # NodeID
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    kind: TaskKind
+    # Callable payload: for normal tasks the function; for actor creation the
+    # class; for actor tasks the method name.
+    func: Any
+    args: tuple
+    kwargs: dict
+    name: str = ""
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: Any = False  # False | True | list of exception types
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=DefaultSchedulingStrategy
+    )
+    # Actor-related fields
+    actor_id: Optional[ActorID] = None
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None  # None | "detached"
+    max_pending_calls: int = -1
+    # Ordering for actor tasks
+    sequence_number: int = 0
+    # Runtime env (recorded; applied by the worker pool when it launches
+    # dedicated workers for the env)
+    runtime_env: Optional[dict] = None
+    # Return object IDs, precomputed by the submitter (owner)
+    return_ids: list = field(default_factory=list)
+    # Depth for scheduling fairness / detection of recursive deadlock
+    depth: int = 0
+
+    def dependencies(self) -> list[ObjectID]:
+        """ObjectIDs appearing at the top level of args/kwargs."""
+        from ray_tpu.object_ref import ObjectRef
+
+        deps = []
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, ObjectRef):
+                deps.append(a.id)
+        return deps
+
+    def describe(self) -> str:
+        if self.kind == TaskKind.ACTOR_TASK:
+            return f"{self.name} (actor={self.actor_id})"
+        return f"{self.name} ({self.task_id.hex()[:8]})"
+
+
+@dataclass
+class Bundle:
+    """One placement-group bundle: a resource request reserved on one node."""
+
+    resources: Dict[str, float]
+    node_id: Any = None  # filled at reservation time
+
+
+@dataclass
+class PlacementGroupSpec:
+    pg_id: PlacementGroupID
+    bundles: list
+    strategy: str = "PACK"  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    lifetime: Optional[str] = None

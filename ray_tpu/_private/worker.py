@@ -1,0 +1,289 @@
+"""The per-process runtime singleton and the public API implementation.
+
+Role-equivalent to the reference's ``python/ray/_private/worker.py`` plus the
+CoreWorker it wraps: owns the memory store, assigns object IDs for puts and
+task returns, resolves task arguments, and implements ``init / shutdown /
+get / put / wait / kill / cancel``. Execution is delegated to a backend: the
+in-process ``LocalBackend`` by default, or a multiprocess cluster backend.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import state as state_mod
+from ray_tpu._private.ids import JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.local_backend import LocalBackend
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+_global_worker: Optional["Worker"] = None
+_init_lock = threading.Lock()
+
+
+class _TaskContext(threading.local):
+    """Per-thread stack of executing tasks (nested via reentrant get)."""
+
+    def _stack(self):
+        if not hasattr(self, "stack"):
+            self.stack = []
+        return self.stack
+
+    def push(self, **kw):
+        self._stack().append(kw)
+
+    def pop(self):
+        self._stack().pop()
+
+    def current(self) -> Optional[dict]:
+        s = self._stack()
+        return s[-1] if s else None
+
+
+class Worker:
+    """The runtime embedded in the driver (and, conceptually, each worker)."""
+
+    def __init__(self, resources: Dict[str, float], namespace: Optional[str] = None):
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.from_random()
+        self.namespace = namespace or f"ns-{self.job_id.hex()}"
+        self.memory_store = MemoryStore()
+        self.task_context = _TaskContext()
+        self._put_counter_lock = threading.Lock()
+        self._put_counters: dict[bytes, int] = {}
+        self._driver_task_id = TaskID.from_random()
+        self.backend = LocalBackend(self, resources)
+        # Named actors / placement groups / KV — the "GCS" of this runtime.
+        self.gcs = state_mod.GlobalState(self)
+
+    # ------------------------------------------------------------------
+    # Object plumbing
+    # ------------------------------------------------------------------
+
+    def current_task_id(self) -> TaskID:
+        ctx = self.task_context.current()
+        if ctx is not None:
+            return ctx["task_spec"].task_id
+        return self._driver_task_id
+
+    def next_put_id(self) -> ObjectID:
+        task_id = self.current_task_id()
+        with self._put_counter_lock:
+            idx = self._put_counters.get(task_id.binary(), 0) + 1
+            self._put_counters[task_id.binary()] = idx
+        return ObjectID.for_put(task_id, idx)
+
+    def put_object(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError(
+                "Calling put() on an ObjectRef is not allowed; pass the ref directly."
+            )
+        oid = self.next_put_id()
+        self.memory_store.put(oid, value)
+        return ObjectRef(oid)
+
+    def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        self.backend.notify_blocked()
+        try:
+            values = []
+            for ref in refs:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.0, deadline - _time.monotonic())
+                try:
+                    values.append(self.memory_store.get(ref.id, remaining))
+                except exc.TaskError as e:
+                    raise e.as_instanceof_cause() from None
+            return values
+        finally:
+            self.backend.notify_unblocked()
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        self.backend.notify_blocked()
+        try:
+            ready_ids, not_ready_ids = self.memory_store.wait(
+                [r.id for r in refs], num_returns, timeout
+            )
+        finally:
+            self.backend.notify_unblocked()
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
+
+    # ------------------------------------------------------------------
+    # Task plumbing (called by the backend)
+    # ------------------------------------------------------------------
+
+    def resolve_args(self, spec: TaskSpec):
+        """Replace top-level ObjectRefs in args/kwargs with their values.
+
+        Nested refs (inside containers) are passed through as refs —
+        borrowing semantics, matching the reference.
+        """
+
+        def _resolve(v):
+            if isinstance(v, ObjectRef):
+                return self.memory_store.get(v.id)
+            return v
+
+        args = tuple(_resolve(a) for a in spec.args)
+        kwargs = {k: _resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def store_task_outputs(self, spec: TaskSpec, values, error=None):
+        if error is not None:
+            for oid in spec.return_ids:
+                self.memory_store.put(oid, None, error=error)
+            return
+        for oid, value in zip(spec.return_ids, values):
+            self.memory_store.put(oid, value)
+
+    def submit(self, spec: TaskSpec) -> list[ObjectRef]:
+        # num_returns=0: no return objects at all (call is fire-and-forget).
+        # Actor creations always carry one status object (index 0).
+        from ray_tpu._private.task_spec import TaskKind
+
+        n = spec.num_returns
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            n = max(n, 1)
+        spec.return_ids = [
+            ObjectID.for_task_return(spec.task_id, i) for i in range(n)
+        ]
+        refs = [ObjectRef(oid) for oid in spec.return_ids]
+        self.backend.submit(spec)
+        return refs
+
+    # -- local handle refcounting ---------------------------------------
+
+    def register_object_ref(self, ref: ObjectRef):
+        self.memory_store.add_local_ref(ref.id)
+
+    def unregister_object_ref(self, oid: ObjectID):
+        self.memory_store.remove_local_ref(oid)
+
+    def shutdown(self):
+        self.backend.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Module-level API (exported via ray_tpu/__init__.py)
+# ----------------------------------------------------------------------
+
+
+def global_worker() -> Worker:
+    if _global_worker is None:
+        # Auto-init may race with another thread's first API call; the lock
+        # inside init() makes the loser reuse the winner's worker.
+        init(ignore_reinit_error=True)
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional[Worker]:
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def init(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: Optional[str] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    **kwargs,
+) -> "Worker":
+    """Start (or connect to) the runtime.
+
+    Reference: ``ray.init`` (``python/ray/_private/worker.py:1096``). Here a
+    single-node in-process runtime is brought up; multiprocess/cluster modes
+    attach through ``ray_tpu.cluster_utils``.
+    """
+    global _global_worker
+    with _init_lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return _global_worker
+            raise RuntimeError(
+                "ray_tpu.init() called twice; pass ignore_reinit_error=True "
+                "or call ray_tpu.shutdown() first."
+            )
+        total: Dict[str, float] = {"CPU": float(num_cpus if num_cpus is not None
+                                                else os.cpu_count() or 1)}
+        try:
+            import jax
+
+            tpus = sum(1 for d in jax.devices() if d.platform == "tpu")
+        except Exception:  # pragma: no cover - jax missing/broken
+            tpus = 0
+        total["TPU"] = float(num_tpus) if num_tpus is not None else float(tpus)
+        if object_store_memory:
+            total["object_store_memory"] = float(object_store_memory)
+        total.update(resources or {})
+        total = {k: v for k, v in total.items() if v > 0 or k == "CPU"}
+        _global_worker = Worker(total, namespace=namespace)
+        atexit.register(shutdown)
+        return _global_worker
+
+
+def shutdown():
+    global _global_worker
+    with _init_lock:
+        if _global_worker is not None:
+            _global_worker.shutdown()
+            _global_worker = None
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    w = global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get_objects([refs], timeout)[0]
+    if isinstance(refs, list):
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r).__name__}")
+        return w.get_objects(refs, timeout)
+    raise TypeError(f"get() expects an ObjectRef or list, got {type(refs).__name__}")
+
+
+def put(value) -> ObjectRef:
+    return global_worker().put_object(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None,
+         fetch_local: bool = True):
+    if not isinstance(refs, list) or not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(
+            f"num_returns must be in [1, {len(refs)}], got {num_returns}"
+        )
+    return global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    w = global_worker()
+    w.gcs.remove_named_actor_by_id(actor_handle._actor_id)
+    w.backend.kill_actor(actor_handle._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    global_worker().backend.cancel(ref.task_id())

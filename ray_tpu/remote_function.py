@@ -1,0 +1,115 @@
+"""``@remote`` functions.
+
+Reference: ``python/ray/remote_function.py`` — a decorated function becomes a
+handle whose ``.remote(...)`` submits a TaskSpec and returns ObjectRef(s);
+``.options(...)`` overrides per-call options.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.resources import normalize_request
+from ray_tpu._private.task_spec import (
+    DefaultSchedulingStrategy,
+    SchedulingStrategy,
+    TaskKind,
+    TaskSpec,
+)
+
+_TASK_OPTIONS = {
+    "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "name", "scheduling_strategy",
+    "runtime_env", "_metadata",
+}
+
+
+class RemoteFunction:
+    def __init__(self, func, **default_options):
+        bad = set(default_options) - _TASK_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid @remote options for a function: {sorted(bad)}")
+        self._function = func
+        self._default_options = default_options
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._function.__name__!r} cannot be called "
+            f"directly; use {self._function.__name__}.remote()."
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        bad = set(options) - _TASK_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid options: {sorted(bad)}")
+        merged = {**self._default_options, **options}
+        return RemoteFunction(self._function, **merged)
+
+    def remote(self, *args, **kwargs):
+        opts = self._default_options
+        w = worker_mod.global_worker()
+        resources = normalize_request(
+            num_cpus=opts.get("num_cpus"),
+            num_tpus=opts.get("num_tpus"),
+            num_gpus=opts.get("num_gpus"),
+            memory=opts.get("memory"),
+            resources=opts.get("resources"),
+            default_cpus=1.0,
+        )
+        strategy = opts.get("scheduling_strategy") or DefaultSchedulingStrategy()
+        if not isinstance(strategy, SchedulingStrategy):
+            raise TypeError(
+                f"scheduling_strategy must be a SchedulingStrategy, got {strategy!r}"
+            )
+        num_returns = opts.get("num_returns", 1)
+        ctx = w.task_context.current()
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            kind=TaskKind.NORMAL_TASK,
+            func=self._function,
+            args=args,
+            kwargs=kwargs,
+            name=opts.get("name") or self._function.__qualname__,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=opts.get("max_retries", 3),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            scheduling_strategy=strategy,
+            runtime_env=opts.get("runtime_env"),
+            depth=(ctx["task_spec"].depth + 1) if ctx else 0,
+        )
+        refs = w.submit(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(**options)`` decorator for functions and classes.
+
+    Reference: ``ray.remote`` (``python/ray/_private/worker.py:2871``).
+    """
+    from ray_tpu.actor import ActorClass
+
+    def _make(obj, options):
+        if isinstance(obj, type):
+            return ActorClass(obj, **options)
+        if callable(obj):
+            return RemoteFunction(obj, **options)
+        raise TypeError(f"@remote requires a function or class, got {type(obj)}")
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return _make(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def decorator(obj):
+        return _make(obj, kwargs)
+
+    return decorator

@@ -1,0 +1,7 @@
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    DefaultSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SchedulingStrategy,
+    SpreadSchedulingStrategy,
+)

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so sharding/multi-chip code
+paths compile and execute without TPU hardware (the driver's
+``dryrun_multichip`` does the same). Must run before any ``import jax``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,164 @@
+"""Actor API tests (modeled on reference ``python/ray/tests/test_actor.py``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get_value(self):
+        return self.value
+
+    def boom(self):
+        raise RuntimeError("method failure")
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote()) == 1
+    assert ray_tpu.get(c.increment.remote(5)) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=10)
+    assert ray_tpu.get(c.get_value.remote()) == 10
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.increment.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_error_does_not_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(RuntimeError, match="method failure"):
+        ray_tpu.get(c.boom.remote())
+    assert ray_tpu.get(c.increment.remote()) == 1
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("ctor fail")
+
+        def f(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.f.remote())
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(start=3)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.get_value.remote()) == 3
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    h1 = Counter.options(name="gie", get_if_exists=True).remote(start=1)
+    h2 = Counter.options(name="gie", get_if_exists=True).remote(start=99)
+    assert h1._actor_id == h2._actor_id
+    assert ray_tpu.get(h2.get_value.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.increment.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.05)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.increment.remote(), timeout=5)
+
+
+def test_pass_actor_handle(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.increment.remote(100))
+
+    assert ray_tpu.get(use.remote(c)) == 100
+
+
+def test_actor_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def slow(self):
+            time.sleep(0.25)
+            return 1
+
+    p = Parallel.remote()
+    start = time.monotonic()
+    assert sum(ray_tpu.get([p.slow.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - start < 0.9
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def f(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.f.remote(21)) == 42
+
+
+def test_actor_resources_held(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=1)
+    class Holder:
+        def ping(self):
+            return 1
+
+    h1 = Holder.remote()
+    h2 = Holder.remote()
+    assert ray_tpu.get([h1.ping.remote(), h2.ping.remote()]) == [1, 1]
+    # both CPUs held by actors now
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 0
+
+
+def test_actor_handle_in_actor(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, other):
+            self.other = other
+
+        def bump(self):
+            return ray_tpu.get(self.other.increment.remote())
+
+    caller = Caller.remote(c)
+    assert ray_tpu.get(caller.bump.remote()) == 1
+
+
+def test_list_named_actors(ray_start_regular):
+    Counter.options(name="lna1").remote()
+    Counter.options(name="lna2").remote()
+    from ray_tpu._private.worker import global_worker
+
+    names = set(global_worker().gcs.list_named_actors())
+    assert {"lna1", "lna2"} <= names

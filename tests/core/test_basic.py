@@ -1,0 +1,233 @@
+"""Core task/object API tests.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` coverage:
+remote invocation, multiple returns, nested tasks, ref passing, put/get,
+wait semantics, error propagation, options validation.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote(num_returns=2)
+def two_returns(x):
+    return x, x + 1
+
+
+@ray_tpu.remote
+def fail():
+    raise ValueError("boom")
+
+
+def test_simple_task(ray_start_regular):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_kwargs(ray_start_regular):
+    assert ray_tpu.get(add.remote(a=5, b=7)) == 12
+
+
+def test_multiple_returns(ray_start_regular):
+    r1, r2 = two_returns.remote(10)
+    assert ray_tpu.get([r1, r2]) == [10, 11]
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"x": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"x": [1, 2, 3]}
+
+
+def test_pass_object_ref_as_arg(ray_start_regular):
+    ref = ray_tpu.put(4)
+    # top-level refs are resolved to values before execution
+    assert ray_tpu.get(add.remote(ref, 1)) == 5
+
+
+def test_chained_tasks(ray_start_regular):
+    ref = add.remote(1, 1)
+    for _ in range(10):
+        ref = add.remote(ref, 1)
+    assert ray_tpu.get(ref) == 12
+
+
+def test_nested_submission(ray_start_regular):
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(add.remote(20, 22))
+
+    assert ray_tpu.get(outer.remote()) == 42
+
+
+def test_deeply_nested_get_no_deadlock(ray_start_2_cpus):
+    @ray_tpu.remote
+    def rec(n):
+        if n == 0:
+            return 0
+        return ray_tpu.get(rec.remote(n - 1)) + 1
+
+    # depth > num_cpus: requires blocked-worker CPU release
+    assert ray_tpu.get(rec.remote(8)) == 8
+
+
+def test_error_propagation(ray_start_regular):
+    with pytest.raises(ValueError, match="boom"):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_is_task_error_too(ray_start_regular):
+    with pytest.raises(TaskError):
+        ray_tpu.get(fail.remote())
+
+
+def test_error_propagates_through_dependency(ray_start_regular):
+    bad = fail.remote()
+    with pytest.raises(ValueError, match="boom"):
+        ray_tpu.get(add.remote(bad, 1))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    refs = [sleepy.remote(0.01), sleepy.remote(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=2)
+    assert ready == [refs[0]] and not_ready == [refs[1]]
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(5)
+
+    refs = [sleepy.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=0.05)
+    assert ready == [] and not_ready == refs
+
+
+def test_wait_rejects_duplicates(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([ref, ref])
+
+
+def test_options_override(ray_start_regular):
+    assert ray_tpu.get(add.options(name="custom").remote(2, 2)) == 4
+
+
+def test_invalid_option_rejected(ray_start_regular):
+    with pytest.raises(ValueError):
+        add.options(nonsense=1)
+
+
+def test_direct_call_rejected(ray_start_regular):
+    with pytest.raises(TypeError):
+        add(1, 2)
+
+
+def test_num_returns_mismatch(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def wrong():
+        return 1, 2
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(wrong.remote()[0])
+
+
+def test_parallel_execution(ray_start_regular):
+    # 4 cpus, 4 sleeps of 0.3s should overlap
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(0.3)
+        return 1
+
+    start = time.monotonic()
+    assert sum(ray_tpu.get([sleepy.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - start < 1.0
+
+
+def test_resource_limit_respected(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        time.sleep(0.2)
+        return 1
+
+    start = time.monotonic()
+    assert sum(ray_tpu.get([heavy.remote() for _ in range(3)])) == 3
+    # three 2-cpu tasks on 2 cpus must serialize: >= 0.6s
+    assert time.monotonic() - start >= 0.55
+
+
+def test_infeasible_task_errors(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=64)
+    def big():
+        return 1
+
+    with pytest.raises(Exception, match="never be satisfied"):
+        ray_tpu.get(big.remote(), timeout=5)
+
+
+def test_retry_exceptions(ray_start_regular):
+    attempts = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=[RuntimeError])
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return attempts["n"]
+
+    assert ray_tpu.get(flaky.remote()) == 3
+
+
+def test_cluster_resources(ray_start_regular):
+    assert ray_tpu.cluster_resources()["CPU"] == 4.0
+
+
+def test_nested_refs_are_borrowed(ray_start_regular):
+    inner = ray_tpu.put(7)
+
+    @ray_tpu.remote
+    def read_container(container):
+        # nested refs arrive as refs, not values
+        (ref,) = container
+        assert isinstance(ref, ray_tpu.ObjectRef)
+        return ray_tpu.get(ref)
+
+    assert ray_tpu.get(read_container.remote([inner])) == 7
+
+
+def test_cancel_pending_task(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=2)
+    def blocker():
+        time.sleep(1.0)
+
+    @ray_tpu.remote(num_cpus=2)
+    def victim():
+        return 1
+
+    b = blocker.remote()
+    v = victim.remote()
+    ray_tpu.cancel(v)
+    with pytest.raises(Exception):
+        ray_tpu.get(v, timeout=5)
+    ray_tpu.get(b)

@@ -1,0 +1,95 @@
+"""Regression tests for bugs found in review/verification of the core runtime."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
+
+
+def test_get_timeout_is_total_deadline(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.4) for _ in range(4)]
+    start = time.monotonic()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(refs, timeout=0.2)
+    # per-ref timeouts would allow up to 0.8s; total deadline must cut at ~0.2
+    assert time.monotonic() - start < 0.5
+
+
+def test_num_returns_zero(ray_start_regular):
+    ran = []
+
+    @ray_tpu.remote(num_returns=0)
+    def fire_and_forget():
+        ran.append(1)
+
+    assert fire_and_forget.remote() is None
+    for _ in range(100):
+        if ran:
+            break
+        time.sleep(0.02)
+    assert ran == [1]
+
+
+def test_named_actor_reusable_after_ctor_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Fragile:
+        def __init__(self, ok):
+            if not ok:
+                raise ValueError("nope")
+            self.ok = ok
+
+        def ping(self):
+            return "pong"
+
+    h = Fragile.options(name="svc").remote(ok=False)
+    with pytest.raises(Exception):
+        ray_tpu.get(h.ping.remote(), timeout=5)
+    # the name must be released so a retry can claim it
+    for _ in range(100):
+        try:
+            h2 = Fragile.options(name="svc").remote(ok=True)
+            break
+        except ValueError:
+            time.sleep(0.02)
+    else:
+        pytest.fail("name 'svc' never released after constructor failure")
+    assert ray_tpu.get(h2.ping.remote()) == "pong"
+
+
+def test_kill_releases_resources_exactly_once(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=2)
+    class Big:
+        def ping(self):
+            return 1
+
+    b = Big.remote()
+    ray_tpu.get(b.ping.remote())
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
+    ray_tpu.kill(b)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+    # double-kill must not over-release
+    ray_tpu.kill(b)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+
+
+def test_submit_after_kill_gets_error_not_hang(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.f.remote())
+    ray_tpu.kill(a)
+    time.sleep(0.05)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.f.remote(), timeout=5)
