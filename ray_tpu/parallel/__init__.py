@@ -1,0 +1,36 @@
+"""TPU-native parallelism: device meshes, sharding rules, collectives,
+and long-context (sequence/context) parallelism.
+
+This package is the TPU answer to the reference's collective substrate
+(`python/ray/util/collective/`, NCCL/Gloo groups — see SURVEY.md §5) and to
+the parallelism strategies Ray delegates to torch DDP/FSDP
+(`python/ray/train/torch/train_loop_utils.py:92-101`). Instead of process
+groups + NCCL calls, parallelism here is expressed as a `jax.sharding.Mesh`
+with named axes and XLA collectives inside compiled programs:
+
+- ``mesh``      — mesh axes (data/fsdp/expert/pipe/seq/tensor) and creation
+- ``sharding``  — logical-axis → mesh-axis rules, NamedSharding helpers
+- ``collectives`` — in-program collective wrappers (psum/all_gather/...)
+- ``ring_attention`` — ring/context parallel attention (absent from the
+  reference entirely; SURVEY.md §5 "Long-context")
+- ``ulysses``   — all-to-all (DeepSpeed-Ulysses style) sequence parallelism
+- ``pipeline``  — pipeline parallel microbatching over a ``pipe`` mesh axis
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    mesh_shape_for,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_pytree,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from ray_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
+from ray_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

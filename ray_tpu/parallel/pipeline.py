@@ -1,0 +1,95 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference has no in-tree pipeline parallelism (only the Alpa release
+test, `release/alpa_tests/train_opt_2_7b_minimum.py:95` — SURVEY.md §2
+parallelism inventory). Here PP is a first-class mesh axis: stage
+parameters are sharded over ``pipe`` (each device group holds one stage)
+and microbatches stream through a `lax.scan` whose carried state rotates
+between neighbouring stages via `lax.ppermute` — the standard SPMD
+"collective pipeline" formulation, which keeps everything inside one XLA
+program (no host round-trips between stages, unlike actor-staged PP).
+
+Schedule: GPipe-style fill/drain. For S stages and M microbatches the scan
+runs S+M-1 ticks; tick t has stage s working on microbatch t-s. Bubble
+fraction (S-1)/(S+M-1) — callers pick M >= 4*S to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_sharded(stage_params, x_mb, stage_fn: Callable,
+                      axis_name: str):
+    """Per-shard body. stage_params: this stage's params (local). x_mb:
+    [M, mb, ...] microbatched input — only stage 0's copy is consumed.
+    Returns [M, mb, ...] outputs (valid on the last stage; replicated back
+    by the caller via ppermute)."""
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    n_mb = x_mb.shape[0]
+    ticks = n_stages + n_mb - 1
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Which microbatch does stage 0 inject this tick?
+        mb_idx = jnp.clip(t, 0, n_mb - 1)
+        injected = lax.dynamic_index_in_dim(x_mb, mb_idx, axis=0,
+                                            keepdims=False)
+        inp = jnp.where(stage_idx == 0, injected, state)
+        out = stage_fn(stage_params, inp)
+        # Last stage records its result at slot t - (n_stages - 1).
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_mb - 1)
+        is_valid = (t >= n_stages - 1) & (stage_idx == n_stages - 1)
+        current = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(is_valid, out, current), out_idx, 0
+        )
+        # Shift activations to the next stage.
+        state = lax.ppermute(out, axis_name, fwd_perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(stage_fn(stage_params,
+                                     jax.tree.map(lambda a: a[0], x_mb)))
+    outputs0 = jnp.zeros((n_mb,) + state0.shape, state0.dtype)
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0),
+                               jnp.arange(ticks))
+    # Broadcast final outputs from the last stage to all stages so the
+    # caller sees a replicated result (psum over one-hot contribution).
+    contribution = jnp.where(stage_idx == n_stages - 1, outputs,
+                             jnp.zeros_like(outputs))
+    return lax.psum(contribution, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches, *,
+                   mesh: Optional[Mesh] = None, axis_name: str = "pipe"):
+    """Run `stage_fn(params, x)` as a pipeline over `axis_name`.
+
+    - `stage_params`: pytree whose leaves have a leading stage dimension of
+      size n_stages, sharded over `axis_name` (each shard sees its own
+      stage's slice with the stage dim collapsed).
+    - `x_microbatches`: [num_microbatches, microbatch, ...] input,
+      replicated over `axis_name`.
+    Returns outputs [num_microbatches, microbatch, ...], replicated.
+    """
+    body = functools.partial(_pipeline_sharded, stage_fn=stage_fn,
+                             axis_name=axis_name)
+    if mesh is None:
+        return body(stage_params, x_microbatches)
+    param_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = jax.shard_map(
+        lambda p, x: body(jax.tree.map(lambda a: a[0], p), x),
+        mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stage_params, x_microbatches)
