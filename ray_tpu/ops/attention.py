@@ -1,0 +1,226 @@
+"""Flash attention: tiled online-softmax attention as a Pallas TPU kernel.
+
+Forward pass is a Pallas kernel (grid over batch × heads × q-blocks with an
+inner k-block sweep; scores never hit HBM). Backward currently recomputes
+the score matrix in pure JAX under XLA — correct and fusion-friendly, with
+a Pallas backward kernel planned; long-context training memory is instead
+handled one level up by ring attention (`ray_tpu.parallel.ring_attention`),
+which only ever sees per-chunk blocks.
+
+Layout: public API takes [batch, seq, heads, head_dim] (matching the rest
+of the framework); the kernel runs in [batch, heads, seq, head_dim]. GQA is
+supported by indexing the KV head as ``h * num_kv_heads // num_heads`` in
+the BlockSpec index maps — no KV replication in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only; absent on pure-CPU installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks fully above the diagonal contribute nothing under causality.
+    should_compute = True
+    if causal:
+        should_compute = (iq + 1) * block_q > ik * block_k
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)      # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                              # [bq, bk]
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:]                         # [bq, 128], lanes equal
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)          # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
+        p = jnp.exp(s - m_next[:, :1])                      # [bq, bk]
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        correction = jnp.exp(m_prev[:, :1] - m_next[:, :1])  # [bq, 1]
+        l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:] = m_next
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, interpret: bool):
+    """q: [B, H, S, D]; k/v: [B, Hkv, Sk, D] (already transposed)."""
+    b, h, sq, d = q.shape
+    _, h_kv, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        )
+    scratch = [
+        jax.ShapeDtypeStruct((block_q, 128), jnp.float32),  # m
+        jax.ShapeDtypeStruct((block_q, 128), jnp.float32),  # l
+        jax.ShapeDtypeStruct((block_q, d), jnp.float32),    # acc
+    ]
+    if pltpu is not None:
+        scratch_shapes = [
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ]
+    else:  # pragma: no cover - CPU interpret path without TPU plugin
+        scratch_shapes = [pl.MemoryRef(s.shape, s.dtype) for s in scratch]
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda ib, ih, iq, ik: (ib, ih * h_kv // h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda ib, ih, iq, ik: (ib, ih * h_kv // h, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Reference math (also the backward pass, via recomputation)
+# ---------------------------------------------------------------------------
+
+
+def _attention_reference(q, k, v, causal: bool, sm_scale: float):
+    """[B, H, S, D] layout. GQA-aware."""
+    b, h, sq, d = q.shape
+    h_kv = k.shape[1]
+    if h_kv != h:
+        rep = h // h_kv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sk = k.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret,
+                   residuals, do):
+    q, k, v = residuals
+
+    def ref(q, k, v):
+        return _attention_reference(q, k, v, causal, sm_scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(do)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: Optional[bool] = None,
+                    use_pallas: Optional[bool] = None):
+    """Flash attention over [batch, seq, heads, head_dim] tensors.
+
+    KV tensors may have fewer heads (GQA). On non-TPU backends falls back
+    to the fused-by-XLA reference unless `interpret=True` forces the kernel
+    through the Pallas interpreter (used by tests).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    on_tpu = _on_tpu()
+    if use_pallas is None:
+        use_pallas = on_tpu or bool(interpret)
+    if use_pallas:
+        out = _flash(qt, kt, vt, causal, sm_scale, block_q, block_k,
+                     bool(interpret) and not on_tpu)
+    else:
+        out = _attention_reference(qt, kt, vt, causal, sm_scale)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,108 @@
+"""Softmax cross-entropy over large vocabularies.
+
+The naive form materializes [tokens, vocab] probabilities (f32) — at 128k
+vocab that dominates train-step memory. The blockwise form streams the
+vocab dimension through a `lax.scan`, carrying only the running max /
+log-sum-exp and the label logit, so peak memory is [tokens, block]. Custom
+VJP recomputes per block on the backward pass (the gradient of CE is
+`softmax - onehot`, emitted blockwise into the logits cotangent).
+
+Note: when the vocab projection is tensor-sharded ("vocab" → tensor axis),
+prefer computing loss inside shard_map with `lax.psum` of per-shard partial
+logsumexp — the train layer wires that; this op is the per-shard building
+block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy_reference(logits, labels):
+    """logits: [N, V] (any float dtype), labels: [N] int. Returns [N] f32."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, labels[:, None], axis=-1)[:, 0]
+    return lse - label_logit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_cross_entropy(logits, labels, block_size: int = 8192):
+    """Blockwise CE. logits: [N, V], labels: [N] → per-token loss [N] f32."""
+    loss, _ = _ce_fwd_math(logits, labels, block_size)
+    return loss
+
+
+def _ce_fwd_math(logits, labels, block_size):
+    n, v = logits.shape
+    block_size = min(block_size, v)
+    n_blocks = (v + block_size - 1) // block_size
+    pad = n_blocks * block_size - v
+    if pad:
+        logits_p = jnp.pad(logits, ((0, 0), (0, pad)),
+                           constant_values=-jnp.inf)
+    else:
+        logits_p = logits
+
+    def step(carry, ib):
+        m, s, lbl = carry
+        # Slice + upcast one block at a time: peak extra memory is [N, B]
+        # f32, not [N, V].
+        blk = lax.dynamic_slice_in_dim(
+            logits_p, ib * block_size, block_size, axis=1
+        ).astype(jnp.float32)                       # [N, B]
+        bm = blk.max(axis=-1)
+        m_new = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - m_new) + jnp.exp(blk - m_new[:, None]).sum(-1)
+        # label logit if it falls in this block
+        idx = labels - ib * block_size
+        in_blk = (idx >= 0) & (idx < block_size)
+        gathered = jnp.take_along_axis(
+            blk, jnp.clip(idx, 0, block_size - 1)[:, None], axis=-1)[:, 0]
+        lbl = jnp.where(in_blk, gathered, lbl)
+        return (m_new, s, lbl), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    (m, s, lbl), _ = lax.scan(step, (m0, s0, l0), jnp.arange(n_blocks))
+    lse = m + jnp.log(s)
+    return lse - lbl, (lse,)
+
+
+def _ce_vjp_fwd(logits, labels, block_size):
+    loss, (lse,) = _ce_fwd_math(logits, labels, block_size)
+    return loss, (logits, labels, lse)
+
+
+def _ce_vjp_bwd(block_size, residuals, g):
+    logits, labels, lse = residuals
+    n, v = logits.shape
+    # d/dlogits = softmax(logits) - onehot(labels), scaled by g per row.
+    # Emitted blockwise to avoid a [N, V] f32 temp beyond the cotangent
+    # itself (which is unavoidable: it's the output).
+    block = min(8192, v)
+    n_blocks = (v + block - 1) // block
+    pad = n_blocks * block - v
+
+    def blk_grad(ib):
+        sl = lax.dynamic_slice_in_dim(logits, ib * block, block, axis=1)
+        p = jnp.exp(sl.astype(jnp.float32) - lse[:, None])
+        idx = labels - ib * block
+        onehot = jax.nn.one_hot(jnp.where((idx >= 0) & (idx < block),
+                                          idx, -1), block, dtype=jnp.float32)
+        return ((p - onehot) * g[:, None]).astype(logits.dtype)
+
+    if pad:
+        logits = jnp.pad(logits, ((0, 0), (0, pad)))
+    parts = [blk_grad(ib) for ib in range(n_blocks)]
+    grad = jnp.concatenate(parts, axis=1)[:, :v]
+    return grad, None
+
+
+softmax_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)

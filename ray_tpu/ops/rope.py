@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE), split-half convention.
+
+Pure JAX: a handful of elementwise ops XLA fuses straight into the
+surrounding attention projections — a Pallas kernel would add nothing.
+Frequencies are precomputed once per model and passed in (static shapes,
+no recompute inside the train step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float = 500000.0, dtype=jnp.float32):
+    """Returns (cos, sin) tables of shape [max_seq_len, head_dim // 2].
+
+    theta=500000 is the Llama-3 base; Llama-2 used 10000.
+    """
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [B, S, H, D]; cos/sin: [max_seq, D//2];
+    positions: optional [B, S] int positions (for decode/packed sequences);
+    defaults to arange(S)."""
+    b, s, h, d = x.shape
+    if positions is None:
+        cos_sel = cos[:s][None, :, None, :]     # [1, S, 1, D/2]
+        sin_sel = sin[:s][None, :, None, :]
+    else:
+        cos_sel = cos[positions][:, :, None, :]  # [B, S, 1, D/2]
+        sin_sel = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos_sel - x2 * sin_sel, x2 * cos_sel + x1 * sin_sel], axis=-1
+    )
+    return out.astype(x.dtype)

@@ -1,0 +1,136 @@
+"""Op-level tests: Pallas kernels (interpret mode on CPU) vs reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    layer_norm,
+    rms_norm,
+    rope_frequencies,
+    softmax_cross_entropy,
+)
+from ray_tpu.ops.attention import _attention_reference
+from ray_tpu.ops.cross_entropy import softmax_cross_entropy_reference
+from ray_tpu.ops.norms import rms_norm_pallas, rms_norm_reference
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_interpret_matches_reference(causal):
+    b, s, h, d = 2, 128, 4, 32
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                          interpret=True)
+    expected = _attention_reference(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal, d ** -0.5,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_gqa():
+    b, s, h, h_kv, d = 1, 64, 8, 2, 16
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h_kv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h_kv, d), jnp.float32)
+    got = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+    expected = _attention_reference(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), True, d ** -0.5,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_grad():
+    b, s, h, d = 1, 64, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(3), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(4), (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, block_q=32, block_k=32,
+                               interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return _attention_reference(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), True, d ** -0.5).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_pallas_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 96, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(6), (256,)) * 0.1 + 1.0
+    got = rms_norm_pallas(x, w, interpret=True)
+    expected = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_layer_norm():
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, 64), jnp.float32)
+    w = jnp.ones(64)
+    b = jnp.zeros(64)
+    out = layer_norm(x, w, b)
+    np.testing.assert_allclose(np.asarray(out).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out).std(-1), 1.0, atol=1e-2)
+
+
+def test_rope_rotation_preserves_norm():
+    cos, sin = rope_frequencies(64, 128)
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 100, 4, 64))
+    out = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(x[:, 0]),
+                               atol=1e-6)
+
+
+def test_rope_positions_arg():
+    cos, sin = rope_frequencies(32, 64)
+    x = jax.random.normal(jax.random.PRNGKey(9), (1, 8, 2, 32))
+    pos = jnp.arange(8)[None, :]
+    a = apply_rope(x, cos, sin)
+    b = apply_rope(x, cos, sin, positions=pos)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_cross_entropy_blockwise_matches_reference():
+    n, v = 32, 1000
+    logits = jax.random.normal(jax.random.PRNGKey(10), (n, v)) * 3
+    labels = jax.random.randint(jax.random.PRNGKey(11), (n,), 0, v)
+    got = softmax_cross_entropy(logits, labels, 256)
+    expected = softmax_cross_entropy_reference(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cross_entropy_grad_matches_reference():
+    n, v = 16, 500
+    logits = jax.random.normal(jax.random.PRNGKey(12), (n, v))
+    labels = jax.random.randint(jax.random.PRNGKey(13), (n,), 0, v)
+
+    g1 = jax.grad(lambda l: softmax_cross_entropy(l, labels, 128).mean())(
+        logits)
+    g2 = jax.grad(
+        lambda l: softmax_cross_entropy_reference(l, labels).mean())(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-6)
