@@ -1,0 +1,120 @@
+"""Flagship benchmark: Llama pretrain step throughput, tokens/sec/chip.
+
+Run by the driver on real TPU hardware after every round; prints exactly
+one JSON line. The metric is the BASELINE.json north star ("Train
+tokens/sec/chip"); the reference publishes no number for it
+(`BASELINE.json -> "published": {}`), so `vs_baseline` is reported against
+the first value this repo establishes (stored in BENCH_BASELINE.json once
+measured) or 1.0 until then.
+
+On a single v5e chip (16G HBM) the largest Llama-3-family config that fits
+a full AdamW train step is ~1B with bf16 optimizer moments; multi-chip runs
+shard with the same code via MeshConfig (fsdp/tensor/seq axes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _measure_llama_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import (
+        LlamaConfig,
+        init_params_sharded,
+        init_train_state,
+        loss_fn,
+        make_optimizer,
+        make_train_step,
+    )
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n = len(devices)
+
+    if on_tpu:
+        cfg = LlamaConfig.llama3_1b()
+        batch, seq = 4, 2048
+        moment_dtype = jnp.bfloat16
+        steps = 10
+    else:  # CPU smoke path so the bench always emits a line
+        cfg = LlamaConfig.debug()
+        batch, seq = 8, 128
+        moment_dtype = None
+        steps = 3
+
+    # One chip → trivial mesh; more chips → fsdp-shard the params.
+    mesh = create_mesh(MeshConfig(data=-1, fsdp=min(n, 4) if n > 1 else 1))
+
+    params = init_params_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    tx = make_optimizer(3e-4, warmup_steps=0, moment_dtype=moment_dtype)
+    state = init_train_state(params, tx)
+    step = make_train_step(
+        lambda p, b: loss_fn(p, b, cfg, mesh=mesh), tx, mesh=mesh,
+        batch_logical={"tokens": ("batch", "seq"),
+                       "targets": ("batch", "seq")},
+    )
+
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    # Warmup (compile) then timed steps.
+    state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    per_chip = tokens_per_sec / n
+
+    # Model FLOPs utilization against v5e peak (197 TFLOP/s bf16).
+    flops_per_token = 6 * cfg.num_params() + 12 * cfg.n_layers * cfg.dim * seq
+    mfu = None
+    if on_tpu:
+        mfu = per_chip * flops_per_token / 197e12
+
+    return {
+        "config": f"llama-{cfg.num_params() / 1e9:.2f}B" if on_tpu
+        else "llama-debug-cpu",
+        "value": per_chip,
+        "mfu": mfu,
+        "batch": batch,
+        "seq": seq,
+        "n_chips": n,
+        "step_ms": dt * 1e3,
+    }
+
+
+def main():
+    result = _measure_llama_train_step()
+    baseline_path = os.path.join(os.path.dirname(__file__),
+                                 "BENCH_BASELINE.json")
+    vs = 1.0
+    try:
+        with open(baseline_path) as f:
+            recorded = json.load(f)
+        if recorded.get("value"):
+            vs = result["value"] / recorded["value"]
+    except (OSError, ValueError):
+        pass
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_per_chip[{result['config']}]",
+        "value": round(result["value"], 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+        "detail": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in result.items() if k != "value"},
+    }))
+
+
+if __name__ == "__main__":
+    main()

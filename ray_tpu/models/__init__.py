@@ -1,0 +1,29 @@
+"""Model zoo, TPU-first.
+
+Pure-JAX pytree models (no framework lock-in) annotated with the logical
+sharding axes from `ray_tpu.parallel.sharding`, so the same model code runs
+single-chip, FSDP, tensor-parallel, and context-parallel by swapping mesh +
+rules. The reference has no model zoo of its own (it wraps torch modules);
+these exist because the TPU framework's Train/Serve/RL layers need
+first-class compiled models to schedule.
+
+- ``llama`` — Llama-3-family decoder LM (GQA, RoPE, SwiGLU), the flagship
+- ``mlp``   — small MLP classifier (the fashion-MNIST baseline workload)
+- ``training`` — TrainState + sharded train-step factory
+"""
+
+from ray_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    init_params_sharded,
+    forward,
+    loss_fn,
+    param_logical_axes,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_init, mlp_forward  # noqa: F401
+from ray_tpu.models.training import (  # noqa: F401
+    TrainState,
+    make_optimizer,
+    make_train_step,
+    init_train_state,
+)

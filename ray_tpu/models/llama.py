@@ -1,0 +1,285 @@
+"""Llama-3-family decoder-only LM, written TPU-first.
+
+Design choices (vs. a torch port):
+- Parameters are a plain pytree of arrays with a parallel pytree of
+  *logical axis names* (`param_logical_axes`) — sharding is data, not code.
+- Layers are stacked along a leading axis and driven by `lax.scan` with
+  `jax.checkpoint` on the body: O(1) compile time in depth, per-layer
+  rematerialization for HBM.
+- Attention is pluggable: Pallas flash kernel (single-device sequence),
+  ring attention or Ulysses over the ``seq`` mesh axis (context parallel),
+  or the reference einsum (CPU tests).
+- bf16 params/activations, f32 for softmax/norm statistics — the MXU path.
+
+Config presets follow the Llama-3 family (rope_theta 500000, GQA,
+SwiGLU with the 8/3 expansion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import flash_attention, _attention_reference
+from ray_tpu.ops.cross_entropy import softmax_cross_entropy
+from ray_tpu.ops.norms import rms_norm_reference
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    tree_shardings,
+    with_logical_constraint,
+)
+from ray_tpu.parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    # "auto" | "flash" | "ring" | "ulysses" | "reference"
+    attention: str = "auto"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, h, l, v = self.dim, self.hidden_dim, self.n_layers, self.vocab_size
+        per_layer = (
+            d * self.n_heads * self.head_dim          # wq
+            + 2 * d * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * d         # wo
+            + 3 * d * h                                # w1, w2, w3
+            + 2 * d                                    # norms
+        )
+        embeds = v * d * (1 if self.tie_embeddings else 2)
+        return l * per_layer + embeds + d
+
+    # -- presets ---------------------------------------------------------
+
+    @staticmethod
+    def debug() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                           dtype=jnp.float32, remat=False)
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        # Llama-3.2-1B: 1.23B params, tied embeddings.
+        return LlamaConfig(vocab_size=128256, dim=2048, n_layers=16,
+                           n_heads=32, n_kv_heads=8, hidden_dim=8192,
+                           tie_embeddings=True)
+
+    @staticmethod
+    def llama3_3b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, dim=3072, n_layers=28,
+                           n_heads=24, n_kv_heads=8, hidden_dim=8192,
+                           tie_embeddings=True)
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()  # defaults are 8B
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                           hidden_dim=28672)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def _init_layer(cfg: LlamaConfig, key) -> Dict[str, Any]:
+    d, hd = cfg.dim, cfg.head_dim
+    k1, k2, k3, k4, k5, k6, k7 = jax.random.split(key, 7)
+    scale = d ** -0.5
+    hidden_scale = cfg.hidden_dim ** -0.5
+    init = jax.nn.initializers.normal(stddev=0.02)
+    return {
+        "attn_norm": jnp.ones(d, cfg.dtype),
+        "wq": init(k1, (d, cfg.n_heads, hd), cfg.dtype),
+        "wk": init(k2, (d, cfg.n_kv_heads, hd), cfg.dtype),
+        "wv": init(k3, (d, cfg.n_kv_heads, hd), cfg.dtype),
+        "wo": (init(k4, (cfg.n_heads, hd, d), cfg.dtype) * scale),
+        "mlp_norm": jnp.ones(d, cfg.dtype),
+        "w1": init(k5, (d, cfg.hidden_dim), cfg.dtype),
+        "w3": init(k6, (d, cfg.hidden_dim), cfg.dtype),
+        "w2": (init(k7, (cfg.hidden_dim, d), cfg.dtype) * hidden_scale),
+    }
+
+
+def init_params(cfg: LlamaConfig, rng) -> Dict[str, Any]:
+    k_embed, k_out, k_layers = jax.random.split(rng, 3)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    layers = jax.vmap(functools.partial(_init_layer, cfg))(layer_keys)
+    params = {
+        "embed": jax.nn.initializers.normal(0.02)(
+            k_embed, (cfg.vocab_size, cfg.dim), cfg.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones(cfg.dim, cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["out"] = jax.nn.initializers.normal(0.02)(
+            k_out, (cfg.dim, cfg.vocab_size), cfg.dtype)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Same structure as `init_params` output, with logical-axis tuples as
+    leaves. Leading `None` on layer params is the scanned layer axis."""
+    layer = {
+        "attn_norm": (None, "norm"),
+        "wq": (None, "embed", "heads", "head_dim"),
+        "wk": (None, "embed", "kv_heads", "head_dim"),
+        "wv": (None, "embed", "kv_heads", "head_dim"),
+        "wo": (None, "heads", "head_dim", "embed"),
+        "mlp_norm": (None, "norm"),
+        "w1": (None, "embed", "mlp"),
+        "w3": (None, "embed", "mlp"),
+        "w2": (None, "mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["out"] = ("embed", "vocab")
+    return axes
+
+
+def init_params_sharded(cfg: LlamaConfig, mesh, rng,
+                        rules=DEFAULT_RULES) -> Dict[str, Any]:
+    """Initialize directly into sharded device buffers (no host staging —
+    required for models bigger than host/chip memory)."""
+    shardings = tree_shardings(mesh, param_logical_axes(cfg), rules)
+    fn = jax.jit(functools.partial(init_params, cfg),
+                 out_shardings=shardings)
+    return fn(rng)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _attention(cfg: LlamaConfig, q, k, v, mesh, rules):
+    """q: [B,S,H,D]; k/v: [B,S,Hkv,D] → [B,S,H,D]."""
+    impl = cfg.attention
+    if impl == "auto":
+        seq_parallel = mesh is not None and mesh.shape.get("seq", 1) > 1
+        if seq_parallel:
+            impl = "ring"
+        else:
+            try:
+                on_tpu = jax.devices()[0].platform == "tpu"
+            except Exception:  # pragma: no cover
+                on_tpu = False
+            impl = "flash" if on_tpu else "reference"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=True)
+    if impl in ("ring", "ulysses"):
+        # Ring/Ulysses currently take equal head counts; expand GQA KV
+        # heads (cheap relative to long-context attention itself).
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        fn = ring_attention if impl == "ring" else ulysses_attention
+        return fn(q, k, v, mesh=mesh, axis_name="seq", causal=True)
+    # reference
+    rep = cfg.n_heads // cfg.n_kv_heads
+    out = _attention_reference(
+        q.transpose(0, 2, 1, 3),
+        jnp.repeat(k, rep, axis=2).transpose(0, 2, 1, 3),
+        jnp.repeat(v, rep, axis=2).transpose(0, 2, 1, 3),
+        True, cfg.head_dim ** -0.5)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
+    """One transformer block. x: [B, S, D]."""
+    h = rms_norm_reference(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    q = with_logical_constraint(q, "batch", "seq", "heads", "head_dim",
+                                mesh=mesh, rules=rules)
+    attn = _attention(cfg, q, k, v, mesh, rules)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn.astype(cfg.dtype), lp["wo"])
+    h2 = rms_norm_reference(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h2, lp["w1"]))
+    up = jnp.einsum("bsd,df->bsf", h2, lp["w3"])
+    ff = with_logical_constraint(gate * up, "batch", "seq", "mlp",
+                                 mesh=mesh, rules=rules)
+    x = x + jnp.einsum("bsf,fd->bsd", ff, lp["w2"])
+    x = with_logical_constraint(x, "batch", "seq", "act_embed",
+                                mesh=mesh, rules=rules)
+    return x
+
+
+def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
+            rules=DEFAULT_RULES, positions=None):
+    """tokens: [B, S] int32 → logits [B, S, vocab] (cfg.dtype)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+    # With context parallelism each shard sees a sequence chunk; RoPE
+    # must use global positions, which the caller passes in. Default is
+    # the unsharded arange.
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = with_logical_constraint(x, "batch", "seq", "act_embed",
+                                mesh=mesh, rules=rules)
+
+    body = functools.partial(_layer_fn, cfg, mesh, rules, cos, sin)
+
+    def scan_body(x, lp):
+        return body(x, lp, positions), None
+
+    if cfg.remat:
+        scan_body = jax.checkpoint(
+            scan_body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm_reference(x, params["final_norm"], cfg.norm_eps)
+    out_w = params["embed"].T if cfg.tie_embeddings else params["out"]
+    logits = jnp.einsum("bsd,dv->bsv", x, out_w.astype(cfg.dtype))
+    return with_logical_constraint(logits, "batch", "seq", "vocab",
+                                   mesh=mesh, rules=rules)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, *, mesh=None,
+            rules=DEFAULT_RULES):
+    """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S],
+    optional "positions": [B,S]}. Returns (mean loss f32, metrics dict)."""
+    logits = forward(params, batch["tokens"], cfg, mesh=mesh, rules=rules,
+                     positions=batch.get("positions"))
+    b, s, v = logits.shape
+    losses = softmax_cross_entropy(
+        logits.reshape(b * s, v), batch["targets"].reshape(b * s))
+    losses = losses.reshape(b, s)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    loss = (losses * mask).sum() / total
+    return loss, {"loss": loss, "tokens": total,
+                  "perplexity": jnp.exp(loss)}

@@ -1,0 +1,52 @@
+"""Small MLP classifier — the fashion-MNIST baseline workload.
+
+Reference parity target: the AIR torch MNIST benchmark
+(`release/air_tests/air_benchmarks/workloads/torch_benchmark.py`), which
+asserts DDP throughput parity. Here the same network is a jit-compiled JAX
+function whose data parallelism is a mesh axis, used by the Train-layer
+tests and `bench.py`'s CPU fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (128, 128)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(cfg: MLPConfig, rng):
+    dims = (cfg.in_dim,) + cfg.hidden + (cfg.n_classes,)
+    keys = jax.random.split(rng, len(dims) - 1)
+    params = []
+    for k, (d_in, d_out) in zip(keys, zip(dims[:-1], dims[1:])):
+        w = jax.random.normal(k, (d_in, d_out), cfg.dtype) * (d_in ** -0.5)
+        b = jnp.zeros(d_out, cfg.dtype)
+        params.append({"w": w, "b": b})
+    return params
+
+
+def mlp_forward(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch):
+    logits = mlp_forward(params, batch["x"])
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}

@@ -25,6 +25,10 @@ DEFAULT_RULES: LogicalRules = (
     ("batch", ("data", "fsdp")),
     ("seq", "seq"),          # activation sequence dim (context parallel)
     ("embed", "fsdp"),       # param embed dim (ZeRO-3 shard)
+    ("act_embed", None),     # activation embed dim: replicated — batch
+                             # already consumes data+fsdp; tensor-sharding
+                             # activations here would force a transpose
+                             # before every matmul
     ("mlp", "tensor"),       # param/activation mlp hidden dim
     ("heads", "tensor"),     # attention heads
     ("kv_heads", "tensor"),
