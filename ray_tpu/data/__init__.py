@@ -1,0 +1,37 @@
+"""ray_tpu.data: distributed data loading and transformation.
+
+The Datasets-equivalent (reference `python/ray/data/`, SURVEY.md §2.4):
+Arrow-backed blocks in the object store, a lazy fused execution plan over
+the core task/actor runtime, streaming iteration with backpressure, and a
+TPU ingest path (`Dataset.iter_jax_batches`) that stages batches host→HBM
+ahead of the consumer.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
+from ray_tpu.data.plan import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data import preprocessors  # noqa: F401
+from ray_tpu.data.aggregate import (  # noqa: F401
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)

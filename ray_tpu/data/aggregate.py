@@ -1,0 +1,164 @@
+"""GroupBy + aggregations over the block model.
+
+Reference: `data/grouped_data.py` + `data/aggregate.py` (AggregateFn,
+Sum/Min/Max/Mean/Std/Count). Implementation: hash-partition blocks by key
+(remote map), then per-partition pandas groupby (remote reduce) — the
+pull-based shuffle pattern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+
+@dataclass
+class AggregateFn:
+    name: str
+    init: Callable[[], Any]
+    accumulate: Callable[[Any, Any], Any]
+    merge: Callable[[Any, Any], Any]
+    finalize: Callable[[Any], Any] = lambda a: a
+
+
+def Count():  # noqa: N802 - reference naming
+    return ("count", None)
+
+
+def Sum(on: str):  # noqa: N802
+    return ("sum", on)
+
+
+def Min(on: str):  # noqa: N802
+    return ("min", on)
+
+
+def Max(on: str):  # noqa: N802
+    return ("max", on)
+
+
+def Mean(on: str):  # noqa: N802
+    return ("mean", on)
+
+
+def Std(on: str):  # noqa: N802
+    return ("std", on)
+
+
+@ray_tpu.remote
+def _hash_partition(block, key, n):
+    acc = BlockAccessor(block)
+    vals = acc.to_numpy(key)
+    hashes = np.asarray([hash(v) % n for v in vals])
+    out = []
+    for j in range(n):
+        idx = np.nonzero(hashes == j)[0].tolist()
+        out.append(acc.take(idx) if idx else acc.slice(0, 0))
+    return out
+
+
+@ray_tpu.remote
+def _list_index(lst, j):
+    return lst[j]
+
+
+@ray_tpu.remote
+def _agg_partition(key, specs, *parts):
+    import pandas as pd
+
+    df = pd.concat([BlockAccessor(p).to_pandas() for p in parts],
+                   ignore_index=True)
+    if df.empty:
+        return df
+    g = df.groupby(key, sort=True)
+    cols = {}
+    for op, on in specs:
+        if op == "count":
+            cols["count()"] = g.size()
+        else:
+            series = getattr(g[on], op)()
+            cols[f"{op}({on})"] = series
+    out = pd.DataFrame(cols).reset_index()
+    return out
+
+
+@ray_tpu.remote
+def _map_groups(key, fn, batch_format, *parts):
+    import pandas as pd
+
+    df = pd.concat([BlockAccessor(p).to_pandas() for p in parts],
+                   ignore_index=True)
+    if df.empty:
+        return df
+    outs = []
+    for _, group in df.groupby(key, sort=True):
+        if batch_format in ("numpy", "default"):
+            batch = {c: group[c].to_numpy() for c in group.columns}
+        else:
+            batch = group
+        result = fn(batch)
+        outs.append(BlockAccessor(
+            BlockAccessor.batch_to_block(result)).to_pandas())
+    return pd.concat(outs, ignore_index=True) if outs else df.iloc[:0]
+
+
+class GroupedData:
+    """Reference: `data/grouped_data.py` GroupedData."""
+
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def _shuffled_partitions(self, n: Optional[int] = None) -> List:
+        refs = self._ds._plan.execute()
+        n = n or max(1, len(refs))
+        splits = [_hash_partition.remote(r, self._key, n) for r in refs]
+        parts_per_out = []
+        for j in range(n):
+            parts_per_out.append([_list_index.remote(s, j) for s in splits])
+        return parts_per_out
+
+    def aggregate(self, *specs) -> "Any":
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data.plan import ExecutionPlan
+
+        parts = self._shuffled_partitions()
+        refs = [_agg_partition.remote(self._key, list(specs), *p)
+                for p in parts]
+        plan = ExecutionPlan([])
+        plan._cached = refs
+        return Dataset(plan)
+
+    def count(self):
+        return self.aggregate(Count())
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str):
+        return self.aggregate(Min(on))
+
+    def max(self, on: str):
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str):
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "default"):
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data.plan import ExecutionPlan
+
+        parts = self._shuffled_partitions()
+        refs = [_map_groups.remote(self._key, fn, batch_format, *p)
+                for p in parts]
+        plan = ExecutionPlan([])
+        plan._cached = refs
+        return Dataset(plan)

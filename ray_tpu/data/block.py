@@ -1,0 +1,284 @@
+"""Block model: the unit of distributed data.
+
+Role-equivalent to the reference's `python/ray/data/block.py:99` (Block =
+list | Arrow table | pandas DataFrame) and `BlockAccessor` (`block.py:237`,
+Arrow impl `_internal/arrow_block.py`). Arrow is the canonical format —
+zero-copy into numpy and, downstream, into pinned host staging buffers for
+device transfer. Lists/DataFrames are accepted and normalized lazily.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Any  # pyarrow.Table | pandas.DataFrame | list
+
+
+@dataclass
+class BlockMetadata:
+    """Reference: `data/block.py` BlockMetadata."""
+
+    num_rows: Optional[int] = None
+    size_bytes: Optional[int] = None
+    schema: Any = None
+    input_files: List[str] = field(default_factory=list)
+    exec_stats: Optional[dict] = None
+
+
+class BlockAccessor:
+    """Uniform view over a block. `BlockAccessor.for_block(b)`."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- introspection ---------------------------------------------------
+
+    def num_rows(self) -> int:
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.num_rows
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return len(b)
+        except ImportError:  # pragma: no cover
+            pass
+        return len(b)
+
+    def size_bytes(self) -> int:
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.nbytes
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return int(b.memory_usage(deep=True).sum())
+        except ImportError:  # pragma: no cover
+            pass
+        return sum(sys.getsizeof(r) for r in b)
+
+    def schema(self):
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.schema
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return pa.Schema.from_pandas(b)
+        except (ImportError, Exception):  # pragma: no cover
+            pass
+        if b:
+            first = b[0]
+            if isinstance(first, dict):
+                return {k: type(v).__name__ for k, v in first.items()}
+            return type(first).__name__
+        return None
+
+    def metadata(self, input_files: Optional[List[str]] = None,
+                 exec_stats: Optional[dict] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(), size_bytes=self.size_bytes(),
+            schema=self.schema(), input_files=input_files or [],
+            exec_stats=exec_stats,
+        )
+
+    # -- conversions -----------------------------------------------------
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return pa.Table.from_pandas(b, preserve_index=False)
+        except ImportError:  # pragma: no cover
+            pass
+        rows = [r if isinstance(r, dict) else {"item": r} for r in b]
+        if not rows:
+            return pa.table({})
+        return pa.Table.from_pylist(rows)
+
+    def to_pandas(self):
+        import pandas as pd
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pd.DataFrame):
+            return b
+        if isinstance(b, pa.Table):
+            return b.to_pandas()
+        return self.to_arrow().to_pandas()
+
+    def to_numpy(self, columns: Optional[Union[str, List[str]]] = None):
+        """Dict of column -> np.ndarray (or single array for one column)."""
+        t = self.to_arrow()
+        cols = ([columns] if isinstance(columns, str)
+                else columns or t.column_names)
+        out = {}
+        for c in cols:
+            col = t.column(c)
+            out[c] = _arrow_column_to_numpy(col)
+        if isinstance(columns, str):
+            return out[columns]
+        return out
+
+    def to_batch(self) -> Dict[str, np.ndarray]:
+        return self.to_numpy()
+
+    def iter_rows(self) -> Iterator[Any]:
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, list):
+            yield from b
+            return
+        t = b if isinstance(b, pa.Table) else self.to_arrow()
+        for row in t.to_pylist():
+            yield row
+
+    # -- slicing / combination -------------------------------------------
+
+    def slice(self, start: int, end: int) -> Block:
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.slice(start, end - start)
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return b.iloc[start:end]
+        except ImportError:  # pragma: no cover
+            pass
+        return b[start:end]
+
+    def take(self, indices) -> Block:
+        import pyarrow as pa
+
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.take(indices)
+        try:
+            import pandas as pd
+
+            if isinstance(b, pd.DataFrame):
+                return b.iloc[list(indices)]
+        except ImportError:  # pragma: no cover
+            pass
+        return [b[i] for i in indices]
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        import pyarrow as pa
+
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0] or \
+            blocks[:1]
+        if not blocks:
+            return []
+        first = blocks[0]
+        if isinstance(first, list):
+            out: list = []
+            for b in blocks:
+                out.extend(b)
+            return out
+        try:
+            import pandas as pd
+
+            if isinstance(first, pd.DataFrame):
+                return pd.concat(blocks, ignore_index=True)
+        except ImportError:  # pragma: no cover
+            pass
+        tables = [BlockAccessor(b).to_arrow() for b in blocks]
+        return pa.concat_tables(tables, promote_options="default")
+
+    @staticmethod
+    def batch_to_block(batch) -> Block:
+        """Normalize a user-returned batch (dict of arrays / DataFrame /
+        Arrow table / list) into a block."""
+        import pyarrow as pa
+
+        if isinstance(batch, (pa.Table, list)):
+            return batch
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return batch
+        except ImportError:  # pragma: no cover
+            pass
+        if isinstance(batch, dict):
+            cols = {}
+            for k, v in batch.items():
+                v = np.asarray(v)
+                if v.ndim > 1:
+                    # Tensor column: store as fixed-shape list array.
+                    cols[k] = _numpy_to_arrow_tensor(v)
+                else:
+                    cols[k] = pa.array(v)
+            return pa.table(cols)
+        raise TypeError(f"unsupported batch type: {type(batch)}")
+
+
+def _arrow_column_to_numpy(col) -> np.ndarray:
+    """ChunkedArray -> numpy, reassembling fixed-shape tensor columns."""
+    import pyarrow as pa
+
+    combined = col.combine_chunks() if isinstance(col, pa.ChunkedArray) \
+        else col
+    if isinstance(combined, pa.ChunkedArray):
+        combined = pa.concat_arrays(combined.chunks) if combined.chunks \
+            else pa.array([])
+    if isinstance(combined.type, pa.FixedShapeTensorType):
+        return combined.to_numpy_ndarray()
+    if pa.types.is_list(combined.type) or pa.types.is_large_list(
+            combined.type):
+        return np.asarray(combined.to_pylist(), dtype=object) \
+            if _ragged(combined) else np.asarray(combined.to_pylist())
+    try:
+        return combined.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, NotImplementedError):
+        return np.asarray(combined.to_pylist())
+
+
+def _ragged(arr) -> bool:
+    lengths = {len(x) if x is not None else 0 for x in arr.to_pylist()}
+    return len(lengths) > 1
+
+
+def _numpy_to_arrow_tensor(v: np.ndarray):
+    import pyarrow as pa
+
+    try:
+        tensor_type = pa.fixed_shape_tensor(pa.from_numpy_dtype(v.dtype),
+                                            v.shape[1:])
+        flat = pa.array(v.reshape(len(v), -1).tolist(),
+                        type=pa.list_(pa.from_numpy_dtype(v.dtype)))
+        return pa.FixedShapeTensorArray.from_storage(
+            tensor_type,
+            flat.cast(pa.list_(pa.from_numpy_dtype(v.dtype),
+                               int(np.prod(v.shape[1:])))),
+        )
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, ValueError):
+        return pa.array(v.tolist())

@@ -1,0 +1,206 @@
+"""Datasources: pluggable reads producing blocks, and file writers.
+
+Reference: `python/ray/data/datasource/` (parquet/csv/json/numpy/binary/
+text readers built on pyarrow, `ReadTask` model). A `Datasource` yields
+`ReadTask`s — plain callables returning an iterator of blocks — which the
+execution plan schedules as remote tasks, so reads parallelize and
+pipeline like any other operator.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+@dataclass
+class ReadTask:
+    fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata = field(default_factory=BlockMetadata)
+
+    def __call__(self) -> Iterable[Block]:
+        return self.fn()
+
+
+class Datasource:
+    """ABC. Reference: `data/datasource/datasource.py`."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, tensor_shape: Optional[tuple] = None,
+                 column: str = "id"):
+        self._n = n
+        self._shape = tensor_shape
+        self._column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        import pyarrow as pa
+
+        n = self._n
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism
+        tasks = []
+        for start in range(0, n, chunk):
+            end = min(start + chunk, n)
+
+            def make(start=start, end=end):
+                ids = np.arange(start, end)
+                if self._shape:
+                    data = np.broadcast_to(
+                        ids.reshape(-1, *([1] * len(self._shape))),
+                        (end - start, *self._shape)).copy()
+                    return [BlockAccessor.batch_to_block(
+                        {self._column: data})]
+                return [pa.table({self._column: ids})]
+
+            tasks.append(ReadTask(lambda s=start, e=end: make(s, e),
+                                  BlockMetadata(num_rows=end - start)))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism
+        tasks = []
+        for start in range(0, n, chunk):
+            part = items[start:start + chunk]
+            tasks.append(ReadTask(lambda p=part: [list(p)],
+                                  BlockMetadata(num_rows=len(part))))
+        return tasks
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith("."))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files for {paths}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """Shared path-expansion + per-file read tasks."""
+
+    def __init__(self, paths, **read_options):
+        self._paths = _expand_paths(paths)
+        self._options = read_options
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        # One task per file; parallelism griding beyond file count would
+        # need row-group splitting (parquet only — future work).
+        tasks = []
+        for path in self._paths:
+            tasks.append(ReadTask(
+                lambda p=path: self._read_file(p),
+                BlockMetadata(input_files=[path]),
+            ))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow.parquet as pq
+
+        columns = self._options.get("columns")
+        table = pq.read_table(path, columns=columns)
+        yield table
+
+
+class CSVDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path, **self._options)
+
+
+class JSONDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(path, **self._options)
+
+
+class NumpyDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        arr = np.load(path, allow_pickle=False)
+        yield BlockAccessor.batch_to_block({"data": arr})
+
+
+class BinaryDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow as pa
+
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()),
+                        "path": [path]})
+
+
+class TextDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow as pa
+
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield pa.table({"text": lines})
+
+
+# ---------------------------------------------------------------------------
+# Writers
+# ---------------------------------------------------------------------------
+
+
+def write_block_parquet(block: Block, path: str, index: int) -> str:
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:06d}.parquet")
+    pq.write_table(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_block_csv(block: Block, path: str, index: int) -> str:
+    import pyarrow.csv as pacsv
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:06d}.csv")
+    pacsv.write_csv(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_block_json(block: Block, path: str, index: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:06d}.json")
+    BlockAccessor(block).to_pandas().to_json(out, orient="records",
+                                             lines=True)
+    return out

@@ -1,0 +1,507 @@
+"""Lazy execution plan + distributed execution over the core runtime.
+
+Role-equivalent to the reference's `data/_internal/plan.py` (ExecutionPlan),
+`_internal/logical/` (logical ops), and the execution engine
+(`_internal/execution/streaming_executor.py`). Map-like operators fuse into
+one task per block (the reference's operator fusion); all-to-all operators
+(repartition/shuffle/sort) are stage barriers implemented as two-phase
+map/reduce task graphs. Block payloads live in the object store as
+ObjectRefs end-to-end — the driver only ever touches small metadata.
+
+Streaming: `iter_block_refs` yields completed block refs with a bounded
+in-flight window (backpressure), so downstream consumers (e.g. the
+train-ingest iterator) pipeline against upstream compute.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.datasource import Datasource
+
+# ---------------------------------------------------------------------------
+# Logical operators
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LogicalOp:
+    name: str = "op"
+
+
+@dataclass
+class Read(LogicalOp):
+    datasource: Optional[Datasource] = None
+    parallelism: int = -1
+
+
+@dataclass
+class FromBlocks(LogicalOp):
+    blocks: List[Block] = field(default_factory=list)
+
+
+@dataclass
+class MapBlocks(LogicalOp):
+    """A fused block→block transform (map_batches/map/filter/flat_map all
+    lower to this)."""
+
+    fn: Optional[Callable[[Block], Block]] = None
+    compute: Any = None  # None (tasks) | ActorPoolStrategy
+    num_cpus: float = 1.0
+
+
+@dataclass
+class Repartition(LogicalOp):
+    num_blocks: int = 1
+
+
+@dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+    num_blocks: Optional[int] = None
+
+
+@dataclass
+class Sort(LogicalOp):
+    key: Optional[str] = None
+    descending: bool = False
+
+
+@dataclass
+class Limit(LogicalOp):
+    limit: int = 0
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List["ExecutionPlan"] = field(default_factory=list)
+
+
+@dataclass
+class Zip(LogicalOp):
+    other: Optional["ExecutionPlan"] = None
+
+
+class ActorPoolStrategy:
+    """Reference: `data/_internal/compute.py` ActorPoolStrategy."""
+
+    def __init__(self, size: int = 2, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.size = max_size or size
+        self.min_size = min_size or size
+
+
+# ---------------------------------------------------------------------------
+# Remote task bodies
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+def _apply_fn(fn, block):
+    return fn(block)
+
+
+@ray_tpu.remote
+def _read_task(task):
+    blocks = list(task())
+    return BlockAccessor.concat(blocks) if len(blocks) != 1 else blocks[0]
+
+
+@ray_tpu.remote
+def _meta_of(block):
+    return BlockAccessor(block).metadata()
+
+
+@ray_tpu.remote
+def _slice_concat(ranges, *blocks):
+    """ranges: [(block_idx, start, end)]; blocks passed as top-level args
+    so ObjectRefs resolve to values before execution."""
+    parts = [BlockAccessor(blocks[i]).slice(s, e) for i, s, e in ranges]
+    return BlockAccessor.concat(parts)
+
+
+@ray_tpu.remote
+def _split_random(block, n, seed):
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    rng = random.Random(seed)
+    assignment = [rng.randrange(n) for _ in range(rows)]
+    out = []
+    for j in range(n):
+        idx = [i for i, a in enumerate(assignment) if a == j]
+        out.append(acc.take(idx) if idx else acc.slice(0, 0))
+    return out
+
+
+@ray_tpu.remote
+def _split_by_key(block, boundaries, key, descending):
+    """Range-partition a block by key into len(boundaries)+1 parts."""
+    import numpy as np
+
+    acc = BlockAccessor(block)
+    vals = acc.to_numpy(key)
+    part_ids = np.searchsorted(np.asarray(boundaries), vals, side="right")
+    out = []
+    for j in range(len(boundaries) + 1):
+        idx = np.nonzero(part_ids == j)[0].tolist()
+        out.append(acc.take(idx) if idx else acc.slice(0, 0))
+    return out
+
+
+@ray_tpu.remote
+def _merge_sorted(key, descending, *parts):
+    block = BlockAccessor.concat(list(parts))
+    t = BlockAccessor(block).to_arrow()
+    order = "descending" if descending else "ascending"
+    return t.sort_by([(key, order)])
+
+
+@ray_tpu.remote
+def _concat_blocks(*parts):
+    return BlockAccessor.concat(list(parts))
+
+
+@ray_tpu.remote
+def _zip_blocks(left, right):
+    import pyarrow as pa
+
+    lt = BlockAccessor(left).to_arrow()
+    rt = BlockAccessor(right).to_arrow()
+    cols = {name: lt.column(name) for name in lt.column_names}
+    for name in rt.column_names:
+        out_name = name if name not in cols else f"{name}_1"
+        cols[out_name] = rt.column(name)
+    return pa.table(cols)
+
+
+@ray_tpu.remote
+def _sample_block(block, key, n):
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    if rows == 0:
+        return []
+    idx = random.sample(range(rows), min(n, rows))
+    vals = BlockAccessor(acc.take(idx)).to_numpy(key)
+    return list(vals)
+
+
+# ---------------------------------------------------------------------------
+# Execution plan
+# ---------------------------------------------------------------------------
+
+
+class _StageStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.wall_s = 0.0
+        self.num_blocks = 0
+        self.num_rows = 0
+
+    def summary(self) -> dict:
+        return {"name": self.name, "wall_s": round(self.wall_s, 4),
+                "blocks": self.num_blocks, "rows": self.num_rows}
+
+
+class ExecutionPlan:
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+        self._cached: Optional[List] = None  # list of block refs
+        self._cached_meta: Optional[List[BlockMetadata]] = None
+        self.stats: List[_StageStats] = []
+
+    def with_op(self, op: LogicalOp) -> "ExecutionPlan":
+        return ExecutionPlan(self.ops + [op])
+
+    # -- fusion ----------------------------------------------------------
+
+    def _fused_stages(self) -> List[LogicalOp]:
+        """Fuse consecutive MapBlocks with the same compute strategy."""
+        stages: List[LogicalOp] = []
+        for op in self.ops:
+            if (isinstance(op, MapBlocks) and stages
+                    and isinstance(stages[-1], MapBlocks)
+                    and stages[-1].compute is None and op.compute is None):
+                prev = stages[-1]
+
+                def fused(block, f=prev.fn, g=op.fn):
+                    return g(f(block))
+
+                stages[-1] = MapBlocks(
+                    name=f"{prev.name}->{op.name}", fn=fused,
+                    num_cpus=max(prev.num_cpus, op.num_cpus))
+            else:
+                stages.append(op)
+        return stages
+
+    # -- execution -------------------------------------------------------
+
+    def execute(self) -> List:
+        if self._cached is None:
+            refs: List = []
+            self.stats = []
+            for op in self._fused_stages():
+                t0 = time.perf_counter()
+                refs = self._execute_op(op, refs)
+                st = _StageStats(op.name)
+                st.wall_s = time.perf_counter() - t0
+                st.num_blocks = len(refs)
+                self.stats.append(st)
+            self._cached = refs
+        return self._cached
+
+    def metadata(self) -> List[BlockMetadata]:
+        if self._cached_meta is None:
+            refs = self.execute()
+            self._cached_meta = ray_tpu.get(
+                [_meta_of.remote(r) for r in refs])
+        return self._cached_meta
+
+    def clear_cache(self):
+        self._cached = None
+        self._cached_meta = None
+
+    def _execute_op(self, op: LogicalOp, refs: List) -> List:
+        if isinstance(op, Read):
+            tasks = op.datasource.get_read_tasks(op.parallelism)
+            return [_read_task.remote(t) for t in tasks]
+        if isinstance(op, FromBlocks):
+            return [ray_tpu.put(b) for b in op.blocks]
+        if isinstance(op, MapBlocks):
+            if isinstance(op.compute, ActorPoolStrategy):
+                return self._map_with_actor_pool(op, refs)
+            return [_apply_fn.options(num_cpus=op.num_cpus).remote(op.fn, r)
+                    for r in refs]
+        if isinstance(op, Repartition):
+            return self._repartition(refs, op.num_blocks)
+        if isinstance(op, RandomShuffle):
+            return self._random_shuffle(refs, op)
+        if isinstance(op, Sort):
+            return self._sort(refs, op)
+        if isinstance(op, Limit):
+            return self._limit(refs, op.limit)
+        if isinstance(op, Union):
+            out = list(refs)
+            for p in op.others:
+                out.extend(p.execute())
+            return out
+        if isinstance(op, Zip):
+            return self._zip(refs, op.other)
+        raise NotImplementedError(f"op {op}")
+
+    # -- map on actor pool ----------------------------------------------
+
+    def _map_with_actor_pool(self, op: MapBlocks, refs: List) -> List:
+        from ray_tpu.util.actor_pool import ActorPool
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, fn):
+                # Class-based transforms construct once per actor (the
+                # reference's stateful UDF semantics).
+                self.fn = fn() if isinstance(fn, type) else fn
+
+            def apply(self, block):
+                return self.fn(block)
+
+        n = min(op.compute.size, max(1, len(refs)))
+        actors = [_MapWorker.options(num_cpus=op.num_cpus).remote(op.fn)
+                  for _ in range(n)]
+        pool = ActorPool(actors)
+        try:
+            return list(pool.map_refs(lambda a, ref: a.apply.remote(ref),
+                                      refs))
+        finally:
+            for a in actors:
+                ray_tpu.kill(a)
+
+    # -- all-to-all ------------------------------------------------------
+
+    def _row_layout(self, refs: List) -> List[int]:
+        metas = ray_tpu.get([_meta_of.remote(r) for r in refs])
+        return [m.num_rows for m in metas]
+
+    def _repartition(self, refs: List, n_out: int) -> List:
+        rows = self._row_layout(refs)
+        total = sum(rows)
+        n_out = max(1, n_out)
+        target = [total // n_out + (1 if i < total % n_out else 0)
+                  for i in range(n_out)]
+        # Build (input_idx, start, end) ranges per output partition.
+        out_refs = []
+        in_idx, in_off = 0, 0
+        for tgt in target:
+            need = tgt
+            pieces = []
+            while need > 0 and in_idx < len(refs):
+                avail = rows[in_idx] - in_off
+                take = min(avail, need)
+                if take > 0:
+                    pieces.append((refs[in_idx], in_off, in_off + take))
+                    in_off += take
+                    need -= take
+                if in_off >= rows[in_idx]:
+                    in_idx += 1
+                    in_off = 0
+            blocks = [p[0] for p in pieces]
+            ranges = [(i, s, e) for i, (_, s, e) in enumerate(pieces)]
+            out_refs.append(_slice_concat.remote(ranges, *blocks))
+        return out_refs
+
+    def _random_shuffle(self, refs: List, op: RandomShuffle) -> List:
+        n_out = op.num_blocks or max(1, len(refs))
+        seed = op.seed if op.seed is not None else random.randrange(2**31)
+        splits = [_split_random.options(num_returns=1).remote(
+            r, n_out, seed + i) for i, r in enumerate(refs)]
+        # splits[i] is a list of n_out sub-blocks; index remotely.
+        out = []
+        for j in range(n_out):
+            parts = [_index_list.remote(s, j) for s in splits]
+            out.append(_concat_blocks.remote(*parts))
+        return out
+
+    def _sort(self, refs: List, op: Sort) -> List:
+        if not refs:
+            return refs
+        n_out = len(refs)
+        samples: List = []
+        for s in ray_tpu.get([_sample_block.remote(r, op.key, 16)
+                              for r in refs]):
+            samples.extend(s)
+        if not samples:
+            return refs
+        samples.sort()
+        boundaries = [samples[int(len(samples) * (i + 1) / n_out)]
+                      for i in range(n_out - 1)]
+        splits = [_split_by_key.remote(r, boundaries, op.key, op.descending)
+                  for r in refs]
+        out = []
+        part_order = range(n_out - 1, -1, -1) if op.descending \
+            else range(n_out)
+        for j in part_order:
+            parts = [_index_list.remote(s, j) for s in splits]
+            out.append(_merge_sorted.remote(op.key, op.descending, *parts))
+        return out
+
+    def _limit(self, refs: List, limit: int) -> List:
+        rows = self._row_layout(refs)
+        out, acc = [], 0
+        for r, n in zip(refs, rows):
+            if acc >= limit:
+                break
+            take = min(n, limit - acc)
+            if take == n:
+                out.append(r)
+            else:
+                out.append(_slice_concat.remote([(0, 0, take)], r))
+            acc += take
+        return out
+
+    def _zip(self, refs: List, other: "ExecutionPlan") -> List:
+        right_refs = other.execute()
+        left_rows = self._row_layout(refs)
+        # Align the right side to the left side's row layout.
+        right_aligned = ExecutionPlan([])
+        right_aligned._cached = right_refs
+        rows_total = sum(left_rows)
+        right_rows = right_aligned._row_layout(right_refs)
+        if sum(right_rows) != rows_total:
+            raise ValueError(
+                f"zip requires equal row counts: {rows_total} vs "
+                f"{sum(right_rows)}")
+        # Repartition right to match left block sizes.
+        sizes = left_rows
+        aligned = []
+        in_idx, in_off = 0, 0
+        for tgt in sizes:
+            need, pieces = tgt, []
+            while need > 0 and in_idx < len(right_refs):
+                avail = right_rows[in_idx] - in_off
+                take = min(avail, need)
+                if take > 0:
+                    pieces.append((right_refs[in_idx], in_off,
+                                   in_off + take))
+                    in_off += take
+                    need -= take
+                if in_off >= right_rows[in_idx]:
+                    in_idx += 1
+                    in_off = 0
+            aligned.append(_slice_concat.remote(
+                [(i, s, e) for i, (_, s, e) in enumerate(pieces)],
+                *[p[0] for p in pieces]))
+        return [_zip_blocks.remote(l, r) for l, r in zip(refs, aligned)]
+
+    # -- streaming -------------------------------------------------------
+
+    def iter_block_refs(self, window: int = 8) -> Iterator:
+        """Yield block refs in order, submitting work lazily with at most
+        `window` unconsumed blocks in flight (backpressure)."""
+        # All-to-all stages force materialization; map chains stream.
+        stages = self._fused_stages()
+        streamable = all(
+            isinstance(op, (Read, FromBlocks, MapBlocks, Limit))
+            for op in stages
+        ) and not any(
+            isinstance(op, MapBlocks)
+            and isinstance(op.compute, ActorPoolStrategy) for op in stages
+        )
+        if self._cached is not None or not streamable:
+            yield from self.execute()
+            return
+
+        # Build the source list + fused transform chain.
+        sources: List[Tuple[str, Any]] = []
+        transforms: List[Callable[[Block], Block]] = []
+        limit = None
+        for op in stages:
+            if isinstance(op, Read):
+                sources = [("task", t)
+                           for t in op.datasource.get_read_tasks(
+                               op.parallelism)]
+            elif isinstance(op, FromBlocks):
+                sources = [("block", b) for b in op.blocks]
+            elif isinstance(op, MapBlocks):
+                transforms.append(op.fn)
+            elif isinstance(op, Limit):
+                limit = op.limit
+
+        def submit(src):
+            kind, payload = src
+            if kind == "task":
+                ref = _read_task.remote(payload)
+            else:
+                ref = ray_tpu.put(payload)
+            for fn in transforms:
+                ref = _apply_fn.remote(fn, ref)
+            return ref
+
+        produced_rows = 0
+        in_flight: List = []
+        src_iter = iter(sources)
+        while True:
+            while len(in_flight) < window:
+                nxt = next(src_iter, None)
+                if nxt is None:
+                    break
+                in_flight.append(submit(nxt))
+            if not in_flight:
+                return
+            ref = in_flight.pop(0)
+            if limit is not None:
+                nrows = ray_tpu.get(_meta_of.remote(ref)).num_rows
+                if produced_rows >= limit:
+                    return
+                if produced_rows + nrows > limit:
+                    ref = _slice_concat.remote(
+                        [(0, 0, limit - produced_rows)], ref)
+                produced_rows += nrows
+            yield ref
+
+
+@ray_tpu.remote
+def _index_list(lst, j):
+    return lst[j]

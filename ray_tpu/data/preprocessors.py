@@ -1,0 +1,221 @@
+"""Preprocessors: fit/transform over Datasets.
+
+Reference: `python/ray/data/preprocessors/` (scalers, encoders, imputers,
+concatenator, chain, batch mapper). Fit computes statistics with Dataset
+aggregates; transform lowers to `map_batches`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _is_fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._is_fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._is_fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return ds.map_batches(self._transform_numpy, batch_format="numpy")
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]):
+        return self._transform_numpy(dict(batch))
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds):
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            vals = ds.to_numpy(c)
+            self.stats_[c] = (float(np.mean(vals)),
+                              float(np.std(vals) or 1.0))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            batch[c] = (batch[c] - mean) / (std or 1.0)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            vals = ds.to_numpy(c)
+            lo, hi = float(np.min(vals)), float(np.max(vals))
+            self.stats_[c] = (lo, hi if hi > lo else lo + 1.0)
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            batch[c] = (batch[c] - lo) / (hi - lo)
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[np.ndarray] = None
+
+    def _fit(self, ds):
+        vals = ds.to_numpy(self.label_column)
+        self.classes_ = np.unique(vals)
+
+    def _transform_numpy(self, batch):
+        lookup = {v: i for i, v in enumerate(self.classes_)}
+        batch[self.label_column] = np.asarray(
+            [lookup[v] for v in batch[self.label_column]])
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.categories_: Dict[str, np.ndarray] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            self.categories_[c] = np.unique(ds.to_numpy(c))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            cats = self.categories_[c]
+            lookup = {v: i for i, v in enumerate(cats)}
+            idx = np.asarray([lookup.get(v, -1) for v in batch[c]])
+            onehot = np.zeros((len(idx), len(cats)), np.float32)
+            valid = idx >= 0
+            onehot[np.arange(len(idx))[valid], idx[valid]] = 1.0
+            del batch[c]
+            batch[c] = onehot
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value=None):
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, float] = {}
+
+    def _needs_fit(self) -> bool:
+        return self.strategy != "constant"
+
+    def _fit(self, ds):
+        import pandas as pd
+
+        df = ds.to_pandas()
+        for c in self.columns:
+            if self.strategy == "mean":
+                self.stats_[c] = float(df[c].mean())
+            elif self.strategy == "median":
+                self.stats_[c] = float(df[c].median())
+            elif self.strategy == "most_frequent":
+                self.stats_[c] = df[c].mode().iloc[0]
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            fill = self.fill_value if self.strategy == "constant" \
+                else self.stats_[c]
+            v = batch[c].astype(float) if self.strategy != "constant" \
+                else batch[c]
+            mask = np.asarray([x is None or (isinstance(x, float)
+                                             and np.isnan(x)) for x in v]) \
+                if v.dtype == object else np.isnan(v)
+            v = np.where(mask, fill, v)
+            batch[c] = v
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one vector column (model input)."""
+
+    def __init__(self, *, include: Optional[List[str]] = None,
+                 exclude: Optional[List[str]] = None,
+                 output_column_name: str = "concat_out",
+                 dtype=np.float32):
+        self.include = include
+        self.exclude = exclude or []
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        cols = self.include or [c for c in batch if c not in self.exclude]
+        arrs = []
+        for c in cols:
+            v = np.asarray(batch[c])
+            arrs.append(v.reshape(len(v), -1).astype(self.dtype))
+            del batch[c]
+        batch[self.output_column_name] = np.concatenate(arrs, axis=1)
+        return batch
+
+
+class BatchMapper(Preprocessor):
+    def __init__(self, fn: Callable, batch_format: str = "numpy"):
+        self.fn = fn
+        self.batch_format = batch_format
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def transform(self, ds):
+        return ds.map_batches(self.fn, batch_format=self.batch_format)
+
+    def _transform_numpy(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = preprocessors
+
+    def fit(self, ds):
+        for p in self.preprocessors:
+            ds_t = p.fit(ds).transform(ds)
+            ds = ds_t
+        self._is_fitted = True
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch

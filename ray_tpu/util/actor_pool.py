@@ -1,0 +1,128 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference: `python/ray/util/actor_pool.py` — same surface (map,
+map_unordered, submit/get_next, push/pop_idle), plus `map_refs` used by the
+data layer to stream ObjectRefs through a pool without fetching values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending: List = []
+
+    # -- core ------------------------------------------------------------
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queues if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending)
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending
+
+    def _drain_pending(self):
+        while self._pending and self._idle:
+            fn, value = self._pending.pop(0)
+            self.submit(fn, value)
+
+    def get_next(self, timeout: float = None):
+        """Next result in submission order."""
+        if self._next_return_index not in self._index_to_future:
+            if not self.has_next():
+                raise StopIteration("no pending results")
+            self._drain_pending()
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(future, timeout=timeout)
+        self._return_actor(future)
+        return value
+
+    def get_next_unordered(self, timeout: float = None):
+        if not self._index_to_future and not self._pending:
+            raise StopIteration("no pending results")
+        self._drain_pending()
+        ready, _ = ray_tpu.wait(list(self._index_to_future.values()),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        for idx, f in list(self._index_to_future.items()):
+            if f == future:
+                del self._index_to_future[idx]
+                break
+        value = ray_tpu.get(future)
+        self._return_actor(future)
+        return value
+
+    def _return_actor(self, future):
+        actor = self._future_to_actor.pop(future, None)
+        if actor is not None:
+            self._idle.append(actor)
+            self._drain_pending()
+
+    # -- bulk helpers ----------------------------------------------------
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def map_refs(self, fn: Callable[[Any, Any], Any],
+                 refs: Iterable[Any]) -> List[Any]:
+        """Run fn(actor, ref) for each ref, returning result *refs* in
+        order (results stay in the object store)."""
+        refs = list(refs)
+        out: List[Any] = [None] * len(refs)
+        submitted: dict = {}
+        i = 0
+        while i < len(refs) or submitted:
+            while i < len(refs) and self._idle:
+                actor = self._idle.pop()
+                future = fn(actor, refs[i])
+                submitted[future] = (i, actor)
+                i += 1
+            if submitted:
+                ready, _ = ray_tpu.wait(list(submitted), num_returns=1)
+                f = ready[0]
+                idx, actor = submitted.pop(f)
+                out[idx] = f
+                self._idle.append(actor)
+        return out
+
+    # -- membership ------------------------------------------------------
+
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+        self._drain_pending()
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None

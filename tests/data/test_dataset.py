@@ -1,0 +1,182 @@
+"""Dataset API tests (reference model: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rd.range(100, parallelism=5)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 5
+    rows = ds.take(3)
+    assert [r["id"] for r in rows] == [0, 1, 2]
+
+
+def test_from_items_and_map():
+    ds = rd.from_items(list(range(10))).map(lambda x: x * 2)
+    assert ds.take_all() == [x * 2 for x in range(10)]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 10}, batch_size=8)
+    vals = [r["id"] for r in ds.take_all()]
+    assert vals == [i * 10 for i in range(64)]
+
+
+def test_map_batches_pandas():
+    def add_col(df):
+        df = df.copy()
+        df["sq"] = df["id"] ** 2
+        return df
+
+    ds = rd.range(16).map_batches(add_col, batch_format="pandas")
+    rows = ds.take_all()
+    assert rows[3] == {"id": 3, "sq": 9}
+
+
+def test_filter_flat_map():
+    ds = rd.from_items(list(range(10))).filter(lambda x: x % 2 == 0)
+    assert ds.take_all() == [0, 2, 4, 6, 8]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert ds2.take_all() == [1, 10, 2, 20]
+
+
+def test_repartition():
+    ds = rd.range(100, parallelism=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 100
+    # rows preserved in order for non-shuffle repartition
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+
+def test_random_shuffle_preserves_multiset():
+    ds = rd.range(50, parallelism=5).random_shuffle(seed=42)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(50))
+    # A fixed-seed shuffle should not be the identity permutation.
+    vals2 = [r["id"] for r in ds.take_all()]
+    assert vals2 != list(range(50))
+
+
+def test_sort():
+    ds = rd.from_items([{"v": x} for x in [5, 3, 8, 1, 9, 2, 7]])
+    got = [r["v"] for r in ds.sort("v").take_all()]
+    assert got == [1, 2, 3, 5, 7, 8, 9]
+    got_desc = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert got_desc == [9, 8, 7, 5, 3, 2, 1]
+
+
+def test_limit_union_zip():
+    assert rd.range(100).limit(7).count() == 7
+    u = rd.range(5).union(rd.range(5))
+    assert u.count() == 10
+    z = rd.range(4).zip(rd.range(4).map_batches(
+        lambda b: {"other": b["id"] + 100}))
+    rows = z.take_all()
+    assert rows[0] == {"id": 0, "other": 100}
+
+
+def test_split():
+    parts = rd.range(90, parallelism=9).split(3)
+    assert len(parts) == 3
+    assert sum(p.count() for p in parts) == 90
+
+
+def test_split_at_indices():
+    parts = rd.range(10).split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+    assert [r["id"] for r in parts[1].take_all()] == [3, 4, 5, 6]
+
+
+def test_aggregates():
+    ds = rd.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_groupby():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    out = ds.groupby("k").sum("v").to_pandas().sort_values("k")
+    assert list(out["k"]) == [0, 1, 2]
+    assert list(out["sum(v)"]) == [sum(i for i in range(12) if i % 3 == k)
+                                   for k in range(3)]
+
+
+def test_groupby_map_groups():
+    ds = rd.from_items([{"k": i % 2, "v": float(i)} for i in range(8)])
+    out = ds.groupby("k").map_groups(
+        lambda b: {"k": b["k"][:1], "n": np.array([len(b["v"])])})
+    rows = sorted(out.take_all(), key=lambda r: r["k"])
+    assert rows == [{"k": 0, "n": 4}, {"k": 1, "n": 4}]
+
+
+def test_iter_batches_fixed_size():
+    ds = rd.range(100, parallelism=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32,
+                                                   drop_last=True)]
+    assert sizes == [32, 32, 32]
+
+
+def test_iter_jax_batches():
+    ds = rd.range(64, parallelism=4)
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 4
+    import jax
+
+    assert isinstance(batches[0]["id"], jax.Array)
+    all_ids = np.concatenate([np.asarray(b["id"]) for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(64))
+
+
+def test_actor_pool_compute():
+    class Doubler:
+        def __call__(self, batch):
+            return {"id": batch["id"] * 2}
+
+    ds = rd.range(40, parallelism=4).map_batches(
+        Doubler, compute=rd.ActorPoolStrategy(size=2))
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i * 2 for i in range(40)]
+
+
+def test_tensor_columns_roundtrip():
+    arr = np.random.rand(20, 3, 4).astype(np.float32)
+    ds = rd.from_numpy(arr)
+    out = ds.to_numpy("data")
+    np.testing.assert_allclose(out, arr)
+    mapped = ds.map_batches(lambda b: {"data": b["data"] * 2})
+    np.testing.assert_allclose(mapped.to_numpy("data"), arr * 2)
+
+
+def test_fusion_stages():
+    ds = rd.range(10).map(lambda x: x).filter(lambda r: True).map(
+        lambda x: x)
+    ds.materialize()
+    # Read + one fused map stage
+    names = [s.name for s in ds._plan.stats]
+    assert len(names) == 2, names
+
+
+def test_stats():
+    ds = rd.range(10).map_batches(lambda b: b)
+    ds.materialize()
+    import json
+
+    stats = json.loads(ds.stats())
+    assert all("wall_s" in s for s in stats)

@@ -1,0 +1,117 @@
+"""File IO datasources, writers, preprocessors."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    SimpleImputer,
+    StandardScaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_parquet_roundtrip(tmp_path):
+    df = pd.DataFrame({"a": range(50), "b": np.random.rand(50)})
+    ds = rd.from_pandas(df).repartition(4)
+    files = ds.write_parquet(str(tmp_path / "out"))
+    assert len(files) == 4
+    back = rd.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 50
+    pd.testing.assert_frame_equal(
+        back.to_pandas().sort_values("a").reset_index(drop=True), df)
+
+
+def test_csv_roundtrip(tmp_path):
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    rd.from_pandas(df).write_csv(str(tmp_path / "csv"))
+    back = rd.read_csv(str(tmp_path / "csv"))
+    assert back.count() == 3
+    assert back.take(1)[0] == {"x": 1, "y": "a"}
+
+
+def test_json_roundtrip(tmp_path):
+    df = pd.DataFrame({"x": [1, 2, 3]})
+    rd.from_pandas(df).write_json(str(tmp_path / "js"))
+    back = rd.read_json(str(tmp_path / "js"))
+    assert back.count() == 3
+
+
+def test_read_text_and_binary(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+    ds2 = rd.read_binary_files(str(p))
+    row = ds2.take(1)[0]
+    assert row["bytes"] == b"hello\nworld\n"
+
+
+def test_numpy_roundtrip(tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+    np.save(tmp_path / "a.npy", arr)
+    ds = rd.read_numpy(str(tmp_path / "a.npy"))
+    np.testing.assert_allclose(ds.to_numpy("data"), arr)
+
+
+def test_standard_scaler():
+    ds = rd.from_pandas(pd.DataFrame({"a": [1.0, 2.0, 3.0, 4.0]}))
+    sc = StandardScaler(["a"]).fit(ds)
+    out = sc.transform(ds).to_numpy("a")
+    np.testing.assert_allclose(out.mean(), 0.0, atol=1e-7)
+    np.testing.assert_allclose(out.std(), 1.0, atol=1e-7)
+
+
+def test_minmax_label_onehot():
+    df = pd.DataFrame({"a": [0.0, 5.0, 10.0], "lbl": ["x", "y", "x"]})
+    ds = rd.from_pandas(df)
+    mm = MinMaxScaler(["a"]).fit(ds)
+    np.testing.assert_allclose(mm.transform(ds).to_numpy("a"),
+                               [0.0, 0.5, 1.0])
+    le = LabelEncoder("lbl").fit(ds)
+    assert le.transform(ds).to_numpy("lbl").tolist() == [0, 1, 0]
+    oh = OneHotEncoder(["lbl"]).fit(ds)
+    out = oh.transform(ds).to_numpy("lbl")
+    np.testing.assert_allclose(out, [[1, 0], [0, 1], [1, 0]])
+
+
+def test_imputer_and_concatenator():
+    df = pd.DataFrame({"a": [1.0, np.nan, 3.0], "b": [4.0, 5.0, 6.0]})
+    ds = rd.from_pandas(df)
+    imp = SimpleImputer(["a"]).fit(ds)
+    out = imp.transform(ds).to_numpy("a")
+    np.testing.assert_allclose(out, [1.0, 2.0, 3.0])
+    cat = Concatenator(output_column_name="features")
+    out2 = cat.transform(imp.transform(ds)).to_numpy("features")
+    assert out2.shape == (3, 2)
+
+
+def test_chain_and_batch_mapper():
+    df = pd.DataFrame({"a": [1.0, 2.0, 3.0]})
+    ds = rd.from_pandas(df)
+    chain = Chain(
+        StandardScaler(["a"]),
+        BatchMapper(lambda b: {"a": b["a"] * 2}),
+    ).fit(ds)
+    out = chain.transform(ds).to_numpy("a")
+    np.testing.assert_allclose(out.mean(), 0.0, atol=1e-7)
+    np.testing.assert_allclose(out.std(), 2.0, atol=1e-7)
+    batch_out = chain.transform_batch({"a": np.array([2.0])})
+    assert "a" in batch_out
