@@ -1,0 +1,16 @@
+"""ray_tpu.air: shared ML plumbing (reference `python/ray/air/`).
+
+Checkpoint (dict ↔ directory ↔ bytes, pytree-aware), ScalingConfig with
+TPU mesh axes instead of `use_gpu`, RunConfig/FailureConfig/
+CheckpointConfig, the worker-side `session` API, and Result.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.air import session  # noqa: F401

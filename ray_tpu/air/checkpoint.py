@@ -1,0 +1,156 @@
+"""Checkpoint: the universal training-artifact currency.
+
+Reference: `python/ray/air/checkpoint.py:63` — a checkpoint freely
+interconverts between dict, directory, bytes, and object-store forms.
+Extended here with pytree awareness: JAX arrays (including sharded ones)
+are fetched to host numpy on save and restored with `jax.device_put` on
+load, so checkpoints round-trip across mesh topologies (the elastic
+re-slice + restore recovery path, SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import shutil
+import tarfile
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_PYTREE_FILE = "pytree.npz"
+_META_FILE = "checkpoint_meta.pkl"
+
+
+def _to_host(tree):
+    """jax/device arrays → numpy, leaving other leaves untouched."""
+    try:
+        import jax
+
+        return jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, jax.Array) else x, tree)
+    except ImportError:  # pragma: no cover
+        return tree
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 local_path: Optional[str] = None):
+        if (data is None) == (local_path is None):
+            raise ValueError("exactly one of data/local_path required")
+        self._data = data
+        self._local_path = local_path
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=_to_host(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(local_path=path)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        return cls(data=pickle.loads(blob))
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        if uri.startswith("file://"):
+            return cls.from_directory(uri[len("file://"):])
+        return cls.from_directory(uri)
+
+    # -- conversions -----------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        meta_path = os.path.join(self._local_path, _META_FILE)
+        npz_path = os.path.join(self._local_path, _PYTREE_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                data = pickle.load(f)
+            if os.path.exists(npz_path):
+                arrays = np.load(npz_path, allow_pickle=False)
+                flat = [arrays[k] for k in sorted(
+                    arrays.files, key=lambda s: int(s.split("_")[1]))]
+                import jax
+
+                treedef = data.pop("__treedef__")
+                data["__pytree__"] = jax.tree.unflatten(treedef, flat)
+            return data
+        # Arbitrary directory: pack file contents.
+        out: Dict[str, Any] = {}
+        for root, _, files in os.walk(self._local_path):
+            for fname in files:
+                p = os.path.join(root, fname)
+                rel = os.path.relpath(p, self._local_path)
+                with open(p, "rb") as f:
+                    out[rel] = f.read()
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._local_path is not None:
+            if os.path.abspath(self._local_path) != os.path.abspath(path):
+                shutil.copytree(self._local_path, path, dirs_exist_ok=True)
+            return path
+        data = dict(self._data)
+        pytree = data.pop("__pytree__", None)
+        if pytree is not None:
+            import jax
+
+            flat, treedef = jax.tree.flatten(_to_host(pytree))
+            np.savez(os.path.join(path, _PYTREE_FILE),
+                     **{f"leaf_{i}": np.asarray(x)
+                        for i, x in enumerate(flat)})
+            data["__treedef__"] = treedef
+        with open(os.path.join(path, _META_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return path
+
+    def to_bytes(self) -> bytes:
+        if self._data is not None:
+            return pickle.dumps(_to_host(self._data))
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self._local_path, arcname=".")
+        return pickle.dumps({"__tar__": buf.getvalue()})
+
+    def to_uri(self, uri: str) -> str:
+        assert uri.startswith("file://"), "only file:// URIs supported"
+        return "file://" + self.to_directory(uri[len("file://"):])
+
+    # -- pytree sugar ----------------------------------------------------
+
+    @classmethod
+    def from_pytree(cls, tree, **extra) -> "Checkpoint":
+        """Store a JAX pytree (e.g. a TrainState) plus metadata."""
+        return cls(data={"__pytree__": _to_host(tree), **extra})
+
+    def to_pytree(self, *, shardings=None):
+        """Restore the pytree; with `shardings` (matching structure) the
+        leaves are placed directly onto the mesh."""
+        data = self.to_dict()
+        tree = data.get("__pytree__")
+        if tree is None:
+            raise ValueError("checkpoint has no pytree payload")
+        if shardings is not None:
+            import jax
+
+            tree = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), tree, shardings)
+        return tree
+
+    def metadata(self) -> Dict[str, Any]:
+        d = self.to_dict()
+        return {k: v for k, v in d.items() if k != "__pytree__"}
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else "dir"
+        return f"Checkpoint({kind})"

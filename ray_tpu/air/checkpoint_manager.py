@@ -1,0 +1,57 @@
+"""Checkpoint manager: keep top-K checkpoints by score.
+
+Reference: `python/ray/air/_internal/checkpoint_manager.py` +
+`CheckpointConfig` (`air/config.py:567`).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        self._heap: List[Tuple[float, int, Checkpoint, dict]] = []
+        self._counter = itertools.count()
+        self.latest: Optional[Checkpoint] = None
+        self.latest_metrics: Optional[dict] = None
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> None:
+        metrics = metrics or {}
+        self.latest = checkpoint
+        self.latest_metrics = metrics
+        attr = self.config.checkpoint_score_attribute
+        if attr is not None and attr in metrics:
+            score = float(metrics[attr])
+        else:
+            score = float(metrics.get("training_iteration", 0))
+        # Min-heap of "badness": pop the worst when over capacity.
+        sign = 1.0 if self.config.checkpoint_score_order == "max" else -1.0
+        heapq.heappush(self._heap,
+                       (sign * score, next(self._counter), checkpoint,
+                        metrics))
+        keep = self.config.num_to_keep
+        if keep is not None and len(self._heap) > keep:
+            heapq.heappop(self._heap)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._heap:
+            return self.latest
+        return max(self._heap)[2]
+
+    @property
+    def best_metrics(self) -> Optional[dict]:
+        if not self._heap:
+            return self.latest_metrics
+        return max(self._heap)[3]
+
+    def best_checkpoints(self) -> List[Tuple[Checkpoint, dict]]:
+        return [(c, m) for _, _, c, m in sorted(self._heap, reverse=True)]

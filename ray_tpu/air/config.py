@@ -1,0 +1,104 @@
+"""Run/scaling/failure/checkpoint configs.
+
+Reference: `python/ray/air/config.py:80` (ScalingConfig), `:508`
+(FailureConfig), `:567` (CheckpointConfig), `:695` (RunConfig). The TPU
+shift: `use_gpu` becomes `use_tpu` + a `mesh` (MeshConfig or axis dict) —
+parallelism is declared as named mesh axes (dp/fsdp/tp/sp/ep/pp) rather
+than inferred from a flat worker count, and placement groups reserve
+whole ICI slices for the group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class ScalingConfig:
+    """How a Train run scales.
+
+    num_workers: actors in the worker group — one per *host/process*
+    (on TPU pods the in-host parallelism is the mesh, not more workers).
+    mesh: named-axis parallelism spec applied inside each SPMD program.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    mesh: Optional[Union[MeshConfig, Dict[str, int]]] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def mesh_config(self) -> MeshConfig:
+        if self.mesh is None:
+            return MeshConfig()
+        if isinstance(self.mesh, MeshConfig):
+            return self.mesh
+        return MeshConfig(**self.mesh)
+
+    @property
+    def num_cpus_per_worker(self) -> float:
+        return (self.resources_per_worker or {}).get("CPU", 1.0)
+
+    @property
+    def num_tpus_per_worker(self) -> float:
+        default = 1.0 if self.use_tpu else 0.0
+        return (self.resources_per_worker or {}).get("TPU", default)
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+    def as_placement_group_factory(self):
+        from ray_tpu.util.placement_group import PlacementGroupFactory
+
+        bundles = [dict(self.trainer_resources or {"CPU": 0.0})]
+        bundles += [self.worker_resources()
+                    for _ in range(self.num_workers)]
+        return PlacementGroupFactory(bundles,
+                                     strategy=self.placement_strategy)
+
+
+@dataclass
+class FailureConfig:
+    """Reference: `air/config.py:508`."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: `air/config.py:567` — keep top-K by score."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be max|min")
+
+
+@dataclass
+class RunConfig:
+    """Reference: `air/config.py:695`."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    stop: Optional[Union[Dict[str, Any], Callable]] = None
+    verbose: int = 1
+    callbacks: List[Any] = field(default_factory=list)
+    log_to_file: bool = False

@@ -1,0 +1,32 @@
+"""Result: what a training/tuning run returns.
+
+Reference: `python/ray/air/result.py` — final metrics, best checkpoint,
+error (if any), and the full metrics history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]] = None
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[Exception] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    path: Optional[str] = None
+    best_checkpoints: List[tuple] = field(default_factory=list)
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.metrics_history)
+
+    @property
+    def config(self) -> Optional[dict]:
+        return (self.metrics or {}).get("config")

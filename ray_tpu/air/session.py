@@ -1,0 +1,121 @@
+"""Worker-side training session API.
+
+Reference: `python/ray/air/session.py` — `session.report(metrics,
+checkpoint=)` is the single channel from the user's train loop back to the
+framework (`:43`), plus rank/shard accessors. The active session is a
+thread-local set up by the worker-group actor running the loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+class TrainSession:
+    """Backing object; created by `train/_internal` per worker."""
+
+    def __init__(self, *, world_rank: int = 0, world_size: int = 1,
+                 local_rank: int = 0, local_world_size: int = 1,
+                 node_rank: int = 0, dataset_shards: Optional[dict] = None,
+                 checkpoint: Optional[Checkpoint] = None,
+                 trial_name: str = "", trial_id: str = "",
+                 experiment_name: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.local_world_size = local_world_size
+        self.node_rank = node_rank
+        self.dataset_shards = dataset_shards or {}
+        self.loaded_checkpoint = checkpoint
+        self.trial_name = trial_name
+        self.trial_id = trial_id
+        self.experiment_name = experiment_name
+        self._results: list = []
+        self._lock = threading.Lock()
+        self._iteration = 0
+
+    # called by the user loop
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        with self._lock:
+            self._iteration += 1
+            metrics = dict(metrics)
+            metrics.setdefault("training_iteration", self._iteration)
+            self._results.append((metrics, checkpoint))
+
+    # called by the framework poller
+    def drain_results(self) -> list:
+        with self._lock:
+            out = self._results
+            self._results = []
+            return out
+
+
+def _session() -> TrainSession:
+    s = getattr(_local, "session", None)
+    if s is None:
+        raise RuntimeError(
+            "No training session active — session.* may only be called "
+            "inside a train loop launched by a Trainer.")
+    return s
+
+
+def _set_session(s: Optional[TrainSession]) -> None:
+    _local.session = s
+
+
+def get_session() -> Optional[TrainSession]:
+    return getattr(_local, "session", None)
+
+
+# -- public API (mirrors reference naming) ---------------------------------
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _session().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _session().loaded_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return _session().dataset_shards.get(name)
+
+
+def get_world_rank() -> int:
+    return _session().world_rank
+
+
+def get_world_size() -> int:
+    return _session().world_size
+
+
+def get_local_rank() -> int:
+    return _session().local_rank
+
+
+def get_local_world_size() -> int:
+    return _session().local_world_size
+
+
+def get_node_rank() -> int:
+    return _session().node_rank
+
+
+def get_trial_name() -> str:
+    return _session().trial_name
+
+
+def get_trial_id() -> str:
+    return _session().trial_id
+
+
+def get_experiment_name() -> str:
+    return _session().experiment_name

@@ -1,0 +1,24 @@
+"""ray_tpu.train: distributed training (reference `python/ray/train/`).
+
+`JaxTrainer` replaces TorchTrainer: SPMD mesh programs instead of NCCL
+process groups. `DataParallelTrainer` is the generic worker-group driver;
+`BackendExecutor`/`WorkerGroup` are the internals (SURVEY.md §3.3 call
+stack).
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig  # noqa: F401
+from ray_tpu.train.base_trainer import BaseTrainer  # noqa: F401
+from ray_tpu.train.data_parallel_trainer import (  # noqa: F401
+    DataParallelTrainer,
+)
+from ray_tpu.train.jax_trainer import (  # noqa: F401
+    JaxConfig,
+    JaxTrainer,
+    allreduce_gradients,
+    prepare_mesh,
+)
+from ray_tpu.train._internal.backend_executor import (  # noqa: F401
+    BackendExecutor,
+    TrainingFailedError,
+)
+from ray_tpu.train._internal.worker_group import WorkerGroup  # noqa: F401

@@ -1,0 +1,137 @@
+"""BackendExecutor: orchestrates a WorkerGroup through a training run.
+
+Reference: `python/ray/train/_internal/backend_executor.py:43` — `start`
+creates the worker group in the run's placement group and assigns ranks;
+`start_training` launches the loop on every worker; `poll` streams
+per-iteration results back (the reference's queue plumbing,
+`train/_internal/session.py:322`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: Optional[BackendConfig],
+                 scaling_config: ScalingConfig):
+        self.backend_config = backend_config or BackendConfig()
+        self.backend: Backend = self.backend_config.backend_cls()()
+        self.scaling_config = scaling_config
+        self.worker_group: Optional[WorkerGroup] = None
+        self.placement_group = None
+        self._own_pg = False
+
+    def start(self, placement_group=None):
+        sc = self.scaling_config
+        if placement_group is None and (sc.num_tpus_per_worker or
+                                        sc.num_workers > 1):
+            factory = sc.as_placement_group_factory()
+            placement_group = factory()
+            placement_group.wait(timeout=60)
+            self._own_pg = True
+        self.placement_group = placement_group
+        self.worker_group = WorkerGroup(
+            sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_group=placement_group,
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable,
+                       config: Optional[Dict[str, Any]],
+                       datasets: Optional[Dict[str, Any]] = None,
+                       checkpoint: Optional[Checkpoint] = None,
+                       group_name: str = "train") -> None:
+        assert self.worker_group is not None, "call start() first"
+        n = len(self.worker_group)
+        self.backend.on_training_start(self.worker_group,
+                                       self.backend_config)
+
+        # Shard datasets across workers (reference: dataset splitting in
+        # `data_parallel_trainer.py`). The "train" dataset is split; other
+        # datasets are passed whole to every worker.
+        shards_per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in (datasets or {}).items():
+            if name == "train" and n > 1:
+                for i, shard in enumerate(ds.split(n, equal=True)):
+                    shards_per_worker[i][name] = shard
+            else:
+                for i in range(n):
+                    shards_per_worker[i][name] = ds
+
+        unique = f"{group_name}-{int(time.time() * 1e6) & 0xFFFFFF:x}"
+        calls = []
+        for rank, worker in enumerate(self.worker_group.workers):
+            session_kwargs = dict(
+                world_rank=rank, world_size=n, local_rank=rank,
+                local_world_size=n, node_rank=0,
+                dataset_shards=shards_per_worker[rank],
+                checkpoint=checkpoint,
+            )
+            wrapped = _wrap_with_collective(train_fn, n, rank, unique)
+            calls.append(worker.start_training.remote(
+                wrapped, config, session_kwargs))
+        ray_tpu.get(calls)
+
+    def poll(self) -> Dict[str, Any]:
+        """One polling sweep over all workers. Returns
+        {"results": [per-worker lists], "done": bool, "errors": [...]}"""
+        polls = ray_tpu.get([w.poll.remote()
+                             for w in self.worker_group.workers])
+        return {
+            "results": [p["results"] for p in polls],
+            "done": all(p["done"] for p in polls),
+            "errors": [p["error"] for p in polls],
+        }
+
+    def join(self, timeout: Optional[float] = None):
+        ray_tpu.get([w.join.remote(timeout)
+                     for w in self.worker_group.workers])
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self._own_pg and self.placement_group is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self.placement_group)
+            except Exception:
+                pass
+            self.placement_group = None
+
+
+def _wrap_with_collective(train_fn: Callable, world_size: int, rank: int,
+                          group_name: str) -> Callable:
+    """Bind a host-collective group inside the train-loop thread, so user
+    code can `ray_tpu.util.collective.allreduce(...)` out of the box."""
+
+    def wrapped(config=None):
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(world_size, rank,
+                                         group_name=group_name)
+        # The default group alias lets user code omit the group name.
+        collective._groups()["default"] = collective._groups()[group_name]
+        try:
+            if config is not None:
+                return train_fn(config)
+            return train_fn()
+        finally:
+            collective._groups().pop("default", None)
+
+    return wrapped

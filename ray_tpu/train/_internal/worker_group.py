@@ -1,0 +1,131 @@
+"""WorkerGroup: the actor fleet that runs a train loop.
+
+Reference: `python/ray/train/_internal/worker_group.py:92`. Each worker is
+an actor; `start_training` launches the user loop on a thread inside the
+actor (so the actor stays responsive to result polling — the reference
+uses a `_TrainSession` thread + queue, `train/_internal/session.py:63`).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as session_mod
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@ray_tpu.remote
+class TrainWorker:
+    def __init__(self):
+        self._session: Optional[session_mod.TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._error_obj: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._env: Dict[str, str] = {}
+
+    def set_env(self, env: Dict[str, str]):
+        import os
+
+        self._env = env
+        os.environ.update(env)
+        return True
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       session_kwargs: Dict[str, Any]) -> bool:
+        self._session = session_mod.TrainSession(**session_kwargs)
+        self._done.clear()
+        self._error = None
+
+        def run():
+            session_mod._set_session(self._session)
+            try:
+                if config is not None:
+                    train_fn(config)
+                else:
+                    train_fn()
+            except BaseException as e:  # noqa: BLE001 - reported to driver
+                self._error = traceback.format_exc()
+                self._error_obj = e
+            finally:
+                session_mod._set_session(None)
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain new results; report liveness + error state."""
+        results = self._session.drain_results() if self._session else []
+        return {
+            "results": results,
+            "done": self._done.is_set(),
+            "error": self._error,
+        }
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        self._done.wait(timeout)
+        if self._error:
+            raise RuntimeError(f"train loop failed:\n{self._error}")
+        return True
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function on the worker (reference
+        WorkerGroup.execute)."""
+        return fn(*args, **kwargs)
+
+    def shutdown(self) -> bool:
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 placement_group=None):
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        res = dict(resources_per_worker or {"CPU": 1})
+        opts: Dict[str, Any] = {
+            "num_cpus": res.pop("CPU", 1),
+        }
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        if res:
+            opts["resources"] = res
+        self.workers: List[Any] = []
+        for i in range(num_workers):
+            o = dict(opts)
+            if placement_group is not None:
+                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=placement_group,
+                    # bundle 0 is the trainer's; workers take 1..N
+                    placement_group_bundle_index=i + 1
+                    if placement_group.bundle_count > num_workers else i,
+                )
+            self.workers.append(TrainWorker.options(**o).remote())
+
+    def __len__(self):
+        return len(self.workers)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)
+                            for w in self.workers])
+
+    def execute_single(self, idx: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(self.workers[idx].execute.remote(fn, *args,
+                                                            **kwargs))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,44 @@
+"""Backend ABC: per-framework worker-group setup hooks.
+
+Reference: `python/ray/train/backend.py` (Backend/BackendConfig) — torch's
+impl sets up the NCCL process group (`train/torch/config.py:113`). TPU
+backends instead wire host-level collective groups and/or
+`jax.distributed` multi-host init; in-program parallelism needs no setup
+(the mesh is formed inside the train loop).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        """Called after workers start, before the train fn runs."""
+
+    def on_training_start(self, worker_group,
+                          backend_config: BackendConfig):
+        """Called right before start_training on each worker."""
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        """Called at teardown."""
+
+
+@dataclass
+class CollectiveGroupConfig(BackendConfig):
+    """Gives every train loop a host-level object-plane collective group
+    (`gloo` replacement). Group init happens inside the train-loop thread
+    (the BackendExecutor wraps the user fn) because group membership is
+    thread-scoped in the in-process runtime."""
+
+    group_name: str = "train_default"
+
+    def backend_cls(self):
+        return Backend

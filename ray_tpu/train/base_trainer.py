@@ -1,0 +1,76 @@
+"""BaseTrainer: the `Trainer.fit()` contract.
+
+Reference: `python/ray/train/base_trainer.py:53` — a Trainer wraps itself
+as a Tune Trainable and runs through `Tuner` even for a single run
+(`fit :540`). Here the same layering holds: `fit()` delegates to a
+single-trial Tune run when the tune layer is importable, falling back to a
+direct driver loop; either path produces an `air.Result`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 preprocessor=None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.preprocessor = preprocessor
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # -- subclass hooks --------------------------------------------------
+
+    def setup(self) -> None:
+        """One-time setup before training (subclass hook)."""
+
+    def preprocess_datasets(self) -> None:
+        if self.preprocessor is None:
+            return
+        train_ds = self.datasets.get("train")
+        if train_ds is not None and getattr(
+                self.preprocessor, "_is_fitted", False) is False:
+            self.preprocessor.fit(train_ds)
+        self.datasets = {
+            k: self.preprocessor.transform(v)
+            for k, v in self.datasets.items()
+        }
+
+    def training_loop(self) -> None:
+        """Drive the actual training; call `session.report` with results.
+        Subclasses must implement."""
+        raise NotImplementedError
+
+    # -- entry point -----------------------------------------------------
+
+    def fit(self) -> Result:
+        """Run to completion and return a Result.
+
+        Mirrors the reference's Trainer→Tuner wrapping
+        (`base_trainer.py:540`): one trial, driven by the tune layer's
+        trial loop for uniform checkpoint/failure handling.
+        """
+        from ray_tpu.tune.trainable import wrap_trainer_as_trainable
+        from ray_tpu.tune.tuner import Tuner
+
+        trainable = wrap_trainer_as_trainable(self)
+        tuner = Tuner(trainable, run_config=self.run_config)
+        grid = tuner.fit()
+        result = grid[0]
+        if result.error and self.run_config.failure_config.fail_fast:
+            raise result.error
+        return result
+
+    def as_trainable(self):
+        from ray_tpu.tune.trainable import wrap_trainer_as_trainable
+
+        return wrap_trainer_as_trainable(self)

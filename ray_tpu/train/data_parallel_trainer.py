@@ -1,0 +1,74 @@
+"""DataParallelTrainer: run a user train loop on N workers.
+
+Reference: `python/ray/train/data_parallel_trainer.py:385`
+(`training_loop` drives `BackendExecutor`). The training_loop here polls
+workers and re-reports rank-0's metrics (with checkpoints) up through
+`session.report`, so the same code path serves direct `.fit()` and Tune
+trials.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+
+
+class DataParallelTrainer(BaseTrainer):
+    _backend_config_cls = BackendConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 preprocessor=None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         preprocessor=preprocessor,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or self._backend_config_cls()
+
+    def training_loop(self) -> None:
+        self.preprocess_datasets()
+        executor = BackendExecutor(self.backend_config, self.scaling_config)
+        executor.start()
+        try:
+            fn = self.train_loop_per_worker
+            takes_config = len(
+                inspect.signature(fn).parameters) >= 1
+            config = self.train_loop_config if takes_config else None
+            executor.start_training(
+                fn if takes_config else (lambda _cfg=None: fn()),
+                config=config if takes_config else {},
+                datasets=self.datasets,
+                checkpoint=self.resume_from_checkpoint,
+            )
+            while True:
+                poll = executor.poll()
+                errors = [e for e in poll["errors"] if e]
+                # Stream rank-0 results upward, attaching checkpoints.
+                rank0 = poll["results"][0]
+                for metrics, ckpt in rank0:
+                    session.report(metrics, checkpoint=ckpt)
+                if errors:
+                    raise RuntimeError(
+                        "training failed on "
+                        f"{len(errors)}/{len(poll['errors'])} workers:\n"
+                        + errors[0])
+                if poll["done"]:
+                    break
+                time.sleep(0.02)
+        finally:
+            executor.shutdown()

@@ -1,0 +1,116 @@
+"""JaxTrainer: SPMD training over TPU meshes.
+
+The reference's TorchTrainer forms an NCCL process group per worker
+(`train/torch/config.py:113`). The TPU-native model is different
+(SURVEY.md §7 "multi-controller JAX"): one worker per *host*, each running
+the same jit-compiled SPMD program; in-host (and cross-host, on pods)
+parallelism is the `jax.sharding.Mesh`, with collectives inserted by XLA.
+The trainer's job is (a) reserving the gang via placement group, (b)
+initializing `jax.distributed` on each worker for multi-host, (c) handing
+the train loop a ready mesh via `prepare_mesh()`.
+
+Host-level data parallelism across *separate* processes without shared
+ICI (e.g. CPU fleets) instead uses the object-plane collective group
+(`ray_tpu.util.collective`) for gradient averaging — the gloo-DDP
+equivalent; see `prepare_ddp`/`allreduce_gradients`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Multi-host wiring config. With `distributed=True` each worker calls
+    `jax.distributed.initialize(coordinator, num_processes, process_id)`
+    before the loop (TPU pod / multi-process CPU); single-host runs skip
+    it."""
+
+    distributed: bool = False
+    coordinator_port: int = 7010
+
+    def backend_cls(self):
+        return JaxBackend
+
+
+class JaxBackend(Backend):
+    def on_training_start(self, worker_group, backend_config: JaxConfig):
+        if not getattr(backend_config, "distributed", False):
+            return
+        import ray_tpu
+
+        # Rank-0's node is the coordinator.
+        def get_ip():
+            import socket
+
+            return socket.gethostbyname(socket.gethostname())
+
+        ip = worker_group.execute_single(0, get_ip)
+        coord = f"{ip}:{backend_config.coordinator_port}"
+        n = len(worker_group)
+
+        def init_dist(coord=coord, n=n):
+            def _do(rank):
+                import jax
+
+                jax.distributed.initialize(coordinator_address=coord,
+                                           num_processes=n,
+                                           process_id=rank)
+                return True
+            return _do
+
+        ray_tpu.get([
+            w.execute.remote(_jax_dist_init, coord, n, i)
+            for i, w in enumerate(worker_group.workers)
+        ])
+
+
+def _jax_dist_init(coord, n, rank):
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
+                               process_id=rank)
+    return True
+
+
+class JaxTrainer(DataParallelTrainer):
+    _backend_config_cls = JaxConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional[JaxConfig] = None,
+                 **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=jax_config, **kwargs)
+
+
+# -- in-loop helpers (reference parity: train.torch.prepare_model etc.) ----
+
+
+def prepare_mesh(scaling_config: Optional[ScalingConfig] = None,
+                 mesh_config: Optional[MeshConfig] = None):
+    """Build the mesh for this worker's visible devices. Inside a Train
+    worker the ScalingConfig's mesh axes apply; standalone it defaults to
+    all devices on the data axis."""
+    cfg = mesh_config or (scaling_config.mesh_config() if scaling_config
+                          else MeshConfig())
+    return create_mesh(cfg)
+
+
+def allreduce_gradients(grads, group_name: str = "default"):
+    """Host-plane gradient mean across the worker group (gloo-DDP
+    equivalent for CPU fleets; on one mesh this is unnecessary — XLA
+    averages via the batch sharding)."""
+    from ray_tpu.util import collective
+
+    if session.get_session() is None or session.get_world_size() == 1:
+        return grads
+    return collective.allreduce_pytree(grads, group_name=group_name,
+                                       op=collective.ReduceOp.MEAN)

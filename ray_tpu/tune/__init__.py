@@ -1,0 +1,70 @@
+"""ray_tpu.tune: hyperparameter optimization + the Train execution
+substrate (reference `python/ray/tune/`, SURVEY.md §2.4).
+
+Function API: `tune.report` is `air.session.report`; Trainables run as
+actors under the TrialRunner event loop with schedulers (ASHA, PBT,
+median-stopping), searchers (grid/random/Optuna), stoppers, and
+checkpoint-based retry/clone.
+"""
+
+from ray_tpu.air import session as _session
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.tune.result_grid import ExperimentAnalysis, ResultGrid  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.stopper import (  # noqa: F401
+    CombinedStopper,
+    ExperimentPlateauStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
+from ray_tpu.tune.trainable import (  # noqa: F401
+    FunctionTrainable,
+    Trainable,
+    wrap_function,
+)
+from ray_tpu.tune.tuner import Tuner, TuneConfig, run  # noqa: F401
+
+# Function-API reporting (reference: `ray.tune.report` → air session).
+report = _session.report
+get_checkpoint = _session.get_checkpoint
+
+
+def with_parameters(fn, **params):
+    """Bind large constant objects to a trainable fn (reference:
+    `tune.with_parameters` — passes via object store to avoid
+    re-serialization per trial)."""
+    import functools
+
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in params.items()}
+
+    if isinstance(fn, type):
+        raise TypeError("with_parameters supports function trainables")
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return fn(config, **resolved)
+
+    return wrapped

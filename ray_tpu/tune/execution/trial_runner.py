@@ -1,0 +1,247 @@
+"""TrialRunner: the Tune event loop.
+
+Reference: `python/ray/tune/execution/trial_runner.py:1140` (`step()` at
+`:1315`) + `ray_trial_executor.py:185`. Trials run as actors
+(`_TrainableActor` wrapping a Trainable); the runner starts pending trials
+up to the concurrency cap, collects `train()` futures as they complete,
+routes results through scheduler + stoppers, retries failures from the
+last checkpoint (`FailureConfig.max_failures`), and supports PBT's
+clone-and-perturb via `clone_trial`.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig, FailureConfig
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.stopper import Stopper
+from ray_tpu.tune.trainable import DONE, Trainable
+
+
+@ray_tpu.remote
+class _TrainableActor:
+    def __init__(self, trainable_cls, config, checkpoint_data):
+        self._inst: Trainable = trainable_cls(config)
+        if checkpoint_data is not None:
+            self._inst.restore(Checkpoint.from_dict(checkpoint_data))
+
+    def train(self) -> Dict[str, Any]:
+        return self._inst.train()
+
+    def save(self) -> Optional[dict]:
+        ckpt = self._inst.save()
+        return None if ckpt is None else ckpt.to_dict()
+
+    def restore(self, data: dict):
+        self._inst.restore(Checkpoint.from_dict(data))
+        return True
+
+    def stop(self):
+        self._inst.stop()
+        return True
+
+
+class TrialRunner:
+    def __init__(self, trainable_cls, trials: List[Trial], *,
+                 scheduler: Optional[TrialScheduler] = None,
+                 stopper: Optional[Stopper] = None,
+                 stop_criteria: Optional[Dict[str, Any]] = None,
+                 failure_config: Optional[FailureConfig] = None,
+                 max_concurrent_trials: Optional[int] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 callbacks: Optional[List] = None):
+        self.trainable_cls = trainable_cls
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.stopper = stopper
+        self.stop_criteria = stop_criteria or {}
+        self.failure_config = failure_config or FailureConfig()
+        self.max_concurrent = max_concurrent_trials or len(trials) or 1
+        self.resources_per_trial = resources_per_trial or {"CPU": 1}
+        self.callbacks = callbacks or []
+        self._in_flight: Dict[Any, Trial] = {}
+        self._stop_all = False
+
+    # -- actor management ------------------------------------------------
+
+    def _start_trial(self, trial: Trial,
+                     checkpoint: Optional[Checkpoint] = None):
+        res = dict(self.resources_per_trial)
+        opts: Dict[str, Any] = {"num_cpus": res.pop("CPU", 1),
+                                "max_restarts": 0}
+        if "TPU" in res:
+            opts["num_tpus"] = res.pop("TPU")
+        ckpt_data = None
+        src = checkpoint or trial.checkpoint
+        if src is not None:
+            ckpt_data = src.to_dict()
+        trial.actor = _TrainableActor.options(**opts).remote(
+            self.trainable_cls, trial.config, ckpt_data)
+        trial.status = Trial.RUNNING
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_start", trial=trial)
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        if trial.actor is not None:
+            try:
+                # Best-effort final checkpoint for restartable state.
+                trial.actor.stop.remote()
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_complete", trial=trial)
+
+    def _save_trial_checkpoint(self, trial: Trial):
+        if trial.actor is None:
+            return
+        try:
+            data = ray_tpu.get(trial.actor.save.remote(), timeout=30)
+        except Exception:
+            return
+        if data is not None:
+            trial.checkpoint_manager.register(
+                Checkpoint.from_dict(data), trial.last_result)
+
+    # -- PBT support -----------------------------------------------------
+
+    def clone_trial(self, trial: Trial, donor: Trial,
+                    new_config: Dict[str, Any]):
+        """Replace `trial`'s state with donor's checkpoint + new config
+        (PBT exploit/explore)."""
+        self._save_checkpoint_from(donor)
+        donor_ckpt = donor.checkpoint
+        if donor_ckpt is None:
+            return
+        # Drop the running actor (its in-flight future is discarded when it
+        # resolves — we mark the trial as restarting).
+        for fut, t in list(self._in_flight.items()):
+            if t is trial:
+                del self._in_flight[fut]
+        self._stop_trial(trial, Trial.PENDING)
+        trial.config = new_config
+        self._start_trial(trial, checkpoint=donor_ckpt)
+        self._submit(trial)
+
+    def _save_checkpoint_from(self, donor: Trial):
+        if donor.actor is not None:
+            self._save_trial_checkpoint(donor)
+
+    # -- event loop ------------------------------------------------------
+
+    def _submit(self, trial: Trial):
+        fut = trial.actor.train.remote()
+        self._in_flight[fut] = trial
+
+    def step(self):
+        # Launch pending trials up to the cap.
+        running = sum(1 for t in self.trials if t.status == Trial.RUNNING)
+        for trial in self.trials:
+            if running >= self.max_concurrent or self._stop_all:
+                break
+            if trial.status == Trial.PENDING:
+                self._start_trial(trial)
+                self._submit(trial)
+                running += 1
+        if not self._in_flight:
+            return
+        ready, _ = ray_tpu.wait(list(self._in_flight), num_returns=1,
+                                timeout=1.0)
+        for fut in ready:
+            trial = self._in_flight.pop(fut, None)
+            if trial is None:
+                continue
+            try:
+                result = ray_tpu.get(fut)
+            except Exception as e:  # trial crashed
+                self._handle_failure(trial, e)
+                continue
+            self._handle_result(trial, result)
+
+    def _handle_result(self, trial: Trial, result: Dict[str, Any]):
+        # A successful step clears transient-failure state.
+        trial.error = None
+        trial.error_tb = None
+        if result.get(DONE):
+            # Record final results that carry real metrics (class API);
+            # skip the function API's bare completion sentinel.
+            if set(result) - {DONE, "training_iteration"}:
+                trial.record_result(result)
+            self._save_trial_checkpoint(trial)
+            self._stop_trial(trial, Trial.TERMINATED)
+            self.scheduler.on_trial_complete(self, trial,
+                                             trial.last_result)
+            return
+        trial.record_result(result)
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_result", trial=trial, result=result)
+        # Checkpoint bookkeeping: function trainables attach checkpoints
+        # via session; class trainables save on frequency.
+        self._save_trial_checkpoint(trial)
+        if self._should_stop_by_criteria(result) or (
+                self.stopper and self.stopper(trial.trial_id, result)):
+            self._stop_trial(trial, Trial.TERMINATED)
+            self.scheduler.on_trial_complete(self, trial, result)
+        elif self.stopper and self.stopper.stop_all():
+            self._stop_all = True
+        else:
+            decision = self.scheduler.on_trial_result(self, trial, result)
+            if decision == TrialScheduler.STOP:
+                self._stop_trial(trial, Trial.TERMINATED)
+                self.scheduler.on_trial_complete(self, trial, result)
+            elif trial.status == Trial.RUNNING:
+                self._submit(trial)
+
+    def _should_stop_by_criteria(self, result: Dict[str, Any]) -> bool:
+        for k, v in self.stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _handle_failure(self, trial: Trial, error: Exception):
+        trial.num_failures += 1
+        trial.error = error
+        trial.error_tb = traceback.format_exc()
+        max_failures = self.failure_config.max_failures
+        if max_failures < 0 or trial.num_failures <= max_failures:
+            # Retry from last checkpoint.
+            self._stop_trial(trial, Trial.PENDING)
+        else:
+            self._stop_trial(trial, Trial.ERROR)
+            self.scheduler.on_trial_complete(self, trial, None)
+            if self.failure_config.fail_fast:
+                self._stop_all = True
+
+    def is_finished(self) -> bool:
+        if self._stop_all:
+            return True
+        return all(t.is_finished() for t in self.trials)
+
+    def run(self):
+        try:
+            while not self.is_finished():
+                self.step()
+        finally:
+            for t in self.trials:
+                if t.status == Trial.RUNNING:
+                    self._stop_trial(
+                        t, Trial.TERMINATED if self._stop_all
+                        else Trial.ERROR)
+
+
+def _safe(cb, method, **kwargs):
+    fn = getattr(cb, method, None)
+    if fn is None:
+        return
+    try:
+        fn(**kwargs)
+    except Exception:
+        pass

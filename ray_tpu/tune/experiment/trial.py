@@ -1,0 +1,56 @@
+"""Trial: one configuration's lifecycle.
+
+Reference: `python/ray/tune/experiment/trial.py` — status FSM
+(PENDING/RUNNING/PAUSED/TERMINATED/ERROR), per-trial checkpoint manager,
+and result history.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.checkpoint_manager import CheckpointManager
+from ray_tpu.air.config import CheckpointConfig
+
+
+class Trial:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+
+    def __init__(self, config: Dict[str, Any],
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 trial_id: Optional[str] = None, name: str = ""):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.name = name or f"trial_{self.trial_id}"
+        self.config = config
+        self.status = Trial.PENDING
+        self.results: List[Dict[str, Any]] = []
+        self.last_result: Dict[str, Any] = {}
+        self.error: Optional[Exception] = None
+        self.error_tb: Optional[str] = None
+        self.num_failures = 0
+        self.checkpoint_manager = CheckpointManager(checkpoint_config)
+        self.actor = None  # runner-owned
+        self.metric_history: Dict[str, List[float]] = {}
+
+    @property
+    def checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint_manager.latest
+
+    def record_result(self, result: Dict[str, Any]):
+        self.results.append(result)
+        self.last_result = result
+        for k, v in result.items():
+            if isinstance(v, (int, float)):
+                self.metric_history.setdefault(k, []).append(float(v))
+
+    def is_finished(self) -> bool:
+        return self.status in (Trial.TERMINATED, Trial.ERROR)
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"

@@ -1,0 +1,78 @@
+"""ResultGrid + ExperimentAnalysis (reference `tune/result_grid.py`,
+`tune/analysis/experiment_analysis.py`)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.air.result import Result
+from ray_tpu.tune.experiment.trial import Trial
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial]):
+        self._trials = trials
+        self._results = [self._to_result(t) for t in trials]
+
+    @staticmethod
+    def _to_result(trial: Trial) -> Result:
+        metrics = dict(trial.last_result)
+        metrics["config"] = trial.config
+        metrics["trial_id"] = trial.trial_id
+        return Result(
+            metrics=metrics,
+            checkpoint=trial.checkpoint_manager.best_checkpoint,
+            error=trial.error,
+            metrics_history=trial.results,
+            best_checkpoints=trial.checkpoint_manager.best_checkpoints(),
+        )
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[Exception]:
+        return [r.error for r in self._results if r.error is not None]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    @property
+    def num_terminated(self) -> int:
+        return sum(1 for t in self._trials
+                   if t.status == Trial.TERMINATED)
+
+    def get_best_result(self, metric: str, mode: str = "max") -> Result:
+        valid = [r for r in self._results
+                 if r.metrics and metric in r.metrics]
+        if not valid:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = (lambda r: r.metrics[metric])
+        return max(valid, key=key) if mode == "max" else min(valid, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {k: v for k, v in (r.metrics or {}).items()
+                   if not isinstance(v, dict)}
+            for ck, cv in (r.metrics or {}).get("config", {}).items():
+                row[f"config/{ck}"] = cv
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class ExperimentAnalysis(ResultGrid):
+    """Thin alias for reference API parity."""
+
+    @property
+    def best_result(self):  # pragma: no cover - convenience
+        raise AttributeError("use get_best_result(metric, mode)")

@@ -1,0 +1,231 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference: `python/ray/tune/schedulers/` — FIFO, ASHA
+(`async_hyperband.py`), MedianStopping (`median_stopping_rule.py`), PBT
+(`pbt.py`: exploit bottom-quantile trials from top performers +
+perturb). Decisions are returned per result: CONTINUE / STOP / and for
+PBT, a clone instruction executed by the runner via checkpoint restore.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.experiment.trial import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    PAUSE = "PAUSE"
+
+    def on_trial_result(self, runner, trial: Trial,
+                        result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, runner, trial: Trial,
+                          result: Optional[Dict[str, Any]] = None):
+        pass
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+
+class FIFOScheduler(TrialScheduler):
+    metric = None
+    mode = "max"
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference `tune/schedulers/async_hyperband.py`): successive
+    halving with asynchronous rung promotion — at each rung, a trial stops
+    unless its metric is in the top 1/reduction_factor of results recorded
+    at that rung."""
+
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace * rf^k up to max_t
+        self.rungs: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: Dict[float, List[float]] = {r: []
+                                                       for r in self.rungs}
+        self._trial_rung: Dict[str, int] = {}
+        self._trial_rung_value: Dict[str, float] = {}
+
+    def _sign(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def _below_cutoff(self, rung: float, value: float) -> bool:
+        recorded = self.rung_results[rung]
+        if len(recorded) < self.rf:
+            return False
+        cutoff = sorted(recorded, reverse=True)[
+            max(0, int(len(recorded) / self.rf) - 1)]
+        return value < cutoff
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        metric = result.get(self.metric)
+        if metric is None:
+            return self.CONTINUE
+        if t >= self.max_t:
+            return self.STOP
+        idx = self._trial_rung.get(trial.trial_id, 0)
+        while idx < len(self.rungs) and t >= self.rungs[idx]:
+            rung = self.rungs[idx]
+            self.rung_results[rung].append(self._sign(metric))
+            self._trial_rung_value[trial.trial_id] = self._sign(metric)
+            idx += 1
+            self._trial_rung[trial.trial_id] = idx
+            if self._below_cutoff(rung, self._sign(metric)):
+                return self.STOP
+        # Eager re-check: a trial that passed its last rung before peers
+        # arrived (e.g. lockstep execution) is re-evaluated against the
+        # now-populated rung, so promotion mistakes are corrected instead
+        # of riding to max_t.
+        if idx > 0 and trial.trial_id in self._trial_rung_value:
+            rung = self.rungs[idx - 1]
+            if self._below_cutoff(rung,
+                                  self._trial_rung_value[trial.trial_id]):
+                return self.STOP
+        return self.CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step."""
+
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 5, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        metric = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if metric is None:
+            return self.CONTINUE
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(metric if self.mode == "max" else -metric)
+        if t < self.grace_period or len(self._avgs) < self.min_samples:
+            return self.CONTINUE
+        my_avg = sum(hist) / len(hist)
+        others = [sum(h) / len(h) for tid, h in self._avgs.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples - 1:
+            return self.CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        return self.STOP if my_avg < median else self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference `tune/schedulers/pbt.py`): every
+    `perturbation_interval` steps, bottom-quantile trials clone a top
+    performer's checkpoint and perturb its hyperparameters (×1.2 / ×0.8 or
+    resample)."""
+
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25, seed=None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = _random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._scores: Dict[str, float] = {}
+
+    def _sign(self, v):
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        metric = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if metric is None:
+            return self.CONTINUE
+        self._scores[trial.trial_id] = self._sign(metric)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return self.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scores = sorted(self._scores.values())
+        if len(scores) < 2:
+            return self.CONTINUE
+        k = max(1, int(len(scores) * self.quantile))
+        lower_cut = scores[k - 1]
+        upper_cut = scores[-k]
+        mine = self._scores[trial.trial_id]
+        if mine > lower_cut or mine >= upper_cut:
+            return self.CONTINUE
+        # Exploit: pick a random top-quantile trial with a checkpoint.
+        top = [tr for tr in runner.trials
+               if self._scores.get(tr.trial_id, -math.inf) >= upper_cut
+               and tr.checkpoint is not None and tr is not trial]
+        if not top:
+            return self.CONTINUE
+        donor = self._rng.choice(top)
+        new_config = self._explore(donor.config)
+        runner.clone_trial(trial, donor, new_config)
+        return self.CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search.sample import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or \
+                    key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                cur = out[key]
+                if isinstance(spec, list):
+                    # nudge to a neighbouring listed value
+                    try:
+                        i = spec.index(cur)
+                        j = min(max(i + self._rng.choice([-1, 1]), 0),
+                                len(spec) - 1)
+                        out[key] = spec[j]
+                    except ValueError:
+                        out[key] = self._rng.choice(spec)
+                elif isinstance(cur, (int, float)):
+                    factor = self._rng.choice([0.8, 1.2])
+                    out[key] = type(cur)(cur * factor)
+        return out

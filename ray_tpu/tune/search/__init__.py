@@ -1,0 +1,24 @@
+"""Search algorithms (reference `python/ray/tune/search/`)."""
+
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    Choice,
+    Domain,
+    GridSearch,
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.search.basic_variant import (  # noqa: F401
+    BasicVariantGenerator,
+)
+from ray_tpu.tune.search.searcher import (  # noqa: F401
+    ConcurrencyLimiter,
+    Repeater,
+    Searcher,
+)

@@ -1,0 +1,69 @@
+"""Grid + random variant generation.
+
+Reference: `python/ray/tune/search/basic_variant.py` +
+`variant_generator.py` — expand `grid_search` entries into a cartesian
+product, sample `Domain` leaves per variant, repeat `num_samples` times.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+from typing import Any, Dict, Iterator, List, Tuple
+
+from ray_tpu.tune.search.sample import Domain
+
+
+def _find_grid_axes(space: Dict[str, Any], prefix=()) -> List[Tuple[tuple, list]]:
+    axes = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            if set(v.keys()) == {"grid_search"}:
+                axes.append((path, v["grid_search"]))
+            else:
+                axes.extend(_find_grid_axes(v, path))
+    return axes
+
+
+def _set_path(cfg: dict, path: tuple, value):
+    d = cfg
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _sample_leaves(space, rng):
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, dict):
+        return {k: _sample_leaves(v, rng) for k, v in space.items()}
+    if isinstance(space, (list, tuple)):
+        return type(space)(_sample_leaves(v, rng) for v in space)
+    return space
+
+
+def generate_variants(space: Dict[str, Any], num_samples: int = 1,
+                      seed: int = None) -> Iterator[Dict[str, Any]]:
+    rng = _random.Random(seed)
+    grid_axes = _find_grid_axes(space)
+    if grid_axes:
+        paths, values = zip(*grid_axes)
+        combos = list(itertools.product(*values))
+    else:
+        paths, combos = (), [()]
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg = _sample_leaves(space, rng)
+            for path, value in zip(paths, combo):
+                _set_path(cfg, path, value)
+            yield cfg
+
+
+class BasicVariantGenerator:
+    def __init__(self, max_concurrent: int = 0):
+        self.max_concurrent = max_concurrent
+
+    def generate(self, space: Dict[str, Any],
+                 num_samples: int = 1, seed=None) -> List[Dict[str, Any]]:
+        return list(generate_variants(space, num_samples, seed))

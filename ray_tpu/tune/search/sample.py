@@ -1,0 +1,132 @@
+"""Search-space sampling DSL.
+
+Reference: `python/ray/tune/search/sample.py` — `uniform`, `loguniform`,
+`randint`, `choice`, `grid_search`, `qrandint`, `randn`, plus `.sample()`
+semantics used by the variant generator.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Domain:
+    def sample(self, rng: _random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+class LogUniform(Domain):
+    def __init__(self, lower: float, upper: float, base: float = 10.0):
+        import math
+
+        self.lower, self.upper, self.base = lower, upper, base
+        self._lo = math.log(lower, base)
+        self._hi = math.log(upper, base)
+
+    def sample(self, rng):
+        return self.base ** rng.uniform(self._lo, self._hi)
+
+
+class QUniform(Domain):
+    def __init__(self, lower: float, upper: float, q: float):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.lower, self.upper)
+        return round(v / self.q) * self.q
+
+
+class RandInt(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class QRandInt(Domain):
+    def __init__(self, lower: int, upper: int, q: int):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = rng.randrange(self.lower, self.upper)
+        return (v // self.q) * self.q
+
+
+class Choice(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Randn(Domain):
+    def __init__(self, mean: float = 0.0, sd: float = 1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class GridSearch:
+    """Marker resolved by the variant generator (cartesian product)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float, base: float = 10.0) -> LogUniform:
+    return LogUniform(lower, upper, base)
+
+
+def quniform(lower: float, upper: float, q: float) -> QUniform:
+    return QUniform(lower, upper, q)
+
+
+def randint(lower: int, upper: int) -> RandInt:
+    return RandInt(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int) -> QRandInt:
+    return QRandInt(lower, upper, q)
+
+
+def choice(categories: Sequence[Any]) -> Choice:
+    return Choice(categories)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Randn:
+    return Randn(mean, sd)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def sample_from(fn) -> "Function":
+    return Function(fn)
+
+
+class Function(Domain):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):
+        try:
+            return self.fn({})
+        except TypeError:
+            return self.fn()

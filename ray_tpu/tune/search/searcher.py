@@ -1,0 +1,163 @@
+"""Searcher ABC + wrappers.
+
+Reference: `python/ray/tune/search/searcher.py` (Searcher),
+`concurrency_limiter.py`, `repeater.py`. Custom searchers implement
+`suggest`/`on_trial_complete`; the runner interleaves suggestions with
+completions. An Optuna adapter is provided when optuna is installed.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import Domain
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        pass
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+
+class RandomSearch(Searcher):
+    """Samples from the space independently per suggestion."""
+
+    def __init__(self, space: Dict[str, Any], seed=None, **kwargs):
+        super().__init__(**kwargs)
+        self.space = space
+        self._rng = _random.Random(seed)
+
+    def suggest(self, trial_id: str):
+        from ray_tpu.tune.search.basic_variant import _sample_leaves
+
+        return _sample_leaves(self.space, self._rng)
+
+
+class ConcurrencyLimiter(Searcher):
+    def __init__(self, searcher: Searcher, max_concurrent: int = 8):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None  # runner retries later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class Repeater(Searcher):
+    """Repeat each suggestion N times and average the metric."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        super().__init__(metric=searcher.metric, mode=searcher.mode)
+        self.searcher = searcher
+        self.repeat = repeat
+        self._group_of: Dict[str, str] = {}
+        self._configs: Dict[str, dict] = {}
+        self._counts: Dict[str, int] = {}
+        self._scores: Dict[str, list] = {}
+
+    def suggest(self, trial_id: str):
+        # Find a group needing more repeats, else open a new one.
+        for gid, count in self._counts.items():
+            if count < self.repeat:
+                self._counts[gid] += 1
+                self._group_of[trial_id] = gid
+                return dict(self._configs[gid])
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None:
+            return None
+        gid = trial_id
+        self._configs[gid] = cfg
+        self._counts[gid] = 1
+        self._scores[gid] = []
+        self._group_of[trial_id] = gid
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        gid = self._group_of.get(trial_id)
+        if gid is None:
+            return
+        if result and self.metric and self.metric in result:
+            self._scores[gid].append(result[self.metric])
+        if len(self._scores[gid]) >= self.repeat:
+            avg = sum(self._scores[gid]) / len(self._scores[gid])
+            self.searcher.on_trial_complete(
+                gid, {self.metric: avg} if self.metric else None, error)
+
+
+class OptunaSearch(Searcher):
+    """Adapter over optuna's TPE (available only if optuna is installed)."""
+
+    def __init__(self, space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed=None):
+        super().__init__(metric=metric, mode=mode)
+        import optuna  # noqa: F401 - raises if unavailable
+
+        self._optuna = optuna
+        sampler = optuna.samplers.TPESampler(seed=seed)
+        direction = "maximize" if mode == "max" else "minimize"
+        self._study = optuna.create_study(direction=direction,
+                                          sampler=sampler)
+        self._space = space
+        self._trials: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str):
+        ot = self._study.ask()
+        self._trials[trial_id] = ot
+        from ray_tpu.tune.search import sample as S
+
+        cfg = {}
+        for k, v in self._space.items():
+            if isinstance(v, S.Uniform):
+                cfg[k] = ot.suggest_float(k, v.lower, v.upper)
+            elif isinstance(v, S.LogUniform):
+                cfg[k] = ot.suggest_float(k, v.lower, v.upper, log=True)
+            elif isinstance(v, S.RandInt):
+                cfg[k] = ot.suggest_int(k, v.lower, v.upper - 1)
+            elif isinstance(v, S.Choice):
+                cfg[k] = ot.suggest_categorical(k, v.categories)
+            elif isinstance(v, S.Domain):
+                cfg[k] = v.sample(_random.Random())
+            else:
+                cfg[k] = v
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ot = self._trials.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(ot, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, result[self.metric])

@@ -1,0 +1,97 @@
+"""Stoppers (reference `python/ray/tune/stopper/`)."""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Any, Dict
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self.max_iter = max_iter
+
+    def __call__(self, trial_id, result):
+        return result.get("training_iteration", 0) >= self.max_iter
+
+
+class TrialPlateauStopper(Stopper):
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 mode: str = "min"):
+        self.metric = metric
+        self.std = std
+        self.num_results = num_results
+        self.grace_period = grace_period
+        self._window = defaultdict(lambda: deque(maxlen=num_results))
+        self._iters = defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        import numpy as np
+
+        v = result.get(self.metric)
+        self._iters[trial_id] += 1
+        if v is None:
+            return False
+        w = self._window[trial_id]
+        w.append(v)
+        if self._iters[trial_id] < self.grace_period or \
+                len(w) < self.num_results:
+            return False
+        return float(np.std(list(w))) < self.std
+
+
+class ExperimentPlateauStopper(Stopper):
+    def __init__(self, metric: str, std: float = 0.001, top: int = 10,
+                 mode: str = "min", patience: int = 0):
+        self.metric = metric
+        self.std = std
+        self.top = top
+        self.mode = mode
+        self.patience = patience
+        self._best: list = []
+        self._stale = 0
+
+    def __call__(self, trial_id, result):
+        import numpy as np
+
+        v = result.get(self.metric)
+        if v is None:
+            return False
+        self._best.append(v if self.mode == "max" else -v)
+        self._best = sorted(self._best, reverse=True)[: self.top]
+        if len(self._best) == self.top and \
+                float(np.std(self._best)) < self.std:
+            self._stale += 1
+        else:
+            self._stale = 0
+        return False
+
+    def stop_all(self):
+        return self._stale > self.patience
+
+
+class FunctionStopper(Stopper):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, trial_id, result):
+        return self.fn(trial_id, result)
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self.stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self.stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self.stoppers)

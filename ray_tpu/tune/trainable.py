@@ -1,0 +1,189 @@
+"""Trainable: the unit Tune schedules.
+
+Reference: `python/ray/tune/trainable/trainable.py` (class API:
+setup/step/save_checkpoint/load_checkpoint) and
+`function_trainable.py` (function API: the user fn runs on a thread,
+`session.report` rendezvous with `step()`). `wrap_trainer_as_trainable`
+is the Train↔Tune bridge (`train/base_trainer.py:759` in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session as session_mod
+from ray_tpu.air.checkpoint import Checkpoint
+
+DONE = "done"
+
+
+class Trainable:
+    """Class API: subclass and implement setup/step/save/load."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = config or {}
+        self.training_iteration = 0
+        self._setup_done = False
+
+    # -- subclass surface -------------------------------------------------
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Optional[Dict[str, Any]]:
+        return None
+
+    def load_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable supports in-place config reset
+        (enables actor reuse in PBT)."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- framework surface ------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        if not self._setup_done:
+            self.setup(self.config)
+            self._setup_done = True
+        result = self.step() or {}
+        self.training_iteration += 1
+        result.setdefault("training_iteration", self.training_iteration)
+        result.setdefault(DONE, False)
+        return result
+
+    def save(self) -> Optional[Checkpoint]:
+        data = self.save_checkpoint()
+        if data is None:
+            return None
+        return Checkpoint.from_dict({
+            **data, "_iteration": self.training_iteration})
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        data = dict(checkpoint.to_dict())
+        self.training_iteration = data.pop("_iteration", 0)
+        if not self._setup_done:
+            self.setup(self.config)
+            self._setup_done = True
+        self.load_checkpoint(data)
+
+    def stop(self) -> None:
+        self.cleanup()
+
+
+class FunctionTrainable(Trainable):
+    """Wraps a user function; each `step()` returns the next
+    `session.report` payload."""
+
+    _fn: Callable = None  # bound by subclass factory
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        super().__init__(config)
+        self._session: Optional[session_mod.TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._tb: Optional[str] = None
+        self._finished = threading.Event()
+        self._restore_checkpoint: Optional[Checkpoint] = None
+        self._last_checkpoint: Optional[Checkpoint] = None
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self._session = session_mod.TrainSession(
+            checkpoint=self._restore_checkpoint)
+
+        def run():
+            session_mod._set_session(self._session)
+            try:
+                try:
+                    self._fn(config)
+                except TypeError as e:
+                    if "positional argument" in str(e):
+                        self._fn()
+                    else:
+                        raise
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+                self._tb = traceback.format_exc()
+            finally:
+                session_mod._set_session(None)
+                self._finished.set()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="tune-fn")
+        self._thread.start()
+
+    def step(self) -> Dict[str, Any]:
+        while True:
+            if not getattr(self, "_buffer", None):
+                self._buffer = list(self._session.drain_results())
+            if self._buffer:
+                metrics, ckpt = self._buffer.pop(0)
+                if ckpt is not None:
+                    self._last_checkpoint = ckpt
+                metrics = dict(metrics)
+                metrics[DONE] = False
+                return metrics
+            if self._finished.is_set():
+                if self._error is not None:
+                    raise RuntimeError(
+                        f"trainable function failed:\n{self._tb}")
+                return {DONE: True}
+            time.sleep(0.005)
+
+    def save_checkpoint(self) -> Optional[Dict[str, Any]]:
+        if self._last_checkpoint is None:
+            return None
+        return dict(self._last_checkpoint.to_dict())
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        # Function API restores by passing the checkpoint into the session
+        # before the fn starts (reference semantics: session.get_checkpoint).
+        data = dict(checkpoint.to_dict())
+        self.training_iteration = data.pop("_iteration", 0)
+        self._restore_checkpoint = Checkpoint.from_dict(data)
+        self._last_checkpoint = self._restore_checkpoint
+
+    def stop(self) -> None:
+        self._finished.wait(timeout=1.0)
+        self.cleanup()
+
+
+def wrap_function(fn: Callable) -> type:
+    """Function → Trainable subclass (reference: `wrap_function`,
+    `tune/trainable/function_trainable.py`)."""
+
+    class _Wrapped(FunctionTrainable):
+        _fn = staticmethod(fn)
+
+    _Wrapped.__name__ = getattr(fn, "__name__", "fn") + "_trainable"
+    return _Wrapped
+
+
+def wrap_trainer_as_trainable(trainer) -> type:
+    """Train→Tune bridge: the trainer's `training_loop` becomes the
+    trainable function; its own session.report calls stream results."""
+
+    def _train_fn(config):
+        if config:
+            # Tune-sampled params override the trainer's loop config.
+            if hasattr(trainer, "train_loop_config"):
+                trainer.train_loop_config = {
+                    **trainer.train_loop_config, **config}
+        ckpt = session_mod.get_checkpoint()
+        if ckpt is not None:
+            trainer.resume_from_checkpoint = ckpt
+        trainer.setup()
+        trainer.training_loop()
+
+    _train_fn.__name__ = type(trainer).__name__
+    return wrap_function(_train_fn)

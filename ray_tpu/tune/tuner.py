@@ -1,0 +1,144 @@
+"""Tuner: the HPO entry point.
+
+Reference: `python/ray/tune/tuner.py` + `tune/impl/tuner_internal.py` +
+`tune.run` (`tune/tune.py`). `Tuner(trainable, param_space=...).fit()`
+expands the param space into trials, runs them through the TrialRunner,
+and returns a ResultGrid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune.execution.trial_runner import TrialRunner
+from ray_tpu.tune.experiment.trial import Trial
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search.basic_variant import generate_variants
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.stopper import FunctionStopper, Stopper
+from ray_tpu.tune.trainable import Trainable, wrap_function
+
+
+@dataclass
+class TuneConfig:
+    """Reference: `tune/tune_config.py`."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    resources_per_trial: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1})
+
+
+class Tuner:
+    def __init__(self, trainable: Union[Callable, type], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self.trainable_cls = trainable
+        elif callable(trainable):
+            self.trainable_cls = wrap_function(trainable)
+        else:
+            raise TypeError(f"unsupported trainable: {trainable!r}")
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._trials: Optional[List[Trial]] = None
+
+    def _make_trials(self) -> List[Trial]:
+        tc = self.tune_config
+        ckpt_cfg = self.run_config.checkpoint_config
+        trials: List[Trial] = []
+        if tc.search_alg is not None:
+            tc.search_alg.set_search_properties(tc.metric, tc.mode,
+                                                self.param_space)
+            for i in range(tc.num_samples):
+                tid = f"t{i:05d}"
+                cfg = tc.search_alg.suggest(tid)
+                if cfg is None:
+                    break
+                trials.append(Trial(cfg, checkpoint_config=ckpt_cfg,
+                                    trial_id=tid))
+        else:
+            for i, cfg in enumerate(generate_variants(
+                    self.param_space, tc.num_samples, tc.seed)):
+                trials.append(Trial(cfg, checkpoint_config=ckpt_cfg,
+                                    trial_id=f"t{i:05d}"))
+        return trials or [Trial({}, checkpoint_config=ckpt_cfg)]
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        if hasattr(scheduler, "set_search_properties"):
+            scheduler.set_search_properties(tc.metric, tc.mode)
+        stop = self.run_config.stop
+        stopper: Optional[Stopper] = None
+        stop_criteria: Dict[str, Any] = {}
+        if isinstance(stop, Stopper):
+            stopper = stop
+        elif callable(stop):
+            stopper = FunctionStopper(stop)
+        elif isinstance(stop, dict):
+            stop_criteria = stop
+
+        self._trials = self._make_trials()
+        runner = TrialRunner(
+            self.trainable_cls, self._trials,
+            scheduler=scheduler, stopper=stopper,
+            stop_criteria=stop_criteria,
+            failure_config=self.run_config.failure_config,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            resources_per_trial=tc.resources_per_trial,
+            callbacks=list(self.run_config.callbacks) + [
+                _SearcherCallback(tc.search_alg)] if tc.search_alg
+            else list(self.run_config.callbacks),
+        )
+        runner.run()
+        return ResultGrid(self._trials)
+
+    def get_results(self) -> ResultGrid:
+        if self._trials is None:
+            raise RuntimeError("call fit() first")
+        return ResultGrid(self._trials)
+
+
+class _SearcherCallback:
+    def __init__(self, searcher: Optional[Searcher]):
+        self.searcher = searcher
+
+    def on_trial_result(self, trial=None, result=None):
+        if self.searcher:
+            self.searcher.on_trial_result(trial.trial_id, result)
+
+    def on_trial_complete(self, trial=None):
+        if self.searcher:
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.last_result,
+                error=trial.error is not None)
+
+
+def run(trainable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "max", scheduler=None, search_alg=None,
+        stop=None, resources_per_trial: Optional[dict] = None,
+        max_concurrent_trials: Optional[int] = None,
+        **_ignored) -> ResultGrid:
+    """`tune.run` compatibility shim over Tuner."""
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            scheduler=scheduler, search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+            resources_per_trial=resources_per_trial or {"CPU": 1}),
+        run_config=RunConfig(stop=stop),
+    )
+    return tuner.fit()

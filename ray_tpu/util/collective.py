@@ -1,0 +1,211 @@
+"""Runtime (host-level) collectives between actors/tasks.
+
+Reference: `python/ray/util/collective/collective.py` — NCCL/Gloo process
+groups with allreduce/allgather/broadcast/barrier (`:258-615`). On TPU the
+*tensor* plane lives inside compiled XLA programs (`ray_tpu.parallel`);
+this module is the *host* plane replacement for Gloo: CPU-side collectives
+over the object plane, used for DDP-style gradient averaging between
+worker actors on CPU paths, metric reduction, and rendezvous/barriers.
+
+Implementation: a named rendezvous actor per group; ranks contribute
+values per operation sequence number and block until the reduction is
+complete. Collectives must be called in the same order on every rank
+(the same contract NCCL imposes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+@ray_tpu.remote
+class _Rendezvous:
+    """Holds in-flight collective rounds for one group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lock = threading.Condition()
+        self._rounds: Dict[int, dict] = {}
+
+    def _round(self, seq: int) -> dict:
+        if seq not in self._rounds:
+            self._rounds[seq] = {"values": {}, "result": None, "reads": 0}
+        return self._rounds[seq]
+
+    def contribute(self, seq: int, rank: int, value, op: str,
+                   root: Optional[int] = None, timeout: float = 60.0):
+        with self._lock:
+            r = self._round(seq)
+            r["values"][rank] = value
+            if len(r["values"]) == self.world_size:
+                r["result"] = _reduce_values(r["values"], op, root)
+                self._lock.notify_all()
+            else:
+                ok = self._lock.wait_for(
+                    lambda: r["result"] is not None, timeout=timeout)
+                if not ok:
+                    raise TimeoutError(
+                        f"collective round {seq}: only "
+                        f"{len(r['values'])}/{self.world_size} ranks arrived")
+            result = r["result"]
+            r["reads"] += 1
+            if r["reads"] == self.world_size:
+                del self._rounds[seq]
+            return result
+
+
+
+
+
+def _reduce_values(values: Dict[int, Any], op: str, root: Optional[int]):
+    if op == "gather":
+        return [values[r] for r in sorted(values)]
+    if op == "broadcast":
+        return values[root]
+    first = values[min(values)]
+    if isinstance(first, list):
+        # Pytree-leaf lists: reduce position-wise in one round.
+        per_rank = [values[r] for r in sorted(values)]
+        return [
+            _reduce_values(
+                {r: per_rank[r][i] for r in range(len(per_rank))}, op, root)
+            for i in range(len(first))
+        ]
+    arrs = [np.asarray(values[r]) for r in sorted(values)]
+    if op == ReduceOp.SUM:
+        return sum(arrs)
+    if op == ReduceOp.PRODUCT:
+        out = arrs[0].copy()
+        for a in arrs[1:]:
+            out = out * a
+        return out
+    if op == ReduceOp.MIN:
+        return np.minimum.reduce(arrs)
+    if op == ReduceOp.MAX:
+        return np.maximum.reduce(arrs)
+    if op == ReduceOp.MEAN:
+        return sum(arrs) / len(arrs)
+    if op == "barrier":
+        return 0
+    raise ValueError(f"unknown op {op}")
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.actor = actor
+        self.seq = 0
+
+    def next_seq(self) -> int:
+        s = self.seq
+        self.seq += 1
+        return s
+
+
+_local = threading.local()
+
+
+def _groups() -> Dict[str, _GroupState]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "object_store",
+                          group_name: str = "default") -> None:
+    """Reference: `util/collective/collective.py:258` (init_collective_group).
+    `backend` accepted for API parity; the object-plane rendezvous is the
+    only host backend."""
+    actor_name = f"__collective::{group_name}"
+    try:
+        actor = ray_tpu.get_actor(actor_name)
+    except ValueError:
+        try:
+            actor = _Rendezvous.options(
+                name=actor_name, max_concurrency=max(64, world_size * 4),
+                lifetime="detached").remote(world_size)
+        except ValueError:
+            actor = ray_tpu.get_actor(actor_name)
+    _groups()[group_name] = _GroupState(group_name, world_size, rank, actor)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    st = _groups().pop(group_name, None)
+    if st is not None:
+        try:
+            ray_tpu.kill(st.actor)
+        except Exception:
+            pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups()[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups()[group_name].world_size
+
+
+def _call(group_name: str, value, op: str, root: Optional[int] = None):
+    st = _groups().get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized on this "
+            "worker; call init_collective_group first")
+    seq = st.next_seq()
+    return ray_tpu.get(st.actor.contribute.remote(seq, st.rank, value, op,
+                                                  root))
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    return _call(group_name, np.asarray(tensor), op)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    return _call(group_name, np.asarray(tensor), "gather")
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _call(group_name, np.asarray(tensor), "broadcast", root=src_rank)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    full = _call(group_name, np.asarray(tensor), op)
+    st = _groups()[group_name]
+    return np.array_split(full, st.world_size)[st.rank]
+
+def barrier(group_name: str = "default") -> None:
+    _call(group_name, 0, "barrier")
+
+
+def allreduce_pytree(tree, group_name: str = "default",
+                     op: str = ReduceOp.MEAN):
+    """Convenience for gradient averaging: flatten, one allreduce per leaf."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    host = [np.asarray(x) for x in leaves]
+    reduced = _call(group_name, host, op)
+    return jax.tree.unflatten(treedef, reduced)

@@ -1,0 +1,218 @@
+"""Placement groups: gang reservation of resource bundles.
+
+Reference: `python/ray/util/placement_group.py:33,136` (API) and the
+raylet-side 2PC reservation (`raylet/placement_group_resource_manager.h`).
+Strategies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD keep reference semantics;
+the TPU extension is an optional ``ici_slice`` bundle label so STRICT_PACK
+groups can demand a contiguous ICI sub-slice (chips that neighbour on the
+torus) rather than any N chips — the gang-scheduling constraint GPUs never
+needed (SURVEY.md §7 "hard parts").
+
+On the single-node in-process backend, reservation carves bundle pools out
+of the node's ResourceSet atomically (all-or-nothing, the 2PC degenerate
+case); the cluster backend will run prepare/commit across nodes on the
+same interfaces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.resources import ResourceSet, to_milli
+from ray_tpu._private.task_spec import (
+    PlacementGroupSchedulingStrategy,
+)
+from ray_tpu._private import worker as worker_mod
+from ray_tpu import exceptions as exc
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a reserved (or pending) group of bundles."""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+        self._ready = threading.Event()
+        self._failed: Optional[str] = None
+
+    def ready(self):
+        """Returns an ObjectRef resolving when reservation completes
+        (reference returns a ref for `ray.get(pg.ready())`)."""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _wait(pg_name, pg):
+            pg.wait(timeout=60.0)
+            return pg
+
+        return _wait.options(num_cpus=0.001).remote(self.name, self)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ok = self._ready.wait(timeout)
+        if self._failed:
+            raise exc.PlacementGroupSchedulingError(self._failed)
+        return ok
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        # Handles are pass-by-reference through the object store: the
+        # in-process registry resolves by id.
+        return (_lookup_pg, (self.id,))
+
+
+def _lookup_pg(pg_id):
+    w = worker_mod.global_worker()
+    table = w.gcs.placement_group_table()
+    pg = table.get(pg_id)
+    if pg is None:
+        raise exc.PlacementGroupSchedulingError(
+            f"placement group {pg_id} not found")
+    return pg
+
+
+def placement_group(bundles: List[Dict[str, float]], *,
+                    strategy: str = "PACK", name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    """Reserve bundles. Reference: `util/placement_group.py:33`."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or all(v == 0 for v in b.values()):
+            raise ValueError(f"bundle must request resources: {b}")
+    w = worker_mod.global_worker()
+    pg = PlacementGroup(PlacementGroupID.from_random(), bundles, strategy,
+                        name)
+    w.gcs.register_placement_group(pg)
+    backend = w.backend
+
+    # Single-node reservation: all bundles land on this node. STRICT_SPREAD
+    # demands distinct nodes, which a single-node cluster cannot satisfy
+    # unless there is exactly one bundle.
+    if strategy == "STRICT_SPREAD" and len(bundles) > 1 and \
+            len(w.gcs.nodes()) == 1:
+        pg._failed = (
+            "STRICT_SPREAD with multiple bundles cannot be satisfied on a "
+            "single-node cluster")
+        pg._ready.set()
+        return pg
+
+    milli = [to_milli(b) for b in bundles]
+    # All-or-nothing: acquire every bundle from the node pool, then carve
+    # per-bundle ResourceSets (the 2PC prepare+commit collapsed to one op).
+    acquired = []
+    ok = True
+    for req in milli:
+        if backend.resources.try_acquire(req):
+            acquired.append(req)
+        else:
+            ok = False
+            break
+    if not ok:
+        for req in acquired:
+            backend.resources.release(req)
+        # Leave pending; a retry loop waits for resources to free up.
+        def _retry():
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                got = []
+                done = True
+                for req in milli:
+                    if backend.resources.try_acquire(req):
+                        got.append(req)
+                    else:
+                        done = False
+                        break
+                if done:
+                    _commit(backend, pg, bundles)
+                    return
+                for req in got:
+                    backend.resources.release(req)
+                backend.resources.wait_for_change(timeout=0.2)
+            pg._failed = "placement group reservation timed out"
+            pg._ready.set()
+
+        threading.Thread(target=_retry, daemon=True).start()
+        return pg
+
+    _commit(backend, pg, bundles)
+    return pg
+
+
+def _commit(backend, pg: PlacementGroup, bundles):
+    for i, b in enumerate(bundles):
+        backend.bundle_resources[(pg.id, i)] = ResourceSet(b)
+    pg._ready.set()
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    w = worker_mod.global_worker()
+    backend = w.backend
+    released: Dict[str, int] = {}
+    for (gid, i) in list(backend.bundle_resources):
+        if gid == pg.id:
+            pool = backend.bundle_resources.pop((gid, i))
+            for k, v in to_milli(pool.total).items():
+                released[k] = released.get(k, 0) + v
+    if released:
+        backend.resources.release(released)
+    w.gcs.remove_placement_group(pg.id)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    w = worker_mod.global_worker()
+    for pg in w.gcs.placement_group_table().values():
+        if pg.name == name:
+            return pg
+    raise ValueError(f"placement group {name!r} not found")
+
+
+def placement_group_table() -> dict:
+    w = worker_mod.global_worker()
+    return {
+        pg.id.hex(): {
+            "name": pg.name,
+            "strategy": pg.strategy,
+            "bundles": pg.bundle_specs,
+            "state": "CREATED" if pg._ready.is_set() and not pg._failed
+            else ("REMOVED" if pg._failed else "PENDING"),
+        }
+        for pg in w.gcs.placement_group_table().values()
+    }
+
+
+@dataclass
+class PlacementGroupFactory:
+    """Deferred PG creation spec (reference: `tune/execution/
+    placement_groups.py` PlacementGroupFactory) — what ScalingConfig lowers
+    to and what Tune's trial executor reserves per trial."""
+
+    bundles: List[Dict[str, float]]
+    strategy: str = "PACK"
+
+    def __call__(self) -> PlacementGroup:
+        # Bundle 0 (trainer overhead) may be empty → drop zero bundles.
+        real = [b for b in self.bundles if b and any(v > 0
+                                                    for v in b.values())]
+        return placement_group(real or [{"CPU": 0.001}],
+                               strategy=self.strategy)
+
+    def required_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for b in self.bundles:
+            for k, v in b.items():
+                out[k] = out.get(k, 0) + v
+        return out

@@ -1,0 +1,194 @@
+"""Tune layer tests: searchers, schedulers, checkpointing, PBT."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig
+from ray_tpu.air.config import CheckpointConfig, FailureConfig
+from ray_tpu.tune import (
+    ASHAScheduler,
+    MaximumIterationStopper,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_search_expansion():
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(trainable, param_space={
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.grid_search([0, 1]),
+    })
+    grid = tuner.fit()
+    assert len(grid) == 6
+    best = grid.get_best_result("score")
+    assert best.metrics["score"] == 31
+
+
+def test_random_sampling_and_num_samples():
+    def trainable(config):
+        tune.report({"v": config["x"]})
+
+    tuner = Tuner(trainable,
+                  param_space={"x": tune.uniform(0, 1)},
+                  tune_config=TuneConfig(num_samples=5, seed=7))
+    grid = tuner.fit()
+    vals = [r.metrics["v"] for r in grid]
+    assert len(vals) == 5
+    assert len(set(vals)) == 5
+    assert all(0 <= v <= 1 for v in vals)
+
+
+def test_class_trainable_and_stop_criteria():
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config.get("start", 0)
+
+        def step(self):
+            self.x += 1
+            return {"x": self.x}
+
+        def save_checkpoint(self):
+            return {"x": self.x}
+
+        def load_checkpoint(self, data):
+            self.x = data["x"]
+
+    tuner = Tuner(MyTrainable, param_space={"start": 5},
+                  run_config=RunConfig(stop={"x": 8}))
+    grid = tuner.fit()
+    assert grid[0].metrics["x"] == 8
+
+
+def test_asha_stops_bad_trials():
+    def trainable(config):
+        for i in range(20):
+            # quality determines convergence speed
+            tune.report({"acc": config["q"] * (i + 1) / 20})
+
+    scheduler = ASHAScheduler(max_t=20, grace_period=2,
+                              reduction_factor=2)
+    tuner = Tuner(trainable,
+                  param_space={"q": tune.grid_search(
+                      [0.1, 0.2, 0.5, 0.9])},
+                  tune_config=TuneConfig(metric="acc", mode="max",
+                                         scheduler=scheduler))
+    grid = tuner.fit()
+    best = grid.get_best_result("acc")
+    assert best.metrics["config"]["q"] == 0.9
+    # at least one bad trial was cut early
+    iters = [len(r.metrics_history) for r in grid]
+    assert min(iters) < 20
+
+
+def test_checkpoint_keep_top_k():
+    def trainable(config):
+        for i, score in enumerate([1, 5, 3, 9, 2]):
+            tune.report({"score": score},
+                        checkpoint=Checkpoint.from_dict({"i": i,
+                                                         "score": score}))
+
+    tuner = Tuner(trainable, run_config=RunConfig(
+        checkpoint_config=CheckpointConfig(
+            num_to_keep=2, checkpoint_score_attribute="score")))
+    grid = tuner.fit()
+    best = grid[0].checkpoint
+    assert best.to_dict()["score"] == 9
+    kept = [m["score"] for _, m in grid[0].best_checkpoints]
+    assert sorted(kept) == [5, 9]
+
+
+def test_failure_retry_from_checkpoint():
+    attempts = {"n": 0}
+
+    class Flaky(tune.Trainable):
+        def setup(self, config):
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            if self.i == 3 and attempts["n"] == 0:
+                attempts["n"] += 1
+                raise RuntimeError("transient failure")
+            return {"i": self.i, "done": self.i >= 5}
+
+        def save_checkpoint(self):
+            return {"i": self.i}
+
+        def load_checkpoint(self, data):
+            self.i = data["i"]
+
+    tuner = Tuner(Flaky, run_config=RunConfig(
+        failure_config=FailureConfig(max_failures=2),
+        stop={"i": 5}))
+    grid = tuner.fit()
+    assert grid[0].error is None
+    assert grid[0].metrics["i"] == 5
+
+
+def test_pbt_clones_good_config():
+    """Bad-config trials should end up near the good config's performance
+    after exploiting its checkpoint."""
+
+    def trainable(config):
+        ck = tune.get_checkpoint()
+        x = ck.to_dict()["x"] if ck else 0.0
+        for _ in range(30):
+            x += config["lr"]
+            tune.report({"x": x},
+                        checkpoint=Checkpoint.from_dict({"x": x}))
+
+    pbt = PopulationBasedTraining(
+        metric="x", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": [0.01, 1.0]}, seed=0)
+    tuner = Tuner(trainable,
+                  param_space={"lr": tune.grid_search([0.01, 1.0])},
+                  tune_config=TuneConfig(metric="x", mode="max",
+                                         scheduler=pbt))
+    grid = tuner.fit()
+    finals = sorted(r.metrics["x"] for r in grid)
+    # Without PBT the bad trial ends at 0.3; with exploitation it should
+    # ride the good trial's checkpoint well past that.
+    assert finals[0] > 1.0, finals
+
+
+def test_stopper_max_iterations():
+    def trainable(config):
+        for i in range(100):
+            tune.report({"i": i})
+
+    tuner = Tuner(trainable, run_config=RunConfig(
+        stop=MaximumIterationStopper(5)))
+    grid = tuner.fit()
+    assert len(grid[0].metrics_history) == 5
+
+
+def test_tune_run_shim():
+    grid = tune.run(lambda cfg: tune.report({"m": cfg["x"] ** 2}),
+                    config={"x": tune.grid_search([2, 3])},
+                    metric="m", mode="max")
+    assert grid.get_best_result("m").metrics["m"] == 9
+
+
+def test_with_parameters():
+    big = np.arange(1000)
+
+    def trainable(config, data=None):
+        tune.report({"s": int(data.sum()) + config["x"]})
+
+    wrapped = tune.with_parameters(trainable, data=big)
+    grid = Tuner(wrapped, param_space={"x": 1}).fit()
+    assert grid[0].metrics["s"] == int(big.sum()) + 1
