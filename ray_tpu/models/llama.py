@@ -283,3 +283,84 @@ def loss_fn(params, batch, cfg: LlamaConfig, *, mesh=None,
     loss = (losses * mask).sum() / total
     return loss, {"loss": loss, "tokens": total,
                   "perplexity": jnp.exp(loss)}
+
+
+# ---------------------------------------------------------------------------
+# KV-cache inference path (prefill + decode) — used by ray_tpu.serve.llm
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: LlamaConfig, n_slots: int, max_seq: int,
+                  dtype=None) -> Dict[str, Any]:
+    """Slot-based KV cache: [layers, slots, max_seq, kv_heads, head_dim].
+    One slot per in-flight sequence; continuous batching admits/retires
+    requests per slot without touching the others (static shapes → one
+    compiled decode program)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, n_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(cfg, q, k_cache, v_cache, q_positions):
+    """q: [B, T, H, D]; caches: [B, S, Hkv, D]; q_positions: [B, T]
+    absolute positions. Causal over absolute key positions."""
+    b, t, h, d = q.shape
+    s = k_cache.shape[1]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    k = jnp.repeat(k_cache, rep, axis=2)
+    v = jnp.repeat(v_cache, rep, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    key_pos = jnp.arange(s)
+    mask = key_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache,
+                       start_pos):
+    """Incremental forward: runs `tokens` [B, T] starting at per-sequence
+    absolute offsets `start_pos` [B], reading/writing the KV cache.
+    Returns (logits [B, T, vocab], new_cache). Works for prefill (T =
+    prompt length) and decode (T = 1) with one code path.
+    """
+    b, t = tokens.shape
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+    positions = start_pos[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def write_cache(cache_b, new_b, start_b):
+        # cache_b: [S, Hkv, D]; new_b: [T, Hkv, D]
+        return lax.dynamic_update_slice(
+            cache_b, new_b.astype(cache_b.dtype), (start_b, 0, 0))
+
+    def layer(x, scanned):
+        lp, k_cache_l, v_cache_l = scanned
+        h = rms_norm_reference(x, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache_l = jax.vmap(write_cache)(k_cache_l, k, start_pos)
+        v_cache_l = jax.vmap(write_cache)(v_cache_l, v, start_pos)
+        attn = _cached_attention(cfg, q, k_cache_l, v_cache_l, positions)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn.astype(cfg.dtype),
+                           lp["wo"])
+        h2 = rms_norm_reference(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h2, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", h2, lp["w3"])
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_new, v_new) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm_reference(x, params["final_norm"], cfg.norm_eps)
+    out_w = params["embed"].T if cfg.tie_embeddings else params["out"]
+    logits = jnp.einsum("bsd,dv->bsv", x, out_w.astype(cfg.dtype))
+    return logits, {"k": k_new, "v": v_new}

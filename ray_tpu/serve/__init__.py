@@ -1,0 +1,180 @@
+"""ray_tpu.serve: online model serving on actors.
+
+Reference `python/ray/serve/` (SURVEY.md §2.4 + §3.4 request path):
+`@serve.deployment` → `serve.run` → detached controller reconciles
+replica actors; handles route via client-side routers fed by long-poll;
+`@serve.batch` batches concurrent calls; an HTTP proxy fronts handles.
+TPU-specific serving (compiled-XLA replicas, continuous batching with a
+paged KV cache) lives in `ray_tpu.serve.llm`.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve._private.controller import (
+    CONTROLLER_NAME,
+    get_or_create_controller,
+)
+from ray_tpu.serve._private.http_proxy import HTTPProxy
+from ray_tpu.serve._private.router import ServeHandle
+
+_proxy: Optional[HTTPProxy] = None
+
+
+@dataclass
+class Deployment:
+    """Result of @serve.deployment; `.bind()`/`.options()` mirror the
+    reference's deployment DSL (`serve/deployment.py`)."""
+
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+    user_config: Any = None
+    max_concurrent_queries: int = 100
+    ray_actor_options: Optional[dict] = None
+    autoscaling_config: Optional[dict] = None
+    route_prefix: Optional[str] = None
+    version: Optional[str] = None
+
+    def options(self, **kwargs) -> "Deployment":
+        import dataclasses as dc
+
+        known = {f.name for f in dc.fields(Deployment)}
+        clean = {k: v for k, v in kwargs.items() if k in known}
+        return dc.replace(self, **clean)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def deploy(self, *init_args, **init_kwargs):
+        return run(self.bind(*init_args, **init_kwargs),
+                   route_prefix=self.route_prefix)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    args: tuple
+    kwargs: dict
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, init_args: tuple = (),
+               init_kwargs: Optional[dict] = None, user_config: Any = None,
+               max_concurrent_queries: int = 100,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None,
+               route_prefix: Optional[str] = None,
+               version: Optional[str] = None, **_ignored):
+    """`@serve.deployment` (reference `serve/api.py`)."""
+
+    def wrap(obj):
+        return Deployment(
+            func_or_class=obj, name=name or obj.__name__,
+            num_replicas=num_replicas, init_args=init_args,
+            init_kwargs=init_kwargs or {}, user_config=user_config,
+            max_concurrent_queries=max_concurrent_queries,
+            ray_actor_options=ray_actor_options,
+            autoscaling_config=autoscaling_config,
+            route_prefix=route_prefix, version=version)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def run(target, *, name: str = "default", route_prefix: Optional[str] = None,
+        _blocking: bool = True) -> ServeHandle:
+    """Deploy an Application (or bare Deployment). Reference:
+    `serve.run` (`serve/api.py`)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects a bound deployment, got "
+                        f"{type(target)}")
+    dep = target.deployment
+    controller = get_or_create_controller()
+    info = {
+        "cls": dep.func_or_class,
+        "init_args": target.args,
+        "init_kwargs": target.kwargs,
+        "num_replicas": dep.num_replicas,
+        "user_config": dep.user_config,
+        "max_concurrent_queries": dep.max_concurrent_queries,
+        "ray_actor_options": dep.ray_actor_options,
+        "autoscaling_config": dep.autoscaling_config,
+        "version": dep.version,
+    }
+    ray_tpu.get(controller.deploy.remote(dep.name, info))
+    if _blocking:
+        _wait_healthy(controller, dep.name)
+    handle = ServeHandle(controller, dep.name,
+                         dep.max_concurrent_queries)
+    prefix = route_prefix if route_prefix is not None else dep.route_prefix
+    if prefix is not None:
+        start_http_proxy().routes.set(prefix, handle)
+    return handle
+
+
+def _wait_healthy(controller, name: str, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        info = ray_tpu.get(controller.get_deployment_info.remote(name))
+        if info and info["status"] == "HEALTHY":
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"deployment {name} not healthy after {timeout}s")
+
+
+def get_deployment_handle(name: str, *_args, **_kwargs) -> ServeHandle:
+    controller = get_or_create_controller()
+    info = ray_tpu.get(controller.get_deployment_info.remote(name))
+    if info is None:
+        raise ValueError(f"deployment {name!r} not found")
+    return ServeHandle(controller, name)
+
+
+def get_app_handle(name: str) -> ServeHandle:
+    return get_deployment_handle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = get_or_create_controller()
+    names = ray_tpu.get(controller.list_deployments.remote())
+    return {
+        n: ray_tpu.get(controller.get_deployment_info.remote(n))
+        for n in names
+    }
+
+
+def delete(name: str):
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:
+    global _proxy
+    if _proxy is None:
+        _proxy = HTTPProxy(host, port)
+    return _proxy
+
+
+def shutdown():
+    global _proxy
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote())
+        ray_tpu.kill(controller)
+    except ValueError:
+        pass
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None

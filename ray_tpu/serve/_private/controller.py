@@ -1,0 +1,225 @@
+"""ServeController: the reconciliation brain.
+
+Reference: `serve/controller.py:70` + `_private/deployment_state.py:998` —
+a detached singleton actor holding target state per deployment (replica
+count, version, config) and a reconcile loop that starts/stops replica
+actors to match, performs rolling updates on version change, health-checks
+replicas, and drives autoscaling from router-reported queue metrics.
+Membership changes broadcast to routers via the long-poll host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.long_poll import LongPollHost
+from ray_tpu.serve._private.replica import ServeReplica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def _version_hash(payload) -> str:
+    import pickle
+
+    try:
+        blob = pickle.dumps(payload)
+    except Exception:
+        blob = repr(payload).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
+class _DeploymentState:
+    def __init__(self, name: str, info: Dict[str, Any]):
+        self.name = name
+        self.info = info  # cls, init_args, init_kwargs, num_replicas, ...
+        self.version = info["version"]
+        self.replicas: List[Any] = []
+        self.replica_versions: Dict[Any, str] = {}
+        self.status = "UPDATING"
+        self.message = ""
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._long_poll = LongPollHost()
+        self._metrics: Dict[str, Dict[str, float]] = {}
+        self._shutdown = threading.Event()
+        self._reconciler = threading.Thread(target=self._reconcile_loop,
+                                            daemon=True)
+        self._reconciler.start()
+
+    # -- API -------------------------------------------------------------
+
+    def deploy(self, name: str, info: Dict[str, Any]) -> bool:
+        info = dict(info)
+        info["version"] = info.get("version") or _version_hash(
+            (info.get("init_args"), info.get("init_kwargs"),
+             info.get("user_config"), info.get("num_replicas")))
+        with self._lock:
+            existing = self._deployments.get(name)
+            if existing is None:
+                self._deployments[name] = _DeploymentState(name, info)
+            else:
+                existing.info = info
+                existing.version = info["version"]
+                existing.status = "UPDATING"
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+        if state:
+            for r in state.replicas:
+                self._stop_replica(r)
+            self._broadcast(name, [])
+        return True
+
+    def get_deployment_info(self, name: str) -> Optional[dict]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return None
+            return {"name": name, "status": st.status,
+                    "num_replicas": len(st.replicas),
+                    "target_replicas": st.info.get("num_replicas", 1),
+                    "version": st.version, "message": st.message}
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments)
+
+    def listen(self, key: str, known_version: int = -1):
+        return self._long_poll.listen(key, known_version)
+
+    def record_handle_metrics(self, deployment: str,
+                              queued: float) -> bool:
+        with self._lock:
+            self._metrics.setdefault(deployment, {})["queued"] = queued
+            self._metrics[deployment]["ts"] = time.monotonic()
+        return True
+
+    def graceful_shutdown(self) -> bool:
+        self._shutdown.set()
+        with self._lock:
+            states = list(self._deployments.values())
+            self._deployments.clear()
+        for st in states:
+            for r in st.replicas:
+                self._stop_replica(r)
+        return True
+
+    # -- reconcile -------------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                traceback.print_exc()
+            self._shutdown.wait(0.1)
+
+    def _reconcile_once(self):
+        with self._lock:
+            states = list(self._deployments.values())
+        for st in states:
+            self._autoscale(st)
+            target = int(st.info.get("num_replicas", 1))
+            version = st.version
+            changed = False
+            # Rolling update: stop outdated replicas one at a time.
+            outdated = [r for r in st.replicas
+                        if st.replica_versions.get(r) != version]
+            if outdated and len(st.replicas) >= target:
+                victim = outdated[0]
+                st.replicas.remove(victim)
+                st.replica_versions.pop(victim, None)
+                self._stop_replica(victim)
+                changed = True
+            while len(st.replicas) < target:
+                r = self._start_replica(st)
+                if r is None:
+                    break
+                st.replicas.append(r)
+                st.replica_versions[r] = version
+                changed = True
+            while len(st.replicas) > target:
+                victim = st.replicas.pop()
+                st.replica_versions.pop(victim, None)
+                self._stop_replica(victim)
+                changed = True
+            if changed or st.status == "UPDATING":
+                up_to_date = all(st.replica_versions.get(r) == version
+                                 for r in st.replicas)
+                if len(st.replicas) == target and up_to_date:
+                    st.status = "HEALTHY"
+                self._broadcast(st.name, st.replicas)
+
+    def _autoscale(self, st: _DeploymentState):
+        cfg = st.info.get("autoscaling_config")
+        if not cfg:
+            return
+        m = self._metrics.get(st.name)
+        if not m or time.monotonic() - m.get("ts", 0) > 10:
+            return
+        target_in_flight = cfg.get("target_num_ongoing_requests_per_replica",
+                                   1.0)
+        current = max(1, len(st.replicas))
+        desired = m["queued"] / max(target_in_flight, 1e-6)
+        desired = int(min(max(desired, cfg.get("min_replicas", 1)),
+                          cfg.get("max_replicas", current)))
+        if desired != st.info.get("num_replicas"):
+            st.info["num_replicas"] = desired
+            st.status = "UPDATING"
+
+    def _start_replica(self, st: _DeploymentState):
+        info = st.info
+        try:
+            # Replicas serve queries concurrently up to the queries cap
+            # (the reference replica is an asyncio actor).
+            opts: Dict[str, Any] = {
+                "max_concurrency": int(
+                    info.get("max_concurrent_queries") or 100),
+            }
+            res = dict(info.get("ray_actor_options") or {})
+            if "num_cpus" in res:
+                opts["num_cpus"] = res["num_cpus"]
+            if "num_tpus" in res:
+                opts["num_tpus"] = res["num_tpus"]
+            return ServeReplica.options(**opts).remote(
+                st.name, info["cls"], info.get("init_args"),
+                info.get("init_kwargs"), info.get("user_config"),
+                st.version)
+        except Exception:
+            st.message = traceback.format_exc()
+            return None
+
+    def _stop_replica(self, replica):
+        try:
+            replica.prepare_for_shutdown.remote()
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    def _broadcast(self, deployment: str, replicas: List[Any]):
+        self._long_poll.notify_changed(f"replicas::{deployment}",
+                                       list(replicas))
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        try:
+            return ServeController.options(
+                name=CONTROLLER_NAME, lifetime="detached",
+                max_concurrency=64, num_cpus=0).remote()
+        except ValueError:
+            return ray_tpu.get_actor(CONTROLLER_NAME)

@@ -1,0 +1,104 @@
+"""HTTP proxy: route HTTP requests to deployment handles.
+
+Reference: `serve/_private/http_proxy.py:425` (uvicorn + ASGI). Here a
+threaded stdlib HTTP server (no external deps in the image) with
+longest-prefix routing; JSON bodies are parsed and handed to the
+deployment callable, results JSON-encoded. An ASGI front-end can be
+swapped in where starlette/uvicorn are available.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+
+
+class _RouteTable:
+    def __init__(self):
+        self._routes: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set(self, prefix: str, handle):
+        with self._lock:
+            self._routes[prefix.rstrip("/") or "/"] = handle
+
+    def remove(self, prefix: str):
+        with self._lock:
+            self._routes.pop(prefix.rstrip("/") or "/", None)
+
+    def match(self, path: str) -> Tuple[Optional[Any], str]:
+        with self._lock:
+            routes = dict(self._routes)
+        best = None
+        best_len = -1
+        for prefix, handle in routes.items():
+            p = prefix.rstrip("/")
+            if (path == p or path.startswith(p + "/") or p == "") and \
+                    len(p) > best_len:
+                best, best_len = (handle, p), len(p)
+        if best is None:
+            return None, path
+        handle, p = best
+        return handle, path[len(p):] or "/"
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.routes = _RouteTable()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self):
+                handle, rest = proxy.routes.match(self.path.split("?")[0])
+                if handle is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                payload: Any = None
+                if body:
+                    try:
+                        payload = json.loads(body)
+                    except ValueError:
+                        payload = body.decode("utf-8", "replace")
+                try:
+                    if payload is None:
+                        ref = handle.remote()
+                    else:
+                        ref = handle.remote(payload)
+                    result = ray_tpu.get(ref, timeout=60)
+                    out = json.dumps(result).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(out)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(json.dumps(
+                        {"error": str(e)}).encode())
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+            do_PUT = _dispatch
+            do_DELETE = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http-proxy")
+        self._thread.start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()

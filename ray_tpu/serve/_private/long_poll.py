@@ -1,0 +1,74 @@
+"""Long-poll: the control→data-plane update channel.
+
+Reference: `serve/_private/long_poll.py:185` (LongPollHost) — clients ask
+"notify me when key K changes past version V"; the host blocks the call
+until the snapshot advances. Routers and proxies learn replica-set and
+route-table changes this way instead of polling hot loops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class LongPollHost:
+    """Lives inside the controller actor (thread-safe)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._snapshots: Dict[str, Any] = {}
+        self._versions: Dict[str, int] = {}
+
+    def notify_changed(self, key: str, snapshot: Any) -> None:
+        with self._cond:
+            self._snapshots[key] = snapshot
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._cond.notify_all()
+
+    def listen(self, key: str, known_version: int = -1,
+               timeout: float = 30.0) -> Tuple[int, Any]:
+        """Block until version(key) > known_version (or timeout); returns
+        (version, snapshot)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._versions.get(key, 0) > known_version,
+                timeout=timeout)
+            return (self._versions.get(key, 0),
+                    self._snapshots.get(key))
+
+
+class LongPollClient:
+    """Driver/router-side: background thread keeping a local copy fresh."""
+
+    def __init__(self, controller, key: str, callback):
+        self._controller = controller
+        self._key = key
+        self._callback = callback
+        self._version = -1
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"longpoll-{key}")
+        self._thread.start()
+
+    def _loop(self):
+        import ray_tpu
+
+        while not self._stopped.is_set():
+            try:
+                version, snapshot = ray_tpu.get(
+                    self._controller.listen.remote(self._key, self._version),
+                    timeout=60)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                continue
+            if version > self._version:
+                self._version = version
+                try:
+                    self._callback(snapshot)
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,85 @@
+"""Replica: the actor wrapping one copy of a deployment's callable.
+
+Reference: `serve/_private/replica.py:268` (RayServeReplica) — construct
+the user class, serve queries, expose reconfigure + health check, report
+in-flight load for the router's capacity decisions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    def __init__(self, deployment_name: str, serialized_cls, init_args,
+                 init_kwargs, user_config=None, version: str = ""):
+        self.deployment_name = deployment_name
+        self.version = version
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._total = 0
+        self._t_busy = 0.0
+        if isinstance(serialized_cls, type):
+            self.callable = serialized_cls(*(init_args or ()),
+                                           **(init_kwargs or {}))
+        else:
+            self.callable = serialized_cls  # plain function deployment
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        fn = getattr(self.callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._in_flight += 1
+            self._total += 1
+        t0 = time.perf_counter()
+        try:
+            target = self.callable
+            if method and method != "__call__":
+                target = getattr(self.callable, method)
+            elif not callable(target):
+                target = getattr(self.callable, "__call__")
+            result = target(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._in_flight -= 1
+                self._t_busy += time.perf_counter() - t0
+
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"in_flight": self._in_flight, "total": self._total,
+                    "busy_s": self._t_busy}
+
+    def prepare_for_shutdown(self) -> bool:
+        # Graceful: wait for in-flight to drain (bounded).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._in_flight == 0:
+                    return True
+            time.sleep(0.02)
+        return False

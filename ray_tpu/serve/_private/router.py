@@ -1,0 +1,125 @@
+"""Router + ServeHandle: the data plane.
+
+Reference: `serve/_private/router.py:263` (`assign_replica :224` —
+round-robin skipping replicas at `max_concurrent_queries`) and
+`serve/handle.py`. Replica membership arrives via long-poll; in-flight
+refs are tracked per replica so the cap is enforced client-side.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.long_poll import LongPollClient
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str,
+                 max_concurrent_queries: int = 100):
+        self._controller = controller
+        self._deployment = deployment_name
+        self._max_concurrent = max_concurrent_queries
+        self._replicas: List[Any] = []
+        self._rr = itertools.count()
+        self._in_flight: Dict[Any, List] = {}
+        self._lock = threading.Condition()
+        self._client = LongPollClient(
+            controller, f"replicas::{deployment_name}",
+            self._update_replicas)
+        self._last_report = 0.0
+
+    def _update_replicas(self, replicas):
+        with self._lock:
+            self._replicas = list(replicas or [])
+            for r in self._replicas:
+                self._in_flight.setdefault(r, [])
+            self._lock.notify_all()
+
+    def _prune(self, replica) -> int:
+        refs = self._in_flight.get(replica, [])
+        if refs:
+            _, not_ready = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0)
+            self._in_flight[replica] = list(not_ready)
+        return len(self._in_flight.get(replica, []))
+
+    def assign_request(self, method: str, args: tuple, kwargs: dict,
+                       timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                n = len(replicas)
+                start = next(self._rr)
+                for i in range(n):
+                    replica = replicas[(start + i) % n]
+                    with self._lock:
+                        load = self._prune(replica)
+                        if load < self._max_concurrent:
+                            ref = replica.handle_request.remote(
+                                method, args, kwargs)
+                            self._in_flight[replica].append(ref)
+                            self._maybe_report()
+                            return ref
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica available for {self._deployment} "
+                    f"within {timeout}s")
+            time.sleep(0.005)
+
+    def _maybe_report(self):
+        now = time.monotonic()
+        if now - self._last_report < 0.5:
+            return
+        self._last_report = now
+        total = sum(len(v) for v in self._in_flight.values())
+        try:
+            self._controller.record_handle_metrics.remote(
+                self._deployment, float(total))
+        except Exception:
+            pass
+
+    def shutdown(self):
+        self._client.stop()
+
+
+class ServeHandle:
+    """Reference: `serve/handle.py` — `handle.remote(...)`,
+    `handle.method_name.remote(...)`."""
+
+    def __init__(self, controller, deployment_name: str,
+                 max_concurrent_queries: int = 100, _method: str = ""):
+        self._controller = controller
+        self._deployment = deployment_name
+        self._method = _method
+        self._router_holder: Dict[str, Router] = {}
+        self._max_concurrent = max_concurrent_queries
+
+    def _router(self) -> Router:
+        r = self._router_holder.get("r")
+        if r is None:
+            r = Router(self._controller, self._deployment,
+                       self._max_concurrent)
+            self._router_holder["r"] = r
+        return r
+
+    def remote(self, *args, **kwargs):
+        return self._router().assign_request(self._method or "__call__",
+                                             args, kwargs)
+
+    def __getattr__(self, name: str) -> "ServeHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        h = ServeHandle(self._controller, self._deployment,
+                        self._max_concurrent, _method=name)
+        h._router_holder = self._router_holder  # share router + caps
+        return h
+
+    def __reduce__(self):
+        return (ServeHandle, (self._controller, self._deployment,
+                              self._max_concurrent, self._method))

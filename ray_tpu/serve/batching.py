@@ -1,0 +1,105 @@
+"""@serve.batch: dynamic request batching.
+
+Reference: `python/ray/serve/batching.py` — concurrent calls to the
+decorated method are grouped (up to `max_batch_size`, waiting at most
+`batch_wait_timeout_s`) and executed once over the list; each caller gets
+its element back. Essential for ML serving: the replica turns N
+single-sample requests into one batched device invocation.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="serve-batcher")
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                first = self.queue.get(timeout=5.0)
+            except queue.Empty:
+                return  # idle thread exits; recreated on demand
+            batch = [first]
+            deadline = self.timeout
+            while len(batch) < self.max_batch_size:
+                try:
+                    batch.append(self.queue.get(timeout=deadline))
+                except queue.Empty:
+                    break
+            self._run(batch)
+
+    def _run(self, batch: List[tuple]):
+        futures = [f for f, _ in batch]
+        items = [x for _, x in batch]
+        try:
+            results = self.fn(items)
+            if results is None or len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function must return a list of "
+                    f"length {len(items)}, got {results!r}")
+            for f, r in zip(futures, results):
+                f.set_result(r)
+        except BaseException as e:  # noqa: BLE001
+            for f in futures:
+                if not f.done():
+                    f.set_exception(e)
+
+    def submit(self, item) -> Future:
+        f: Future = Future()
+        self.queue.put((f, item))
+        self._ensure_thread()
+        return f
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator. The wrapped function must accept a list and return a
+    list of equal length; callers pass single items."""
+
+    def decorate(fn: Callable):
+        batchers: dict = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            # Methods: bind per-instance so `self` stays out of the batch.
+            if len(args) == 2 and not isinstance(args[0], (list, tuple)):
+                self_obj, item = args
+                key = id(self_obj)
+                if key not in batchers:
+                    batchers[key] = _Batcher(
+                        lambda items, s=self_obj: fn(s, items),
+                        max_batch_size, batch_wait_timeout_s)
+                return batchers[key].submit(item).result()
+            (item,) = args
+            if "fn" not in batchers:
+                batchers["fn"] = _Batcher(fn, max_batch_size,
+                                          batch_wait_timeout_s)
+            return batchers["fn"].submit(item).result()
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return decorate(_fn)
+    return decorate

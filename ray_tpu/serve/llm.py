@@ -1,0 +1,298 @@
+"""Continuous-batching LLM engine for TPU serving.
+
+No reference equivalent (the reference serves arbitrary Python callables);
+this is the TPU-specific serving layer SURVEY.md §7 step 8 calls for:
+compiled-XLA replicas with continuous batching. Design constraints come
+from XLA's compilation model — every device program must have static
+shapes — so:
+
+- The KV cache is slot-based: `max_batch_size` sequence slots, each with a
+  `max_seq_len` KV region (`models.llama.init_kv_cache`). Admission =
+  prefill into a free slot; retirement frees the slot. The decode step is
+  ONE fixed-shape jit program over all slots regardless of occupancy.
+- Prefill lengths are bucketed to powers of two, so at most log2(max_seq)
+  prefill programs ever compile.
+- Sampling (greedy / temperature / top-k) runs on device; one token per
+  slot per step streams back to waiting callers.
+
+The engine is thread-safe: callers enqueue requests and block on their
+completion; a background loop interleaves admission and decode — the
+continuous-batching scheduler (admission between decode steps, no
+generation stall).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    forward_with_cache,
+    init_kv_cache,
+)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: int
+    prompt: List[int]
+    params: SamplingParams
+    out_queue: "queue.Queue"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    t_arrival: float = 0.0
+    t_first_token: Optional[float] = None
+
+
+class LLMEngine:
+    def __init__(self, cfg: LlamaConfig, params, *,
+                 max_batch_size: int = 8, max_seq_len: Optional[int] = None,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = max_batch_size
+        self.max_seq = max_seq_len or cfg.max_seq_len
+        self.cache = init_kv_cache(cfg, self.n_slots, self.max_seq)
+        self._rng = jax.random.PRNGKey(seed)
+
+        # Per-slot host state.
+        self._free_slots = list(range(self.n_slots))
+        self._slot_req: Dict[int, _Request] = {}
+        self._lengths = np.zeros(self.n_slots, np.int32)  # tokens in cache
+        self._last_token = np.zeros(self.n_slots, np.int32)
+        self._active = np.zeros(self.n_slots, bool)
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._req_counter = itertools.count()
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # Compiled programs. Prefill is per-slot (batch 1, bucketed T);
+        # decode covers all slots at T=1.
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(0,),
+                                static_argnames=("t",))
+
+    # -- compiled bodies -------------------------------------------------
+
+    def _prefill_impl(self, cache, tokens, slot, length, t):
+        """tokens: [1, t] padded prompt; writes KV for one slot, returns
+        logits at the last real position [vocab]."""
+        slot_cache = {"k": lax_slice_slot(cache["k"], slot),
+                      "v": lax_slice_slot(cache["v"], slot)}
+        logits, new_slot_cache = forward_with_cache(
+            self.params, tokens, self.cfg, slot_cache,
+            jnp.zeros((1,), jnp.int32))
+        cache = {
+            "k": lax_write_slot(cache["k"], new_slot_cache["k"], slot),
+            "v": lax_write_slot(cache["v"], new_slot_cache["v"], slot),
+        }
+        last = logits[0, length - 1]
+        return cache, last
+
+    def _decode_impl(self, cache, last_tokens, lengths, temps, rng):
+        """One token for every slot. last_tokens/lengths/temps: [slots].
+        `lengths` is the absolute position the fed token occupies."""
+        logits, cache = forward_with_cache(
+            self.params, last_tokens[:, None], self.cfg, cache, lengths)
+        logits = logits[:, 0, :].astype(jnp.float32)  # [slots, vocab]
+        greedy = logits.argmax(-1)
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+        next_tokens = jnp.where(temps > 0, sampled, greedy)
+        return cache, next_tokens.astype(jnp.int32), rng
+
+    # -- public API ------------------------------------------------------
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._running.set()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="llm-engine")
+            self._thread.start()
+
+    def stop(self):
+        self._running.clear()
+
+    def generate(self, prompt_ids: List[int],
+                 params: Optional[SamplingParams] = None,
+                 stream: bool = False):
+        """Blocking generate (or an iterator of tokens with stream=True)."""
+        req = _Request(
+            request_id=next(self._req_counter), prompt=list(prompt_ids),
+            params=params or SamplingParams(), out_queue=queue.Queue(),
+            t_arrival=time.perf_counter())
+        self._queue.put(req)
+        self.start()
+
+        def token_iter():
+            while True:
+                item = req.out_queue.get()
+                if item is None:
+                    return
+                yield item
+
+        if stream:
+            return token_iter()
+        return list(token_iter())
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_slots": int(self._active.sum()),
+                "free_slots": len(self._free_slots),
+                "queued": self._queue.qsize(),
+            }
+
+    # -- engine loop -----------------------------------------------------
+
+    def _loop(self):
+        self._temps_arr = np.zeros(self.n_slots, np.float32)
+        while self._running.is_set():
+            admitted = self._admit()
+            if not self._active.any():
+                if not admitted:
+                    try:
+                        req = self._queue.get(timeout=0.05)
+                        self._queue.put(req)
+                    except queue.Empty:
+                        continue
+                continue
+            self._decode_once()
+
+    def _admit(self) -> bool:
+        admitted = False
+        while self._free_slots:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            prompt = req.prompt[-(self.max_seq - 1):]
+            t_real = len(prompt)
+            bucket = 1
+            while bucket < t_real:
+                bucket *= 2
+            bucket = min(bucket, self.max_seq)
+            slot = self._free_slots.pop()
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :t_real] = prompt
+            self.cache, last_logits = self._prefill(
+                self.cache, jnp.asarray(tokens),
+                jnp.int32(slot), jnp.int32(t_real), t=bucket)
+            first = int(np.asarray(last_logits.argmax(-1))) \
+                if req.params.temperature == 0 else int(np.asarray(
+                    jax.random.categorical(
+                        jax.random.fold_in(self._rng, req.request_id),
+                        last_logits / max(req.params.temperature, 1e-6))))
+            req.t_first_token = time.perf_counter()
+            req.tokens.append(first)
+            req.out_queue.put(first)
+            with self._lock:
+                req.slot = slot
+                self._slot_req[slot] = req
+                self._lengths[slot] = t_real
+                self._last_token[slot] = first
+                self._active[slot] = True
+                self._temps_arr[slot] = req.params.temperature
+            if self._finished(req, first):
+                self._retire(slot)
+            admitted = True
+        return admitted
+
+    def _decode_once(self):
+        # The fed token occupies absolute position `lengths` (prompt is
+        # 0..len-1, first generated token sits at len, etc.).
+        self.cache, next_tokens, self._rng = self._decode(
+            self.cache, jnp.asarray(self._last_token),
+            jnp.asarray(self._lengths), jnp.asarray(self._temps_arr),
+            self._rng)
+        next_host = np.asarray(next_tokens)
+        with self._lock:
+            for slot in np.nonzero(self._active)[0]:
+                req = self._slot_req[slot]
+                tok = int(next_host[slot])
+                req.tokens.append(tok)
+                req.out_queue.put(tok)
+                self._lengths[slot] += 1
+                self._last_token[slot] = tok
+                if self._finished(req, tok) or \
+                        self._lengths[slot] >= self.max_seq - 1:
+                    self._retire(slot)
+
+    def _finished(self, req: _Request, token: int) -> bool:
+        if token in req.params.stop_token_ids:
+            return True
+        return len(req.tokens) >= req.params.max_tokens
+
+    def _retire(self, slot: int):
+        req = self._slot_req.pop(slot, None)
+        if req is not None:
+            req.out_queue.put(None)
+        self._active[slot] = False
+        self._lengths[slot] = 0
+        self._free_slots.append(slot)
+
+
+def lax_slice_slot(cache, slot):
+    """cache: [L, slots, S, H, D] → [L, 1, S, H, D] at `slot`."""
+    return jax.lax.dynamic_slice_in_dim(cache, slot, 1, axis=1)
+
+
+def lax_write_slot(cache, slot_cache, slot):
+    return jax.lax.dynamic_update_slice_in_dim(cache, slot_cache, slot,
+                                               axis=1)
+
+
+# -- Serve integration ------------------------------------------------------
+
+
+class LLMDeployment:
+    """Deployment-ready wrapper: `serve.deployment(LLMDeployment).bind(...)`.
+
+    Each replica owns one engine (one model copy + cache in its chip's
+    HBM); serve's router spreads requests over replicas.
+    """
+
+    def __init__(self, cfg: LlamaConfig, params_fn: Callable[[], Any],
+                 max_batch_size: int = 8,
+                 max_seq_len: Optional[int] = None):
+        params = params_fn() if callable(params_fn) else params_fn
+        self.engine = LLMEngine(cfg, params, max_batch_size=max_batch_size,
+                                max_seq_len=max_seq_len)
+        self.engine.start()
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        tokens = self.engine.generate(
+            request["prompt_ids"],
+            SamplingParams(
+                max_tokens=int(request.get("max_tokens", 64)),
+                temperature=float(request.get("temperature", 0.0)),
+                stop_token_ids=tuple(request.get("stop_token_ids", ()))))
+        return {"tokens": tokens,
+                "latency_s": time.perf_counter() - t0}
+
+    def check_health(self):
+        assert self.engine._thread is None or \
+            self.engine._thread.is_alive() or \
+            not self.engine._running.is_set()
