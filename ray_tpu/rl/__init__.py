@@ -1,0 +1,37 @@
+"""ray_tpu.rl: reinforcement learning (the RLlib-equivalent).
+
+Reference `rllib/` (SURVEY.md §2.4): Algorithm-on-Trainable so Tune
+schedules RL runs, CPU rollout-worker actor fleets, jit-compiled learner
+updates (the TPU side), V-trace/GAE, replay buffers. Env API is
+gymnasium-style with built-in classic-control envs (no gym in the image).
+"""
+
+from ray_tpu.rl.algorithm import (  # noqa: F401
+    Algorithm,
+    AlgorithmConfig,
+    WorkerSet,
+)
+from ray_tpu.rl.algorithms import (  # noqa: F401
+    DQN,
+    DQNConfig,
+    IMPALA,
+    IMPALAConfig,
+    PPO,
+    PPOConfig,
+)
+from ray_tpu.rl.env import (  # noqa: F401
+    Box,
+    CartPoleEnv,
+    Discrete,
+    Env,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rl.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    ReservoirReplayBuffer,
+)
+from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rl.sample_batch import SampleBatch  # noqa: F401

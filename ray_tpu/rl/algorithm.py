@@ -1,0 +1,183 @@
+"""Algorithm base + fluent AlgorithmConfig.
+
+Reference: `rllib/algorithms/algorithm.py:149` (Algorithm extends
+Trainable; `step` = one Tune iteration) and `algorithm_config.py` (fluent
+config). Algorithms here follow the same shape: `config.build()` →
+`algo.train()` loops, and `Algorithm` subclasses `tune.Trainable` so Tune
+schedules RL experiments unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env_spec: Any = None
+        self.env_config: dict = {}
+        self.num_rollout_workers: int = 2
+        self.num_envs_per_worker: int = 1
+        self.rollout_fragment_length: int = 200
+        self.train_batch_size: int = 2000
+        self.lr: float = 5e-4
+        self.gamma: float = 0.99
+        self.seed: int = 0
+        self.extra: Dict[str, Any] = {}
+
+    # fluent API (reference naming)
+    def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env_spec = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 num_envs_per_worker=None,
+                 rollout_fragment_length=None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    env_runners = rollouts  # new-stack alias
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def resources(self, **kwargs) -> "AlgorithmConfig":
+        self.extra.update(kwargs)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None, "no algorithm class bound"
+        return self.algo_class(self)
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items()
+             if k not in ("algo_class",)}
+        return d
+
+
+class WorkerSet:
+    """Reference: `rllib/evaluation/worker_set.py` — the rollout fleet."""
+
+    def __init__(self, config: AlgorithmConfig, policy_apply: Callable,
+                 policy_kind: str = "actor_critic"):
+        from ray_tpu.rl.rollout_worker import RolloutWorker
+
+        self.workers = [
+            RolloutWorker.remote(
+                config.env_spec, policy_apply,
+                num_envs=config.num_envs_per_worker,
+                env_config=config.env_config,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1000 * (i + 1),
+                policy_kind=policy_kind)
+            for i in range(max(1, config.num_rollout_workers))
+        ]
+
+    def sample(self, weights) -> List:
+        ref_w = ray_tpu.put(weights)
+        return ray_tpu.get([w.sample.remote(ref_w) for w in self.workers])
+
+    def episode_stats(self) -> List:
+        out = []
+        for stats in ray_tpu.get([w.episode_stats.remote()
+                                  for w in self.workers]):
+            out.extend(stats)
+        return out
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+class Algorithm(Trainable):
+    """One RL algorithm instance; `train()` = one iteration."""
+
+    config_cls = AlgorithmConfig
+
+    def __init__(self, config=None):
+        if isinstance(config, AlgorithmConfig):
+            self.algo_config = config
+            super().__init__(config.to_dict())
+        else:
+            self.algo_config = self.config_cls()
+            if config:
+                self.algo_config.training(**{
+                    k: v for k, v in dict(config).items()})
+                if "env" in (config or {}):
+                    self.algo_config.environment(config["env"])
+            super().__init__(config or {})
+        self._iter_stats: Dict[str, Any] = {}
+        self._episode_window: List[float] = []
+
+    # Trainable hooks --------------------------------------------------
+
+    def setup(self, config):
+        self.build_components()
+
+    def build_components(self):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        metrics = self.training_step()
+        stats = self.workers.episode_stats() if hasattr(self, "workers") \
+            else []
+        for r, _ in stats:
+            self._episode_window.append(r)
+        self._episode_window = self._episode_window[-100:]
+        if self._episode_window:
+            metrics["episode_reward_mean"] = float(
+                np.mean(self._episode_window))
+            metrics["episodes_this_iter"] = len(stats)
+        return metrics
+
+    def cleanup(self):
+        if hasattr(self, "workers"):
+            self.workers.stop()
+
+    def save_checkpoint(self):
+        import jax
+
+        return {"weights": jax.device_get(self.get_weights())}
+
+    def load_checkpoint(self, data):
+        self.set_weights(data["weights"])
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights):
+        raise NotImplementedError

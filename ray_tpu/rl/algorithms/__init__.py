@@ -1,0 +1,3 @@
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401

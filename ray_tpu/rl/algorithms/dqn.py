@@ -1,0 +1,161 @@
+"""DQN with target network + (prioritized) replay.
+
+Reference: `rllib/algorithms/dqn/` — epsilon-greedy collection into a
+replay buffer, TD updates against a periodically-synced target network,
+optional double-Q and prioritized replay.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.buffer_size = 50_000
+        self.learning_starts = 1000
+        self.target_update_freq = 500  # env steps
+        self.train_batch_size = 32
+        self.double_q = True
+        self.prioritized_replay = False
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.num_sgd_per_iter = 32
+
+
+class DQN(Algorithm):
+    config_cls = DQNConfig
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        n_actions = env.action_space.n
+        self.params = models.q_net_init(jax.random.PRNGKey(cfg.seed),
+                                        obs_dim, n_actions)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = (PrioritizedReplayBuffer(cfg.buffer_size)
+                       if cfg.prioritized_replay
+                       else ReplayBuffer(cfg.buffer_size))
+        self._steps_sampled = 0
+        self._steps_since_target = 0
+
+        # Behaviour policy on workers: epsilon-greedy expressed as logits
+        # of the mixture (1-eps)·near-greedy + eps·uniform, so the
+        # worker's categorical sampling implements the exploration.
+        def behaviour(params_and_eps, obs):
+            params, eps = params_and_eps
+            q = models.q_net_apply(params, obs)
+            n = q.shape[-1]
+            greedy_probs = jax.nn.softmax(q * 50.0)
+            probs = (1.0 - eps) * greedy_probs + eps / n
+            return jnp.log(probs + 1e-9), jnp.zeros(obs.shape[0])
+
+        self.workers = WorkerSet(cfg, behaviour)
+        self._update = jax.jit(functools.partial(
+            _dqn_update, tx=self.tx, gamma=cfg.gamma,
+            double_q=cfg.double_q))
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        batches = self.workers.sample((self.params, jnp.float32(eps)))
+        flat = []
+        for b in batches:
+            n, t = np.asarray(b[REWARDS]).shape
+            flat.append(SampleBatch({
+                k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
+                for k, v in b.items()
+            }))
+        batch = SampleBatch.concat(flat)
+        self.buffer.add(batch)
+        self._steps_sampled += batch.count
+        self._steps_since_target += batch.count
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()
+                     if k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)})
+                losses.append(float(loss))
+                if hasattr(self.buffer, "update_priorities") and \
+                        "batch_indexes" in mb:
+                    self.buffer.update_priorities(
+                        mb["batch_indexes"], np.asarray(td))
+        if self._steps_since_target >= cfg.target_update_freq:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._steps_since_target = 0
+        return {
+            "mean_td_loss": float(np.mean(losses)) if losses else None,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "num_env_steps_sampled_this_iter": batch.count,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.tx.init(self.params)
+
+
+def _dqn_update(params, target_params, opt_state, mb, *, tx, gamma,
+                double_q):
+    def loss_fn(params):
+        q = models.q_net_apply(params, mb[OBS])
+        q_taken = jnp.take_along_axis(q, mb[ACTIONS][:, None], 1)[:, 0]
+        q_next_target = models.q_net_apply(target_params, mb[NEXT_OBS])
+        if double_q:
+            q_next_online = models.q_net_apply(params, mb[NEXT_OBS])
+            next_a = q_next_online.argmax(-1)
+            q_next = jnp.take_along_axis(q_next_target, next_a[:, None],
+                                         1)[:, 0]
+        else:
+            q_next = q_next_target.max(-1)
+        target = mb[REWARDS] + gamma * (1.0 - mb[DONES].astype(
+            jnp.float32)) * jax.lax.stop_gradient(q_next)
+        td = q_taken - target
+        return (td ** 2).mean(), td
+
+    (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, jnp.abs(td)

@@ -1,0 +1,188 @@
+"""Environment API + built-in envs.
+
+Reference: `rllib/env/` (BaseEnv/VectorEnv/MultiAgentEnv over gym). The
+image has no gym, so the Env protocol is defined here (gymnasium-style
+reset/step returning (obs, info) / (obs, reward, terminated, truncated,
+info)); external gym envs plug in via `GymEnvAdapter` when available.
+CartPole is implemented natively as the standard test/bench workload.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Space:
+    def sample(self, rng: np.random.RandomState):
+        raise NotImplementedError
+
+
+class Discrete(Space):
+    def __init__(self, n: int):
+        self.n = n
+        self.shape = ()
+        self.dtype = np.int64
+
+    def sample(self, rng):
+        return int(rng.randint(self.n))
+
+
+class Box(Space):
+    def __init__(self, low, high, shape=None, dtype=np.float32):
+        self.low = np.broadcast_to(np.asarray(low, dtype), shape).copy() \
+            if shape else np.asarray(low, dtype)
+        self.high = np.broadcast_to(np.asarray(high, dtype), shape).copy() \
+            if shape else np.asarray(high, dtype)
+        self.shape = self.low.shape
+        self.dtype = dtype
+
+    def sample(self, rng):
+        return rng.uniform(
+            np.clip(self.low, -10, 10),
+            np.clip(self.high, -10, 10)).astype(self.dtype)
+
+
+class Env:
+    observation_space: Space
+    action_space: Space
+
+    def reset(self, *, seed: Optional[int] = None) -> Tuple[Any, dict]:
+        raise NotImplementedError
+
+    def step(self, action) -> Tuple[Any, float, bool, bool, dict]:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class CartPoleEnv(Env):
+    """Classic control CartPole-v1 dynamics (standard constants)."""
+
+    def __init__(self, max_steps: int = 500):
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = max_steps
+        high = np.array([self.x_threshold * 2, np.inf,
+                         self.theta_threshold * 2, np.inf], np.float32)
+        self.observation_space = Box(-high, high)
+        self.action_space = Discrete(2)
+        self._rng = np.random.RandomState()
+        self._state = None
+        self._t = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4).astype(
+            np.float32)
+        self._t = 0
+        return self._state.copy(), {}
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta
+                ) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta ** 2
+                           / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta \
+            / self.total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * xacc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self._t += 1
+        terminated = bool(abs(x) > self.x_threshold
+                          or abs(theta) > self.theta_threshold)
+        truncated = self._t >= self.max_steps
+        return self._state.copy(), 1.0, terminated, truncated, {}
+
+
+class GymEnvAdapter(Env):  # pragma: no cover - needs gym installed
+    def __init__(self, gym_env):
+        self._env = gym_env
+        self.observation_space = gym_env.observation_space
+        self.action_space = gym_env.action_space
+
+    def reset(self, *, seed=None):
+        return self._env.reset(seed=seed)
+
+    def step(self, action):
+        return self._env.step(action)
+
+
+_ENV_REGISTRY: Dict[str, Callable[..., Env]] = {
+    "CartPole-v1": CartPoleEnv,
+}
+
+
+def register_env(name: str, creator: Callable[..., Env]):
+    """Reference: `ray.tune.registry.register_env`."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(spec, env_config: Optional[dict] = None) -> Env:
+    if isinstance(spec, Env):
+        return spec
+    if callable(spec):
+        return spec(env_config or {})
+    if isinstance(spec, str):
+        if spec in _ENV_REGISTRY:
+            try:
+                return _ENV_REGISTRY[spec](**(env_config or {}))
+            except TypeError:
+                return _ENV_REGISTRY[spec](env_config or {})
+        try:
+            import gymnasium
+
+            return GymEnvAdapter(gymnasium.make(spec))
+        except ImportError:
+            raise ValueError(f"unknown env {spec!r} and gymnasium not "
+                             "installed")
+    raise TypeError(f"cannot build env from {spec!r}")
+
+
+class VectorEnv:
+    """N sequential envs behind a batched interface (reference
+    `rllib/env/vector_env.py`)."""
+
+    def __init__(self, spec, num_envs: int,
+                 env_config: Optional[dict] = None):
+        self.envs: List[Env] = [make_env(spec, env_config)
+                                for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs = []
+        for i, e in enumerate(self.envs):
+            o, _ = e.reset(seed=None if seed is None else seed + i)
+            obs.append(o)
+        return np.stack(obs)
+
+    def step(self, actions):
+        obs, rews, terms, truncs = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, te, tr, _ = e.step(a)
+            if te or tr:
+                o, _ = e.reset()
+            obs.append(o)
+            rews.append(r)
+            terms.append(te)
+            truncs.append(tr)
+        return (np.stack(obs), np.asarray(rews, np.float32),
+                np.asarray(terms), np.asarray(truncs))

@@ -1,0 +1,55 @@
+"""Policy/value networks in JAX (reference: `rllib/models/` catalog).
+
+Small MLP torsos; the TPU story is that the *learner update* is one jit
+program (`ray_tpu.rl.learner`) — rollouts stay on CPU actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(rng, sizes, dtype=jnp.float32):
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (i, o) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        params.append({
+            "w": jax.random.normal(k, (i, o), dtype) * np.sqrt(2.0 / i),
+            "b": jnp.zeros(o, dtype),
+        })
+    return params
+
+
+def mlp_apply(params, x, activate_last=False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+def actor_critic_init(rng, obs_dim: int, n_actions: int,
+                      hidden=(64, 64)) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(rng)
+    return {
+        "pi": mlp_init(k1, (obs_dim, *hidden, n_actions)),
+        "vf": mlp_init(k2, (obs_dim, *hidden, 1)),
+    }
+
+
+def actor_critic_apply(params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    logits = mlp_apply(params["pi"], obs)
+    value = mlp_apply(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+def q_net_init(rng, obs_dim: int, n_actions: int, hidden=(64, 64)):
+    return {"q": mlp_init(rng, (obs_dim, *hidden, n_actions))}
+
+
+def q_net_apply(params, obs):
+    return mlp_apply(params["q"], obs)

@@ -1,0 +1,99 @@
+"""Replay buffers (reference `rllib/utils/replay_buffers/`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over flat transitions."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Optional[dict] = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return self._size
+
+    def add(self, batch: SampleBatch):
+        n = batch.count
+        if self._storage is None:
+            self._storage = {
+                k: np.zeros((self.capacity, *np.asarray(v).shape[1:]),
+                            np.asarray(v).dtype)
+                for k, v in batch.items()
+            }
+        for k, v in batch.items():
+            v = np.asarray(v)
+            idx = (self._idx + np.arange(n)) % self.capacity
+            self._storage[k][idx] = v
+        self._idx = (self._idx + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, n: int) -> SampleBatch:
+        idx = self._rng.randint(0, self._size, size=n)
+        return SampleBatch({k: v[idx] for k, v in self._storage.items()})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritization (sum-tree-free O(n) variant — fine for
+    host-side buffers at these sizes)."""
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._priorities = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add(self, batch: SampleBatch):
+        n = batch.count
+        idx = (self._idx + np.arange(n)) % self.capacity
+        super().add(batch)
+        self._priorities[idx] = self._max_prio
+
+    def sample(self, n: int) -> SampleBatch:
+        prios = self._priorities[: self._size] ** self.alpha
+        probs = prios / prios.sum()
+        idx = self._rng.choice(self._size, size=n, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        weights /= weights.max()
+        out = SampleBatch({k: v[idx] for k, v in self._storage.items()})
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx, priorities):
+        priorities = np.abs(priorities) + 1e-6
+        self._priorities[idx] = priorities
+        self._max_prio = max(self._max_prio, priorities.max())
+
+
+class ReservoirReplayBuffer(ReplayBuffer):
+    """Reservoir sampling buffer (reference: league-based algos)."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        super().__init__(capacity, seed)
+        self._seen = 0
+
+    def add(self, batch: SampleBatch):
+        n = batch.count
+        if self._storage is None or self._size < self.capacity:
+            super().add(batch)
+            self._seen += n
+            return
+        for k in self._storage:
+            v = np.asarray(batch[k])
+            for i in range(n):
+                j = self._rng.randint(0, self._seen + i + 1)
+                if j < self.capacity:
+                    self._storage[k][j] = v[i]
+        self._seen += n

@@ -1,0 +1,103 @@
+"""RolloutWorker: actor-side env stepping.
+
+Reference: `rllib/evaluation/rollout_worker.py` + `sampler.py` — workers
+hold env copies + policy weights, sample fixed-size trajectory fragments,
+and sync weights from the learner (broadcast through the object store).
+Policy inference on workers is CPU jax (batched over the vector env).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    VALUES,
+)
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    def __init__(self, env_spec, policy_apply: Callable, *,
+                 num_envs: int = 1, env_config: Optional[dict] = None,
+                 rollout_fragment_length: int = 200, seed: int = 0,
+                 policy_kind: str = "actor_critic"):
+        import jax
+
+        self.vec = VectorEnv(env_spec, num_envs, env_config)
+        self.apply = jax.jit(policy_apply)
+        self.fragment = rollout_fragment_length
+        self.kind = policy_kind
+        self._rng = np.random.RandomState(seed)
+        self._jax_rng = jax.random.PRNGKey(seed)
+        self.obs = self.vec.reset(seed=seed)
+        self._episode_rewards = np.zeros(num_envs, np.float64)
+        self._episode_lens = np.zeros(num_envs, np.int64)
+        self._completed: list = []
+
+    def sample(self, weights) -> SampleBatch:
+        """Collect one fragment of `fragment` steps × num_envs."""
+        import jax
+
+        rows: Dict[str, list] = {OBS: [], ACTIONS: [], REWARDS: [],
+                                 DONES: [], NEXT_OBS: [], LOGPS: [],
+                                 VALUES: []}
+        for _ in range(self.fragment):
+            out = self.apply(weights, self.obs)
+            if self.kind == "actor_critic":
+                logits, values = out
+            else:  # q-network: greedy-ish epsilon handled by caller config
+                logits, values = out, np.zeros(len(self.obs), np.float32)
+            logits = np.asarray(logits, np.float32)
+            # Sample actions from the categorical distribution.
+            z = self._rng.gumbel(size=logits.shape)
+            actions = (logits + z).argmax(-1)
+            logp = logits - _logsumexp(logits)
+            act_logp = np.take_along_axis(
+                logp, actions[:, None], axis=1)[:, 0]
+            next_obs, rewards, terms, truncs = self.vec.step(actions)
+            dones = np.logical_or(terms, truncs)
+            rows[OBS].append(self.obs.copy())
+            rows[ACTIONS].append(actions)
+            rows[REWARDS].append(rewards)
+            rows[DONES].append(dones)
+            rows[NEXT_OBS].append(next_obs.copy())
+            rows[LOGPS].append(act_logp)
+            rows[VALUES].append(np.asarray(values, np.float32))
+            self._episode_rewards += rewards
+            self._episode_lens += 1
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(
+                    (float(self._episode_rewards[i]),
+                     int(self._episode_lens[i])))
+                self._episode_rewards[i] = 0.0
+                self._episode_lens[i] = 0
+            self.obs = next_obs
+        # [T, N, ...] -> [T*N, ...] time-major flatten per env kept
+        # contiguous: transpose to [N, T, ...] so GAE can scan per env.
+        batch = SampleBatch()
+        for k, v in rows.items():
+            arr = np.stack(v)  # [T, N, ...]
+            batch[k] = np.swapaxes(arr, 0, 1)  # [N, T, ...]
+        return batch
+
+    def episode_stats(self, clear: bool = True):
+        stats = list(self._completed)
+        if clear:
+            self._completed = []
+        return stats
+
+
+def _logsumexp(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=axis, keepdims=True))

@@ -1,0 +1,56 @@
+"""SampleBatch: the trajectory data container.
+
+Reference: `rllib/policy/sample_batch.py` — a dict of parallel arrays with
+concat/split/shuffle, plus the standard column names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "next_obs"
+LOGPS = "action_logp"
+VALUES = "values"
+ADVANTAGES = "advantages"
+TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([np.asarray(b[k]) for b in batches])
+            for k in keys
+        })
+
+    def shuffle(self, rng: np.random.RandomState) -> "SampleBatch":
+        idx = rng.permutation(self.count)
+        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n - size + 1, size):
+            yield SampleBatch({k: np.asarray(v)[start:start + size]
+                               for k, v in self.items()})
+
+    def split(self, n: int) -> List["SampleBatch"]:
+        out = []
+        for idx in np.array_split(np.arange(self.count), n):
+            out.append(SampleBatch({k: np.asarray(v)[idx]
+                                    for k, v in self.items()}))
+        return out
