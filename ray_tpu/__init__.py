@@ -30,6 +30,21 @@ def nodes():
     return global_worker().gcs.nodes()
 
 
+def timeline(filename=None):
+    """Chrome-trace dump of task execution (reference: `ray.timeline`,
+    `python/ray/_private/state.py:851`). Returns the event list; with
+    `filename`, writes JSON loadable in chrome://tracing or Perfetto."""
+    import json
+
+    from ray_tpu._private.worker import global_worker
+
+    events = global_worker().task_events.chrome_trace()
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
 def cluster_resources():
     from ray_tpu._private.worker import global_worker
 
@@ -61,5 +76,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
 ]

@@ -327,11 +327,16 @@ class LocalBackend:
                              request: Dict[str, int]):
         ctx = self.worker.task_context
         ctx.push(task_spec=spec, node_id=self.node_id, pool=pool, request=request)
+        events = self.worker.task_events
+        events.task_started(spec, self.node_id,
+                            threading.current_thread().name)
         try:
             args, kwargs = self.worker.resolve_args(spec)
             result = spec.func(*args, **kwargs)
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
+            events.task_finished(spec)
         except BaseException as e:  # noqa: BLE001 - any user failure → object error
+            events.task_finished(spec, error=f"{type(e).__name__}: {e}")
             self._handle_task_failure(spec, e)
         finally:
             ctx.pop()
@@ -340,6 +345,9 @@ class LocalBackend:
     def _execute_actor_task(self, actor: _Actor, spec: TaskSpec):
         ctx = self.worker.task_context
         ctx.push(task_spec=spec, node_id=self.node_id, pool=None, request=None)
+        events = self.worker.task_events
+        events.task_started(spec, self.node_id,
+                            threading.current_thread().name)
         try:
             args, kwargs = self.worker.resolve_args(spec)
             method = getattr(actor.instance, spec.func)
@@ -349,7 +357,9 @@ class LocalBackend:
             else:
                 result = method(*args, **kwargs)
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
+            events.task_finished(spec)
         except BaseException as e:  # noqa: BLE001
+            events.task_finished(spec, error=f"{type(e).__name__}: {e}")
             err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
             self.worker.store_task_outputs(spec, None, error=err)
         finally:

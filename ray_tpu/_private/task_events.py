@@ -1,0 +1,86 @@
+"""Task event buffer: the observability substrate.
+
+Role-equivalent to the reference's `TaskEventBuffer`
+(`core_worker/task_event_buffer.h:188`) feeding GcsTaskManager: every task
+execution records state transitions + timing here; the state API
+(`ray_tpu.experimental.state`) queries it and `ray_tpu.timeline()` dumps
+Chrome traces from it (reference `_private/state.py:435`).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class TaskEvent:
+    task_id: str
+    name: str
+    kind: str            # NORMAL_TASK | ACTOR_CREATION | ACTOR_TASK
+    state: str           # RUNNING | FINISHED | FAILED
+    start_s: float = 0.0
+    end_s: Optional[float] = None
+    node_id: str = ""
+    worker: str = ""
+    error: str = ""
+    actor_id: Optional[str] = None
+
+    def duration_s(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return self.end_s - self.start_s
+
+
+class TaskEventBuffer:
+    def __init__(self, max_events: int = 100_000):
+        self._lock = threading.Lock()
+        self._events: "collections.OrderedDict[str, TaskEvent]" = \
+            collections.OrderedDict()
+        self._max = max_events
+
+    def task_started(self, spec, node_id, worker_name: str) -> None:
+        ev = TaskEvent(
+            task_id=spec.task_id.hex(), name=spec.name,
+            kind=spec.kind.name, state="RUNNING",
+            start_s=time.time(), node_id=node_id.hex(),
+            worker=worker_name,
+            actor_id=spec.actor_id.hex() if spec.actor_id else None)
+        with self._lock:
+            self._events[ev.task_id] = ev
+            while len(self._events) > self._max:
+                self._events.popitem(last=False)
+
+    def task_finished(self, spec, error: Optional[str] = None) -> None:
+        with self._lock:
+            ev = self._events.get(spec.task_id.hex())
+            if ev is None:
+                return
+            ev.end_s = time.time()
+            ev.state = "FAILED" if error else "FINISHED"
+            ev.error = error or ""
+
+    def list_events(self, limit: int = 10_000) -> List[TaskEvent]:
+        with self._lock:
+            return list(self._events.values())[-limit:]
+
+    def chrome_trace(self) -> List[dict]:
+        """Chrome tracing format (`chrome://tracing` / Perfetto)."""
+        out = []
+        for ev in self.list_events():
+            end = ev.end_s or time.time()
+            out.append({
+                "name": ev.name,
+                "cat": ev.kind.lower(),
+                "ph": "X",
+                "ts": ev.start_s * 1e6,
+                "dur": (end - ev.start_s) * 1e6,
+                "pid": ev.node_id[:8],
+                "tid": ev.worker,
+                "args": {"task_id": ev.task_id, "state": ev.state,
+                         **({"error": ev.error} if ev.error else {})},
+            })
+        return out

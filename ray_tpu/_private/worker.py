@@ -57,6 +57,9 @@ class Worker:
         self.namespace = namespace or f"ns-{self.job_id.hex()}"
         self.memory_store = MemoryStore()
         self.task_context = _TaskContext()
+        from ray_tpu._private.task_events import TaskEventBuffer
+
+        self.task_events = TaskEventBuffer()
         self._put_counter_lock = threading.Lock()
         self._put_counters: dict[bytes, int] = {}
         self._driver_task_id = TaskID.from_random()

@@ -1,0 +1,157 @@
+"""ray_tpu.dag: lazy DAGs over tasks and actors.
+
+Reference: `python/ray/dag/` — `DAGNode` graph built from
+`fn.bind(...)` / `ActorClass.bind(...)` with `InputNode` placeholders;
+`.execute(input)` walks the graph submitting tasks/actor calls. Used by
+serve graphs and `ray_tpu.workflow`.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    def __init__(self, args: tuple = (), kwargs: Optional[dict] = None):
+        self._bound_args = args
+        self._bound_kwargs = kwargs or {}
+        self._uuid = uuid.uuid4().hex
+
+    # -- traversal -------------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def _resolve_args(self, cache: Dict[str, Any], dag_input):
+        args = [self._resolve_one(a, cache, dag_input)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve_one(v, cache, dag_input)
+                  for k, v in self._bound_kwargs.items()}
+        return tuple(args), kwargs
+
+    @staticmethod
+    def _resolve_one(v, cache, dag_input):
+        if isinstance(v, DAGNode):
+            return v._execute_impl(cache, dag_input)
+        return v
+
+    # -- execution -------------------------------------------------------
+
+    def execute(self, *input_args, _get: bool = True):
+        """Run the DAG; leaf results fetched unless _get=False (then an
+        ObjectRef or value is returned as produced)."""
+        dag_input = input_args[0] if input_args else None
+        cache: Dict[str, Any] = {}
+        out = self._execute_impl(cache, dag_input)
+        if _get and isinstance(out, ray_tpu.ObjectRef):
+            return ray_tpu.get(out)
+        return out
+
+    def _execute_impl(self, cache: Dict[str, Any], dag_input):
+        if self._uuid in cache:
+            return cache[self._uuid]
+        result = self._run(cache, dag_input)
+        cache[self._uuid] = result
+        return result
+
+    def _run(self, cache, dag_input):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to `.execute(value)`."""
+
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _run(self, cache, dag_input):
+        return dag_input
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _run(self, cache, dag_input):
+        args, kwargs = self._resolve_args(cache, dag_input)
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """An actor instantiation in the graph; methods create
+    ClassMethodNodes."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+        self._actor_handle = None
+
+    def _run(self, cache, dag_input):
+        if self._actor_handle is None:
+            args, kwargs = self._resolve_args(cache, dag_input)
+            self._actor_handle = self._cls.remote(*args, **kwargs)
+        return self._actor_handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodBinder(self, name)
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._class_node = class_node
+        self._method = method
+
+    def _children(self):
+        return super()._children() + [self._class_node]
+
+    def _run(self, cache, dag_input):
+        handle = self._class_node._execute_impl(cache, dag_input)
+        args, kwargs = self._resolve_args(cache, dag_input)
+        resolved = [ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef)
+                    else a for a in args]
+        return getattr(handle, self._method).remote(*resolved, **kwargs)
+
+
+def _install_bind():
+    """Add `.bind()` to RemoteFunction and ActorClass (reference wires
+    this in `ray/dag` import)."""
+    from ray_tpu.actor import ActorClass
+    from ray_tpu.remote_function import RemoteFunction
+
+    def fn_bind(self, *args, **kwargs):
+        return FunctionNode(self, args, kwargs)
+
+    def cls_bind(cls_self, *args, **kwargs):
+        return ClassNode(cls_self, args, kwargs)
+
+    RemoteFunction.bind = fn_bind
+    ActorClass.bind = cls_bind
+
+
+_install_bind()

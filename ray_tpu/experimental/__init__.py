@@ -1,0 +1,1 @@
+from ray_tpu.experimental import state  # noqa: F401

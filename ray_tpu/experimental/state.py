@@ -1,0 +1,134 @@
+"""Typed state API: cluster introspection.
+
+Reference: `python/ray/experimental/state/api.py` (`list_actors :738`,
+`list_tasks :961`, `summarize_* :1278+`) backed by GcsTaskManager /
+dashboard state aggregator. Here the sources are the worker's task-event
+buffer, the backend actor table, and the GCS registries.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _worker():
+    return worker_mod.global_worker()
+
+
+def list_tasks(*, filters: Optional[List[tuple]] = None,
+               limit: int = 10_000) -> List[Dict[str, Any]]:
+    events = _worker().task_events.list_events(limit)
+    rows = [
+        {
+            "task_id": ev.task_id,
+            "name": ev.name,
+            "type": ev.kind,
+            "state": ev.state,
+            "start_time_s": ev.start_s,
+            "end_time_s": ev.end_s,
+            "duration_s": ev.duration_s(),
+            "node_id": ev.node_id,
+            "worker": ev.worker,
+            "error_message": ev.error,
+            "actor_id": ev.actor_id,
+        }
+        for ev in events
+    ]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(*, filters: Optional[List[tuple]] = None,
+                limit: int = 10_000) -> List[Dict[str, Any]]:
+    w = _worker()
+    rows = []
+    for actor_id, actor in list(w.backend._actors.items()):
+        rows.append({
+            "actor_id": actor_id.hex(),
+            "state": actor.state,
+            "class_name": getattr(actor.spec.func, "__name__",
+                                  str(actor.spec.func)),
+            "name": actor.spec.actor_name or "",
+            "pending_tasks": actor.mailbox.qsize(),
+            "death_cause": actor.death_cause,
+        })
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(*, limit: int = 10_000) -> List[Dict[str, Any]]:
+    store = _worker().memory_store
+    rows = []
+    with store._lock:  # introspection only
+        for oid, entry in list(store._entries.items())[:limit]:
+            rows.append({
+                "object_id": oid.hex(),
+                "ready": entry.ready,
+                "has_error": entry.error is not None,
+                "local_refs": entry.local_refs,
+            })
+    return rows
+
+
+def list_placement_groups(**kwargs) -> List[Dict[str, Any]]:
+    from ray_tpu.util.placement_group import placement_group_table
+
+    return [dict(pg_id=k, **v) for k, v in placement_group_table().items()]
+
+
+def list_nodes(**kwargs) -> List[Dict[str, Any]]:
+    return _worker().gcs.nodes()
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    counts: Dict[tuple, int] = collections.Counter()
+    total_time: Dict[str, float] = collections.defaultdict(float)
+    for ev in _worker().task_events.list_events():
+        counts[(ev.name, ev.state)] += 1
+        if ev.duration_s():
+            total_time[ev.name] += ev.duration_s()
+    summary: Dict[str, Any] = {}
+    for (name, state), n in counts.items():
+        entry = summary.setdefault(
+            name, {"states": {}, "total_time_s": 0.0})
+        entry["states"][state] = n
+        entry["total_time_s"] = round(total_time.get(name, 0.0), 6)
+    return summary
+
+
+def summarize_actors() -> Dict[str, Any]:
+    counts: Dict[tuple, int] = collections.Counter()
+    for row in list_actors():
+        counts[(row["class_name"], row["state"])] += 1
+    summary: Dict[str, Any] = {}
+    for (cls, state), n in counts.items():
+        summary.setdefault(cls, {})[state] = n
+    return summary
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = list_objects()
+    return {"total": len(rows),
+            "with_error": sum(1 for r in rows if r["has_error"])}
+
+
+def _apply_filters(rows, filters):
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op in ("=", "=="):
+                ok = have == value
+            elif op == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out

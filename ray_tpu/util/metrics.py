@@ -1,0 +1,138 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: `python/ray/util/metrics.py` → C++ OpenCensus pipeline. Here
+metrics aggregate in a process-global registry with tag support and a
+Prometheus-exposition dump (`export_prometheus`), which the dashboard/
+metrics agent scrapes or writes out.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def get(self, tags=None) -> float:
+        return self._values.get(self._key(tags), 0.0)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def get(self, tags=None) -> float:
+        return self._values.get(self._key(tags), 0.0)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="",
+                 boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [
+            0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def get(self, tags=None) -> dict:
+        k = self._key(tags)
+        return {"count": self._totals.get(k, 0),
+                "sum": self._sums.get(k, 0.0),
+                "buckets": list(self._counts.get(
+                    k, [0] * (len(self.boundaries) + 1)))}
+
+
+def _fmt_tags(key: Tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def export_prometheus() -> str:
+    """Prometheus text exposition of every registered metric."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, (Counter, Gauge)):
+            for k, v in m._values.items():
+                lines.append(f"{m.name}{_fmt_tags(k)} {v}")
+        elif isinstance(m, Histogram):
+            for k, counts in m._counts.items():
+                acc = 0
+                for b, c in zip(m.boundaries, counts):
+                    acc += c
+                    tags = dict(k)
+                    tags["le"] = str(b)
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {acc}")
+                tags = dict(k)
+                tags["le"] = "+Inf"
+                lines.append(
+                    f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {m._totals[k]}")
+                lines.append(f"{m.name}_sum{_fmt_tags(k)} {m._sums[k]}")
+                lines.append(f"{m.name}_count{_fmt_tags(k)} {m._totals[k]}")
+    return "\n".join(lines) + "\n"

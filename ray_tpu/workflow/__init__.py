@@ -1,0 +1,188 @@
+"""ray_tpu.workflow: durable DAG execution.
+
+Reference: `python/ray/workflow/` (SURVEY.md §2.4) — `workflow.run(dag)`
+executes a `ray_tpu.dag` graph with per-step results checkpointed to
+storage (`workflow_storage.py` equivalent), so a crashed workflow resumes
+from completed steps; a management registry tracks status.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, InputNode
+
+_storage_root: Optional[str] = None
+_lock = threading.Lock()
+
+
+def init(storage: Optional[str] = None):
+    """Set the durable storage root (default ~/.ray_tpu_workflows)."""
+    global _storage_root
+    _storage_root = storage or os.path.expanduser("~/.ray_tpu_workflows")
+    os.makedirs(_storage_root, exist_ok=True)
+
+
+def _root() -> str:
+    if _storage_root is None:
+        init()
+    return _storage_root
+
+
+class WorkflowStorage:
+    """Filesystem-backed step-result store (reference:
+    `workflow/workflow_storage.py`)."""
+
+    def __init__(self, workflow_id: str):
+        self.path = os.path.join(_root(), workflow_id)
+        os.makedirs(os.path.join(self.path, "steps"), exist_ok=True)
+
+    def _step_file(self, step_id: str) -> str:
+        return os.path.join(self.path, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_file(step_id))
+
+    def load_step(self, step_id: str):
+        with open(self._step_file(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_step(self, step_id: str, value):
+        tmp = self._step_file(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self._step_file(step_id))
+
+    def set_status(self, status: str, error: str = ""):
+        with open(os.path.join(self.path, "status"), "w") as f:
+            f.write(f"{status}\n{error}")
+
+    def get_status(self) -> str:
+        try:
+            with open(os.path.join(self.path, "status")) as f:
+                return f.read().splitlines()[0]
+        except OSError:
+            return "NONE"
+
+
+def _step_id_of(node: DAGNode) -> str:
+    """Deterministic step id: structural position + function name."""
+    name = getattr(getattr(node, "_fn", None), "__name__", None) or \
+        type(node).__name__
+    return f"{name}-{_structural_hash(node)[:12]}"
+
+
+def _structural_hash(node: DAGNode, seen=None) -> str:
+    seen = seen or {}
+    if id(node) in seen:
+        return seen[id(node)]
+    parts = [type(node).__name__,
+             getattr(getattr(node, "_fn", None), "__name__", "")]
+    for a in node._bound_args:
+        parts.append(_structural_hash(a, seen) if isinstance(a, DAGNode)
+                     else repr(a))
+    for k, v in sorted(node._bound_kwargs.items()):
+        parts.append(k)
+        parts.append(_structural_hash(v, seen) if isinstance(v, DAGNode)
+                     else repr(v))
+    h = hashlib.sha1("|".join(parts).encode()).hexdigest()
+    seen[id(node)] = h
+    return h
+
+
+def _execute_durable(node: DAGNode, storage: WorkflowStorage, dag_input,
+                     cache: Dict[str, Any]):
+    if node._uuid in cache:
+        return cache[node._uuid]
+    if isinstance(node, InputNode):
+        result = dag_input
+    else:
+        step_id = _step_id_of(node)
+        if storage.has_step(step_id):
+            result = storage.load_step(step_id)
+        else:
+            # Resolve children durably first, then run this step.
+            args = tuple(
+                _execute_durable(a, storage, dag_input, cache)
+                if isinstance(a, DAGNode) else a
+                for a in node._bound_args)
+            kwargs = {
+                k: _execute_durable(v, storage, dag_input, cache)
+                if isinstance(v, DAGNode) else v
+                for k, v in node._bound_kwargs.items()}
+            fn = getattr(node, "_fn", None)
+            if fn is None:
+                raise TypeError(
+                    f"workflow steps must be function nodes, got "
+                    f"{type(node).__name__}")
+            result = ray_tpu.get(fn.remote(*args, **kwargs))
+            storage.save_step(step_id, result)
+    cache[node._uuid] = result
+    return result
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        dag_input: Any = None) -> Any:
+    """Run (or resume) a workflow to completion, returning the output.
+    Completed steps are skipped on resume."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    storage = WorkflowStorage(workflow_id)
+    storage.set_status("RUNNING")
+    try:
+        result = _execute_durable(dag, storage, dag_input, {})
+        storage.save_step("__output__", result)
+        storage.set_status("SUCCESSFUL")
+        return result
+    except BaseException as e:  # noqa: BLE001
+        storage.set_status("FAILED", str(e))
+        raise
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              dag_input: Any = None):
+    """Launch as a task; returns an ObjectRef of the output."""
+
+    @ray_tpu.remote
+    def _runner(payload):
+        dag, wid, dinput = payload
+        return run(dag, workflow_id=wid, dag_input=dinput)
+
+    return _runner.remote((dag, workflow_id, dag_input))
+
+
+def get_status(workflow_id: str) -> str:
+    return WorkflowStorage(workflow_id).get_status()
+
+
+def get_output(workflow_id: str):
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id} has no stored output")
+    return storage.load_step("__output__")
+
+
+def resume(workflow_id: str):
+    """Re-run a failed workflow from its stored steps. The caller must
+    re-supply the same DAG via `run` with the same workflow_id; this
+    helper just returns the stored output when already successful."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.get_status() == "SUCCESSFUL":
+        return storage.load_step("__output__")
+    raise ValueError(
+        f"workflow {workflow_id} is {storage.get_status()}; re-issue "
+        "run(dag, workflow_id=...) to resume execution")
+
+
+def list_all() -> List[tuple]:
+    root = _root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        if os.path.isdir(os.path.join(root, wid)):
+            out.append((wid, WorkflowStorage(wid).get_status()))
+    return out

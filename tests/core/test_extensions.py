@@ -1,0 +1,294 @@
+"""Core-extension tests: dag, workflow, queue, metrics, state API,
+timeline, placement groups, actor pool."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+from ray_tpu.experimental import state
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    export_prometheus,
+)
+from ray_tpu.util.placement_group import (
+    PlacementGroupFactory,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- dag --------------------------------------------------------------------
+
+
+def test_dag_function_graph():
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def combine(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = combine.bind(a.bind(inp), b.bind(inp))
+    assert dag.execute(10) == 11 + 20
+
+
+def test_dag_actor_graph():
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        node = Acc.bind(100)
+        dag = node.add.bind(inp)
+    assert dag.execute(5) == 105
+    assert dag.execute(7) == 112  # same actor reused
+
+
+def test_dag_diamond_executes_shared_node_once():
+    calls = []
+
+    @ray_tpu.remote
+    def source():
+        calls.append(1)
+        return 1
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    src = source.bind()
+    dag = add.bind(double.bind(src), double.bind(src))
+    assert dag.execute() == 4
+    assert len(calls) == 1
+
+
+# -- workflow ---------------------------------------------------------------
+
+
+def test_workflow_run_and_resume(tmp_path):
+    workflow.init(str(tmp_path))
+    executed = []
+
+    @ray_tpu.remote
+    def step_a():
+        executed.append("a")
+        return 10
+
+    @ray_tpu.remote
+    def step_b(x):
+        executed.append("b")
+        return x * 2
+
+    dag = step_b.bind(step_a.bind())
+    out = workflow.run(dag, workflow_id="wf1")
+    assert out == 20
+    assert workflow.get_status("wf1") == "SUCCESSFUL"
+    assert workflow.get_output("wf1") == 20
+
+    # Re-running skips completed steps entirely.
+    executed.clear()
+    out2 = workflow.run(dag, workflow_id="wf1")
+    assert out2 == 20
+    assert executed == []
+
+
+def test_workflow_failure_then_resume(tmp_path):
+    workflow.init(str(tmp_path))
+    state_holder = {"fail": True}
+
+    @ray_tpu.remote
+    def good():
+        return 5
+
+    @ray_tpu.remote
+    def flaky(x):
+        if state_holder["fail"]:
+            raise RuntimeError("boom")
+        return x + 1
+
+    dag = flaky.bind(good.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2")
+    assert workflow.get_status("wf2") == "FAILED"
+    state_holder["fail"] = False
+    out = workflow.run(dag, workflow_id="wf2")  # resumes: `good` cached
+    assert out == 6
+    assert ("wf2", "SUCCESSFUL") in workflow.list_all()
+
+
+# -- queue ------------------------------------------------------------------
+
+
+def test_queue_basic():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_across_tasks():
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ray_tpu.get(producer.remote(q, 5))
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_metrics():
+    c = Counter("test_requests", "desc", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    assert c.get({"route": "/a"}) == 3
+    g = Gauge("test_gauge")
+    g.set(42)
+    assert g.get() == 42
+    h = Histogram("test_lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    stats = h.get()
+    assert stats["count"] == 3
+    assert stats["buckets"] == [1, 1, 1]
+    text = export_prometheus()
+    assert "test_requests" in text and "test_lat_bucket" in text
+
+
+# -- state API + timeline ---------------------------------------------------
+
+
+def test_state_api_tasks_and_actors():
+    @ray_tpu.remote
+    def work(x):
+        return x
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+
+    tasks = state.list_tasks()
+    names = {t["name"] for t in tasks}
+    assert any("work" in n for n in names)
+    finished = state.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert len(finished) >= 3
+    actors = state.list_actors()
+    assert any(r["class_name"] == "A" for r in actors)
+    summary = state.summarize_tasks()
+    assert any("work" in k for k in summary)
+
+
+def test_timeline_chrome_trace(tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get(traced.remote())
+    path = str(tmp_path / "trace.json")
+    events = ray_tpu.timeline(path)
+    assert any("traced" in e["name"] for e in events)
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    assert isinstance(data, list) and data
+
+
+# -- placement groups -------------------------------------------------------
+
+
+def test_placement_group_reserve_and_use():
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout=5)
+
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    @ray_tpu.remote
+    def inside():
+        return "ok"
+
+    out = ray_tpu.get(inside.options(
+        num_cpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+    ).remote())
+    assert out == "ok"
+    table = placement_group_table()
+    assert any(v["state"] == "CREATED" for v in table.values())
+    remove_placement_group(pg)
+
+
+def test_placement_group_factory():
+    factory = PlacementGroupFactory([{"CPU": 0}, {"CPU": 1}],
+                                    strategy="PACK")
+    assert factory.required_resources() == {"CPU": 1}
+    pg = factory()
+    assert pg.wait(timeout=5)
+    remove_placement_group(pg)
+
+
+def test_actor_pool():
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Worker.remote() for _ in range(3)])
+    got = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert got == [x * 2 for x in range(10)]
+    got2 = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                     range(5)))
+    assert got2 == [0, 2, 4, 6, 8]
