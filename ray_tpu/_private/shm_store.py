@@ -1,0 +1,197 @@
+"""Python client for the native shared-memory object store.
+
+Wraps `src/object_store` (the plasma-equivalent, see store.h) over ctypes
+— no pybind11 in the image. Zero-copy reads: the client mmaps the same
+segment and returns numpy views directly over object payloads (reference
+parity: plasma's zero-copy numpy buffers, `plasma/client.h`).
+
+The library builds on demand with g++ (`ensure_built`), cached under
+`build/`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "src", "object_store")
+_BUILD = os.path.join(_REPO_ROOT, "build")
+_LIB = os.path.join(_BUILD, "libray_tpu_store.so")
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class StoreStats(ctypes.Structure):
+    _fields_ = [
+        ("capacity", ctypes.c_uint64),
+        ("allocated", ctypes.c_uint64),
+        ("num_objects", ctypes.c_uint64),
+        ("num_sealed", ctypes.c_uint64),
+        ("evictions", ctypes.c_uint64),
+        ("create_failures", ctypes.c_uint64),
+    ]
+
+
+def ensure_built() -> str:
+    with _build_lock:
+        src = os.path.join(_SRC, "store.cc")
+        if os.path.exists(_LIB) and \
+                os.path.getmtime(_LIB) >= os.path.getmtime(src):
+            return _LIB
+        os.makedirs(_BUILD, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", _LIB,
+             src, "-lpthread", "-lrt"],
+            check=True, cwd=_SRC, capture_output=True)
+        return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(ensure_built())
+    lib.shm_store_create.restype = ctypes.c_void_p
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                     ctypes.c_uint32]
+    lib.shm_store_attach.restype = ctypes.c_void_p
+    lib.shm_store_attach.argtypes = [ctypes.c_char_p]
+    lib.shm_store_close.argtypes = [ctypes.c_void_p]
+    lib.shm_store_destroy.argtypes = [ctypes.c_char_p]
+    lib.shm_obj_create.restype = ctypes.c_uint64
+    lib.shm_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+    lib.shm_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_get.restype = ctypes.c_uint64
+    lib.shm_obj_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_uint64)]
+    lib.shm_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_stats.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(StoreStats)]
+    lib.shm_store_mmap_size.restype = ctypes.c_uint64
+    lib.shm_store_mmap_size.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class ShmObjectStore:
+    """One node's shared object store (create on the 'head', attach from
+    workers)."""
+
+    def __init__(self, name: str = "/ray_tpu_store",
+                 capacity: int = 256 * 2**20, max_objects: int = 4096,
+                 create: bool = True):
+        self._lib = _load()
+        self.name = name
+        if create:
+            self._handle = self._lib.shm_store_create(
+                name.encode(), capacity, max_objects)
+        else:
+            self._handle = self._lib.shm_store_attach(name.encode())
+        if not self._handle:
+            raise OSError(f"failed to open shm store {name!r}")
+        # Map the segment into this process for zero-copy access.
+        size = self._lib.shm_store_mmap_size(self._handle)
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            self._map = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._map)
+
+    # -- raw bytes -------------------------------------------------------
+
+    def put_bytes(self, object_id: bytes, payload: bytes) -> bool:
+        assert len(object_id) == 20
+        off = self._lib.shm_obj_create(self._handle, object_id,
+                                       len(payload))
+        if off == 2**64 - 1:
+            return False
+        self._view[off:off + len(payload)] = payload
+        return bool(self._lib.shm_obj_seal(self._handle, object_id))
+
+    def get_bytes(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy view; call release(object_id) when done."""
+        size = ctypes.c_uint64()
+        off = self._lib.shm_obj_get(self._handle, object_id,
+                                    ctypes.byref(size))
+        if off == 2**64 - 1:
+            return None
+        return self._view[off:off + size.value]
+
+    # -- numpy -----------------------------------------------------------
+
+    def put_numpy(self, object_id: bytes, arr: np.ndarray) -> bool:
+        arr = np.ascontiguousarray(arr)
+        header = _encode_header(arr)
+        total = len(header) + arr.nbytes
+        off = self._lib.shm_obj_create(self._handle, object_id, total)
+        if off == 2**64 - 1:
+            return False
+        self._view[off:off + len(header)] = header
+        dst = np.frombuffer(self._view, np.uint8, arr.nbytes,
+                            off + len(header))
+        dst[:] = arr.view(np.uint8).reshape(-1)
+        return bool(self._lib.shm_obj_seal(self._handle, object_id))
+
+    def get_numpy(self, object_id: bytes) -> Optional[np.ndarray]:
+        """Zero-copy read-only array backed by shared memory."""
+        buf = self.get_bytes(object_id)
+        if buf is None:
+            return None
+        dtype, shape, hlen = _decode_header(buf)
+        arr = np.frombuffer(buf, dtype=dtype, offset=hlen).reshape(shape)
+        arr.flags.writeable = False
+        return arr
+
+    # -- lifecycle -------------------------------------------------------
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.shm_obj_contains(self._handle, object_id))
+
+    def release(self, object_id: bytes) -> bool:
+        return bool(self._lib.shm_obj_release(self._handle, object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        return bool(self._lib.shm_obj_delete(self._handle, object_id))
+
+    def stats(self) -> dict:
+        st = StoreStats()
+        self._lib.shm_store_stats(self._handle, ctypes.byref(st))
+        return {f[0]: getattr(st, f[0]) for f in StoreStats._fields_}
+
+    def close(self):
+        if self._handle:
+            self._lib.shm_store_close(self._handle)
+            self._handle = None
+
+    def destroy(self):
+        self.close()
+        self._lib.shm_store_destroy(self.name.encode())
+
+
+def _encode_header(arr: np.ndarray) -> bytes:
+    import json
+
+    meta = json.dumps({"dtype": arr.dtype.str,
+                       "shape": list(arr.shape)}).encode()
+    return len(meta).to_bytes(4, "little") + meta
+
+
+def _decode_header(buf):
+    import json
+
+    hlen = int.from_bytes(bytes(buf[:4]), "little")
+    meta = json.loads(bytes(buf[4:4 + hlen]))
+    return np.dtype(meta["dtype"]), tuple(meta["shape"]), 4 + hlen

@@ -1,0 +1,376 @@
+#include "store.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <new>
+
+namespace ray_tpu {
+
+namespace {
+constexpr uint64_t kAlign = 64;
+
+uint64_t AlignUp(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+uint64_t NowNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+}  // namespace
+
+struct StoreHeader {
+  uint64_t magic;
+  uint64_t capacity;      // arena bytes
+  uint64_t arena_offset;  // from mmap base
+  uint32_t max_objects;
+  uint32_t pad0;
+  uint64_t allocated;
+  uint64_t lru_clock;
+  uint64_t evictions;
+  uint64_t create_failures;
+  pthread_mutex_t mutex;  // process-shared
+  // ObjectEntry table follows immediately after this struct.
+};
+
+static ObjectEntry* EntryTable(StoreHeader* h) {
+  return reinterpret_cast<ObjectEntry*>(reinterpret_cast<uint8_t*>(h) +
+                                        sizeof(StoreHeader));
+}
+
+class MutexGuard {
+ public:
+  explicit MutexGuard(pthread_mutex_t* m) : m_(m) {
+    int rc = pthread_mutex_lock(m_);
+    if (rc == EOWNERDEAD) {
+      // A crashed process held the lock; state is best-effort consistent
+      // (all mutations are single-word or order-safe), recover.
+      pthread_mutex_consistent(m_);
+    }
+  }
+  ~MutexGuard() { pthread_mutex_unlock(m_); }
+
+ private:
+  pthread_mutex_t* m_;
+};
+
+ShmStore* ShmStore::Create(const char* name, uint64_t capacity,
+                           uint32_t max_objects) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t table_bytes = sizeof(StoreHeader) +
+                         uint64_t(max_objects) * sizeof(ObjectEntry);
+  uint64_t arena_off = AlignUp(table_bytes);
+  uint64_t total = arena_off + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = new (base) StoreHeader();
+  h->magic = kMagic;
+  h->capacity = capacity;
+  h->arena_offset = arena_off;
+  h->max_objects = max_objects;
+  h->allocated = 0;
+  h->lru_clock = 1;
+  h->evictions = 0;
+  h->create_failures = 0;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  memset(EntryTable(h), 0, uint64_t(max_objects) * sizeof(ObjectEntry));
+  // One giant free block spans the arena.
+  auto* first = reinterpret_cast<BlockHeader*>(
+      reinterpret_cast<uint8_t*>(base) + arena_off);
+  first->size = capacity - sizeof(BlockHeader);
+  first->free = 1;
+
+  auto* s = new ShmStore();
+  s->header_ = h;
+  s->base_ = reinterpret_cast<uint8_t*>(base);
+  s->arena_ = s->base_ + arena_off;
+  s->map_size_ = total;
+  s->fd_ = fd;
+  s->owner_ = true;
+  snprintf(s->name_, sizeof(s->name_), "%s", name);
+  return s;
+}
+
+ShmStore* ShmStore::Attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* h = reinterpret_cast<StoreHeader*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, (size_t)st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  auto* s = new ShmStore();
+  s->header_ = h;
+  s->base_ = reinterpret_cast<uint8_t*>(base);
+  s->arena_ = s->base_ + h->arena_offset;
+  s->map_size_ = (uint64_t)st.st_size;
+  s->fd_ = fd;
+  s->owner_ = false;
+  snprintf(s->name_, sizeof(s->name_), "%s", name);
+  return s;
+}
+
+ShmStore::~ShmStore() {
+  if (base_) munmap(base_, map_size_);
+  if (fd_ >= 0) close(fd_);
+}
+
+ObjectEntry* ShmStore::FindEntry(const uint8_t* id) {
+  ObjectEntry* table = EntryTable(header_);
+  for (uint32_t i = 0; i < header_->max_objects; i++) {
+    if (table[i].state != (int32_t)ObjectState::kFree &&
+        memcmp(table[i].id, id, kIdSize) == 0) {
+      return &table[i];
+    }
+  }
+  return nullptr;
+}
+
+ObjectEntry* ShmStore::FindFreeEntry() {
+  ObjectEntry* table = EntryTable(header_);
+  for (uint32_t i = 0; i < header_->max_objects; i++) {
+    if (table[i].state == (int32_t)ObjectState::kFree) return &table[i];
+  }
+  return nullptr;
+}
+
+uint8_t* ShmStore::Allocate(uint64_t size) {
+  uint64_t need = AlignUp(size);
+  uint8_t* cursor = arena_;
+  uint8_t* end = arena_ + header_->capacity;
+  while (cursor + sizeof(BlockHeader) <= end) {
+    auto* blk = reinterpret_cast<BlockHeader*>(cursor);
+    if (blk->size == 0) break;  // corrupt / end sentinel
+    if (blk->free) {
+      // Forward-coalesce adjacent free blocks.
+      uint8_t* nxt = cursor + sizeof(BlockHeader) + blk->size;
+      while (nxt + sizeof(BlockHeader) <= end) {
+        auto* nblk = reinterpret_cast<BlockHeader*>(nxt);
+        if (!nblk->free || nblk->size == 0) break;
+        blk->size += sizeof(BlockHeader) + nblk->size;
+        nxt = cursor + sizeof(BlockHeader) + blk->size;
+      }
+      if (blk->size >= need) {
+        // Split if the tail is worth keeping.
+        if (blk->size >= need + sizeof(BlockHeader) + kAlign) {
+          auto* tail = reinterpret_cast<BlockHeader*>(
+              cursor + sizeof(BlockHeader) + need);
+          tail->size = blk->size - need - sizeof(BlockHeader);
+          tail->free = 1;
+          blk->size = need;
+        }
+        blk->free = 0;
+        header_->allocated += blk->size + sizeof(BlockHeader);
+        return cursor + sizeof(BlockHeader);
+      }
+    }
+    cursor += sizeof(BlockHeader) + blk->size;
+  }
+  return nullptr;
+}
+
+void ShmStore::FreeBlock(uint64_t payload_offset) {
+  auto* blk = reinterpret_cast<BlockHeader*>(arena_ + payload_offset -
+                                             sizeof(BlockHeader));
+  blk->free = 1;
+  header_->allocated -= blk->size + sizeof(BlockHeader);
+}
+
+bool ShmStore::EvictUntil(uint64_t /*needed*/) {
+  // Evict the single LRU sealed+unpinned object; the caller retries the
+  // allocation after each eviction (total-free is a bad proxy under
+  // fragmentation — only a successful first-fit proves there is room).
+  ObjectEntry* table = EntryTable(header_);
+  ObjectEntry* victim = nullptr;
+  for (uint32_t i = 0; i < header_->max_objects; i++) {
+    ObjectEntry* e = &table[i];
+    if (e->state == (int32_t)ObjectState::kSealed && e->refcount == 0) {
+      if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+    }
+  }
+  if (!victim) return false;
+  FreeBlock(victim->offset);
+  victim->state = (int32_t)ObjectState::kFree;
+  header_->evictions++;
+  return true;
+}
+
+uint8_t* ShmStore::CreateObject(const uint8_t* id, uint64_t size) {
+  MutexGuard g(&header_->mutex);
+  if (FindEntry(id)) return nullptr;  // already exists
+  ObjectEntry* e = FindFreeEntry();
+  if (!e) {
+    header_->create_failures++;
+    return nullptr;
+  }
+  uint8_t* p = Allocate(size);
+  while (!p && EvictUntil(size)) {
+    p = Allocate(size);
+  }
+  if (!p) {
+    header_->create_failures++;
+    return nullptr;
+  }
+  memcpy(e->id, id, kIdSize);
+  e->offset = (uint64_t)(p - arena_);
+  e->size = size;
+  e->state = (int32_t)ObjectState::kCreated;
+  e->refcount = 1;  // writer pin
+  e->lru_tick = header_->lru_clock++;
+  e->create_ns = NowNs();
+  return p;
+}
+
+bool ShmStore::Seal(const uint8_t* id) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  if (!e || e->state != (int32_t)ObjectState::kCreated) return false;
+  e->state = (int32_t)ObjectState::kSealed;
+  e->refcount -= 1;  // drop writer pin
+  return true;
+}
+
+const uint8_t* ShmStore::Get(const uint8_t* id, uint64_t* size_out) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  if (!e || e->state != (int32_t)ObjectState::kSealed) return nullptr;
+  e->refcount += 1;
+  e->lru_tick = header_->lru_clock++;
+  if (size_out) *size_out = e->size;
+  return arena_ + e->offset;
+}
+
+bool ShmStore::Contains(const uint8_t* id) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  return e && e->state == (int32_t)ObjectState::kSealed;
+}
+
+bool ShmStore::Release(const uint8_t* id) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  if (!e || e->refcount <= 0) return false;
+  e->refcount -= 1;
+  return true;
+}
+
+bool ShmStore::Delete(const uint8_t* id) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  if (!e || e->refcount > 0) return false;
+  FreeBlock(e->offset);
+  e->state = (int32_t)ObjectState::kFree;
+  return true;
+}
+
+StoreStats ShmStore::Stats() {
+  MutexGuard g(&header_->mutex);
+  StoreStats out;
+  out.capacity = header_->capacity;
+  out.allocated = header_->allocated;
+  out.evictions = header_->evictions;
+  out.create_failures = header_->create_failures;
+  out.num_objects = 0;
+  out.num_sealed = 0;
+  ObjectEntry* table = EntryTable(header_);
+  for (uint32_t i = 0; i < header_->max_objects; i++) {
+    if (table[i].state != (int32_t)ObjectState::kFree) out.num_objects++;
+    if (table[i].state == (int32_t)ObjectState::kSealed) out.num_sealed++;
+  }
+  return out;
+}
+
+}  // namespace ray_tpu
+
+// -- C API ------------------------------------------------------------------
+
+using ray_tpu::ShmStore;
+
+extern "C" {
+
+void* shm_store_create(const char* name, uint64_t capacity,
+                       uint32_t max_objects) {
+  return ShmStore::Create(name, capacity, max_objects);
+}
+
+void* shm_store_attach(const char* name) { return ShmStore::Attach(name); }
+
+void shm_store_close(void* store) { delete static_cast<ShmStore*>(store); }
+
+void shm_store_destroy(const char* name) { shm_unlink(name); }
+
+uint64_t shm_obj_create(void* store, const uint8_t* id, uint64_t size) {
+  auto* s = static_cast<ShmStore*>(store);
+  uint8_t* p = s->CreateObject(id, size);
+  if (!p) return UINT64_MAX;
+  // Offset from mmap base so the Python side can address its own mapping.
+  return (uint64_t)(p - s->base());
+}
+
+int shm_obj_seal(void* store, const uint8_t* id) {
+  return static_cast<ShmStore*>(store)->Seal(id) ? 1 : 0;
+}
+
+uint64_t shm_obj_get(void* store, const uint8_t* id, uint64_t* size_out) {
+  auto* s = static_cast<ShmStore*>(store);
+  const uint8_t* p = s->Get(id, size_out);
+  if (!p) return UINT64_MAX;
+  return (uint64_t)(p - s->base());
+}
+
+int shm_obj_contains(void* store, const uint8_t* id) {
+  return static_cast<ShmStore*>(store)->Contains(id) ? 1 : 0;
+}
+
+int shm_obj_release(void* store, const uint8_t* id) {
+  return static_cast<ShmStore*>(store)->Release(id) ? 1 : 0;
+}
+
+int shm_obj_delete(void* store, const uint8_t* id) {
+  return static_cast<ShmStore*>(store)->Delete(id) ? 1 : 0;
+}
+
+void shm_store_stats(void* store, ray_tpu::StoreStats* out) {
+  *out = static_cast<ShmStore*>(store)->Stats();
+}
+
+uint64_t shm_store_mmap_size(void* store) {
+  return static_cast<ShmStore*>(store)->map_size();
+}
+
+}  // extern "C"

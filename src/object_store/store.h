@@ -1,0 +1,128 @@
+// Shared-memory object store: the plasma equivalent.
+//
+// Role-equivalent to the reference's plasma store
+// (src/ray/object_manager/plasma/store.h:55, client.h, dlmalloc.cc): an
+// mmap'd arena shared across processes on one node holding immutable
+// sealed objects, with create/seal/get/release lifecycle, refcounting,
+// and LRU eviction of unpinned sealed objects under memory pressure.
+//
+// Differences from the reference's design, on purpose:
+// - One shm segment with an in-arena first-fit allocator instead of
+//   dlmalloc-over-fd-passing: clients attach by name (shm_open) rather
+//   than receiving fds over a unix socket, which removes the store
+//   server thread entirely — all operations are lock-protected
+//   (process-shared robust mutex) direct calls.
+// - Object IDs are fixed 20 bytes (matching the Python ObjectID).
+//
+// The C API at the bottom is the ctypes surface for Python
+// (ray_tpu/_private/shm_store.py) and keeps zero-copy semantics: Python
+// maps the same segment and wraps object payloads in numpy arrays
+// without copying.
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace ray_tpu {
+
+constexpr uint32_t kIdSize = 20;
+constexpr uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
+
+enum class ObjectState : int32_t {
+  kFree = 0,
+  kCreated = 1,  // allocated, writer filling it
+  kSealed = 2,   // immutable, readable
+};
+
+struct ObjectEntry {
+  uint8_t id[kIdSize];
+  uint64_t offset;    // payload offset from arena base
+  uint64_t size;      // payload size
+  uint64_t metadata_size;
+  int32_t state;      // ObjectState
+  int32_t refcount;   // pins: created=1 by writer; get +1, release -1
+  uint64_t lru_tick;  // for eviction ordering
+  uint64_t create_ns;
+};
+
+// Free/used block header embedded in the arena (first-fit allocator with
+// forward coalescing).
+struct BlockHeader {
+  uint64_t size;  // payload bytes following this header
+  uint32_t free;  // 1 = free
+  uint32_t pad;
+};
+
+struct StoreStats {
+  uint64_t capacity;
+  uint64_t allocated;
+  uint64_t num_objects;
+  uint64_t num_sealed;
+  uint64_t evictions;
+  uint64_t create_failures;
+};
+
+struct StoreHeader;  // opaque in public API
+
+class ShmStore {
+ public:
+  // Create a new segment (unlinks existing with same name) or attach.
+  static ShmStore* Create(const char* name, uint64_t capacity,
+                          uint32_t max_objects);
+  static ShmStore* Attach(const char* name);
+  ~ShmStore();
+
+  // Returns payload pointer or null (exists / no space after eviction).
+  uint8_t* CreateObject(const uint8_t* id, uint64_t size);
+  bool Seal(const uint8_t* id);
+  // Pins + returns payload (null if absent or unsealed).
+  const uint8_t* Get(const uint8_t* id, uint64_t* size_out);
+  bool Contains(const uint8_t* id);
+  bool Release(const uint8_t* id);
+  bool Delete(const uint8_t* id);  // refcount must be 0
+  StoreStats Stats();
+
+  const char* name() const { return name_; }
+  const uint8_t* base() const { return base_; }
+  uint64_t map_size() const { return map_size_; }
+
+ private:
+  ShmStore() = default;
+  bool EvictUntil(uint64_t needed);
+  uint8_t* Allocate(uint64_t size);
+  void FreeBlock(uint64_t payload_offset);
+  ObjectEntry* FindEntry(const uint8_t* id);
+  ObjectEntry* FindFreeEntry();
+
+  StoreHeader* header_ = nullptr;
+  uint8_t* base_ = nullptr;   // mmap base
+  uint8_t* arena_ = nullptr;  // data arena base
+  uint64_t map_size_ = 0;
+  int fd_ = -1;
+  bool owner_ = false;
+  char name_[256] = {0};
+};
+
+}  // namespace ray_tpu
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface)
+// ---------------------------------------------------------------------------
+extern "C" {
+void* shm_store_create(const char* name, uint64_t capacity,
+                       uint32_t max_objects);
+void* shm_store_attach(const char* name);
+void shm_store_close(void* store);
+void shm_store_destroy(const char* name);  // unlink the segment
+// Returns offset from mmap base (so Python can slice its own mapping), or
+// UINT64_MAX on failure.
+uint64_t shm_obj_create(void* store, const uint8_t* id, uint64_t size);
+int shm_obj_seal(void* store, const uint8_t* id);
+uint64_t shm_obj_get(void* store, const uint8_t* id, uint64_t* size_out);
+int shm_obj_contains(void* store, const uint8_t* id);
+int shm_obj_release(void* store, const uint8_t* id);
+int shm_obj_delete(void* store, const uint8_t* id);
+void shm_store_stats(void* store, ray_tpu::StoreStats* out);
+uint64_t shm_store_mmap_size(void* store);
+}

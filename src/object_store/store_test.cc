@@ -1,0 +1,85 @@
+// Unit tests for the shm object store (no gtest dep — plain asserts).
+// Mirrors the coverage style of the reference's plasma tests
+// (src/ray/object_manager/plasma/test/).
+
+#include <assert.h>
+#include <string.h>
+#include <sys/mman.h>
+
+#include <cstdio>
+
+#include "store.h"
+
+using ray_tpu::ShmStore;
+using ray_tpu::StoreStats;
+
+static void make_id(uint8_t* id, int n) {
+  memset(id, 0, ray_tpu::kIdSize);
+  memcpy(id, &n, sizeof(n));
+}
+
+int main() {
+  const char* name = "/raytpu_store_test";
+  ShmStore* store = ShmStore::Create(name, 1 << 20, 64);
+  assert(store);
+
+  // create / seal / get / release / delete lifecycle
+  uint8_t id[ray_tpu::kIdSize];
+  make_id(id, 1);
+  uint8_t* p = store->CreateObject(id, 1000);
+  assert(p);
+  memset(p, 0xAB, 1000);
+  assert(!store->Contains(id));  // not sealed yet
+  assert(store->CreateObject(id, 10) == nullptr);  // duplicate
+  assert(store->Seal(id));
+  assert(store->Contains(id));
+  uint64_t size = 0;
+  const uint8_t* q = store->Get(id, &size);
+  assert(q && size == 1000 && q[999] == 0xAB);
+
+  // second client attaches and sees the object zero-copy
+  ShmStore* client = ShmStore::Attach(name);
+  assert(client);
+  uint64_t csize = 0;
+  const uint8_t* cq = client->Get(id, &csize);
+  assert(cq && csize == 1000 && cq[0] == 0xAB);
+  assert(client->Release(id));
+
+  assert(store->Release(id));
+  assert(store->Delete(id));
+  assert(!store->Contains(id));
+
+  // eviction under pressure: fill with unpinned sealed objects, then
+  // allocate something big.
+  for (int i = 10; i < 16; i++) {
+    make_id(id, i);
+    uint8_t* pi = store->CreateObject(id, 150 * 1024);
+    assert(pi);
+    assert(store->Seal(id));
+    assert(store->Release(id) == false);  // refcount already 0 post-seal
+  }
+  StoreStats st = store->Stats();
+  assert(st.num_sealed == 6);
+  make_id(id, 99);
+  uint8_t* big = store->CreateObject(id, 700 * 1024);
+  assert(big);  // must have evicted LRU objects
+  st = store->Stats();
+  assert(st.evictions > 0);
+  assert(store->Seal(id));
+
+  // pinned objects are not evictable: pin everything, then fail create.
+  uint64_t sz;
+  assert(store->Get(id, &sz));
+  uint8_t id2[ray_tpu::kIdSize];
+  make_id(id2, 100);
+  uint8_t* impossible = store->CreateObject(id2, 900 * 1024);
+  assert(impossible == nullptr);
+  st = store->Stats();
+  assert(st.create_failures > 0);
+
+  delete client;
+  delete store;
+  shm_unlink(name);
+  printf("store_test: all assertions passed\n");
+  return 0;
+}

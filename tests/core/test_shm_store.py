@@ -1,0 +1,85 @@
+"""Native shm object store: Python client tests (incl. cross-process)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.shm_store import ShmObjectStore
+
+
+@pytest.fixture
+def store():
+    s = ShmObjectStore(name="/raytpu_pytest_store", capacity=16 * 2**20,
+                       max_objects=128)
+    yield s
+    s.destroy()
+
+
+def _oid(n: int) -> bytes:
+    return n.to_bytes(4, "little") + b"\0" * 16
+
+
+def test_bytes_roundtrip(store):
+    assert store.put_bytes(_oid(1), b"hello world")
+    view = store.get_bytes(_oid(1))
+    assert bytes(view) == b"hello world"
+    store.release(_oid(1))
+    assert store.contains(_oid(1))
+    assert store.delete(_oid(1))
+    assert not store.contains(_oid(1))
+
+
+def test_numpy_zero_copy(store):
+    arr = np.arange(10000, dtype=np.float32).reshape(100, 100)
+    assert store.put_numpy(_oid(2), arr)
+    out = store.get_numpy(_oid(2))
+    np.testing.assert_array_equal(out, arr)
+    assert not out.flags.writeable
+    # Zero-copy: the array's buffer lives in the shared map, not a copy.
+    assert out.base is not None
+    store.release(_oid(2))
+
+
+def test_eviction_under_pressure(store):
+    big = np.zeros(2 * 2**20, np.uint8)  # 2MB each into a 16MB store
+    for i in range(10):
+        assert store.put_numpy(_oid(100 + i), big)
+    st = store.stats()
+    assert st["evictions"] > 0
+    # Most recent objects survive.
+    assert store.contains(_oid(109))
+
+
+def test_duplicate_create_fails(store):
+    assert store.put_bytes(_oid(3), b"x")
+    assert not store.put_bytes(_oid(3), b"y")
+
+
+def test_cross_process_access(store):
+    arr = np.arange(256, dtype=np.int64)
+    assert store.put_numpy(_oid(7), arr)
+    code = """
+import numpy as np
+from ray_tpu._private.shm_store import ShmObjectStore
+s = ShmObjectStore(name="/raytpu_pytest_store", create=False)
+oid = (7).to_bytes(4, "little") + b"\\0" * 16
+out = s.get_numpy(oid)
+assert out is not None and out.sum() == %d, out
+s.release(oid)
+s.put_bytes((8).to_bytes(4, "little") + b"\\0" * 16, b"from-child")
+s.close()
+print("child-ok")
+""" % int(arr.sum())
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert "child-ok" in out.stdout, out.stderr
+    # Parent sees the child's object.
+    view = store.get_bytes(_oid(8))
+    assert bytes(view) == b"from-child"
+    store.release(_oid(8))
