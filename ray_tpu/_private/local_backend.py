@@ -331,8 +331,11 @@ class LocalBackend:
         events.task_started(spec, self.node_id,
                             threading.current_thread().name)
         try:
+            from ray_tpu._private.runtime_env import applied_runtime_env
+
             args, kwargs = self.worker.resolve_args(spec)
-            result = spec.func(*args, **kwargs)
+            with applied_runtime_env(spec.runtime_env):
+                result = spec.func(*args, **kwargs)
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
             events.task_finished(spec)
         except BaseException as e:  # noqa: BLE001 - any user failure → object error

@@ -121,8 +121,12 @@ class MemoryStore:
                     if remaining <= 0:
                         break
                 cond.wait(remaining)
+            # At most num_returns ready refs are returned (ray.wait
+            # contract); extras stay in not_ready even if resolved.
             ready = [oid for oid in object_ids if oid in ready_set]
-        not_ready = [oid for oid in object_ids if oid not in ready_set]
+            ready = ready[:num_returns]
+        ready_out = set(ready)
+        not_ready = [oid for oid in object_ids if oid not in ready_out]
         return ready, not_ready
 
     # -- local reference counting (process-lifetime GC) ------------------

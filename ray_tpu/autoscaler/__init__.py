@@ -1,0 +1,21 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+Reference: `python/ray/autoscaler/` (SURVEY.md §2.2) — `StandardAutoscaler`
+control loop reading resource load, a bin-packing demand scheduler
+(`resource_demand_scheduler.py`), and a `NodeProvider` plugin ABC with
+cloud implementations. The TPU shift: node types are *slices*
+(`v5e-8`, `v5e-64`, ...) — atomic units with ICI topology labels — not
+fungible GPU VMs, so scaling requests whole slices and placement groups
+can demand contiguous ones.
+"""
+
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeNodeProvider,
+    NodeProvider,
+    TPUPodProvider,
+)
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    AutoscalerConfig,
+    NodeType,
+    StandardAutoscaler,
+)

@@ -1,0 +1,180 @@
+"""StandardAutoscaler: the scaling control loop + demand bin-packing.
+
+Reference: `autoscaler/_private/autoscaler.py` (control loop) and
+`resource_demand_scheduler.py` (pack pending demands onto node types
+respecting min/max workers and `upscaling_speed`). Demand comes from the
+scheduler's unfulfilled requests; supply from provider node types.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclass
+class NodeType:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType] = field(default_factory=list)
+    upscaling_speed: float = 1.0
+    idle_timeout_s: float = 60.0
+    interval_s: float = 1.0
+
+
+def bin_pack_demands(demands: List[Dict[str, float]],
+                     node_types: List[NodeType],
+                     existing: Dict[str, int]) -> Dict[str, int]:
+    """Choose node launches covering `demands` (list of resource dicts).
+    First-fit-decreasing onto the smallest feasible node type; respects
+    per-type max_workers. Returns {node_type: count_to_launch}."""
+    to_launch: Dict[str, int] = {}
+    # Track remaining capacity of planned nodes.
+    open_nodes: List[Dict[str, float]] = []
+
+    def feasible(nt: NodeType, demand):
+        return all(nt.resources.get(k, 0) >= v for k, v in demand.items())
+
+    demands_sorted = sorted(
+        demands, key=lambda d: -sum(d.values()))
+    types_sorted = sorted(node_types,
+                          key=lambda nt: sum(nt.resources.values()))
+    for demand in demands_sorted:
+        placed = False
+        for node in open_nodes:
+            if all(node.get(k, 0) >= v for k, v in demand.items()):
+                for k, v in demand.items():
+                    node[k] -= v
+                placed = True
+                break
+        if placed:
+            continue
+        for nt in types_sorted:
+            launched = existing.get(nt.name, 0) + to_launch.get(nt.name, 0)
+            if feasible(nt, demand) and launched < nt.max_workers:
+                to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+                node = dict(nt.resources)
+                for k, v in demand.items():
+                    node[k] -= v
+                open_nodes.append(node)
+                placed = True
+                break
+        # Infeasible demands are simply skipped (reported upstream).
+    return to_launch
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 demand_fn=None):
+        """`demand_fn() -> List[resource dict]`: pending unfulfilled
+        requests (defaults to reading the local backend's waiting queue)."""
+        self.provider = provider
+        self.config = config
+        self.demand_fn = demand_fn or _default_demand_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle_since: Dict[str, float] = {}
+        self.launches = 0
+        self.terminations = 0
+
+    # -- one reconcile pass ---------------------------------------------
+
+    def update(self):
+        demands = self.demand_fn()
+        nodes = self.provider.non_terminated_nodes({})
+        by_type: Dict[str, int] = {}
+        for n in nodes:
+            t = self.provider.node_tags(n).get("node-type") or \
+                self.provider.node_tags(n).get("ici_slice", "unknown")
+            by_type[t] = by_type.get(t, 0) + 1
+
+        # min_workers floor
+        for nt in self.config.node_types:
+            deficit = nt.min_workers - by_type.get(nt.name, 0)
+            if deficit > 0:
+                self.provider.create_node(nt.name, deficit)
+                self.launches += deficit
+                by_type[nt.name] = nt.min_workers
+
+        if demands:
+            plan = bin_pack_demands(demands, self.config.node_types,
+                                    by_type)
+            for name, count in plan.items():
+                count = max(1, min(
+                    count,
+                    math.ceil(count * self.config.upscaling_speed)))
+                self.provider.create_node(name, count)
+                self.launches += count
+        else:
+            # Idle downscaling to min_workers.
+            now = time.monotonic()
+            per_type_seen: Dict[str, int] = {}
+            for n in nodes:
+                t = self.provider.node_tags(n).get("node-type", "unknown")
+                per_type_seen[t] = per_type_seen.get(t, 0) + 1
+                nt = next((x for x in self.config.node_types
+                           if x.name == t), None)
+                if nt is None:
+                    continue
+                if per_type_seen[t] <= nt.min_workers:
+                    self._idle_since.pop(n, None)
+                    continue
+                first_idle = self._idle_since.setdefault(n, now)
+                if now - first_idle > self.config.idle_timeout_s:
+                    self.provider.terminate_node(n)
+                    self.terminations += 1
+                    self._idle_since.pop(n, None)
+
+    # -- loop ------------------------------------------------------------
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:  # pragma: no cover - keep the loop alive
+                pass
+            self._stop.wait(self.config.interval_s)
+
+    def stop(self):
+        self._stop.set()
+
+    def summary(self) -> dict:
+        nodes = self.provider.non_terminated_nodes({})
+        return {
+            "nodes": len(nodes),
+            "launches": self.launches,
+            "terminations": self.terminations,
+            "pending_demands": len(self.demand_fn()),
+        }
+
+
+def _default_demand_fn() -> List[Dict[str, float]]:
+    """Pending resource demands from the local backend: tasks waiting for
+    resources (the reference reads the same from GCS resource load)."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.resources import from_milli, to_milli
+
+    w = worker_mod.global_worker_or_none()
+    if w is None:
+        return []
+    backend = w.backend
+    with backend._lock:
+        waiting = list(backend._waiting_for_resources)
+    return [dict(s.resources) or {"CPU": 1.0} for s in waiting]

@@ -1,0 +1,112 @@
+"""Dashboard: HTTP JSON API over cluster state.
+
+Reference: `dashboard/` (head + modules; SURVEY.md §2.2). The API surface
+(nodes/tasks/actors/objects/jobs/metrics/serve) is served by a threaded
+stdlib HTTP server reading the state API, metrics registry, and serve
+controller — the aggregation role of `dashboard/state_aggregator.py`.
+The React UI is out of scope; the JSON API is the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = dashboard._route(self.path)
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="dashboard")
+        self._thread.start()
+
+    def _route(self, path: str):
+        import ray_tpu
+        from ray_tpu.experimental import state
+
+        path = path.split("?")[0].rstrip("/") or "/"
+        if path == "/api/metrics":
+            from ray_tpu.util.metrics import export_prometheus
+
+            return export_prometheus().encode(), "text/plain"
+        routes = {
+            "/": lambda: {"status": "ok",
+                          "endpoints": ["/api/nodes", "/api/tasks",
+                                        "/api/actors", "/api/objects",
+                                        "/api/cluster_status",
+                                        "/api/serve", "/api/metrics",
+                                        "/api/timeline"]},
+            "/api/nodes": state.list_nodes,
+            "/api/tasks": state.list_tasks,
+            "/api/actors": state.list_actors,
+            "/api/objects": state.list_objects,
+            "/api/placement_groups": state.list_placement_groups,
+            "/api/timeline": ray_tpu.timeline,
+            "/api/cluster_status": lambda: {
+                "cluster_resources": ray_tpu.cluster_resources(),
+                "available_resources": ray_tpu.available_resources(),
+                "task_summary": state.summarize_tasks(),
+                "actor_summary": state.summarize_actors(),
+            },
+            "/api/serve": self._serve_status,
+        }
+        fn = routes[path]  # KeyError → 404
+        return json.dumps(fn(), default=str).encode(), "application/json"
+
+    @staticmethod
+    def _serve_status():
+        try:
+            from ray_tpu import serve
+
+            return serve.status()
+        except Exception:
+            return {}
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+_server: Optional[DashboardServer] = None
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 0) -> DashboardServer:
+    global _server
+    if _server is None:
+        import ray_tpu
+
+        ray_tpu.init(ignore_reinit_error=True)
+        _server = DashboardServer(host, port)
+    return _server
+
+
+def shutdown_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -1,0 +1,145 @@
+"""CLI: cluster/job/observability commands.
+
+Reference: `python/ray/scripts/scripts.py` (`ray start/stop/status/
+memory/timeline/summary`, `ray job submit/...`). Run as
+`python -m ray_tpu.scripts.cli <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    print(json.dumps({
+        "nodes": ray_tpu.nodes(),
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+    }, indent=2, default=str))
+
+
+def cmd_summary(args):
+    import ray_tpu
+    from ray_tpu.experimental import state
+
+    ray_tpu.init(ignore_reinit_error=True)
+    kind = args.kind
+    fn = {"tasks": state.summarize_tasks,
+          "actors": state.summarize_actors,
+          "objects": state.summarize_objects}[kind]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_list(args):
+    import ray_tpu
+    from ray_tpu.experimental import state
+
+    ray_tpu.init(ignore_reinit_error=True)
+    fn = {"tasks": state.list_tasks, "actors": state.list_actors,
+          "objects": state.list_objects,
+          "nodes": state.list_nodes,
+          "placement-groups": state.list_placement_groups}[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_timeline(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    out = args.output or "timeline.json"
+    ray_tpu.timeline(out)
+    print(f"wrote {out}")
+
+
+def cmd_memory(args):
+    import ray_tpu
+    from ray_tpu.experimental import state
+
+    ray_tpu.init(ignore_reinit_error=True)
+    rows = state.list_objects()
+    print(json.dumps({"objects": rows,
+                      "summary": state.summarize_objects()},
+                     indent=2, default=str))
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        if args.wait:
+            info = client.wait_until_finish(job_id)
+            print(client.get_job_logs(job_id))
+            print(f"{job_id}: {info.status}")
+            sys.exit(0 if info.status == "SUCCEEDED" else 1)
+        print(job_id)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        client.stop_job(args.job_id)
+        print("stopped")
+
+
+def cmd_dashboard(args):
+    from ray_tpu.dashboard import start_dashboard
+
+    server = start_dashboard(port=args.port)
+    print(f"dashboard at http://{server.host}:{server.port}")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary")
+    p.add_argument("kind", choices=["tasks", "actors", "objects"])
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("list")
+    p.add_argument("kind", choices=["tasks", "actors", "objects", "nodes",
+                                    "placement-groups"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser("memory").set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("job")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("--wait", action="store_true")
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("job_id")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("dashboard")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

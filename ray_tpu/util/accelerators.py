@@ -1,0 +1,70 @@
+"""Accelerator type constants — TPU-first.
+
+Reference: `python/ray/util/accelerators/accelerators.py` (NVIDIA-only in
+the snapshot). Here TPU generations are first-class, with chip/HBM specs
+the scheduler and mesh heuristics can consult; NVIDIA constants retained
+for API compatibility.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+# TPU generations (per-chip figures; bf16 peak)
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5E"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+# Reference-compat GPU constants
+NVIDIA_TESLA_V100 = "V100"
+NVIDIA_TESLA_P100 = "P100"
+NVIDIA_TESLA_T4 = "T4"
+NVIDIA_TESLA_A100 = "A100"
+NVIDIA_A100_40G = "A100-40G"
+NVIDIA_A100_80G = "A100-80G"
+NVIDIA_H100 = "H100"
+
+
+@dataclass(frozen=True)
+class TPUChipSpec:
+    name: str
+    hbm_bytes: int
+    peak_bf16_flops: float
+    ici_bandwidth_gbps: float  # per link, one direction
+
+
+TPU_SPECS: Dict[str, TPUChipSpec] = {
+    TPU_V4: TPUChipSpec(TPU_V4, 32 * 2**30, 275e12, 50),
+    TPU_V5E: TPUChipSpec(TPU_V5E, 16 * 2**30, 197e12, 50),
+    TPU_V5P: TPUChipSpec(TPU_V5P, 95 * 2**30, 459e12, 100),
+    TPU_V6E: TPUChipSpec(TPU_V6E, 32 * 2**30, 918e12, 100),
+}
+
+
+def detect_tpu_type() -> str:
+    """Best-effort generation detection on this host."""
+    import os
+
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    mapping = {"v4": TPU_V4, "v5e": TPU_V5E, "v5p": TPU_V5P,
+               "v6e": TPU_V6E}
+    if env in mapping:
+        return mapping[env]
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for key, val in mapping.items():
+            if key in kind:
+                return val
+        if "v5 lite" in kind or "v5lite" in kind:
+            return TPU_V5E
+    except Exception:
+        pass
+    return TPU_V5E
+
+
+def chip_spec(name: str = None) -> TPUChipSpec:
+    return TPU_SPECS[name or detect_tpu_type()]

@@ -1,0 +1,46 @@
+"""Serializability inspection (reference `ray.util.check_serialize`)."""
+
+from __future__ import annotations
+
+import inspect
+import pickle
+from typing import Any, List, Set, Tuple
+
+
+def inspect_serializability(obj: Any, name: str = None,
+                            depth: int = 3) -> Tuple[bool, Set[str]]:
+    """Try to pickle `obj`; on failure, walk closures/attributes to find
+    the offending members. Returns (serializable, failure_set)."""
+    failures: Set[str] = set()
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    ok = _check(obj, name, depth, failures)
+    return ok, failures
+
+
+def _check(obj, name, depth, failures) -> bool:
+    try:
+        pickle.dumps(obj)
+        return True
+    except Exception:
+        pass
+    if depth <= 0:
+        failures.add(name)
+        return False
+    found_inner = False
+    if inspect.isfunction(obj) and obj.__closure__:
+        for var, cell in zip(obj.__code__.co_freevars, obj.__closure__):
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if not _check(inner, f"{name}.<closure:{var}>", depth - 1,
+                          failures):
+                found_inner = True
+    members = getattr(obj, "__dict__", None)
+    if isinstance(members, dict):
+        for attr, value in list(members.items())[:50]:
+            if not _check(value, f"{name}.{attr}", depth - 1, failures):
+                found_inner = True
+    if not found_inner:
+        failures.add(name)
+    return False

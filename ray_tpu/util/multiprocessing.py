@@ -1,0 +1,100 @@
+"""multiprocessing.Pool-compatible shim over tasks.
+
+Reference: `python/ray/util/multiprocessing/` — drop-in Pool whose workers
+are remote tasks instead of forked processes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _invoke(fn, args, kwargs):
+    return fn(*args, **(kwargs or {}))
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        vals = ray_tpu.get(self._refs, timeout=timeout)
+        return vals[0] if self._single else vals
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, *args, **kwargs):
+        ray_tpu.init(ignore_reinit_error=True)
+        self._processes = processes
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return ray_tpu.get(_invoke.remote(fn, args, kwds))
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        return AsyncResult([_invoke.remote(fn, args, kwds)], single=True)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return ray_tpu.get([_invoke.remote(fn, (x,), None)
+                            for x in iterable])
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize=None) -> AsyncResult:
+        return AsyncResult([_invoke.remote(fn, (x,), None)
+                            for x in iterable], single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize=None) -> List[Any]:
+        return ray_tpu.get([_invoke.remote(fn, tuple(args), None)
+                            for args in iterable])
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize=None):
+        refs = [_invoke.remote(fn, (x,), None) for x in iterable]
+        for r in refs:
+            yield ray_tpu.get(r)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize=None):
+        refs = [_invoke.remote(fn, (x,), None) for x in iterable]
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    def close(self):
+        pass
+
+    def terminate(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False
