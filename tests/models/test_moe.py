@@ -1,0 +1,89 @@
+"""MoE model tests: routing, expert-parallel equivalence, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from ray_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    init_moe_params_sharded,
+    moe_forward,
+    moe_loss_fn,
+)
+from ray_tpu.parallel import MeshConfig, create_mesh
+
+
+def _batch(cfg, b=2, s=16, seed=0):
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (b, s), 0, cfg.vocab_size)
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+
+def test_moe_forward_finite_and_aux():
+    cfg = MoEConfig.debug_moe()
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+    logits, aux = moe_forward(params, batch["tokens"], cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # Balanced-ish routing at init: aux near its floor of 1.0.
+    assert 0.9 < float(aux) < 3.0
+
+
+def test_moe_expert_parallel_matches_single_device():
+    cfg = MoEConfig.debug_moe()
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+    expected, aux0 = moe_forward(params, batch["tokens"], cfg)
+
+    mesh = create_mesh(MeshConfig(data=2, expert=2, tensor=2))
+    sharded = init_moe_params_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    got, aux1 = jax.jit(
+        lambda p, t: moe_forward(p, t, cfg, mesh=mesh)
+    )(sharded, batch["tokens"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux1), float(aux0), rtol=1e-4)
+
+
+def test_moe_train_step_descends():
+    cfg = MoEConfig.debug_moe()
+    mesh = create_mesh(MeshConfig(data=2, expert=2, tensor=2))
+    params = init_moe_params_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    tx = make_optimizer(5e-3, warmup_steps=0)
+    state = init_train_state(params, tx)
+    step = make_train_step(
+        lambda p, b: moe_loss_fn(p, b, cfg, mesh=mesh), tx, mesh=mesh,
+        batch_logical={"tokens": ("batch", "seq"),
+                       "targets": ("batch", "seq")})
+    batch = _batch(cfg, b=4, s=16)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["ce_loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_topk_gating_selects_k_experts():
+    cfg = MoEConfig.debug_moe()
+    from ray_tpu.models.moe import _moe_ffn, _init_moe_layer
+
+    from ray_tpu.parallel.sharding import DEFAULT_RULES
+
+    lp = _init_moe_layer(cfg, jax.random.PRNGKey(1))
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 8, cfg.dim))
+    out, aux = _moe_ffn(cfg, lp, x, None, DEFAULT_RULES)
+    assert out.shape == x.shape
+    # Recompute gates to confirm exactly k nonzero per token.
+    logits = jnp.einsum("bsd,de->bse", x, lp["router"])
+    probs = jax.nn.softmax(logits, -1)
+    topk_vals, _ = jax.lax.top_k(probs, cfg.n_experts_per_token)
+    gates = jnp.where(probs >= topk_vals[..., -1:], probs, 0.0)
+    nonzero = (gates > 0).sum(-1)
+    assert int(nonzero.max()) == cfg.n_experts_per_token
