@@ -1,0 +1,188 @@
+"""Worker-node process for multiprocess cluster mode.
+
+Role-equivalent to the reference's raylet + worker pool on one node
+(SURVEY.md §1 process topology): registers with the head, executes tasks
+submitted over the control plane on a LocalBackend, serves its objects to
+peers (owner-based pull — the reference's
+`ownership_based_object_directory.h` pattern: the head only stores
+*locations*, payloads move node→node directly), and pulls remote
+dependencies before dispatch.
+
+Entry: ``python -m ray_tpu._private.cluster_node --head HOST:PORT ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+
+class NodeRuntime:
+    def __init__(self, head_address, resources: Dict[str, float],
+                 node_id: Optional[str] = None):
+        self.head = RpcClient.to(tuple(head_address))
+        self.node_id = node_id or NodeID.from_random().hex()
+
+        # Bring up a standard in-process runtime for this node.
+        worker_mod.shutdown()
+        self.worker = worker_mod.init(**_res_kwargs(resources))
+        self.worker.is_cluster_node = True
+        self._install_report_hook()
+
+        self.server = RpcServer({
+            "submit_task": self._submit_task,
+            "get_object": self._get_object,
+            "contains_object": self._contains_object,
+            "kill_actor": self._kill_actor,
+            "ping": self._ping,
+            "shutdown": self._shutdown,
+        })
+        self._shutdown_event = threading.Event()
+        self.head.call("register_node", node_id=self.node_id,
+                       address=self.server.address, resources=resources)
+
+    # -- object plane ----------------------------------------------------
+
+    def _install_report_hook(self):
+        """Report object locations to the head as task outputs land."""
+        worker = self.worker
+        orig = worker.store_task_outputs
+        node = self
+
+        def store_and_report(spec, values, error=None):
+            orig(spec, values, error=error)
+            oids = [oid.binary() for oid in spec.return_ids]
+            if oids:
+                try:
+                    node.head.call("report_objects", oids=oids,
+                                   address=node.server.address)
+                except Exception:
+                    pass
+
+        worker.store_task_outputs = store_and_report
+
+    def _fetch_dependency(self, oid: ObjectID, timeout: float = 30.0):
+        if self.worker.memory_store.contains(oid):
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.worker.memory_store.contains(oid):
+                return  # produced locally while we were polling
+            loc = self.head.call("locate", oid=oid.binary())
+            if loc is not None and tuple(loc) != self.server.address:
+                ok, value, err = RpcClient.to(tuple(loc)).call(
+                    "get_object", oid=oid.binary())
+                if ok:
+                    self.worker.memory_store.put(oid, value, error=err)
+                    return
+            time.sleep(0.02)
+        raise TimeoutError(f"could not fetch {oid.hex()} from cluster")
+
+    # -- RPC handlers ----------------------------------------------------
+
+    def _submit_task(self, spec):
+        from ray_tpu.object_ref import ObjectRef
+
+        deps = [arg.id for arg in
+                list(spec.args) + list(spec.kwargs.values())
+                if isinstance(arg, ObjectRef)]
+        missing = [d for d in deps
+                   if not self.worker.memory_store.contains(d)]
+        if not missing:
+            self.worker.backend.submit(spec)
+            return True
+
+        # Pull remote deps off the RPC thread: ack immediately so the
+        # driver isn't blocked on our fetches (the reference's
+        # DependencyManager is likewise async).
+        def fetch_then_submit():
+            try:
+                for d in missing:
+                    self._fetch_dependency(d)
+                self.worker.backend.submit(spec)
+            except BaseException as e:  # noqa: BLE001
+                from ray_tpu import exceptions as exc
+
+                self.worker.store_task_outputs(
+                    spec, None,
+                    error=exc.TaskError(e, spec.describe()))
+
+        threading.Thread(target=fetch_then_submit, daemon=True).start()
+        return True
+
+    def _get_object(self, oid: bytes, timeout: float = 30.0):
+        object_id = ObjectID(oid)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ready, value, error = self.worker.memory_store.peek(object_id)
+            if ready:
+                return True, value, error
+            time.sleep(0.005)
+        return False, None, None
+
+    def _contains_object(self, oid: bytes):
+        return self.worker.memory_store.contains(ObjectID(oid))
+
+    def _kill_actor(self, actor_id, no_restart: bool = True):
+        self.worker.backend.kill_actor(actor_id, no_restart)
+        return True
+
+    def _ping(self):
+        return {
+            "node_id": self.node_id,
+            "available": self.worker.backend.resources.available,
+            "total": self.worker.backend.resources.total,
+        }
+
+    def _shutdown(self):
+        self._shutdown_event.set()
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+
+    def serve_forever(self):
+        try:
+            while not self._shutdown_event.wait(0.5):
+                pass
+        finally:
+            self.server.shutdown()
+            worker_mod.shutdown()
+
+
+def _res_kwargs(resources: Dict[str, float]) -> dict:
+    kw: Dict[str, Any] = {}
+    res = dict(resources)
+    if "CPU" in res:
+        kw["num_cpus"] = res.pop("CPU")
+    if "TPU" in res:
+        kw["num_tpus"] = res.pop("TPU")
+    if res:
+        kw["resources"] = res
+    return kw
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--num-cpus", type=float, default=1)
+    parser.add_argument("--num-tpus", type=float, default=0)
+    parser.add_argument("--node-id", default=None)
+    args = parser.parse_args()
+    host, port = args.head.rsplit(":", 1)
+    resources = {"CPU": args.num_cpus}
+    if args.num_tpus:
+        resources["TPU"] = args.num_tpus
+    runtime = NodeRuntime((host, int(port)), resources,
+                          node_id=args.node_id)
+    runtime.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

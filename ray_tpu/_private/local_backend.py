@@ -423,13 +423,48 @@ class LocalBackend:
         actor = self._actors.get(actor_id)
         if actor is None:
             return
+        spec = actor.spec
+        can_restart = not no_restart and (
+            spec.max_restarts == -1
+            or actor.num_restarts < spec.max_restarts)
         drained = actor.stop("killed via kill()")
+        if can_restart:
+            # Reference semantics (`gcs_actor_manager.h` restart FSM):
+            # re-run the constructor; queued calls survive the restart.
+            restarts = actor.num_restarts + 1
+            pool = getattr(actor, "_held_pool", None)
+            if pool is not None:
+                actor._held_pool = None
+                pool.release(actor._held_request)
+            replacement = _Actor(self, spec)
+            replacement.num_restarts = restarts
+            self._actors[actor_id] = replacement
+            for item in drained:
+                replacement.mailbox.put(item)
+            self._ready.put(spec)
+            return
         for item in drained:
             self.worker.store_task_outputs(
                 item, None,
                 error=exc.ActorDiedError(actor_id.hex()[:8], actor.death_cause),
             )
         self._on_actor_death(actor, exc.ActorDiedError(actor_id.hex()[:8], "killed"))
+
+    def pending_demand_milli(self) -> Dict[str, int]:
+        """Resource demand of tasks queued but not yet dispatched — the
+        backlog signal the cluster scheduler and autoscaler consume
+        (reference: raylet backlog reporting in lease requests)."""
+        from ray_tpu._private.resources import to_milli as _to_milli
+
+        demand: Dict[str, int] = {}
+        with self._ready.mutex:
+            queued = list(self._ready.queue)
+        with self._lock:
+            queued += list(self._waiting_for_resources)
+        for s in queued:
+            for k, v in _to_milli(s.resources).items():
+                demand[k] = demand.get(k, 0) + v
+        return demand
 
     def actor_state(self, actor_id: ActorID) -> str:
         actor = self._actors.get(actor_id)

@@ -1,0 +1,154 @@
+"""Framed-pickle TCP RPC: the cluster control/data plane transport.
+
+Role-equivalent to the reference's gRPC layer (`src/ray/rpc/`): a threaded
+server dispatching named methods, and a client with pooled connections.
+Payloads are pickle (cloudpickle for code objects) with a 4-byte length
+prefix — on TPU-VM fleets the control plane rides DCN and this framing is
+sufficient; the tensor plane never touches it (XLA collectives own ICI).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+_LEN = struct.Struct("!I")
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RpcServer:
+    """Threaded request/response server: {method, kwargs} → {ok, result}."""
+
+    def __init__(self, handlers: Dict[str, Callable],
+                 host: str = "127.0.0.1", port: int = 0):
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        fn = server_self.handlers[msg["method"]]
+                        result = fn(**msg.get("kwargs", {}))
+                        reply = {"ok": True, "result": result}
+                    except BaseException as e:  # noqa: BLE001
+                        import traceback
+
+                        reply = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}",
+                                 "traceback": traceback.format_exc()}
+                    try:
+                        send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.handlers = handlers
+        self._server = Server((host, port), Handler)
+        self.address: Tuple[str, int] = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"rpc-server-{self.address[1]}")
+        self._thread.start()
+
+    def add_handler(self, name: str, fn: Callable):
+        self.handlers[name] = fn
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """One logical connection per target address, thread-safe via a lock
+    per connection (requests are small; head fan-in is the bottleneck long
+    before this is)."""
+
+    _pools: Dict[Tuple[str, int], "RpcClient"] = {}
+    _pools_lock = threading.Lock()
+
+    def __init__(self, address: Tuple[str, int]):
+        self.address = tuple(address)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def to(cls, address) -> "RpcClient":
+        key = tuple(address)
+        with cls._pools_lock:
+            client = cls._pools.get(key)
+            if client is None:
+                client = cls(key)
+                cls._pools[key] = client
+            return client
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.address, timeout=30)
+        return self._sock
+
+    def call(self, method: str, **kwargs) -> Any:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    sock = self._ensure()
+                    send_msg(sock, {"method": method, "kwargs": kwargs})
+                    reply = recv_msg(sock)
+                    break
+                except (ConnectionError, OSError):
+                    self.close_locked()
+                    if attempt:
+                        raise
+        if not reply["ok"]:
+            raise RemoteCallError(
+                f"{method} failed on {self.address}: {reply['error']}\n"
+                + reply.get("traceback", ""))
+        return reply["result"]
+
+    def close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._lock:
+            self.close_locked()
+
+
+class RemoteCallError(RuntimeError):
+    pass

@@ -1,0 +1,334 @@
+"""Cluster mode: multiprocess nodes on one machine (or many).
+
+Reference: `python/ray/cluster_utils.py:99` — `Cluster` runs N
+raylet-equivalents as separate OS processes, which is how the reference
+tests multi-node scheduling and failure handling without real machines
+(SURVEY.md §4). Here:
+
+- the driver process is the head: it hosts the GCS-style services
+  (node table, object directory) and its own LocalBackend;
+- `add_node()` spawns `ray_tpu._private.cluster_node` subprocesses that
+  register and execute shipped tasks;
+- scheduling: local-first pack, spill to the least-loaded remote node
+  with capacity (the reference's hybrid policy shape);
+- objects stay with their executing node (owner-based directory); gets
+  pull node→node.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.task_spec import TaskKind
+
+
+class _NodeRecord:
+    def __init__(self, node_id: str, address: Tuple[str, int],
+                 resources: Dict[str, float]):
+        self.node_id = node_id
+        self.address = tuple(address)
+        self.resources = resources
+        self.alive = True
+
+
+class ClusterHead:
+    """GCS-equivalent services hosted in the driver process."""
+
+    def __init__(self, worker):
+        self.worker = worker
+        self._lock = threading.Lock()
+        self.nodes: Dict[str, _NodeRecord] = {}
+        self.object_locations: Dict[bytes, Tuple[str, int]] = {}
+        self.actor_nodes: Dict[bytes, str] = {}
+        self.server = RpcServer({
+            "register_node": self._register_node,
+            "report_objects": self._report_objects,
+            "locate": self._locate,
+            "get_object": self._get_object,
+            "get_nodes": self._get_nodes,
+        })
+
+    def _register_node(self, node_id, address, resources):
+        with self._lock:
+            self.nodes[node_id] = _NodeRecord(node_id, address, resources)
+        return True
+
+    def _report_objects(self, oids: List[bytes], address):
+        with self._lock:
+            for oid in oids:
+                self.object_locations[oid] = tuple(address)
+        return True
+
+    def _locate(self, oid: bytes):
+        with self._lock:
+            loc = self.object_locations.get(oid)
+        if loc is not None:
+            return loc
+        # The driver itself may own it.
+        if self.worker.memory_store.contains(ObjectID(oid)):
+            return self.server.address
+        return None
+
+    def _get_object(self, oid: bytes, timeout: float = 30.0):
+        object_id = ObjectID(oid)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ready, value, error = self.worker.memory_store.peek(object_id)
+            if ready:
+                return True, value, error
+            time.sleep(0.005)
+        return False, None, None
+
+    def _get_nodes(self):
+        with self._lock:
+            return [
+                {"NodeID": n.node_id, "Address": n.address,
+                 "Resources": n.resources, "Alive": n.alive}
+                for n in self.nodes.values()
+            ]
+
+
+class ClusterBackendMixin:
+    """Installed over the driver's LocalBackend: route specs to nodes."""
+
+    def __init__(self, worker, head: ClusterHead):
+        self.worker = worker
+        self.head = head
+        self.local_backend = worker.backend
+        self._rr = 0
+
+    def submit(self, spec) -> None:
+        head = self.head
+        if spec.kind == TaskKind.ACTOR_TASK:
+            node_id = head.actor_nodes.get(spec.actor_id.binary())
+            if node_id is not None:
+                self._send(head.nodes[node_id], spec)
+                return
+            self._ensure_local_deps(spec)
+            self.local_backend.submit(spec)
+            return
+        target = self._choose_node(spec)
+        if target is None:
+            # A head-local task may still depend on remote objects.
+            self._ensure_local_deps(spec)
+            self.local_backend.submit(spec)
+            return
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            head.actor_nodes[spec.actor_id.binary()] = target.node_id
+        self._send(target, spec)
+
+    def _ensure_local_deps(self, spec):
+        from ray_tpu.object_ref import ObjectRef
+
+        store = self.worker.memory_store
+        head = self.head
+        missing = [a.id for a in
+                   list(spec.args) + list(spec.kwargs.values())
+                   if isinstance(a, ObjectRef) and not store.contains(a.id)]
+        for oid in missing:
+            def fetch(oid=oid):
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if store.contains(oid):
+                        return
+                    loc = head._locate(oid.binary())
+                    if loc is not None and \
+                            tuple(loc) != head.server.address:
+                        ok, value, err = RpcClient.to(tuple(loc)).call(
+                            "get_object", oid=oid.binary())
+                        if ok:
+                            store.put(oid, value, error=err)
+                            return
+                    time.sleep(0.01)
+
+            threading.Thread(target=fetch, daemon=True).start()
+
+    def _choose_node(self, spec) -> Optional[_NodeRecord]:
+        """Local-first pack; spill to remote capacity when local can't run
+        it now (reference hybrid policy shape)."""
+        from ray_tpu._private.resources import to_milli
+
+        request = to_milli(spec.resources)
+        local = self.local_backend.resources
+        pending = self.local_backend.pending_demand_milli()
+        with local._cond:
+            local_fits_now = all(
+                local._available.get(k, 0) - pending.get(k, 0) >= v
+                for k, v in request.items())
+        if local_fits_now:
+            return None
+        candidates = [n for n in self.head.nodes.values() if n.alive]
+        best, best_avail = None, -1.0
+        for node in candidates:
+            try:
+                info = RpcClient.to(node.address).call("ping")
+            except Exception:
+                node.alive = False
+                continue
+            avail = info["available"]
+            if all(avail.get(k, 0) * 1000 >= v
+                   for k, v in request.items()):
+                score = sum(avail.values())
+                if score > best_avail:
+                    best, best_avail = node, score
+        return best
+
+    def _send(self, node: _NodeRecord, spec):
+        # Proactively publish local args so the node can pull them.
+        from ray_tpu.object_ref import ObjectRef
+
+        local_oids = []
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(arg, ObjectRef) and \
+                    self.worker.memory_store.contains(arg.id):
+                local_oids.append(arg.id.binary())
+        if local_oids:
+            self.head._report_objects(local_oids, self.head.server.address)
+        RpcClient.to(node.address).call("submit_task", spec=spec)
+
+    # Delegate everything else to the local backend.
+
+    def __getattr__(self, name):
+        return getattr(self.local_backend, name)
+
+
+class ClusterDriverMixin:
+    """get()/wait() that pull remote objects on demand."""
+
+    @staticmethod
+    def install(worker, head: ClusterHead):
+        worker.cluster_head = head
+        original_get = worker.get_objects
+        original_wait = worker.wait
+        fetching: set = set()
+        lock = threading.Lock()
+
+        def ensure_fetch(ref):
+            if worker.memory_store.contains(ref.id):
+                return
+            key = ref.id.binary()
+            with lock:
+                if key in fetching:
+                    return
+                fetching.add(key)
+
+            def fetch():
+                try:
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        loc = head._locate(key)
+                        if loc is not None and \
+                                tuple(loc) != head.server.address:
+                            ok, value, err = RpcClient.to(
+                                tuple(loc)).call("get_object", oid=key)
+                            if ok:
+                                worker.memory_store.put(ref.id, value,
+                                                        error=err)
+                                return
+                        if worker.memory_store.contains(ref.id):
+                            return
+                        time.sleep(0.01)
+                finally:
+                    with lock:
+                        fetching.discard(key)
+
+            threading.Thread(target=fetch, daemon=True).start()
+
+        def get_objects(refs, timeout=None):
+            for ref in refs:
+                ensure_fetch(ref)
+            return original_get(refs, timeout)
+
+        def wait(refs, num_returns, timeout, fetch_local=True):
+            for ref in refs:
+                ensure_fetch(ref)
+            return original_wait(refs, num_returns, timeout, fetch_local)
+
+        worker.get_objects = get_objects
+        worker.wait = wait
+
+
+class Cluster:
+    """Reference: `ray.cluster_utils.Cluster` (`cluster_utils.py:99`)."""
+
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        head_node_args = head_node_args or {}
+        worker_mod.shutdown()
+        self.driver_worker = worker_mod.init(
+            num_cpus=head_node_args.get("num_cpus", 2),
+            num_tpus=head_node_args.get("num_tpus"),
+            resources=head_node_args.get("resources"))
+        self.head = ClusterHead(self.driver_worker)
+        backend = ClusterBackendMixin(self.driver_worker, self.head)
+        self.driver_worker.backend = backend
+        ClusterDriverMixin.install(self.driver_worker, self.head)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._counter = 0
+
+    @property
+    def address(self) -> str:
+        host, port = self.head.server.address
+        return f"{host}:{port}"
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 wait: bool = True, **_kw) -> str:
+        self._counter += 1
+        node_id = f"node-{self._counter}"
+        cmd = [sys.executable, "-m", "ray_tpu._private.cluster_node",
+               "--head", self.address, "--num-cpus", str(num_cpus),
+               "--node-id", node_id]
+        if num_tpus:
+            cmd += ["--num-tpus", str(num_tpus)]
+        import os
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(cmd, env=env)
+        self._procs[node_id] = proc
+        if wait:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if node_id in self.head.nodes:
+                    return node_id
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node process exited with {proc.returncode}")
+                time.sleep(0.05)
+            raise TimeoutError("node failed to register")
+        return node_id
+
+    def remove_node(self, node_id: str, graceful: bool = True):
+        record = self.head.nodes.get(node_id)
+        proc = self._procs.pop(node_id, None)
+        if record is not None:
+            record.alive = False
+            if graceful:
+                try:
+                    RpcClient.to(record.address).call("shutdown")
+                except Exception:
+                    pass
+            self.head.nodes.pop(node_id, None)
+        if proc is not None:
+            if not graceful:
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def nodes(self) -> List[dict]:
+        return self.head._get_nodes()
+
+    def shutdown(self):
+        for node_id in list(self._procs):
+            self.remove_node(node_id)
+        self.head.server.shutdown()
+        worker_mod.shutdown()

@@ -1,0 +1,113 @@
+"""Multiprocess cluster-mode tests (reference model:
+`ray.cluster_utils.Cluster`-based multi-node tests, SURVEY.md §4)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_remote_node_executes_spillover(cluster):
+    cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(num_cpus=2)
+    def where():
+        time.sleep(1.0)  # hold the CPUs so later submits must spill
+        return os.getpid()
+
+    # 4 concurrent 2-CPU tasks > head's 2 CPUs → some must spill to the
+    # worker node (different pid).
+    refs = [where.remote() for _ in range(4)]
+    pids = set(ray_tpu.get(refs, timeout=60))
+    assert len(pids) >= 2, pids
+
+
+def test_cross_node_object_transfer(cluster):
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=2)
+    def produce():
+        import numpy as np
+
+        return np.arange(1000)
+
+    @ray_tpu.remote(num_cpus=2)
+    def consume(arr):
+        return int(arr.sum())
+
+    # Force both tasks off-head by saturating head CPUs.
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(1.0)
+        return 1
+
+    h = hog.remote()
+    data = produce.remote()
+    total = consume.remote(data)
+    assert ray_tpu.get(total, timeout=60) == 999 * 500
+    ray_tpu.get(h)
+
+
+def test_driver_arg_shipped_to_node(cluster):
+    cluster.add_node(num_cpus=2)
+    big = list(range(5000))
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote(num_cpus=2)
+    def length(x):
+        return len(x)
+
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(0.8)
+        return 1
+
+    h = hog.remote()
+    assert ray_tpu.get(length.remote(ref), timeout=60) == 5000
+    ray_tpu.get(h)
+
+
+def test_actor_on_remote_node(cluster):
+    cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(num_cpus=3)  # cannot fit on the 2-CPU head
+    class Counter:
+        def __init__(self):
+            self.pid = os.getpid()
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def whoami(self):
+            return self.pid
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+    assert ray_tpu.get(c.whoami.remote(), timeout=60) != os.getpid()
+
+
+def test_node_removal(cluster):
+    nid = cluster.add_node(num_cpus=2)
+    assert len(cluster.nodes()) == 1
+    cluster.remove_node(nid)
+    assert len(cluster.nodes()) == 0
+
+    # Cluster still works locally after the node left.
+    @ray_tpu.remote
+    def f():
+        return 42
+
+    assert ray_tpu.get(f.remote(), timeout=30) == 42
