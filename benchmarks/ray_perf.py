@@ -1,0 +1,112 @@
+"""Core micro-op benchmark suite.
+
+Reference: `python/ray/_private/ray_perf.py:93-305` (run nightly by
+`release/microbenchmark/`): tasks/s (sync, 1:1, scatter), actor calls/s
+(sync + async), put/get latency and bandwidth, `wait` on many refs.
+Prints one JSON object with every metric; `python benchmarks/ray_perf.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def timeit(name, fn, multiplier: int = 1, min_time: float = 1.0) -> float:
+    # Warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    return rate
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    results = {}
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def tiny_cheap():
+        return b"ok"
+
+    results["single_client_tasks_sync_per_s"] = timeit(
+        "tasks sync", lambda: ray_tpu.get(tiny.remote()))
+
+    def batch_submit():
+        ray_tpu.get([tiny_cheap.remote() for _ in range(100)])
+
+    results["single_client_tasks_async_per_s"] = timeit(
+        "tasks async batch", batch_submit, multiplier=100)
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+    actor = Actor.remote()
+    results["actor_calls_sync_per_s"] = timeit(
+        "actor sync", lambda: ray_tpu.get(actor.ping.remote()))
+
+    def actor_batch():
+        ray_tpu.get([actor.ping.remote() for _ in range(100)])
+
+    results["actor_calls_async_per_s"] = timeit(
+        "actor async", actor_batch, multiplier=100)
+
+    small = np.zeros(1024, np.uint8)
+    results["put_small_per_s"] = timeit(
+        "put 1KB", lambda: ray_tpu.put(small))
+
+    big = np.zeros(64 * 2**20, np.uint8)
+
+    def put_get_big():
+        ref = ray_tpu.put(big)
+        ray_tpu.get(ref)
+
+    rate = timeit("put+get 64MB", put_get_big)
+    results["put_get_64MB_GBps"] = rate * 64 / 1024
+
+    refs = [tiny_cheap.remote() for _ in range(1000)]
+    ray_tpu.get(refs)
+    results["wait_1k_refs_per_s"] = timeit(
+        "wait 1k", lambda: ray_tpu.wait(refs, num_returns=1000,
+                                        timeout=10))
+
+    n_deep = 10
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def fan(width):
+        return 1
+
+    def scatter_gather():
+        ray_tpu.get([fan.remote(i) for i in range(n_deep)])
+
+    results["scatter_gather_10_per_s"] = timeit(
+        "1:n:1", scatter_gather)
+
+    results = {k: round(v, 1) for k, v in results.items()}
+    print(json.dumps(results, indent=2))
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""HuggingFace checkpoint interop for the Llama family.
+
+Converts `transformers` Llama weights (safetensors/torch state dict) into
+this framework's param pytree — the bridge for serving/fine-tuning
+published checkpoints. Conversion is pure tensor reshaping:
+
+- `q_proj.weight` [H*hd, D] → wq [D, H, hd] (transpose + split heads)
+- `gate/up/down_proj` → w1/w3/w2 (transposed)
+- `embed_tokens` → embed; `lm_head` → out (absent when tied)
+
+HF stores Q/K in the *interleaved* RoPE convention; our kernels use the
+split-half convention, so Q/K weights are permuted accordingly (standard
+`permute` from the transformers conversion script, inverted).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Map a `transformers.LlamaConfig` to our LlamaConfig."""
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        hidden_dim=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int, dim: int) -> np.ndarray:
+    """HF interleaved → split-half convention. w: [n_heads*hd, dim]."""
+    hd = w.shape[0] // n_heads
+    w = w.reshape(n_heads, 2, hd // 2, dim)
+    return w.transpose(0, 2, 1, 3).reshape(n_heads * hd, dim)
+
+
+def params_from_hf_state_dict(state_dict: Dict[str, Any],
+                              cfg: LlamaConfig,
+                              dtype=None) -> Dict[str, Any]:
+    """Torch/numpy state dict → param pytree (layers stacked for scan)."""
+    dtype = dtype or cfg.dtype
+
+    def tensor(name) -> np.ndarray:
+        t = state_dict[name]
+        if hasattr(t, "detach"):
+            t = t.detach().to("cpu").float().numpy()
+        return np.asarray(t, np.float32)
+
+    hd = cfg.head_dim
+    layers: Dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w1", "w2", "w3")}
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        wq = _unpermute_rope(tensor(p + "self_attn.q_proj.weight"),
+                             cfg.n_heads, cfg.dim)
+        wk = _unpermute_rope(tensor(p + "self_attn.k_proj.weight"),
+                             cfg.n_kv_heads, cfg.dim)
+        wv = tensor(p + "self_attn.v_proj.weight")
+        wo = tensor(p + "self_attn.o_proj.weight")
+        layers["attn_norm"].append(
+            tensor(p + "input_layernorm.weight"))
+        layers["wq"].append(
+            wq.T.reshape(cfg.dim, cfg.n_heads, hd))
+        layers["wk"].append(
+            wk.T.reshape(cfg.dim, cfg.n_kv_heads, hd))
+        layers["wv"].append(
+            wv.T.reshape(cfg.dim, cfg.n_kv_heads, hd))
+        layers["wo"].append(
+            wo.T.reshape(cfg.n_heads, hd, cfg.dim))
+        layers["mlp_norm"].append(
+            tensor(p + "post_attention_layernorm.weight"))
+        layers["w1"].append(tensor(p + "mlp.gate_proj.weight").T)
+        layers["w3"].append(tensor(p + "mlp.up_proj.weight").T)
+        layers["w2"].append(tensor(p + "mlp.down_proj.weight").T)
+
+    params = {
+        "embed": jnp.asarray(tensor("model.embed_tokens.weight"), dtype),
+        "layers": {k: jnp.asarray(np.stack(v), dtype)
+                   for k, v in layers.items()},
+        "final_norm": jnp.asarray(tensor("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["out"] = jnp.asarray(tensor("lm_head.weight").T, dtype)
+    return params
+
+
+def load_llama_from_hf(model_name_or_path: str, *,
+                       dtype=None,
+                       mesh=None, rules=None):
+    """Load a transformers Llama checkpoint into (cfg, params); with a
+    mesh, parameters are placed sharded."""
+    import transformers
+
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+        model_name_or_path)
+    cfg = config_from_hf(hf_model.config)
+    params = params_from_hf_state_dict(hf_model.state_dict(), cfg,
+                                       dtype=dtype)
+    if mesh is not None:
+        from ray_tpu.models.llama import param_logical_axes
+        from ray_tpu.parallel.sharding import DEFAULT_RULES, shard_pytree
+
+        params = shard_pytree(params, mesh, param_logical_axes(cfg),
+                              rules or DEFAULT_RULES)
+    return cfg, params
