@@ -28,7 +28,8 @@ from jax import lax
 from ray_tpu.ops.attention import flash_attention, _attention_reference
 from ray_tpu.ops.cross_entropy import softmax_cross_entropy
 from ray_tpu.ops.norms import rms_norm_reference
-from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.ops.rope import (apply_rope, rope_frequencies,
+                              rope_from_positions)
 from ray_tpu.parallel.ring_attention import ring_attention
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -241,12 +242,32 @@ def _layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
 def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
             rules=DEFAULT_RULES, positions=None):
     """tokens: [B, S] int32 → logits [B, S, vocab] (cfg.dtype)."""
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
-                                cfg.rope_theta)
     # With context parallelism each shard sees a sequence chunk; RoPE
     # must use global positions, which the caller passes in. Default is
-    # the unsharded arange.
-    x = params["embed"][tokens].astype(cfg.dtype)
+    # the unsharded arange. For explicit positions, cos/sin come from an
+    # elementwise compute (no table gather) hoisted out of the layer
+    # loop and constrained to the activation sharding — the gather form
+    # makes the SPMD partitioner replicate-and-repartition the looked-up
+    # values every step ("involuntary full rematerialization").
+    if positions is not None:
+        cos, sin = rope_from_positions(positions, cfg.head_dim,
+                                       cfg.rope_theta)
+        cos = with_logical_constraint(cos, "batch", "seq", None,
+                                      mesh=mesh, rules=rules)
+        sin = with_logical_constraint(sin, "batch", "seq", None,
+                                      mesh=mesh, rules=rules)
+        positions = None
+    else:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+    # Replicate the (small) table before the token gather: with the table
+    # left vocab/embed-sharded the SPMD partitioner partitions the gather
+    # on the embed dim and then "involuntarily rematerializes" (fully
+    # replicates) the gathered activations to reach the activation
+    # sharding. Transitioning the table once is strictly cheaper.
+    embed = with_logical_constraint(params["embed"], None, None,
+                                    mesh=mesh, rules=rules)
+    x = embed[tokens].astype(cfg.dtype)
     x = with_logical_constraint(x, "batch", "seq", "act_embed",
                                 mesh=mesh, rules=rules)
 

@@ -32,7 +32,8 @@ from ray_tpu.models.llama import (
 )
 from ray_tpu.ops.cross_entropy import softmax_cross_entropy
 from ray_tpu.ops.norms import rms_norm_reference
-from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.ops.rope import (apply_rope, rope_frequencies,
+                              rope_from_positions)
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     tree_shardings,
@@ -154,9 +155,24 @@ def _moe_ffn(cfg: MoEConfig, lp, x, mesh, rules):
 def moe_forward(params, tokens, cfg: MoEConfig, *, mesh=None,
                 rules=DEFAULT_RULES, positions=None):
     """Returns (logits [B,S,V], total aux loss)."""
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
-                                cfg.rope_theta)
-    x = params["embed"][tokens].astype(cfg.dtype)
+    # Same SPMD hygiene as llama.forward: explicit positions → elementwise
+    # cos/sin sharded with the activations (no table gather), and the
+    # embed table replicated before the token gather so the partitioner
+    # doesn't fully rematerialize the gathered activations.
+    if positions is not None:
+        cos, sin = rope_from_positions(positions, cfg.head_dim,
+                                       cfg.rope_theta)
+        cos = with_logical_constraint(cos, "batch", "seq", None,
+                                      mesh=mesh, rules=rules)
+        sin = with_logical_constraint(sin, "batch", "seq", None,
+                                      mesh=mesh, rules=rules)
+        positions = None
+    else:
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+    embed = with_logical_constraint(params["embed"], None, None,
+                                    mesh=mesh, rules=rules)
+    x = embed[tokens].astype(cfg.dtype)
     x = with_logical_constraint(x, "batch", "seq", "act_embed",
                                 mesh=mesh, rules=rules)
 

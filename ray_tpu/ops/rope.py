@@ -17,19 +17,36 @@ def rope_frequencies(head_dim: int, max_seq_len: int,
 
     theta=500000 is the Llama-3 base; Llama-2 used 10000.
     """
+    return rope_from_positions(jnp.arange(max_seq_len), head_dim, theta,
+                               dtype)
+
+
+def rope_from_positions(positions, head_dim: int, theta: float = 500000.0,
+                        dtype=jnp.float32):
+    """cos/sin of shape [*positions.shape, head_dim // 2] computed
+    directly from integer positions — no table gather. Elementwise, so
+    it shards with the activations under SPMD; the table-gather form
+    forces the partitioner into a replicate-and-repartition of the
+    looked-up values when batch/seq are mesh-sharded."""
     inv_freq = 1.0 / (theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    t = jnp.arange(max_seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
 def apply_rope(x, cos, sin, positions=None):
-    """x: [B, S, H, D]; cos/sin: [max_seq, D//2];
-    positions: optional [B, S] int positions (for decode/packed sequences);
-    defaults to arange(S)."""
+    """x: [B, S, H, D]; cos/sin: [max_seq, D//2] tables, or pre-selected
+    [B, S, D//2] (callers doing context parallelism hoist the position
+    gather out of the layer loop and shard it with the activations);
+    positions: optional [B, S] int positions (for decode/packed
+    sequences); defaults to arange(S)."""
     b, s, h, d = x.shape
-    if positions is None:
+    if cos.ndim == 3:
+        assert positions is None, (
+            "pre-selected 3-D cos/sin already encode positions")
+        cos_sel = cos[:, :, None, :]             # [B, S, 1, D/2]
+        sin_sel = sin[:, :, None, :]
+    elif positions is None:
         cos_sel = cos[:s][None, :, None, :]     # [1, S, 1, D/2]
         sin_sel = sin[:s][None, :, None, :]
     else:
