@@ -103,10 +103,64 @@ def main():
         "1:n:1", scatter_gather)
 
     results = {k: round(v, 1) for k, v in results.items()}
-    print(json.dumps(results, indent=2))
     ray_tpu.shutdown()
+    results.update(cluster_bench())
+    print(json.dumps(results, indent=2))
     return results
 
 
+def cluster_bench() -> dict:
+    """Cross-process object-plane throughput (shm vs pickle RPC)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out = {}
+    cluster = Cluster(head_node_args={"num_cpus": 1},
+                      shm_capacity=1024 * 2**20)
+    try:
+        cluster.add_node(num_cpus=4)
+        if cluster.shm_plane is not None:
+            # Steady-state numbers: let the background page-populate
+            # finish (a long-lived cluster runs fully populated).
+            cluster.shm_plane.store.wait_prefault(60)
+        mb = 64
+
+        @ray_tpu.remote(num_cpus=2)
+        def produce():
+            return np.zeros(mb * 2**20, np.uint8)
+
+        @ray_tpu.remote(num_cpus=2)
+        def consume(x):
+            return x.nbytes
+
+        def node_to_driver():
+            assert ray_tpu.get(produce.remote()).nbytes == mb * 2**20
+
+        def driver_to_node():
+            big = np.zeros(mb * 2**20, np.uint8)
+            assert ray_tpu.get(consume.remote(ray_tpu.put(big))) \
+                == mb * 2**20
+
+        rate = timeit("node->driver 64MB", node_to_driver, min_time=3.0)
+        out["xproc_get_64MB_GBps"] = round(rate * mb / 1024, 2)
+        rate = timeit("driver->node 64MB", driver_to_node, min_time=3.0)
+        out["xproc_put_arg_64MB_GBps"] = round(rate * mb / 1024, 2)
+        if cluster.shm_plane is not None:
+            out["shm_enabled"] = True
+            out["shm_evictions"] = cluster.shm_plane.stats()["evictions"]
+    finally:
+        cluster.shutdown()
+    return out
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None,
+                        help="also write results JSON to this path")
+    cli_args = parser.parse_args()
+    res = main()
+    if cli_args.out:
+        with open(cli_args.out, "w") as f:
+            json.dump(res, f, indent=2)

@@ -26,7 +26,8 @@ from ray_tpu._private.rpc import RpcClient, RpcServer
 
 class NodeRuntime:
     def __init__(self, head_address, resources: Dict[str, float],
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 shm_name: Optional[str] = None):
         self.head = RpcClient.to(tuple(head_address))
         self.node_id = node_id or NodeID.from_random().hex()
 
@@ -34,6 +35,25 @@ class NodeRuntime:
         worker_mod.shutdown()
         self.worker = worker_mod.init(**_res_kwargs(resources))
         self.worker.is_cluster_node = True
+        self.transfer_addr: Optional[tuple] = None
+        try:
+            from ray_tpu._private.shm_plane import SharedPlane
+
+            if shm_name:
+                # Same host as the head: attach its segment — objects
+                # move zero-copy between processes with no transfer.
+                plane = SharedPlane(shm_name, create=False)
+            else:
+                # Own segment (remote host, or simulating one): peers
+                # reach our objects through the native transfer server.
+                plane = SharedPlane(f"/ray_tpu_node_{os.getpid()}",
+                                    create=True)
+            plane.install(self.worker)
+            self.plane = plane
+            port = plane.store.start_transfer_server()
+            self.transfer_addr = ("127.0.0.1", port)
+        except Exception:
+            self.plane = None  # heap/RPC path still correct
         self._install_report_hook()
 
         self.server = RpcServer({
@@ -43,10 +63,27 @@ class NodeRuntime:
             "kill_actor": self._kill_actor,
             "ping": self._ping,
             "shutdown": self._shutdown,
-        })
+        }, dedupe_methods=frozenset({"submit_task", "kill_actor"}))
         self._shutdown_event = threading.Event()
-        self.head.call("register_node", node_id=self.node_id,
-                       address=self.server.address, resources=resources)
+        # Registration is idempotent; retry through transient head
+        # unavailability during cluster bring-up.
+        last_err: Optional[BaseException] = None
+        plane = getattr(self.worker, "shm_plane", None)
+        for _ in range(10):
+            try:
+                self.head.call("register_node", node_id=self.node_id,
+                               address=self.server.address,
+                               resources=resources,
+                               transfer=self.transfer_addr,
+                               shm_name=plane.name if plane else None)
+                break
+            except Exception as e:
+                last_err = e
+                time.sleep(0.5)
+        else:
+            raise RuntimeError(
+                f"node {self.node_id} could not register with head at "
+                f"{head_address}: {last_err}")
 
     # -- object plane ----------------------------------------------------
 
@@ -75,9 +112,18 @@ class NodeRuntime:
         while time.monotonic() < deadline:
             if self.worker.memory_store.contains(oid):
                 return  # produced locally while we were polling
-            loc = self.head.call("locate", oid=oid.binary())
-            if loc is not None and tuple(loc) != self.server.address:
-                ok, value, err = RpcClient.to(tuple(loc)).call(
+            from ray_tpu.cluster_utils import (_try_shm_fetch,
+                                               _try_transfer_fetch)
+
+            if _try_shm_fetch(self.worker, oid):
+                return
+            info = self.head.call("locate2", oid=oid.binary())
+            if info is not None and \
+                    tuple(info["address"]) != self.server.address:
+                if _try_transfer_fetch(self.worker, oid, info):
+                    return
+                ok, value, err = RpcClient.to(
+                    tuple(info["address"])).call(
                     "get_object", oid=oid.binary())
                 if ok:
                     self.worker.memory_store.put(oid, value, error=err)
@@ -153,6 +199,12 @@ class NodeRuntime:
                 pass
         finally:
             self.server.shutdown()
+            plane = getattr(self, "plane", None)
+            if plane is not None:
+                if plane._owner:
+                    plane.destroy()
+                else:
+                    plane.close()
             worker_mod.shutdown()
 
 
@@ -174,13 +226,14 @@ def main():
     parser.add_argument("--num-cpus", type=float, default=1)
     parser.add_argument("--num-tpus", type=float, default=0)
     parser.add_argument("--node-id", default=None)
+    parser.add_argument("--shm-name", default=None)
     args = parser.parse_args()
     host, port = args.head.rsplit(":", 1)
     resources = {"CPU": args.num_cpus}
     if args.num_tpus:
         resources["TPU"] = args.num_tpus
     runtime = NodeRuntime((host, int(port)), resources,
-                          node_id=args.node_id)
+                          node_id=args.node_id, shm_name=args.shm_name)
     runtime.serve_forever()
 
 

@@ -14,11 +14,14 @@ import socket
 import socketserver
 import struct
 import threading
+import uuid
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import cloudpickle
 
 _LEN = struct.Struct("!I")
+_REPLY_CACHE_SIZE = 4096
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
@@ -43,10 +46,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class RpcServer:
-    """Threaded request/response server: {method, kwargs} → {ok, result}."""
+    """Threaded request/response server: {method, kwargs} → {ok, result}.
+
+    Methods listed in ``dedupe_methods`` get exactly-once semantics under
+    client retry: completed replies are cached by request id, and a retry
+    racing a still-running execution waits for that execution instead of
+    starting a second one. Idempotent methods skip the cache so large
+    replies (e.g. object payloads) aren't retained.
+    """
 
     def __init__(self, handlers: Dict[str, Callable],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 dedupe_methods: Optional[frozenset] = None):
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -56,16 +67,22 @@ class RpcServer:
                         msg = recv_msg(self.request)
                     except (ConnectionError, OSError):
                         return
-                    try:
-                        fn = server_self.handlers[msg["method"]]
-                        result = fn(**msg.get("kwargs", {}))
-                        reply = {"ok": True, "result": result}
-                    except BaseException as e:  # noqa: BLE001
-                        import traceback
+                    rid = msg.get("id")
+                    if msg.get("method") not in server_self.dedupe_methods:
+                        rid = None
+                    reply = server_self._await_reply(rid) if rid else None
+                    if reply is None:
+                        try:
+                            fn = server_self.handlers[msg["method"]]
+                            result = fn(**msg.get("kwargs", {}))
+                            reply = {"ok": True, "result": result}
+                        except BaseException as e:  # noqa: BLE001
+                            import traceback
 
-                        reply = {"ok": False,
-                                 "error": f"{type(e).__name__}: {e}",
-                                 "traceback": traceback.format_exc()}
+                            reply = {"ok": False,
+                                     "error": f"{type(e).__name__}: {e}",
+                                     "traceback": traceback.format_exc()}
+                        server_self._finish_reply(rid, reply)
                     try:
                         send_msg(self.request, reply)
                     except (ConnectionError, OSError):
@@ -76,6 +93,10 @@ class RpcServer:
             allow_reuse_address = True
 
         self.handlers = handlers
+        self.dedupe_methods = dedupe_methods or frozenset()
+        self._replies: OrderedDict[str, Any] = OrderedDict()
+        self._inflight: Dict[str, threading.Event] = {}
+        self._replies_lock = threading.Lock()
         self._server = Server((host, port), Handler)
         self.address: Tuple[str, int] = self._server.server_address[:2]
         self._thread = threading.Thread(
@@ -85,6 +106,32 @@ class RpcServer:
 
     def add_handler(self, name: str, fn: Callable):
         self.handlers[name] = fn
+
+    def _await_reply(self, rid: str):
+        """Cached reply for rid, waiting out an in-flight execution."""
+        with self._replies_lock:
+            reply = self._replies.get(rid)
+            if reply is not None:
+                return reply
+            event = self._inflight.get(rid)
+            if event is None:
+                # First sighting: claim the id; caller executes.
+                self._inflight[rid] = threading.Event()
+                return None
+        event.wait()
+        with self._replies_lock:
+            return self._replies.get(rid)
+
+    def _finish_reply(self, rid: Optional[str], reply: Any):
+        if rid is None:
+            return
+        with self._replies_lock:
+            self._replies[rid] = reply
+            while len(self._replies) > _REPLY_CACHE_SIZE:
+                self._replies.popitem(last=False)
+            event = self._inflight.pop(rid, None)
+        if event is not None:
+            event.set()
 
     def shutdown(self):
         self._server.shutdown()
@@ -103,6 +150,8 @@ class RpcClient:
         self.address = tuple(address)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._id_prefix = uuid.uuid4().hex[:12]
+        self._seq = 0
 
     @classmethod
     def to(cls, address) -> "RpcClient":
@@ -121,10 +170,13 @@ class RpcClient:
 
     def call(self, method: str, **kwargs) -> Any:
         with self._lock:
+            self._seq += 1
+            rid = f"{self._id_prefix}:{self._seq}"
             for attempt in (0, 1):
                 try:
                     sock = self._ensure()
-                    send_msg(sock, {"method": method, "kwargs": kwargs})
+                    send_msg(sock, {"method": method, "kwargs": kwargs,
+                                    "id": rid})
                     reply = recv_msg(sock)
                     break
                 except (ConnectionError, OSError):

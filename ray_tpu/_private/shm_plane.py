@@ -1,0 +1,236 @@
+"""Shared-memory object plane: large values cross process boundaries
+through the native store, not pickle-over-TCP.
+
+Role-equivalent to the reference's plasma integration
+(`src/ray/core_worker/store_provider/plasma_store_provider.h`): values
+whose payload exceeds a threshold are serialized once into the node's
+shm segment (`src/object_store/store.cc`) with pickle protocol 5 —
+array buffers go out-of-band, so a reader on the same host reconstructs
+numpy arrays as zero-copy views over the mapped segment.
+
+Lifecycle: readers pin objects on get (store refcount) and the pin is
+released when the local MemoryStore entry is dropped — i.e. zero-copy
+views are valid while an ObjectRef is in scope, the reference's
+documented contract for plasma-backed numpy. Creates that fail for lack
+of space retry after waiting out eviction (the reference's
+create-request-queue backpressure, `plasma/create_request_queue.h`),
+then fall back to the heap/RPC path.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.shm_store import ShmObjectStore
+
+_MAGIC = b"RTS1"
+_ALIGN = 64
+
+DEFAULT_THRESHOLD = int(os.environ.get("RAY_TPU_SHM_THRESHOLD", 64 * 1024))
+DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_SHM_CAPACITY",
+                                      1024 * 2**20))
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class SharedPlane:
+    """One process's handle onto the node-wide shared object segment."""
+
+    def __init__(self, name: str, *, create: bool,
+                 capacity: int = DEFAULT_CAPACITY,
+                 max_objects: int = 8192,
+                 threshold: int = DEFAULT_THRESHOLD):
+        self.name = name
+        self.threshold = threshold
+        self.store = ShmObjectStore(name=name, capacity=capacity,
+                                    max_objects=max_objects, create=create)
+        self._lock = threading.Lock()
+        self._pinned: set[bytes] = set()
+        self._owner = create
+
+    # -- write side ------------------------------------------------------
+
+    def maybe_put(self, object_id: ObjectID, value: Any,
+                  timeout: float = 2.0) -> bool:
+        """Serialize ``value`` into the segment if its payload crosses the
+        threshold. Returns True iff the object is now readable from shm."""
+        oid = object_id.binary()
+        if self.store.contains(oid):
+            return True
+        try:
+            buffers: list = []
+            pik = cloudpickle.dumps(value, protocol=5,
+                                    buffer_callback=buffers.append)
+            raws = [b.raw() for b in buffers]
+        except Exception:
+            return False  # unpicklable / non-contiguous buffer: heap path
+        total_payload = len(pik) + sum(r.nbytes for r in raws)
+        if total_payload < self.threshold:
+            return False
+
+        # Layout: magic | u32 npickle | u32 nbuffers |
+        #         nbuffers * (u64 offset, u64 length) | pickle | buffers
+        header_len = len(_MAGIC) + 8 + 16 * len(raws)
+        pik_off = header_len
+        offs = []
+        cursor = _align(pik_off + len(pik))
+        for r in raws:
+            offs.append((cursor, r.nbytes))
+            cursor = _align(cursor + r.nbytes)
+        total = cursor
+
+        deadline = time.monotonic() + timeout
+        while True:
+            off = self.store._lib.shm_obj_create(
+                self.store._handle, oid, total)
+            if off != 2**64 - 1:
+                break
+            # Create-queue backpressure: eviction may need releases from
+            # other processes; wait briefly and retry.
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+        view = self.store._view
+        cur = off
+        view[cur:cur + len(_MAGIC)] = _MAGIC
+        cur += len(_MAGIC)
+        view[cur:cur + 4] = len(pik).to_bytes(4, "little")
+        view[cur + 4:cur + 8] = len(raws).to_bytes(4, "little")
+        cur += 8
+        for boff, blen in offs:
+            view[cur:cur + 8] = boff.to_bytes(8, "little")
+            view[cur + 8:cur + 16] = blen.to_bytes(8, "little")
+            cur += 16
+        view[off + pik_off:off + pik_off + len(pik)] = pik
+        for (boff, blen), r in zip(offs, raws):
+            if blen:
+                view[off + boff:off + boff + blen] = r.cast("B")
+        return bool(self.store._lib.shm_obj_seal(self.store._handle, oid))
+
+    # -- read side -------------------------------------------------------
+
+    def get(self, object_id: ObjectID) -> Tuple[bool, Any]:
+        """(found, value). Arrays in the value are zero-copy views over
+        the segment; the object stays pinned until `release`."""
+        oid = object_id.binary()
+        buf = self.store.get_bytes(oid)  # pins on success
+        if buf is None:
+            return False, None
+        try:
+            if bytes(buf[:4]) != _MAGIC:
+                self.store.release(oid)
+                return False, None
+            npik = int.from_bytes(bytes(buf[4:8]), "little")
+            nbuf = int.from_bytes(bytes(buf[8:12]), "little")
+            cur = 12
+            offs = []
+            for _ in range(nbuf):
+                boff = int.from_bytes(bytes(buf[cur:cur + 8]), "little")
+                blen = int.from_bytes(bytes(buf[cur + 8:cur + 16]),
+                                      "little")
+                offs.append((boff, blen))
+                cur += 16
+            pik = bytes(buf[cur:cur + npik])
+            base = self.store._view
+            # Offsets are relative to the object payload; rebase onto the
+            # process-wide mapping so views outlive `buf`.
+            obj_off = self._payload_offset(oid)
+            # Read-only views: sealed objects are immutable; a writable
+            # reconstructed array would let readers corrupt shared memory.
+            views = [base[obj_off + boff:obj_off + boff + blen]
+                     .toreadonly() for boff, blen in offs]
+            value = pickle.loads(pik, buffers=views)
+        except Exception:
+            self.store.release(oid)
+            raise
+        with self._lock:
+            if oid in self._pinned:
+                # Already pinned by an earlier get: drop the extra pin.
+                self.store.release(oid)
+            else:
+                self._pinned.add(oid)
+        return True, value
+
+    def _payload_offset(self, oid: bytes) -> int:
+        import ctypes
+
+        size = ctypes.c_uint64()
+        off = self.store._lib.shm_obj_get(self.store._handle, oid,
+                                          ctypes.byref(size))
+        if off == 2**64 - 1:
+            raise KeyError("object vanished from shm store")
+        self.store.release(oid)  # balance the extra pin from the lookup
+        return off
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self.store.contains(object_id.binary())
+
+    def release(self, object_id: ObjectID) -> None:
+        oid = object_id.binary()
+        with self._lock:
+            if oid not in self._pinned:
+                return
+            self._pinned.discard(oid)
+        self.store.release(oid)
+
+    def stats(self) -> dict:
+        return self.store.stats()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def install(self, worker) -> None:
+        """Attach this plane to a Worker: large puts/outputs get shared,
+        and MemoryStore entry GC releases shm pins."""
+        worker.shm_plane = self
+        store = worker.memory_store
+        plane = self
+
+        orig_remove = store.remove_local_ref
+
+        def remove_local_ref(object_id):
+            entry = store._entries.get(object_id)
+            last = entry is not None and entry.local_refs <= 1
+            orig_remove(object_id)
+            if last and object_id not in store._entries:
+                plane.release(object_id)
+
+        store.remove_local_ref = remove_local_ref
+
+    def close(self):
+        with self._lock:
+            pinned, self._pinned = list(self._pinned), set()
+        for oid in pinned:
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+        self.store.close()
+
+    def destroy(self):
+        self.close()
+        try:
+            self.store._lib.shm_store_destroy(self.name.encode())
+        except Exception:
+            pass
+
+
+def share_value(worker, object_id: ObjectID, value: Any) -> bool:
+    """Publish a worker-local value into the node's shared plane (no-op
+    without a plane or for small values)."""
+    plane: Optional[SharedPlane] = getattr(worker, "shm_plane", None)
+    if plane is None or value is None:
+        return False
+    try:
+        return plane.maybe_put(object_id, value)
+    except Exception:
+        return False

@@ -63,6 +63,10 @@ class Worker:
         self._put_counter_lock = threading.Lock()
         self._put_counters: dict[bytes, int] = {}
         self._driver_task_id = TaskID.from_random()
+        # Set by SharedPlane.install in cluster mode: large values are
+        # published to the node's shm segment for zero-copy cross-process
+        # reads (plasma-provider role).
+        self.shm_plane = None
         self.backend = LocalBackend(self, resources)
         # Named actors / placement groups / KV — the "GCS" of this runtime.
         self.gcs = state_mod.GlobalState(self)
@@ -91,6 +95,10 @@ class Worker:
             )
         oid = self.next_put_id()
         self.memory_store.put(oid, value)
+        if self.shm_plane is not None:
+            from ray_tpu._private.shm_plane import share_value
+
+            share_value(self, oid, value)
         return ObjectRef(oid)
 
     def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
@@ -150,6 +158,10 @@ class Worker:
             return
         for oid, value in zip(spec.return_ids, values):
             self.memory_store.put(oid, value)
+            if self.shm_plane is not None:
+                from ray_tpu._private.shm_plane import share_value
+
+                share_value(self, oid, value)
 
     def submit(self, spec: TaskSpec) -> list[ObjectRef]:
         # num_returns=0: no return objects at all (call is fire-and-forget).

@@ -56,7 +56,17 @@ class Checkpoint:
 
     @classmethod
     def from_bytes(cls, blob: bytes) -> "Checkpoint":
-        return cls(data=pickle.loads(blob))
+        data = pickle.loads(blob)
+        if isinstance(data, dict) and set(data) == {"__tar__"}:
+            # Directory-backed checkpoint serialized by to_bytes(): unpack
+            # the tarball so the round trip yields a dir checkpoint again
+            # (reference: air/checkpoint.py _FS_CHECKPOINT_KEY handling).
+            path = tempfile.mkdtemp(prefix="ckpt_")
+            with tarfile.open(fileobj=io.BytesIO(data["__tar__"]),
+                              mode="r") as tar:
+                tar.extractall(path, filter="data")
+            return cls(local_path=path)
+        return cls(data=data)
 
     @classmethod
     def from_uri(cls, uri: str) -> "Checkpoint":

@@ -27,15 +27,60 @@ from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import TaskKind
+from ray_tpu.exceptions import ActorDiedError, OwnerDiedError
+
+
+def _try_shm_fetch(worker, oid) -> bool:
+    """Zero-copy read from the node's shared segment, if the object is
+    there. Faster and cheaper than any RPC — always tried first."""
+    plane = getattr(worker, "shm_plane", None)
+    if plane is None:
+        return False
+    try:
+        found, value = plane.get(oid)
+    except Exception:
+        return False
+    if not found:
+        return False
+    worker.memory_store.put(oid, value)
+    return True
+
+
+def _try_transfer_fetch(worker, oid, loc_info) -> bool:
+    """Chunked native pull from the owner's transfer server into the
+    local segment, then zero-copy read — the cross-host object plane
+    (reference: ObjectManager Pull, `pull_manager.h:52`). Skipped when
+    the owner shares our segment (plain shm read suffices) or the
+    object isn't shm-backed."""
+    plane = getattr(worker, "shm_plane", None)
+    if plane is None or not loc_info:
+        return False
+    transfer = loc_info.get("transfer")
+    if transfer is None or loc_info.get("shm") == plane.name:
+        return False
+    try:
+        rc = plane.store.pull_from(oid.binary(), transfer[0], transfer[1])
+        if rc not in (0, -5):
+            return False
+        return _try_shm_fetch(worker, oid)
+    except Exception:
+        return False
 
 
 class _NodeRecord:
     def __init__(self, node_id: str, address: Tuple[str, int],
-                 resources: Dict[str, float]):
+                 resources: Dict[str, float],
+                 transfer: Optional[Tuple[str, int]] = None,
+                 shm_name: Optional[str] = None):
         self.node_id = node_id
         self.address = tuple(address)
         self.resources = resources
         self.alive = True
+        # Object-plane endpoints: the native transfer server serving this
+        # node's shm segment, and the segment name (nodes sharing a
+        # segment read each other's objects without any transfer).
+        self.transfer = tuple(transfer) if transfer else None
+        self.shm_name = shm_name
 
 
 class ClusterHead:
@@ -51,13 +96,17 @@ class ClusterHead:
             "register_node": self._register_node,
             "report_objects": self._report_objects,
             "locate": self._locate,
+            "locate2": self._locate2,
             "get_object": self._get_object,
             "get_nodes": self._get_nodes,
         })
+        self.transfer_addr: Optional[Tuple[str, int]] = None
 
-    def _register_node(self, node_id, address, resources):
+    def _register_node(self, node_id, address, resources,
+                       transfer=None, shm_name=None):
         with self._lock:
-            self.nodes[node_id] = _NodeRecord(node_id, address, resources)
+            self.nodes[node_id] = _NodeRecord(node_id, address, resources,
+                                              transfer, shm_name)
         return True
 
     def _report_objects(self, oids: List[bytes], address):
@@ -67,14 +116,31 @@ class ClusterHead:
         return True
 
     def _locate(self, oid: bytes):
+        """Owner's RPC address, or None. (Legacy callers; see _locate2.)"""
+        info = self._locate2(oid)
+        return info["address"] if info else None
+
+    def _locate2(self, oid: bytes):
+        """Rich location: {"address", "transfer", "shm"} of the owner."""
         with self._lock:
             loc = self.object_locations.get(oid)
-        if loc is not None:
-            return loc
-        # The driver itself may own it.
+            if loc is not None:
+                for n in self.nodes.values():
+                    if n.address == loc:
+                        return {"address": loc, "transfer": n.transfer,
+                                "shm": n.shm_name}
+                if loc == self.server.address:
+                    return self._self_location()
+                return {"address": loc, "transfer": None, "shm": None}
         if self.worker.memory_store.contains(ObjectID(oid)):
-            return self.server.address
+            return self._self_location()
         return None
+
+    def _self_location(self):
+        plane = getattr(self.worker, "shm_plane", None)
+        return {"address": self.server.address,
+                "transfer": getattr(self, "transfer_addr", None),
+                "shm": plane.name if plane else None}
 
     def _get_object(self, oid: bytes, timeout: float = 30.0):
         object_id = ObjectID(oid)
@@ -109,7 +175,23 @@ class ClusterBackendMixin:
         if spec.kind == TaskKind.ACTOR_TASK:
             node_id = head.actor_nodes.get(spec.actor_id.binary())
             if node_id is not None:
-                self._send(head.nodes[node_id], spec)
+                actor_desc = spec.actor_id.hex()[:8]
+                record = head.nodes.get(node_id)
+                if record is None or not record.alive:
+                    self._fail_spec(spec, ActorDiedError(
+                        actor_desc, f"its node {node_id} is dead"))
+                    return
+                try:
+                    self._send(record, spec)
+                except (ConnectionError, OSError) as e:
+                    # Transport failure: the node itself is unreachable.
+                    record.alive = False
+                    self._fail_spec(spec, ActorDiedError(
+                        actor_desc, f"node {node_id} unreachable: {e}"))
+                except Exception as e:
+                    # Handler-level error: the node is healthy, this
+                    # submission failed — fail the task, keep the node.
+                    self._fail_spec(spec, e)
                 return
             self._ensure_local_deps(spec)
             self.local_backend.submit(spec)
@@ -124,6 +206,11 @@ class ClusterBackendMixin:
             head.actor_nodes[spec.actor_id.binary()] = target.node_id
         self._send(target, spec)
 
+    def _fail_spec(self, spec, error: Exception) -> None:
+        store = self.worker.memory_store
+        for oid in spec.return_ids:
+            store.put(oid, None, error=error)
+
     def _ensure_local_deps(self, spec):
         from ray_tpu.object_ref import ObjectRef
 
@@ -134,19 +221,40 @@ class ClusterBackendMixin:
                    if isinstance(a, ObjectRef) and not store.contains(a.id)]
         for oid in missing:
             def fetch(oid=oid):
+                if _try_shm_fetch(self.worker, oid):
+                    return
+                # Transport failures are retried until the deadline (a
+                # brief owner stall must not poison the object); if the
+                # owner stayed unreachable the whole window, `get` raises
+                # OwnerDiedError instead of hanging. A never-located
+                # object is left pending — its producer may just be slow.
                 deadline = time.monotonic() + 60
+                transport_err = None
                 while time.monotonic() < deadline:
                     if store.contains(oid):
                         return
-                    loc = head._locate(oid.binary())
-                    if loc is not None and \
-                            tuple(loc) != head.server.address:
-                        ok, value, err = RpcClient.to(tuple(loc)).call(
-                            "get_object", oid=oid.binary())
+                    info = head._locate2(oid.binary())
+                    if info is not None and \
+                            tuple(info["address"]) != head.server.address:
+                        if _try_transfer_fetch(self.worker, oid, info):
+                            return
+                        try:
+                            ok, value, err = RpcClient.to(
+                                tuple(info["address"])).call(
+                                "get_object", oid=oid.binary())
+                        except Exception as e:
+                            transport_err = e
+                            time.sleep(0.2)
+                            continue
                         if ok:
                             store.put(oid, value, error=err)
                             return
                     time.sleep(0.01)
+                if transport_err is not None and not store.contains(oid):
+                    store.put(oid, None, error=OwnerDiedError(
+                        oid.hex()[:12],
+                        f"owner of {oid.hex()[:12]} unreachable for 60s: "
+                        f"{transport_err}"))
 
             threading.Thread(target=fetch, daemon=True).start()
 
@@ -222,12 +330,24 @@ class ClusterDriverMixin:
             def fetch():
                 try:
                     deadline = time.monotonic() + 60
+                    transport_err = None
                     while time.monotonic() < deadline:
-                        loc = head._locate(key)
-                        if loc is not None and \
-                                tuple(loc) != head.server.address:
-                            ok, value, err = RpcClient.to(
-                                tuple(loc)).call("get_object", oid=key)
+                        if _try_shm_fetch(worker, ref.id):
+                            return
+                        info = head._locate2(key)
+                        if info is not None and \
+                                tuple(info["address"]) != \
+                                head.server.address:
+                            if _try_transfer_fetch(worker, ref.id, info):
+                                return
+                            try:
+                                ok, value, err = RpcClient.to(
+                                    tuple(info["address"])).call(
+                                    "get_object", oid=key)
+                            except Exception as e:
+                                transport_err = e
+                                time.sleep(0.2)
+                                continue
                             if ok:
                                 worker.memory_store.put(ref.id, value,
                                                         error=err)
@@ -235,6 +355,13 @@ class ClusterDriverMixin:
                         if worker.memory_store.contains(ref.id):
                             return
                         time.sleep(0.01)
+                    if transport_err is not None and \
+                            not worker.memory_store.contains(ref.id):
+                        worker.memory_store.put(
+                            ref.id, None, error=OwnerDiedError(
+                                ref.id.hex()[:12],
+                                f"owner unreachable for 60s: "
+                                f"{transport_err}"))
                 finally:
                     with lock:
                         fetching.discard(key)
@@ -259,7 +386,10 @@ class Cluster:
     """Reference: `ray.cluster_utils.Cluster` (`cluster_utils.py:99`)."""
 
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[dict] = None):
+                 head_node_args: Optional[dict] = None,
+                 shm_capacity: Optional[int] = None):
+        import os
+
         head_node_args = head_node_args or {}
         worker_mod.shutdown()
         self.driver_worker = worker_mod.init(
@@ -270,7 +400,23 @@ class Cluster:
         backend = ClusterBackendMixin(self.driver_worker, self.head)
         self.driver_worker.backend = backend
         ClusterDriverMixin.install(self.driver_worker, self.head)
+        # Node-wide shared object segment (plasma role): the head creates
+        # it; node subprocesses attach by name. Large objects then cross
+        # process boundaries zero-copy instead of via pickle RPC.
+        self.shm_plane = None
+        try:
+            from ray_tpu._private import shm_plane as shm_mod
+
+            kwargs = {"capacity": shm_capacity} if shm_capacity else {}
+            self.shm_plane = shm_mod.SharedPlane(
+                f"/ray_tpu_{os.getpid()}", create=True, **kwargs)
+            self.shm_plane.install(self.driver_worker)
+            port = self.shm_plane.store.start_transfer_server()
+            self.head.transfer_addr = ("127.0.0.1", port)
+        except Exception:  # shm unavailable: pickle RPC still works
+            self.shm_plane = None
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._logs: Dict[str, str] = {}
         self._counter = 0
 
     @property
@@ -279,7 +425,15 @@ class Cluster:
         return f"{host}:{port}"
 
     def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
-                 wait: bool = True, **_kw) -> str:
+                 wait: bool = True, simulate_remote_host: bool = False,
+                 **_kw) -> str:
+        """Spawn a node subprocess. With ``simulate_remote_host`` the node
+        gets its own shm segment instead of attaching the head's, so the
+        native transfer plane (cross-host path) is exercised on one
+        machine — the reference's fake-multinode testing idea."""
+        import os
+        import tempfile
+
         self._counter += 1
         node_id = f"node-{self._counter}"
         cmd = [sys.executable, "-m", "ray_tpu._private.cluster_node",
@@ -287,23 +441,47 @@ class Cluster:
                "--node-id", node_id]
         if num_tpus:
             cmd += ["--num-tpus", str(num_tpus)]
-        import os
-
+        if self.shm_plane is not None and not simulate_remote_host:
+            cmd += ["--shm-name", self.shm_plane.name]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(cmd, env=env)
+        # Child output goes to a log file: a node that dies during
+        # bring-up must leave evidence, not vanish silently.
+        log_path = os.path.join(tempfile.gettempdir(),
+                                f"ray_tpu_{os.getpid()}_{node_id}.log")
+        log_f = open(log_path, "wb")
+        proc = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f)
+        log_f.close()
         self._procs[node_id] = proc
+        self._logs[node_id] = log_path
         if wait:
-            deadline = time.monotonic() + 30
+            # Generous deadline: imports alone can take tens of seconds
+            # on a busy single-core box.
+            deadline = time.monotonic() + 120
             while time.monotonic() < deadline:
                 if node_id in self.head.nodes:
                     return node_id
                 if proc.poll() is not None:
                     raise RuntimeError(
-                        f"node process exited with {proc.returncode}")
+                        f"node process exited with {proc.returncode};"
+                        f" log tail:\n{self._log_tail(node_id)}")
                 time.sleep(0.05)
-            raise TimeoutError("node failed to register")
+            raise TimeoutError(
+                f"node failed to register within 120s; log tail:\n"
+                f"{self._log_tail(node_id)}")
         return node_id
+
+    def _log_tail(self, node_id: str, nbytes: int = 4096) -> str:
+        path = self._logs.get(node_id)
+        if not path:
+            return "<no log>"
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - nbytes))
+                return f.read().decode(errors="replace")
+        except OSError as e:
+            return f"<log unreadable: {e}>"
 
     def remove_node(self, node_id: str, graceful: bool = True):
         record = self.head.nodes.get(node_id)
@@ -331,4 +509,7 @@ class Cluster:
         for node_id in list(self._procs):
             self.remove_node(node_id)
         self.head.server.shutdown()
+        if self.shm_plane is not None:
+            self.shm_plane.destroy()
+            self.shm_plane = None
         worker_mod.shutdown()

@@ -44,7 +44,7 @@ def _on_tpu() -> bool:
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                       sm_scale: float, causal: bool,
-                      block_q: int, block_k: int):
+                      block_q: int, block_k: int, sk: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -60,21 +60,38 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     if causal:
         should_compute = (iq + 1) * block_q > ik * block_k
 
+    # Ragged last k-block (sk % block_k != 0): the padded columns hold
+    # undefined memory and must not feed the online softmax. Statically
+    # elided when shapes divide evenly.
+    pad_cols = sk % block_k != 0
+
     @pl.when(should_compute)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)      # [bq, d]
         k = k_ref[0, 0].astype(jnp.float32)      # [bk, d]
         v = v_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        if pad_cols:
+            # Padded K/V rows hold undefined memory; a masked p of exactly
+            # 0 still yields NaN from 0 * NaN in p @ v — zero them.
+            kv_rows = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, v.shape[-1]), 0)
+            v = jnp.where(kv_rows < sk, v, 0.0)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale                              # [bq, bk]
-        if causal:
+        mask = None
+        if causal or pad_cols:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            mask = rows >= cols
+            if causal and pad_cols:
+                mask = (rows >= cols) & (cols < sk)
+            elif causal:
+                mask = rows >= cols
+            else:
+                mask = cols < sk
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]                         # [bq, 128], lanes equal
@@ -82,8 +99,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_cur = jnp.max(s, axis=-1, keepdims=True)          # [bq, 1]
         m_next = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
         p = jnp.exp(s - m_next[:, :1])                      # [bq, bk]
-        if causal:
-            p = jnp.where(rows >= cols, p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         correction = jnp.exp(m_prev[:, :1] - m_next[:, :1])  # [bq, 1]
         l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:] = m_next
@@ -109,7 +126,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, sk=sk,
     )
     kwargs = {}
     if pltpu is not None and not interpret:

@@ -90,10 +90,13 @@ class ReservoirReplayBuffer(ReplayBuffer):
             super().add(batch)
             self._seen += n
             return
-        for k in self._storage:
-            v = np.asarray(batch[k])
-            for i in range(n):
-                j = self._rng.randint(0, self._seen + i + 1)
-                if j < self.capacity:
+        # One slot draw per incoming transition, applied to every storage
+        # key — per-key draws would scatter one transition's fields across
+        # unrelated rows.
+        arrays = {k: np.asarray(batch[k]) for k in self._storage}
+        for i in range(n):
+            j = self._rng.randint(0, self._seen + i + 1)
+            if j < self.capacity:
+                for k, v in arrays.items():
                     self._storage[k][j] = v[i]
         self._seen += n

@@ -1,0 +1,259 @@
+// Chunked TCP object transfer between node stores. See transfer.h.
+
+#include "transfer.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+
+namespace ray_tpu {
+
+namespace {
+
+std::mutex g_stats_mu;
+
+bool SendAll(int fd, const void* buf, uint64_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, uint64_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+struct Request {
+  uint32_t magic;
+  uint8_t op;
+  uint8_t id[kIdSize];
+  uint64_t offset;
+  uint64_t len;
+} __attribute__((packed));
+
+}  // namespace
+
+TransferServer* TransferServer::Start(ShmStore* store, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+
+  auto* srv = new TransferServer();
+  srv->store_ = store;
+  srv->listen_fd_ = fd;
+  srv->port_ = ntohs(addr.sin_port);
+  srv->accept_thread_ = new std::thread([srv] { srv->AcceptLoop(); });
+  return srv;
+}
+
+TransferServer::~TransferServer() { Stop(); }
+
+void TransferServer::Stop() {
+  if (stopping_) return;
+  stopping_ = true;
+  if (listen_fd_ >= 0) {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  auto* t = static_cast<std::thread*>(accept_thread_);
+  if (t != nullptr) {
+    if (t->joinable()) t->join();
+    delete t;
+    accept_thread_ = nullptr;
+  }
+}
+
+void TransferServer::AcceptLoop() {
+  while (!stopping_) {
+    int conn = accept(listen_fd_, nullptr, nullptr);
+    if (conn < 0) {
+      if (stopping_) return;
+      if (errno == EINTR) continue;
+      return;
+    }
+    int one = 1;
+    setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread([this, conn] { HandleConn(conn); }).detach();
+  }
+}
+
+void TransferServer::HandleConn(int fd) {
+  Request req;
+  while (!stopping_ && RecvAll(fd, &req, sizeof(req))) {
+    if (req.magic != kTransferMagic) break;
+    uint64_t size = 0;
+    const uint8_t* payload = store_->Get(req.id, &size);  // pins
+    if (payload == nullptr) {
+      uint64_t missing = UINT64_MAX;
+      if (!SendAll(fd, &missing, sizeof(missing))) break;
+      continue;
+    }
+    bool ok = SendAll(fd, &size, sizeof(size));
+    if (ok && req.op == (uint8_t)TransferOp::kGet) {
+      uint64_t off = req.offset < size ? req.offset : size;
+      uint64_t len = req.len == 0 ? size - off : req.len;
+      if (off + len > size) len = size - off;
+      // Chunked send: bounded writes so a slow peer can't pin a huge
+      // buffer and stats stay live.
+      uint64_t sent = 0;
+      while (ok && sent < len) {
+        uint64_t n = len - sent < kChunkSize ? len - sent : kChunkSize;
+        ok = SendAll(fd, payload + off + sent, n);
+        sent += n;
+      }
+      std::lock_guard<std::mutex> g(g_stats_mu);
+      stats_.bytes_sent += sent;
+      stats_.objects_served += 1;
+      if (!ok) stats_.errors += 1;
+    }
+    store_->Release(req.id);
+    if (!ok) break;
+  }
+  close(fd);
+}
+
+TransferStats TransferServer::stats() const {
+  std::lock_guard<std::mutex> g(g_stats_mu);
+  return stats_;
+}
+
+int PullObject(ShmStore* store, const uint8_t* id, const char* host,
+               uint16_t port, TransferStats* stats) {
+  if (store->Contains(id)) return -5;
+
+  addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%u", port);
+  if (getaddrinfo(host, port_str, &hints, &res) != 0 || res == nullptr) {
+    return -1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) close(fd);
+    return -1;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  Request req = {};
+  req.magic = kTransferMagic;
+  req.op = (uint8_t)TransferOp::kGet;
+  memcpy(req.id, id, kIdSize);
+  req.offset = 0;
+  req.len = 0;
+  uint64_t size = 0;
+  if (!SendAll(fd, &req, sizeof(req)) ||
+      !RecvAll(fd, &size, sizeof(size))) {
+    close(fd);
+    return -4;
+  }
+  if (size == UINT64_MAX) {
+    close(fd);
+    return -2;
+  }
+
+  uint8_t* dst = store->CreateObject(id, size);
+  if (dst == nullptr) {
+    // Either a racing pull created it, or no space after eviction.
+    close(fd);
+    return store->Contains(id) ? -5 : -3;
+  }
+  // Chunked recv straight into the arena payload — no staging buffer.
+  uint64_t got = 0;
+  bool ok = true;
+  while (ok && got < size) {
+    uint64_t n = size - got < kChunkSize ? size - got : kChunkSize;
+    ok = RecvAll(fd, dst + got, n);
+    got += n;
+  }
+  close(fd);
+  if (!ok) {
+    store->Release(id);  // drop writer pin; entry stays unsealed
+    store->Delete(id);
+    if (stats) stats->errors += 1;
+    return -4;
+  }
+  store->Seal(id);
+  if (stats) {
+    stats->bytes_received += got;
+    stats->objects_pulled += 1;
+  }
+  return 0;
+}
+
+}  // namespace ray_tpu
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* shm_transfer_start(void* store, uint16_t port) {
+  return ray_tpu::TransferServer::Start(
+      static_cast<ray_tpu::ShmStore*>(store), port);
+}
+
+uint16_t shm_transfer_port(void* server) {
+  return static_cast<ray_tpu::TransferServer*>(server)->port();
+}
+
+void shm_transfer_stop(void* server) {
+  auto* s = static_cast<ray_tpu::TransferServer*>(server);
+  s->Stop();
+  delete s;
+}
+
+int shm_transfer_pull(void* store, const uint8_t* id, const char* host,
+                      uint16_t port) {
+  return ray_tpu::PullObject(static_cast<ray_tpu::ShmStore*>(store), id,
+                             host, port, nullptr);
+}
+
+void shm_transfer_stats(void* server, ray_tpu::TransferStats* out) {
+  *out = static_cast<ray_tpu::TransferServer*>(server)->stats();
+}
+}
