@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.rpc import RpcClient, RpcServer, routable_host
 
 
 class NodeRuntime:
@@ -35,7 +35,14 @@ class NodeRuntime:
         worker_mod.shutdown()
         self.worker = worker_mod.init(**_res_kwargs(resources))
         self.worker.is_cluster_node = True
+        # Endpoints are advertised at the interface the head routes us
+        # on (loopback in single-host simulation, the NIC IP on a real
+        # multi-host deployment) — the reference's node manager likewise
+        # registers the node's resolved IP, not loopback.
+        self._adv_host = routable_host(tuple(head_address))
         self.transfer_addr: Optional[tuple] = None
+        self.plane = None
+        plane = None
         try:
             from ray_tpu._private.shm_plane import SharedPlane
 
@@ -48,12 +55,26 @@ class NodeRuntime:
                 # reach our objects through the native transfer server.
                 plane = SharedPlane(f"/ray_tpu_node_{os.getpid()}",
                                     create=True)
-            plane.install(self.worker)
-            self.plane = plane
+            # Server first, install last: if anything here raises the
+            # worker has not been touched yet.
             port = plane.store.start_transfer_server()
-            self.transfer_addr = ("127.0.0.1", port)
+            plane.install(self.worker)
+            self.transfer_addr = (self._adv_host, port)
+            self.plane = plane
         except Exception:
-            self.plane = None  # heap/RPC path still correct
+            # Heap/RPC path is still correct — but don't leak a
+            # half-installed plane or an orphaned /dev/shm segment.
+            if plane is not None:
+                if getattr(self.worker, "shm_plane", None) is plane:
+                    self.worker.shm_plane = None
+                try:
+                    if shm_name:
+                        plane.close()      # attached: owner cleans up
+                    else:
+                        plane.destroy()    # ours: unlink the segment
+                except Exception:
+                    pass
+            self.transfer_addr = None
         self._install_report_hook()
 
         self.server = RpcServer({
@@ -63,7 +84,10 @@ class NodeRuntime:
             "kill_actor": self._kill_actor,
             "ping": self._ping,
             "shutdown": self._shutdown,
-        }, dedupe_methods=frozenset({"submit_task", "kill_actor"}))
+        }, host="0.0.0.0",
+           dedupe_methods=frozenset({"submit_task", "kill_actor"}))
+        # Advertised control address (bind is all-interfaces).
+        self.address = (self._adv_host, self.server.address[1])
         self._shutdown_event = threading.Event()
         # Registration is idempotent; retry through transient head
         # unavailability during cluster bring-up.
@@ -72,7 +96,7 @@ class NodeRuntime:
         for _ in range(10):
             try:
                 self.head.call("register_node", node_id=self.node_id,
-                               address=self.server.address,
+                               address=self.address,
                                resources=resources,
                                transfer=self.transfer_addr,
                                shm_name=plane.name if plane else None)
@@ -99,7 +123,7 @@ class NodeRuntime:
             if oids:
                 try:
                     node.head.call("report_objects", oids=oids,
-                                   address=node.server.address)
+                                   address=node.address)
                 except Exception:
                     pass
 
@@ -119,7 +143,7 @@ class NodeRuntime:
                 return
             info = self.head.call("locate2", oid=oid.binary())
             if info is not None and \
-                    tuple(info["address"]) != self.server.address:
+                    tuple(info["address"]) != self.address:
                 if _try_transfer_fetch(self.worker, oid, info):
                     return
                 ok, value, err = RpcClient.to(

@@ -21,7 +21,28 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import cloudpickle
 
 _LEN = struct.Struct("!I")
-_REPLY_CACHE_SIZE = 4096
+# Reply retention is per client (keyed by the client's id prefix), not a
+# global FIFO: a request with sequence N implicitly acks every reply with
+# sequence < N from that client (the client holds a lock across each
+# call+retry), so each client retains at most its in-flight reply. The
+# only global bound needed is on the number of distinct clients.
+_MAX_CLIENT_CACHES = 4096
+
+
+def routable_host(peer_address: Tuple[str, int]) -> str:
+    """The local interface IP a peer at ``peer_address`` would reach us
+    on (UDP-connect trick — the kernel picks the outbound interface; no
+    packet is sent). Nodes advertise this instead of loopback so object
+    and control endpoints work across hosts; falls back to loopback."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((peer_address[0], peer_address[1] or 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
@@ -49,10 +70,13 @@ class RpcServer:
     """Threaded request/response server: {method, kwargs} → {ok, result}.
 
     Methods listed in ``dedupe_methods`` get exactly-once semantics under
-    client retry: completed replies are cached by request id, and a retry
-    racing a still-running execution waits for that execution instead of
-    starting a second one. Idempotent methods skip the cache so large
-    replies (e.g. object payloads) aren't retained.
+    client retry: completed replies are retained per client until that
+    client's next request acks them (request seq N acks replies < N), and
+    a retry racing a still-running execution waits for that execution
+    instead of starting a second one. A waiter that finds the reply gone
+    (client cache evicted) gets an error reply — never a re-execution.
+    Idempotent methods skip the cache so large replies (e.g. object
+    payloads) aren't retained.
     """
 
     def __init__(self, handlers: Dict[str, Callable],
@@ -94,7 +118,8 @@ class RpcServer:
 
         self.handlers = handlers
         self.dedupe_methods = dedupe_methods or frozenset()
-        self._replies: OrderedDict[str, Any] = OrderedDict()
+        # client id prefix → {seq: reply}; OrderedDict for LRU over clients.
+        self._replies: OrderedDict[str, Dict[int, Any]] = OrderedDict()
         self._inflight: Dict[str, threading.Event] = {}
         self._replies_lock = threading.Lock()
         self._server = Server((host, port), Handler)
@@ -107,12 +132,24 @@ class RpcServer:
     def add_handler(self, name: str, fn: Callable):
         self.handlers[name] = fn
 
+    @staticmethod
+    def _split_rid(rid: str) -> Tuple[str, int]:
+        prefix, _, seq = rid.rpartition(":")
+        return prefix, int(seq)
+
     def _await_reply(self, rid: str):
         """Cached reply for rid, waiting out an in-flight execution."""
+        prefix, seq = self._split_rid(rid)
         with self._replies_lock:
-            reply = self._replies.get(rid)
-            if reply is not None:
-                return reply
+            per_client = self._replies.get(prefix)
+            if per_client is not None:
+                cached = per_client.get(seq)
+                if cached is not None:
+                    return cached
+                # Seeing seq means the client received every reply < seq
+                # (it serializes call+retry under one lock) — drop them.
+                for old in [s for s in per_client if s < seq]:
+                    del per_client[old]
             event = self._inflight.get(rid)
             if event is None:
                 # First sighting: claim the id; caller executes.
@@ -120,14 +157,24 @@ class RpcServer:
                 return None
         event.wait()
         with self._replies_lock:
-            return self._replies.get(rid)
+            reply = self._replies.get(prefix, {}).get(seq)
+        if reply is None:
+            # Cache evicted between finish and wakeup: fail the retry
+            # rather than silently executing a second time.
+            return {"ok": False,
+                    "error": "RetryError: reply for retried request "
+                             "expired before delivery"}
+        return reply
 
     def _finish_reply(self, rid: Optional[str], reply: Any):
         if rid is None:
             return
+        prefix, seq = self._split_rid(rid)
         with self._replies_lock:
-            self._replies[rid] = reply
-            while len(self._replies) > _REPLY_CACHE_SIZE:
+            per_client = self._replies.setdefault(prefix, {})
+            per_client[seq] = reply
+            self._replies.move_to_end(prefix)
+            while len(self._replies) > _MAX_CLIENT_CACHES:
                 self._replies.popitem(last=False)
             event = self._inflight.pop(rid, None)
         if event is not None:

@@ -412,7 +412,10 @@ class Cluster:
                 f"/ray_tpu_{os.getpid()}", create=True, **kwargs)
             self.shm_plane.install(self.driver_worker)
             port = self.shm_plane.store.start_transfer_server()
-            self.head.transfer_addr = ("127.0.0.1", port)
+            # Advertise on the host nodes already use to reach the head's
+            # RPC server — loopback in single-host simulation, the real
+            # head host otherwise.
+            self.head.transfer_addr = (self.head.server.address[0], port)
         except Exception:  # shm unavailable: pickle RPC still works
             self.shm_plane = None
         self._procs: Dict[str, subprocess.Popen] = {}

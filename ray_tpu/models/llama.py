@@ -239,6 +239,24 @@ def _layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
     return x
 
 
+# Tables up to this size are replicated before the token gather: with the
+# table left vocab-sharded the SPMD partitioner partitions the gather on
+# the vocab dim and then "involuntarily rematerializes" (fully replicates)
+# the gathered activations to reach the activation sharding, so one table
+# transition is strictly cheaper. Past the threshold (large-vocab TP
+# configs) replication would cost vocab*embed bytes of HBM per device, so
+# the table keeps its embed-dim shard instead — the gather then moves only
+# the looked-up rows, at the price of an all-gather over the activations.
+_EMBED_REPLICATE_MAX_BYTES = 1 << 27  # 128 MiB
+
+
+def _embed_lookup(embed, tokens, mesh, rules):
+    small = embed.size * embed.dtype.itemsize <= _EMBED_REPLICATE_MAX_BYTES
+    axes = (None, None) if small else (None, "embed")
+    embed = with_logical_constraint(embed, *axes, mesh=mesh, rules=rules)
+    return embed[tokens]
+
+
 def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
             rules=DEFAULT_RULES, positions=None):
     """tokens: [B, S] int32 → logits [B, S, vocab] (cfg.dtype)."""
@@ -260,14 +278,7 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
     else:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
-    # Replicate the (small) table before the token gather: with the table
-    # left vocab/embed-sharded the SPMD partitioner partitions the gather
-    # on the embed dim and then "involuntarily rematerializes" (fully
-    # replicates) the gathered activations to reach the activation
-    # sharding. Transitioning the table once is strictly cheaper.
-    embed = with_logical_constraint(params["embed"], None, None,
-                                    mesh=mesh, rules=rules)
-    x = embed[tokens].astype(cfg.dtype)
+    x = _embed_lookup(params["embed"], tokens, mesh, rules).astype(cfg.dtype)
     x = with_logical_constraint(x, "batch", "seq", "act_embed",
                                 mesh=mesh, rules=rules)
 

@@ -28,6 +28,7 @@ from jax import lax
 from ray_tpu.models.llama import (
     LlamaConfig,
     _attention,
+    _embed_lookup,
     _init_layer,
 )
 from ray_tpu.ops.cross_entropy import softmax_cross_entropy
@@ -157,8 +158,9 @@ def moe_forward(params, tokens, cfg: MoEConfig, *, mesh=None,
     """Returns (logits [B,S,V], total aux loss)."""
     # Same SPMD hygiene as llama.forward: explicit positions → elementwise
     # cos/sin sharded with the activations (no table gather), and the
-    # embed table replicated before the token gather so the partitioner
-    # doesn't fully rematerialize the gathered activations.
+    # embed table size-gated replicated/sharded before the token gather
+    # (_embed_lookup) so the partitioner doesn't fully rematerialize the
+    # gathered activations.
     if positions is not None:
         cos, sin = rope_from_positions(positions, cfg.head_dim,
                                        cfg.rope_theta)
@@ -170,9 +172,7 @@ def moe_forward(params, tokens, cfg: MoEConfig, *, mesh=None,
     else:
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
-    embed = with_logical_constraint(params["embed"], None, None,
-                                    mesh=mesh, rules=rules)
-    x = embed[tokens].astype(cfg.dtype)
+    x = _embed_lookup(params["embed"], tokens, mesh, rules).astype(cfg.dtype)
     x = with_logical_constraint(x, "batch", "seq", "act_embed",
                                 mesh=mesh, rules=rules)
 

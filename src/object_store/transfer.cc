@@ -87,8 +87,7 @@ TransferServer* TransferServer::Start(ShmStore* store, uint16_t port) {
 TransferServer::~TransferServer() { Stop(); }
 
 void TransferServer::Stop() {
-  if (stopping_) return;
-  stopping_ = true;
+  if (stopping_.exchange(true)) return;
   if (listen_fd_ >= 0) {
     shutdown(listen_fd_, SHUT_RDWR);
     close(listen_fd_);
@@ -100,18 +99,32 @@ void TransferServer::Stop() {
     delete t;
     accept_thread_ = nullptr;
   }
+  // Unblock in-flight handlers (they may be mid-recv on a slow peer)
+  // and wait for every one to finish before the caller frees us / the
+  // store we serve from.
+  std::unique_lock<std::mutex> lk(conn_mu_);
+  for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);
+  conn_cv_.wait(lk, [this] { return conn_fds_.empty(); });
 }
 
 void TransferServer::AcceptLoop() {
-  while (!stopping_) {
+  while (!stopping_.load()) {
     int conn = accept(listen_fd_, nullptr, nullptr);
     if (conn < 0) {
-      if (stopping_) return;
+      if (stopping_.load()) return;
       if (errno == EINTR) continue;
       return;
     }
     int one = 1;
     setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> g(conn_mu_);
+      if (stopping_.load()) {  // Stop() may have run since accept()
+        close(conn);
+        continue;
+      }
+      conn_fds_.insert(conn);
+    }
     std::thread([this, conn] { HandleConn(conn); }).detach();
   }
 }
@@ -148,6 +161,11 @@ void TransferServer::HandleConn(int fd) {
     store_->Release(req.id);
     if (!ok) break;
   }
+  {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    conn_fds_.erase(fd);
+  }
+  conn_cv_.notify_all();
   close(fd);
 }
 

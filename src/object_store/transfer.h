@@ -20,7 +20,11 @@
 
 #pragma once
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
+#include <mutex>
+#include <set>
 
 #include "store.h"
 
@@ -45,7 +49,9 @@ struct TransferStats {
 class TransferServer {
  public:
   // Serves objects from `store` on `port` (0 = ephemeral). Spawns an
-  // accept thread; per-connection handling on detached threads.
+  // accept thread; per-connection handling on detached threads whose
+  // fds are tracked so Stop() can shut them down and drain before the
+  // server (and the store behind it) is torn down.
   static TransferServer* Start(ShmStore* store, uint16_t port);
   ~TransferServer();
 
@@ -61,9 +67,16 @@ class TransferServer {
   ShmStore* store_ = nullptr;
   int listen_fd_ = -1;
   uint16_t port_ = 0;
-  volatile bool stopping_ = false;
+  std::atomic<bool> stopping_{false};
   void* accept_thread_ = nullptr;  // std::thread*
   TransferStats stats_ = {};
+
+  // Live connection tracking: Stop() shuts each fd down (unblocking
+  // handlers mid-recv) then waits for the set to drain, so no handler
+  // can touch store_/stats_ after Stop() returns.
+  std::mutex conn_mu_;
+  std::condition_variable conn_cv_;
+  std::set<int> conn_fds_;
 };
 
 // Pulls object `id` from host:port into `store` (create → recv → seal).
