@@ -27,9 +27,13 @@ from ray_tpu._private.rpc import RpcClient, RpcServer, routable_host
 class NodeRuntime:
     def __init__(self, head_address, resources: Dict[str, float],
                  node_id: Optional[str] = None,
-                 shm_name: Optional[str] = None):
+                 shm_name: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.head = RpcClient.to(tuple(head_address))
         self.node_id = node_id or NodeID.from_random().hex()
+        # Scheduling labels (e.g. {"ici_slice": "slice-0"} marking which
+        # contiguous TPU slice this host belongs to).
+        self.labels = dict(labels or {})
 
         # Bring up a standard in-process runtime for this node.
         worker_mod.shutdown()
@@ -81,29 +85,40 @@ class NodeRuntime:
             "submit_task": self._submit_task,
             "get_object": self._get_object,
             "contains_object": self._contains_object,
+            "free_objects": self._free_objects,
             "kill_actor": self._kill_actor,
+            "prepare_bundle": self._prepare_bundle,
+            "commit_bundle": self._commit_bundle,
+            "return_bundle": self._return_bundle,
             "ping": self._ping,
             "shutdown": self._shutdown,
         }, host="0.0.0.0",
            dedupe_methods=frozenset({"submit_task", "kill_actor"}))
+        # 2PC bundle reservation state: (pg_id, idx) -> milli request held
+        # in "prepared" until commit or return (reference:
+        # `raylet/placement_group_resource_manager.h`).
+        self._prepared_bundles: Dict[tuple, Dict[str, int]] = {}
         # Advertised control address (bind is all-interfaces).
         self.address = (self._adv_host, self.server.address[1])
         self._shutdown_event = threading.Event()
         # Registration is idempotent; retry through transient head
         # unavailability during cluster bring-up.
+        from ray_tpu._private.config import ray_config
+
         last_err: Optional[BaseException] = None
         plane = getattr(self.worker, "shm_plane", None)
-        for _ in range(10):
+        for _ in range(ray_config.rpc_connect_retries):
             try:
                 self.head.call("register_node", node_id=self.node_id,
                                address=self.address,
                                resources=resources,
                                transfer=self.transfer_addr,
-                               shm_name=plane.name if plane else None)
+                               shm_name=plane.name if plane else None,
+                               labels=self.labels)
                 break
             except Exception as e:
                 last_err = e
-                time.sleep(0.5)
+                time.sleep(ray_config.rpc_retry_backoff_s)
         else:
             raise RuntimeError(
                 f"node {self.node_id} could not register with head at "
@@ -129,9 +144,14 @@ class NodeRuntime:
 
         worker.store_task_outputs = store_and_report
 
-    def _fetch_dependency(self, oid: ObjectID, timeout: float = 30.0):
+    def _fetch_dependency(self, oid: ObjectID,
+                          timeout: Optional[float] = None):
+        from ray_tpu._private.config import ray_config
+
         if self.worker.memory_store.contains(oid):
             return
+        if timeout is None:
+            timeout = ray_config.fetch_deadline_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.worker.memory_store.contains(oid):
@@ -200,15 +220,75 @@ class NodeRuntime:
     def _contains_object(self, oid: bytes):
         return self.worker.memory_store.contains(ObjectID(oid))
 
+    def _free_objects(self, oids):
+        """Drop objects whose driver-side refcount hit zero (the head
+        fans the release out to owners — reference: FreeObjects RPC,
+        `object_manager.proto:61`)."""
+        object_ids = [ObjectID(o) for o in oids]
+        self.worker.memory_store.evict(object_ids)
+        plane = getattr(self.worker, "shm_plane", None)
+        if plane is not None:
+            for object_id in object_ids:
+                try:
+                    plane.release(object_id)
+                except Exception:
+                    pass
+        return True
+
     def _kill_actor(self, actor_id, no_restart: bool = True):
         self.worker.backend.kill_actor(actor_id, no_restart)
         return True
+
+    # -- placement-group 2PC (prepare / commit / return) -----------------
+
+    def _prepare_bundle(self, pg_id: bytes, index: int, request):
+        """Phase 1: tentatively acquire the bundle's resources."""
+        key = (pg_id, index)
+        if key in self._prepared_bundles:
+            return True  # idempotent retry
+        milli = {k: int(v) for k, v in request.items()}
+        if self.worker.backend.resources.try_acquire(milli):
+            self._prepared_bundles[key] = milli
+            return True
+        return False
+
+    def _commit_bundle(self, pg_id: bytes, index: int, bundle):
+        """Phase 2: convert the held resources into a bundle pool tasks
+        can target via PlacementGroupSchedulingStrategy."""
+        from ray_tpu._private.ids import PlacementGroupID
+        from ray_tpu._private.resources import ResourceSet
+
+        key = (pg_id, index)
+        if key not in self._prepared_bundles:
+            return False
+        self._prepared_bundles.pop(key)
+        self.worker.backend.bundle_resources[
+            (PlacementGroupID(pg_id), index)] = ResourceSet(bundle)
+        return True
+
+    def _return_bundle(self, pg_id: bytes, index: int):
+        """Abort a prepared bundle, or release a committed one."""
+        from ray_tpu._private.ids import PlacementGroupID
+        from ray_tpu._private.resources import to_milli
+
+        key = (pg_id, index)
+        held = self._prepared_bundles.pop(key, None)
+        if held is not None:
+            self.worker.backend.resources.release(held)
+            return True
+        pool = self.worker.backend.bundle_resources.pop(
+            (PlacementGroupID(pg_id), index), None)
+        if pool is not None:
+            self.worker.backend.resources.release(to_milli(pool.total))
+            return True
+        return False
 
     def _ping(self):
         return {
             "node_id": self.node_id,
             "available": self.worker.backend.resources.available,
             "total": self.worker.backend.resources.total,
+            "labels": self.labels,
         }
 
     def _shutdown(self):
@@ -218,9 +298,23 @@ class NodeRuntime:
     # -- lifecycle -------------------------------------------------------
 
     def serve_forever(self):
+        """Serve until shutdown — or until the head stays unreachable
+        past the health window (a dead head orphans the node; exiting
+        mirrors the reference raylet's GCS-disconnect suicide)."""
+        from ray_tpu._private.config import ray_config
+
+        misses = 0
         try:
-            while not self._shutdown_event.wait(0.5):
-                pass
+            while not self._shutdown_event.wait(
+                    max(ray_config.health_check_period_s, 0.1)):
+                try:
+                    self.head.call("get_nodes")
+                    misses = 0
+                except Exception:
+                    misses += 1
+                    if misses >= 4 * \
+                            ray_config.health_check_failure_threshold:
+                        break
         finally:
             self.server.shutdown()
             plane = getattr(self, "plane", None)
@@ -251,13 +345,17 @@ def main():
     parser.add_argument("--num-tpus", type=float, default=0)
     parser.add_argument("--node-id", default=None)
     parser.add_argument("--shm-name", default=None)
+    parser.add_argument("--label", action="append", default=[],
+                        help="node label key=value (repeatable)")
     args = parser.parse_args()
     host, port = args.head.rsplit(":", 1)
     resources = {"CPU": args.num_cpus}
     if args.num_tpus:
         resources["TPU"] = args.num_tpus
+    labels = dict(kv.split("=", 1) for kv in args.label)
     runtime = NodeRuntime((host, int(port)), resources,
-                          node_id=args.node_id, shm_name=args.shm_name)
+                          node_id=args.node_id, shm_name=args.shm_name,
+                          labels=labels)
     runtime.serve_forever()
 
 

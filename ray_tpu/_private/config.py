@@ -1,0 +1,101 @@
+"""Runtime configuration flags.
+
+Role-equivalent to the reference's ``RAY_CONFIG`` table
+(`src/ray/common/ray_config_def.h`: a macro table of 193 typed tunables,
+overridable per-process via ``RAY_<name>`` environment variables or
+``ray.init(_system_config=...)``). Here the table is a dataclass of typed
+fields; overrides come from ``RAY_TPU_<NAME>`` env vars (checked at first
+access) or ``ray_tpu.init(_system_config={...})``.
+
+Usage::
+
+    from ray_tpu._private.config import ray_config
+    period = ray_config.health_check_period_s
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class RayTpuConfig:
+    # -- failure detection (reference: gcs_health_check_manager.h:39,
+    #    ray_config_def.h health_check_* flags) ---------------------------
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 3
+
+    # -- object plane ----------------------------------------------------
+    # Driver/node-side remote fetch gives up after this long without
+    # locating an owner (reference: fetch_timeout_milliseconds).
+    fetch_deadline_s: float = 60.0
+    # Objects above this many bytes go to the shared segment / transfer
+    # plane instead of inline pickle RPC.
+    shm_share_threshold_bytes: int = 64 * 1024
+    # Disk spill: objects spill when the in-process store exceeds this
+    # fraction of its budget (reference: object_spilling_threshold).
+    object_spilling_threshold: float = 0.8
+    object_store_memory_bytes: int = 2 * 1024 ** 3
+    min_spilling_size_bytes: int = 1024 * 1024
+
+    # -- lineage / reconstruction (reference: object_recovery_manager.h,
+    #    task_manager.h lineage pinning) ---------------------------------
+    enable_object_reconstruction: bool = True
+    max_reconstruction_attempts: int = 3
+
+    # -- rpc -------------------------------------------------------------
+    rpc_connect_retries: int = 10
+    rpc_retry_backoff_s: float = 0.5
+
+    # -- scheduling ------------------------------------------------------
+    # Pack below this node-utilization fraction, then prefer spreading
+    # (reference: scheduler_spread_threshold, hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = 0.5
+
+    # -- memory monitor / worker killing (reference: memory_monitor.h) ---
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, f.default)
+        _apply_env_overrides(self)
+
+
+def _coerce(raw: str, target_type: type) -> Any:
+    if target_type is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return target_type(raw)
+
+
+def _apply_env_overrides(cfg: RayTpuConfig) -> None:
+    for f in dataclasses.fields(cfg):
+        env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+        if env is not None:
+            try:
+                setattr(cfg, f.name, _coerce(env, type(f.default)))
+            except (TypeError, ValueError):
+                pass
+
+
+_lock = threading.Lock()
+ray_config = RayTpuConfig()
+_apply_env_overrides(ray_config)
+
+
+def apply_system_config(overrides: Optional[Dict[str, Any]]) -> None:
+    """``init(_system_config={...})`` hook: named overrides win over env."""
+    if not overrides:
+        return
+    valid = {f.name for f in dataclasses.fields(ray_config)}
+    with _lock:
+        for key, value in overrides.items():
+            if key not in valid:
+                raise ValueError(
+                    f"unknown _system_config key {key!r}; valid keys: "
+                    f"{sorted(valid)}")
+            setattr(ray_config, key, value)

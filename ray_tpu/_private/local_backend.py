@@ -68,6 +68,8 @@ class _Actor:
         ) if inspect.isclass(spec.func) else False
         self._threads: list[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Dedicated forked worker when spec.isolate_process is set.
+        self._proc = None
 
     def start(self):
         n = max(1, self.spec.max_concurrency) if not self.is_async else 1
@@ -83,7 +85,14 @@ class _Actor:
         """Run the constructor; returns True on success."""
         spec = self.spec
         try:
-            self.instance = spec.func(*spec.args, **spec.kwargs)
+            if spec.isolate_process:
+                # The instance lives in a dedicated forked worker; the
+                # node only holds the command socket.
+                self._proc = self.backend.worker_pool.dedicated()
+                self._proc.request(("init", spec.func, spec.args,
+                                    spec.kwargs, spec.runtime_env))
+            else:
+                self.instance = spec.func(*spec.args, **spec.kwargs)
             self.state = ActorState.ALIVE
             self.backend.worker.store_task_outputs(spec, [None])
             return True
@@ -162,10 +171,21 @@ class LocalBackend:
         self._shutdown = threading.Event()
         # Per-bundle resource sets for placement groups: (pg_id, index) -> ResourceSet
         self.bundle_resources: dict[tuple, ResourceSet] = {}
+        # Forked-worker pool for isolate_process tasks/actors, created on
+        # first use (reference: worker_pool.h:156).
+        self._worker_pool = None
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="raylet-dispatch", daemon=True
         )
         self._dispatcher.start()
+
+    @property
+    def worker_pool(self):
+        if self._worker_pool is None:
+            from ray_tpu._private.worker_pool import WorkerPool
+
+            self._worker_pool = WorkerPool()
+        return self._worker_pool
 
     # ------------------------------------------------------------------
     # Submission
@@ -334,8 +354,14 @@ class LocalBackend:
             from ray_tpu._private.runtime_env import applied_runtime_env
 
             args, kwargs = self.worker.resolve_args(spec)
-            with applied_runtime_env(spec.runtime_env):
-                result = spec.func(*args, **kwargs)
+            if spec.isolate_process:
+                # Crash isolation: run in a forked worker so an os._exit /
+                # segfault fails this task, not the node.
+                result = self.worker_pool.run(spec.func, args, kwargs,
+                                              spec.runtime_env)
+            else:
+                with applied_runtime_env(spec.runtime_env):
+                    result = spec.func(*args, **kwargs)
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
             events.task_finished(spec)
         except BaseException as e:  # noqa: BLE001 - any user failure → object error
@@ -353,14 +379,26 @@ class LocalBackend:
                             threading.current_thread().name)
         try:
             args, kwargs = self.worker.resolve_args(spec)
-            method = getattr(actor.instance, spec.func)
-            if inspect.iscoroutinefunction(method):
-                result = actor._loop.run_until_complete(method(*args, **kwargs)) \
-                    if actor._loop else asyncio.run(method(*args, **kwargs))
+            if actor._proc is not None:
+                result = actor._proc.request(("method", spec.func, args,
+                                              kwargs))
             else:
-                result = method(*args, **kwargs)
+                method = getattr(actor.instance, spec.func)
+                if inspect.iscoroutinefunction(method):
+                    result = actor._loop.run_until_complete(method(*args, **kwargs)) \
+                        if actor._loop else asyncio.run(method(*args, **kwargs))
+                else:
+                    result = method(*args, **kwargs)
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
             events.task_finished(spec)
+        except exc.WorkerCrashedError as e:
+            # The actor's worker process died mid-call: fail this call,
+            # then restart the actor (within max_restarts) — reference:
+            # gcs_actor_manager.h restart FSM on worker failure.
+            events.task_finished(spec, error=f"WorkerCrashedError: {e}")
+            self.worker.store_task_outputs(
+                spec, None, error=exc.TaskError(e, spec.describe()))
+            self._handle_actor_crash(actor, str(e))
         except BaseException as e:  # noqa: BLE001
             events.task_finished(spec, error=f"{type(e).__name__}: {e}")
             err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
@@ -399,7 +437,40 @@ class LocalBackend:
         err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
         self.worker.store_task_outputs(spec, None, error=err)
 
+    def _handle_actor_crash(self, actor: _Actor, cause: str):
+        """Worker-process death: restart in place if budget remains
+        (queued calls survive onto the replacement), else die."""
+        spec = actor.spec
+        can_restart = spec.max_restarts == -1 or \
+            actor.num_restarts < spec.max_restarts
+        drained = actor.stop(f"worker process crashed: {cause}")
+        if actor._proc is not None:
+            actor._proc.kill()
+            actor._proc = None
+        if can_restart:
+            pool = getattr(actor, "_held_pool", None)
+            if pool is not None:
+                actor._held_pool = None
+                pool.release(actor._held_request)
+            replacement = _Actor(self, spec)
+            replacement.num_restarts = actor.num_restarts + 1
+            self._actors[actor.actor_id] = replacement
+            for item in drained:
+                replacement.mailbox.put(item)
+            self._ready.put(spec)
+            return
+        for item in drained:
+            self.worker.store_task_outputs(
+                item, None,
+                error=exc.ActorDiedError(actor.actor_id.hex()[:8],
+                                         actor.death_cause))
+        self._on_actor_death(actor, exc.ActorDiedError(
+            actor.actor_id.hex()[:8], actor.death_cause))
+
     def _on_actor_death(self, actor: _Actor, error: BaseException):
+        if actor._proc is not None:
+            actor._proc.kill()
+            actor._proc = None
         # Idempotent: release lifetime resources exactly once.
         pool = getattr(actor, "_held_pool", None)
         if pool is not None:
@@ -428,6 +499,9 @@ class LocalBackend:
             spec.max_restarts == -1
             or actor.num_restarts < spec.max_restarts)
         drained = actor.stop("killed via kill()")
+        if actor._proc is not None:
+            actor._proc.kill()
+            actor._proc = None
         if can_restart:
             # Reference semantics (`gcs_actor_manager.h` restart FSM):
             # re-run the constructor; queued calls survive the restart.
@@ -499,4 +573,9 @@ class LocalBackend:
         self._shutdown.set()
         for actor in list(self._actors.values()):
             actor.stop("node shutdown")
+            if actor._proc is not None:
+                actor._proc.kill()
+                actor._proc = None
+        if self._worker_pool is not None:
+            self._worker_pool.shutdown()
         self._dispatcher.join(timeout=1.0)

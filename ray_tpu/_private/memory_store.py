@@ -144,6 +144,14 @@ class MemoryStore:
             if entry.local_refs <= 0 and entry.ready:
                 del self._entries[object_id]
 
+    def evict(self, object_ids: list[ObjectID]) -> None:
+        """Drop local copies entirely (unlike `free`, which poisons the
+        entry): a later get blocks until the object is re-fetched or
+        reconstructed. Used by the cluster cache and spilling."""
+        with self._lock:
+            for oid in object_ids:
+                self._entries.pop(oid, None)
+
     def free(self, object_ids: list[ObjectID]) -> None:
         with self._lock:
             for oid in object_ids:

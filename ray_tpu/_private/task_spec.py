@@ -84,6 +84,9 @@ class TaskSpec:
     # Runtime env (recorded; applied by the worker pool when it launches
     # dedicated workers for the env)
     runtime_env: Optional[dict] = None
+    # Execute in a forked worker process (crash isolation) instead of a
+    # thread of the node process. Reference: raylet worker_pool.h:156.
+    isolate_process: bool = False
     # Return object IDs, precomputed by the submitter (owner)
     return_ids: list = field(default_factory=list)
     # Depth for scheduling fairness / detection of recursive deadlock

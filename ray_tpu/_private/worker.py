@@ -218,6 +218,7 @@ def init(
     namespace: Optional[str] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
     **kwargs,
 ) -> "Worker":
     """Start (or connect to) the runtime.
@@ -235,6 +236,9 @@ def init(
                 "ray_tpu.init() called twice; pass ignore_reinit_error=True "
                 "or call ray_tpu.shutdown() first."
             )
+        from ray_tpu._private.config import apply_system_config
+
+        apply_system_config(_system_config)
         total: Dict[str, float] = {"CPU": float(num_cpus if num_cpus is not None
                                                 else os.cpu_count() or 1)}
         try:

@@ -24,7 +24,7 @@ _ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "name",
     "namespace", "lifetime", "max_restarts", "max_task_retries",
     "max_concurrency", "max_pending_calls", "scheduling_strategy",
-    "runtime_env", "get_if_exists", "_metadata",
+    "runtime_env", "get_if_exists", "_metadata", "isolate_process",
 }
 
 
@@ -166,6 +166,7 @@ class ActorClass:
             max_pending_calls=opts.get("max_pending_calls", -1),
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
+            isolate_process=bool(opts.get("isolate_process", False)),
         )
         handle = ActorHandle(
             actor_id, self._cls, name, opts.get("max_task_retries", 0)

@@ -17,6 +17,7 @@ tests multi-node scheduling and failure handling without real machines
 
 from __future__ import annotations
 
+import logging
 import subprocess
 import sys
 import threading
@@ -71,7 +72,8 @@ class _NodeRecord:
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float],
                  transfer: Optional[Tuple[str, int]] = None,
-                 shm_name: Optional[str] = None):
+                 shm_name: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.node_id = node_id
         self.address = tuple(address)
         self.resources = resources
@@ -81,10 +83,20 @@ class _NodeRecord:
         # segment read each other's objects without any transfer).
         self.transfer = tuple(transfer) if transfer else None
         self.shm_name = shm_name
+        # Scheduling labels, e.g. {"ici_slice": "slice-0"}.
+        self.labels = dict(labels or {})
 
 
 class ClusterHead:
-    """GCS-equivalent services hosted in the driver process."""
+    """GCS-equivalent services hosted in the driver process.
+
+    Beyond the node table and object directory this owns the failure
+    story: task *lineage* (creating TaskSpec per return object —
+    reference: `reference_count.h:61` lineage pinning), the in-flight
+    dispatch table, and a proactive health checker (reference:
+    `gcs_health_check_manager.h:39`) that marks dead nodes and triggers
+    re-execution of lost work.
+    """
 
     def __init__(self, worker):
         self.worker = worker
@@ -92,6 +104,18 @@ class ClusterHead:
         self.nodes: Dict[str, _NodeRecord] = {}
         self.object_locations: Dict[bytes, Tuple[str, int]] = {}
         self.actor_nodes: Dict[bytes, str] = {}
+        # Failure/recovery state. lineage maps each task-return object to
+        # its creating spec; inflight maps task_id -> (node_id, spec)
+        # until outputs are reported; actor_specs keeps creation specs for
+        # restart-on-node-death.
+        self.lineage: Dict[bytes, Any] = {}
+        self.inflight: Dict[bytes, Tuple[str, Any]] = {}
+        self.actor_specs: Dict[bytes, Any] = {}
+        self.actor_restarts_left: Dict[bytes, int] = {}
+        self._recon_attempts: Dict[bytes, int] = {}
+        # Placement-group bundle locations: (pg_id_binary, index) ->
+        # node_id, or None for the head itself.
+        self.pg_bundle_nodes: Dict[Tuple[bytes, int], Optional[str]] = {}
         self.server = RpcServer({
             "register_node": self._register_node,
             "report_objects": self._report_objects,
@@ -101,19 +125,206 @@ class ClusterHead:
             "get_nodes": self._get_nodes,
         })
         self.transfer_addr: Optional[Tuple[str, int]] = None
+        self._health_stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- registration / directory ---------------------------------------
 
     def _register_node(self, node_id, address, resources,
-                       transfer=None, shm_name=None):
+                       transfer=None, shm_name=None, labels=None):
         with self._lock:
             self.nodes[node_id] = _NodeRecord(node_id, address, resources,
-                                              transfer, shm_name)
+                                              transfer, shm_name, labels)
+        self._ensure_health_checker()
         return True
 
     def _report_objects(self, oids: List[bytes], address):
         with self._lock:
             for oid in oids:
                 self.object_locations[oid] = tuple(address)
+                self._recon_attempts.pop(oid, None)
+                # Outputs landed: the producing task is no longer in
+                # flight anywhere.
+                oid_obj = ObjectID(oid)
+                self.inflight.pop(oid_obj.task_id().binary(), None)
         return True
+
+    # -- dispatch bookkeeping (called by ClusterBackendMixin) -----------
+
+    def record_lineage(self, spec) -> None:
+        from ray_tpu._private.task_spec import TaskKind
+
+        with self._lock:
+            if spec.kind in (TaskKind.NORMAL_TASK, TaskKind.ACTOR_CREATION):
+                for oid in spec.return_ids:
+                    self.lineage[oid.binary()] = spec
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                key = spec.actor_id.binary()
+                self.actor_specs[key] = spec
+                self.actor_restarts_left.setdefault(
+                    key, getattr(spec, "max_restarts", 0))
+
+    def record_inflight(self, spec, node_id: str) -> None:
+        # All kinds, actor calls included: a node death must *fail* an
+        # in-flight actor call (typed ActorDiedError) rather than leave
+        # its caller hanging on a never-located return object.
+        with self._lock:
+            self.inflight[spec.task_id.binary()] = (node_id, spec)
+
+    # -- health checking -------------------------------------------------
+
+    def _ensure_health_checker(self):
+        from ray_tpu._private.config import ray_config
+
+        with self._lock:
+            if self._health_thread is not None or \
+                    ray_config.health_check_period_s <= 0:
+                return
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True,
+                name="ray_tpu-health-check")
+            self._health_thread.start()
+
+    def _health_loop(self):
+        from ray_tpu._private.config import ray_config
+
+        failures: Dict[str, int] = {}
+        while not self._health_stop.wait(ray_config.health_check_period_s):
+            with self._lock:
+                records = [n for n in self.nodes.values() if n.alive]
+            for record in records:
+                try:
+                    RpcClient.to(record.address).call("ping")
+                    failures[record.node_id] = 0
+                except Exception:
+                    count = failures.get(record.node_id, 0) + 1
+                    failures[record.node_id] = count
+                    if count >= ray_config.health_check_failure_threshold:
+                        self.mark_node_dead(record.node_id,
+                                            reason="health check failed")
+
+    def stop(self):
+        self._health_stop.set()
+
+    # -- node death + recovery -------------------------------------------
+
+    def mark_node_dead(self, node_id: str, reason: str = "") -> None:
+        """Purge the dead node from the directory and re-execute what it
+        held: in-flight tasks are resubmitted, its actors restarted on
+        surviving nodes (within max_restarts), and objects it owned are
+        left to on-demand lineage reconstruction (`_maybe_reconstruct`).
+        Reference: `gcs_node_manager` death flow + `task_manager.h`
+        resubmit + `object_recovery_manager.h:106`.
+        """
+        with self._lock:
+            record = self.nodes.get(node_id)
+            if record is None or not record.alive:
+                return
+            record.alive = False
+            addr = record.address
+            # Objects whose only copy was there are gone.
+            lost = [oid for oid, loc in self.object_locations.items()
+                    if loc == addr]
+            for oid in lost:
+                del self.object_locations[oid]
+            resubmit = [spec for (nid, spec) in self.inflight.values()
+                        if nid == node_id]
+            for spec in resubmit:
+                self.inflight.pop(spec.task_id.binary(), None)
+            dead_actors = [aid for aid, nid in self.actor_nodes.items()
+                           if nid == node_id]
+            # Bundles reserved there are gone; tasks targeting them fail
+            # with PlacementGroupSchedulingError until re-reserved.
+            for key, nid in list(self.pg_bundle_nodes.items()):
+                if nid == node_id:
+                    del self.pg_bundle_nodes[key]
+        logging.getLogger(__name__).warning(
+            "node %s marked dead (%s): %d objects lost, %d tasks in "
+            "flight, %d actors", node_id, reason, len(lost),
+            len(resubmit), len(dead_actors))
+        # Restart actors first so resubmitted / queued actor tasks find a
+        # live location.
+        for aid in dead_actors:
+            self._restart_actor(aid, node_id)
+        for spec in resubmit:
+            if spec.kind == TaskKind.ACTOR_TASK:
+                # Reference semantics: calls in flight on a dying actor
+                # fail (retries are the caller's max_task_retries layer).
+                from ray_tpu.exceptions import ActorDiedError
+
+                for oid in spec.return_ids:
+                    self.worker.memory_store.put(
+                        oid, None, error=ActorDiedError(
+                            spec.actor_id.hex()[:8],
+                            f"its node {node_id} died mid-call"))
+                continue
+            self._resubmit(spec)
+
+    def _restart_actor(self, actor_id: bytes, dead_node: str) -> None:
+        from ray_tpu.exceptions import ActorDiedError
+
+        with self._lock:
+            spec = self.actor_specs.get(actor_id)
+            left = self.actor_restarts_left.get(actor_id, 0)
+            if spec is None or left <= 0:
+                # No restart budget: future calls fail fast.
+                self.actor_nodes.pop(actor_id, None)
+                return
+            self.actor_restarts_left[actor_id] = left - 1
+            self.actor_nodes.pop(actor_id, None)
+        # Re-run the creation spec through the normal scheduler; it
+        # re-registers the actor's node on dispatch.
+        self._resubmit(spec)
+
+    def _resubmit(self, spec) -> None:
+        try:
+            self.worker.backend.submit(spec)
+        except Exception as e:  # pragma: no cover - best effort
+            from ray_tpu import exceptions as exc
+
+            for oid in spec.return_ids:
+                self.worker.memory_store.put(
+                    oid, None, error=exc.TaskError(e, spec.describe()))
+
+    def release_objects(self, oids: List[bytes]) -> None:
+        """Driver refcount hit zero: unpin lineage and tell the owning
+        nodes to drop their copies."""
+        by_addr: Dict[Tuple[str, int], List[bytes]] = {}
+        with self._lock:
+            for oid in oids:
+                self.lineage.pop(oid, None)
+                self._recon_attempts.pop(oid, None)
+                loc = self.object_locations.pop(oid, None)
+                if loc is not None and loc != self.server.address:
+                    by_addr.setdefault(loc, []).append(oid)
+        for addr, batch in by_addr.items():
+            try:
+                RpcClient.to(addr).call("free_objects", oids=batch)
+            except Exception:
+                pass
+
+    def _maybe_reconstruct(self, oid: bytes) -> None:
+        """On-demand lineage reconstruction: if a requested object has no
+        live copy but we know its creating task, re-execute it (bounded
+        by max_reconstruction_attempts)."""
+        from ray_tpu._private.config import ray_config
+
+        if not ray_config.enable_object_reconstruction:
+            return
+        with self._lock:
+            spec = self.lineage.get(oid)
+            if spec is None:
+                return
+            if spec.task_id.binary() in self.inflight:
+                return  # already being re-executed
+            attempts = self._recon_attempts.get(oid, 0)
+            if attempts >= ray_config.max_reconstruction_attempts:
+                return
+            self._recon_attempts[oid] = attempts + 1
+        logging.getLogger(__name__).info(
+            "reconstructing object %s via lineage (attempt %d)",
+            oid.hex()[:12], attempts + 1)
+        self._resubmit(spec)
 
     def _locate(self, oid: bytes):
         """Owner's RPC address, or None. (Legacy callers; see _locate2.)"""
@@ -121,7 +332,9 @@ class ClusterHead:
         return info["address"] if info else None
 
     def _locate2(self, oid: bytes):
-        """Rich location: {"address", "transfer", "shm"} of the owner."""
+        """Rich location: {"address", "transfer", "shm"} of the owner.
+        A miss for an object with known lineage kicks off reconstruction
+        (the caller keeps polling and picks up the re-executed result)."""
         with self._lock:
             loc = self.object_locations.get(oid)
             if loc is not None:
@@ -134,6 +347,7 @@ class ClusterHead:
                 return {"address": loc, "transfer": None, "shm": None}
         if self.worker.memory_store.contains(ObjectID(oid)):
             return self._self_location()
+        self._maybe_reconstruct(oid)
         return None
 
     def _self_location(self):
@@ -185,7 +399,13 @@ class ClusterBackendMixin:
                     self._send(record, spec)
                 except (ConnectionError, OSError) as e:
                     # Transport failure: the node itself is unreachable.
-                    record.alive = False
+                    # mark_node_dead restarts the actor elsewhere if it
+                    # has restart budget; this call still fails (the
+                    # reference fails in-flight calls on a dying actor
+                    # unless max_task_retries covers them — retries are
+                    # the submitter's RemoteFunction layer here).
+                    head.mark_node_dead(node_id,
+                                        reason=f"unreachable: {e}")
                     self._fail_spec(spec, ActorDiedError(
                         actor_desc, f"node {node_id} unreachable: {e}"))
                 except Exception as e:
@@ -196,20 +416,190 @@ class ClusterBackendMixin:
             self._ensure_local_deps(spec)
             self.local_backend.submit(spec)
             return
-        target = self._choose_node(spec)
-        if target is None:
-            # A head-local task may still depend on remote objects.
-            self._ensure_local_deps(spec)
-            self.local_backend.submit(spec)
+        # Strategy-directed routing (reference: the scheduling-policy set
+        # of `scheduling/policy/` — PG-affinity, node-affinity, spread).
+        routed = self._route_by_strategy(spec)
+        if routed is not False:
             return
-        if spec.kind == TaskKind.ACTOR_CREATION:
-            head.actor_nodes[spec.actor_id.binary()] = target.node_id
-        self._send(target, spec)
+        # Normal tasks / actor creations: try nodes until one accepts.
+        attempted: set = set()
+        while True:
+            target = self._choose_node(spec, exclude=attempted)
+            if target is None:
+                from ray_tpu._private.resources import to_milli
+
+                request = to_milli(spec.resources)
+                local_total = to_milli(dict(
+                    self.local_backend.resources.total))
+                if all(local_total.get(k, 0) >= v
+                       for k, v in request.items()):
+                    # A head-local task may still depend on remote objects.
+                    self._ensure_local_deps(spec)
+                    self.local_backend.submit(spec)
+                    return
+                # Too big for the head and no remote capacity *right now*:
+                # queue cluster-wide (the reference raylet queues leases),
+                # failing fast only if no live node could ever fit it.
+                self._queue_for_cluster(spec, request)
+                return
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                head.actor_nodes[spec.actor_id.binary()] = target.node_id
+            try:
+                self._send(target, spec)
+                return
+            except (ConnectionError, OSError) as e:
+                # Not yet in the in-flight table (that happens only after
+                # a successful send), so mark_node_dead won't resubmit
+                # this spec — the loop retries it on another node.
+                attempted.add(target.node_id)
+                head.mark_node_dead(target.node_id,
+                                    reason=f"unreachable: {e}")
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    head.actor_nodes.pop(spec.actor_id.binary(), None)
 
     def _fail_spec(self, spec, error: Exception) -> None:
         store = self.worker.memory_store
         for oid in spec.return_ids:
             store.put(oid, None, error=error)
+
+    def _route_by_strategy(self, spec):
+        """Route a spec per its scheduling strategy. Returns False when
+        the default (hybrid local-first) policy should decide instead."""
+        from ray_tpu._private.task_spec import (
+            NodeAffinitySchedulingStrategy,
+            PlacementGroupSchedulingStrategy,
+            SpreadSchedulingStrategy,
+        )
+        from ray_tpu import exceptions as exc
+
+        strat = spec.scheduling_strategy
+        head = self.head
+
+        if isinstance(strat, PlacementGroupSchedulingStrategy) and \
+                strat.placement_group is not None:
+            pg = strat.placement_group
+            # Resolve the canonical handle (serialized handles may be
+            # detached reconstructions with a stale ready bit).
+            canonical = self.worker.gcs.placement_group_table().get(pg.id)
+            if canonical is not None:
+                pg = canonical
+            pgid = pg.id.binary()
+            idx = strat.placement_group_bundle_index
+            if not pg._ready.is_set():
+                # Reservation still in flight: queue until it commits
+                # (the reference queues PG-targeted leases likewise).
+                def wait_then_submit(spec=spec, pg=pg):
+                    pg._ready.wait(timeout=300)
+                    self.submit(spec)
+
+                threading.Thread(target=wait_then_submit, daemon=True,
+                                 name="ray_tpu-pg-wait").start()
+                return True
+            if pg._failed:
+                self._fail_spec(spec, exc.PlacementGroupSchedulingError(
+                    f"placement group reservation failed: {pg._failed}"))
+                return True
+            entries = {k: v for k, v in head.pg_bundle_nodes.items()
+                       if k[0] == pgid}
+            if not entries:
+                return False  # single-node PG (head-local pools)
+            if idx >= 0:
+                node_id = entries.get((pgid, idx), "__missing__")
+                if node_id == "__missing__":
+                    self._fail_spec(spec, exc.PlacementGroupSchedulingError(
+                        f"bundle {idx} of placement group is not reserved"))
+                    return True
+            else:
+                # Any bundle: prefer one on this (head) node, else first.
+                node_id = None if None in entries.values() else \
+                    next(iter(entries.values()))
+            if node_id is None:
+                self._ensure_local_deps(spec)
+                self.local_backend.submit(spec)
+                return True
+            record = head.nodes.get(node_id)
+            if record is None or not record.alive:
+                self._fail_spec(spec, exc.PlacementGroupSchedulingError(
+                    f"placement group bundle's node {node_id} is dead"))
+                return True
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                head.actor_nodes[spec.actor_id.binary()] = record.node_id
+            try:
+                self._send(record, spec)
+            except (ConnectionError, OSError) as e:
+                head.mark_node_dead(record.node_id,
+                                    reason=f"unreachable: {e}")
+                self._fail_spec(spec, exc.PlacementGroupSchedulingError(
+                    f"placement group bundle's node {node_id} became "
+                    f"unreachable: {e}"))
+            return True
+
+        if isinstance(strat, NodeAffinitySchedulingStrategy) and \
+                strat.node_id is not None:
+            wanted = strat.node_id
+            if isinstance(wanted, bytes):
+                wanted = wanted.decode()
+            record = head.nodes.get(str(wanted))
+            if record is None or not record.alive:
+                if strat.soft:
+                    return False
+                self._fail_spec(spec, RuntimeError(
+                    f"node affinity target {wanted!r} is not available"))
+                return True
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                head.actor_nodes[spec.actor_id.binary()] = record.node_id
+            try:
+                self._send(record, spec)
+            except (ConnectionError, OSError) as e:
+                head.mark_node_dead(record.node_id,
+                                    reason=f"unreachable: {e}")
+                if strat.soft:
+                    return False
+                self._fail_spec(spec, RuntimeError(
+                    f"node affinity target {wanted!r} became unreachable"))
+            return True
+
+        if isinstance(strat, SpreadSchedulingStrategy):
+            # Round-robin over head + alive nodes with capacity
+            # (reference: spread_scheduling_policy.h:27).
+            from ray_tpu._private.resources import to_milli
+
+            request = to_milli(spec.resources)
+            slots: List[Optional[_NodeRecord]] = [None]
+            slots += [n for n in head.nodes.values() if n.alive]
+            for attempt in range(len(slots)):
+                target = slots[(self._rr + attempt) % len(slots)]
+                if target is None:
+                    local = self.local_backend.resources
+                    with local._cond:
+                        fits = all(local._available.get(k, 0) >= v
+                                   for k, v in request.items())
+                    if not fits:
+                        continue
+                    self._rr += attempt + 1
+                    self._ensure_local_deps(spec)
+                    self.local_backend.submit(spec)
+                    return True
+                try:
+                    info = RpcClient.to(target.address).call("ping")
+                except Exception:
+                    continue
+                if all(info["available"].get(k, 0) * 1000 >= v
+                       for k, v in request.items()):
+                    self._rr += attempt + 1
+                    if spec.kind == TaskKind.ACTOR_CREATION:
+                        head.actor_nodes[spec.actor_id.binary()] = \
+                            target.node_id
+                    try:
+                        self._send(target, spec)
+                        return True
+                    except (ConnectionError, OSError) as e:
+                        head.mark_node_dead(target.node_id,
+                                            reason=f"unreachable: {e}")
+                        continue
+            return False  # nothing fits now: fall back to default queueing
+
+        return False
 
     def _ensure_local_deps(self, spec):
         from ray_tpu.object_ref import ObjectRef
@@ -228,7 +618,9 @@ class ClusterBackendMixin:
                 # owner stayed unreachable the whole window, `get` raises
                 # OwnerDiedError instead of hanging. A never-located
                 # object is left pending — its producer may just be slow.
-                deadline = time.monotonic() + 60
+                from ray_tpu._private.config import ray_config
+
+                deadline = time.monotonic() + ray_config.fetch_deadline_s
                 transport_err = None
                 while time.monotonic() < deadline:
                     if store.contains(oid):
@@ -253,12 +645,54 @@ class ClusterBackendMixin:
                 if transport_err is not None and not store.contains(oid):
                     store.put(oid, None, error=OwnerDiedError(
                         oid.hex()[:12],
-                        f"owner of {oid.hex()[:12]} unreachable for 60s: "
+                        f"owner of {oid.hex()[:12]} unreachable past the fetch deadline: "
                         f"{transport_err}"))
 
             threading.Thread(target=fetch, daemon=True).start()
 
-    def _choose_node(self, spec) -> Optional[_NodeRecord]:
+    def _queue_for_cluster(self, spec, request) -> None:
+        """Background retry until some node frees capacity (or none could
+        ever fit). Keeps the head's LocalBackend out of it: its hard
+        infeasibility check is per-node, not cluster-wide."""
+        from ray_tpu._private.resources import to_milli
+        from ray_tpu import exceptions as exc
+
+        def loop():
+            while True:
+                feasible = False
+                for record in self.head.nodes.values():
+                    if not record.alive:
+                        continue
+                    total = to_milli(dict(record.resources))
+                    if all(total.get(k, 0) >= v
+                           for k, v in request.items()):
+                        feasible = True
+                        break
+                if not feasible:
+                    self._fail_spec(spec, exc.RayTpuError(
+                        f"task {spec.describe()} requests {spec.resources} "
+                        f"which no live cluster node can satisfy"))
+                    return
+                target = self._choose_node(spec, exclude=())
+                if target is not None:
+                    if spec.kind == TaskKind.ACTOR_CREATION:
+                        self.head.actor_nodes[spec.actor_id.binary()] = \
+                            target.node_id
+                    try:
+                        self._send(target, spec)
+                        return
+                    except (ConnectionError, OSError) as e:
+                        self.head.mark_node_dead(
+                            target.node_id, reason=f"unreachable: {e}")
+                        if spec.kind == TaskKind.ACTOR_CREATION:
+                            self.head.actor_nodes.pop(
+                                spec.actor_id.binary(), None)
+                time.sleep(0.1)
+
+        threading.Thread(target=loop, daemon=True,
+                         name="ray_tpu-cluster-queue").start()
+
+    def _choose_node(self, spec, exclude=()) -> Optional[_NodeRecord]:
         """Local-first pack; spill to remote capacity when local can't run
         it now (reference hybrid policy shape)."""
         from ray_tpu._private.resources import to_milli
@@ -272,7 +706,8 @@ class ClusterBackendMixin:
                 for k, v in request.items())
         if local_fits_now:
             return None
-        candidates = [n for n in self.head.nodes.values() if n.alive]
+        candidates = [n for n in self.head.nodes.values()
+                      if n.alive and n.node_id not in exclude]
         best, best_avail = None, -1.0
         for node in candidates:
             try:
@@ -299,7 +734,11 @@ class ClusterBackendMixin:
                 local_oids.append(arg.id.binary())
         if local_oids:
             self.head._report_objects(local_oids, self.head.server.address)
+        # Lineage before the wire (resubmittable even if we crash right
+        # after the send); in-flight only on acceptance.
+        self.head.record_lineage(spec)
         RpcClient.to(node.address).call("submit_task", spec=spec)
+        self.head.record_inflight(spec, node.node_id)
 
     # Delegate everything else to the local backend.
 
@@ -328,8 +767,11 @@ class ClusterDriverMixin:
                 fetching.add(key)
 
             def fetch():
+                from ray_tpu._private.config import ray_config
+
                 try:
-                    deadline = time.monotonic() + 60
+                    deadline = time.monotonic() + \
+                        ray_config.fetch_deadline_s
                     transport_err = None
                     while time.monotonic() < deadline:
                         if _try_shm_fetch(worker, ref.id):
@@ -360,7 +802,7 @@ class ClusterDriverMixin:
                         worker.memory_store.put(
                             ref.id, None, error=OwnerDiedError(
                                 ref.id.hex()[:12],
-                                f"owner unreachable for 60s: "
+                                f"owner unreachable past the fetch deadline: "
                                 f"{transport_err}"))
                 finally:
                     with lock:
@@ -380,6 +822,38 @@ class ClusterDriverMixin:
 
         worker.get_objects = get_objects
         worker.wait = wait
+
+        # -- distributed release: when the driver's refcount for an
+        # object hits zero, batch-release it cluster-wide (owner node
+        # drops its copy; lineage unpins). Reference: ReferenceCounter
+        # release → FreeObjects fan-out.
+        import queue as _queue
+
+        release_q: _queue.Queue = _queue.Queue()
+        original_unregister = worker.unregister_object_ref
+
+        def unregister(oid):
+            original_unregister(oid)
+            release_q.put(oid.binary())
+
+        def release_loop():
+            while True:
+                batch = [release_q.get()]
+                time.sleep(0.05)
+                while True:
+                    try:
+                        batch.append(release_q.get_nowait())
+                    except _queue.Empty:
+                        break
+                try:
+                    head.release_objects(batch)
+                except Exception:
+                    pass
+
+        worker.unregister_object_ref = unregister
+        t = threading.Thread(target=release_loop, daemon=True,
+                             name="ray_tpu-release")
+        t.start()
 
 
 class Cluster:
@@ -429,6 +903,7 @@ class Cluster:
 
     def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
                  wait: bool = True, simulate_remote_host: bool = False,
+                 labels: Optional[Dict[str, str]] = None,
                  **_kw) -> str:
         """Spawn a node subprocess. With ``simulate_remote_host`` the node
         gets its own shm segment instead of attaching the head's, so the
@@ -444,6 +919,8 @@ class Cluster:
                "--node-id", node_id]
         if num_tpus:
             cmd += ["--num-tpus", str(num_tpus)]
+        for key, value in (labels or {}).items():
+            cmd += ["--label", f"{key}={value}"]
         if self.shm_plane is not None and not simulate_remote_host:
             cmd += ["--shm-name", self.shm_plane.name]
         env = dict(os.environ)
@@ -490,12 +967,19 @@ class Cluster:
         record = self.head.nodes.get(node_id)
         proc = self._procs.pop(node_id, None)
         if record is not None:
-            record.alive = False
             if graceful:
+                record.alive = False
                 try:
                     RpcClient.to(record.address).call("shutdown")
                 except Exception:
                     pass
+            else:
+                # Ungraceful removal is the fault-injection path (the
+                # reference's NodeKiller): kill first, then run the full
+                # death flow so in-flight work and actors recover.
+                if proc is not None:
+                    proc.kill()
+                self.head.mark_node_dead(node_id, reason="killed")
             self.head.nodes.pop(node_id, None)
         if proc is not None:
             if not graceful:
@@ -505,10 +989,19 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 proc.kill()
 
+    def kill_node(self, node_id: str):
+        """`kill -9` the node process *without* telling the head — death
+        must be discovered by the health checker (chaos-test hook)."""
+        proc = self._procs.get(node_id)
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+
     def nodes(self) -> List[dict]:
         return self.head._get_nodes()
 
     def shutdown(self):
+        self.head.stop()
         for node_id in list(self._procs):
             self.remove_node(node_id)
         self.head.server.shutdown()

@@ -288,8 +288,15 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
         return body(x, lp, positions), None
 
     if cfg.remat:
+        # Save the flash-attention output + logsumexp across the remat
+        # boundary: the backward then recomputes only the cheap projections
+        # (for the q/k/v residuals) and never re-runs the forward attention
+        # kernel. ~37MB/layer at 4x2048 — a large step-time win for a small
+        # slice of HBM.
         scan_body = jax.checkpoint(
-            scan_body, policy=jax.checkpoint_policies.nothing_saveable)
+            scan_body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     x, _ = lax.scan(scan_body, x, params["layers"])
     x = rms_norm_reference(x, params["final_norm"], cfg.norm_eps)
     out_w = params["embed"].T if cfg.tie_embeddings else params["out"]

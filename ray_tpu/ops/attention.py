@@ -1,11 +1,13 @@
-"""Flash attention: tiled online-softmax attention as a Pallas TPU kernel.
+"""Flash attention: tiled online-softmax attention as Pallas TPU kernels.
 
 Forward pass is a Pallas kernel (grid over batch × heads × q-blocks with an
-inner k-block sweep; scores never hit HBM). Backward currently recomputes
-the score matrix in pure JAX under XLA — correct and fusion-friendly, with
-a Pallas backward kernel planned; long-context training memory is instead
-handled one level up by ring attention (`ray_tpu.parallel.ring_attention`),
-which only ever sees per-chunk blocks.
+inner k-block sweep; scores never hit HBM) that also emits the per-row
+logsumexp. Backward is two Pallas kernels recomputing p = exp(s - lse)
+per tile: a dk/dv kernel (grid over k-blocks, inner q sweep) and a dq
+kernel (grid over q-blocks, inner k sweep) — the [Sq, Sk] score matrix
+never materialises in HBM in either direction. Long-context training
+memory is additionally handled one level up by ring attention
+(`ray_tpu.parallel.ring_attention`), which only ever sees per-chunk blocks.
 
 Layout: public API takes [batch, seq, heads, head_dim] (matching the rest
 of the framework); the kernel runs in [batch, heads, seq, head_dim]. GQA is
@@ -20,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 try:  # TPU backend only; absent on pure-CPU installs
@@ -28,6 +31,14 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 _NEG_INF = -1e30
+
+
+def _vmem(shape, dtype):
+    """VMEM scratch on TPU; generic MemoryRef under pure-CPU interpret
+    installs where the TPU pallas plugin is absent (pltpu is None)."""
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(shape, dtype)  # pragma: no cover - no-TPU installs
 
 
 def _on_tpu() -> bool:
@@ -42,7 +53,8 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_ref, l_ref, acc_ref, *,
                       sm_scale: float, causal: bool,
                       block_q: int, block_k: int, sk: int):
     iq = pl.program_id(2)
@@ -65,23 +77,27 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     # elided when shapes divide evenly.
     pad_cols = sk % block_k != 0
 
-    @pl.when(should_compute)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)      # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)      # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)      # [bk, d]
+    def compute(apply_mask):
+        # Matmul inputs stay in their storage dtype (bf16 on the training
+        # path) with float32 accumulation — an f32 upcast before the dot
+        # would push the MXU onto its much slower fp32 path. sm_scale is
+        # folded into the [bq, d] q tile instead of being spent as a full
+        # [bq, bk] pass over the score matrix.
+        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)  # [bq, d]
+        k = k_ref[0, 0]                          # [bk, d]
+        v = v_ref[0, 0]                          # [bk, d]
         if pad_cols:
             # Padded K/V rows hold undefined memory; a masked p of exactly
             # 0 still yields NaN from 0 * NaN in p @ v — zero them.
             kv_rows = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, v.shape[-1]), 0)
-            v = jnp.where(kv_rows < sk, v, 0.0)
+            v = jnp.where(kv_rows < sk, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale                              # [bq, bk]
+        )                                         # [bq, bk]
         mask = None
-        if causal or pad_cols:
+        if apply_mask:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
@@ -100,29 +116,63 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_next = jnp.maximum(m_prev, m_cur)                 # [bq, 128]
         p = jnp.exp(s - m_next[:, :1])                      # [bq, bk]
         if mask is not None:
+            # Also covers fully-masked rows (m = -inf would give p = 1).
             p = jnp.where(mask, p, 0.0)
         correction = jnp.exp(m_prev[:, :1] - m_next[:, :1])  # [bq, 1]
         l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:] = m_next
+        # p in the storage dtype for the PV matmul (FlashAttention-standard;
+        # keeps the MXU on its fast path), accumulate in f32.
         acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    if not causal and not pad_cols:
+        pl.when(should_compute)(lambda: compute(False))
+    elif not causal:
+        pl.when(should_compute)(lambda: compute(True))
+    else:
+        # The kernel is VPU-bound, so mask arithmetic is a real cost:
+        # only blocks intersecting the diagonal (or the ragged tail) pay
+        # for the iota/compare/select passes; blocks fully below the
+        # diagonal — most of the sweep for long sequences — skip them.
+        needs_mask = iq * block_q < (ik + 1) * block_k - 1
+        if pad_cols:
+            needs_mask = needs_mask | (ik == nk - 1)
+        pl.when(should_compute & needs_mask)(lambda: compute(True))
+        pl.when(should_compute & jnp.logical_not(needs_mask))(
+            lambda: compute(False))
+
     @pl.when(ik == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l[:, :1]).astype(o_ref.dtype)
+        # Per-row logsumexp (lane-broadcast), consumed by the backward
+        # kernels to recompute p = exp(s - lse) per tile.
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
                block_q: int, block_k: int, interpret: bool):
-    """q: [B, H, S, D]; k/v: [B, Hkv, Sk, D] (already transposed)."""
+    """q: [B, H, S, D]; k/v: [B, Hkv, Sk, D] (already transposed).
+
+    Returns ``(o, lse)`` where ``lse`` is the per-row logsumexp with shape
+    ``[B, H, Sq]`` (float32), needed by the Pallas backward.
+    """
     b, h, sq, d = q.shape
     _, h_kv, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     grid = (b, h, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+
+    def kv_index(ib, ih, iq, ik):
+        if causal:
+            # Blocks strictly above the diagonal are skipped by the kernel;
+            # clamp their fetch index to the diagonal block so the pipeline
+            # doesn't stream K/V tiles that are never read.
+            ik = jnp.minimum(ik, ((iq + 1) * block_q - 1) // block_k)
+        return (ib, ih * h_kv // h, ik, 0)
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -134,42 +184,345 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         )
-    scratch = [
-        jax.ShapeDtypeStruct((block_q, 128), jnp.float32),  # m
-        jax.ShapeDtypeStruct((block_q, 128), jnp.float32),  # l
-        jax.ShapeDtypeStruct((block_q, d), jnp.float32),    # acc
+    scratch_shapes = [
+        _vmem((block_q, 128), jnp.float32),  # m
+        _vmem((block_q, 128), jnp.float32),  # l
+        _vmem((block_q, d), jnp.float32),    # acc
     ]
-    if pltpu is not None:
-        scratch_shapes = [
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ]
-    else:  # pragma: no cover - CPU interpret path without TPU plugin
-        scratch_shapes = [pl.MemoryRef(s.shape, s.dtype) for s in scratch]
 
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda ib, ih, iq, ik: (ib, ih * h_kv // h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda ib, ih, iq, ik: (ib, ih * h_kv // h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
+        ],
         scratch_shapes=scratch_shapes,
         interpret=interpret,
         **kwargs,
     )(q, k, v)
+    return o, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
-# Reference math (also the backward pass, via recomputation)
+# Backward kernels
+#
+# Standard flash backward (reference design: the FlashAttention-2 paper's
+# tiling; no code shared with any framework): with lse saved from the
+# forward and delta = rowsum(do * o) precomputed,
+#   p  = exp(s - lse)          s = scale * q @ k^T
+#   dv = p^T @ do
+#   dp = do @ v^T
+#   ds = p * (dp - delta) * scale
+#   dk = ds^T @ q
+#   dq = ds @ k
+# Split into two kernels so every output is written by exactly one grid
+# lane: dk/dv (grid over k-blocks, inner q sweep) and dq (grid over
+# q-blocks, inner k sweep).
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          sm_scale: float, causal: bool,
+                          block_q: int, block_k: int, sq: int, sk: int):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should_compute = True
+    if causal:
+        should_compute = (iq + 1) * block_q > ik * block_k
+
+    pad_rows = sq % block_q != 0
+
+    def compute(apply_mask):
+        # Storage-dtype matmul inputs, f32 accumulation; sm_scale folded
+        # into the q tile (dk = ds^T @ (scale*q) is the exact gradient —
+        # see the math above).
+        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)   # [bq, d]
+        k = k_ref[0, 0]                            # [bk, d]
+        v = v_ref[0, 0]                            # [bk, d]
+        do = do_ref[0, 0]                          # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                 # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]             # [bq, 1]
+        if apply_mask and pad_rows:
+            # Ragged last q-block: padded rows hold undefined memory and
+            # would pollute the dk/dv column sums — zero their inputs.
+            q_rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, q.shape[-1]), 0)
+            q = jnp.where(q_rows < sq, q, jnp.zeros_like(q))
+            do = jnp.where(q_rows < sq, do, jnp.zeros_like(do))
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                          # [bq, bk]
+        p = jnp.exp(s - lse)
+
+        mask = None
+        if apply_mask:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.full((block_q, block_k), True)
+            if causal:
+                cols = ik * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                mask &= rows >= cols
+            if pad_rows:
+                mask &= rows < sq
+            p = jnp.where(mask, p, 0.0)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                          # [bq, bk]
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+
+        # dv += p^T @ do ; dk += ds^T @ q  (contract over the q rows)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # Masking is needed only on diagonal-intersecting blocks and (for a
+    # ragged sq) the last q block; padded k columns are column-separable
+    # here — their garbage lands in dk/dv rows that are sliced off.
+    if not causal and not pad_rows:
+        pl.when(should_compute)(lambda: compute(False))
+    else:
+        needs_mask = False
+        if causal:
+            needs_mask = iq * block_q < (ik + 1) * block_k - 1
+        if pad_rows:
+            needs_mask = needs_mask | (iq == nq - 1)
+        pl.when(should_compute & needs_mask)(lambda: compute(True))
+        pl.when(should_compute & jnp.logical_not(needs_mask))(
+            lambda: compute(False))
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *,
+                         sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, sk: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    should_compute = True
+    if causal:
+        should_compute = (iq + 1) * block_q > ik * block_k
+
+    pad_cols = sk % block_k != 0
+
+    def compute(apply_mask):
+        # Storage-dtype matmul inputs, f32 accumulation; sm_scale folded
+        # into the q tile, un-applied to dq in _finalize.
+        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)   # [bq, d]
+        k = k_ref[0, 0]                            # [bk, d]
+        v = v_ref[0, 0]                            # [bk, d]
+        do = do_ref[0, 0]                          # [bq, d]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        if apply_mask and pad_cols:
+            # Padded K/V rows hold undefined memory; dq = ds @ k mixes k
+            # rows into every dq element, so zero them (ds is masked to 0
+            # there, but 0 * NaN would still poison the product).
+            kv_rows = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, k.shape[-1]), 0)
+            k = jnp.where(kv_rows < sk, k, jnp.zeros_like(k))
+            v = jnp.where(kv_rows < sk, v, jnp.zeros_like(v))
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jnp.exp(s - lse)
+
+        mask = None
+        if apply_mask:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if causal and pad_cols:
+                mask = (rows >= cols) & (cols < sk)
+            elif causal:
+                mask = rows >= cols
+            else:
+                mask = cols < sk
+            p = jnp.where(mask, p, 0.0)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
+
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if not causal and not pad_cols:
+        pl.when(should_compute)(lambda: compute(False))
+    else:
+        needs_mask = False
+        if causal:
+            needs_mask = iq * block_q < (ik + 1) * block_k - 1
+        if pad_cols:
+            needs_mask = needs_mask | (ik == nk - 1)
+        pl.when(should_compute & needs_mask)(lambda: compute(True))
+        pl.when(should_compute & jnp.logical_not(needs_mask))(
+            lambda: compute(False))
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = (dq_acc[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, interpret: bool):
+    """All tensors [B, H(kv), S, D]; lse [B, H, Sq] float32."""
+    b, h, sq, d = q.shape
+    _, h_kv, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse4 = jnp.broadcast_to(lse[..., None], (b, h, sq, 128))
+    delta4 = jnp.broadcast_to(delta[..., None], (b, h, sq, 128))
+
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        )
+
+    def kv_index(ib, ih, iq, ik):
+        if causal:
+            ik = jnp.minimum(ik, ((iq + 1) * block_q - 1) // block_k)
+        return (ib, ih * h_kv // h, ik, 0)
+
+    def q_index(ib, ih, iq, ik):
+        return (ib, ih, iq, 0)
+
+    def lane_index(ib, ih, iq, ik):
+        return (ib, ih, iq, 0)
+
+    # --- dq: grid over q-blocks, inner sweep over k-blocks -----------------
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, sk=sk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, block_q, 128), lane_index),
+            pl.BlockSpec((1, 1, block_q, 128), lane_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_index),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, do, lse4, delta4)
+
+    # --- dk/dv: grid over k-blocks, inner sweep over q-blocks --------------
+    # For causal masks the head of the q sweep is skipped; clamp the fetch
+    # index up to the first contributing q-block.
+    def q_index_dkv(ib, ih, ik, iq):
+        if causal:
+            iq = jnp.maximum(iq, (ik * block_k) // block_q)
+        return (ib, ih, iq, 0)
+
+    def lane_index_dkv(ib, ih, ik, iq):
+        if causal:
+            iq = jnp.maximum(iq, (ik * block_k) // block_q)
+        return (ib, ih, iq, 0)
+
+    def kv_index_dkv(ib, ih, ik, iq):
+        return (ib, ih * h_kv // h, ik, 0)
+
+    def dkv_out_index(ib, ih, ik, iq):
+        return (ib, ih, ik, 0)
+
+    # dk/dv are produced per *query* head (float32) and group-reduced to the
+    # kv heads afterwards — no KV replication in HBM on the way in.
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, sq=sq, sk=sk),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index_dkv),
+            pl.BlockSpec((1, 1, block_k, d), kv_index_dkv),
+            pl.BlockSpec((1, 1, block_k, d), kv_index_dkv),
+            pl.BlockSpec((1, 1, block_q, d), q_index_dkv),
+            pl.BlockSpec((1, 1, block_q, 128), lane_index_dkv),
+            pl.BlockSpec((1, 1, block_q, 128), lane_index_dkv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), dkv_out_index),
+            pl.BlockSpec((1, 1, block_k, d), dkv_out_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_k, d), jnp.float32),
+            _vmem((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, do, lse4, delta4)
+
+    if h_kv != h:
+        rep = h // h_kv
+        dk = dk.reshape(b, h_kv, rep, sk, d).sum(axis=2)
+        dv = dv.reshape(b, h_kv, rep, sk, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Reference math (used on non-TPU backends and as the test oracle)
 # ---------------------------------------------------------------------------
 
 
@@ -194,23 +547,32 @@ def _attention_reference(q, k, v, causal: bool, sm_scale: float):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return o, (q, k, v)
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                        interpret)
+    # Under layer-level rematerialization, saving these two residuals (and
+    # recomputing only the cheap projections for q/k/v) lets the remat
+    # policy elide the forward kernel from the backward pass entirely:
+    # jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse").
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    # Optionally saveable (policy decides): skips the qkv-projection +
+    # rope recompute in the backward at ~50MB/layer for typical configs.
+    q = checkpoint_name(q, "flash_q")
+    k = checkpoint_name(k, "flash_k")
+    v = checkpoint_name(v, "flash_v")
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret,
                    residuals, do):
-    q, k, v = residuals
-
-    def ref(q, k, v):
-        return _attention_reference(q, k, v, causal, sm_scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(do)
+    q, k, v, o, lse = residuals
+    return _flash_bwd(q, k, v, o, lse, do, causal, sm_scale,
+                      block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -23,7 +23,7 @@ from ray_tpu._private.task_spec import (
 _TASK_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "num_returns",
     "max_retries", "retry_exceptions", "name", "scheduling_strategy",
-    "runtime_env", "_metadata",
+    "runtime_env", "_metadata", "isolate_process",
 }
 
 
@@ -80,6 +80,7 @@ class RemoteFunction:
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
+            isolate_process=bool(opts.get("isolate_process", False)),
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
         )
         refs = w.submit(spec)

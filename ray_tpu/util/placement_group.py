@@ -67,25 +67,38 @@ class PlacementGroup:
         return len(self.bundle_specs)
 
     def __reduce__(self):
-        # Handles are pass-by-reference through the object store: the
-        # in-process registry resolves by id.
-        return (_lookup_pg, (self.id,))
+        # Handles are pass-by-reference: the receiving process resolves
+        # by id from its registry, or (on a worker node that never saw
+        # the creation) reconstructs a detached handle — the id and
+        # bundle shape are all task routing needs.
+        return (_lookup_pg, (self.id, self.bundle_specs, self.strategy,
+                             self.name))
 
 
-def _lookup_pg(pg_id):
+def _lookup_pg(pg_id, bundles=None, strategy="PACK", name=""):
     w = worker_mod.global_worker()
     table = w.gcs.placement_group_table()
     pg = table.get(pg_id)
     if pg is None:
-        raise exc.PlacementGroupSchedulingError(
-            f"placement group {pg_id} not found")
+        if bundles is None:
+            raise exc.PlacementGroupSchedulingError(
+                f"placement group {pg_id} not found")
+        pg = PlacementGroup(pg_id, bundles, strategy, name)
+        pg._ready.set()
     return pg
 
 
 def placement_group(bundles: List[Dict[str, float]], *,
                     strategy: str = "PACK", name: str = "",
-                    lifetime: Optional[str] = None) -> PlacementGroup:
-    """Reserve bundles. Reference: `util/placement_group.py:33`."""
+                    lifetime: Optional[str] = None,
+                    ici_slice: Optional[str] = None) -> PlacementGroup:
+    """Reserve bundles. Reference: `util/placement_group.py:33`.
+
+    ``ici_slice`` (TPU extension): constrain every bundle to nodes of one
+    contiguous ICI slice — a specific slice by label value, or ``"auto"``
+    to let the scheduler pick any single slice whose nodes fit the group.
+    Nodes advertise their slice via the ``ici_slice`` node label.
+    """
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles:
@@ -96,8 +109,17 @@ def placement_group(bundles: List[Dict[str, float]], *,
     w = worker_mod.global_worker()
     pg = PlacementGroup(PlacementGroupID.from_random(), bundles, strategy,
                         name)
+    pg.ici_slice = ici_slice
     w.gcs.register_placement_group(pg)
     backend = w.backend
+
+    # Cluster mode: multi-node reservation through the head (2PC).
+    head = getattr(w, "cluster_head", None)
+    if head is not None and getattr(head, "nodes", None):
+        threading.Thread(
+            target=_cluster_reserve, args=(w, head, pg),
+            kwargs={"ici_slice": ici_slice}, daemon=True).start()
+        return pg
 
     # Single-node reservation: all bundles land on this node. STRICT_SPREAD
     # demands distinct nodes, which a single-node cluster cannot satisfy
@@ -158,9 +180,300 @@ def _commit(backend, pg: PlacementGroup, bundles):
     pg._ready.set()
 
 
+# ---------------------------------------------------------------------------
+# Cluster-mode reservation: 2PC prepare/commit across nodes.
+# Reference: `gcs_placement_group_scheduler.h` (PreparePgBundles →
+# CommitPgBundles, ReturnPgBundles on abort) with the PACK / SPREAD /
+# STRICT_* placement policies of `bundle_scheduling_policy.h:82-109`.
+# ---------------------------------------------------------------------------
+
+
+class _Candidate:
+    """A placement target: the head's local backend or a remote node."""
+
+    def __init__(self, node_id, available_milli, labels):
+        self.node_id = node_id          # None = the head itself
+        self.avail = dict(available_milli)
+        self.labels = labels or {}
+
+    def fits(self, req) -> bool:
+        return all(self.avail.get(k, 0) >= v for k, v in req.items())
+
+    def take(self, req) -> None:
+        for k, v in req.items():
+            self.avail[k] = self.avail.get(k, 0) - v
+
+
+def _candidates(w, head) -> List[_Candidate]:
+    from ray_tpu._private.rpc import RpcClient
+
+    out = []
+    local = w.backend.resources
+    with local._cond:
+        avail = dict(local._available)
+    out.append(_Candidate(None, avail, {}))
+    for record in list(head.nodes.values()):
+        if not record.alive:
+            continue
+        try:
+            info = RpcClient.to(record.address).call("ping")
+        except Exception:
+            continue
+        milli = {k: int(v * 1000) for k, v in info["available"].items()}
+        out.append(_Candidate(record.node_id, milli,
+                              info.get("labels") or record.labels))
+    return out
+
+
+def _plan_bundles(candidates: List[_Candidate], milli: List[Dict[str, int]],
+                  strategy: str) -> Optional[List[_Candidate]]:
+    """Assign each bundle a candidate (simulated greedily on copies of
+    the availability vectors); None if the strategy can't be satisfied."""
+    if strategy == "STRICT_PACK":
+        for cand in sorted(candidates, key=lambda c: -sum(c.avail.values())):
+            trial = _Candidate(cand.node_id, cand.avail, cand.labels)
+            if all(_take_if_fits(trial, req) for req in milli):
+                return [cand] * len(milli)
+        return None
+    if strategy == "STRICT_SPREAD":
+        if len(candidates) < len(milli):
+            return None
+        # Place the largest bundles first (greedy on distinct nodes is
+        # only correct in decreasing-size order).
+        order_b = sorted(range(len(milli)),
+                         key=lambda i: -sum(milli[i].values()))
+        chosen_by_idx: Dict[int, _Candidate] = {}
+        used = set()
+        for i in order_b:
+            req = milli[i]
+            picked = None
+            for cand in sorted(candidates,
+                               key=lambda c: -sum(c.avail.values())):
+                if id(cand) in used or not cand.fits(req):
+                    continue
+                picked = cand
+                break
+            if picked is None:
+                return None
+            used.add(id(picked))
+            chosen_by_idx[i] = picked
+        return [chosen_by_idx[i] for i in range(len(milli))]
+    # PACK: minimize node count — greedy first-fit onto already-used
+    # nodes, opening a new one only when needed. SPREAD: round-robin
+    # best-effort distinct.
+    sims = [_Candidate(c.node_id, c.avail, c.labels) for c in candidates]
+    by_sim = dict(zip(map(id, sims), candidates))
+    chosen = []
+    used: List[int] = []
+    order = sorted(range(len(sims)),
+                   key=lambda i: -sum(sims[i].avail.values()))
+    rr = 0
+    for req in milli:
+        picked = None
+        if strategy == "PACK":
+            for idx in used:
+                if sims[idx].fits(req):
+                    picked = idx
+                    break
+            if picked is None:
+                for idx in order:
+                    if sims[idx].fits(req):
+                        picked = idx
+                        break
+        else:  # SPREAD
+            for attempt in range(len(sims)):
+                idx = order[(rr + attempt) % len(order)]
+                if sims[idx].fits(req):
+                    picked = idx
+                    rr = (order.index(idx) + 1) % len(order)
+                    break
+        if picked is None:
+            return None
+        sims[picked].take(req)
+        if picked not in used:
+            used.append(picked)
+        chosen.append(by_sim[id(sims[picked])])
+    return chosen
+
+
+def _take_if_fits(cand: _Candidate, req) -> bool:
+    if not cand.fits(req):
+        return False
+    cand.take(req)
+    return True
+
+
+def _cluster_reserve(w, head, pg: PlacementGroup,
+                     ici_slice: Optional[str] = None,
+                     timeout: float = 300.0) -> None:
+    from ray_tpu._private.rpc import RpcClient
+
+    bundles = pg.bundle_specs
+    milli = [to_milli(b) for b in bundles]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        candidates = _candidates(w, head)
+        # ICI-slice gang constraint: restrict to one slice's nodes.
+        if ici_slice is not None:
+            groups: Dict[str, List[_Candidate]] = {}
+            for c in candidates:
+                label = c.labels.get("ici_slice")
+                if label is not None:
+                    groups.setdefault(label, []).append(c)
+            if ici_slice != "auto":
+                groups = {ici_slice: groups.get(ici_slice, [])}
+            plan = None
+            for label in sorted(
+                    groups, key=lambda g: -sum(sum(c.avail.values())
+                                               for c in groups[g])):
+                plan = _plan_bundles(groups[label], milli, pg.strategy)
+                if plan is not None:
+                    break
+        else:
+            plan = _plan_bundles(candidates, milli, pg.strategy)
+        if plan is None:
+            if pg.strategy in ("STRICT_PACK", "STRICT_SPREAD") and \
+                    not _could_ever_fit(w, head, pg, milli, ici_slice):
+                pg._failed = (
+                    f"{pg.strategy} placement group cannot be satisfied "
+                    f"by the current cluster")
+                pg._ready.set()
+                return
+            time.sleep(0.2)
+            continue
+
+        # Phase 1: prepare everywhere.
+        prepared: List[int] = []
+        ok = True
+        for i, (cand, req) in enumerate(zip(plan, milli)):
+            if cand.node_id is None:
+                got = w.backend.resources.try_acquire(req)
+            else:
+                record = head.nodes.get(cand.node_id)
+                try:
+                    got = record is not None and RpcClient.to(
+                        record.address).call(
+                        "prepare_bundle", pg_id=pg.id.binary(),
+                        index=i, request=req)
+                except Exception:
+                    got = False
+            if got:
+                prepared.append(i)
+            else:
+                ok = False
+                break
+        if not ok:
+            # Abort: return everything prepared, then retry.
+            for i in prepared:
+                cand = plan[i]
+                if cand.node_id is None:
+                    w.backend.resources.release(milli[i])
+                else:
+                    record = head.nodes.get(cand.node_id)
+                    if record is not None:
+                        try:
+                            RpcClient.to(record.address).call(
+                                "return_bundle", pg_id=pg.id.binary(),
+                                index=i)
+                        except Exception:
+                            pass
+            time.sleep(0.1)
+            continue
+
+        # Phase 2: commit. A commit failure (node died between prepare
+        # and commit) aborts the whole round: tear down everything placed
+        # so far — committed bundles included — and retry from scratch,
+        # never recording a bundle the node doesn't actually hold.
+        committed = []
+        commit_ok = True
+        for i, (cand, bundle) in enumerate(zip(plan, bundles)):
+            if cand.node_id is None:
+                w.backend.bundle_resources[(pg.id, i)] = ResourceSet(bundle)
+                committed.append(i)
+                continue
+            record = head.nodes.get(cand.node_id)
+            try:
+                if record is None or not RpcClient.to(record.address).call(
+                        "commit_bundle", pg_id=pg.id.binary(), index=i,
+                        bundle=bundle):
+                    commit_ok = False
+                    break
+                committed.append(i)
+            except Exception:
+                commit_ok = False
+                break
+        if not commit_ok:
+            for i in range(len(plan)):
+                cand = plan[i]
+                if cand.node_id is None:
+                    # Head-local: phase 1 acquired the resources whether
+                    # or not phase 2 created the pool yet — drop the pool
+                    # if present and give the resources back either way.
+                    w.backend.bundle_resources.pop((pg.id, i), None)
+                    w.backend.resources.release(milli[i])
+                else:
+                    record = head.nodes.get(cand.node_id)
+                    if record is not None:
+                        try:
+                            RpcClient.to(record.address).call(
+                                "return_bundle", pg_id=pg.id.binary(),
+                                index=i)
+                        except Exception:
+                            pass
+            time.sleep(0.2)
+            continue
+        for i, cand in enumerate(plan):
+            head.pg_bundle_nodes[(pg.id.binary(), i)] = cand.node_id
+        pg.bundle_nodes = [c.node_id for c in plan]
+        pg._ready.set()
+        return
+    pg._failed = "placement group reservation timed out"
+    pg._ready.set()
+
+
+def _could_ever_fit(w, head, pg, milli, ici_slice) -> bool:
+    """Feasibility against *total* cluster capacity (ignoring current
+    usage): if even empty nodes couldn't host it, fail fast."""
+    from ray_tpu._private.resources import to_milli as _tm
+
+    totals = [_Candidate(None, _tm(dict(w.backend.resources.total)), {})]
+    for record in head.nodes.values():
+        if record.alive:
+            totals.append(_Candidate(
+                record.node_id, _tm(dict(record.resources)), record.labels))
+    if ici_slice is not None:
+        if ici_slice == "auto":
+            slices = {c.labels.get("ici_slice")
+                      for c in totals} - {None}
+            return any(_plan_bundles(
+                [c for c in totals if c.labels.get("ici_slice") == s],
+                milli, pg.strategy) is not None for s in slices)
+        totals = [c for c in totals
+                  if c.labels.get("ici_slice") == ici_slice]
+    return _plan_bundles(totals, milli, pg.strategy) is not None
+
+
 def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu._private.rpc import RpcClient
+
     w = worker_mod.global_worker()
     backend = w.backend
+    # Cluster-held bundles: tell each owning node to return its bundle.
+    head = getattr(w, "cluster_head", None)
+    if head is not None:
+        for (pgid, i), node_id in list(head.pg_bundle_nodes.items()):
+            if pgid != pg.id.binary():
+                continue
+            head.pg_bundle_nodes.pop((pgid, i), None)
+            if node_id is None:
+                continue  # head-local: released via bundle_resources below
+            record = head.nodes.get(node_id)
+            if record is not None and record.alive:
+                try:
+                    RpcClient.to(record.address).call(
+                        "return_bundle", pg_id=pgid, index=i)
+                except Exception:
+                    pass
     released: Dict[str, int] = {}
     for (gid, i) in list(backend.bundle_resources):
         if gid == pg.id:

@@ -1,0 +1,169 @@
+"""Cross-node placement groups (2PC prepare/commit) + scheduling policies.
+
+Reference models: `gcs_placement_group_scheduler.h` (2PC),
+`bundle_scheduling_policy.h:82-109` (PACK/SPREAD/STRICT_*),
+`scheduling/policy/spread_scheduling_policy.h:27`,
+`node_affinity_scheduling_policy.h:29`, and the repo's TPU extension:
+`ici_slice` node labels gating gang placement to one contiguous slice.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 1})
+    yield c
+    c.shutdown()
+
+
+def test_strict_spread_across_three_nodes(cluster):
+    """Three 2-CPU bundles cannot share nodes: head + 2 nodes each take
+    exactly one, and tasks pinned to distinct bundles run in distinct
+    processes."""
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    # head has 1 CPU; give it room for one 1-CPU bundle
+    pg = placement_group([{"CPU": 1}, {"CPU": 2}, {"CPU": 2}],
+                         strategy="STRICT_SPREAD")
+    assert pg.wait(timeout=60)
+    nodes = pg.bundle_nodes
+    assert len(set(nodes)) == 3, f"bundles share nodes: {nodes}"
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return os.getpid()
+
+    pids = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(3)], timeout=60)
+    assert len(set(pids)) == 3
+    remove_placement_group(pg)
+
+
+def test_strict_pack_lands_on_one_node(cluster):
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(timeout=60)
+    assert len(set(pg.bundle_nodes)) == 1
+
+    @ray_tpu.remote(num_cpus=2)
+    def where():
+        return os.getpid()
+
+    pids = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)], timeout=60)
+    assert pids[0] == pids[1]
+    remove_placement_group(pg)
+
+
+def test_strict_pack_infeasible_fails_fast(cluster):
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 8}, {"CPU": 8}], strategy="STRICT_PACK")
+    with pytest.raises(Exception):
+        pg.wait(timeout=30)
+
+
+def test_pack_reserves_and_frees(cluster):
+    """PACK across nodes; removing the group returns capacity."""
+    node = cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 2}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout=60)
+    remove_placement_group(pg)
+    # After release the node's full capacity is available again.
+    from ray_tpu._private.rpc import RpcClient
+
+    record = cluster.head.nodes[node]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        info = RpcClient.to(record.address).call("ping")
+        if info["available"].get("CPU", 0) == 2:
+            return
+        time.sleep(0.1)
+    raise AssertionError("bundle resources were not returned")
+
+
+def test_spread_strategy_round_robins(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        time.sleep(0.2)
+        return os.getpid()
+
+    refs = [where.options(
+        scheduling_strategy=SpreadSchedulingStrategy()).remote()
+        for _ in range(4)]
+    pids = set(ray_tpu.get(refs, timeout=60))
+    assert len(pids) >= 2, f"spread used only one process: {pids}"
+
+
+def test_node_affinity_strategy(cluster):
+    node1 = cluster.add_node(num_cpus=2)
+    node2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return os.getpid()
+
+    pid1 = ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node1)).remote(), timeout=60)
+    pid2 = ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node2)).remote(), timeout=60)
+    assert pid1 != pid2
+    # Same node again → same process.
+    assert pid1 == ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node1)).remote(), timeout=60)
+
+    # Hard affinity to a missing node fails; soft falls back.
+    with pytest.raises(Exception):
+        ray_tpu.get(where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id="node-999")).remote(), timeout=30)
+    assert ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id="node-999", soft=True)).remote(), timeout=30)
+
+
+def test_ici_slice_gang_placement(cluster):
+    """ici_slice="auto" must place every bundle within ONE slice's nodes
+    even when capacity exists across slices — the contiguous-slice gang
+    constraint (SURVEY.md §7 step 4)."""
+    a1 = cluster.add_node(num_cpus=2, labels={"ici_slice": "slice-a"})
+    a2 = cluster.add_node(num_cpus=2, labels={"ici_slice": "slice-a"})
+    b1 = cluster.add_node(num_cpus=2, labels={"ici_slice": "slice-b"})
+    assert b1
+
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK",
+                         ici_slice="auto")
+    assert pg.wait(timeout=60)
+    assert set(pg.bundle_nodes) <= {a1, a2}, pg.bundle_nodes
+    remove_placement_group(pg)
+
+    # Pinning to a named slice that cannot fit the group fails fast.
+    pg_bad = placement_group([{"CPU": 2}, {"CPU": 2}],
+                             strategy="STRICT_SPREAD", ici_slice="slice-b")
+    with pytest.raises(Exception):
+        pg_bad.wait(timeout=30)

@@ -1,0 +1,157 @@
+"""Worker process isolation: a crashing task/actor cannot take down the
+node. Reference: raylet WorkerPool (`src/ray/raylet/worker_pool.h:156`) —
+forked workers execute tasks; worker death is a task failure, not a node
+failure.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import WorkerCrashedError
+
+
+@pytest.fixture
+def ray_local():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_isolated_task_runs_out_of_process(ray_local):
+    @ray_tpu.remote(isolate_process=True)
+    def whoami():
+        return os.getpid()
+
+    pid = ray_tpu.get(whoami.remote(), timeout=60)
+    assert pid != os.getpid()
+
+
+def test_isolated_task_crash_is_task_error_not_node_death(ray_local):
+    @ray_tpu.remote(isolate_process=True, max_retries=0)
+    def die():
+        os._exit(1)
+
+    @ray_tpu.remote
+    def alive():
+        return "still here"
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(die.remote(), timeout=60)
+    assert "worker process" in str(ei.value).lower() or \
+        isinstance(ei.value, WorkerCrashedError)
+    # The node survived: plain tasks still run.
+    assert ray_tpu.get(alive.remote(), timeout=60) == "still here"
+    # And so do further isolated tasks (the pool replaced the worker).
+    @ray_tpu.remote(isolate_process=True)
+    def ok():
+        return 7
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 7
+
+
+def test_isolated_task_roundtrips_numpy(ray_local):
+    @ray_tpu.remote(isolate_process=True)
+    def make(n):
+        return np.arange(n, dtype=np.float32)
+
+    out = ray_tpu.get(make.remote(4096), timeout=60)
+    assert out.shape == (4096,) and out[-1] == 4095.0
+
+
+def test_isolated_task_exception_propagates(ray_local):
+    @ray_tpu.remote(isolate_process=True, max_retries=0)
+    def boom():
+        raise ValueError("inner detail")
+
+    with pytest.raises(ValueError, match="inner detail"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_isolated_actor_state_and_crash_restart(ray_local):
+    @ray_tpu.remote(isolate_process=True, max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+        def crash(self):
+            os._exit(1)
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+    pid1 = ray_tpu.get(c.pid.remote(), timeout=60)
+    assert pid1 != os.getpid()
+
+    with pytest.raises(Exception):
+        ray_tpu.get(c.crash.remote(), timeout=60)
+
+    # Restarted in a fresh process with fresh state.
+    def restarted():
+        try:
+            return ray_tpu.get(c.incr.remote(), timeout=5) == 1
+        except Exception:
+            return False
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if restarted():
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("actor did not restart after worker crash")
+    pid2 = ray_tpu.get(c.pid.remote(), timeout=60)
+    assert pid2 != pid1
+
+
+def test_isolated_actor_without_budget_dies(ray_local):
+    @ray_tpu.remote(isolate_process=True)
+    class A:
+        def crash(self):
+            os._exit(1)
+
+        def f(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.f.remote(), timeout=60) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(a.crash.remote(), timeout=60)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.f.remote(), timeout=30)
+
+
+def test_isolation_in_cluster_node_survives(tmp_path):
+    """A crashing isolated task on a cluster node leaves the node alive."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        node = cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=2, isolate_process=True, max_retries=0)
+        def die():
+            os._exit(1)
+
+        @ray_tpu.remote(num_cpus=2)
+        def where():
+            return os.getpid()
+
+        with pytest.raises(Exception):
+            ray_tpu.get(die.remote(), timeout=60)
+        assert cluster.head.nodes[node].alive
+        pid = ray_tpu.get(where.remote(), timeout=60)
+        assert pid != os.getpid()  # node still executing work
+    finally:
+        cluster.shutdown()

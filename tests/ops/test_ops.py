@@ -74,6 +74,38 @@ def test_flash_attention_grad():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [
+    (1, 64, 64, 4, 2, 16),     # GQA, even blocks
+    (2, 96, 96, 4, 4, 16),     # ragged q and k blocks (96 % 32 != 0 w/ 64)
+    (1, 100, 100, 2, 2, 16),   # ragged both
+])
+def test_flash_attention_grad_pallas_bwd(causal, shape):
+    """Pallas dq/dk/dv kernels vs reference autodiff, incl. GQA + ragged."""
+    b, sq, sk, h, h_kv, d = shape
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = jax.random.normal(keys[0], (b, sq, h, d))
+    k = jax.random.normal(keys[1], (b, sk, h_kv, d))
+    v = jax.random.normal(keys[2], (b, sk, h_kv, d))
+    do = jax.random.normal(keys[3], (b, sq, h, d))
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=64,
+                               block_k=64, interpret=True)
+
+    def ref(q, k, v):
+        return _attention_reference(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal, d ** -0.5,
+        ).transpose(0, 2, 1, 3)
+
+    _, vjp1 = jax.vjp(flash, q, k, v)
+    _, vjp2 = jax.vjp(ref, q, k, v)
+    for a, b_ in zip(vjp1(do), vjp2(do)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_rms_norm_pallas_matches_reference():
     x = jax.random.normal(jax.random.PRNGKey(5), (4, 96, 256), jnp.float32)
     w = jax.random.normal(jax.random.PRNGKey(6), (256,)) * 0.1 + 1.0
