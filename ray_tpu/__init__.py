@@ -7,6 +7,15 @@ get / put / wait`` + actors + placement groups) while the ML layers
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# pyarrow's bundled jemalloc/mimalloc pool segfaults under this runtime's
+# thread pattern (task threads building tables concurrently with consumer
+# threads converting them — reproducibly crashed in combine_chunks). The
+# glibc allocator is safe; must be set before the first pyarrow import
+# anywhere in the process.
+_os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.worker import (  # noqa: F401
     cancel,

@@ -297,12 +297,52 @@ class NodeRuntime:
 
     # -- lifecycle -------------------------------------------------------
 
+    def _resource_report_loop(self):
+        """Push the availability view to the head (reference:
+        ray_syncer.h RESOURCE_VIEW deltas). Doubles as a heartbeat; only
+        deltas are sent (an unchanged view is skipped, with a periodic
+        keepalive so the head's freshness window stays warm)."""
+        from ray_tpu._private.config import ray_config
+
+        last_sent = None
+        last_time = 0.0
+        while not self._shutdown_event.wait(
+                max(ray_config.resource_report_period_s, 0.01)):
+            view = dict(self.worker.backend.resources.available)
+            keepalive = time.monotonic() - last_time > \
+                ray_config.resource_report_period_s * \
+                (ray_config.resource_report_fresh_periods / 2)
+            if view == last_sent and not keepalive:
+                continue
+            try:
+                ok = self.head.call("report_resources",
+                                    node_id=self.node_id,
+                                    available=view, labels=self.labels)
+                last_sent = view
+                last_time = time.monotonic()
+                if ok is False:
+                    # Head lost us (restart?): re-register.
+                    plane = getattr(self.worker, "shm_plane", None)
+                    self.head.call(
+                        "register_node", node_id=self.node_id,
+                        address=self.address,
+                        resources=dict(
+                            self.worker.backend.resources.total),
+                        transfer=self.transfer_addr,
+                        shm_name=plane.name if plane else None,
+                        labels=self.labels)
+            except Exception:
+                pass
+
     def serve_forever(self):
         """Serve until shutdown — or until the head stays unreachable
         past the health window (a dead head orphans the node; exiting
         mirrors the reference raylet's GCS-disconnect suicide)."""
         from ray_tpu._private.config import ray_config
 
+        reporter = threading.Thread(target=self._resource_report_loop,
+                                    daemon=True, name="resource-report")
+        reporter.start()
         misses = 0
         try:
             while not self._shutdown_event.wait(

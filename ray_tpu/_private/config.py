@@ -51,6 +51,14 @@ class RayTpuConfig:
     rpc_connect_retries: int = 10
     rpc_retry_backoff_s: float = 0.5
 
+    # -- resource view sync (reference: ray_syncer.h RESOURCE_VIEW) ------
+    # Nodes push availability deltas to the head at this period; the
+    # scheduler reads the cached view instead of pinging per submission.
+    resource_report_period_s: float = 0.1
+    # A pushed report also counts as a heartbeat: nodes reporting within
+    # this many periods are skipped by the active health checker.
+    resource_report_fresh_periods: float = 5.0
+
     # -- scheduling ------------------------------------------------------
     # Pack below this node-utilization fraction, then prefer spreading
     # (reference: scheduler_spread_threshold, hybrid_scheduling_policy.h).
@@ -59,6 +67,10 @@ class RayTpuConfig:
     # -- memory monitor / worker killing (reference: memory_monitor.h) ---
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_ms: int = 250
+
+    # -- GCS storage (reference: store_client/; "" = in-memory, a file
+    #    path selects the durable SQLite backend in Redis's role) -------
+    gcs_storage_path: str = ""
 
     def reset(self) -> None:
         for f in dataclasses.fields(self):

@@ -1,0 +1,139 @@
+"""Pluggable GCS table storage: in-memory by default, SQLite for
+persistence across head restarts.
+
+Role-equivalent to the reference's GCS store clients —
+`src/ray/gcs/store_client/in_memory_store_client.h:31` (default) and
+`redis_store_client.h:28` (the fault-tolerance backend) behind the
+`GcsTableStorage` facade (`gcs_server/gcs_table_storage.h`). SQLite plays
+Redis's durability role here: single-file, transactional, in the standard
+library — the right "external store" for a single-head deployment (a real
+Redis client would drop in behind the same ABC).
+
+Select via ``ray_tpu.init(_system_config={"gcs_storage_path": ...})`` or
+the ``RAY_TPU_GCS_STORAGE_PATH`` env var; empty means in-memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class StoreClient:
+    """Typed-table KV: (table, key) -> bytes."""
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_all(self, table: str) -> List[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: bytes) -> None:
+        raise NotImplementedError
+
+    def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStoreClient(StoreClient):
+    """Reference: `in_memory_store_client.h:31`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tables: Dict[str, Dict[bytes, bytes]] = {}
+
+    def _table(self, table: str) -> Dict[bytes, bytes]:
+        t = self._tables.get(table)
+        if t is None:
+            t = self._tables[table] = {}
+        return t
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._table(table)[key] = value
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._table(table).get(key)
+
+    def get_all(self, table: str) -> List[Tuple[bytes, bytes]]:
+        with self._lock:
+            return list(self._table(table).items())
+
+    def delete(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._table(table).pop(key, None)
+
+    def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._table(table) if k.startswith(prefix)]
+
+
+class SqliteStoreClient(StoreClient):
+    """Durable backend (the reference's Redis role,
+    `redis_store_client.h:28`): state survives head-process restarts."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            " tbl TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (tbl, key))")
+        # WAL: concurrent readers during writes, and a crash mid-write
+        # never corrupts committed state.
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (tbl, key, value) VALUES (?, ?, ?)"
+                " ON CONFLICT(tbl, key) DO UPDATE SET value=excluded.value",
+                (table, key, value))
+            self._conn.commit()
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE tbl=? AND key=?",
+                (table, key)).fetchone()
+        return row[0] if row else None
+
+    def get_all(self, table: str) -> List[Tuple[bytes, bytes]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM kv WHERE tbl=?", (table,)).fetchall()
+        return [(bytes(k), bytes(v)) for k, v in rows]
+
+    def delete(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE tbl=? AND key=?",
+                               (table, key))
+            self._conn.commit()
+
+    def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
+        return [k for k, _ in self.get_all(table) if k.startswith(prefix)]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def make_store_client() -> StoreClient:
+    """Backend selection from the config table."""
+    from ray_tpu._private.config import ray_config
+
+    path = getattr(ray_config, "gcs_storage_path", "")
+    if path:
+        return SqliteStoreClient(path)
+    return InMemoryStoreClient()

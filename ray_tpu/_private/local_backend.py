@@ -86,9 +86,12 @@ class _Actor:
         spec = self.spec
         try:
             if spec.isolate_process:
-                # The instance lives in a dedicated forked worker; the
-                # node only holds the command socket.
-                self._proc = self.backend.worker_pool.dedicated()
+                # The instance lives in a dedicated worker process; the
+                # node only holds the command socket. "spawn" execs a
+                # fresh interpreter (pristine process globals — needed
+                # for jax.distributed ranks); True forks.
+                self._proc = self.backend.worker_pool.dedicated(
+                    spawn=spec.isolate_process == "spawn", meta=spec)
                 self._proc.request(("init", spec.func, spec.args,
                                     spec.kwargs, spec.runtime_env))
             else:
@@ -182,9 +185,14 @@ class LocalBackend:
     @property
     def worker_pool(self):
         if self._worker_pool is None:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
             from ray_tpu._private.worker_pool import WorkerPool
 
             self._worker_pool = WorkerPool()
+            # Worker killing under memory pressure only makes sense once
+            # killable (process-isolated) work exists.
+            self._memory_monitor = MemoryMonitor(self)
+            self._memory_monitor.start()
         return self._worker_pool
 
     # ------------------------------------------------------------------
@@ -355,10 +363,12 @@ class LocalBackend:
 
             args, kwargs = self.worker.resolve_args(spec)
             if spec.isolate_process:
-                # Crash isolation: run in a forked worker so an os._exit /
-                # segfault fails this task, not the node.
-                result = self.worker_pool.run(spec.func, args, kwargs,
-                                              spec.runtime_env)
+                # Crash isolation: run in a worker process so an
+                # os._exit / segfault fails this task, not the node.
+                # "spawn" = one-shot fresh interpreter.
+                result = self.worker_pool.run(
+                    spec.func, args, kwargs, spec.runtime_env,
+                    spawn=spec.isolate_process == "spawn", meta=spec)
             else:
                 with applied_runtime_env(spec.runtime_env):
                     result = spec.func(*args, **kwargs)
@@ -445,7 +455,7 @@ class LocalBackend:
             actor.num_restarts < spec.max_restarts
         drained = actor.stop(f"worker process crashed: {cause}")
         if actor._proc is not None:
-            actor._proc.kill()
+            self.worker_pool.release_dedicated(actor._proc)
             actor._proc = None
         if can_restart:
             pool = getattr(actor, "_held_pool", None)
@@ -469,7 +479,7 @@ class LocalBackend:
 
     def _on_actor_death(self, actor: _Actor, error: BaseException):
         if actor._proc is not None:
-            actor._proc.kill()
+            self.worker_pool.release_dedicated(actor._proc)
             actor._proc = None
         # Idempotent: release lifetime resources exactly once.
         pool = getattr(actor, "_held_pool", None)
@@ -500,7 +510,7 @@ class LocalBackend:
             or actor.num_restarts < spec.max_restarts)
         drained = actor.stop("killed via kill()")
         if actor._proc is not None:
-            actor._proc.kill()
+            self.worker_pool.release_dedicated(actor._proc)
             actor._proc = None
         if can_restart:
             # Reference semantics (`gcs_actor_manager.h` restart FSM):
@@ -574,8 +584,13 @@ class LocalBackend:
         for actor in list(self._actors.values()):
             actor.stop("node shutdown")
             if actor._proc is not None:
-                actor._proc.kill()
+                if self._worker_pool is not None:
+                    self._worker_pool.release_dedicated(actor._proc)
+                else:
+                    actor._proc.kill()
                 actor._proc = None
+        if getattr(self, "_memory_monitor", None) is not None:
+            self._memory_monitor.stop()
         if self._worker_pool is not None:
             self._worker_pool.shutdown()
         self._dispatcher.join(timeout=1.0)

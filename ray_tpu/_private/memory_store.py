@@ -28,15 +28,23 @@ class _Entry:
     callbacks: list = field(default_factory=list)
     # number of ObjectRef handles alive in this process (best-effort GC)
     local_refs: int = 0
+    # spilling bookkeeping: estimated in-memory size; disk URL once the
+    # value has been spilled (value is then None until restored)
+    size: int = 0
+    last_access: float = 0.0
+    spilled_url: Optional[str] = None
 
 
 class MemoryStore:
-    def __init__(self):
+    def __init__(self, spill_manager=None):
         # RLock: ObjectRef.__del__ can fire from GC while this process holds
         # the lock (allocation inside _entry triggers collection), re-entering
         # remove_local_ref on the same thread.
         self._lock = threading.RLock()
         self._entries: dict[ObjectID, _Entry] = {}
+        # Optional SpillManager (ray_tpu._private.spilling): set by the
+        # worker when an object-store budget is configured.
+        self.spill_manager = spill_manager
 
     def _entry(self, object_id: ObjectID) -> _Entry:
         entry = self._entries.get(object_id)
@@ -47,6 +55,7 @@ class MemoryStore:
 
     def put(self, object_id: ObjectID, value: Any,
             error: Optional[BaseException] = None) -> None:
+        manager = self.spill_manager
         with self._lock:
             entry = self._entry(object_id)
             if entry.ready:
@@ -54,11 +63,19 @@ class MemoryStore:
             entry.value = value
             entry.error = error
             entry.ready = True
+            entry.last_access = time.monotonic()
+            if manager is not None and error is None:
+                from ray_tpu._private.spilling import estimate_size
+
+                entry.size = estimate_size(value)
+                manager.note_put(entry.size)
             callbacks = entry.callbacks
             entry.callbacks = []
         entry.event.set()
         for cb in callbacks:
             cb(object_id)
+        if manager is not None and manager.over_threshold():
+            manager.maybe_spill()
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -82,17 +99,56 @@ class MemoryStore:
             raise GetTimeoutError(
                 f"get() timed out after {timeout}s waiting for {object_id}"
             )
-        if entry.error is not None:
-            raise entry.error
-        return entry.value
+        # Snapshot value+url together under the lock: a concurrent
+        # spiller setting value=None between two bare reads must not be
+        # observable as a silent None result.
+        with self._lock:
+            error, value, url = entry.error, entry.value, entry.spilled_url
+            entry.last_access = time.monotonic()
+        if error is not None:
+            raise error
+        if url is not None and value is None:
+            return self._restore(object_id, entry, url)
+        return value
 
     def peek(self, object_id: ObjectID):
-        """Return (ready, value, error) without blocking."""
+        """Return (ready, value, error) without blocking (except a
+        transparent disk restore for spilled values)."""
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None or not entry.ready:
                 return False, None, None
-            return True, entry.value, entry.error
+            error, value, url = entry.error, entry.value, entry.spilled_url
+            entry.last_access = time.monotonic()
+        if error is None and url is not None and value is None:
+            return True, self._restore(object_id, entry, url), None
+        return True, value, error
+
+    def _restore(self, object_id: ObjectID, entry: _Entry, url: str):
+        """Load a spilled value back (reference: restore IO worker path,
+        `external_storage.py` restore_spilled_objects). Uses the caller's
+        snapshotted url — a concurrent free()/evict() may clear the entry
+        and delete the file, which must surface as the entry's error (or
+        a typed loss), never a raw file error."""
+        try:
+            value = self.spill_manager.restore(url)
+        except OSError:
+            with self._lock:
+                error = entry.error
+            if error is not None:
+                raise error
+            raise ObjectLostError(
+                object_id.hex(),
+                f"spilled copy of {object_id} disappeared (released "
+                f"concurrently?)")
+        with self._lock:
+            if entry.error is not None:
+                raise entry.error
+            if entry.value is None:
+                entry.value = value
+                entry.last_access = time.monotonic()
+                self.spill_manager.note_put(entry.size)
+            return entry.value
 
     def wait(self, object_ids: list[ObjectID], num_returns: int,
              timeout: Optional[float]) -> tuple[list[ObjectID], list[ObjectID]]:
@@ -129,6 +185,46 @@ class MemoryStore:
         not_ready = [oid for oid in object_ids if oid not in ready_out]
         return ready, not_ready
 
+    # -- spilling hooks (called by SpillManager) --------------------------
+
+    def spill_candidates(self):
+        """Cold→hot list of (oid, value, size, existing_url) eligible to
+        spill: ready, no error, value resident, big enough."""
+        from ray_tpu._private.config import ray_config
+
+        with self._lock:
+            out = [
+                (e.last_access, oid, e.value, e.size, e.spilled_url)
+                for oid, e in self._entries.items()
+                if e.ready and e.error is None and e.value is not None
+                and e.size >= ray_config.min_spilling_size_bytes
+            ]
+        # last_access captured under the lock: entries may be deleted
+        # concurrently, and the sort must not reach back into the dict.
+        out.sort(key=lambda item: item[0])
+        return [(oid, value, size, url)
+                for _, oid, value, size, url in out]
+
+    def mark_spilled(self, object_id: ObjectID, url: str) -> bool:
+        """Drop the in-memory value, keeping the disk URL. Returns False
+        if the entry disappeared (released meanwhile)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready or entry.value is None:
+                return False
+            entry.value = None
+            entry.spilled_url = url
+            return True
+
+    def _drop_entry_locked(self, entry: _Entry) -> Optional[str]:
+        """Common release path: account the dropped bytes, hand back any
+        spill URL for deletion."""
+        manager = self.spill_manager
+        if manager is not None and entry.ready and entry.error is None \
+                and entry.value is not None:
+            manager.note_drop(entry.size)
+        return entry.spilled_url
+
     # -- local reference counting (process-lifetime GC) ------------------
 
     def add_local_ref(self, object_id: ObjectID) -> None:
@@ -136,29 +232,47 @@ class MemoryStore:
             self._entry(object_id).local_refs += 1
 
     def remove_local_ref(self, object_id: ObjectID) -> None:
+        url = None
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
                 return
             entry.local_refs -= 1
             if entry.local_refs <= 0 and entry.ready:
+                url = self._drop_entry_locked(entry)
                 del self._entries[object_id]
+        if url is not None and self.spill_manager is not None:
+            self.spill_manager.delete([url])
 
     def evict(self, object_ids: list[ObjectID]) -> None:
         """Drop local copies entirely (unlike `free`, which poisons the
         entry): a later get blocks until the object is re-fetched or
         reconstructed. Used by the cluster cache and spilling."""
+        urls = []
         with self._lock:
             for oid in object_ids:
-                self._entries.pop(oid, None)
+                entry = self._entries.pop(oid, None)
+                if entry is not None:
+                    url = self._drop_entry_locked(entry)
+                    if url is not None:
+                        urls.append(url)
+        if urls and self.spill_manager is not None:
+            self.spill_manager.delete(urls)
 
     def free(self, object_ids: list[ObjectID]) -> None:
+        urls = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
                 if entry is not None and entry.ready:
+                    url = self._drop_entry_locked(entry)
+                    if url is not None:
+                        urls.append(url)
+                        entry.spilled_url = None
                     entry.value = None
                     entry.error = ObjectLostError(oid.hex(), f"object {oid} was freed")
+        if urls and self.spill_manager is not None:
+            self.spill_manager.delete(urls)
 
     def num_objects(self) -> int:
         with self._lock:

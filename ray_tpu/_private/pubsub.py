@@ -1,0 +1,115 @@
+"""Long-poll pub/sub: the control-plane event channel.
+
+Role-equivalent to the reference's `src/ray/pubsub/` — a Publisher buffers
+messages per channel; Subscribers long-poll with a cursor and get every
+message published since (`publisher.h:188-216` is the same
+buffer+long-poll shape). Used for node lifecycle events (NODE_ADDED /
+NODE_DEAD), with channels open to any producer (the dashboard and state
+API read the same stream).
+
+Messages are (seq, payload) tuples; a bounded ring per channel means a
+subscriber that sleeps too long misses old messages (it can resync from
+authoritative state — same contract as the reference's pubsub, which is
+a cache, not a log).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_RING = 1024
+
+
+class _Channel:
+    def __init__(self):
+        self.seq = 0
+        self.buffer: List[Tuple[int, Any]] = []
+        self.cond = threading.Condition()
+
+    def publish(self, payload: Any) -> int:
+        with self.cond:
+            self.seq += 1
+            self.buffer.append((self.seq, payload))
+            if len(self.buffer) > _RING:
+                del self.buffer[: len(self.buffer) - _RING]
+            self.cond.notify_all()
+            return self.seq
+
+    def poll(self, cursor: int, timeout: float) -> Tuple[int, List[Any]]:
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while True:
+                newer = [(s, p) for (s, p) in self.buffer if s > cursor]
+                if newer:
+                    return newer[-1][0], [p for _, p in newer]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return cursor, []
+                self.cond.wait(remaining)
+
+
+class Publisher:
+    """Server side: per-channel buffers, long-poll handler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._channels: Dict[str, _Channel] = {}
+
+    def _channel(self, name: str) -> _Channel:
+        with self._lock:
+            ch = self._channels.get(name)
+            if ch is None:
+                ch = self._channels[name] = _Channel()
+            return ch
+
+    def publish(self, channel: str, payload: Any) -> int:
+        return self._channel(channel).publish(payload)
+
+    def poll(self, channel: str, subscriber_id: str, cursor: int,
+             timeout: float = 10.0) -> Dict[str, Any]:
+        new_cursor, messages = self._channel(channel).poll(cursor, timeout)
+        return {"cursor": new_cursor, "messages": messages}
+
+
+class Subscriber:
+    """Client side: a background long-poll loop per channel delivering to
+    a callback. `rpc_call(channel, subscriber_id, cursor, timeout)` is the
+    transport hook — in cluster mode bind it to a *dedicated* client
+    (``RpcClient.dedicated(addr)``): the pooled per-address client
+    serializes calls on one socket, and a long poll parked there would
+    head-of-line block every other RPC to that address."""
+
+    def __init__(self, rpc_call, subscriber_id: str):
+        self._rpc_call = rpc_call
+        self.subscriber_id = subscriber_id
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def subscribe(self, channel: str, callback) -> None:
+        def loop():
+            cursor = 0
+            while not self._stop.is_set():
+                try:
+                    reply = self._rpc_call(
+                        channel=channel, subscriber_id=self.subscriber_id,
+                        cursor=cursor, timeout=5.0)
+                except Exception:
+                    if self._stop.wait(0.5):
+                        return
+                    continue
+                cursor = reply["cursor"]
+                for message in reply["messages"]:
+                    try:
+                        callback(message)
+                    except Exception:  # subscriber bugs don't kill the loop
+                        pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"pubsub-{channel}")
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()

@@ -210,6 +210,14 @@ class RpcClient:
                 cls._pools[key] = client
             return client
 
+    @classmethod
+    def dedicated(cls, address) -> "RpcClient":
+        """A non-pooled client with its own connection. Required for
+        long-poll calls (pubsub subscribe): the pooled client serializes
+        calls on one socket, so a 10s poll would head-of-line block every
+        other RPC this process sends to the same address."""
+        return cls(tuple(address))
+
     def _ensure(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self.address, timeout=30)

@@ -17,12 +17,22 @@ from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 
 class GlobalState:
     def __init__(self, worker):
+        from ray_tpu._private.gcs_storage import make_store_client
+
         self._worker = worker
         self._lock = threading.Lock()
         # (namespace, name) -> actor handle info
         self._named_actors: Dict[tuple, Any] = {}
         self._kv: Dict[tuple, bytes] = {}
         self._placement_groups: Dict[PlacementGroupID, Any] = {}
+        # Pluggable table storage (reference: gcs_table_storage.h over
+        # store_client/): in-memory by default; with a configured
+        # gcs_storage_path the KV table is durable — a restarted head
+        # reloads it (the reference's Redis-backed GCS FT story).
+        self._store = make_store_client()
+        for key, value in self._store.get_all("kv"):
+            ns, _, k = key.partition(b"\x00")
+            self._kv[(ns, k)] = value
 
     # -- named actors ----------------------------------------------------
 
@@ -70,6 +80,7 @@ class GlobalState:
             if not overwrite and k in self._kv:
                 return False
             self._kv[k] = value
+            self._store.put("kv", k[0] + b"\x00" + k[1], value)
             return True
 
     def kv_get(self, key: bytes, namespace: Optional[bytes] = None) -> Optional[bytes]:
@@ -77,8 +88,10 @@ class GlobalState:
             return self._kv.get((namespace or b"", key))
 
     def kv_del(self, key: bytes, namespace: Optional[bytes] = None) -> None:
+        k = (namespace or b"", key)
         with self._lock:
-            self._kv.pop((namespace or b"", key), None)
+            self._kv.pop(k, None)
+            self._store.delete("kv", k[0] + b"\x00" + k[1])
 
     def kv_keys(self, prefix: bytes, namespace: Optional[bytes] = None) -> list:
         ns = namespace or b""

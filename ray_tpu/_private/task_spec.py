@@ -53,6 +53,16 @@ class PlacementGroupSchedulingStrategy(SchedulingStrategy):
     placement_group_capture_child_tasks: bool = False
 
 
+def check_isolate_process(value):
+    """isolate_process accepts False (in-thread), True (forked worker),
+    or "spawn" (fresh interpreter); anything else is a typo that would
+    otherwise silently fork."""
+    if value not in (False, True, "spawn"):
+        raise ValueError(
+            f"isolate_process must be False, True, or 'spawn', got {value!r}")
+    return value
+
+
 @dataclass
 class TaskSpec:
     task_id: TaskID
@@ -84,9 +94,11 @@ class TaskSpec:
     # Runtime env (recorded; applied by the worker pool when it launches
     # dedicated workers for the env)
     runtime_env: Optional[dict] = None
-    # Execute in a forked worker process (crash isolation) instead of a
-    # thread of the node process. Reference: raylet worker_pool.h:156.
-    isolate_process: bool = False
+    # Execute in a separate worker process (crash isolation) instead of
+    # a thread of the node process: False (in-thread), True (forked), or
+    # "spawn" (fresh interpreter — for workloads needing pristine
+    # process-global state). Reference: raylet worker_pool.h:156.
+    isolate_process: Any = False
     # Return object IDs, precomputed by the submitter (owner)
     return_ids: list = field(default_factory=list)
     # Depth for scheduling fairness / detection of recursive deadlock

@@ -56,6 +56,13 @@ class Worker:
         self.job_id = JobID.from_random()
         self.namespace = namespace or f"ns-{self.job_id.hex()}"
         self.memory_store = MemoryStore()
+        # Disk spilling under memory pressure (reference:
+        # local_object_manager.h:41 + external_storage.py). The manager
+        # object is cheap; its spill directory is only created on the
+        # first actual spill. Budget/thresholds live in the config table.
+        from ray_tpu._private.spilling import SpillManager
+
+        self.memory_store.spill_manager = SpillManager(self.memory_store)
         self.task_context = _TaskContext()
         from ray_tpu._private.task_events import TaskEventBuffer
 
@@ -188,6 +195,9 @@ class Worker:
 
     def shutdown(self):
         self.backend.shutdown()
+        manager = self.memory_store.spill_manager
+        if manager is not None:
+            manager.storage.destroy()
 
 
 # ----------------------------------------------------------------------

@@ -26,6 +26,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, List, Optional
 
 import cloudpickle
@@ -97,20 +98,43 @@ def _worker_main(sock: socket.socket) -> None:
 
 
 class WorkerProcess:
-    """One forked worker and its command socket."""
+    """One worker process and its command socket.
 
-    def __init__(self):
+    ``spawn=False`` (default) forks — cheap, shares the parent's warm
+    imports. ``spawn=True`` execs a fresh interpreter — required when the
+    worker must own pristine process-global state (e.g. a JAX
+    ``jax.distributed`` rank: forked children inherit the parent's
+    already-initialized XLA runtime, which cannot be re-wired)."""
+
+    def __init__(self, spawn: bool = False):
         parent_sock, child_sock = socket.socketpair()
-        pid = os.fork()
-        if pid == 0:
-            # Child: drop the parent's end, serve, never return.
-            parent_sock.close()
-            try:
-                _worker_main(child_sock)
-            finally:  # pragma: no cover - belt and braces
-                os._exit(0)
-        child_sock.close()
-        self.pid = pid
+        if spawn:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env["PYTHONPATH"] = os.pathsep.join(
+                [repo_root] + [p for p in sys.path if p])
+            env["RAY_TPU_WORKER_FD"] = str(child_sock.fileno())
+            self._popen = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_pool"],
+                pass_fds=[child_sock.fileno()], env=env)
+            child_sock.close()
+            self.pid = self._popen.pid
+        else:
+            self._popen = None
+            pid = os.fork()
+            if pid == 0:
+                # Child: drop the parent's end, serve, never return.
+                parent_sock.close()
+                try:
+                    _worker_main(child_sock)
+                finally:  # pragma: no cover - belt and braces
+                    os._exit(0)
+            child_sock.close()
+            self.pid = pid
         self.sock = parent_sock
         self.alive = True
         # One in-flight request at a time: the frame protocol has no
@@ -148,6 +172,9 @@ class WorkerProcess:
         self._reap()
 
     def _reap(self) -> None:
+        if self._popen is not None:
+            self._popen.wait()
+            return
         try:
             os.waitpid(self.pid, 0)
         except ChildProcessError:
@@ -163,23 +190,56 @@ class WorkerPool:
         self._lock = threading.Lock()
         self._max_idle = max_idle
         self._closed = False
+        # pid -> (proc, task_spec, start_time) for work currently
+        # executing: the memory monitor's kill-policy input
+        # (reference: the raylet's worker registry).
+        self.active: dict = {}
 
-    def run(self, fn, args, kwargs, runtime_env=None) -> Any:
-        """Execute fn in a pooled worker process. Raises the task's own
-        exception on user error, WorkerCrashedError if the process died."""
-        worker = self._checkout()
+    def run(self, fn, args, kwargs, runtime_env=None,
+            spawn: bool = False, meta=None) -> Any:
+        """Execute fn in a worker process. Raises the task's own
+        exception on user error, WorkerCrashedError if the process died
+        (or was OOM-killed by the memory monitor). ``spawn=True`` uses a
+        one-shot fresh interpreter (never pooled — pristine process
+        globals are the whole point). ``meta`` (the TaskSpec) feeds the
+        worker-killing policy."""
+        worker = WorkerProcess(spawn=True) if spawn else self._checkout()
+        with self._lock:
+            self.active[worker.pid] = (worker, meta, time.time())
         try:
             result = worker.request(("call", fn, args, kwargs, runtime_env))
         except BaseException:
-            if worker.alive:
+            # Deregister BEFORE check-in: once the worker is back in the
+            # pool another task may claim it and register the same pid.
+            with self._lock:
+                self.active.pop(worker.pid, None)
+            if spawn:
+                worker.kill()
+            elif worker.alive:
                 self._checkin(worker)
             raise
-        self._checkin(worker)
+        with self._lock:
+            self.active.pop(worker.pid, None)
+        if spawn:
+            worker.kill()
+        else:
+            self._checkin(worker)
         return result
 
-    def dedicated(self) -> WorkerProcess:
-        """A worker owned by the caller (isolated actors); never pooled."""
-        return WorkerProcess()
+    def dedicated(self, spawn: bool = False, meta=None) -> WorkerProcess:
+        """A worker owned by the caller (isolated actors); never pooled
+        but registered in `active` so the memory-pressure kill policy can
+        see it (an OOM'd isolated actor dies and restarts via
+        max_restarts instead of the kernel killing the node)."""
+        worker = WorkerProcess(spawn=spawn)
+        with self._lock:
+            self.active[worker.pid] = (worker, meta, time.time())
+        return worker
+
+    def release_dedicated(self, worker: WorkerProcess) -> None:
+        with self._lock:
+            self.active.pop(worker.pid, None)
+        worker.kill()
 
     def _checkout(self) -> WorkerProcess:
         with self._lock:
@@ -201,3 +261,9 @@ class WorkerPool:
             idle, self._idle = self._idle, []
         for worker in idle:
             worker.kill()
+
+
+if __name__ == "__main__":
+    # Spawned-worker entry: serve the command socket handed down via fd.
+    _fd = int(os.environ["RAY_TPU_WORKER_FD"])
+    _worker_main(socket.socket(fileno=_fd))

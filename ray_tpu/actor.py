@@ -14,6 +14,7 @@ from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
+    check_isolate_process,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
@@ -166,7 +167,7 @@ class ActorClass:
             max_pending_calls=opts.get("max_pending_calls", -1),
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
-            isolate_process=bool(opts.get("isolate_process", False)),
+            isolate_process=check_isolate_process(opts.get("isolate_process", False)),
         )
         handle = ActorHandle(
             actor_id, self._cls, name, opts.get("max_task_retries", 0)

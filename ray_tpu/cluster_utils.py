@@ -85,6 +85,11 @@ class _NodeRecord:
         self.shm_name = shm_name
         # Scheduling labels, e.g. {"ici_slice": "slice-0"}.
         self.labels = dict(labels or {})
+        # Pushed resource view (reference: ray_syncer RESOURCE_VIEW
+        # deltas): refreshed by report_resources; the scheduler reads
+        # this instead of pinging the node per submission.
+        self.available: Dict[str, float] = dict(resources)
+        self.last_report: float = time.monotonic()
 
 
 class ClusterHead:
@@ -119,11 +124,18 @@ class ClusterHead:
         self.server = RpcServer({
             "register_node": self._register_node,
             "report_objects": self._report_objects,
+            "report_resources": self._report_resources,
             "locate": self._locate,
             "locate2": self._locate2,
             "get_object": self._get_object,
             "get_nodes": self._get_nodes,
+            "subscribe": self._subscribe,
         })
+        # Long-poll pubsub channels (reference: pubsub/publisher.h:302);
+        # node lifecycle events publish here.
+        from ray_tpu._private.pubsub import Publisher
+
+        self.publisher = Publisher()
         self.transfer_addr: Optional[Tuple[str, int]] = None
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
@@ -135,8 +147,33 @@ class ClusterHead:
         with self._lock:
             self.nodes[node_id] = _NodeRecord(node_id, address, resources,
                                               transfer, shm_name, labels)
+        self.publisher.publish("node_events", {
+            "event": "NODE_ADDED", "node_id": node_id,
+            "address": tuple(address)})
         self._ensure_health_checker()
         return True
+
+    def _report_resources(self, node_id: str, available, total=None,
+                          labels=None):
+        """Pushed resource-view delta (reference: ray_syncer.h:86). Also
+        treated as a liveness heartbeat by the health checker."""
+        with self._lock:
+            record = self.nodes.get(node_id)
+            if record is None:
+                return False  # unknown: node should re-register
+            record.available = dict(available)
+            if total:
+                record.resources = dict(total)
+            if labels:
+                record.labels = dict(labels)
+            record.last_report = time.monotonic()
+        return True
+
+    def _subscribe(self, channel: str, subscriber_id: str, cursor: int,
+                   timeout: float = 10.0):
+        """Long-poll subscription endpoint (reference: long-poll pubsub,
+        `pubsub/publisher.h:188-216`)."""
+        return self.publisher.poll(channel, subscriber_id, cursor, timeout)
 
     def _report_objects(self, oids: List[bytes], address):
         with self._lock:
@@ -192,7 +229,14 @@ class ClusterHead:
         while not self._health_stop.wait(ray_config.health_check_period_s):
             with self._lock:
                 records = [n for n in self.nodes.values() if n.alive]
+            fresh_window = ray_config.resource_report_period_s * \
+                ray_config.resource_report_fresh_periods
             for record in records:
+                # A recent pushed resource report doubles as a heartbeat:
+                # no need to burn an RPC on it.
+                if time.monotonic() - record.last_report < fresh_window:
+                    failures[record.node_id] = 0
+                    continue
                 try:
                     RpcClient.to(record.address).call("ping")
                     failures[record.node_id] = 0
@@ -242,6 +286,8 @@ class ClusterHead:
             "node %s marked dead (%s): %d objects lost, %d tasks in "
             "flight, %d actors", node_id, reason, len(lost),
             len(resubmit), len(dead_actors))
+        self.publisher.publish("node_events", {
+            "event": "NODE_DEAD", "node_id": node_id, "reason": reason})
         # Restart actors first so resubmitted / queued actor tasks find a
         # live location.
         for aid in dead_actors:
@@ -580,11 +626,7 @@ class ClusterBackendMixin:
                     self._ensure_local_deps(spec)
                     self.local_backend.submit(spec)
                     return True
-                try:
-                    info = RpcClient.to(target.address).call("ping")
-                except Exception:
-                    continue
-                if all(info["available"].get(k, 0) * 1000 >= v
+                if all(target.available.get(k, 0) * 1000 >= v
                        for k, v in request.items()):
                     self._rr += attempt + 1
                     if spec.kind == TaskKind.ACTOR_CREATION:
@@ -706,16 +748,14 @@ class ClusterBackendMixin:
                 for k, v in request.items())
         if local_fits_now:
             return None
+        # Pushed resource view (ray_syncer role): no per-submit pings.
+        # Staleness is fine — the receiving node queues anything that no
+        # longer fits, and the next report corrects the view.
         candidates = [n for n in self.head.nodes.values()
                       if n.alive and n.node_id not in exclude]
         best, best_avail = None, -1.0
         for node in candidates:
-            try:
-                info = RpcClient.to(node.address).call("ping")
-            except Exception:
-                node.alive = False
-                continue
-            avail = info["available"]
+            avail = node.available
             if all(avail.get(k, 0) * 1000 >= v
                    for k, v in request.items()):
                 score = sum(avail.values())

@@ -217,6 +217,12 @@ class ExecutionPlan:
     def with_op(self, op: LogicalOp) -> "ExecutionPlan":
         return ExecutionPlan(self.ops + [op])
 
+    @property
+    def streaming_stats(self) -> List[dict]:
+        """Per-operator stats of the last streaming execution."""
+        executor = getattr(self, "_streaming_executor", None)
+        return executor.stats() if executor else []
+
     # -- fusion ----------------------------------------------------------
 
     def _fused_stages(self) -> List[LogicalOp]:
@@ -437,69 +443,87 @@ class ExecutionPlan:
 
     # -- streaming -------------------------------------------------------
 
-    def iter_block_refs(self, window: int = 8) -> Iterator:
-        """Yield block refs in order, submitting work lazily with at most
-        `window` unconsumed blocks in flight (backpressure)."""
-        # All-to-all stages force materialization; map chains stream.
-        stages = self._fused_stages()
-        streamable = all(
-            isinstance(op, (Read, FromBlocks, MapBlocks, Limit))
-            for op in stages
-        ) and not any(
-            isinstance(op, MapBlocks)
-            and isinstance(op.compute, ActorPoolStrategy) for op in stages
+    def to_physical(self):
+        """Lower the fused logical plan to a physical operator chain for
+        the streaming executor (reference: plan → operators lowering in
+        `_internal/execution/legacy_compat.py` + operators/)."""
+        from ray_tpu.data.streaming_executor import (
+            AllToAllOp,
+            LimitOp,
+            MapOp,
+            SourceOp,
         )
-        if self._cached is not None or not streamable:
-            yield from self.execute()
-            return
 
-        # Build the source list + fused transform chain.
-        sources: List[Tuple[str, Any]] = []
-        transforms: List[Callable[[Block], Block]] = []
-        limit = None
-        for op in stages:
+        def label(op, kind):
+            return op.name if op.name and op.name != "op" else kind
+
+        phys = []
+        if self._cached is not None:
+            phys.append(SourceOp("cached", refs=list(self._cached)))
+            return phys
+        for op in self._fused_stages():
             if isinstance(op, Read):
-                sources = [("task", t)
-                           for t in op.datasource.get_read_tasks(
-                               op.parallelism)]
+                phys.append(SourceOp(
+                    label(op, "read"),
+                    read_tasks=list(op.datasource.get_read_tasks(
+                        op.parallelism))))
             elif isinstance(op, FromBlocks):
-                sources = [("block", b) for b in op.blocks]
+                phys.append(SourceOp(label(op, "from_blocks"),
+                                     blocks=list(op.blocks)))
             elif isinstance(op, MapBlocks):
-                transforms.append(op.fn)
+                if isinstance(op.compute, ActorPoolStrategy):
+                    phys.append(AllToAllOp(
+                        label(op, "map(actor_pool)"),
+                        lambda refs, op=op:
+                        self._map_with_actor_pool(op, refs)))
+                else:
+                    phys.append(MapOp(label(op, "map"), op.fn,
+                                      num_cpus=op.num_cpus))
             elif isinstance(op, Limit):
-                limit = op.limit
+                phys.append(LimitOp(label(op, "limit"), op.limit))
+            elif isinstance(op, Repartition):
+                phys.append(AllToAllOp(
+                    label(op, "repartition"),
+                    lambda refs, op=op:
+                    self._repartition(refs, op.num_blocks)))
+            elif isinstance(op, RandomShuffle):
+                phys.append(AllToAllOp(
+                    label(op, "random_shuffle"),
+                    lambda refs, op=op: self._random_shuffle(refs, op)))
+            elif isinstance(op, Sort):
+                phys.append(AllToAllOp(
+                    label(op, "sort"),
+                    lambda refs, op=op: self._sort(refs, op)))
+            elif isinstance(op, Union):
+                phys.append(AllToAllOp(
+                    label(op, "union"),
+                    lambda refs, op=op: refs + [
+                        r for p in op.others for r in p.execute()]))
+            elif isinstance(op, Zip):
+                phys.append(AllToAllOp(
+                    label(op, "zip"),
+                    lambda refs, op=op: self._zip(refs, op.other)))
+            else:  # pragma: no cover
+                raise NotImplementedError(f"op {op}")
+        return phys
 
-        def submit(src):
-            kind, payload = src
-            if kind == "task":
-                ref = _read_task.remote(payload)
-            else:
-                ref = ray_tpu.put(payload)
-            for fn in transforms:
-                ref = _apply_fn.remote(fn, ref)
-            return ref
+    def iter_block_refs(self, window: int = 8) -> Iterator:
+        """Yield block refs in order through the streaming operator-graph
+        executor: every map stage pipelines with a bounded in-flight
+        window; all-to-all stages barrier (accumulating while upstream
+        still streams) then stream their outputs. Per-op stats land in
+        `self.streaming_stats`."""
+        from ray_tpu.data.streaming_executor import StreamingExecutor
 
-        produced_rows = 0
-        in_flight: List = []
-        src_iter = iter(sources)
-        while True:
-            while len(in_flight) < window:
-                nxt = next(src_iter, None)
-                if nxt is None:
-                    break
-                in_flight.append(submit(nxt))
-            if not in_flight:
-                return
-            ref = in_flight.pop(0)
-            if limit is not None:
-                nrows = ray_tpu.get(_meta_of.remote(ref)).num_rows
-                if produced_rows >= limit:
-                    return
-                if produced_rows + nrows > limit:
-                    ref = _slice_concat.remote(
-                        [(0, 0, limit - produced_rows)], ref)
-                produced_rows += nrows
+        executor = StreamingExecutor(self.to_physical())
+        self._streaming_executor = executor
+        # A fully drained stream doubles as materialization: repeated
+        # iteration (multi-epoch ingest) must not re-run shuffles/sorts.
+        out: List = []
+        for ref in executor.iter_refs(window=window):
+            out.append(ref)
             yield ref
+        self._cached = out
 
 
 @ray_tpu.remote

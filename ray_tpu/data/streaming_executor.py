@@ -1,0 +1,280 @@
+"""Streaming operator-graph executor: pipelined, pull-based, bounded.
+
+Role-equivalent to the reference's
+`data/_internal/execution/streaming_executor.py:35`: the logical plan
+lowers to a chain of physical operators; blocks flow through the chain as
+ObjectRefs with a bounded number in flight per operator (backpressure), so
+downstream consumption (e.g. train ingest) overlaps upstream reads and
+transforms instead of materializing stage-by-stage.
+
+Operator kinds:
+- SourceOp: read tasks / local blocks, submitted lazily under the cap.
+- MapOp: one task per block (fused transform chains arrive pre-fused).
+- AllToAllOp: a barrier (shuffle/sort/repartition/zip/union): collects
+  every upstream block, runs its task graph, then streams results out.
+  Upstream stays pipelined while the barrier accumulates.
+- LimitOp: cuts the stream after N rows without running upstream further.
+
+Ordering is preserved (per-op FIFO completion), matching the reference's
+default preserve_order semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+
+
+class _OpStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.submitted = 0
+        self.completed = 0
+        self.busy_s = 0.0          # driver-observed submit→finish span
+        self.peak_in_flight = 0
+
+    def summary(self) -> dict:
+        return {"name": self.name, "blocks": self.completed,
+                "wall_s": round(self.busy_s, 4),
+                "peak_in_flight": self.peak_in_flight}
+
+
+class PhysicalOp:
+    """Base: pull-based operator with a bounded in-flight window."""
+
+    def __init__(self, name: str, max_in_flight: int = 8):
+        self.name = name
+        self.max_in_flight = max_in_flight
+        self.inputs: deque = deque()       # refs waiting to process
+        self.in_flight: deque = deque()    # (ref, t_submit) FIFO
+        self.outputs: deque = deque()      # completed refs
+        self.upstream_done = False
+        self.stats = _OpStats(name)
+
+    # -- hooks -----------------------------------------------------------
+
+    def submit_one(self) -> bool:
+        """Launch one unit of work if possible. Returns True if launched."""
+        return False
+
+    def done(self) -> bool:
+        return (self.upstream_done and not self.inputs
+                and not self.in_flight)
+
+    # -- shared machinery ------------------------------------------------
+
+    def poll(self) -> bool:
+        """Move completed head-of-line work to outputs (FIFO order keeps
+        the stream deterministic). Returns True if anything progressed."""
+        progressed = False
+        while self.in_flight:
+            ref, t0 = self.in_flight[0]
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                break
+            self.in_flight.popleft()
+            self.outputs.append(ref)
+            self.stats.completed += 1
+            self.stats.busy_s += time.perf_counter() - t0
+            progressed = True
+        return progressed
+
+    def _track(self, ref) -> None:
+        self.in_flight.append((ref, time.perf_counter()))
+        self.stats.submitted += 1
+        self.stats.peak_in_flight = max(self.stats.peak_in_flight,
+                                        len(self.in_flight))
+
+
+class SourceOp(PhysicalOp):
+    """Read tasks or pre-materialized blocks."""
+
+    def __init__(self, name: str, read_tasks: Optional[List] = None,
+                 blocks: Optional[List] = None, refs: Optional[List] = None,
+                 max_in_flight: int = 8):
+        super().__init__(name, max_in_flight)
+        self._tasks = deque(read_tasks or [])
+        self._blocks = deque(blocks or [])
+        self._refs = deque(refs or [])
+        self.upstream_done = True
+
+    def submit_one(self) -> bool:
+        from ray_tpu.data.plan import _read_task
+
+        if len(self.in_flight) >= self.max_in_flight:
+            return False
+        if self._tasks:
+            self._track(_read_task.remote(self._tasks.popleft()))
+            return True
+        if self._blocks:
+            self._track(ray_tpu.put(self._blocks.popleft()))
+            return True
+        if self._refs:
+            self._track(self._refs.popleft())
+            return True
+        return False
+
+    def done(self) -> bool:
+        return not (self._tasks or self._blocks or self._refs
+                    or self.in_flight)
+
+
+class MapOp(PhysicalOp):
+    def __init__(self, name: str, fn: Callable, num_cpus: float = 1.0,
+                 max_in_flight: int = 8):
+        super().__init__(name, max_in_flight)
+        self.fn = fn
+        self.num_cpus = num_cpus
+
+    def submit_one(self) -> bool:
+        from ray_tpu.data.plan import _apply_fn
+
+        if not self.inputs or len(self.in_flight) >= self.max_in_flight:
+            return False
+        ref = self.inputs.popleft()
+        self._track(_apply_fn.options(num_cpus=self.num_cpus)
+                    .remote(self.fn, ref))
+        return True
+
+
+class AllToAllOp(PhysicalOp):
+    """Barrier operator: buffers all upstream refs, then runs
+    `run_fn(refs) -> refs` (the existing two-stage shuffle/sort task
+    graphs) exactly once."""
+
+    def __init__(self, name: str, run_fn: Callable[[List], List]):
+        super().__init__(name, max_in_flight=1)
+        self.run_fn = run_fn
+        self._buffered: List = []
+        self._ran = False
+
+    def submit_one(self) -> bool:
+        while self.inputs:
+            self._buffered.append(self.inputs.popleft())
+        if self._ran or not self.upstream_done or self.inputs:
+            return False
+        t0 = time.perf_counter()
+        out = self.run_fn(self._buffered)
+        self._ran = True
+        # Drop the input refs: holding them would pin every pre-barrier
+        # block for the life of the plan (the executor is retained for
+        # streaming_stats).
+        self._buffered = []
+        for ref in out:
+            self.outputs.append(ref)
+        self.stats.submitted += len(out)
+        self.stats.completed += len(out)
+        self.stats.busy_s += time.perf_counter() - t0
+        return True
+
+    def done(self) -> bool:
+        # Done once the barrier ran and its outputs drained downstream.
+        return self._ran and not self.outputs
+
+    def poll(self) -> bool:
+        return False  # no async in-flight: run_fn produced final refs
+
+
+class LimitOp(PhysicalOp):
+    """Row-limit: passes refs through until the limit is reached, then
+    declares the whole pipeline done (upstream stops being polled)."""
+
+    def __init__(self, name: str, limit: int):
+        super().__init__(name, max_in_flight=1)
+        self.limit = limit
+        self._rows = 0
+        self.exhausted = False
+
+    def submit_one(self) -> bool:
+        from ray_tpu.data.plan import _meta_of, _slice_concat
+
+        if self.exhausted or not self.inputs:
+            return False
+        ref = self.inputs.popleft()
+        # Row accounting needs only the block's length: fetch *metadata*
+        # (the payload itself stays in the object store / on its node).
+        rows = ray_tpu.get(_meta_of.remote(ref)).num_rows
+        if rows == 0:
+            # An empty block is not end-of-stream — swallow it and keep
+            # pulling (the limit counts rows, not blocks).
+            return True
+        take = min(rows, self.limit - self._rows)
+        if take <= 0:
+            self.exhausted = True
+            return False
+        if take < rows:
+            ref = _slice_concat.remote([(0, 0, take)], ref)
+        self._rows += take
+        self.outputs.append(ref)
+        self.stats.completed += 1
+        if self._rows >= self.limit:
+            self.exhausted = True
+        return True
+
+    def done(self) -> bool:
+        return self.exhausted or (self.upstream_done and not self.inputs
+                                  and not self.in_flight)
+
+
+class StreamingExecutor:
+    """Drives a chain of PhysicalOps; iterate over the sink's refs."""
+
+    def __init__(self, ops: List[PhysicalOp]):
+        self.ops = ops
+
+    def iter_refs(self, window: int = 8) -> Iterator:
+        """Yield sink output refs as they complete, keeping at most
+        ``window`` unconsumed sink outputs (consumer backpressure)."""
+        ops = self.ops
+        sink = ops[-1]
+        pending_yield: deque = deque()
+        while True:
+            progressed = False
+            # Propagate done-ness and move outputs downstream.
+            for i, op in enumerate(ops):
+                if i > 0:
+                    up = ops[i - 1]
+                    while up.outputs:
+                        op.inputs.append(up.outputs.popleft())
+                        progressed = True
+                    op.upstream_done = up.done()
+            # Poll completions sink-first (frees windows for upstream).
+            for op in reversed(ops):
+                if op.poll():
+                    progressed = True
+            # A LimitOp that hit its limit short-circuits everything
+            # upstream of it.
+            cut = next((i for i, op in enumerate(ops)
+                        if isinstance(op, LimitOp) and op.exhausted), None)
+            # Launch new work while the consumer window has room.
+            room = window - len(pending_yield)
+            for i, op in enumerate(ops):
+                if cut is not None and i < cut:
+                    continue
+                if i == len(ops) - 1 and room <= 0:
+                    break
+                while op.submit_one():
+                    progressed = True
+                    if i == len(ops) - 1:
+                        room -= 1
+                        if room <= 0:
+                            break
+            while sink.outputs:
+                pending_yield.append(sink.outputs.popleft())
+            if pending_yield:
+                yield pending_yield.popleft()
+                continue
+            if (cut is not None and ops[cut].done() and
+                    all(op.done() for op in ops[cut:])) or \
+                    all(op.done() for op in ops):
+                while sink.outputs:
+                    yield sink.outputs.popleft()
+                return
+            if not progressed:
+                time.sleep(0.002)
+
+    def stats(self) -> List[dict]:
+        return [op.stats.summary() for op in self.ops]

@@ -14,6 +14,7 @@ from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
+    check_isolate_process,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
@@ -80,7 +81,7 @@ class RemoteFunction:
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
-            isolate_process=bool(opts.get("isolate_process", False)),
+            isolate_process=check_isolate_process(opts.get("isolate_process", False)),
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
         )
         refs = w.submit(spec)

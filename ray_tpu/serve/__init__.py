@@ -23,6 +23,7 @@ from ray_tpu.serve._private.controller import (
 )
 from ray_tpu.serve._private.http_proxy import HTTPProxy
 from ray_tpu.serve._private.router import ServeHandle
+from ray_tpu.serve.streaming import is_stream, iter_stream  # noqa: F401
 
 _proxy: Optional[HTTPProxy] = None
 

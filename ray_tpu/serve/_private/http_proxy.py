@@ -76,6 +76,41 @@ class HTTPProxy:
                     else:
                         ref = handle.remote(payload)
                     result = ray_tpu.get(ref, timeout=60)
+                    from ray_tpu.serve.streaming import (is_stream,
+                                                         iter_stream)
+
+                    if is_stream(result):
+                        # Server-sent events, flushed per chunk: tokens
+                        # reach the client while the model is still
+                        # decoding (reference: ASGI StreamingResponse).
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.end_headers()
+                        try:
+                            for chunk in iter_stream(result):
+                                self.wfile.write(
+                                    b"data: " + json.dumps(chunk).encode()
+                                    + b"\n\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"data: [DONE]\n\n")
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionError):
+                            pass  # client went away mid-stream
+                        except Exception as stream_err:  # noqa: BLE001
+                            # Headers already sent: a mid-stream failure
+                            # must become an error *event*, never a 500
+                            # status line spliced into the SSE body.
+                            try:
+                                self.wfile.write(
+                                    b"data: " + json.dumps(
+                                        {"error": str(stream_err)}
+                                    ).encode() + b"\n\ndata: [DONE]\n\n")
+                                self.wfile.flush()
+                            except (BrokenPipeError, ConnectionError):
+                                pass
+                        return
                     out = json.dumps(result).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

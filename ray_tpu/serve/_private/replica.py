@@ -63,11 +63,50 @@ class ServeReplica:
                 import asyncio
 
                 result = asyncio.run(result)
+            if inspect.isgenerator(result):
+                return self._start_stream(result)
             return result
         finally:
             with self._lock:
                 self._in_flight -= 1
                 self._t_busy += time.perf_counter() - t0
+
+    def _start_stream(self, gen):
+        """Generator results stream through an actor-backed queue: the
+        replica pumps in a background thread (bounded queue =
+        backpressure); the consumer — HTTP proxy or Python caller via
+        `serve.iter_stream` — pulls until the end marker. This is the
+        token-streaming channel (reference: ASGI StreamingResponse
+        through `http_proxy.py:425`; the transport differs, the contract
+        — incremental chunks over one request — is the same)."""
+        from ray_tpu.serve.streaming import STREAM_END_KEY, STREAM_KEY
+        from ray_tpu.util.queue import Queue
+
+        queue = Queue(maxsize=64)
+
+        def pump():
+            # Finite put timeouts: an abandoned consumer (client gone,
+            # queue actor killed by iter_stream's cleanup) must release
+            # the pump thread and close the generator, not pin them
+            # forever behind a full queue.
+            try:
+                for item in gen:
+                    queue.put(item, timeout=60.0)
+                queue.put({STREAM_END_KEY: True}, timeout=60.0)
+            except BaseException as e:  # noqa: BLE001 - surfaced to reader
+                try:
+                    gen.close()
+                except Exception:
+                    pass
+                try:
+                    queue.put({STREAM_END_KEY: True, "error": repr(e)},
+                              timeout=5.0)
+                except Exception:
+                    pass
+
+        threading.Thread(target=pump, daemon=True,
+                         name="serve-stream-pump").start()
+        return {STREAM_KEY: queue}
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:

@@ -281,14 +281,21 @@ class LLMDeployment:
                                 max_seq_len=max_seq_len)
         self.engine.start()
 
-    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def __call__(self, request: Dict[str, Any]):
         t0 = time.perf_counter()
-        tokens = self.engine.generate(
-            request["prompt_ids"],
-            SamplingParams(
-                max_tokens=int(request.get("max_tokens", 64)),
-                temperature=float(request.get("temperature", 0.0)),
-                stop_token_ids=tuple(request.get("stop_token_ids", ()))))
+        params = SamplingParams(
+            max_tokens=int(request.get("max_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            stop_token_ids=tuple(request.get("stop_token_ids", ())))
+        if request.get("stream"):
+            # Generator return → the replica streams it chunk-by-chunk
+            # (tokens reach the client during decode, not after).
+            def token_stream():
+                for i, token in enumerate(self.engine.generate(
+                        request["prompt_ids"], params, stream=True)):
+                    yield {"token": int(token), "index": i}
+            return token_stream()
+        tokens = self.engine.generate(request["prompt_ids"], params)
         return {"tokens": tokens,
                 "latency_s": time.perf_counter() - t0}
 

@@ -46,6 +46,10 @@ class BackendExecutor:
             sc.num_workers,
             resources_per_worker=sc.worker_resources(),
             placement_group=placement_group,
+            # jax.distributed needs one *fresh* OS process per rank
+            # (forked children inherit unusable XLA runtime state).
+            isolate_process="spawn" if getattr(
+                self.backend_config, "distributed", False) else False,
         )
         self.backend.on_start(self.worker_group, self.backend_config)
 

@@ -86,7 +86,8 @@ class TrainWorker:
 class WorkerGroup:
     def __init__(self, num_workers: int,
                  resources_per_worker: Optional[Dict[str, float]] = None,
-                 placement_group=None):
+                 placement_group=None,
+                 isolate_process: bool = False):
         from ray_tpu.util.scheduling_strategies import (
             PlacementGroupSchedulingStrategy,
         )
@@ -95,6 +96,11 @@ class WorkerGroup:
         opts: Dict[str, Any] = {
             "num_cpus": res.pop("CPU", 1),
         }
+        if isolate_process:
+            # Each worker in its own OS process: required for
+            # jax.distributed (one JAX process per rank). Pass through
+            # as-is ("spawn" or True).
+            opts["isolate_process"] = isolate_process
         if "TPU" in res:
             opts["num_tpus"] = res.pop("TPU")
         if res:

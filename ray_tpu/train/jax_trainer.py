@@ -29,13 +29,21 @@ from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 
 @dataclass
 class JaxConfig(BackendConfig):
-    """Multi-host wiring config. With `distributed=True` each worker calls
-    `jax.distributed.initialize(coordinator, num_processes, process_id)`
-    before the loop (TPU pod / multi-process CPU); single-host runs skip
-    it."""
+    """Multi-host wiring config. With `distributed=True` each worker runs
+    in its own OS process (the WorkerGroup forces `isolate_process`) and
+    calls `jax.distributed.initialize(coordinator, num_processes,
+    process_id)` before the loop — one JAX process per host, the
+    multi-controller model. Single-host runs skip it.
+
+    ``platform`` / ``num_local_devices`` pin the per-process backend
+    (e.g. platform="cpu", num_local_devices=2 gives a 2-process ×
+    2-device CPU test mesh — how multi-host is exercised without a pod;
+    CPU collectives ride the gloo plugin)."""
 
     distributed: bool = False
     coordinator_port: int = 7010
+    platform: Optional[str] = None
+    num_local_devices: Optional[int] = None
 
     def backend_cls(self):
         return JaxBackend
@@ -56,26 +64,42 @@ class JaxBackend(Backend):
         ip = worker_group.execute_single(0, get_ip)
         coord = f"{ip}:{backend_config.coordinator_port}"
         n = len(worker_group)
-
-        def init_dist(coord=coord, n=n):
-            def _do(rank):
-                import jax
-
-                jax.distributed.initialize(coordinator_address=coord,
-                                           num_processes=n,
-                                           process_id=rank)
-                return True
-            return _do
+        platform = backend_config.platform
+        local = backend_config.num_local_devices
 
         ray_tpu.get([
-            w.execute.remote(_jax_dist_init, coord, n, i)
+            w.execute.remote(_jax_dist_init, coord, n, i, platform, local)
             for i, w in enumerate(worker_group.workers)
         ])
 
 
-def _jax_dist_init(coord, n, rank):
+def _jax_dist_init(coord, n, rank, platform=None, num_local_devices=None):
+    """Per-rank jax.distributed bring-up. Runs inside an isolated worker
+    process; if that process was forked from a parent that already
+    initialized JAX, the inherited backends are discarded first so the
+    distributed client is wired into fresh ones."""
+    import os
+    import re
+
     import jax
 
+    import jax._src.xla_bridge as xla_bridge
+
+    if xla_bridge._backends:  # pragma: no cover - forked-worker fallback
+        xla_bridge._clear_backends()
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    if num_local_devices is not None and (platform or "") == "cpu":
+        # Inherited test env may force a host device count; the explicit
+        # per-rank setting wins.
+        flags = os.environ.get("XLA_FLAGS", "")
+        stripped = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags).strip()
+        if stripped != flags:
+            os.environ["XLA_FLAGS"] = stripped
+        jax.config.update("jax_num_cpu_devices", num_local_devices)
+    if (platform or "") == "cpu":
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=coord, num_processes=n,
                                process_id=rank)
     return True

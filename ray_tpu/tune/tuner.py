@@ -52,6 +52,116 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._trials: Optional[List[Trial]] = None
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # -- experiment durability -------------------------------------------
+    # Reference: TrialRunner experiment checkpointing
+    # (`tune/execution/trial_runner.py:427`) + `Tuner.restore`
+    # (`tune/tuner.py` restore path): trial registry + per-trial latest
+    # checkpoints snapshot to `<storage_path>/<name>/experiment_state.pkl`
+    # on every trial event; `Tuner.restore` resumes unfinished trials
+    # from their last checkpoints.
+
+    def _experiment_dir(self) -> Optional[str]:
+        if not self.run_config.storage_path:
+            return None
+        import os
+
+        name = self.run_config.name or "experiment"
+        path = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _save_experiment_state(self) -> None:
+        path = self._experiment_dir()
+        if path is None or self._trials is None:
+            return
+        import os
+
+        import cloudpickle
+
+        # Checkpoint payloads are serialized once per distinct checkpoint
+        # object, not on every trial event — to_dict() on a
+        # directory-backed checkpoint loads the full model state.
+        cache = getattr(self, "_ckpt_dict_cache", None)
+        if cache is None:
+            cache = self._ckpt_dict_cache = {}
+
+        def ckpt_dict(t):
+            ckpt = t.checkpoint
+            if ckpt is None:
+                return None
+            cached = cache.get(t.trial_id)
+            if cached is not None and cached[0] is ckpt:
+                return cached[1]
+            data = ckpt.to_dict()
+            cache[t.trial_id] = (ckpt, data)
+            return data
+
+        state = {
+            "param_space": self.param_space,
+            "tune_config": self.tune_config,
+            "run_config": self.run_config,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "name": t.name,
+                    "config": t.config,
+                    "status": t.status,
+                    "results": t.results,
+                    "last_result": t.last_result,
+                    "num_failures": t.num_failures,
+                    "checkpoint": ckpt_dict(t),
+                }
+                for t in self._trials
+            ],
+        }
+        target = os.path.join(path, "experiment_state.pkl")
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(cloudpickle.dumps(state))
+        os.replace(tmp, target)  # atomic: a crash never corrupts state
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, type]) -> "Tuner":
+        """Resume an interrupted experiment from its state file: finished
+        trials keep their results; unfinished trials re-run from their
+        last checkpoint."""
+        import os
+        import pickle
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            state = pickle.loads(f.read())
+        tuner = cls(trainable, param_space=state["param_space"],
+                    tune_config=state["tune_config"],
+                    run_config=state["run_config"])
+        ckpt_cfg = tuner.run_config.checkpoint_config
+        trials: List[Trial] = []
+        for ts in state["trials"]:
+            trial = Trial(ts["config"], checkpoint_config=ckpt_cfg,
+                          trial_id=ts["trial_id"], name=ts["name"])
+            trial.results = list(ts["results"])
+            trial.last_result = dict(ts["last_result"])
+            for r in trial.results:
+                for k, v in r.items():
+                    if isinstance(v, (int, float)):
+                        trial.metric_history.setdefault(k, []).append(
+                            float(v))
+            trial.num_failures = ts["num_failures"]
+            if ts["checkpoint"] is not None:
+                trial.checkpoint_manager.register(
+                    Checkpoint.from_dict(ts["checkpoint"]),
+                    ts["last_result"])
+            # Finished trials stay finished; everything else re-runs
+            # (from the registered checkpoint when there is one).
+            trial.status = Trial.TERMINATED \
+                if ts["status"] == Trial.TERMINATED else Trial.PENDING
+            trials.append(trial)
+        tuner._restored_trials = trials
+        return tuner
 
     def _make_trials(self) -> List[Trial]:
         tc = self.tune_config
@@ -89,7 +199,13 @@ class Tuner:
         elif isinstance(stop, dict):
             stop_criteria = stop
 
-        self._trials = self._make_trials()
+        self._trials = self._restored_trials or self._make_trials()
+        callbacks = list(self.run_config.callbacks)
+        if tc.search_alg:
+            callbacks.append(_SearcherCallback(tc.search_alg))
+        if self.run_config.storage_path:
+            callbacks.append(_ExperimentSaver(self))
+            self._save_experiment_state()
         runner = TrialRunner(
             self.trainable_cls, self._trials,
             scheduler=scheduler, stopper=stopper,
@@ -97,17 +213,35 @@ class Tuner:
             failure_config=self.run_config.failure_config,
             max_concurrent_trials=tc.max_concurrent_trials,
             resources_per_trial=tc.resources_per_trial,
-            callbacks=list(self.run_config.callbacks) + [
-                _SearcherCallback(tc.search_alg)] if tc.search_alg
-            else list(self.run_config.callbacks),
+            callbacks=callbacks,
         )
         runner.run()
+        if self.run_config.storage_path:
+            self._save_experiment_state()
         return ResultGrid(self._trials)
 
     def get_results(self) -> ResultGrid:
         if self._trials is None:
             raise RuntimeError("call fit() first")
         return ResultGrid(self._trials)
+
+
+class _ExperimentSaver:
+    """Snapshot experiment state on every trial event (reference:
+    `trial_runner.py:427` checkpointing cadence, collapsed to
+    event-driven since trials report at human timescales here)."""
+
+    def __init__(self, tuner: Tuner):
+        self.tuner = tuner
+
+    def on_trial_start(self, trial=None):
+        self.tuner._save_experiment_state()
+
+    def on_trial_result(self, trial=None, result=None):
+        self.tuner._save_experiment_state()
+
+    def on_trial_complete(self, trial=None):
+        self.tuner._save_experiment_state()
 
 
 class _SearcherCallback:

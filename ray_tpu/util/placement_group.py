@@ -205,23 +205,19 @@ class _Candidate:
 
 
 def _candidates(w, head) -> List[_Candidate]:
-    from ray_tpu._private.rpc import RpcClient
-
     out = []
     local = w.backend.resources
     with local._cond:
         avail = dict(local._available)
     out.append(_Candidate(None, avail, {}))
+    # Pushed resource view (ray_syncer role) — no per-reservation pings;
+    # stale optimism is corrected by the prepare phase failing and the
+    # reservation loop retrying.
     for record in list(head.nodes.values()):
         if not record.alive:
             continue
-        try:
-            info = RpcClient.to(record.address).call("ping")
-        except Exception:
-            continue
-        milli = {k: int(v * 1000) for k, v in info["available"].items()}
-        out.append(_Candidate(record.node_id, milli,
-                              info.get("labels") or record.labels))
+        milli = {k: int(v * 1000) for k, v in record.available.items()}
+        out.append(_Candidate(record.node_id, milli, record.labels))
     return out
 
 

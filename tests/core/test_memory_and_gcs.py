@@ -1,0 +1,135 @@
+"""Memory monitor / worker killing + GCS storage backends.
+
+Reference: `src/ray/common/memory_monitor.h:52`,
+`worker_killing_policy_retriable_fifo.h`,
+`gcs/store_client/{in_memory,redis}_store_client.h` (SQLite plays the
+durable Redis role here).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import ray_config
+from ray_tpu.exceptions import WorkerCrashedError
+
+
+@pytest.fixture
+def ray_local():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_memory_monitor_kills_newest_retriable_first(ray_local, monkeypatch):
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    backend = ray_tpu._private.worker.global_worker().backend
+
+    @ray_tpu.remote(isolate_process=True, max_retries=0)
+    def hog():
+        time.sleep(30)
+        return "survived"
+
+    ref = hog.remote()
+    # Wait until the worker registers as active.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        pool = backend._worker_pool
+        if pool is not None and pool.active:
+            break
+        time.sleep(0.05)
+    assert backend._worker_pool.active
+
+    monitor = MemoryMonitor(backend, usage_fn=lambda: 0.99)
+    assert monitor.kill_one(0.99)  # policy found and killed a worker
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert "worker process" in str(ei.value).lower()
+
+
+def test_memory_monitor_retries_retriable_task(ray_local):
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    backend = ray_tpu._private.worker.global_worker().backend
+
+    @ray_tpu.remote(isolate_process=True, max_retries=2,
+                    retry_exceptions=[WorkerCrashedError])
+    def flaky(marker_dir):
+        import os
+        import time as _t
+
+        path = os.path.join(marker_dir, f"a{os.getpid()}")
+        open(path, "w").close()
+        if len(os.listdir(marker_dir)) < 2:
+            _t.sleep(20)  # first attempt: park until the monitor kills us
+        return len(os.listdir(marker_dir))
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ref = flaky.remote(d)
+        monitor = MemoryMonitor(backend, usage_fn=lambda: 0.99)
+        deadline = time.monotonic() + 20
+        killed = False
+        while time.monotonic() < deadline and not killed:
+            pool = backend._worker_pool
+            if pool is not None and pool.active:
+                killed = monitor.kill_one(0.99)
+            time.sleep(0.05)
+        assert killed
+        # The retry (fresh worker) sees 2 markers and returns.
+        assert ray_tpu.get(ref, timeout=60) == 2
+
+
+def test_system_memory_usage_readable():
+    from ray_tpu._private.memory_monitor import (
+        system_memory_usage_fraction,
+    )
+
+    usage = system_memory_usage_fraction()
+    assert 0.0 < usage < 1.0
+
+
+def test_gcs_storage_in_memory_and_sqlite(tmp_path):
+    from ray_tpu._private.gcs_storage import (
+        InMemoryStoreClient,
+        SqliteStoreClient,
+    )
+
+    for store in (InMemoryStoreClient(),
+                  SqliteStoreClient(str(tmp_path / "gcs.db"))):
+        store.put("actors", b"a1", b"v1")
+        store.put("actors", b"a2", b"v2")
+        store.put("actors", b"a1", b"v1b")  # overwrite
+        assert store.get("actors", b"a1") == b"v1b"
+        assert store.get("jobs", b"a1") is None
+        assert sorted(store.keys("actors")) == [b"a1", b"a2"]
+        store.delete("actors", b"a2")
+        assert store.get("actors", b"a2") is None
+        store.close()
+
+
+def test_kv_survives_head_restart(tmp_path, monkeypatch):
+    """With a configured gcs_storage_path, internal KV outlives the
+    worker process (the reference's Redis-backed GCS FT contract)."""
+    monkeypatch.setattr(ray_config, "gcs_storage_path",
+                        str(tmp_path / "gcs.db"))
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=1)
+    w.gcs.kv_put(b"jobkey", b"payload", namespace=b"jobs")
+    w.gcs.kv_put(b"other", b"x")
+    ray_tpu.shutdown()
+
+    w2 = ray_tpu.init(num_cpus=1)  # "restarted head"
+    assert w2.gcs.kv_get(b"jobkey", namespace=b"jobs") == b"payload"
+    assert w2.gcs.kv_get(b"other") == b"x"
+    w2.gcs.kv_del(b"other")
+    ray_tpu.shutdown()
+
+    w3 = ray_tpu.init(num_cpus=1)
+    assert w3.gcs.kv_get(b"other") is None
+    ray_tpu.shutdown()

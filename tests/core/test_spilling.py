@@ -1,0 +1,111 @@
+"""Disk spilling under memory pressure: spill cold objects, transparent
+restore on get, file deletion on ref release.
+
+Reference: `src/ray/raylet/local_object_manager.h:41` (SpillObjects),
+`python/ray/_private/external_storage.py:72/:246`.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import ray_config
+
+
+@pytest.fixture
+def small_budget(monkeypatch):
+    # 4MB budget, spill above 50%, spill anything >= 256KB.
+    monkeypatch.setattr(ray_config, "object_store_memory_bytes", 4 * 2**20)
+    monkeypatch.setattr(ray_config, "object_spilling_threshold", 0.5)
+    monkeypatch.setattr(ray_config, "min_spilling_size_bytes", 256 * 1024)
+    yield
+
+
+@pytest.fixture
+def ray_local(small_budget):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu._private_worker()
+    ray_tpu.shutdown()
+
+
+def _private_worker():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker()
+
+
+ray_tpu._private_worker = _private_worker
+
+
+def test_put_beyond_budget_spills_and_restores(ray_local):
+    w = ray_local
+    manager = w.memory_store.spill_manager
+    arrays = [np.full((256, 1024), i, dtype=np.float32) for i in range(8)]
+    refs = [ray_tpu.put(a) for a in arrays]  # 8 x 1MB > 4MB budget
+
+    stats = manager.stats()
+    assert stats["num_spilled"] > 0, stats
+    assert stats["in_memory_bytes"] <= manager.budget
+    spill_dir = manager.storage.directory
+    assert len(os.listdir(spill_dir)) == stats["num_spilled"]
+
+    # Every value — spilled or resident — reads back intact.
+    for i, ref in enumerate(refs):
+        out = ray_tpu.get(ref)
+        assert out.shape == (256, 1024) and float(out[0, 0]) == float(i)
+    assert manager.stats()["num_restored"] > 0
+
+
+def test_release_deletes_spill_files(ray_local):
+    w = ray_local
+    manager = w.memory_store.spill_manager
+    refs = [ray_tpu.put(np.ones((256, 1024), np.float32) * i)
+            for i in range(8)]
+    assert manager.stats()["num_spilled"] > 0
+    spill_dir = manager.storage.directory
+    assert os.listdir(spill_dir)
+    del refs
+    import gc
+
+    gc.collect()
+    assert os.listdir(spill_dir) == []
+
+
+def test_spilled_task_output_roundtrip(ray_local):
+    @ray_tpu.remote
+    def big(i):
+        return np.full((256, 1024), i, dtype=np.float32)
+
+    refs = [big.remote(i) for i in range(8)]
+    outs = ray_tpu.get(refs)
+    for i, out in enumerate(outs):
+        assert float(out[0, 0]) == float(i)
+    assert ray_local.memory_store.spill_manager.stats()["num_spilled"] > 0
+
+
+def test_small_objects_never_spill(ray_local):
+    refs = [ray_tpu.put(np.ones(16, np.float32)) for _ in range(100)]
+    assert ray_local.memory_store.spill_manager.stats()["num_spilled"] == 0
+    assert all(r is not None for r in ray_tpu.get(refs))
+
+
+def test_restored_object_respills_without_rewrite(ray_local):
+    manager = ray_local.memory_store.spill_manager
+    refs = [ray_tpu.put(np.full((256, 1024), i, np.float32))
+            for i in range(8)]
+    first_spills = manager.stats()["num_spilled"]
+    assert first_spills > 0
+    # Touch everything (restores spilled values back into memory)...
+    for ref in refs:
+        ray_tpu.get(ref)
+    # ...then push new data: restored copies may be dropped again, but
+    # their bytes are already on disk — num_spilled (fresh writes) should
+    # not grow by re-serializing them.
+    extra = [ray_tpu.put(np.full((256, 1024), 100 + i, np.float32))
+             for i in range(4)]
+    assert extra
+    stats = manager.stats()
+    assert stats["num_spilled"] <= first_spills + 4

@@ -1,0 +1,101 @@
+"""Serve streaming: generator deployments stream chunks to Python callers
+and over HTTP as server-sent events, with the first chunk arriving before
+the last is produced.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_up():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_python_caller_iter_stream(serve_up):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, request):
+            def gen():
+                for i in range(5):
+                    yield {"i": i}
+            return gen()
+
+    handle = serve.run(Streamer.bind(), route_prefix="/s1")
+    result = ray_tpu.get(handle.remote({"n": 5}), timeout=60)
+    assert serve.is_stream(result)
+    chunks = list(serve.iter_stream(result))
+    assert [c["i"] for c in chunks] == [0, 1, 2, 3, 4]
+
+
+def test_stream_error_propagates(serve_up):
+    @serve.deployment
+    class Bad:
+        def __call__(self, request):
+            def gen():
+                yield {"ok": 1}
+                raise ValueError("mid-stream boom")
+            return gen()
+
+    handle = serve.run(Bad.bind(), route_prefix="/s2")
+    result = ray_tpu.get(handle.remote({}), timeout=60)
+    it = serve.iter_stream(result)
+    assert next(it)["ok"] == 1
+    with pytest.raises(RuntimeError, match="mid-stream boom"):
+        list(it)
+
+
+def test_http_sse_streams_incrementally(serve_up):
+    """Chunks arrive over HTTP while the generator is still producing —
+    the first data line lands well before the slow tail finishes."""
+
+    @serve.deployment
+    class SlowStreamer:
+        def __call__(self, request):
+            def gen():
+                for i in range(4):
+                    yield {"i": i}
+                    time.sleep(0.4)
+            return gen()
+
+    serve.run(SlowStreamer.bind(), route_prefix="/slow")
+    proxy = serve.start_http_proxy()
+    conn = http.client.HTTPConnection(proxy.host, proxy.port, timeout=30)
+    t0 = time.perf_counter()
+    conn.request("POST", "/slow", body=json.dumps({}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.headers.get("Content-Type") == "text/event-stream"
+
+    first_at = None
+    items = []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            line, buf = buf.split(b"\n\n", 1)
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                break
+            if first_at is None:
+                first_at = time.perf_counter() - t0
+            items.append(json.loads(payload))
+    conn.close()
+    assert [c["i"] for c in items] == [0, 1, 2, 3]
+    # 4 chunks at 0.4s spacing = ~1.6s total; the first arrived early.
+    assert first_at is not None and first_at < 1.0, first_at
