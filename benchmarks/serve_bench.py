@@ -1,0 +1,132 @@
+"""Serve/LLM north-star benchmark: p50 TTFT + decode throughput.
+
+Runs the continuous-batching engine (ray_tpu.serve.llm.LLMEngine) on the
+local chip with Llama-3.2-1B-shaped random weights and measures, over a
+set of concurrent streaming requests:
+
+- TTFT: request arrival -> first streamed token (p50/p95), covering
+  queueing + bucketed prefill (the BASELINE.json "Serve TTFT" north star
+  the reference leaves unpublished).
+- decode throughput: generated tokens/sec across the whole run.
+
+Usage: python benchmarks/serve_bench.py [--requests 16] [--max-tokens 32]
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# Runnable from anywhere without PYTHONPATH (which can shadow the
+# platform plugin discovery on some images).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--max-tokens", type=int, default=32)
+    parser.add_argument("--prompt-len", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--decode-steps", type=int, default=8)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig, init_params_sharded
+    from ray_tpu.parallel import MeshConfig, create_mesh
+    from ray_tpu.serve.llm import LLMEngine, SamplingParams
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = LlamaConfig.llama3_1b() if on_tpu else LlamaConfig.debug()
+    mesh = create_mesh(MeshConfig(data=-1))
+    params = init_params_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    engine = LLMEngine(cfg, params, max_batch_size=args.batch_size,
+                       max_seq_len=min(cfg.max_seq_len, 1024),
+                       decode_steps=args.decode_steps)
+    engine.start()
+
+    rng = np.random.default_rng(0)
+    prompt_len = min(args.prompt_len, 96) if not on_tpu else args.prompt_len
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(args.requests)]
+
+    # Warm up the compiled prefill/decode programs.
+    list(engine.generate(prompts[0],
+                         SamplingParams(max_tokens=4, temperature=0.0)))
+
+    ttfts = []
+    total_tokens = [0]
+    first_times = []
+    last_times = [0.0]
+    lock = threading.Lock()
+
+    def one_request(prompt):
+        t0 = time.perf_counter()
+        first = None
+        count = 0
+        for _tok in engine.generate(
+                prompt, SamplingParams(max_tokens=args.max_tokens,
+                                       temperature=0.0), stream=True):
+            now = time.perf_counter()
+            if first is None:
+                first = now - t0
+                with lock:
+                    first_times.append(now)
+            count += 1
+            with lock:
+                last_times[0] = max(last_times[0], now)
+        with lock:
+            ttfts.append(first)
+            total_tokens[0] += count
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=one_request, args=(p,))
+               for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    engine.stop()
+
+    ttfts.sort()
+    p50 = ttfts[len(ttfts) // 2]
+    p95 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))]
+    # Decode-phase rate: once every request has its first token, the
+    # remaining tokens are pure continuous-batching decode (prefill cost
+    # is what TTFT measures). Only meaningful when every request fits in
+    # one wave (requests <= slots); in multi-wave runs the first wave
+    # decodes before the last wave's first token, which would inflate
+    # the figure — report null there.
+    one_wave = args.requests <= args.batch_size
+    decode_window = max(last_times[0] - max(first_times), 1e-9)
+    decode_tokens = total_tokens[0] - len(prompts)
+    print(json.dumps({
+        "metric": "serve_ttft_p50_ms",
+        "value": round(p50 * 1e3, 1),
+        "unit": "ms",
+        "detail": {
+            "config": "llama-1.24B" if on_tpu else "llama-debug-cpu",
+            "ttft_p95_ms": round(p95 * 1e3, 1),
+            "decode_tokens_per_s": round(decode_tokens / decode_window, 1) if one_wave else None,
+            "end_to_end_tokens_per_s": round(total_tokens[0] / wall, 1),
+            "requests": args.requests,
+            "prompt_len": prompt_len,
+            "max_tokens": args.max_tokens,
+            "batch_slots": args.batch_size,
+            "decode_steps": args.decode_steps,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,11 +42,15 @@ from ray_tpu.models.llama import (
 )
 
 
+# lax.top_k needs a static k: per-slot top_k values are clamped to this.
+_TOP_K_MAX = 64
+
+
 @dataclasses.dataclass
 class SamplingParams:
     max_tokens: int = 64
     temperature: float = 0.0  # 0 → greedy
-    top_k: int = 0
+    top_k: int = 0            # 0 = full softmax; clamped to _TOP_K_MAX
     stop_token_ids: tuple = ()
 
 
@@ -65,10 +69,14 @@ class _Request:
 class LLMEngine:
     def __init__(self, cfg: LlamaConfig, params, *,
                  max_batch_size: int = 8, max_seq_len: Optional[int] = None,
-                 seed: int = 0):
+                 decode_steps: int = 1, seed: int = 0):
         self.cfg = cfg
         self.params = params
         self.n_slots = max_batch_size
+        # Tokens generated per decode dispatch (in-program scan).
+        # >1 trades admission granularity (a new request waits for the
+        # current block) for K-fold fewer dispatches.
+        self.decode_steps = max(1, int(decode_steps))
         self.max_seq = max_seq_len or cfg.max_seq_len
         self.cache = init_kv_cache(cfg, self.n_slots, self.max_seq)
         self._rng = jax.random.PRNGKey(seed)
@@ -87,20 +95,23 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
 
         # Compiled programs. Prefill is per-slot (batch 1, bucketed T);
-        # decode covers all slots at T=1.
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(0,),
+        # decode covers all slots at T=1. Params are explicit arguments —
+        # closing over them would bake the full weight set into every
+        # compiled program as constants (one 2.5GB copy per prefill
+        # bucket), exploding compile time and HBM.
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,),
                                 static_argnames=("t",))
 
     # -- compiled bodies -------------------------------------------------
 
-    def _prefill_impl(self, cache, tokens, slot, length, t):
+    def _prefill_impl(self, params, cache, tokens, slot, length, t):
         """tokens: [1, t] padded prompt; writes KV for one slot, returns
         logits at the last real position [vocab]."""
         slot_cache = {"k": lax_slice_slot(cache["k"], slot),
                       "v": lax_slice_slot(cache["v"], slot)}
         logits, new_slot_cache = forward_with_cache(
-            self.params, tokens, self.cfg, slot_cache,
+            params, tokens, self.cfg, slot_cache,
             jnp.zeros((1,), jnp.int32))
         cache = {
             "k": lax_write_slot(cache["k"], new_slot_cache["k"], slot),
@@ -109,18 +120,41 @@ class LLMEngine:
         last = logits[0, length - 1]
         return cache, last
 
-    def _decode_impl(self, cache, last_tokens, lengths, temps, rng):
-        """One token for every slot. last_tokens/lengths/temps: [slots].
-        `lengths` is the absolute position the fed token occupies."""
-        logits, cache = forward_with_cache(
-            self.params, last_tokens[:, None], self.cfg, cache, lengths)
-        logits = logits[:, 0, :].astype(jnp.float32)  # [slots, vocab]
-        greedy = logits.argmax(-1)
-        rng, sub = jax.random.split(rng)
-        sampled = jax.random.categorical(
-            sub, logits / jnp.maximum(temps, 1e-6)[:, None])
-        next_tokens = jnp.where(temps > 0, sampled, greedy)
-        return cache, next_tokens.astype(jnp.int32), rng
+    def _decode_impl(self, params, cache, last_tokens, lengths, temps,
+                     topks, rng):
+        """`decode_steps` tokens for every slot per dispatch, via an
+        in-program `lax.scan` (vLLM-style multi-step decoding): one
+        device execution amortizes the per-dispatch overhead over K
+        tokens — the lever that matters both for high-latency runtimes
+        and for launch overhead on real pods. Returns tokens
+        [slots, K]."""
+
+        def step(carry, _):
+            cache, tokens, lengths, rng = carry
+            logits, cache = forward_with_cache(
+                params, tokens[:, None], self.cfg, cache, lengths)
+            logits = logits[:, 0, :].astype(jnp.float32)  # [slots, vocab]
+            greedy = logits.argmax(-1)
+            # Per-slot top-k truncation: threshold at each slot's k-th
+            # largest logit (k clamped to _TOP_K_MAX — lax.top_k needs a
+            # static k, so one sorted prefix serves every slot).
+            kth_vals = jax.lax.top_k(logits, _TOP_K_MAX)[0]
+            idx = jnp.clip(topks - 1, 0, _TOP_K_MAX - 1)
+            thresh = jnp.take_along_axis(kth_vals, idx[:, None], axis=1)
+            truncated = jnp.where(logits < thresh, -jnp.inf, logits)
+            sample_logits = jnp.where((topks > 0)[:, None], truncated,
+                                      logits)
+            rng, sub = jax.random.split(rng)
+            sampled = jax.random.categorical(
+                sub, sample_logits / jnp.maximum(temps, 1e-6)[:, None])
+            next_tokens = jnp.where(temps > 0, sampled,
+                                    greedy).astype(jnp.int32)
+            return (cache, next_tokens, lengths + 1, rng), next_tokens
+
+        (cache, _, _, rng), toks = jax.lax.scan(
+            step, (cache, last_tokens, lengths, rng), None,
+            length=self.decode_steps)
+        return cache, toks.T, rng  # [slots, K]
 
     # -- public API ------------------------------------------------------
 
@@ -168,6 +202,7 @@ class LLMEngine:
 
     def _loop(self):
         self._temps_arr = np.zeros(self.n_slots, np.float32)
+        self._topks_arr = np.zeros(self.n_slots, np.int32)
         while self._running.is_set():
             admitted = self._admit()
             if not self._active.any():
@@ -197,7 +232,7 @@ class LLMEngine:
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :t_real] = prompt
             self.cache, last_logits = self._prefill(
-                self.cache, jnp.asarray(tokens),
+                self.params, self.cache, jnp.asarray(tokens),
                 jnp.int32(slot), jnp.int32(t_real), t=bucket)
             first = int(np.asarray(last_logits.argmax(-1))) \
                 if req.params.temperature == 0 else int(np.asarray(
@@ -214,6 +249,8 @@ class LLMEngine:
                 self._last_token[slot] = first
                 self._active[slot] = True
                 self._temps_arr[slot] = req.params.temperature
+                self._topks_arr[slot] = max(0, min(req.params.top_k,
+                                                   _TOP_K_MAX))
             if self._finished(req, first):
                 self._retire(slot)
             admitted = True
@@ -223,21 +260,26 @@ class LLMEngine:
         # The fed token occupies absolute position `lengths` (prompt is
         # 0..len-1, first generated token sits at len, etc.).
         self.cache, next_tokens, self._rng = self._decode(
-            self.cache, jnp.asarray(self._last_token),
+            self.params, self.cache, jnp.asarray(self._last_token),
             jnp.asarray(self._lengths), jnp.asarray(self._temps_arr),
-            self._rng)
-        next_host = np.asarray(next_tokens)
+            jnp.asarray(self._topks_arr), self._rng)
+        next_host = np.asarray(next_tokens)  # [slots, K]
         with self._lock:
             for slot in np.nonzero(self._active)[0]:
                 req = self._slot_req[slot]
-                tok = int(next_host[slot])
-                req.tokens.append(tok)
-                req.out_queue.put(tok)
-                self._lengths[slot] += 1
-                self._last_token[slot] = tok
-                if self._finished(req, tok) or \
-                        self._lengths[slot] >= self.max_seq - 1:
-                    self._retire(slot)
+                # Walk this slot's K-token block; once the request
+                # finishes mid-block the remaining tokens are padding
+                # compute and are discarded.
+                for k in range(next_host.shape[1]):
+                    tok = int(next_host[slot, k])
+                    req.tokens.append(tok)
+                    req.out_queue.put(tok)
+                    self._lengths[slot] += 1
+                    self._last_token[slot] = tok
+                    if self._finished(req, tok) or \
+                            self._lengths[slot] >= self.max_seq - 1:
+                        self._retire(slot)
+                        break
 
     def _finished(self, req: _Request, token: int) -> bool:
         if token in req.params.stop_token_ids:
@@ -275,10 +317,12 @@ class LLMDeployment:
 
     def __init__(self, cfg: LlamaConfig, params_fn: Callable[[], Any],
                  max_batch_size: int = 8,
-                 max_seq_len: Optional[int] = None):
+                 max_seq_len: Optional[int] = None,
+                 decode_steps: int = 1):
         params = params_fn() if callable(params_fn) else params_fn
         self.engine = LLMEngine(cfg, params, max_batch_size=max_batch_size,
-                                max_seq_len=max_seq_len)
+                                max_seq_len=max_seq_len,
+                                decode_steps=decode_steps)
         self.engine.start()
 
     def __call__(self, request: Dict[str, Any]):
