@@ -162,10 +162,14 @@ void TransferServer::HandleConn(int fd) {
     if (!ok) break;
   }
   {
+    // Notify while holding the lock: Stop()'s waiter may observe the
+    // empty set, return, and let the destructor destroy conn_cv_ — an
+    // unlocked notify_all would then touch a freed condvar (TSan-caught
+    // pthread_cond_destroy/broadcast race).
     std::lock_guard<std::mutex> g(conn_mu_);
     conn_fds_.erase(fd);
+    conn_cv_.notify_all();
   }
-  conn_cv_.notify_all();
   close(fd);
 }
 
