@@ -1,0 +1,77 @@
+"""RLlib north-star benchmark: environment samples/sec through the
+rollout-worker fleet + PPO train throughput.
+
+BASELINE.json lists "RLlib samples/sec" as a north star the reference
+measures nightly without committing an absolute number; this records ours
+for the CartPole PPO config the test suite learns with.
+
+Usage: python benchmarks/rl_bench.py [--iters 6] [--workers 4]
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=6)
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--envs-per-worker", type=int, default=4)
+    parser.add_argument("--fragment", type=int, default=256)
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.rl import PPOConfig
+
+    ray_tpu.init(num_cpus=max(8, args.workers * 2),
+                 ignore_reinit_error=True)
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=args.workers,
+                        num_envs_per_worker=args.envs_per_worker,
+                        rollout_fragment_length=args.fragment)
+              .training(lr=3e-3, num_sgd_iter=8, sgd_minibatch_size=256)
+              .debugging(seed=0))
+    algo = config.build()
+
+    algo.train()  # warm-up iteration: compiles the update program
+    samples = 0
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        result = algo.train()
+        samples += result.get("num_env_steps_sampled_this_iter",
+                              args.workers * args.envs_per_worker *
+                              args.fragment)
+    wall = time.perf_counter() - t0
+    reward = result.get("episode_reward_mean", 0.0)
+    algo.cleanup()
+    ray_tpu.shutdown()
+
+    print(json.dumps({
+        "metric": "rl_env_samples_per_s",
+        "value": round(samples / wall, 1),
+        "unit": "env_steps/s",
+        "detail": {
+            "algo": "PPO", "env": "CartPole-v1",
+            "host_cpus": os.cpu_count(),
+            "workers": args.workers,
+            "envs_per_worker": args.envs_per_worker,
+            "fragment": args.fragment,
+            "iters": args.iters,
+            "train_iters_per_s": round(args.iters / wall, 3),
+            "episode_reward_mean": round(float(reward), 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -580,7 +580,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None,
                     use_pallas: Optional[bool] = None):
     """Flash attention over [batch, seq, heads, head_dim] tensors.

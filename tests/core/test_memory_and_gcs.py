@@ -70,6 +70,8 @@ def test_memory_monitor_retries_retriable_task(ray_local):
 
     import tempfile
 
+    import os
+
     with tempfile.TemporaryDirectory() as d:
         ref = flaky.remote(d)
         monitor = MemoryMonitor(backend, usage_fn=lambda: 0.99)
@@ -77,7 +79,10 @@ def test_memory_monitor_retries_retriable_task(ray_local):
         killed = False
         while time.monotonic() < deadline and not killed:
             pool = backend._worker_pool
-            if pool is not None and pool.active:
+            # Only kill once the first attempt has provably started (its
+            # marker exists) — killing during worker startup would leave
+            # the retry seeing a single marker.
+            if pool is not None and pool.active and os.listdir(d):
                 killed = monitor.kill_one(0.99)
             time.sleep(0.05)
         assert killed
