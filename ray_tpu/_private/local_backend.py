@@ -119,7 +119,15 @@ class _Actor:
             self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
         while True:
-            item = self.mailbox.get()
+            try:
+                item = self.mailbox.get(timeout=0.5)
+            except queue.Empty:
+                # Sentinel counting can undercount when a kill races
+                # start() mid-spawn; the periodic state check guarantees
+                # every executor thread exits after death regardless.
+                if self.state == ActorState.DEAD:
+                    return
+                continue
             if item is None:
                 return
             if self.state == ActorState.DEAD:

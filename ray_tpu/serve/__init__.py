@@ -94,19 +94,51 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
 def run(target, *, name: str = "default", route_prefix: Optional[str] = None,
         _blocking: bool = True) -> ServeHandle:
-    """Deploy an Application (or bare Deployment). Reference:
-    `serve.run` (`serve/api.py`)."""
+    """Deploy an Application — or a *deployment graph*: bound arguments
+    that are themselves Applications deploy first and arrive in the
+    parent's constructor as ServeHandles, composing multi-model
+    pipelines (reference: `serve/_private/deployment_graph_build.py` +
+    `serve/drivers.py` DAGDriver)."""
     if isinstance(target, Deployment):
         target = target.bind()
     if not isinstance(target, Application):
         raise TypeError(f"serve.run expects a bound deployment, got "
                         f"{type(target)}")
+    handle = _deploy_application(target, {}, _blocking)
     dep = target.deployment
+    prefix = route_prefix if route_prefix is not None else dep.route_prefix
+    if prefix is not None:
+        start_http_proxy().routes.set(prefix, handle)
+    return handle
+
+
+def _resolve_bound(value, seen: dict, blocking: bool):
+    if isinstance(value, Application):
+        return _deploy_application(value, seen, blocking)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_bound(v, seen, blocking)
+                           for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_bound(v, seen, blocking)
+                for k, v in value.items()}
+    return value
+
+
+def _deploy_application(app: Application, seen: dict,
+                        blocking: bool = True) -> ServeHandle:
+    """Deploy one node of a graph (children first, depth-first). The
+    same bound node appearing twice (diamond graphs) deploys once."""
+    if id(app) in seen:
+        return seen[id(app)]
+    dep = app.deployment
+    init_args = tuple(_resolve_bound(a, seen, blocking) for a in app.args)
+    init_kwargs = {k: _resolve_bound(v, seen, blocking)
+                   for k, v in app.kwargs.items()}
     controller = get_or_create_controller()
     info = {
         "cls": dep.func_or_class,
-        "init_args": target.args,
-        "init_kwargs": target.kwargs,
+        "init_args": init_args,
+        "init_kwargs": init_kwargs,
         "num_replicas": dep.num_replicas,
         "user_config": dep.user_config,
         "max_concurrent_queries": dep.max_concurrent_queries,
@@ -115,13 +147,11 @@ def run(target, *, name: str = "default", route_prefix: Optional[str] = None,
         "version": dep.version,
     }
     ray_tpu.get(controller.deploy.remote(dep.name, info))
-    if _blocking:
+    if blocking:
         _wait_healthy(controller, dep.name)
     handle = ServeHandle(controller, dep.name,
                          dep.max_concurrent_queries)
-    prefix = route_prefix if route_prefix is not None else dep.route_prefix
-    if prefix is not None:
-        start_http_proxy().routes.set(prefix, handle)
+    seen[id(app)] = handle
     return handle
 
 
@@ -133,6 +163,30 @@ def _wait_healthy(controller, name: str, timeout: float = 30.0):
             return
         time.sleep(0.02)
     raise TimeoutError(f"deployment {name} not healthy after {timeout}s")
+
+
+@deployment
+class DAGDriver:
+    """HTTP entry point for a deployment graph (reference:
+    `serve/drivers.py` DAGDriver): routes each request into the bound
+    graph's root handle and returns its result.
+
+    Usage::
+
+        graph = Combiner.bind(ModelA.bind(), ModelB.bind())
+        serve.run(serve.DAGDriver.bind(graph), route_prefix="/pipeline")
+    """
+
+    def __init__(self, root_handle, http_adapter=None):
+        self.root = root_handle
+        self.http_adapter = http_adapter
+
+    def __call__(self, request=None):
+        if self.http_adapter is not None:
+            request = self.http_adapter(request)
+        ref = self.root.remote(request) if request is not None \
+            else self.root.remote()
+        return ray_tpu.get(ref, timeout=60)
 
 
 def get_deployment_handle(name: str, *_args, **_kwargs) -> ServeHandle:

@@ -185,8 +185,13 @@ class ServeController:
             # Replicas serve queries concurrently up to the queries cap
             # (the reference replica is an asyncio actor).
             opts: Dict[str, Any] = {
-                "max_concurrency": int(
-                    info.get("max_concurrent_queries") or 100),
+                # The router already enforces max_concurrent_queries as
+                # the in-flight cap; the replica needs only enough
+                # executor threads for real parallelism — one OS thread
+                # per queued query (100 threads x N replicas) starves
+                # small hosts.
+                "max_concurrency": min(
+                    int(info.get("max_concurrent_queries") or 100), 16),
             }
             res = dict(info.get("ray_actor_options") or {})
             if "num_cpus" in res:

@@ -53,15 +53,24 @@ class LongPollClient:
 
     def _loop(self):
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError, ActorError
 
         while not self._stopped.is_set():
             try:
                 version, snapshot = ray_tpu.get(
                     self._controller.listen.remote(self._key, self._version),
                     timeout=60)
+            except (ActorDiedError, ActorError):
+                # Controller is gone (serve.shutdown / crash): this
+                # client is permanently orphaned — exit instead of
+                # spinning error objects forever.
+                return
             except Exception:
                 if self._stopped.is_set():
                     return
+                # Transient failure: back off — a hot retry loop against
+                # a broken controller starves every other thread.
+                self._stopped.wait(0.5)
                 continue
             if version > self._version:
                 self._version = version

@@ -159,11 +159,15 @@ class LLMEngine:
     # -- public API ------------------------------------------------------
 
     def start(self):
-        if self._thread is None or not self._thread.is_alive():
-            self._running.set()
-            self._thread = threading.Thread(target=self._loop, daemon=True,
-                                            name="llm-engine")
-            self._thread.start()
+        # Under the lock: concurrent generate() callers must never spawn
+        # two engine loops — dueling loops double-assign slots and feed
+        # the donated cache twice, silently losing requests.
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._running.set()
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="llm-engine")
+                self._thread.start()
 
     def stop(self):
         self._running.clear()
