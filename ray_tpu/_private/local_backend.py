@@ -82,8 +82,16 @@ class _Actor:
             self._threads.append(t)
 
     def _construct(self) -> bool:
-        """Run the constructor; returns True on success."""
+        """Run the constructor; returns True on success. Pushes task
+        context (so tasks submitted from __init__ join the caller's
+        trace) and records a construction span."""
         spec = self.spec
+        ctx = self.backend.worker.task_context
+        events = self.backend.worker.task_events
+        ctx.push(task_spec=spec, node_id=self.backend.node_id, pool=None,
+                 request=None)
+        events.task_started(spec, self.backend.node_id,
+                            threading.current_thread().name)
         try:
             if spec.isolate_process:
                 # The instance lives in a dedicated worker process; the
@@ -98,14 +106,18 @@ class _Actor:
                 self.instance = spec.func(*spec.args, **spec.kwargs)
             self.state = ActorState.ALIVE
             self.backend.worker.store_task_outputs(spec, [None])
+            events.task_finished(spec)
             return True
         except BaseException as e:  # noqa: BLE001 - constructor error kills actor
             self.state = ActorState.DEAD
             self.death_cause = f"constructor raised {type(e).__name__}: {e}"
             err = exc.TaskError(e, spec.describe())
             self.backend.worker.store_task_outputs(spec, None, error=err)
+            events.task_finished(spec, error=f"{type(e).__name__}: {e}")
             self.backend._on_actor_death(self, err)
             return False
+        finally:
+            ctx.pop()
 
     def _run_loop(self):
         # Only the first thread constructs; others wait until alive.

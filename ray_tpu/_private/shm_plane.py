@@ -216,8 +216,22 @@ class SharedPlane:
                 pass
         self.store.close()
 
-    def destroy(self):
-        self.close()
+    def destroy(self, unmap: bool = True):
+        """Tear the segment down. ``unmap=False`` unlinks the name but
+        leaves the mapping intact: in-flight readers on other threads
+        (driver fetch loops during cluster shutdown) would otherwise
+        fault on unmapped memory; the pages free at process exit."""
+        if unmap:
+            self.close()
+        else:
+            with self._lock:
+                pinned, self._pinned = list(self._pinned), set()
+            for oid in pinned:
+                try:
+                    self.store.release(oid)
+                except Exception:
+                    pass
+            self.store.stop_transfer_server()
         try:
             self.store._lib.shm_store_destroy(self.name.encode())
         except Exception:

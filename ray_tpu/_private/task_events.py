@@ -15,6 +15,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private.task_spec import trace_id_of as _trace_id_of
+
 
 @dataclass
 class TaskEvent:
@@ -28,6 +30,9 @@ class TaskEvent:
     worker: str = ""
     error: str = ""
     actor_id: Optional[str] = None
+    # Span linkage: the task's own id is its span id.
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     def duration_s(self) -> Optional[float]:
         if self.end_s is None:
@@ -48,7 +53,10 @@ class TaskEventBuffer:
             kind=spec.kind.name, state="RUNNING",
             start_s=time.time(), node_id=node_id.hex(),
             worker=worker_name,
-            actor_id=spec.actor_id.hex() if spec.actor_id else None)
+            actor_id=spec.actor_id.hex() if spec.actor_id else None,
+            trace_id=_trace_id_of(spec),
+            parent_span_id=(spec.trace_parent[1] if spec.trace_parent
+                            else ""))
         with self._lock:
             self._events[ev.task_id] = ev
             while len(self._events) > self._max:

@@ -53,6 +53,19 @@ class PlacementGroupSchedulingStrategy(SchedulingStrategy):
     placement_group_capture_child_tasks: bool = False
 
 
+def trace_id_of(spec) -> str:
+    """A task's trace id: inherited from its submitter, or rooted at
+    itself (single source of truth for the derivation)."""
+    return spec.trace_parent[0] if spec.trace_parent \
+        else spec.task_id.hex()
+
+
+def trace_parent_from(parent_spec) -> tuple:
+    """The submitting task's span becomes the child's parent; the trace
+    id is inherited (or rooted at the submitting task)."""
+    return (trace_id_of(parent_spec), parent_spec.task_id.hex())
+
+
 def check_isolate_process(value):
     """isolate_process accepts False (in-thread), True (forked worker),
     or "spawn" (fresh interpreter); anything else is a typo that would
@@ -103,6 +116,10 @@ class TaskSpec:
     return_ids: list = field(default_factory=list)
     # Depth for scheduling fairness / detection of recursive deadlock
     depth: int = 0
+    # Distributed tracing: (trace_id_hex, parent_span_id_hex) propagated
+    # from the submitting task (reference: tracing_helper.py span
+    # context in task metadata).
+    trace_parent: Optional[tuple] = None
 
     def dependencies(self) -> list[ObjectID]:
         """ObjectIDs appearing at the top level of args/kwargs."""

@@ -15,6 +15,7 @@ from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    trace_parent_from,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
@@ -91,6 +92,8 @@ class ActorHandle:
             max_retries=self._max_task_retries,
             actor_id=self._actor_id,
             sequence_number=seq,
+            trace_parent=(trace_parent_from(_ctx["task_spec"])
+                          if (_ctx := w.task_context.current()) else None),
         )
         refs = w.submit(spec)
         return refs[0] if num_returns == 1 else refs
@@ -168,6 +171,8 @@ class ActorClass:
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
             isolate_process=check_isolate_process(opts.get("isolate_process", False)),
+            trace_parent=(trace_parent_from(_ctx["task_spec"])
+                          if (_ctx := w.task_context.current()) else None),
         )
         handle = ActorHandle(
             actor_id, self._cls, name, opts.get("max_task_retries", 0)

@@ -1046,6 +1046,12 @@ class Cluster:
             self.remove_node(node_id)
         self.head.server.shutdown()
         if self.shm_plane is not None:
-            self.shm_plane.destroy()
+            # Detach from the worker first (new fetches skip shm), then
+            # unlink WITHOUT unmapping: a fetch thread mid-read keeps a
+            # valid mapping instead of segfaulting on teardown.
+            if getattr(self.driver_worker, "shm_plane", None) \
+                    is self.shm_plane:
+                self.driver_worker.shm_plane = None
+            self.shm_plane.destroy(unmap=False)
             self.shm_plane = None
         worker_mod.shutdown()

@@ -1,0 +1,82 @@
+"""Distributed tracing: task spans with cross-task parent linkage.
+
+Role-equivalent to the reference's OpenTelemetry integration
+(`ray.init(_tracing_startup_hook=...)` + `tracing_helper.py`, which
+monkey-wraps remote calls to propagate span context through task
+metadata): here the span context rides the TaskSpec itself
+(`trace_parent`), every execution records a span in the task-event
+buffer, and this module exports them in an OTLP-shaped JSON form any
+OpenTelemetry backend can ingest after a trivial transform. No network
+exporter is wired (the image has no collector); `export_spans()` returns
+the list, `save_spans(path)` writes it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def export_spans(worker=None) -> List[Dict[str, Any]]:
+    """All recorded task spans, OTLP-shaped: traceId / spanId /
+    parentSpanId / name / kind / start-end (ns) / status / attributes."""
+    import time
+
+    w = worker or worker_mod.global_worker()
+    spans = []
+    # The full buffer, not list_events' default 10k tail — a truncated
+    # export would drop trace roots out from under their children.
+    for ev in w.task_events.list_events(limit=w.task_events._max):
+        running = ev.end_s is None
+        end = time.time() if running else ev.end_s
+        spans.append({
+            "traceId": ev.trace_id or ev.task_id,
+            "spanId": ev.task_id,
+            "parentSpanId": ev.parent_span_id or None,
+            "name": ev.name,
+            "kind": "SPAN_KIND_INTERNAL",
+            "startTimeUnixNano": int(ev.start_s * 1e9),
+            "endTimeUnixNano": int(end * 1e9),
+            # A still-running task must not export as a completed OK
+            # span; UNSET + live end time mirrors chrome_trace.
+            "status": {"code": "STATUS_CODE_ERROR" if ev.error
+                       else ("STATUS_CODE_UNSET" if running
+                             else "STATUS_CODE_OK"),
+                       "message": ev.error},
+            "attributes": {
+                "ray_tpu.task_kind": ev.kind,
+                "ray_tpu.node_id": ev.node_id,
+                "ray_tpu.worker": ev.worker,
+                "ray_tpu.actor_id": ev.actor_id or "",
+                "ray_tpu.state": ev.state,
+            },
+        })
+    return spans
+
+
+def get_trace(trace_id: str, worker=None) -> List[Dict[str, Any]]:
+    """Spans belonging to one trace, in start-time order."""
+    spans = [s for s in export_spans(worker) if s["traceId"] == trace_id]
+    spans.sort(key=lambda s: s["startTimeUnixNano"])
+    return spans
+
+
+def save_spans(path: str, worker=None) -> int:
+    spans = export_spans(worker)
+    with open(path, "w") as f:
+        json.dump(spans, f)
+    return len(spans)
+
+
+def current_trace_id(worker=None) -> Optional[str]:
+    """The trace id of the currently executing task (None in the driver
+    outside any task)."""
+    w = worker or worker_mod.global_worker()
+    from ray_tpu._private.task_spec import trace_id_of
+
+    ctx = w.task_context.current()
+    if ctx is None:
+        return None
+    return trace_id_of(ctx["task_spec"])

@@ -15,6 +15,7 @@ from ray_tpu._private.ids import TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    trace_parent_from,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
@@ -83,6 +84,8 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             isolate_process=check_isolate_process(opts.get("isolate_process", False)),
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
+            trace_parent=(trace_parent_from(ctx["task_spec"])
+                          if ctx else None),
         )
         refs = w.submit(spec)
         if num_returns == 0:

@@ -117,16 +117,13 @@ def test_actor_restart_on_node_death(cluster):
     first_pid = ray_tpu.get(c.pid.remote(), timeout=60)
     assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
 
-    cluster.kill_node(node if cluster.head.actor_nodes else node)
-    # Kill whichever node hosts the actor.
-    host = None
-    for aid, nid in list(cluster.head.actor_nodes.items()):
-        host = nid
-    if host and host != node:
-        cluster.kill_node(host)
-    _wait_for(lambda: all(not n.alive or n.node_id not in (node, host)
-                          for n in cluster.head.nodes.values()
-                          if n.node_id in (node, host)),
+    # Kill exactly the node hosting the actor (recorded BEFORE the kill:
+    # reading it afterwards can race the health checker's restart and
+    # kill the actor's *new* home too, exhausting the restart budget).
+    host = next(iter(cluster.head.actor_nodes.values()))
+    assert host in (node, node2)
+    cluster.kill_node(host)
+    _wait_for(lambda: not cluster.head.nodes[host].alive,
               msg="dead node detected")
 
     # After restart the actor lives on the surviving node with fresh

@@ -1,0 +1,84 @@
+"""Distributed tracing spans: parent linkage across task/actor hops.
+
+Reference role: OpenTelemetry span propagation (`tracing_helper.py`);
+here span context rides the TaskSpec and exports OTLP-shaped JSON.
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import tracing
+
+
+@pytest.fixture
+def ray_local():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_nested_task_spans_link(ray_local):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) * 10
+
+    ref = parent.remote(1)
+    assert ray_tpu.get(ref, timeout=60) == 20
+
+    spans = tracing.export_spans()
+    p = next(s for s in spans if s["name"].endswith(".parent"))
+    c = next(s for s in spans if s["name"].endswith(".child"))
+    # Root span: its own id is the trace id, no parent.
+    assert p["traceId"] == p["spanId"] and p["parentSpanId"] is None
+    # Child joins the parent's trace with correct linkage.
+    assert c["traceId"] == p["spanId"]
+    assert c["parentSpanId"] == p["spanId"]
+    assert c["status"]["code"] == "STATUS_CODE_OK"
+
+    trace = tracing.get_trace(p["traceId"])
+    assert [s["name"].rsplit(".", 1)[-1] for s in trace] == \
+        ["parent", "child"]
+
+
+def test_actor_call_spans_link(ray_local):
+    @ray_tpu.remote
+    class A:
+        def f(self, x):
+            return x * 2
+
+    @ray_tpu.remote
+    def driver_task(handle):
+        return ray_tpu.get(handle.f.remote(21))
+
+    a = A.remote()
+    assert ray_tpu.get(driver_task.remote(a), timeout=60) == 42
+    spans = tracing.export_spans()
+    task_span = next(s for s in spans if s["name"].endswith("driver_task"))
+    method_span = next(s for s in spans if s["name"] == "A.f")
+    assert method_span["traceId"] == task_span["traceId"]
+    assert method_span["parentSpanId"] == task_span["spanId"]
+    assert method_span["attributes"]["ray_tpu.task_kind"] == "ACTOR_TASK"
+
+
+def test_error_span_status_and_save(ray_local, tmp_path):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("traced failure")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(boom.remote(), timeout=60)
+    spans = tracing.export_spans()
+    err = next(s for s in spans if s["name"].endswith("boom"))
+    assert err["status"]["code"] == "STATUS_CODE_ERROR"
+    assert "traced failure" in err["status"]["message"]
+
+    path = tmp_path / "spans.json"
+    n = tracing.save_spans(str(path))
+    assert n == len(json.loads(path.read_text()))
