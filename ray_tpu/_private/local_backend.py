@@ -224,6 +224,14 @@ class LocalBackend:
             self._submit_actor_task(spec)
             return
         if spec.kind == TaskKind.ACTOR_CREATION:
+            existing = self._actors.get(spec.actor_id)
+            if existing is not None and \
+                    existing.state != ActorState.DEAD:
+                # Duplicate creation (e.g. a node-death sweep re-driving
+                # a spec that also took the normal path): creating a
+                # second instance would strand queued calls in a mailbox
+                # whose creation can never get resources.
+                return
             # Register the mailbox immediately so method calls submitted
             # before the creation task is dispatched are queued, mirroring
             # the reference's client-side queueing while an actor is

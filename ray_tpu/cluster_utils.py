@@ -208,6 +208,10 @@ class ClusterHead:
         with self._lock:
             self.inflight[spec.task_id.binary()] = (node_id, spec)
 
+    def clear_inflight(self, spec) -> None:
+        with self._lock:
+            self.inflight.pop(spec.task_id.binary(), None)
+
     # -- health checking -------------------------------------------------
 
     def _ensure_health_checker(self):
@@ -774,11 +778,20 @@ class ClusterBackendMixin:
                 local_oids.append(arg.id.binary())
         if local_oids:
             self.head._report_objects(local_oids, self.head.server.address)
-        # Lineage before the wire (resubmittable even if we crash right
-        # after the send); in-flight only on acceptance.
+        # Lineage + in-flight BEFORE the wire: a fast task can execute
+        # and report its outputs before this function returns, and that
+        # report must find (and clear) the in-flight entry — recording
+        # after the ack leaves a stale entry that a later node-death
+        # sweep would re-drive as a duplicate. On send failure the entry
+        # is cleared before the caller's mark_node_dead sweep runs, so
+        # only the caller retries.
         self.head.record_lineage(spec)
-        RpcClient.to(node.address).call("submit_task", spec=spec)
         self.head.record_inflight(spec, node.node_id)
+        try:
+            RpcClient.to(node.address).call("submit_task", spec=spec)
+        except BaseException:
+            self.head.clear_inflight(spec)
+            raise
 
     # Delegate everything else to the local backend.
 
