@@ -12,21 +12,46 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
     WorkerSet,
 )
 from ray_tpu.rl.algorithms import (  # noqa: F401
+    A2C,
+    A2CConfig,
+    BC,
+    BCConfig,
     DQN,
     DQNConfig,
     IMPALA,
     IMPALAConfig,
+    MARWIL,
+    MARWILConfig,
     PPO,
     PPOConfig,
+    SAC,
+    SACConfig,
+)
+from ray_tpu.rl.connectors import (  # noqa: F401
+    ClipAction,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+    UnsquashAction,
 )
 from ray_tpu.rl.env import (  # noqa: F401
     Box,
     CartPoleEnv,
     Discrete,
     Env,
+    MultiAgentEnv,
+    PendulumEnv,
     VectorEnv,
     make_env,
     register_env,
+)
+from ray_tpu.rl.multi_agent import MultiAgentRolloutWorker  # noqa: F401
+from ray_tpu.rl.offline import (  # noqa: F401
+    InputReader,
+    JsonReader,
+    JsonWriter,
 )
 from ray_tpu.rl.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,

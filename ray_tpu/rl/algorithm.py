@@ -26,6 +26,10 @@ class AlgorithmConfig:
         self.num_rollout_workers: int = 2
         self.num_envs_per_worker: int = 1
         self.rollout_fragment_length: int = 200
+        # Connector pipelines (ray_tpu.rl.connectors); pickled out to
+        # each worker, so every worker gets its own copy.
+        self.obs_connectors: Any = None
+        self.action_connectors: Any = None
         self.train_batch_size: int = 2000
         self.lr: float = 5e-4
         self.gamma: float = 0.99
@@ -42,13 +46,19 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers=None,
                  num_envs_per_worker=None,
-                 rollout_fragment_length=None) -> "AlgorithmConfig":
+                 rollout_fragment_length=None,
+                 obs_connectors=None,
+                 action_connectors=None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if obs_connectors is not None:
+            self.obs_connectors = obs_connectors
+        if action_connectors is not None:
+            self.action_connectors = action_connectors
         return self
 
     env_runners = rollouts  # new-stack alias
@@ -97,13 +107,24 @@ class WorkerSet:
                 env_config=config.env_config,
                 rollout_fragment_length=config.rollout_fragment_length,
                 seed=config.seed + 1000 * (i + 1),
-                policy_kind=policy_kind)
+                policy_kind=policy_kind,
+                obs_connectors=config.obs_connectors,
+                action_connectors=config.action_connectors)
             for i in range(max(1, config.num_rollout_workers))
         ]
 
     def sample(self, weights) -> List:
         ref_w = ray_tpu.put(weights)
         return ray_tpu.get([w.sample.remote(ref_w) for w in self.workers])
+
+    def connector_state(self):
+        """State of worker 0's connector pipelines (the canonical copy
+        for checkpointing)."""
+        return ray_tpu.get(self.workers[0].connector_state.remote())
+
+    def set_connector_state(self, state):
+        ray_tpu.get([w.set_connector_state.remote(state)
+                     for w in self.workers])
 
     def episode_stats(self) -> List:
         out = []
@@ -171,10 +192,15 @@ class Algorithm(Trainable):
     def save_checkpoint(self):
         import jax
 
-        return {"weights": jax.device_get(self.get_weights())}
+        ckpt = {"weights": jax.device_get(self.get_weights())}
+        if hasattr(self, "workers"):
+            ckpt["connectors"] = self.workers.connector_state()
+        return ckpt
 
     def load_checkpoint(self, data):
         self.set_weights(data["weights"])
+        if data.get("connectors") and hasattr(self, "workers"):
+            self.workers.set_connector_state(data["connectors"])
 
     def get_weights(self):
         raise NotImplementedError

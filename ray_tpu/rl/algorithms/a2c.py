@@ -1,0 +1,120 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference: `rllib/algorithms/a2c/` (sync variant of A3C) — collect one
+synchronized batch of fragments from the worker fleet, compute GAE
+advantages, take one gradient step on the combined actor-critic loss.
+The simplest on-policy algorithm; shares the rollout/GAE machinery with
+PPO but no ratio clipping and a single update per batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.algorithms.ppo import compute_gae
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    OBS,
+    REWARDS,
+    TARGETS,
+    VALUES,
+)
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(A2C)
+        self.lambda_ = 1.0          # plain n-step returns by default
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 0.5
+
+
+class A2C(Algorithm):
+    config_cls = A2CConfig
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        n_actions = env.action_space.n
+        self.params = models.actor_critic_init(
+            jax.random.PRNGKey(cfg.seed), obs_dim, n_actions)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self.workers = WorkerSet(cfg, models.actor_critic_apply)
+        self._update = jax.jit(functools.partial(
+            _a2c_update, tx=self.tx, vf_coeff=cfg.vf_coeff,
+            entropy_coeff=cfg.entropy_coeff))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batches = self.workers.sample(self.params)
+        from ray_tpu.rl.sample_batch import SampleBatch
+
+        batch = SampleBatch.concat(batches)  # [N, T, ...]
+        last_obs = batch["next_obs"][:, -1]
+        _, last_values = models.actor_critic_apply(
+            self.params, jnp.asarray(last_obs))
+        adv, targets = compute_gae(
+            np.asarray(batch[REWARDS]), np.asarray(batch[VALUES]),
+            np.asarray(batch[DONES]), np.asarray(last_values),
+            cfg.gamma, cfg.lambda_)
+        flat = {
+            OBS: np.asarray(batch[OBS]).reshape(-1, batch[OBS].shape[-1]),
+            ACTIONS: np.asarray(batch[ACTIONS]).ravel(),
+            ADVANTAGES: adv.ravel(),
+            TARGETS: targets.ravel(),
+        }
+        a = flat[ADVANTAGES]
+        flat[ADVANTAGES] = (a - a.mean()) / (a.std() + 1e-8)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in flat.items()})
+        return {
+            "policy_loss": float(stats["pi_loss"]),
+            "vf_loss": float(stats["vf_loss"]),
+            "entropy": float(stats["entropy"]),
+            "num_env_steps_sampled_this_iter": int(
+                np.asarray(batch[REWARDS]).size),
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.opt_state = self.tx.init(self.params)
+
+
+def _a2c_update(params, opt_state, batch, *, tx, vf_coeff, entropy_coeff):
+    def loss_fn(params):
+        logits, values = models.actor_critic_apply(params, batch[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch[ACTIONS][:, None],
+                                   axis=1)[:, 0]
+        pi_loss = -(logp * batch[ADVANTAGES]).mean()
+        vf_loss = 0.5 * ((values - batch[TARGETS]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, stats

@@ -1,0 +1,175 @@
+"""BC and MARWIL: offline / imitation learning.
+
+Reference: `rllib/algorithms/bc/` and `rllib/algorithms/marwil/` — BC is
+plain behavioral cloning (maximize log-likelihood of dataset actions);
+MARWIL weights the cloning term by exponentiated advantages
+(`exp(beta * A / c)`) estimated with a learned value function, so better
+trajectories are imitated harder. BC is exactly MARWIL with beta=0 (the
+reference implements it that way too).
+
+Data comes from an `InputReader` (JSONL files recorded by `JsonWriter`,
+or any SampleBatch source) instead of live rollout workers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.offline import InputReader, JsonReader
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(MARWIL)
+        self.beta = 1.0             # advantage-weighting temperature
+        self.vf_coeff = 1.0
+        self.grad_clip = 0.5
+        self.input_ = None          # path / list of paths / InputReader
+        self.train_batch_size = 512
+        self.num_rollout_workers = 0
+
+    def offline_data(self, *, input_=None) -> "MARWILConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self.beta = 0.0
+
+
+class MARWIL(Algorithm):
+    config_cls = MARWILConfig
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        n_actions = env.action_space.n
+        self.params = models.actor_critic_init(
+            jax.random.PRNGKey(cfg.seed), obs_dim, n_actions)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        inp = cfg.input_
+        self.reader: InputReader = (inp if isinstance(inp, InputReader)
+                                    else JsonReader(inp))
+        # MA advantage normalizer (running mean of squared advantages,
+        # the reference's `c^2` estimate).
+        self._c2 = 1.0
+        self._update = jax.jit(functools.partial(
+            _marwil_update, tx=self.tx, beta=cfg.beta,
+            vf_coeff=cfg.vf_coeff))
+
+    def _reward_to_go(self, rew: np.ndarray, done: np.ndarray):
+        """Discounted reward-to-go within ONE time-ordered trajectory
+        array [T] (resets at dones). Must NOT be applied across
+        env/fragment joins — callers compute it per fragment."""
+        returns = np.zeros_like(rew)
+        acc = 0.0
+        for i in range(len(rew) - 1, -1, -1):
+            acc = rew[i] + self.algo_config.gamma * acc * (1.0 - done[i])
+            returns[i] = acc
+        return returns
+
+    def _next_train_batch(self) -> SampleBatch:
+        cfg = self.algo_config
+        rows, count = [], 0
+        while count < cfg.train_batch_size:
+            b = self.reader.next()
+            rew = np.asarray(b[REWARDS], np.float32)
+            done = (np.asarray(b[DONES]).astype(np.float32)
+                    if DONES in b else np.zeros_like(rew))
+            # Returns are computed per fragment per env row BEFORE any
+            # flatten/concat: a single backward pass over joined rows
+            # would leak one trajectory's rewards into another's.
+            if rew.ndim == 2:  # [N, T] rollout fragments
+                returns = np.stack([
+                    self._reward_to_go(rew[i], done[i])
+                    for i in range(rew.shape[0])])
+            else:
+                returns = self._reward_to_go(rew, done)
+            b = SampleBatch({**b, "returns": returns})
+            # Flatten [N, T, ...] fragments to [N*T, ...] rows.
+            if np.asarray(b[OBS]).ndim == 3:
+                b = SampleBatch({
+                    k: np.asarray(v).reshape(
+                        -1, *np.asarray(v).shape[2:])
+                    for k, v in b.items()})
+            rows.append(b)
+            count += b.count
+        return SampleBatch.concat(rows)
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = self._next_train_batch()
+        data = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS]).astype(
+                np.int32)),
+            "returns": jnp.asarray(np.asarray(batch["returns"],
+                                              np.float32)),
+        }
+        self.params, self.opt_state, stats, c2 = self._update(
+            self.params, self.opt_state, data, jnp.float32(self._c2))
+        self._c2 = float(c2)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.opt_state = self.tx.init(self.params)
+
+
+class BC(MARWIL):
+    config_cls = BCConfig
+
+
+def _marwil_update(params, opt_state, data, c2, *, tx, beta, vf_coeff):
+    def loss_fn(params):
+        logits, values = models.actor_critic_apply(params, data[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, data[ACTIONS][:, None],
+                                   axis=1)[:, 0]
+        adv = data["returns"] - values
+        if beta > 0.0:
+            w = jnp.exp(beta * jax.lax.stop_gradient(
+                adv / jnp.sqrt(c2 + 1e-8)))
+            w = jnp.minimum(w, 20.0)  # explosion guard (reference cap)
+        else:
+            w = jnp.ones_like(logp)
+        pi_loss = -(w * logp).mean()
+        vf_loss = (adv ** 2).mean()
+        total = pi_loss + (vf_coeff * vf_loss if beta > 0.0 else 0.0)
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "mean_weight": w.mean(),
+                       "adv2": jax.lax.stop_gradient((adv ** 2).mean())}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    # Polyak-update the advantage scale estimate.
+    c2 = 0.99 * c2 + 0.01 * stats.pop("adv2")
+    return params, opt_state, stats, c2

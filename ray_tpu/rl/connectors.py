@@ -1,0 +1,140 @@
+"""Connectors: composable obs/action transformation pipelines.
+
+Reference: `rllib/connectors/` — small stateless-or-stateful transforms
+chained between env and policy (agent/obs connectors) and between policy
+and env (action connectors). Configure via
+`AlgorithmConfig.rollouts(obs_connectors=..., action_connectors=...)`;
+each RolloutWorker gets its own (pickled) copy. Stateful connector state
+(e.g. NormalizeObs running stats) is worker-local during training;
+`Algorithm.save_checkpoint` captures worker 0's state and restore pushes
+it to every worker, so evaluation sees the training-time preprocessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Connector:
+    """One transform. `__call__` maps a batched array to a batched array."""
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: Sequence[Connector] = ()):
+        self.connectors: List[Connector] = list(connectors)
+
+    def append(self, c: Connector) -> "ConnectorPipeline":
+        self.connectors.append(c)
+        return self
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def get_state(self) -> Dict[str, Any]:
+        return {str(i): c.get_state()
+                for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for i, c in enumerate(self.connectors):
+            if str(i) in state:
+                c.set_state(state[str(i)])
+
+
+# -- obs connectors ---------------------------------------------------------
+
+
+class FlattenObs(Connector):
+    """[B, ...] → [B, prod(...)] (reference flatten preprocessor)."""
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        return x.reshape(x.shape[0], -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, x):
+        return np.clip(x, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std normalization (reference MeanStdFilter). State
+    (count/mean/m2) rides along with policy weights via get/set_state."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: Optional[float] = 10.0,
+                 update: bool = True):
+        self.eps = epsilon
+        self.clip = clip
+        self.update = update
+        self.count = 0.0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float64)
+        if self.mean is None:
+            self.mean = np.zeros(x.shape[1:], np.float64)
+            self.m2 = np.zeros(x.shape[1:], np.float64)
+        if self.update:
+            # Chan parallel-update of count/mean/M2 with the batch stats.
+            bc = float(len(x))
+            bmean = x.mean(0)
+            bm2 = ((x - bmean) ** 2).sum(0)
+            delta = bmean - self.mean
+            tot = self.count + bc
+            self.mean = self.mean + delta * bc / max(tot, 1.0)
+            self.m2 = self.m2 + bm2 + delta ** 2 * self.count * bc \
+                / max(tot, 1.0)
+            self.count = tot
+        var = self.m2 / max(self.count - 1.0, 1.0)
+        out = (x - self.mean) / np.sqrt(var + self.eps)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        return out.astype(np.float32)
+
+    def get_state(self):
+        return {"count": self.count,
+                "mean": None if self.mean is None else self.mean.copy(),
+                "m2": None if self.m2 is None else self.m2.copy()}
+
+    def set_state(self, state):
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+
+# -- action connectors ------------------------------------------------------
+
+
+class ClipAction(Connector):
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, a):
+        return np.clip(a, self.low, self.high)
+
+
+class UnsquashAction(Connector):
+    """[-1, 1] → [low, high] (reference `unsquash_action`)."""
+
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, a):
+        return self.low + (np.asarray(a) + 1.0) * 0.5 \
+            * (self.high - self.low)

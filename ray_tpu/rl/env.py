@@ -111,6 +111,66 @@ class CartPoleEnv(Env):
         return self._state.copy(), 1.0, terminated, truncated, {}
 
 
+class PendulumEnv(Env):
+    """Classic control Pendulum-v1 dynamics (standard constants) — the
+    continuous-action test/bench workload (reference: gym pendulum, used
+    by RLlib's SAC/DDPG tuned examples)."""
+
+    def __init__(self, max_steps: int = 200):
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.g, self.m, self.l = 10.0, 1.0, 1.0
+        self.observation_space = Box(
+            np.array([-1.0, -1.0, -self.max_speed], np.float32),
+            np.array([1.0, 1.0, self.max_speed], np.float32))
+        self.action_space = Box(np.array([-self.max_torque], np.float32),
+                                np.array([self.max_torque], np.float32))
+        self.max_steps = max_steps
+        self._rng = np.random.RandomState()
+        self._state = None
+        self._t = 0
+
+    def _obs(self):
+        th, thdot = self._state
+        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        th, thdot = self._state
+        u = float(np.clip(np.asarray(action).ravel()[0],
+                          -self.max_torque, self.max_torque))
+        angle = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = angle ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.l) * np.sin(th)
+                         + 3.0 / (self.m * self.l ** 2) * u) * self.dt
+        thdot = np.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self._state = (th, thdot)
+        self._t += 1
+        return self._obs(), -float(cost), False, self._t >= self.max_steps, {}
+
+
+class MultiAgentEnv(Env):
+    """Multi-agent env protocol (reference `rllib/env/multi_agent_env.py`):
+    reset/step consume and return dicts keyed by agent id; the special
+    "__all__" key in the terminated/truncated dicts ends the episode."""
+
+    agent_ids: List[str] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
 class GymEnvAdapter(Env):  # pragma: no cover - needs gym installed
     def __init__(self, gym_env):
         self._env = gym_env
@@ -126,6 +186,7 @@ class GymEnvAdapter(Env):  # pragma: no cover - needs gym installed
 
 _ENV_REGISTRY: Dict[str, Callable[..., Env]] = {
     "CartPole-v1": CartPoleEnv,
+    "Pendulum-v1": PendulumEnv,
 }
 
 
@@ -152,6 +213,8 @@ def make_env(spec, env_config: Optional[dict] = None) -> Env:
         except ImportError:
             raise ValueError(f"unknown env {spec!r} and gymnasium not "
                              "installed")
+        except Exception as e:  # gymnasium registry miss -> uniform error
+            raise ValueError(f"unknown env {spec!r}: {e}") from e
     raise TypeError(f"cannot build env from {spec!r}")
 
 
@@ -175,14 +238,20 @@ class VectorEnv:
         return np.stack(obs)
 
     def step(self, actions):
-        obs, rews, terms, truncs = [], [], [], []
+        obs, final, rews, terms, truncs = [], [], [], [], []
         for e, a in zip(self.envs, actions):
             o, r, te, tr, _ = e.step(a)
+            final.append(o)  # the true successor obs, pre-reset
             if te or tr:
                 o, _ = e.reset()
             obs.append(o)
             rews.append(r)
             terms.append(te)
             truncs.append(tr)
+        # Auto-reset swallows the episode's real final observation from
+        # the return value; keep it reachable so off-policy algorithms
+        # can bootstrap truncated episodes correctly (gymnasium puts it
+        # in info["final_observation"]; here it's a property).
+        self.final_obs = np.stack(final)
         return (np.stack(obs), np.asarray(rews, np.float32),
                 np.asarray(terms), np.asarray(truncs))

@@ -53,3 +53,47 @@ def q_net_init(rng, obs_dim: int, n_actions: int, hidden=(64, 64)):
 
 def q_net_apply(params, obs):
     return mlp_apply(params["q"], obs)
+
+
+# -- continuous control (SAC-style) ----------------------------------------
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def gaussian_policy_init(rng, obs_dim: int, act_dim: int, hidden=(64, 64)):
+    """Tanh-squashed diagonal Gaussian policy: one torso emitting
+    [mean, log_std] (2 * act_dim outputs)."""
+    return {"pi": mlp_init(rng, (obs_dim, *hidden, 2 * act_dim))}
+
+
+def gaussian_policy_apply(params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    out = mlp_apply(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    return mean, log_std
+
+
+def gaussian_sample(mean, log_std, eps):
+    """eps ~ N(0,1). Returns (squashed action in [-1,1], log-prob with the
+    tanh change-of-variables correction)."""
+    std = jnp.exp(log_std)
+    u = mean + std * eps
+    a = jnp.tanh(u)
+    logp = (-0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                    + jnp.log(2 * jnp.pi))).sum(-1)
+    # d tanh(u)/du = 1 - tanh(u)^2; numerically-stable log form.
+    logp -= (2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u))).sum(-1)
+    return a, logp
+
+
+def q_sa_init(rng, obs_dim: int, act_dim: int, hidden=(64, 64)):
+    """Twin state-action critics Q(s, a) -> scalar (SAC/TD3 shape)."""
+    k1, k2 = jax.random.split(rng)
+    return {"q1": mlp_init(k1, (obs_dim + act_dim, *hidden, 1)),
+            "q2": mlp_init(k2, (obs_dim + act_dim, *hidden, 1))}
+
+
+def q_sa_apply(params, obs, act) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    x = jnp.concatenate([obs, act], axis=-1)
+    return (mlp_apply(params["q1"], x)[..., 0],
+            mlp_apply(params["q2"], x)[..., 0])

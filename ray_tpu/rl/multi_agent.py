@@ -1,0 +1,132 @@
+"""Multi-agent rollout support.
+
+Reference: `rllib/env/multi_agent_env.py` + the multi-agent paths of
+`rollout_worker.py`/`sampler.py` — an env whose reset/step speak dicts
+keyed by agent id, a policy-mapping function assigning each agent to a
+policy, and sampling that produces one SampleBatch PER POLICY (agents
+mapped to the same policy share a batch, the "parameter sharing" setup).
+
+Scope: discrete-action categorical policies, one env per worker. The
+returned batches are row-flat ([steps, ...]) and carry the standard
+columns, so the single-agent learner updates (PPO/A2C losses) apply
+unchanged per policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import MultiAgentEnv
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    TERMINATEDS,
+    VALUES,
+)
+
+
+@ray_tpu.remote
+class MultiAgentRolloutWorker:
+    """Samples fragments from one MultiAgentEnv.
+
+    policy_applies: {policy_id: apply_fn(weights, obs) -> (logits, values)}
+    policy_mapping_fn: agent_id -> policy_id
+    """
+
+    def __init__(self, env_creator: Callable[..., MultiAgentEnv],
+                 policy_applies: Dict[str, Callable], *,
+                 policy_mapping_fn: Callable[[str], str],
+                 env_config: Optional[dict] = None,
+                 rollout_fragment_length: int = 100, seed: int = 0):
+        import jax
+
+        self.env = env_creator(env_config or {})
+        self.applies = {pid: jax.jit(fn)
+                        for pid, fn in policy_applies.items()}
+        self.mapping = policy_mapping_fn
+        self.fragment = rollout_fragment_length
+        self._rng = np.random.RandomState(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self._completed: list = []
+
+    def sample(self, weights_per_policy: Dict[str, Any]) -> Dict[
+            str, SampleBatch]:
+        rows: Dict[str, Dict[str, list]] = {}
+
+        def _rows(pid):
+            return rows.setdefault(pid, {
+                OBS: [], ACTIONS: [], REWARDS: [], DONES: [],
+                TERMINATEDS: [], NEXT_OBS: [], LOGPS: [], VALUES: []})
+
+        for _ in range(self.fragment):
+            # Group live agents by policy and batch their inference.
+            actions: Dict[str, Any] = {}
+            step_info: Dict[str, tuple] = {}
+            by_policy: Dict[str, list] = {}
+            for aid in self.obs:
+                by_policy.setdefault(self.mapping(aid), []).append(aid)
+            for pid, aids in by_policy.items():
+                obs_arr = np.stack([np.asarray(self.obs[a], np.float32)
+                                    for a in aids])
+                logits, values = self.applies[pid](
+                    weights_per_policy[pid], obs_arr)
+                logits = np.asarray(logits, np.float32)
+                z = self._rng.gumbel(size=logits.shape)
+                acts = (logits + z).argmax(-1)
+                logp = logits - _logsumexp(logits)
+                act_logp = np.take_along_axis(
+                    logp, acts[:, None], axis=1)[:, 0]
+                for i, aid in enumerate(aids):
+                    actions[aid] = int(acts[i])
+                    step_info[aid] = (pid, obs_arr[i], acts[i],
+                                      act_logp[i],
+                                      float(np.asarray(values)[i]))
+            next_obs, rewards, terms, truncs, _ = self.env.step(actions)
+            done_all = bool(terms.get("__all__", False)
+                            or truncs.get("__all__", False))
+            for aid, (pid, ob, act, lp, val) in step_info.items():
+                r = _rows(pid)
+                term = bool(terms.get(aid, False))
+                trunc = bool(truncs.get(aid, False))
+                r[OBS].append(ob)
+                r[ACTIONS].append(act)
+                r[REWARDS].append(float(rewards.get(aid, 0.0)))
+                r[DONES].append(term or trunc or done_all)
+                r[TERMINATEDS].append(term)
+                r[NEXT_OBS].append(np.asarray(
+                    next_obs.get(aid, ob), np.float32))
+                r[LOGPS].append(lp)
+                r[VALUES].append(val)
+                self._episode_reward += float(rewards.get(aid, 0.0))
+            self._episode_len += 1
+            if done_all:
+                self._completed.append(
+                    (self._episode_reward, self._episode_len))
+                self._episode_reward, self._episode_len = 0.0, 0
+                self.obs, _ = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {pid: SampleBatch({k: np.asarray(v)
+                                  for k, v in r.items()})
+                for pid, r in rows.items()}
+
+    def episode_stats(self, clear: bool = True):
+        stats = list(self._completed)
+        if clear:
+            self._completed = []
+        return stats
+
+
+def _logsumexp(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=axis, keepdims=True))

@@ -1,0 +1,111 @@
+"""Offline IO: write/read SampleBatch experience to/from JSONL files.
+
+Reference: `rllib/offline/` — `JsonWriter` (rollouts → newline-delimited
+JSON with base64 arrays), `JsonReader` (files → SampleBatch stream),
+`InputReader` ABC so algorithms can consume either live rollouts or
+recorded data. Used by the offline algorithms (BC/MARWIL) and for
+dataset export.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob as globlib
+import io
+import json
+import os
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def _encode_array(a: np.ndarray) -> dict:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+    return {"__npy__": base64.b64encode(buf.getvalue()).decode("ascii")}
+
+
+def _decode_value(v):
+    if isinstance(v, dict) and "__npy__" in v:
+        return np.load(io.BytesIO(base64.b64decode(v["__npy__"])),
+                       allow_pickle=False)
+    return np.asarray(v)
+
+
+class InputReader:
+    """Source of training batches (reference `rllib/offline/io.py`)."""
+
+    def next(self) -> SampleBatch:
+        raise NotImplementedError
+
+
+class JsonWriter:
+    """Append SampleBatches to JSONL files, rolling at max_file_size."""
+
+    def __init__(self, path: str, max_file_size: int = 64 << 20):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_file_size = max_file_size
+        self._index = 0
+        self._file = None
+
+    def _roll(self):
+        if self._file is not None:
+            self._file.close()
+        name = os.path.join(self.path, f"output-{self._index:05d}.json")
+        self._index += 1
+        self._file = open(name, "w")
+
+    def write(self, batch: SampleBatch):
+        if self._file is None or self._file.tell() > self.max_file_size:
+            self._roll()
+        row = {k: _encode_array(np.asarray(v)) for k, v in batch.items()}
+        self._file.write(json.dumps(row) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader(InputReader):
+    """Read SampleBatches back from JSONL files (cycling forever)."""
+
+    def __init__(self, inputs: Union[str, Sequence[str]]):
+        if isinstance(inputs, str):
+            if os.path.isdir(inputs):
+                inputs = sorted(
+                    globlib.glob(os.path.join(inputs, "*.json")))
+            else:
+                inputs = sorted(globlib.glob(inputs)) or [inputs]
+        self.files: List[str] = list(inputs)
+        if not self.files:
+            raise ValueError("JsonReader: no input files")
+        self._iter: Optional[Iterator[SampleBatch]] = None
+
+    def _read_all(self) -> Iterator[SampleBatch]:
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    row = json.loads(line)
+                    yield SampleBatch({k: _decode_value(v)
+                                       for k, v in row.items()})
+
+    def next(self) -> SampleBatch:
+        if self._iter is None:
+            self._iter = self._read_all()
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = self._read_all()
+            return next(self._iter)
+
+    def read_all(self) -> SampleBatch:
+        """Materialize every batch concatenated (for small datasets)."""
+        return SampleBatch.concat(list(self._read_all()))

@@ -22,6 +22,7 @@ from ray_tpu.rl.sample_batch import (
     OBS,
     REWARDS,
     SampleBatch,
+    TERMINATEDS,
     VALUES,
 )
 
@@ -31,16 +32,35 @@ class RolloutWorker:
     def __init__(self, env_spec, policy_apply: Callable, *,
                  num_envs: int = 1, env_config: Optional[dict] = None,
                  rollout_fragment_length: int = 200, seed: int = 0,
-                 policy_kind: str = "actor_critic"):
+                 policy_kind: str = "actor_critic",
+                 obs_connectors=None, action_connectors=None):
         import jax
 
         self.vec = VectorEnv(env_spec, num_envs, env_config)
         self.apply = jax.jit(policy_apply)
         self.fragment = rollout_fragment_length
         self.kind = policy_kind
+        # Connector pipelines (ray_tpu.rl.connectors): obs transforms run
+        # before the policy (and the transformed obs is what lands in the
+        # batch, so the learner sees the same space); action transforms
+        # run between the policy sample and env.step. Stateful connector
+        # state (e.g. NormalizeObs running stats) is worker-local.
+        self.obs_connectors = obs_connectors
+        if policy_kind == "gaussian" and action_connectors is None:
+            # Gaussian policies emit squashed actions in [-1, 1]; the
+            # default pipeline rescales to the action-space bounds. A
+            # caller-supplied pipeline REPLACES this (so composing your
+            # own UnsquashAction doesn't double-rescale).
+            from ray_tpu.rl.connectors import (ConnectorPipeline,
+                                               UnsquashAction)
+
+            space = self.vec.action_space
+            action_connectors = ConnectorPipeline(
+                [UnsquashAction(space.low, space.high)])
+        self.action_connectors = action_connectors
         self._rng = np.random.RandomState(seed)
         self._jax_rng = jax.random.PRNGKey(seed)
-        self.obs = self.vec.reset(seed=seed)
+        self.obs = self._connect_obs(self.vec.reset(seed=seed))
         self._episode_rewards = np.zeros(num_envs, np.float64)
         self._episode_lens = np.zeros(num_envs, np.int64)
         self._completed: list = []
@@ -50,28 +70,59 @@ class RolloutWorker:
         import jax
 
         rows: Dict[str, list] = {OBS: [], ACTIONS: [], REWARDS: [],
-                                 DONES: [], NEXT_OBS: [], LOGPS: [],
-                                 VALUES: []}
+                                 DONES: [], TERMINATEDS: [], NEXT_OBS: [],
+                                 LOGPS: [], VALUES: []}
         for _ in range(self.fragment):
             out = self.apply(weights, self.obs)
-            if self.kind == "actor_critic":
-                logits, values = out
-            else:  # q-network: greedy-ish epsilon handled by caller config
-                logits, values = out, np.zeros(len(self.obs), np.float32)
-            logits = np.asarray(logits, np.float32)
-            # Sample actions from the categorical distribution.
-            z = self._rng.gumbel(size=logits.shape)
-            actions = (logits + z).argmax(-1)
-            logp = logits - _logsumexp(logits)
-            act_logp = np.take_along_axis(
-                logp, actions[:, None], axis=1)[:, 0]
-            next_obs, rewards, terms, truncs = self.vec.step(actions)
+            if self.kind == "gaussian":
+                # Continuous control: tanh-squashed diagonal Gaussian.
+                # ACTIONS stores the squashed action in [-1, 1]; the
+                # action-connector pipeline (UnsquashAction installed by
+                # default in __init__) rescales for the env.
+                mean, log_std = (np.asarray(o, np.float32) for o in out)
+                std = np.exp(log_std)
+                u = mean + std * self._rng.normal(size=mean.shape)
+                actions = np.tanh(u)
+                act_logp = (-0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                                    + np.log(2 * np.pi))).sum(-1)
+                act_logp -= (2 * (np.log(2.0) - u
+                                  - _softplus(-2 * u))).sum(-1)
+                values = np.zeros(len(self.obs), np.float32)
+                env_actions = actions
+            else:
+                if self.kind == "actor_critic":
+                    logits, values = out
+                else:  # q-network: epsilon handled by caller config
+                    logits, values = out, np.zeros(len(self.obs),
+                                                   np.float32)
+                logits = np.asarray(logits, np.float32)
+                # Sample actions from the categorical distribution.
+                z = self._rng.gumbel(size=logits.shape)
+                actions = (logits + z).argmax(-1)
+                logp = logits - _logsumexp(logits)
+                act_logp = np.take_along_axis(
+                    logp, actions[:, None], axis=1)[:, 0]
+                env_actions = actions
+            if self.action_connectors is not None:
+                env_actions = self.action_connectors(env_actions)
+            next_obs, rewards, terms, truncs = self.vec.step(env_actions)
             dones = np.logical_or(terms, truncs)
+            if dones.any():
+                # NEXT_OBS must be the true successor (pre-auto-reset) so
+                # off-policy targets bootstrap truncated episodes right;
+                # the policy continues from the post-reset obs. (Both go
+                # through the obs connectors; stateful connector stats
+                # see done-step rows twice — negligible.)
+                true_next = self._connect_obs(self.vec.final_obs)
+                next_obs = self._connect_obs(next_obs)
+            else:
+                next_obs = true_next = self._connect_obs(next_obs)
             rows[OBS].append(self.obs.copy())
             rows[ACTIONS].append(actions)
             rows[REWARDS].append(rewards)
             rows[DONES].append(dones)
-            rows[NEXT_OBS].append(next_obs.copy())
+            rows[TERMINATEDS].append(np.asarray(terms))
+            rows[NEXT_OBS].append(true_next.copy())
             rows[LOGPS].append(act_logp)
             rows[VALUES].append(np.asarray(values, np.float32))
             self._episode_rewards += rewards
@@ -91,6 +142,24 @@ class RolloutWorker:
             batch[k] = np.swapaxes(arr, 0, 1)  # [N, T, ...]
         return batch
 
+    def _connect_obs(self, obs):
+        return obs if self.obs_connectors is None \
+            else self.obs_connectors(obs)
+
+    def connector_state(self):
+        return {
+            "obs": None if self.obs_connectors is None
+            else self.obs_connectors.get_state(),
+            "action": None if self.action_connectors is None
+            else self.action_connectors.get_state(),
+        }
+
+    def set_connector_state(self, state):
+        if state.get("obs") and self.obs_connectors is not None:
+            self.obs_connectors.set_state(state["obs"])
+        if state.get("action") and self.action_connectors is not None:
+            self.action_connectors.set_state(state["action"])
+
     def episode_stats(self, clear: bool = True):
         stats = list(self._completed)
         if clear:
@@ -101,3 +170,7 @@ class RolloutWorker:
 def _logsumexp(x, axis=-1):
     m = x.max(axis=axis, keepdims=True)
     return m + np.log(np.exp(x - m).sum(axis=axis, keepdims=True))
+
+
+def _softplus(x):
+    return np.logaddexp(0.0, x)

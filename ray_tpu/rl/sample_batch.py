@@ -13,7 +13,8 @@ import numpy as np
 OBS = "obs"
 ACTIONS = "actions"
 REWARDS = "rewards"
-DONES = "dones"
+DONES = "dones"            # terminated OR truncated (episode boundary)
+TERMINATEDS = "terminateds"  # env-terminal only (bootstrap mask)
 NEXT_OBS = "next_obs"
 LOGPS = "action_logp"
 VALUES = "values"
