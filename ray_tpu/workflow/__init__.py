@@ -3,7 +3,13 @@
 Reference: `python/ray/workflow/` (SURVEY.md §2.4) — `workflow.run(dag)`
 executes a `ray_tpu.dag` graph with per-step results checkpointed to
 storage (`workflow_storage.py` equivalent), so a crashed workflow resumes
-from completed steps; a management registry tracks status.
+from completed steps. Management surface (reference `workflow_access.py`
+WorkflowManagementActor): a named detached actor exposing
+list/status/cancel/resume to any driver. Events (reference
+`event_listener.py` / `http_event_provider.py`): `wait_for_event` steps
+block durably until `trigger_event` delivers a payload; `TimerListener`
+fires at a wall-clock time. Per-step `max_retries`/`catch_exceptions`
+via `with_options` (reference `workflow.options`).
 """
 
 from __future__ import annotations
@@ -35,6 +41,10 @@ def _root() -> str:
     return _storage_root
 
 
+class WorkflowCancelledError(RuntimeError):
+    pass
+
+
 class WorkflowStorage:
     """Filesystem-backed step-result store (reference:
     `workflow/workflow_storage.py`)."""
@@ -42,6 +52,49 @@ class WorkflowStorage:
     def __init__(self, workflow_id: str):
         self.path = os.path.join(_root(), workflow_id)
         os.makedirs(os.path.join(self.path, "steps"), exist_ok=True)
+        os.makedirs(os.path.join(self.path, "events"), exist_ok=True)
+
+    # cancellation flag (written by any process, read between steps)
+    def request_cancel(self):
+        with open(os.path.join(self.path, "cancel"), "w") as f:
+            f.write("1")
+
+    def cancel_requested(self) -> bool:
+        return os.path.exists(os.path.join(self.path, "cancel"))
+
+    # the DAG itself, so resume works without the original driver
+    # (cloudpickle: step functions are usually closures/locals)
+    def save_dag(self, dag, dag_input):
+        import cloudpickle
+
+        tmp = os.path.join(self.path, "dag.pkl.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump((dag, dag_input), f)
+        os.replace(tmp, os.path.join(self.path, "dag.pkl"))
+
+    def load_dag(self):
+        with open(os.path.join(self.path, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def has_dag(self) -> bool:
+        return os.path.exists(os.path.join(self.path, "dag.pkl"))
+
+    # events
+    def event_file(self, key: str) -> str:
+        return os.path.join(self.path, "events", f"{key}.pkl")
+
+    def post_event(self, key: str, payload):
+        tmp = self.event_file(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, self.event_file(key))
+
+    def get_event(self, key: str):
+        with open(self.event_file(key), "rb") as f:
+            return pickle.load(f)
+
+    def has_event(self, key: str) -> bool:
+        return os.path.exists(self.event_file(key))
 
     def _step_file(self, step_id: str) -> str:
         return os.path.join(self.path, "steps", f"{step_id}.pkl")
@@ -54,9 +107,14 @@ class WorkflowStorage:
             return pickle.load(f)
 
     def save_step(self, step_id: str, value):
+        # cloudpickle: step values may hold rich exception objects
+        # (catch_exceptions) or closures; loading stays stdlib pickle
+        # (cloudpickle output is pickle-compatible).
+        import cloudpickle
+
         tmp = self._step_file(step_id) + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(value, f)
+            cloudpickle.dump(value, f)
         os.replace(tmp, self._step_file(step_id))
 
     def set_status(self, status: str, error: str = ""):
@@ -100,8 +158,18 @@ def _execute_durable(node: DAGNode, storage: WorkflowStorage, dag_input,
                      cache: Dict[str, Any]):
     if node._uuid in cache:
         return cache[node._uuid]
+    if storage.cancel_requested():
+        raise WorkflowCancelledError(
+            f"workflow cancelled ({os.path.basename(storage.path)})")
     if isinstance(node, InputNode):
         result = dag_input
+    elif isinstance(node, EventNode):
+        step_id = f"event-{node._key}"
+        if storage.has_step(step_id):
+            result = storage.load_step(step_id)
+        else:
+            result = node._listener.poll_for_event(storage)
+            storage.save_step(step_id, result)
     else:
         step_id = _step_id_of(node)
         if storage.has_step(step_id):
@@ -121,7 +189,33 @@ def _execute_durable(node: DAGNode, storage: WorkflowStorage, dag_input,
                 raise TypeError(
                     f"workflow steps must be function nodes, got "
                     f"{type(node).__name__}")
-            result = ray_tpu.get(fn.remote(*args, **kwargs))
+            # Re-check here: the entry check above runs during the
+            # initial DAG descent (t~0 for every node); by the time the
+            # dependencies have executed, a cancel may have arrived.
+            if storage.cancel_requested():
+                raise WorkflowCancelledError(
+                    f"workflow cancelled "
+                    f"({os.path.basename(storage.path)})")
+            opts = getattr(node, "_workflow_options", {})
+            retries = int(opts.get("max_retries", 0))
+            catch = bool(opts.get("catch_exceptions", False))
+            attempt = 0
+            while True:
+                try:
+                    result = ray_tpu.get(fn.remote(*args, **kwargs))
+                    if catch:
+                        result = (result, None)
+                    break
+                except WorkflowCancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    if attempt < retries:
+                        attempt += 1
+                        continue
+                    if catch:
+                        result = (None, e)
+                        break
+                    raise
             storage.save_step(step_id, result)
     cache[node._uuid] = result
     return result
@@ -133,12 +227,16 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     Completed steps are skipped on resume."""
     workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
     storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dag, dag_input)  # resume needs no original driver
     storage.set_status("RUNNING")
     try:
         result = _execute_durable(dag, storage, dag_input, {})
         storage.save_step("__output__", result)
         storage.set_status("SUCCESSFUL")
         return result
+    except WorkflowCancelledError as e:
+        storage.set_status("CANCELED", str(e))
+        raise
     except BaseException as e:  # noqa: BLE001
         storage.set_status("FAILED", str(e))
         raise
@@ -168,15 +266,42 @@ def get_output(workflow_id: str):
 
 
 def resume(workflow_id: str):
-    """Re-run a failed workflow from its stored steps. The caller must
-    re-supply the same DAG via `run` with the same workflow_id; this
-    helper just returns the stored output when already successful."""
+    """Resume a FAILED/CANCELED/RUNNING-at-crash workflow from its stored
+    DAG and completed steps; returns the output. Already-successful
+    workflows return their stored output directly."""
     storage = WorkflowStorage(workflow_id)
     if storage.get_status() == "SUCCESSFUL":
         return storage.load_step("__output__")
-    raise ValueError(
-        f"workflow {workflow_id} is {storage.get_status()}; re-issue "
-        "run(dag, workflow_id=...) to resume execution")
+    if not storage.has_dag():
+        raise ValueError(
+            f"workflow {workflow_id} has no stored DAG (pre-upgrade run?);"
+            " re-issue run(dag, workflow_id=...) to resume")
+    # Clear a stale cancel flag so the resumed run can proceed.
+    cancel_path = os.path.join(storage.path, "cancel")
+    if os.path.exists(cancel_path):
+        os.remove(cancel_path)
+    dag, dag_input = storage.load_dag()
+    return run(dag, workflow_id=workflow_id, dag_input=dag_input)
+
+
+def resume_all() -> List[tuple]:
+    """Resume every workflow not already successful (reference:
+    `workflow.resume_all` after cluster restart). Returns
+    [(workflow_id, output), ...] for the resumed ones."""
+    out = []
+    for wid, status in list_all():
+        if status in ("FAILED", "CANCELED", "RUNNING") \
+                and WorkflowStorage(wid).has_dag():
+            try:
+                out.append((wid, resume(wid)))
+            except Exception:  # noqa: BLE001 — keep resuming the rest
+                pass
+    return out
+
+
+def cancel(workflow_id: str):
+    """Request cancellation; takes effect at the next step boundary."""
+    WorkflowStorage(workflow_id).request_cancel()
 
 
 def list_all() -> List[tuple]:
@@ -186,3 +311,140 @@ def list_all() -> List[tuple]:
         if os.path.isdir(os.path.join(root, wid)):
             out.append((wid, WorkflowStorage(wid).get_status()))
     return out
+
+
+def with_options(node: DAGNode, *, max_retries: int = 0,
+                 catch_exceptions: bool = False) -> DAGNode:
+    """Attach per-step execution options (reference `workflow.options`):
+    `max_retries` re-runs a failing step; `catch_exceptions` makes the
+    step yield `(result, None)` or `(None, exception)` instead of
+    raising."""
+    node._workflow_options = {"max_retries": max_retries,
+                              "catch_exceptions": catch_exceptions}
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+
+class EventListener:
+    """Reference `workflow/event_listener.py`: poll_for_event blocks until
+    the external event arrives, returning its payload. Durable: once a
+    wait_for_event step commits, resume never re-waits."""
+
+    def poll_for_event(self, storage: WorkflowStorage):
+        raise NotImplementedError
+
+
+class TriggerListener(EventListener):
+    """Waits for `trigger_event(workflow_id, key, payload)`."""
+
+    def __init__(self, key: str, poll_interval_s: float = 0.05,
+                 timeout_s: Optional[float] = None):
+        self.key = key
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def poll_for_event(self, storage: WorkflowStorage):
+        deadline = None if self.timeout_s is None \
+            else time.monotonic() + self.timeout_s
+        while not storage.has_event(self.key):
+            if storage.cancel_requested():
+                raise WorkflowCancelledError("cancelled while waiting "
+                                             f"for event {self.key!r}")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"event {self.key!r} not delivered in "
+                    f"{self.timeout_s}s")
+            time.sleep(self.poll_interval_s)
+        return storage.get_event(self.key)
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (reference TimerListener)."""
+
+    def __init__(self, fire_at: float):
+        self.fire_at = fire_at
+
+    def poll_for_event(self, storage: WorkflowStorage):
+        while time.time() < self.fire_at:
+            if storage.cancel_requested():
+                raise WorkflowCancelledError("cancelled in timer wait")
+            time.sleep(min(0.05, max(0.0, self.fire_at - time.time())))
+        return self.fire_at
+
+
+class EventNode(DAGNode):
+    """DAG node that blocks on an EventListener; its value is the event
+    payload."""
+
+    def __init__(self, listener: EventListener, key: str):
+        super().__init__()
+        self._listener = listener
+        self._key = key
+
+    def _run(self, cache, dag_input):  # non-durable .execute() path
+        raise RuntimeError("EventNode only executes inside workflow.run")
+
+
+def wait_for_event(key_or_listener, **kwargs) -> EventNode:
+    """`wait_for_event("approval")` waits for `trigger_event(wid,
+    "approval", payload)`; or pass an EventListener instance."""
+    if isinstance(key_or_listener, EventListener):
+        key = getattr(key_or_listener, "key", None) or \
+            f"listener-{type(key_or_listener).__name__}"
+        return EventNode(key_or_listener, key)
+    return EventNode(TriggerListener(key_or_listener, **kwargs),
+                     key_or_listener)
+
+
+def trigger_event(workflow_id: str, key: str, payload: Any = None):
+    """Deliver an event payload to a (possibly waiting) workflow."""
+    WorkflowStorage(workflow_id).post_event(key, payload)
+
+
+# ---------------------------------------------------------------------------
+# Management actor
+# ---------------------------------------------------------------------------
+
+_MANAGER_NAME = "__workflow_manager__"
+
+
+@ray_tpu.remote
+class _WorkflowManager:
+    """Detached named actor making the workflow registry queryable from
+    any driver (reference `workflow_access.py` WorkflowManagementActor).
+    Storage stays the source of truth; the actor is the cluster-visible
+    façade (and runs resume_all off-driver)."""
+
+    def __init__(self, storage_root: Optional[str] = None):
+        init(storage_root)
+
+    def list_all(self):
+        return list_all()
+
+    def get_status(self, workflow_id: str):
+        return get_status(workflow_id)
+
+    def cancel(self, workflow_id: str):
+        cancel(workflow_id)
+
+    def run_async(self, dag, workflow_id=None, dag_input=None):
+        return run(dag, workflow_id=workflow_id, dag_input=dag_input)
+
+    def resume_all(self):
+        return resume_all()
+
+
+def get_management_actor(storage_root: Optional[str] = None):
+    """Get or create the named workflow-management actor."""
+    try:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+    except Exception:  # noqa: BLE001
+        try:
+            return _WorkflowManager.options(
+                name=_MANAGER_NAME).remote(storage_root or _root())
+        except ValueError:  # lost the creation race
+            return ray_tpu.get_actor(_MANAGER_NAME)

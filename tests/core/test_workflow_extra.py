@@ -1,0 +1,156 @@
+"""Round-3 workflow surface: management actor, events, cancel, true
+resume from stored DAG, per-step retry/catch options."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def _double(x):
+    return 2 * x
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+def test_resume_from_stored_dag(tmp_path):
+    """resume() needs no DAG from the caller — it reloads the stored one."""
+    workflow.init(str(tmp_path))
+    flaky_calls = {"n": 0}
+    marker = tmp_path / "fail_once"
+    marker.write_text("x")
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+
+        if os.path.exists(str(marker)):
+            os.remove(str(marker))
+            raise RuntimeError("boom")
+        return x + 1
+
+    dag = _double.bind(flaky.bind(10))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wfr")
+    assert workflow.get_status("wfr") == "FAILED"
+    # New "driver": no DAG in hand.
+    out = workflow.resume("wfr")
+    assert out == 22
+    assert workflow.get_status("wfr") == "SUCCESSFUL"
+
+
+def test_step_retry_and_catch(tmp_path):
+    workflow.init(str(tmp_path))
+    cnt = tmp_path / "attempts"
+    cnt.write_text("0")
+
+    @ray_tpu.remote
+    def fails_twice():
+        n = int(cnt.read_text())
+        cnt.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"attempt {n}")
+        return "ok"
+
+    node = workflow.with_options(fails_twice.bind(), max_retries=3)
+    assert workflow.run(node, workflow_id="wf-retry") == "ok"
+    assert int(cnt.read_text()) == 3
+
+    @ray_tpu.remote
+    def always_fails():
+        raise ValueError("nope")
+
+    node = workflow.with_options(always_fails.bind(),
+                                 catch_exceptions=True)
+    result, err = workflow.run(node, workflow_id="wf-catch")
+    assert result is None
+    assert isinstance(err, Exception)
+    assert workflow.get_status("wf-catch") == "SUCCESSFUL"
+
+
+def test_event_trigger_unblocks(tmp_path):
+    workflow.init(str(tmp_path))
+    ev = workflow.wait_for_event("approval", timeout_s=10)
+    dag = _add.bind(ev, 5)
+
+    done = {}
+
+    def runner():
+        done["out"] = workflow.run(dag, workflow_id="wf-ev", dag_input=None)
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(0.2)
+    assert workflow.get_status("wf-ev") == "RUNNING"
+    workflow.trigger_event("wf-ev", "approval", 37)
+    t.join(timeout=15)
+    assert done.get("out") == 42
+    # Resume does not re-wait: the event step is durable.
+    assert workflow.resume("wf-ev") == 42
+
+
+def test_timer_listener(tmp_path):
+    workflow.init(str(tmp_path))
+    fire_at = time.time() + 0.3
+    node = workflow.wait_for_event(workflow.TimerListener(fire_at))
+    t0 = time.time()
+    workflow.run(node, workflow_id="wf-timer")
+    assert time.time() - t0 >= 0.25
+
+
+def test_cancel_stops_at_step_boundary(tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.3)
+        return x
+
+    # chain of slow steps; cancel lands between them
+    dag = slow.bind(slow.bind(slow.bind(slow.bind(1))))
+    err = {}
+
+    def runner():
+        try:
+            workflow.run(dag, workflow_id="wf-cancel")
+        except workflow.WorkflowCancelledError as e:
+            err["e"] = e
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(0.35)
+    workflow.cancel("wf-cancel")
+    t.join(timeout=10)
+    assert "e" in err
+    assert workflow.get_status("wf-cancel") == "CANCELED"
+    # resume clears the flag and finishes
+    assert workflow.resume("wf-cancel") == 1
+
+
+def test_management_actor(tmp_path):
+    workflow.init(str(tmp_path))
+    mgr = workflow.get_management_actor(str(tmp_path))
+    dag = _double.bind(21)
+    out = ray_tpu.get(mgr.run_async.remote(dag, "wf-mgr", None))
+    assert out == 42
+    listing = dict(ray_tpu.get(mgr.list_all.remote()))
+    assert listing.get("wf-mgr") == "SUCCESSFUL"
+    assert ray_tpu.get(mgr.get_status.remote("wf-mgr")) == "SUCCESSFUL"
+    # second lookup returns the same named actor
+    again = workflow.get_management_actor()
+    assert ray_tpu.get(again.get_status.remote("wf-mgr")) == "SUCCESSFUL"
