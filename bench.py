@@ -38,7 +38,15 @@ def _measure_llama_train_step():
     n = len(devices)
 
     if on_tpu:
-        cfg = LlamaConfig.llama3_1b()
+        import dataclasses
+
+        # remat="gate" saves the silu(w1) MLP activation across the remat
+        # boundary (the largest recompute the HBM budget allows next to
+        # AdamW bf16 moments); fused CE (cfg default) keeps the [tokens,
+        # vocab] logits unmaterialized. Sweep provenance:
+        # benchmarks/sweep_step.py — batch 4 beat 2/8 per token on this
+        # chip.
+        cfg = dataclasses.replace(LlamaConfig.llama3_1b(), remat="gate")
         batch, seq = 4, 2048
         moment_dtype = jnp.bfloat16
         steps = 10

@@ -1,0 +1,115 @@
+"""Chip calibration: peak achievable matmul FLOPs and HBM bandwidth on
+this device, measured inside one jit program (scan-amortized, so tunnel
+dispatch overhead is negligible). Establishes the real MFU denominator."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _time(fn, *args, reps=3):
+    out = fn(*args)
+    jnp.sum(out.astype(jnp.float32)).block_until_ready()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        float(jnp.sum(out.astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: platform={dev.platform} kind={dev.device_kind}")
+
+    key = jax.random.PRNGKey(0)
+    n = 8192
+    k_iters = 50
+    x = jax.random.normal(key, (n, n), jnp.bfloat16)
+    w = jax.random.normal(key, (n, n), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def chain(x, w):
+        def body(c, _):
+            c = jnp.dot(c, w)
+            return c, None
+
+        c, _ = lax.scan(body, x, None, length=k_iters)
+        return c
+
+    dt = _time(chain, x, w)
+    flops = 2 * n * n * n * k_iters
+    print(f"matmul {n}x{n}x{n} x{k_iters}: {dt * 1e3:.1f} ms "
+          f"-> {flops / dt / 1e12:.1f} TFLOP/s bf16")
+
+    # Train-step-shaped matmul: [8192, 2048] @ [2048, 8192]
+    m, kk, nn = 8192, 2048, 8192
+    a = jax.random.normal(key, (m, kk), jnp.bfloat16)
+    b = jax.random.normal(key, (kk, nn), jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def chain2(a, b):
+        def body(c, _):
+            out = jnp.dot(c, b)        # [m, nn]
+            c = jnp.dot(out, b.T)      # back to [m, kk]
+            return c, None
+
+        c, _ = lax.scan(body, a, None, length=k_iters)
+        return c
+
+    dt = _time(chain2, a, b)
+    flops = 2 * m * kk * nn * 2 * k_iters
+    print(f"matmul {m}x{kk}x{nn} pair x{k_iters}: {dt * 1e3:.1f} ms "
+          f"-> {flops / dt / 1e12:.1f} TFLOP/s bf16")
+
+    # Transposed-operand dots at train shapes (the bwd/CE patterns).
+    # Data-dependent scan so XLA can't CSE the repeated dots.
+    a0 = jax.random.normal(key, (8192, 2048), jnp.bfloat16)
+    wn = jax.random.normal(key, (2048, 8192), jnp.bfloat16) * 0.01
+    wt = jax.random.normal(key, (8192, 2048), jnp.bfloat16) * 0.01
+    cases = [
+        ("x@w  ", wn, lambda a, w: jnp.dot(a, w), 1),
+        ("x@w.T", wt, lambda a, w: jnp.dot(a, w.T), 1),
+        ("pair ", wn, lambda a, w: jnp.dot(jnp.dot(a, w), w.T), 2),
+    ]
+    for name, wv, fn, nd in cases:
+        @jax.jit
+        def rep(a, w, fn=fn):
+            def body(c, _):
+                out = fn(c, w)
+                # fold the output back into the carry (keeps dependence)
+                c = c + out[:, :2048].astype(jnp.bfloat16) * 1e-6
+                return c, None
+
+            c, _ = lax.scan(body, a, None, length=30)
+            return c
+
+        dt = _time(rep, a0, wv)
+        fl = 2 * 8192 * 2048 * 8192 * 30 * nd
+        print(f"{name}: {dt * 1e3:7.1f} ms -> {fl / dt / 1e12:6.1f} "
+              "TFLOP/s")
+
+    # HBM bandwidth: big copy-add chain.
+    big = jax.random.normal(key, (256, 1024, 1024), jnp.bfloat16)  # 512MB
+
+    @jax.jit
+    def bwchain(z):
+        def body(c, _):
+            return c + 1.0, None
+
+        c, _ = lax.scan(body, z, None, length=20)
+        return c
+
+    dt = _time(bwchain, big)
+    traffic = big.size * 2 * 2 * 20  # rd + wr per iter
+    print(f"elementwise chain: {dt * 1e3:.1f} ms -> "
+          f"{traffic / dt / 1e9:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

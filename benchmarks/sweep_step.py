@@ -1,0 +1,58 @@
+"""One train-step timing for a (batch, remat) config — run one config per
+process (HBM fragmentation across configs in one process causes spurious
+OOMs). Driven by benchmarks/sweep_step.sh or manually:
+
+    SWEEP_BATCH=8 SWEEP_REMAT=mlp python -m benchmarks.sweep_step
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import (LlamaConfig, init_params_sharded,
+                                init_train_state, loss_fn, make_optimizer,
+                                make_train_step)
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    batch = int(os.environ.get("SWEEP_BATCH", "4"))
+    remat_s = os.environ.get("SWEEP_REMAT", "true")
+    remat = {"true": True, "false": False}.get(remat_s, remat_s)
+    seq = int(os.environ.get("SWEEP_SEQ", "2048"))
+
+    cfg = dataclasses.replace(LlamaConfig.llama3_1b(), remat=remat)
+    mesh = create_mesh(MeshConfig(data=-1, fsdp=1))
+    params = init_params_sharded(cfg, mesh, jax.random.PRNGKey(0))
+    tx = make_optimizer(3e-4, warmup_steps=0, moment_dtype=jnp.bfloat16)
+    state = init_train_state(params, tx)
+    del params
+    step = make_train_step(
+        lambda p, b: loss_fn(p, b, cfg, mesh=mesh), tx, mesh=mesh,
+        batch_logical={"tokens": ("batch", "seq"),
+                       "targets": ("batch", "seq")})
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    bd = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    state, m = step(state, bd)
+    float(m["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            state, m = step(state, bd)
+        float(m["loss"])
+        best = min(best, (time.perf_counter() - t0) / 5)
+    toks = batch * seq / best
+    print(f"batch={batch} remat={remat_s}: {best * 1e3:.1f} ms/step, "
+          f"{toks:.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

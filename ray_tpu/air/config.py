@@ -102,3 +102,6 @@ class RunConfig:
     verbose: int = 1
     callbacks: List[Any] = field(default_factory=list)
     log_to_file: bool = False
+    # ray_tpu.tune.syncer.SyncConfig — uploads the experiment dir to
+    # durable storage after checkpoint events (reference tune/syncer.py).
+    sync_config: Optional[Any] = None

@@ -38,6 +38,34 @@ class DashboardServer:
                     self.end_headers()
                     self.wfile.write(str(e).encode())
 
+            def do_PUT(self):
+                # Declarative serve deploy (reference REST:
+                # PUT /api/serve/applications/ with a ServeDeploySchema
+                # JSON body).
+                path = self.path.split("?")[0].rstrip("/")
+                if path != "/api/serve/applications":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    config = json.loads(self.rfile.read(n) or b"{}")
+                    from ray_tpu.serve.schema import apply_config
+
+                    apply_config(config)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(b'{"status": "ok"}')
+                except ValueError as e:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address[:2]
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -73,6 +101,7 @@ class DashboardServer:
                 "actor_summary": state.summarize_actors(),
             },
             "/api/serve": self._serve_status,
+            "/api/serve/applications": self._serve_applications,
         }
         fn = routes[path]  # KeyError → 404
         return json.dumps(fn(), default=str).encode(), "application/json"
@@ -83,6 +112,15 @@ class DashboardServer:
             from ray_tpu import serve
 
             return serve.status()
+        except Exception:
+            return {}
+
+    @staticmethod
+    def _serve_applications():
+        try:
+            from ray_tpu.serve.schema import status_schema
+
+            return status_schema()
         except Exception:
             return {}
 

@@ -24,9 +24,11 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import flash_attention, _attention_reference
-from ray_tpu.ops.cross_entropy import softmax_cross_entropy
+from ray_tpu.ops.cross_entropy import (fused_linear_cross_entropy,
+                                       softmax_cross_entropy)
 from ray_tpu.ops.norms import rms_norm_reference
 from ray_tpu.ops.rope import (apply_rope, rope_frequencies,
                               rope_from_positions)
@@ -54,7 +56,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # "auto" | "flash" | "ring" | "ulysses" | "reference"
     attention: str = "auto"
-    remat: bool = True
+    # False | True (save attn out/lse only) | "gate" (+silu(w1) act) |
+    # "mlp" (+both ffn acts). Validated in forward_hidden.
+    remat: Any = True
+    # Fuse the output projection into the CE loss (logits never
+    # materialized). Auto-disabled when the vocab dim is sharded.
+    fused_ce: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -229,8 +236,12 @@ def _layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
     attn = _attention(cfg, q, k, v, mesh, rules)
     x = x + jnp.einsum("bshk,hkd->bsd", attn.astype(cfg.dtype), lp["wo"])
     h2 = rms_norm_reference(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h2, lp["w1"]))
-    up = jnp.einsum("bsd,df->bsf", h2, lp["w3"])
+    # Named for selective remat: cfg.remat="mlp" saves these two (the
+    # dominant recompute cost) while still rematerializing the rest.
+    gate = checkpoint_name(
+        jax.nn.silu(jnp.einsum("bsd,df->bsf", h2, lp["w1"])), "ffn_gate")
+    up = checkpoint_name(
+        jnp.einsum("bsd,df->bsf", h2, lp["w3"]), "ffn_up")
     ff = with_logical_constraint(gate * up, "batch", "seq", "mlp",
                                  mesh=mesh, rules=rules)
     x = x + jnp.einsum("bsf,fd->bsd", ff, lp["w2"])
@@ -257,9 +268,11 @@ def _embed_lookup(embed, tokens, mesh, rules):
     return embed[tokens]
 
 
-def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
-            rules=DEFAULT_RULES, positions=None):
-    """tokens: [B, S] int32 → logits [B, S, vocab] (cfg.dtype)."""
+def forward_hidden(params, tokens, cfg: LlamaConfig, *, mesh=None,
+                   rules=DEFAULT_RULES, positions=None):
+    """tokens: [B, S] int32 → final-norm hidden states [B, S, D]
+    (cfg.dtype) — the stack without the output projection, so the loss
+    can fuse projection+CE (`fused_linear_cross_entropy`)."""
     # With context parallelism each shard sees a sequence chunk; RoPE
     # must use global positions, which the caller passes in. Default is
     # the unsharded arange. For explicit positions, cos/sin come from an
@@ -292,28 +305,71 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
         # boundary: the backward then recomputes only the cheap projections
         # (for the q/k/v residuals) and never re-runs the forward attention
         # kernel. ~37MB/layer at 4x2048 — a large step-time win for a small
-        # slice of HBM.
+        # slice of HBM. remat="mlp" additionally saves the two MLP hidden
+        # activations (the dominant recompute FLOPs; ~268MB/layer at
+        # 4x2048) — worth it when the fused-CE loss path leaves the HBM
+        # headroom.
+        if cfg.remat not in (True, "mlp", "gate"):
+            raise ValueError(
+                f"remat={cfg.remat!r}: expected False, True, 'gate', or "
+                "'mlp' (a typo here would silently train with attn-only "
+                "checkpointing)")
+        names = ["flash_out", "flash_lse"]
+        if cfg.remat == "mlp":
+            names += ["ffn_gate", "ffn_up"]
+        elif cfg.remat == "gate":  # half the HBM of "mlp"
+            names += ["ffn_gate"]
         scan_body = jax.checkpoint(
             scan_body,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                "flash_out", "flash_lse"))
+            policy=jax.checkpoint_policies.save_only_these_names(*names))
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm_reference(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm_reference(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
+            rules=DEFAULT_RULES, positions=None):
+    """tokens: [B, S] int32 → logits [B, S, vocab] (cfg.dtype)."""
+    x = forward_hidden(params, tokens, cfg, mesh=mesh, rules=rules,
+                       positions=positions)
     out_w = params["embed"].T if cfg.tie_embeddings else params["out"]
     logits = jnp.einsum("bsd,dv->bsv", x, out_w.astype(cfg.dtype))
     return with_logical_constraint(logits, "batch", "seq", "vocab",
                                    mesh=mesh, rules=rules)
 
 
+def _vocab_sharded(mesh, rules) -> bool:
+    if mesh is None:
+        return False
+    axis = dict(rules).get("vocab")
+    if axis is None:
+        return False
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape.get(a, 1)
+    return size > 1
+
+
 def loss_fn(params, batch, cfg: LlamaConfig, *, mesh=None,
             rules=DEFAULT_RULES):
     """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S],
     optional "positions": [B,S]}. Returns (mean loss f32, metrics dict)."""
-    logits = forward(params, batch["tokens"], cfg, mesh=mesh, rules=rules,
-                     positions=batch.get("positions"))
-    b, s, v = logits.shape
-    losses = softmax_cross_entropy(
-        logits.reshape(b * s, v), batch["targets"].reshape(b * s))
+    b, s = batch["tokens"].shape
+    if cfg.fused_ce and not _vocab_sharded(mesh, rules):
+        # Fused projection+CE: the [tokens, vocab] logits tensor is never
+        # materialized (the largest single activation at 128k vocab).
+        x = forward_hidden(params, batch["tokens"], cfg, mesh=mesh,
+                           rules=rules, positions=batch.get("positions"))
+        out_w = params["embed"].T if cfg.tie_embeddings else params["out"]
+        losses = fused_linear_cross_entropy(
+            x.reshape(b * s, cfg.dim), out_w.astype(cfg.dtype),
+            batch["targets"].reshape(b * s))
+    else:
+        logits = forward(params, batch["tokens"], cfg, mesh=mesh,
+                         rules=rules, positions=batch.get("positions"))
+        losses = softmax_cross_entropy(
+            logits.reshape(b * s, cfg.vocab_size),
+            batch["targets"].reshape(b * s))
     losses = losses.reshape(b, s)
     mask = batch.get("mask")
     if mask is None:

@@ -88,6 +88,44 @@ def cmd_job(args):
         print("stopped")
 
 
+def cmd_serve(args):
+    """`serve deploy/run/status/shutdown` (reference
+    `serve/scripts.py` CLI over the REST schema)."""
+    import json
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import schema
+
+    ray_tpu.init(ignore_reinit_error=True)
+    if args.serve_cmd == "deploy":
+        import yaml
+
+        with open(args.config_file) as f:
+            config = yaml.safe_load(f)
+        schema.apply_config(config)
+        print(f"deployed {len(config.get('applications', []))} "
+              "application(s)")
+    elif args.serve_cmd == "run":
+        # serve.run binds bare Deployments itself
+        serve.run(schema.import_target(args.import_path),
+                  route_prefix=args.route_prefix)
+        print(f"serving {args.import_path}")
+        if args.blocking:
+            import time
+
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                pass
+    elif args.serve_cmd == "status":
+        print(json.dumps(schema.status_schema(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_dashboard(args):
     from ray_tpu.dashboard import start_dashboard
 
@@ -136,6 +174,18 @@ def main(argv=None):
     p = sub.add_parser("dashboard")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("serve")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    pd = ssub.add_parser("deploy")
+    pd.add_argument("config_file")
+    pr = ssub.add_parser("run")
+    pr.add_argument("import_path")
+    pr.add_argument("--route-prefix", default=None)
+    pr.add_argument("--blocking", action="store_true")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     args.fn(args)

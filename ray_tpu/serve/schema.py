@@ -1,0 +1,186 @@
+"""Declarative Serve config schemas + apply.
+
+Reference: `python/ray/serve/schema.py` (pydantic models behind the REST
+API and `serve deploy`) — here as validated dataclasses: a config file
+describes applications by import path with per-deployment option
+overrides; `apply_config` makes the cluster match it; `status_schema`
+is the inverse (live state → config-shaped dict). The dashboard mounts
+these at `/api/serve/applications/` (GET/PUT, reference REST surface)
+and `scripts/cli.py serve` drives them from the command line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, List, Optional
+
+_DEPLOYMENT_FIELDS = ("name", "num_replicas", "max_concurrent_queries",
+                      "user_config", "autoscaling_config",
+                      "ray_actor_options", "version")
+
+
+@dataclasses.dataclass
+class DeploymentSchema:
+    """Per-deployment override block (reference DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    user_config: Any = None
+    autoscaling_config: Optional[dict] = None
+    ray_actor_options: Optional[dict] = None
+    version: Optional[str] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DeploymentSchema":
+        unknown = set(d) - set(_DEPLOYMENT_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown deployment config keys: {sorted(unknown)} "
+                f"(valid: {list(_DEPLOYMENT_FIELDS)})")
+        if "name" not in d:
+            raise ValueError("deployment config requires 'name'")
+        return DeploymentSchema(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+@dataclasses.dataclass
+class ServeApplicationSchema:
+    """One application: an import path to a bound deployment (graph)
+    plus overrides (reference ServeApplicationSchema)."""
+
+    import_path: str
+    name: str = "default"
+    route_prefix: Optional[str] = None
+    deployments: List[DeploymentSchema] = dataclasses.field(
+        default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ServeApplicationSchema":
+        d = dict(d)
+        unknown = set(d) - {"import_path", "name", "route_prefix",
+                            "deployments"}
+        if unknown:
+            raise ValueError(
+                f"unknown application config keys: {sorted(unknown)}")
+        if "import_path" not in d:
+            raise ValueError("application config requires 'import_path'")
+        deps = [DeploymentSchema.from_dict(x)
+                for x in d.pop("deployments", [])]
+        return ServeApplicationSchema(deployments=deps, **d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"import_path": self.import_path,
+                               "name": self.name}
+        if self.route_prefix is not None:
+            out["route_prefix"] = self.route_prefix
+        if self.deployments:
+            out["deployments"] = [x.to_dict() for x in self.deployments]
+        return out
+
+
+@dataclasses.dataclass
+class ServeDeploySchema:
+    """Top-level config: the list of applications (reference
+    ServeDeploySchema)."""
+
+    applications: List[ServeApplicationSchema]
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ServeDeploySchema":
+        unknown = set(d) - {"applications", "proxy_location",
+                            "http_options"}
+        if unknown:
+            raise ValueError(f"unknown serve config keys: "
+                             f"{sorted(unknown)}")
+        apps = d.get("applications")
+        if not isinstance(apps, list) or not apps:
+            raise ValueError("serve config requires a non-empty "
+                             "'applications' list")
+        return ServeDeploySchema(
+            applications=[ServeApplicationSchema.from_dict(a)
+                          for a in apps])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"applications": [a.to_dict()
+                                 for a in self.applications]}
+
+
+def import_target(import_path: str):
+    """Resolve "pkg.module:attr" to the bound application object."""
+    if ":" not in import_path:
+        raise ValueError(
+            f"import path {import_path!r} must be 'module:attribute'")
+    module_name, attr = import_path.split(":", 1)
+    module = importlib.import_module(module_name)
+    target = module
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
+def _apply_overrides(target, overrides: Dict[str, DeploymentSchema]):
+    """Rebuild an Application tree with per-deployment overrides."""
+    from ray_tpu.serve import Application
+
+    if not isinstance(target, Application):
+        return target
+
+    def rebuild(value):
+        if isinstance(value, Application):
+            dep = value.deployment
+            sch = overrides.get(dep.name)
+            if sch is not None:
+                dep = dep.options(**sch.to_dict())
+            args = tuple(rebuild(a) for a in value.args)
+            kwargs = {k: rebuild(v) for k, v in value.kwargs.items()}
+            return Application(dep, args, kwargs)
+        if isinstance(value, (list, tuple)):
+            return type(value)(rebuild(v) for v in value)
+        if isinstance(value, dict):
+            return {k: rebuild(v) for k, v in value.items()}
+        return value
+
+    return rebuild(target)
+
+
+def apply_config(config: Dict[str, Any], *, blocking: bool = True):
+    """Make the cluster match a declarative config (the PUT
+    /api/serve/applications handler and `serve deploy`). Returns
+    {app_name: ServeHandle}."""
+    from ray_tpu import serve
+
+    schema = ServeDeploySchema.from_dict(config)
+    handles = {}
+    for app in schema.applications:
+        target = import_target(app.import_path)
+        if isinstance(target, serve.Deployment):
+            # bind here (not in serve.run) so overrides below can walk
+            # the Application tree
+            target = target.bind()
+        overrides = {d.name: d for d in app.deployments}
+        target = _apply_overrides(target, overrides)
+        handles[app.name] = serve.run(
+            target, name=app.name, route_prefix=app.route_prefix,
+            _blocking=blocking)
+    return handles
+
+
+def status_schema() -> Dict[str, Any]:
+    """Live deployment state, config-shaped (GET handler / `serve
+    status`)."""
+    from ray_tpu import serve
+
+    out = {}
+    for name, info in serve.status().items():
+        out[name] = {
+            "status": info.get("status"),
+            "message": info.get("message", ""),
+            "num_replicas": info.get("num_replicas"),
+            "version": info.get("version"),
+        }
+    return out

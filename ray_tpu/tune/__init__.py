@@ -42,6 +42,11 @@ from ray_tpu.tune.trainable import (  # noqa: F401
     Trainable,
     wrap_function,
 )
+from ray_tpu.tune.syncer import (  # noqa: F401
+    LocalSyncer,
+    SyncConfig,
+    Syncer,
+)
 from ray_tpu.tune.tuner import Tuner, TuneConfig, run  # noqa: F401
 
 # Function-API reporting (reference: `ray.tune.report` → air session).

@@ -1,0 +1,155 @@
+"""Syncer: ship experiment/trial artifacts to durable storage.
+
+Reference: `python/ray/tune/syncer.py` — a `SyncConfig` on the RunConfig
+selects a `Syncer` that uploads the experiment directory (state file +
+trial checkpoints) to an `upload_dir` after checkpoint events, rate-
+limited by `sync_period`; `Tuner.restore` syncs back down first. The
+reference speaks pyarrow.fs URIs (s3/gs); this environment has no object
+store, so the built-ins are filesystem-to-filesystem (a network mount is
+the multi-node story), and the ABC is the plug-in point for cloud
+backends.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Union
+
+
+class Syncer:
+    """sync_up/sync_down move a whole directory tree; wait() blocks on
+    any in-flight background transfer."""
+
+    def sync_up(self, local_dir: str, remote_dir: str) -> bool:
+        raise NotImplementedError
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, remote_dir: str) -> bool:
+        shutil.rmtree(remote_dir, ignore_errors=True)
+        return True
+
+    def wait(self):
+        pass
+
+
+class LocalSyncer(Syncer):
+    """Filesystem copy (shutil) — the default."""
+
+    def sync_up(self, local_dir: str, remote_dir: str) -> bool:
+        if not os.path.isdir(local_dir):
+            return False
+        try:
+            shutil.copytree(local_dir, remote_dir, dirs_exist_ok=True)
+        except FileNotFoundError:
+            # A concurrent experiment-state save os.replace()d a file
+            # mid-copy; the tree is consistent again by now — retry once.
+            shutil.copytree(local_dir, remote_dir, dirs_exist_ok=True)
+        return True
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> bool:
+        if not os.path.isdir(remote_dir):
+            return False
+        shutil.copytree(remote_dir, local_dir, dirs_exist_ok=True)
+        return True
+
+
+class _BackgroundSyncer(Syncer):
+    """Run another syncer's sync_up off-thread (the experiment loop never
+    blocks on uploads — reference's default behavior)."""
+
+    def __init__(self, inner: Syncer):
+        self.inner = inner
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def _run(self, local_dir: str, remote_dir: str):
+        try:
+            self.inner.sync_up(local_dir, remote_dir)
+        except BaseException as e:  # noqa: BLE001 — surfaced in wait()
+            self._error = e
+
+    def sync_up(self, local_dir: str, remote_dir: str) -> bool:
+        self.wait()
+        self._thread = threading.Thread(
+            target=self._run, args=(local_dir, remote_dir), daemon=True)
+        self._thread.start()
+        return True
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> bool:
+        self.wait()
+        return self.inner.sync_down(remote_dir, local_dir)
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError("background sync failed") from e
+
+
+@dataclass
+class SyncConfig:
+    """Reference `tune/syncer.py` SyncConfig."""
+
+    upload_dir: Optional[str] = None
+    syncer: Union[str, Syncer, None] = "auto"  # "auto" | Syncer | None
+    sync_period: float = 300.0
+    sync_on_checkpoint: bool = True
+
+    def resolve_syncer(self) -> Optional[Syncer]:
+        if self.syncer is None or self.upload_dir is None:
+            return None
+        if isinstance(self.syncer, Syncer):
+            return self.syncer
+        if self.syncer == "auto":
+            return _BackgroundSyncer(LocalSyncer())
+        raise ValueError(f"unknown syncer {self.syncer!r}")
+
+
+class SyncerCallback:
+    """Tuner-side driver: rate-limited upload of the experiment dir."""
+
+    def __init__(self, sync_config: SyncConfig, experiment_dir: str):
+        self.config = sync_config
+        self.experiment_dir = experiment_dir
+        self.syncer = sync_config.resolve_syncer()
+        self._last_sync = 0.0
+
+    @property
+    def remote_dir(self) -> Optional[str]:
+        if self.config.upload_dir is None:
+            return None
+        return os.path.join(self.config.upload_dir,
+                            os.path.basename(self.experiment_dir))
+
+    def maybe_sync(self, *, force: bool = False):
+        if self.syncer is None:
+            return
+        if not force and not self.config.sync_on_checkpoint:
+            return  # periodic-only mode: just the final forced sync
+        now = time.monotonic()
+        if not force and now - self._last_sync < self.config.sync_period:
+            return  # rate limit: full-tree copies are expensive
+        self._last_sync = now
+        self.syncer.sync_up(self.experiment_dir, self.remote_dir)
+
+    def close(self):
+        if self.syncer is not None:
+            self.maybe_sync(force=True)
+            self.syncer.wait()
+
+
+def sync_down_experiment(upload_dir: str, name: str,
+                         local_dir: str) -> bool:
+    """Fetch `<upload_dir>/<name>` into `<local_dir>/<name>` (the
+    Tuner.restore entry point for synced experiments)."""
+    syncer = LocalSyncer()
+    return syncer.sync_down(os.path.join(upload_dir, name),
+                            os.path.join(local_dir, name))

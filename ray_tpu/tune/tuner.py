@@ -121,6 +121,19 @@ class Tuner:
         with open(tmp, "wb") as f:
             f.write(cloudpickle.dumps(state))
         os.replace(tmp, target)  # atomic: a crash never corrupts state
+        self._maybe_sync()
+
+    def _maybe_sync(self, *, force: bool = False) -> None:
+        sync_cfg = self.run_config.sync_config
+        if sync_cfg is None:
+            return
+        cb = getattr(self, "_syncer_cb", None)
+        if cb is None:
+            from ray_tpu.tune.syncer import SyncerCallback
+
+            cb = self._syncer_cb = SyncerCallback(
+                sync_cfg, self._experiment_dir())
+        cb.maybe_sync(force=force)
 
     @classmethod
     def restore(cls, path: str, trainable: Union[Callable, type]) -> "Tuner":
@@ -218,6 +231,9 @@ class Tuner:
         runner.run()
         if self.run_config.storage_path:
             self._save_experiment_state()
+            cb = getattr(self, "_syncer_cb", None)
+            if cb is not None:
+                cb.close()  # final forced upload, wait for in-flight
         return ResultGrid(self._trials)
 
     def get_results(self) -> ResultGrid:

@@ -166,3 +166,30 @@ def test_cross_entropy_grad_matches_reference():
         lambda l: softmax_cross_entropy_reference(l, labels).mean())(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("v,block", [(500, 128), (384, 128), (1000, 1000)])
+def test_fused_linear_cross_entropy_matches_unfused(v, block):
+    from ray_tpu.ops.cross_entropy import fused_linear_cross_entropy
+
+    n, d = 24, 32
+    x = jax.random.normal(jax.random.PRNGKey(20), (n, d))
+    w = jax.random.normal(jax.random.PRNGKey(21), (d, v)) * 0.1
+    labels = jax.random.randint(jax.random.PRNGKey(22), (n,), 0, v)
+
+    got = fused_linear_cross_entropy(x, w, labels, block)
+    expected = softmax_cross_entropy_reference(x @ w, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-4, atol=1e-4)
+
+    # Gradients wrt both x and w match the unfused composition.
+    gx1, gw1 = jax.grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, labels, block).mean(),
+        argnums=(0, 1))(x, w)
+    gx2, gw2 = jax.grad(
+        lambda x, w: softmax_cross_entropy_reference(x @ w, labels).mean(),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,24 @@
+"""Importable sample application for schema/CLI tests."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment(name="adder")
+class Adder:
+    def __init__(self, doubler, offset=1):
+        self.doubler = doubler
+        self.offset = offset
+
+    def __call__(self, x):
+        import ray_tpu
+
+        return ray_tpu.get(self.doubler.remote(x)) + self.offset
+
+
+app = Adder.bind(Doubler.bind())

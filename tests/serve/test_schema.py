@@ -1,0 +1,124 @@
+"""Serve declarative config: schemas, apply_config, REST, CLI."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.schema import (
+    DeploymentSchema,
+    ServeApplicationSchema,
+    ServeDeploySchema,
+    apply_config,
+    import_target,
+    status_schema,
+)
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_schema_validation():
+    with pytest.raises(ValueError, match="unknown deployment"):
+        DeploymentSchema.from_dict({"name": "x", "replicas": 3})
+    with pytest.raises(ValueError, match="requires 'name'"):
+        DeploymentSchema.from_dict({"num_replicas": 2})
+    with pytest.raises(ValueError, match="requires 'import_path'"):
+        ServeApplicationSchema.from_dict({"name": "a"})
+    with pytest.raises(ValueError, match="non-empty"):
+        ServeDeploySchema.from_dict({"applications": []})
+    s = ServeDeploySchema.from_dict({"applications": [
+        {"import_path": "m:app", "deployments": [
+            {"name": "d", "num_replicas": 3}]}]})
+    assert s.to_dict()["applications"][0]["deployments"][0][
+        "num_replicas"] == 3
+
+
+def test_import_target():
+    app = import_target("tests.serve.sample_app:app")
+    assert isinstance(app, serve.Application)
+    with pytest.raises(ValueError, match="module:attribute"):
+        import_target("no_colon_here")
+
+
+def test_apply_config_with_overrides():
+    handles = apply_config({
+        "applications": [{
+            "name": "calc",
+            "import_path": "tests.serve.sample_app:app",
+            "deployments": [
+                {"name": "adder", "user_config": None},
+                {"name": "Doubler", "num_replicas": 2},
+            ],
+        }],
+    })
+    assert ray_tpu.get(handles["calc"].remote(20)) == 41
+    st = status_schema()
+    assert st["Doubler"]["status"] == "HEALTHY"
+    assert st["Doubler"]["num_replicas"] == 2
+    assert st["adder"]["status"] == "HEALTHY"
+
+
+def test_rest_put_and_get():
+    from ray_tpu.dashboard import shutdown_dashboard, start_dashboard
+
+    try:
+        server = start_dashboard(port=0)
+        base = f"http://{server.host}:{server.port}"
+        config = {"applications": [{
+            "name": "calc",
+            "import_path": "tests.serve.sample_app:app",
+        }]}
+        req = urllib.request.Request(
+            f"{base}/api/serve/applications/", method="PUT",
+            data=json.dumps(config).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+
+        with urllib.request.urlopen(
+                f"{base}/api/serve/applications/", timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["adder"]["status"] == "HEALTHY"
+
+        # invalid config -> 400
+        req = urllib.request.Request(
+            f"{base}/api/serve/applications/", method="PUT",
+            data=b'{"applications": []}')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        shutdown_dashboard()
+
+
+def test_cli_serve_deploy_and_status(tmp_path, capsys):
+    import yaml
+
+    from ray_tpu.scripts.cli import main
+
+    cfg_file = tmp_path / "serve.yaml"
+    cfg_file.write_text(yaml.safe_dump({
+        "applications": [{
+            "name": "calc",
+            "import_path": "tests.serve.sample_app:app",
+            "deployments": [{"name": "Doubler", "num_replicas": 2}],
+        }],
+    }))
+    main(["serve", "deploy", str(cfg_file)])
+    out = capsys.readouterr().out
+    assert "deployed 1 application" in out
+    main(["serve", "status"])
+    out = capsys.readouterr().out
+    assert "HEALTHY" in out
+    main(["serve", "shutdown"])
+    out = capsys.readouterr().out
+    assert "shut down" in out
