@@ -315,9 +315,12 @@ class NodeRuntime:
             if view == last_sent and not keepalive:
                 continue
             try:
+                from ray_tpu._private.node_stats import sample_node_stats
+
                 ok = self.head.call("report_resources",
                                     node_id=self.node_id,
-                                    available=view, labels=self.labels)
+                                    available=view, labels=self.labels,
+                                    stats=sample_node_stats())
                 last_sent = view
                 last_time = time.monotonic()
                 if ok is False:

@@ -116,12 +116,19 @@ class GlobalState:
 
     def nodes(self) -> list:
         b = self._worker.backend
+        head = getattr(b, "head", None)
+        if head is not None and hasattr(head, "_get_nodes"):
+            return head._get_nodes()  # cluster mode: full node table
+        from ray_tpu._private.node_stats import sample_node_stats
+
         return [
             {
                 "NodeID": b.node_id.hex(),
                 "Alive": True,
                 "Resources": b.resources.total,
+                "Available": b.resources.available,
                 "Labels": getattr(b, "labels", {}),
+                "Stats": sample_node_stats(),
             }
         ]
 

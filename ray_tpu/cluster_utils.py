@@ -90,6 +90,9 @@ class _NodeRecord:
         # this instead of pinging the node per submission.
         self.available: Dict[str, float] = dict(resources)
         self.last_report: float = time.monotonic()
+        # Latest physical-stats sample from the node's in-process agent
+        # (node_stats.py), carried on resource reports.
+        self.stats: Dict[str, Any] = {}
 
 
 class ClusterHead:
@@ -154,9 +157,10 @@ class ClusterHead:
         return True
 
     def _report_resources(self, node_id: str, available, total=None,
-                          labels=None):
+                          labels=None, stats=None):
         """Pushed resource-view delta (reference: ray_syncer.h:86). Also
-        treated as a liveness heartbeat by the health checker."""
+        treated as a liveness heartbeat by the health checker, and the
+        carrier for per-node agent stats (node_stats.py)."""
         with self._lock:
             record = self.nodes.get(node_id)
             if record is None:
@@ -166,6 +170,8 @@ class ClusterHead:
                 record.resources = dict(total)
             if labels:
                 record.labels = dict(labels)
+            if stats:
+                record.stats = dict(stats)
             record.last_report = time.monotonic()
         return True
 
@@ -420,7 +426,9 @@ class ClusterHead:
         with self._lock:
             return [
                 {"NodeID": n.node_id, "Address": n.address,
-                 "Resources": n.resources, "Alive": n.alive}
+                 "Resources": n.resources, "Alive": n.alive,
+                 "Available": n.available, "Labels": n.labels,
+                 "Stats": n.stats}
                 for n in self.nodes.values()
             ]
 

@@ -81,9 +81,11 @@ class DashboardServer:
             from ray_tpu.util.metrics import export_prometheus
 
             return export_prometheus().encode(), "text/plain"
+        if path == "/ui":
+            return _UI_HTML.encode(), "text/html"
         routes = {
             "/": lambda: {"status": "ok",
-                          "endpoints": ["/api/nodes", "/api/tasks",
+                          "endpoints": ["/ui", "/api/nodes", "/api/tasks",
                                         "/api/actors", "/api/objects",
                                         "/api/cluster_status",
                                         "/api/serve", "/api/metrics",
@@ -128,6 +130,80 @@ class DashboardServer:
         self._server.shutdown()
         self._server.server_close()
 
+
+# Minimal single-file UI over the JSON API (the reference ships a React
+# app, `dashboard/client/`; the JSON API remains the contract — this
+# page is a zero-dependency reader for humans).
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin:1.2em 0 .4em}
+ table{border-collapse:collapse;background:#fff;font-size:.85rem}
+ th,td{border:1px solid #ddd;padding:.3em .6em;text-align:left}
+ th{background:#f0f0f0} .num{text-align:right}
+ #err{color:#b00} .muted{color:#777}
+</style></head><body>
+<h1>ray_tpu dashboard <span id="ts" class="muted"></span></h1>
+<div id="err"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Cluster resources</h2><table id="res"></table>
+<h2>Task summary</h2><table id="tasks"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Serve applications</h2><table id="serve"></table>
+<script>
+const fmt = (b) => b==null ? "" :
+  b > 1e9 ? (b/1e9).toFixed(1)+" GB" :
+  b > 1e6 ? (b/1e6).toFixed(1)+" MB" : b;
+function table(el, rows, cols){
+  el.innerHTML = "<tr>"+cols.map(c=>"<th>"+c+"</th>").join("")+"</tr>" +
+    rows.map(r=>"<tr>"+cols.map(c=>"<td>"+(r[c]??"")+"</td>").join("")
+    +"</tr>").join("");
+}
+async function refresh(){
+  try {
+    const [nodes, status, actors, serve] = await Promise.all([
+      fetch("/api/nodes").then(r=>r.json()),
+      fetch("/api/cluster_status").then(r=>r.json()),
+      fetch("/api/actors").then(r=>r.json()),
+      fetch("/api/serve").then(r=>r.json())]);
+    table(document.getElementById("nodes"), nodes.map(n=>({
+      NodeID:(n.NodeID||"").slice(0,12), Alive:n.Alive,
+      CPU:(n.Resources||{}).CPU, TPU:(n.Resources||{}).TPU||"",
+      "cpu%":(n.Stats||{}).cpu_percent??"",
+      "mem%":(n.Stats||{}).mem_percent??"",
+      mem:fmt((n.Stats||{}).mem_total),
+      pids:(n.Stats||{}).pid_count??""})),
+      ["NodeID","Alive","CPU","TPU","cpu%","mem%","mem","pids"]);
+    const res = status.cluster_resources||{},
+          avail = status.available_resources||{};
+    table(document.getElementById("res"),
+      Object.keys(res).map(k=>({resource:k, total:res[k],
+                                available:avail[k]??""})),
+      ["resource","total","available"]);
+    const ts = status.task_summary||{};
+    table(document.getElementById("tasks"),
+      Object.keys(ts).map(k=>({name:k,
+        states:JSON.stringify(ts[k].states),
+        "time (s)":ts[k].total_time_s})),
+      ["name","states","time (s)"]);
+    table(document.getElementById("actors"),
+      (Array.isArray(actors)?actors:[]).map(a=>({
+        actor_id:(a.actor_id||"").slice(0,12), class:a.class_name,
+        state:a.state, name:a.name||""})),
+      ["actor_id","class","state","name"]);
+    table(document.getElementById("serve"),
+      Object.entries(serve).map(([k,v])=>({deployment:k,
+        status:(v||{}).status, replicas:(v||{}).num_replicas})),
+      ["deployment","status","replicas"]);
+    document.getElementById("ts").textContent =
+      "refreshed " + new Date().toLocaleTimeString();
+    document.getElementById("err").textContent = "";
+  } catch (e) { document.getElementById("err").textContent = e; }
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
 
 _server: Optional[DashboardServer] = None
 

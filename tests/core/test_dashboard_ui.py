@@ -1,0 +1,69 @@
+"""Dashboard UI page + node stats agent plumbing."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import shutdown_dashboard, start_dashboard
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    shutdown_dashboard()
+    ray_tpu.shutdown()
+
+
+def test_ui_page_served():
+    server = start_dashboard(port=0)
+    base = f"http://{server.host}:{server.port}"
+    with urllib.request.urlopen(f"{base}/ui", timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/html"
+        body = resp.read().decode()
+    assert "ray_tpu dashboard" in body
+    assert "/api/nodes" in body
+    # advertised from the index
+    with urllib.request.urlopen(base, timeout=10) as resp:
+        assert "/ui" in json.loads(resp.read())["endpoints"]
+
+
+def test_nodes_carry_stats():
+    server = start_dashboard(port=0)
+    base = f"http://{server.host}:{server.port}"
+    with urllib.request.urlopen(f"{base}/api/nodes", timeout=10) as resp:
+        nodes = json.loads(resp.read())
+    assert len(nodes) == 1
+    stats = nodes[0]["Stats"]
+    assert stats["mem_total"] > 0
+    assert stats["cpu_count"] >= 1
+    assert "cpu_percent" in stats
+
+
+def test_cluster_nodes_carry_stats():
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        from ray_tpu.experimental import state
+
+        deadline = time.monotonic() + 15
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            nodes = state.list_nodes()
+            remote = [n for n in nodes if n.get("Stats")]
+            ok = bool(remote) and any(
+                n["Stats"].get("mem_total", 0) > 0 for n in remote)
+            if not ok:
+                time.sleep(0.3)
+        assert ok, nodes
+    finally:
+        cluster.shutdown()
