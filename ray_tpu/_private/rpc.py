@@ -1,15 +1,18 @@
-"""Framed-pickle TCP RPC: the cluster control/data plane transport.
+"""Framed TCP RPC: the cluster control/data plane transport.
 
 Role-equivalent to the reference's gRPC layer (`src/ray/rpc/`): a threaded
 server dispatching named methods, and a client with pooled connections.
-Payloads are pickle (cloudpickle for code objects) with a 4-byte length
-prefix — on TPU-VM fleets the control plane rides DCN and this framing is
-sufficient; the tensor plane never touches it (XLA collectives own ICI).
+The envelope and all standard-typed payloads ride the typed wire format
+(`_private/wire.py` — the protobuf-contracts role: declared, versioned
+`Request`/`Reply` messages, validated at decode); only user payloads
+(functions, custom objects) are carried as explicitly-tagged opaque
+(cloudpickle) sections. On TPU-VM fleets the control plane rides DCN and
+this framing is sufficient; the tensor plane never touches it (XLA
+collectives own ICI).
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
 import struct
@@ -18,7 +21,7 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
-import cloudpickle
+from ray_tpu._private import wire
 
 _LEN = struct.Struct("!I")
 # Reply retention is per client (keyed by the client's id prefix), not a
@@ -46,14 +49,14 @@ def routable_host(peer_address: Tuple[str, int]) -> str:
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
-    payload = cloudpickle.dumps(obj)
+    payload = wire.encode(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def recv_msg(sock: socket.socket) -> Any:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
-    return pickle.loads(_recv_exact(sock, length))
+    return wire.decode(_recv_exact(sock, length))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -91,21 +94,24 @@ class RpcServer:
                         msg = recv_msg(self.request)
                     except (ConnectionError, OSError):
                         return
-                    rid = msg.get("id")
-                    if msg.get("method") not in server_self.dedupe_methods:
+                    if not isinstance(msg, wire.Request):
+                        return  # typed-envelope violation: drop peer
+                    rid = msg.id or None
+                    if msg.method not in server_self.dedupe_methods:
                         rid = None
                     reply = server_self._await_reply(rid) if rid else None
                     if reply is None:
                         try:
-                            fn = server_self.handlers[msg["method"]]
-                            result = fn(**msg.get("kwargs", {}))
-                            reply = {"ok": True, "result": result}
+                            fn = server_self.handlers[msg.method]
+                            result = fn(**(msg.kwargs or {}))
+                            reply = wire.Reply(ok=True, result=result)
                         except BaseException as e:  # noqa: BLE001
                             import traceback
 
-                            reply = {"ok": False,
-                                     "error": f"{type(e).__name__}: {e}",
-                                     "traceback": traceback.format_exc()}
+                            reply = wire.Reply(
+                                ok=False,
+                                error=f"{type(e).__name__}: {e}",
+                                traceback=traceback.format_exc())
                         server_self._finish_reply(rid, reply)
                     try:
                         send_msg(self.request, reply)
@@ -161,9 +167,10 @@ class RpcServer:
         if reply is None:
             # Cache evicted between finish and wakeup: fail the retry
             # rather than silently executing a second time.
-            return {"ok": False,
-                    "error": "RetryError: reply for retried request "
-                             "expired before delivery"}
+            return wire.Reply(
+                ok=False,
+                error="RetryError: reply for retried request expired "
+                      "before delivery")
         return reply
 
     def _finish_reply(self, rid: Optional[str], reply: Any):
@@ -230,19 +237,23 @@ class RpcClient:
             for attempt in (0, 1):
                 try:
                     sock = self._ensure()
-                    send_msg(sock, {"method": method, "kwargs": kwargs,
-                                    "id": rid})
+                    send_msg(sock, wire.Request(id=rid, method=method,
+                                                kwargs=kwargs))
                     reply = recv_msg(sock)
                     break
                 except (ConnectionError, OSError):
                     self.close_locked()
                     if attempt:
                         raise
-        if not reply["ok"]:
+        if not isinstance(reply, wire.Reply):
             raise RemoteCallError(
-                f"{method} failed on {self.address}: {reply['error']}\n"
-                + reply.get("traceback", ""))
-        return reply["result"]
+                f"{method} on {self.address}: malformed reply "
+                f"{type(reply).__name__}")
+        if not reply.ok:
+            raise RemoteCallError(
+                f"{method} failed on {self.address}: {reply.error}\n"
+                + (reply.traceback or ""))
+        return reply.result
 
     def close_locked(self):
         if self._sock is not None:

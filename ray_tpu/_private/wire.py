@@ -1,0 +1,301 @@
+"""Typed wire contracts for the control plane.
+
+Role-equivalent to the reference's protobuf contracts
+(`src/ray/protobuf/*.proto`): every cross-process control message has a
+declared, versioned schema, and the byte format is a small
+self-describing binary encoding — NOT pickle. Pickle (cloudpickle) is
+confined to explicitly-`Opaque` fields (user functions/args/results),
+so the envelope and standard control traffic never require arbitrary
+deserialization; a receiver validates field types against the declared
+schema at decode time and rejects unknown message types and
+newer-than-known schema versions instead of guessing.
+
+Format (tag byte + payload, recursive):
+  N nil · T/F bool · i int64 · I bignum · d float64 · s str · b bytes ·
+  l list · t tuple · m dict · M registered message · O opaque(cloudpickle)
+
+Messages are dataclasses registered with `@message("Name", version=N)`;
+their annotated field types (int/float/str/bytes/bool/dict/list or Any)
+are enforced on decode — the .proto-file role, in Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import typing
+from typing import Any, Dict, Tuple
+
+import cloudpickle
+import pickle
+
+_U32 = struct.Struct("!I")
+_I64 = struct.Struct("!q")
+_F64 = struct.Struct("!d")
+_U16 = struct.Struct("!H")
+
+
+class WireError(ValueError):
+    pass
+
+
+class Opaque:
+    """Explicitly pickled payload (user code/args). The ONLY place the
+    wire format admits pickle — everything else is structural."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
+# -- message registry -------------------------------------------------------
+
+_REGISTRY: Dict[str, Tuple[type, int]] = {}
+
+_SCALAR_CHECKS = {
+    int: int, float: (int, float), str: str, bytes: bytes, bool: bool,
+    dict: dict, list: list, tuple: tuple,
+}
+
+
+def message(name: str, version: int = 1):
+    """Register a dataclass as a wire message type (a .proto entry)."""
+
+    def wrap(cls):
+        cls = dataclasses.dataclass(cls)
+        cls._wire_name = name
+        cls._wire_version = version
+        _REGISTRY[name] = (cls, version)
+        return cls
+
+    return wrap
+
+
+def _check_field(cls, fname: str, ftype, value):
+    if value is None or ftype is Any:
+        return
+    origin = typing.get_origin(ftype)
+    base = origin or ftype
+    expect = _SCALAR_CHECKS.get(base)
+    if expect is not None and not isinstance(value, expect):
+        raise WireError(
+            f"{cls._wire_name}.{fname}: expected {base.__name__}, got "
+            f"{type(value).__name__}")
+
+
+# -- encode -----------------------------------------------------------------
+
+
+def _enc_str(out: bytearray, s: str):
+    raw = s.encode()
+    out += _U32.pack(len(raw))
+    out += raw
+
+
+def _encode_value(out: bytearray, v: Any):
+    if v is None:
+        out += b"N"
+    elif v is True:
+        out += b"T"
+    elif v is False:
+        out += b"F"
+    elif isinstance(v, int):
+        if -(2 ** 63) <= v < 2 ** 63:
+            out += b"i"
+            out += _I64.pack(v)
+        else:
+            out += b"I"
+            raw = str(v).encode()
+            out += _U32.pack(len(raw))
+            out += raw
+    elif isinstance(v, float):
+        out += b"d"
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        out += b"s"
+        _enc_str(out, v)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        raw = bytes(v)
+        out += b"b"
+        out += _U32.pack(len(raw))
+        out += raw
+    elif isinstance(v, (list, tuple)):
+        out += b"l" if isinstance(v, list) else b"t"
+        out += _U32.pack(len(v))
+        for item in v:
+            _encode_value(out, item)
+    elif isinstance(v, dict):
+        out += b"m"
+        out += _U32.pack(len(v))
+        for k, val in v.items():
+            _encode_value(out, k)
+            _encode_value(out, val)
+    elif isinstance(v, Opaque):
+        raw = cloudpickle.dumps(v.value)
+        out += b"O"
+        out += _U32.pack(len(raw))
+        out += raw
+    elif hasattr(type(v), "_wire_name"):
+        out += b"M"
+        _enc_str(out, type(v)._wire_name)
+        out += _U16.pack(type(v)._wire_version)
+        fields = dataclasses.fields(v)
+        out += _U16.pack(len(fields))
+        for f in fields:
+            _enc_str(out, f.name)
+            _encode_value(out, getattr(v, f.name))
+    else:
+        # Not a standard type and not declared: ship as opaque — the
+        # receiver sees it tagged as pickled, never by surprise.
+        raw = cloudpickle.dumps(v)
+        out += b"O"
+        out += _U32.pack(len(raw))
+        out += raw
+
+
+def encode(v: Any) -> bytes:
+    out = bytearray()
+    _encode_value(out, v)
+    return bytes(out)
+
+
+def encodes_natively(v: Any) -> bool:
+    """True if v encodes without any opaque (pickle) section."""
+    return b"O" not in _tags_of(encode(v))
+
+
+def _tags_of(raw: bytes) -> bytes:
+    # Walk the encoding collecting tag bytes (cheap structural check).
+    tags = bytearray()
+    _Decoder(raw, collect=tags).value()
+    return bytes(tags)
+
+
+# -- decode -----------------------------------------------------------------
+
+
+class _Decoder:
+    def __init__(self, raw: bytes, *, allow_opaque: bool = True,
+                 collect: bytearray = None):
+        self.raw = raw
+        self.pos = 0
+        self.allow_opaque = allow_opaque
+        self.collect = collect
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.raw):
+            raise WireError("truncated message")
+        chunk = self.raw[self.pos:self.pos + n]
+        self.pos += n
+        return chunk
+
+    def _str(self) -> str:
+        (n,) = _U32.unpack(self._take(4))
+        return self._take(n).decode()
+
+    def value(self) -> Any:
+        tag = self._take(1)
+        if self.collect is not None:
+            self.collect += tag
+        if tag == b"N":
+            return None
+        if tag == b"T":
+            return True
+        if tag == b"F":
+            return False
+        if tag == b"i":
+            return _I64.unpack(self._take(8))[0]
+        if tag == b"I":
+            return int(self._str())
+        if tag == b"d":
+            return _F64.unpack(self._take(8))[0]
+        if tag == b"s":
+            return self._str()
+        if tag == b"b":
+            (n,) = _U32.unpack(self._take(4))
+            return self._take(n)
+        if tag in (b"l", b"t"):
+            (n,) = _U32.unpack(self._take(4))
+            items = [self.value() for _ in range(n)]
+            return items if tag == b"l" else tuple(items)
+        if tag == b"m":
+            (n,) = _U32.unpack(self._take(4))
+            return {self.value(): self.value() for _ in range(n)}
+        if tag == b"O":
+            (n,) = _U32.unpack(self._take(4))
+            raw = self._take(n)
+            if self.collect is not None:
+                return None  # structural walk: don't unpickle
+            if not self.allow_opaque:
+                raise WireError("opaque payload rejected by receiver")
+            return pickle.loads(raw)
+        if tag == b"M":
+            name = self._str()
+            (version,) = _U16.unpack(self._take(2))
+            (nfields,) = _U16.unpack(self._take(2))
+            entry = _REGISTRY.get(name)
+            if entry is None and self.collect is None:
+                raise WireError(f"unknown message type {name!r}")
+            cls, known_version = entry if entry else (None, version)
+            if version > known_version and self.collect is None:
+                raise WireError(
+                    f"message {name} v{version} is newer than known "
+                    f"v{known_version}; upgrade the receiver")
+            kwargs = {}
+            for _ in range(nfields):
+                fname = self._str()
+                fval = self.value()
+                kwargs[fname] = fval
+            if self.collect is not None:
+                return None
+            declared = {f.name: f for f in dataclasses.fields(cls)}
+            clean = {}
+            for fname, fval in kwargs.items():
+                f = declared.get(fname)
+                if f is None:
+                    continue  # older receiver: skip newer fields
+                _check_field(cls, fname, f.type if not isinstance(
+                    f.type, str) else typing.get_type_hints(cls).get(
+                        fname, Any), fval)
+                clean[fname] = fval
+            return cls(**clean)
+        raise WireError(f"bad wire tag {tag!r}")
+
+
+def decode(raw: bytes, *, allow_opaque: bool = True) -> Any:
+    dec = _Decoder(raw, allow_opaque=allow_opaque)
+    out = dec.value()
+    if dec.pos != len(raw):
+        raise WireError("trailing bytes after message")
+    return out
+
+
+# -- the control-plane contracts -------------------------------------------
+# The envelope (every RPC) and the typed control messages. Adding a field
+# is backward compatible (older receivers skip unknown fields); bumping
+# `version` is the breaking-change gate (newer versions are rejected by
+# older receivers with a clear error).
+
+
+@message("rpc.Request", version=1)
+class Request:
+    id: str = ""           # "" = no exactly-once dedupe requested
+    method: str = ""
+    kwargs: Any = None     # dict; values may be Opaque
+
+
+@message("rpc.Reply", version=1)
+class Reply:
+    ok: bool = True
+    result: Any = None
+    error: str = ""
+    traceback: str = ""
+
+
+@message("node.ResourceReport", version=1)
+class ResourceReport:
+    node_id: str = ""
+    available: dict = None
+    labels: dict = None
+    stats: dict = None

@@ -89,14 +89,15 @@ def test_rpc_retry_does_not_reexecute():
         # Simulate a connection drop after a processed request: replay the
         # same request id manually and expect the cached reply.
         from ray_tpu._private.rpc import recv_msg, send_msg
+        from ray_tpu._private import wire
         import socket
 
         rid = f"{client._id_prefix}:{client._seq}"
         with socket.create_connection(server.address) as sock:
-            send_msg(sock, {"method": "bump", "kwargs": {"n": 1},
-                            "id": rid})
+            send_msg(sock, wire.Request(method="bump", kwargs={"n": 1},
+                                        id=rid))
             reply = recv_msg(sock)
-        assert reply["ok"] and reply["result"] == 1
+        assert reply.ok and reply.result == 1
         assert calls == [1], "handler re-executed on retry"
         client.close()
     finally:
@@ -111,6 +112,7 @@ def test_rpc_reply_retained_until_acked_by_next_request():
     expired gets an error, never a re-execution."""
     import socket
 
+    from ray_tpu._private import wire
     from ray_tpu._private.rpc import (RpcClient, RpcServer, recv_msg,
                                       send_msg)
 
@@ -129,14 +131,15 @@ def test_rpc_reply_retained_until_acked_by_next_request():
         # Heavy traffic from *other* clients must not evict the reply.
         for i in range(50):
             with socket.create_connection(server.address) as sock:
-                send_msg(sock, {"method": "bump", "kwargs": {"n": 0},
-                                "id": f"other{i}:1"})
+                send_msg(sock, wire.Request(method="bump",
+                                            kwargs={"n": 0},
+                                            id=f"other{i}:1"))
                 recv_msg(sock)
         with socket.create_connection(server.address) as sock:
-            send_msg(sock, {"method": "bump", "kwargs": {"n": 1},
-                            "id": rid})
+            send_msg(sock, wire.Request(method="bump", kwargs={"n": 1},
+                                        id=rid))
             reply = recv_msg(sock)
-        assert reply["ok"] and reply["result"] == 1, reply
+        assert reply.ok and reply.result == 1, reply
         assert calls.count(1) == 1, "handler re-executed on delayed retry"
         # The client's next request acks (drops) the old reply; a replay
         # of the acked id then re-executes at most by design choice — but

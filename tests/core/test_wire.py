@@ -1,0 +1,140 @@
+"""Typed wire contracts: roundtrips, validation, versioning, opaque
+confinement, and the RPC envelope integration."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import wire
+
+
+def roundtrip(v):
+    return wire.decode(wire.encode(v))
+
+
+def test_scalar_roundtrips():
+    for v in [None, True, False, 0, -1, 2 ** 40, -(2 ** 70), 2 ** 100,
+              3.25, float("inf"), "", "héllo", b"", b"\x00\xff",
+              [1, 2, [3]], (1, (2,)), {"a": 1, 2: "b", b"k": None}]:
+        got = roundtrip(v)
+        assert got == v
+        assert type(got) is type(v) or isinstance(v, bool)
+
+
+def test_nested_structures_stay_native():
+    msg = {"method": "report", "kwargs": {"available": {"CPU": 4.0},
+                                          "ids": [b"\x01" * 16] * 3}}
+    assert wire.encodes_natively(msg)
+    assert roundtrip(msg) == msg
+
+
+def test_unknown_objects_become_tagged_opaque():
+    arr = np.arange(5)
+    enc = wire.encode({"x": arr})
+    assert not wire.encodes_natively({"x": arr})
+    got = wire.decode(enc)
+    np.testing.assert_array_equal(got["x"], arr)
+    # A receiver can refuse opaque payloads outright.
+    with pytest.raises(wire.WireError, match="opaque"):
+        wire.decode(enc, allow_opaque=False)
+
+
+def test_envelope_messages():
+    req = wire.Request(id="c:1", method="f", kwargs={"x": 1})
+    got = roundtrip(req)
+    assert isinstance(got, wire.Request)
+    assert (got.id, got.method, got.kwargs) == ("c:1", "f", {"x": 1})
+    assert wire.encodes_natively(req)
+
+    rep = wire.Reply(ok=False, error="boom", traceback="tb")
+    got = roundtrip(rep)
+    assert isinstance(got, wire.Reply)
+    assert not got.ok and got.error == "boom"
+
+
+def test_field_type_validation():
+    bad = wire.Request(id=7, method="f", kwargs=None)  # id must be str
+    with pytest.raises(wire.WireError, match="expected str"):
+        roundtrip(bad)
+
+
+def test_unknown_message_rejected():
+    @wire.message("test.Ephemeral", version=1)
+    class Ephemeral:
+        x: int = 0
+
+    enc = wire.encode(Ephemeral(x=1))
+    del wire._REGISTRY["test.Ephemeral"]
+    with pytest.raises(wire.WireError, match="unknown message"):
+        wire.decode(enc)
+
+
+def test_newer_version_rejected_older_fields_skipped():
+    @wire.message("test.Versioned", version=2)
+    class V2:
+        x: int = 0
+        y: str = ""
+
+    enc = wire.encode(V2(x=1, y="z"))
+
+    # Re-register as v1 with fewer fields (an "older receiver").
+    @wire.message("test.Versioned", version=1)
+    class V1:
+        x: int = 0
+
+    with pytest.raises(wire.WireError, match="newer than known"):
+        wire.decode(enc)
+
+    # Same version, extra field: skipped, not fatal (forward-compatible
+    # field addition).
+    @wire.message("test.Versioned2", version=1)
+    class W2:
+        x: int = 0
+        y: str = ""
+
+    enc = wire.encode(W2(x=5, y="keep"))
+    del wire._REGISTRY["test.Versioned2"]
+
+    @wire.message("test.Versioned2", version=1)
+    class W1:
+        x: int = 0
+
+    got = wire.decode(enc)
+    assert got.x == 5 and not hasattr(got, "y")
+    del wire._REGISTRY["test.Versioned"]
+    del wire._REGISTRY["test.Versioned2"]
+
+
+def test_truncation_and_trailing_errors():
+    enc = wire.encode({"a": [1, 2, 3]})
+    with pytest.raises(wire.WireError, match="truncated"):
+        wire.decode(enc[:-2])
+    with pytest.raises(wire.WireError, match="trailing"):
+        wire.decode(enc + b"N")
+
+
+def test_rpc_envelope_end_to_end():
+    from ray_tpu._private.rpc import RemoteCallError, RpcClient, RpcServer
+
+    server = RpcServer({"add": lambda a, b: a + b,
+                        "boom": lambda: 1 / 0})
+    try:
+        client = RpcClient.dedicated(server.address)
+        assert client.call("add", a=2, b=40) == 42
+        with pytest.raises(RemoteCallError, match="ZeroDivisionError"):
+            client.call("boom")
+        # user payloads (arbitrary objects) still flow
+        assert client.call("add", a=[1, 2], b=[np.int64(3)]) \
+            == [1, 2, np.int64(3)]
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_resource_report_contract():
+    rep = wire.ResourceReport(node_id="n1", available={"CPU": 2.0},
+                              labels={}, stats={"cpu_percent": 1.5})
+    got = roundtrip(rep)
+    assert dataclasses.asdict(got) == dataclasses.asdict(rep)
+    assert wire.encodes_natively(rep)
