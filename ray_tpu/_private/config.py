@@ -50,6 +50,14 @@ class RayTpuConfig:
     # -- rpc -------------------------------------------------------------
     rpc_connect_retries: int = 10
     rpc_retry_backoff_s: float = 0.5
+    # Mutual-TLS for the control plane (reference: RAY_USE_TLS +
+    # RAY_TLS_SERVER_CERT/KEY/CA_CERT, rpc/grpc_server TLS creds). All
+    # three paths must be set when use_tls is on; both sides verify the
+    # peer against the shared CA.
+    use_tls: bool = False
+    tls_server_cert: str = ""
+    tls_server_key: str = ""
+    tls_ca_cert: str = ""
 
     # -- resource view sync (reference: ray_syncer.h RESOURCE_VIEW) ------
     # Nodes push availability deltas to the head at this period; the

@@ -32,6 +32,29 @@ _LEN = struct.Struct("!I")
 _MAX_CLIENT_CACHES = 4096
 
 
+def _tls_context(server: bool):
+    """Mutual-TLS context when `use_tls` is configured (reference:
+    RAY_USE_TLS + RAY_TLS_* in rpc/grpc_server); None = plaintext."""
+    from ray_tpu._private.config import ray_config
+
+    if not ray_config.use_tls:
+        return None
+    import ssl
+
+    if not (ray_config.tls_server_cert and ray_config.tls_server_key
+            and ray_config.tls_ca_cert):
+        raise ValueError("use_tls requires tls_server_cert, "
+                         "tls_server_key, and tls_ca_cert")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER if server
+                         else ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(ray_config.tls_server_cert,
+                        ray_config.tls_server_key)
+    ctx.load_verify_locations(ray_config.tls_ca_cert)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = False  # fleet nodes verify by CA, not hostname
+    return ctx
+
+
 def routable_host(peer_address: Tuple[str, int]) -> str:
     """The local interface IP a peer at ``peer_address`` would reach us
     on (UDP-connect trick — the kernel picks the outbound interface; no
@@ -118,9 +141,17 @@ class RpcServer:
                     except (ConnectionError, OSError):
                         return
 
+        tls_ctx = _tls_context(server=True)
+
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+
+            def get_request(self):
+                sock, addr = super().get_request()
+                if tls_ctx is not None:
+                    sock = tls_ctx.wrap_socket(sock, server_side=True)
+                return sock, addr
 
         self.handlers = handlers
         self.dedupe_methods = dedupe_methods or frozenset()
@@ -227,7 +258,11 @@ class RpcClient:
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(self.address, timeout=30)
+            sock = socket.create_connection(self.address, timeout=30)
+            ctx = _tls_context(server=False)
+            if ctx is not None:
+                sock = ctx.wrap_socket(sock)
+            self._sock = sock
         return self._sock
 
     def call(self, method: str, **kwargs) -> Any:

@@ -38,6 +38,50 @@ class DashboardServer:
                     self.end_headers()
                     self.wfile.write(str(e).encode())
 
+            def do_POST(self):
+                # Job submission REST (reference: dashboard job_head —
+                # POST /api/jobs/ {entrypoint, metadata?, runtime_env?};
+                # POST /api/jobs/<id>/stop).
+                path = self.path.split("?")[0].rstrip("/")
+                try:
+                    from ray_tpu.job_submission import JobSubmissionClient
+
+                    client = JobSubmissionClient()
+                    if path == "/api/jobs":
+                        n = int(self.headers.get("Content-Length", 0))
+                        spec = json.loads(self.rfile.read(n) or b"{}")
+                        if "entrypoint" not in spec:
+                            raise ValueError("job spec requires "
+                                             "'entrypoint'")
+                        job_id = client.submit_job(
+                            entrypoint=spec["entrypoint"],
+                            metadata=spec.get("metadata"),
+                            runtime_env=spec.get("runtime_env"))
+                        self._json(200, {"job_id": job_id})
+                    elif path.startswith("/api/jobs/") and \
+                            path.endswith("/stop"):
+                        job_id = path[len("/api/jobs/"):-len("/stop")]
+                        self._json(200,
+                                   {"stopped": client.stop_job(job_id)})
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                except ValueError as e:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+            def _json(self, code, obj):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_PUT(self):
                 # Declarative serve deploy (reference REST:
                 # PUT /api/serve/applications/ with a ServeDeploySchema
@@ -83,6 +127,9 @@ class DashboardServer:
             return export_prometheus().encode(), "text/plain"
         if path == "/ui":
             return _UI_HTML.encode(), "text/html"
+        if path == "/api/jobs" or path.startswith("/api/jobs/"):
+            return (json.dumps(self._jobs_route(path),
+                               default=str).encode(), "application/json")
         routes = {
             "/": lambda: {"status": "ok",
                           "endpoints": ["/ui", "/api/nodes", "/api/tasks",
@@ -107,6 +154,20 @@ class DashboardServer:
         }
         fn = routes[path]  # KeyError → 404
         return json.dumps(fn(), default=str).encode(), "application/json"
+
+    @staticmethod
+    def _jobs_route(path: str):
+        import dataclasses
+
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient()
+        if path == "/api/jobs":
+            return [dataclasses.asdict(j) for j in client.list_jobs()]
+        rest = path[len("/api/jobs/"):]
+        if rest.endswith("/logs"):
+            return {"logs": client.get_job_logs(rest[:-len("/logs")])}
+        return dataclasses.asdict(client.get_job_info(rest))
 
     @staticmethod
     def _serve_status():

@@ -145,6 +145,20 @@ class JobSubmissionClient:
         return ray_tpu.get(self._supervisor(job_id).stop.remote())
 
     def list_jobs(self) -> List[JobInfo]:
+        # Discover supervisors from the named-actor registry, not the
+        # client-local dict: any client (e.g. each REST request makes a
+        # fresh one) must see every job in the cluster.
+        from ray_tpu.experimental import state
+
+        for row in state.list_actors():
+            name = row.get("name") or ""
+            if name.startswith("_job_supervisor:"):
+                job_id = name[len("_job_supervisor:"):]
+                if job_id not in self._jobs and row["state"] != "DEAD":
+                    try:
+                        self._jobs[job_id] = ray_tpu.get_actor(name)
+                    except ValueError:
+                        pass
         return [ray_tpu.get(s.get_info.remote())
                 for s in self._jobs.values()]
 

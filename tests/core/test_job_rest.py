@@ -1,0 +1,75 @@
+"""Job submission REST surface on the dashboard."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import shutdown_dashboard, start_dashboard
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    shutdown_dashboard()
+    ray_tpu.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_job_submit_status_logs_over_rest():
+    server = start_dashboard(port=0)
+    base = f"http://{server.host}:{server.port}"
+
+    out = _post(f"{base}/api/jobs/", {
+        "entrypoint": "python -c \"print('hello from job')\""})
+    job_id = out["job_id"]
+
+    deadline = time.monotonic() + 30
+    status = None
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(f"{base}/api/jobs/{job_id}",
+                                    timeout=10) as resp:
+            info = json.loads(resp.read())
+        status = info["status"]
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.2)
+    assert status == "SUCCEEDED", info
+
+    with urllib.request.urlopen(f"{base}/api/jobs/{job_id}/logs",
+                                timeout=10) as resp:
+        logs = json.loads(resp.read())["logs"]
+    assert "hello from job" in logs
+
+    with urllib.request.urlopen(f"{base}/api/jobs/", timeout=10) as resp:
+        listing = json.loads(resp.read())
+    assert any(j["job_id"] == job_id for j in listing)
+
+
+def test_job_stop_and_bad_spec():
+    server = start_dashboard(port=0)
+    base = f"http://{server.host}:{server.port}"
+
+    out = _post(f"{base}/api/jobs/", {
+        "entrypoint": "python -c \"import time; time.sleep(60)\""})
+    job_id = out["job_id"]
+    time.sleep(0.5)
+    stopped = _post(f"{base}/api/jobs/{job_id}/stop", {})
+    assert stopped["stopped"] is True
+
+    req = urllib.request.Request(
+        f"{base}/api/jobs/", method="POST", data=b"{}")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
