@@ -17,6 +17,11 @@ from ray_tpu.train.jax_trainer import (  # noqa: F401
     allreduce_gradients,
     prepare_mesh,
 )
+from ray_tpu.train.torch import (  # noqa: F401
+    TorchCheckpoint,
+    TorchConfig,
+    TorchTrainer,
+)
 from ray_tpu.train._internal.backend_executor import (  # noqa: F401
     BackendExecutor,
     TrainingFailedError,

@@ -1,0 +1,149 @@
+"""TorchTrainer: torch DDP training on the actor runtime.
+
+Reference: `python/ray/train/torch/` — `TorchConfig` sets up a
+`torch.distributed` process group across the worker actors
+(`config.py:113` `_setup_torch_process_group`; NCCL there, gloo here —
+this image is CPU torch), `prepare_model` wraps in DDP
+(`train_loop_utils.py:92`), `prepare_data_loader` adds a
+DistributedSampler. Workers run as spawned OS processes (torch process
+groups are process-global state, same constraint as jax.distributed).
+
+On TPU fleets the flagship is `JaxTrainer` (SPMD mesh, XLA collectives);
+TorchTrainer exists for CPU-side torch workloads and API parity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"
+    init_port: int = 7031
+    timeout_s: float = 120.0
+    # Process-global torch state needs one fresh OS process per rank;
+    # the BackendExecutor spawns workers when this is True.
+    distributed: bool = True
+
+    def backend_cls(self):
+        return TorchBackend
+
+
+class TorchBackend(Backend):
+    def on_training_start(self, worker_group,
+                          backend_config: TorchConfig):
+        import ray_tpu
+
+        def get_ip():
+            import socket
+
+            return socket.gethostbyname(socket.gethostname())
+
+        master = worker_group.execute_single(0, get_ip)
+        n = len(worker_group)
+        ray_tpu.get([
+            w.execute.remote(
+                _torch_dist_init, master, backend_config.init_port, n, i,
+                backend_config.backend, backend_config.timeout_s)
+            for i, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig):
+        import ray_tpu
+
+        def teardown():
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+
+        try:
+            ray_tpu.get([w.execute.remote(teardown)
+                         for w in worker_group.workers])
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+def _torch_dist_init(master: str, port: int, world_size: int, rank: int,
+                     backend: str, timeout_s: float):
+    """Per-rank process-group bring-up (reference
+    `_setup_torch_process_group`, train/torch/config.py:113)."""
+    import datetime
+
+    import torch.distributed as dist
+
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master}:{port}",
+        rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return True
+
+
+def prepare_model(model, *, wrap_ddp: Optional[bool] = None):
+    """DDP-wrap when running distributed (reference
+    `train.torch.prepare_model`, train_loop_utils.py:92)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if wrap_ddp is None:
+        wrap_ddp = dist.is_initialized() and dist.get_world_size() > 1
+    if wrap_ddp:
+        model = DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader, *, add_dist_sampler: bool = True):
+    """Rebuild a DataLoader with a DistributedSampler sharding the
+    dataset across ranks (reference `prepare_data_loader`)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, DistributedSampler
+
+    if not (add_dist_sampler and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )
+
+
+class TorchTrainer(DataParallelTrainer):
+    _backend_config_cls = TorchConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional[TorchConfig] = None,
+                 **kwargs: Any):
+        super().__init__(train_loop_per_worker,
+                         backend_config=torch_config or TorchConfig(),
+                         **kwargs)
+
+
+class TorchCheckpoint:
+    """Reference `train/torch/torch_checkpoint.py`: model state dicts as
+    AIR checkpoints."""
+
+    @staticmethod
+    def from_model(model) -> "Any":
+        from ray_tpu.air import Checkpoint
+
+        module = getattr(model, "module", model)  # unwrap DDP
+        return Checkpoint.from_dict(
+            {"model_state": module.state_dict()})
+
+    @staticmethod
+    def get_model(checkpoint, model):
+        """Load the checkpointed state into `model`, returning it."""
+        model.load_state_dict(checkpoint.to_dict()["model_state"])
+        return model
