@@ -88,14 +88,29 @@ def main():
             ttfts.append(first)
             total_tokens[0] += count
 
-    t_start = time.perf_counter()
-    threads = [threading.Thread(target=one_request, args=(p,))
-               for p in prompts]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t_start
+    def run_wave(wave_prompts):
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=one_request, args=(p,))
+                   for p in wave_prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t_start
+
+    # Wave 1 absorbs the platform's idle-restart stall (the tunneled
+    # chip's first dispatch after an idle gap blocks for seconds —
+    # measured ~3.5s on a program that runs in ~60ms warm; see
+    # BENCH_CALIBRATION.json). Wave 2 is the steady-state serving number
+    # a loaded server sees; wave-1 numbers ride along as cold-start.
+    cold_wall = run_wave(prompts)
+    cold_ttfts = sorted(ttfts)
+    cold_p50 = cold_ttfts[len(cold_ttfts) // 2]
+    ttfts.clear()
+    total_tokens[0] = 0
+    first_times.clear()
+    last_times[0] = 0.0
+    wall = run_wave(prompts)
     engine.stop()
 
     ttfts.sort()
@@ -117,6 +132,8 @@ def main():
         "detail": {
             "config": "llama-1.24B" if on_tpu else "llama-debug-cpu",
             "ttft_p95_ms": round(p95 * 1e3, 1),
+            "cold_start_ttft_p50_ms": round(cold_p50 * 1e3, 1),
+            "cold_start_wall_s": round(cold_wall, 2),
             "decode_tokens_per_s": round(decode_tokens / decode_window, 1) if one_wave else None,
             "end_to_end_tokens_per_s": round(total_tokens[0] / wall, 1),
             "requests": args.requests,

@@ -79,7 +79,9 @@ class NodeRuntime:
                 except Exception:
                     pass
             self.transfer_addr = None
+        self._shutdown_event = threading.Event()
         self._install_report_hook()
+        self._install_borrow_hooks()
 
         self.server = RpcServer({
             "submit_task": self._submit_task,
@@ -100,7 +102,6 @@ class NodeRuntime:
         self._prepared_bundles: Dict[tuple, Dict[str, int]] = {}
         # Advertised control address (bind is all-interfaces).
         self.address = (self._adv_host, self.server.address[1])
-        self._shutdown_event = threading.Event()
         # Registration is idempotent; retry through transient head
         # unavailability during cluster bring-up.
         from ray_tpu._private.config import ray_config
@@ -134,6 +135,18 @@ class NodeRuntime:
 
         def store_and_report(spec, values, error=None):
             orig(spec, values, error=error)
+            # Primary-copy pin (reference: plasma primary copies stay
+            # pinned until the owner frees them): local handle churn (an
+            # actor holding then releasing a ref to an object that lives
+            # here) must never evict the only copy; the head's
+            # free_objects is what drops it.
+            for roid in spec.return_ids:
+                worker.memory_store.pin_object(roid)
+            # Borrow registrations first: the output report unpins this
+            # task's args at the head, so any borrow the task created
+            # must be on record before that (same head connection →
+            # ordered).
+            getattr(node, "_flush_borrows", lambda: None)()
             oids = [oid.binary() for oid in spec.return_ids]
             if oids:
                 try:
@@ -143,6 +156,92 @@ class NodeRuntime:
                     pass
 
         worker.store_task_outputs = store_and_report
+
+    def _install_borrow_hooks(self):
+        """Register this node as a borrower of every object it holds a
+        handle to (reference: ReferenceCounter borrower protocol). A ref
+        deserialized here (task arg, value inside actor state) adds this
+        node to the head's borrower set for its object; the last local
+        handle dropping removes it.
+
+        Reporting is LEVEL-based, not edge-based: hooks only mark an oid
+        "touched"; the flush consults the store's current handle count
+        and diffs against what the head was last told. This is immune to
+        drop-then-reacquire races inside one flush window (an edge queue
+        could deliver add+remove in the wrong order), and a failed flush
+        simply re-touches the batch for the next round. Adds are flushed
+        BEFORE task-output reports on the same head connection, so the
+        head never unpins a task's args before learning about a borrow
+        the task created."""
+        worker = self.worker
+        node = self
+        orig_register = worker.register_object_ref
+        orig_unregister = worker.unregister_object_ref
+        touched: set = set()
+        reported: set = set()  # oids the head believes we borrow
+        lock = threading.Lock()
+        flush_lock = threading.Lock()  # one flush at a time (loop +
+        #                                pre-report flushes can race)
+        from ray_tpu._private.ids import ObjectID as _OID
+
+        def flush():
+            with flush_lock:
+                _flush_inner()
+
+        def _flush_inner():
+            with lock:
+                batch = list(touched)
+                touched.clear()
+            if not batch:
+                return
+            adds, removes = [], []
+            for ob in batch:
+                holding = worker.memory_store.local_ref_count(
+                    _OID(ob)) > 0
+                if holding and ob not in reported:
+                    adds.append(ob)
+                elif not holding and ob in reported:
+                    removes.append(ob)
+            try:
+                if adds:
+                    node.head.call("add_borrowers", oids=adds,
+                                   node_id=node.node_id)
+                    reported.update(adds)
+                if removes:
+                    node.head.call("remove_borrowers", oids=removes,
+                                   node_id=node.node_id)
+                    reported.difference_update(removes)
+            except Exception:
+                # Head unreachable: nothing was dropped — re-touch so the
+                # next flush retries (a lost add would let the head free
+                # a borrowed object; a lost remove would leak it).
+                with lock:
+                    touched.update(batch)
+
+        def register(ref):
+            count = orig_register(ref)
+            if count == 1:
+                with lock:
+                    touched.add(ref.id.binary())
+            return count
+
+        def unregister(oid):
+            zero = orig_unregister(oid)
+            if zero:
+                with lock:
+                    touched.add(oid.binary())
+            return zero
+
+        worker.register_object_ref = register
+        worker.unregister_object_ref = unregister
+        self._flush_borrows = flush
+
+        def flush_loop():
+            while not self._shutdown_event.wait(0.2):
+                flush()
+
+        threading.Thread(target=flush_loop, daemon=True,
+                         name="borrow-flush").start()
 
     def _fetch_dependency(self, oid: ObjectID,
                           timeout: Optional[float] = None):

@@ -28,6 +28,10 @@ class _Entry:
     callbacks: list = field(default_factory=list)
     # number of ObjectRef handles alive in this process (best-effort GC)
     local_refs: int = 0
+    # primary-copy pin (cluster nodes pin task outputs until the head's
+    # free — orthogonal to handle refs so borrow edge-detection stays
+    # count==1/count==0)
+    pinned: bool = False
     # spilling bookkeeping: estimated in-memory size; disk URL once the
     # value has been spilled (value is then None until restored)
     size: int = 0
@@ -227,17 +231,49 @@ class MemoryStore:
 
     # -- local reference counting (process-lifetime GC) ------------------
 
-    def add_local_ref(self, object_id: ObjectID) -> None:
+    def add_local_ref(self, object_id: ObjectID) -> int:
+        """Returns the new local handle count (1 = first handle)."""
         with self._lock:
-            self._entry(object_id).local_refs += 1
+            entry = self._entry(object_id)
+            entry.local_refs += 1
+            return entry.local_refs
 
-    def remove_local_ref(self, object_id: ObjectID) -> None:
+    def local_ref_count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return 0 if entry is None else max(entry.local_refs, 0)
+
+    def remove_local_ref(self, object_id: ObjectID) -> bool:
+        """Returns True when this drop took the handle count to zero."""
+        url = None
+        zero = False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return False
+            entry.local_refs -= 1
+            if entry.local_refs <= 0:
+                zero = True
+                if entry.ready and not entry.pinned:
+                    url = self._drop_entry_locked(entry)
+                    del self._entries[object_id]
+        if url is not None and self.spill_manager is not None:
+            self.spill_manager.delete([url])
+        return zero
+
+    def pin_object(self, object_id: ObjectID) -> None:
+        """Keep the local copy regardless of handle count (plasma
+        primary-copy role); released by `unpin_object` or `evict`."""
+        with self._lock:
+            self._entry(object_id).pinned = True
+
+    def unpin_object(self, object_id: ObjectID) -> None:
         url = None
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
                 return
-            entry.local_refs -= 1
+            entry.pinned = False
             if entry.local_refs <= 0 and entry.ready:
                 url = self._drop_entry_locked(entry)
                 del self._entries[object_id]

@@ -200,9 +200,10 @@ class SharedPlane:
         def remove_local_ref(object_id):
             entry = store._entries.get(object_id)
             last = entry is not None and entry.local_refs <= 1
-            orig_remove(object_id)
+            zero = orig_remove(object_id)
             if last and object_id not in store._entries:
                 plane.release(object_id)
+            return zero  # the became-zero signal drives cluster release
 
         store.remove_local_ref = remove_local_ref
 

@@ -131,6 +131,37 @@ class TaskSpec:
                 deps.append(a.id)
         return deps
 
+    def nested_dependencies(self, max_depth: int = 4) -> list[ObjectID]:
+        """ObjectIDs reachable through standard containers in
+        args/kwargs (depth-limited). Used to pin a dispatched task's arg
+        objects against a racing driver release; refs buried in custom
+        user objects are covered by the executing node's borrower
+        registration instead."""
+        from ray_tpu.object_ref import ObjectRef
+
+        deps: list[ObjectID] = []
+        seen: set = set()
+
+        def walk(v, depth):
+            if isinstance(v, ObjectRef):
+                if v.binary() not in seen:
+                    seen.add(v.binary())
+                    deps.append(v.id)
+                return
+            if depth <= 0:
+                return
+            if isinstance(v, (list, tuple, set, frozenset)):
+                for item in v:
+                    walk(item, depth - 1)
+            elif isinstance(v, dict):
+                for k, item in v.items():
+                    walk(k, depth - 1)
+                    walk(item, depth - 1)
+
+        for a in list(self.args) + list(self.kwargs.values()):
+            walk(a, max_depth)
+        return deps
+
     def describe(self) -> str:
         if self.kind == TaskKind.ACTOR_TASK:
             return f"{self.name} (actor={self.actor_id})"

@@ -187,11 +187,11 @@ class Worker:
 
     # -- local handle refcounting ---------------------------------------
 
-    def register_object_ref(self, ref: ObjectRef):
-        self.memory_store.add_local_ref(ref.id)
+    def register_object_ref(self, ref: ObjectRef) -> int:
+        return self.memory_store.add_local_ref(ref.id)
 
-    def unregister_object_ref(self, oid: ObjectID):
-        self.memory_store.remove_local_ref(oid)
+    def unregister_object_ref(self, oid: ObjectID) -> bool:
+        return self.memory_store.remove_local_ref(oid)
 
     def shutdown(self):
         self.backend.shutdown()

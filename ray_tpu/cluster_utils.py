@@ -121,6 +121,15 @@ class ClusterHead:
         self.actor_specs: Dict[bytes, Any] = {}
         self.actor_restarts_left: Dict[bytes, int] = {}
         self._recon_attempts: Dict[bytes, int] = {}
+        # Distributed refcount (reference: reference_count.h borrower
+        # protocol, adapted to head-owned objects). A driver release is
+        # deferred while any node holds a handle (borrowers) or any
+        # dispatched-but-unfinished task's args reference the object
+        # (task_pins); the actual free runs when the last holder drops.
+        self.borrowers: Dict[bytes, set] = {}          # oid -> {node_id}
+        self.task_pins: Dict[bytes, set] = {}          # oid -> {task_id}
+        self._task_pinned: Dict[bytes, list] = {}      # task_id -> [oid]
+        self.driver_released: set = set()
         # Placement-group bundle locations: (pg_id_binary, index) ->
         # node_id, or None for the head itself.
         self.pg_bundle_nodes: Dict[Tuple[bytes, int], Optional[str]] = {}
@@ -128,6 +137,8 @@ class ClusterHead:
             "register_node": self._register_node,
             "report_objects": self._report_objects,
             "report_resources": self._report_resources,
+            "add_borrowers": self._add_borrowers,
+            "remove_borrowers": self._remove_borrowers,
             "locate": self._locate,
             "locate2": self._locate2,
             "get_object": self._get_object,
@@ -182,14 +193,17 @@ class ClusterHead:
         return self.publisher.poll(channel, subscriber_id, cursor, timeout)
 
     def _report_objects(self, oids: List[bytes], address):
+        frees = []
         with self._lock:
             for oid in oids:
                 self.object_locations[oid] = tuple(address)
                 self._recon_attempts.pop(oid, None)
                 # Outputs landed: the producing task is no longer in
-                # flight anywhere.
-                oid_obj = ObjectID(oid)
-                self.inflight.pop(oid_obj.task_id().binary(), None)
+                # flight anywhere; its arg pins drop with it.
+                tid = ObjectID(oid).task_id().binary()
+                self.inflight.pop(tid, None)
+                frees.extend(self._unpin_task_locked(tid))
+        self._fan_out_frees(frees)
         return True
 
     # -- dispatch bookkeeping (called by ClusterBackendMixin) -----------
@@ -212,11 +226,81 @@ class ClusterHead:
         # in-flight actor call (typed ActorDiedError) rather than leave
         # its caller hanging on a never-located return object.
         with self._lock:
-            self.inflight[spec.task_id.binary()] = (node_id, spec)
+            tid = spec.task_id.binary()
+            self.inflight[tid] = (node_id, spec)
+            # Pin arg objects for the task's lifetime: a driver release
+            # racing the dispatch must not free an argument out from
+            # under the executing task.
+            pinned = []
+            for dep in spec.nested_dependencies():
+                ob = dep.binary()
+                self.task_pins.setdefault(ob, set()).add(tid)
+                pinned.append(ob)
+            if pinned:
+                self._task_pinned[tid] = pinned
 
     def clear_inflight(self, spec) -> None:
         with self._lock:
-            self.inflight.pop(spec.task_id.binary(), None)
+            tid = spec.task_id.binary()
+            self.inflight.pop(tid, None)
+            frees = self._unpin_task_locked(tid)
+        self._fan_out_frees(frees)
+
+    def _unpin_task_locked(self, tid: bytes) -> list:
+        frees = []
+        for ob in self._task_pinned.pop(tid, ()):
+            pins = self.task_pins.get(ob)
+            if pins is not None:
+                pins.discard(tid)
+                if not pins:
+                    del self.task_pins[ob]
+                    frees.extend(self._maybe_free_locked(ob))
+        return frees
+
+    def _maybe_free_locked(self, oid: bytes) -> list:
+        """If the driver released oid and nothing pins/borrows it any
+        longer, free it for real. Returns [(addr, oid)] RPC work to do
+        outside the lock."""
+        if oid not in self.driver_released:
+            return []
+        if self.borrowers.get(oid) or self.task_pins.get(oid):
+            return []
+        self.driver_released.discard(oid)
+        self.lineage.pop(oid, None)
+        self._recon_attempts.pop(oid, None)
+        loc = self.object_locations.pop(oid, None)
+        if loc is not None and loc != self.server.address:
+            return [(loc, oid)]
+        return []
+
+    def _fan_out_frees(self, frees: list) -> None:
+        by_addr: Dict[Tuple[str, int], List[bytes]] = {}
+        for addr, oid in frees:
+            by_addr.setdefault(addr, []).append(oid)
+        for addr, batch in by_addr.items():
+            try:
+                RpcClient.to(addr).call("free_objects", oids=batch)
+            except Exception:
+                pass
+
+    def _add_borrowers(self, oids: List[bytes], node_id: str) -> bool:
+        with self._lock:
+            for oid in oids:
+                self.borrowers.setdefault(oid, set()).add(node_id)
+        return True
+
+    def _remove_borrowers(self, oids: List[bytes], node_id: str) -> bool:
+        frees = []
+        with self._lock:
+            for oid in oids:
+                holders = self.borrowers.get(oid)
+                if holders is not None:
+                    holders.discard(node_id)
+                    if not holders:
+                        del self.borrowers[oid]
+                        frees.extend(self._maybe_free_locked(oid))
+        self._fan_out_frees(frees)
+        return True
 
     # -- health checking -------------------------------------------------
 
@@ -285,6 +369,16 @@ class ClusterHead:
                         if nid == node_id]
             for spec in resubmit:
                 self.inflight.pop(spec.task_id.binary(), None)
+            # A dead node can no longer borrow anything; dropping it may
+            # unblock deferred frees (fanned out after the lock).
+            dead_frees = []
+            for oid in [o for o, holders in self.borrowers.items()
+                        if node_id in holders]:
+                holders = self.borrowers[oid]
+                holders.discard(node_id)
+                if not holders:
+                    del self.borrowers[oid]
+                    dead_frees.extend(self._maybe_free_locked(oid))
             dead_actors = [aid for aid, nid in self.actor_nodes.items()
                            if nid == node_id]
             # Bundles reserved there are gone; tasks targeting them fail
@@ -298,6 +392,7 @@ class ClusterHead:
             len(resubmit), len(dead_actors))
         self.publisher.publish("node_events", {
             "event": "NODE_DEAD", "node_id": node_id, "reason": reason})
+        self._fan_out_frees(dead_frees)
         # Restart actors first so resubmitted / queued actor tasks find a
         # live location.
         for aid in dead_actors:
@@ -313,6 +408,10 @@ class ClusterHead:
                         oid, None, error=ActorDiedError(
                             spec.actor_id.hex()[:8],
                             f"its node {node_id} died mid-call"))
+                with self._lock:
+                    failed_frees = self._unpin_task_locked(
+                        spec.task_id.binary())
+                self._fan_out_frees(failed_frees)
                 continue
             self._resubmit(spec)
 
@@ -341,23 +440,31 @@ class ClusterHead:
             for oid in spec.return_ids:
                 self.worker.memory_store.put(
                     oid, None, error=exc.TaskError(e, spec.describe()))
+            # The task will never complete: drop its arg pins or any
+            # driver-released arg stays pinned (and unfreed) forever.
+            with self._lock:
+                frees = self._unpin_task_locked(spec.task_id.binary())
+            self._fan_out_frees(frees)
 
     def release_objects(self, oids: List[bytes]) -> None:
-        """Driver refcount hit zero: unpin lineage and tell the owning
-        nodes to drop their copies."""
-        by_addr: Dict[Tuple[str, int], List[bytes]] = {}
+        """Driver refcount hit zero. Objects still borrowed by a node or
+        pinned by an in-flight task's args defer their free until the
+        last holder drops (reference: ReferenceCounter borrower
+        protocol); the rest free immediately."""
+        frees = []
         with self._lock:
             for oid in oids:
-                self.lineage.pop(oid, None)
-                self._recon_attempts.pop(oid, None)
-                loc = self.object_locations.pop(oid, None)
-                if loc is not None and loc != self.server.address:
-                    by_addr.setdefault(loc, []).append(oid)
-        for addr, batch in by_addr.items():
-            try:
-                RpcClient.to(addr).call("free_objects", oids=batch)
-            except Exception:
-                pass
+                self.driver_released.add(oid)
+                frees.extend(self._maybe_free_locked(oid))
+        self._fan_out_frees(frees)
+
+    def unrelease_objects(self, oids: List[bytes]) -> None:
+        """The driver re-acquired a handle (e.g. an actor returned a
+        borrowed ref back): a pending deferred release must not fire
+        when the last borrower later drops."""
+        with self._lock:
+            for oid in oids:
+                self.driver_released.discard(oid)
 
     def _maybe_reconstruct(self, oid: bytes) -> None:
         """On-demand lineage reconstruction: if a requested object has no
@@ -892,12 +999,28 @@ class ClusterDriverMixin:
 
         release_q: _queue.Queue = _queue.Queue()
         original_unregister = worker.unregister_object_ref
+        original_register = worker.register_object_ref
+
+        def register(ref):
+            count = original_register(ref)
+            if count == 1:
+                # Re-acquiring a handle the driver had fully dropped
+                # (e.g. an actor handed a borrowed ref back): cancel any
+                # pending deferred release synchronously — before this
+                # call returns the driver may rely on the object.
+                head.unrelease_objects([ref.id.binary()])
+            return count
 
         def unregister(oid):
-            original_unregister(oid)
-            release_q.put(oid.binary())
+            # Only a drop to zero releases cluster-wide: a second driver
+            # handle to the same object (e.g. a deserialized copy) must
+            # keep it alive.
+            if original_unregister(oid):
+                release_q.put(oid.binary())
 
         def release_loop():
+            from ray_tpu._private.ids import ObjectID as _OID
+
             while True:
                 batch = [release_q.get()]
                 time.sleep(0.05)
@@ -906,11 +1029,20 @@ class ClusterDriverMixin:
                         batch.append(release_q.get_nowait())
                     except _queue.Empty:
                         break
+                # Level check at apply time: a handle re-acquired while
+                # the release sat in this queue must win (the register
+                # hook's synchronous unrelease covers the post-apply
+                # window; this covers the pre-apply one).
+                batch = [ob for ob in batch
+                         if worker.memory_store.local_ref_count(
+                             _OID(ob)) == 0]
                 try:
-                    head.release_objects(batch)
+                    if batch:
+                        head.release_objects(batch)
                 except Exception:
                     pass
 
+        worker.register_object_ref = register
         worker.unregister_object_ref = unregister
         t = threading.Thread(target=release_loop, daemon=True,
                              name="ray_tpu-release")

@@ -1,0 +1,208 @@
+"""Distributed refcount / borrower protocol (reference:
+reference_count.h borrowing): a driver release must not free an object
+out from under a node that still holds a handle, nor from under an
+in-flight task's args; the deferred free happens when the last holder
+drops."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote(num_cpus=2)
+def _produce():
+    return np.arange(2048)
+
+
+@ray_tpu.remote(num_cpus=2)
+class _Holder:
+    def __init__(self):
+        self.held = None
+
+    def hold(self, ref_in_list):
+        # Receiving a ref INSIDE a container keeps it unresolved: the
+        # actor stores the handle, not the value (the borrow case).
+        self.held = ref_in_list[0]
+        return True
+
+    def read(self):
+        return int(ray_tpu.get(self.held).sum())
+
+    def drop(self):
+        self.held = None
+        gc.collect()
+        return True
+
+
+def _hog(cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        time.sleep(1.0)
+        return 1
+
+    return hog.remote()
+
+
+def test_borrowed_object_survives_driver_release(cluster):
+    cluster.add_node(num_cpus=2)
+    head = cluster.head
+
+    h = _hog(cluster)  # push the producer + actor off-head
+    ref = _produce.remote()
+    holder = _Holder.remote()
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=60)
+    ray_tpu.get(h)
+
+    oid = ref.binary()
+    # Give the borrow registration a beat to land.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid not in head.borrowers:
+        time.sleep(0.1)
+    assert oid in head.borrowers, "node never registered as borrower"
+
+    # Driver drops its handle; the object must survive for the actor.
+    del ref
+    gc.collect()
+    time.sleep(0.5)  # release loop batches at 50ms
+    assert oid in head.driver_released or oid in head.object_locations
+    assert ray_tpu.get(holder.read.remote(), timeout=60) \
+        == 2047 * 1024  # value intact after driver release
+
+    # Actor drops the last handle → deferred free actually runs.
+    assert ray_tpu.get(holder.drop.remote(), timeout=60)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and (
+            oid in head.borrowers or oid in head.driver_released):
+        time.sleep(0.1)
+    assert oid not in head.borrowers
+    assert oid not in head.driver_released, \
+        "deferred free never executed"
+
+
+def test_inflight_task_args_pinned_against_release(cluster):
+    cluster.add_node(num_cpus=2)
+    head = cluster.head
+
+    @ray_tpu.remote(num_cpus=2)
+    def slow_consume(arr):
+        time.sleep(1.0)
+        return int(arr.sum())
+
+    h = _hog(cluster)
+    ref = _produce.remote()
+    out = slow_consume.remote(ref)
+    # Drop the arg's driver handle while the consumer is in flight.
+    del ref
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 2047 * 1024
+    ray_tpu.get(h)
+    # After completion nothing should stay pinned forever.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and head.task_pins:
+        time.sleep(0.1)
+    assert not head.task_pins
+
+
+def test_nested_ref_arg_pinned_against_release(cluster):
+    """A ref nested in a container arg is pinned at dispatch
+    (nested_dependencies): `f.remote([r]); del r` must not race the
+    release."""
+    cluster.add_node(num_cpus=2)
+    head = cluster.head
+
+    @ray_tpu.remote(num_cpus=2)
+    def consume_list(lst):
+        time.sleep(0.6)
+        return int(ray_tpu.get(lst[0]).sum())
+
+    h = _hog(cluster)
+    ref = _produce.remote()
+    out = consume_list.remote([ref])
+    del ref
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 2047 * 1024
+    ray_tpu.get(h)
+
+
+def test_driver_reacquire_cancels_deferred_release(cluster):
+    """Driver drops its handle, an actor still borrows, then hands the
+    ref back — the re-acquired driver handle must cancel the deferred
+    release so the later borrower drop doesn't free it."""
+    cluster.add_node(num_cpus=2)
+    head = cluster.head
+
+    @ray_tpu.remote(num_cpus=2)
+    class Keeper:
+        def __init__(self):
+            self.held = None
+
+        def hold(self, lst):
+            self.held = lst[0]
+            return True
+
+        def give_back(self):
+            return [self.held]
+
+        def drop(self):
+            self.held = None
+            gc.collect()
+            return True
+
+    h = _hog(cluster)
+    ref = _produce.remote()
+    keeper = Keeper.remote()
+    assert ray_tpu.get(keeper.hold.remote([ref]), timeout=60)
+    ray_tpu.get(h)
+    oid = ref.binary()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid not in head.borrowers:
+        time.sleep(0.1)
+
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+
+    # Driver re-acquires the same object's ref from the actor.
+    ref_again = ray_tpu.get(keeper.give_back.remote(), timeout=60)[0]
+    assert ref_again.binary() == oid
+    time.sleep(0.3)
+    assert oid not in head.driver_released
+
+    # Actor drops; the driver's live handle must keep the object.
+    assert ray_tpu.get(keeper.drop.remote(), timeout=60)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid in head.borrowers:
+        time.sleep(0.1)
+    assert int(ray_tpu.get(ref_again, timeout=60).sum()) == 2047 * 1024
+
+
+def test_second_driver_handle_keeps_object(cluster):
+    """Two driver handles to one object: dropping one must not release
+    cluster-wide (the became-zero gate)."""
+    import pickle
+
+    cluster.add_node(num_cpus=2)
+    head = cluster.head
+    h = _hog(cluster)
+    ref = _produce.remote()
+    ray_tpu.wait([ref], timeout=60)
+    ref2 = pickle.loads(pickle.dumps(ref))
+    oid = ref.binary()
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    assert oid not in head.driver_released
+    assert ray_tpu.get(ref2, timeout=60).sum() == 2047 * 1024
+    ray_tpu.get(h)
