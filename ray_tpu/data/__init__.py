@@ -25,6 +25,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_text,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
+from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.plan import ActorPoolStrategy  # noqa: F401
 from ray_tpu.data import preprocessors  # noqa: F401
 from ray_tpu.data.aggregate import (  # noqa: F401

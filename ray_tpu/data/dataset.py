@@ -284,6 +284,21 @@ class Dataset:
         plan._cached = out_refs
         return Dataset(plan)
 
+    def window(self, *, blocks_per_window: int = 10):
+        """Stream execution one window of blocks at a time
+        (reference `Dataset.window` → DatasetPipeline): memory is
+        bounded to a window instead of the whole dataset."""
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, blocks_per_window)
+
+    def repeat(self, epochs: int):
+        """Re-stream the dataset `epochs` times (reference
+        `Dataset.repeat` → DatasetPipeline for multi-epoch training)."""
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_repeated(self, epochs)
+
     def train_test_split(self, test_size: float, *,
                          shuffle: bool = False,
                          seed: Optional[int] = None):

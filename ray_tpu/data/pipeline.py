@@ -1,0 +1,128 @@
+"""DatasetPipeline: windowed, optionally repeated streaming execution.
+
+Reference: `python/ray/data/dataset_pipeline.py:65` — a pipeline splits a
+dataset into windows of blocks and executes transforms one window at a
+time, bounding memory to a window instead of the whole dataset;
+`.repeat(epochs)` re-streams for multi-epoch training. Transforms added
+on the pipeline apply per window; iteration drains windows in order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional
+
+from ray_tpu.data.plan import ExecutionPlan, FromBlocks, Read, ReadTasks
+
+
+class DatasetPipeline:
+    def __init__(self, window_plans: List[ExecutionPlan], *,
+                 epochs: int = 1):
+        self._window_plans = window_plans
+        self._epochs = epochs
+        # (method_name, args, kwargs) applied to each window Dataset
+        # when it materializes.
+        self._ops: List[tuple] = []
+
+    # -- construction (used by Dataset.window / Dataset.repeat) ---------
+
+    @staticmethod
+    def from_dataset(ds, blocks_per_window: int) -> "DatasetPipeline":
+        plan = ds._plan
+        first, rest = plan.ops[0], plan.ops[1:]
+        windows: List[ExecutionPlan] = []
+        if isinstance(first, Read) and plan._cached is None:
+            tasks = list(first.datasource.get_read_tasks(
+                first.parallelism))
+            for i in range(0, len(tasks), blocks_per_window):
+                windows.append(ExecutionPlan(
+                    [ReadTasks(read_tasks=tasks[i:i + blocks_per_window])]
+                    + list(rest)))
+        else:
+            # Materialized (or non-read) source: window over its blocks.
+            import ray_tpu
+
+            refs = plan.execute()
+            blocks = ray_tpu.get(list(refs))
+            for i in range(0, len(blocks), blocks_per_window):
+                windows.append(ExecutionPlan(
+                    [FromBlocks(blocks=blocks[i:i + blocks_per_window])]))
+        return DatasetPipeline(windows)
+
+    @staticmethod
+    def from_repeated(ds, epochs: int) -> "DatasetPipeline":
+        return DatasetPipeline([ds._plan], epochs=epochs)
+
+    # -- per-window transforms ------------------------------------------
+
+    def _chain(self, method: str, *args, **kwargs) -> "DatasetPipeline":
+        out = DatasetPipeline(self._window_plans, epochs=self._epochs)
+        out._ops = self._ops + [(method, args, kwargs)]
+        return out
+
+    def map_batches(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._chain("map_batches", fn, **kwargs)
+
+    def map(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._chain("map", fn, **kwargs)
+
+    def filter(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._chain("filter", fn, **kwargs)
+
+    def flat_map(self, fn, **kwargs) -> "DatasetPipeline":
+        return self._chain("flat_map", fn, **kwargs)
+
+    def random_shuffle_each_window(self, *, seed: Optional[int] = None
+                                   ) -> "DatasetPipeline":
+        return self._chain("random_shuffle", seed=seed)
+
+    def repeat(self, epochs: int) -> "DatasetPipeline":
+        out = DatasetPipeline(self._window_plans,
+                              epochs=self._epochs * epochs)
+        out._ops = list(self._ops)
+        return out
+
+    # -- iteration -------------------------------------------------------
+
+    def _window_datasets(self) -> Iterator:
+        from ray_tpu.data.dataset import Dataset
+
+        for _ in range(self._epochs):
+            for plan in self._window_plans:
+                ds = Dataset(ExecutionPlan(list(plan.ops)))
+                for method, args, kwargs in self._ops:
+                    ds = getattr(ds, method)(*args, **kwargs)
+                yield ds
+
+    def iter_epochs(self) -> Iterator["DatasetPipeline"]:
+        for _ in range(self._epochs):
+            one = DatasetPipeline(self._window_plans, epochs=1)
+            one._ops = list(self._ops)
+            yield one
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        for ds in self._window_datasets():
+            yield from ds.iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ds in self._window_datasets():
+            yield from ds.iter_rows()
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for ds in self._window_datasets():
+            for row in ds.iter_rows():
+                out.append(row)
+                if len(out) >= limit:
+                    return out
+        return out
+
+    def count(self) -> int:
+        return sum(ds.count() for ds in self._window_datasets())
+
+    def num_windows(self) -> int:
+        return len(self._window_plans) * self._epochs
+
+    def stats(self) -> str:
+        return (f"DatasetPipeline({len(self._window_plans)} windows x "
+                f"{self._epochs} epochs, {len(self._ops)} per-window "
+                "ops)")

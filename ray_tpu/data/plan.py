@@ -46,6 +46,14 @@ class FromBlocks(LogicalOp):
 
 
 @dataclass
+class ReadTasks(LogicalOp):
+    """Read from an explicit task list (DatasetPipeline windows slice a
+    datasource's read tasks into per-window plans)."""
+
+    read_tasks: List[Any] = field(default_factory=list)
+
+
+@dataclass
 class MapBlocks(LogicalOp):
     """A fused block→block transform (map_batches/map/filter/flat_map all
     lower to this)."""
@@ -275,6 +283,8 @@ class ExecutionPlan:
         if isinstance(op, Read):
             tasks = op.datasource.get_read_tasks(op.parallelism)
             return [_read_task.remote(t) for t in tasks]
+        if isinstance(op, ReadTasks):
+            return [_read_task.remote(t) for t in op.read_tasks]
         if isinstance(op, FromBlocks):
             return [ray_tpu.put(b) for b in op.blocks]
         if isinstance(op, MapBlocks):
@@ -467,6 +477,9 @@ class ExecutionPlan:
                     label(op, "read"),
                     read_tasks=list(op.datasource.get_read_tasks(
                         op.parallelism))))
+            elif isinstance(op, ReadTasks):
+                phys.append(SourceOp(label(op, "read_tasks"),
+                                     read_tasks=list(op.read_tasks)))
             elif isinstance(op, FromBlocks):
                 phys.append(SourceOp(label(op, "from_blocks"),
                                      blocks=list(op.blocks)))

@@ -1,0 +1,68 @@
+"""DatasetPipeline: windowed + repeated streaming execution."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def ray_local():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_window_streams_all_rows():
+    ds = rd.range(100, parallelism=10)
+    pipe = ds.window(blocks_per_window=3)
+    assert pipe.num_windows() == 4  # ceil(10 / 3)
+    rows = sorted(r["id"] if isinstance(r, dict) else r
+                  for r in pipe.iter_rows())
+    assert rows == list(range(100))
+
+
+def test_window_transforms_apply_per_window():
+    ds = rd.range(40, parallelism=8)
+    pipe = (ds.window(blocks_per_window=4)
+            .map(lambda x: (x["id"] if isinstance(x, dict) else x) * 2)
+            .filter(lambda x: x % 4 == 0))
+    got = sorted(pipe.iter_rows())
+    expect = sorted(x * 2 for x in range(40) if (x * 2) % 4 == 0)
+    assert got == expect
+
+
+def test_repeat_epochs():
+    ds = rd.range(10, parallelism=2)
+    pipe = ds.repeat(3)
+    assert pipe.num_windows() == 3
+    rows = [r["id"] if isinstance(r, dict) else r
+            for r in pipe.iter_rows()]
+    assert len(rows) == 30
+    assert sorted(rows) == sorted(list(range(10)) * 3)
+    # iter_epochs yields one pipeline per epoch
+    epochs = list(pipe.iter_epochs())
+    assert len(epochs) == 3
+    assert epochs[0].count() == 10
+
+
+def test_window_then_repeat_and_shuffle():
+    ds = rd.range(24, parallelism=6)
+    pipe = (ds.window(blocks_per_window=2)
+            .random_shuffle_each_window(seed=0)
+            .repeat(2))
+    rows = [r["id"] if isinstance(r, dict) else r
+            for r in pipe.iter_rows()]
+    assert len(rows) == 48
+    assert sorted(rows) == sorted(list(range(24)) * 2)
+
+
+def test_window_iter_batches():
+    ds = rd.from_items(list(range(32)))
+    pipe = ds.window(blocks_per_window=2)
+    batches = list(pipe.iter_batches(batch_size=8, batch_format="numpy"))
+    total = sum(len(np.atleast_1d(b)) if not isinstance(b, dict)
+                else len(next(iter(b.values()))) for b in batches)
+    assert total == 32
