@@ -19,10 +19,12 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
 from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401

@@ -575,6 +575,20 @@ def read_text(paths, *, parallelism: int = -1) -> Dataset:
         parallelism=parallelism)]))
 
 
+def read_images(paths, *, parallelism: int = -1, **opts) -> Dataset:
+    return Dataset(ExecutionPlan([Read(
+        name="ReadImages", datasource=ds_mod.ImageDatasource(paths,
+                                                             **opts),
+        parallelism=parallelism)]))
+
+
+def read_tfrecords(paths, *, parallelism: int = -1, **opts) -> Dataset:
+    return Dataset(ExecutionPlan([Read(
+        name="ReadTFRecords",
+        datasource=ds_mod.TFRecordDatasource(paths, **opts),
+        parallelism=parallelism)]))
+
+
 def read_datasource(datasource: ds_mod.Datasource, *,
                     parallelism: int = -1) -> Dataset:
     return Dataset(ExecutionPlan([Read(

@@ -175,6 +175,96 @@ class TextDatasource(FileDatasource):
         yield pa.table({"text": lines})
 
 
+class ImageDatasource(FileDatasource):
+    """Decode images into HWC uint8 arrays (reference
+    `datasource/image_datasource.py`; PIL-backed). Options: `size`
+    (H, W) resize, `mode` (e.g. "RGB") conversion."""
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        from PIL import Image
+
+        img = Image.open(path)
+        mode = self._options.get("mode")
+        if mode:
+            img = img.convert(mode)
+        size = self._options.get("size")
+        if size:
+            img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+        arr = np.asarray(img)
+        # List block: HWC image arrays don't flatten into Arrow columns
+        # (no tensor-extension dependency) — rows keep real ndarrays.
+        yield [{"image": arr, "path": path}]
+
+
+# -- TFRecord framing (no TF dependency: length-prefixed records with
+# masked crc32c, the standard on-disk layout) -------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (_CRC32C_POLY if _c & 1 else 0)
+    _CRC32C_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+class TFRecordDatasource(FileDatasource):
+    """Raw TFRecord records as a `bytes` column (reference
+    `datasource/tfrecords_datasource.py`; tf.train.Example decoding is
+    the caller's map step — no TF/protobuf dependency here)."""
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import struct as st
+
+        import pyarrow as pa
+
+        validate = self._options.get("validate_crc", True)
+        records = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(12)
+                if not header:
+                    break
+                if len(header) < 12:
+                    raise ValueError(f"truncated TFRecord header in "
+                                     f"{path}")
+                (length,) = st.unpack("<Q", header[:8])
+                (len_crc,) = st.unpack("<I", header[8:12])
+                if validate and _masked_crc(header[:8]) != len_crc:
+                    raise ValueError(f"bad length crc in {path}")
+                data = f.read(length)
+                (data_crc,) = st.unpack("<I", f.read(4))
+                if validate and _masked_crc(data) != data_crc:
+                    raise ValueError(f"bad record crc in {path}")
+                records.append(data)
+        yield pa.table({"bytes": pa.array(records, type=pa.binary())})
+
+
+def write_tfrecords(records: Iterable[bytes], path: str) -> None:
+    """Write raw records in TFRecord framing."""
+    import struct as st
+
+    with open(path, "wb") as f:
+        for rec in records:
+            header = st.pack("<Q", len(rec))
+            f.write(header)
+            f.write(st.pack("<I", _masked_crc(header)))
+            f.write(rec)
+            f.write(st.pack("<I", _masked_crc(rec)))
+
+
 # ---------------------------------------------------------------------------
 # Writers
 # ---------------------------------------------------------------------------

@@ -59,6 +59,43 @@ def test_window_then_repeat_and_shuffle():
     assert sorted(rows) == sorted(list(range(24)) * 2)
 
 
+def test_read_images(tmp_path):
+    from PIL import Image
+
+    for i in range(3):
+        arr = np.full((8, 6, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path / "*.png"), size=(4, 4), mode="RGB")
+    rows = ds.take_all()
+    assert len(rows) == 3
+    img = rows[0]["image"]
+    assert np.asarray(img).shape == (4, 4, 3)
+
+
+def test_read_tfrecords_roundtrip(tmp_path):
+    from ray_tpu.data.datasource import write_tfrecords
+
+    records = [b"alpha", b"beta", b"\x00" * 100]
+    path = tmp_path / "data.tfrecord"
+    write_tfrecords(records, str(path))
+    ds = rd.read_tfrecords(str(path))
+    got = [bytes(r["bytes"]) for r in ds.take_all()]
+    assert got == records
+
+    # Corruption is detected.
+    blob = bytearray(path.read_bytes())
+    blob[-3] ^= 0xFF
+    bad = tmp_path / "bad.tfrecord"
+    bad.write_bytes(bytes(blob))
+    with pytest.raises(Exception, match="crc"):
+        rd.read_tfrecords(str(bad)).take_all()
+    # ...and can be skipped.
+    got = [bytes(r["bytes"])
+           for r in rd.read_tfrecords(str(bad),
+                                      validate_crc=False).take_all()]
+    assert len(got) == 3
+
+
 def test_window_iter_batches():
     ds = rd.from_items(list(range(32)))
     pipe = ds.window(blocks_per_window=2)
