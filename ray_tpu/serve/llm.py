@@ -93,6 +93,13 @@ class LLMEngine:
         self._lock = threading.Lock()
         self._running = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Pipelined decode: the in-flight block's device token array (its
+        # host fetch happens while the next block computes), plus
+        # device-side last-token/length carries valid while no admission
+        # has touched the host copies.
+        self._pending_toks = None
+        self._dev_last = None
+        self._dev_lengths = None
 
         # Compiled programs. Prefill is per-slot (batch 1, bucketed T);
         # decode covers all slots at T=1. Params are explicit arguments —
@@ -131,6 +138,10 @@ class LLMEngine:
 
         def step(carry, _):
             cache, tokens, lengths, rng = carry
+            # Clamp for retired slots that keep computing until their
+            # slot is re-admitted (pipelined decode fetches lag a block):
+            # their writes wrap at the last position instead of OOB.
+            lengths = jnp.minimum(lengths, self.max_seq - 2)
             logits, cache = forward_with_cache(
                 params, tokens[:, None], self.cfg, cache, lengths)
             logits = logits[:, 0, :].astype(jnp.float32)  # [slots, vocab]
@@ -151,10 +162,12 @@ class LLMEngine:
                                     greedy).astype(jnp.int32)
             return (cache, next_tokens, lengths + 1, rng), next_tokens
 
-        (cache, _, _, rng), toks = jax.lax.scan(
+        (cache, last, lengths, rng), toks = jax.lax.scan(
             step, (cache, last_tokens, lengths, rng), None,
             length=self.decode_steps)
-        return cache, toks.T, rng  # [slots, K]
+        # Device-side carries (last/lengths) let the NEXT decode dispatch
+        # before this block's tokens reach the host (pipelined decode).
+        return cache, toks.T, last, lengths, rng  # toks: [slots, K]
 
     # -- public API ------------------------------------------------------
 
@@ -171,6 +184,13 @@ class LLMEngine:
 
     def stop(self):
         self._running.clear()
+        # Let the loop leave its current device fetch before interpreter
+        # teardown (a daemon thread cancelled mid-fetch can abort the
+        # process with pthread noise).
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=10)
 
     def generate(self, prompt_ids: List[int],
                  params: Optional[SamplingParams] = None,
@@ -210,6 +230,8 @@ class LLMEngine:
         while self._running.is_set():
             admitted = self._admit()
             if not self._active.any():
+                # Drop any in-flight block for fully-retired slots.
+                self._flush_pending()
                 if not admitted:
                     try:
                         req = self._queue.get(timeout=0.05)
@@ -220,7 +242,12 @@ class LLMEngine:
             self._decode_once()
 
     def _admit(self) -> bool:
-        admitted = False
+        if self._queue.empty() or not self._free_slots:
+            return False
+        # Admission invalidates the device carries and needs free slots:
+        # drain the in-flight decode block first.
+        self._flush_pending()
+        staged = []  # (req, slot, t_real, last_logits_ref)
         while self._free_slots:
             try:
                 req = self._queue.get_nowait()
@@ -238,12 +265,24 @@ class LLMEngine:
             self.cache, last_logits = self._prefill(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.int32(slot), jnp.int32(t_real), t=bucket)
-            first = int(np.asarray(last_logits.argmax(-1))) \
-                if req.params.temperature == 0 else int(np.asarray(
-                    jax.random.categorical(
-                        jax.random.fold_in(self._rng, req.request_id),
-                        last_logits / max(req.params.temperature, 1e-6))))
-            req.t_first_token = time.perf_counter()
+            staged.append((req, slot, t_real, last_logits))
+        if not staged:
+            return False
+        # ONE device-side sampling + ONE host sync for the whole wave:
+        # per-admit argmax fetches would serialize a tunnel round-trip
+        # per request (the dominant pre-first-token cost).
+        logits = jnp.stack([s[3] for s in staged])  # [n, vocab]
+        temps = jnp.asarray([s[0].params.temperature for s in staged],
+                            jnp.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+        firsts = np.asarray(jnp.where(temps > 0, sampled,
+                                      logits.argmax(-1)))
+        now = time.perf_counter()
+        for (req, slot, t_real, _), first in zip(staged, firsts):
+            first = int(first)
+            req.t_first_token = now
             req.tokens.append(first)
             req.out_queue.put(first)
             with self._lock:
@@ -257,17 +296,34 @@ class LLMEngine:
                                                    _TOP_K_MAX))
             if self._finished(req, first):
                 self._retire(slot)
-            admitted = True
-        return admitted
+        # Host state changed: rebuild device carries on the next decode.
+        self._dev_last = self._dev_lengths = None
+        return True
 
     def _decode_once(self):
         # The fed token occupies absolute position `lengths` (prompt is
-        # 0..len-1, first generated token sits at len, etc.).
-        self.cache, next_tokens, self._rng = self._decode(
-            self.params, self.cache, jnp.asarray(self._last_token),
-            jnp.asarray(self._lengths), jnp.asarray(self._temps_arr),
+        # 0..len-1, first generated token sits at len, etc.). Dispatch
+        # block N+1 from the device-side carries, THEN fetch block N —
+        # the host round-trip overlaps the next block's compute.
+        last = self._dev_last if self._dev_last is not None \
+            else jnp.asarray(self._last_token)
+        lengths = self._dev_lengths if self._dev_lengths is not None \
+            else jnp.asarray(self._lengths)
+        (self.cache, next_tokens, self._dev_last, self._dev_lengths,
+         self._rng) = self._decode(
+            self.params, self.cache, last, lengths,
+            jnp.asarray(self._temps_arr),
             jnp.asarray(self._topks_arr), self._rng)
-        next_host = np.asarray(next_tokens)  # [slots, K]
+        prev, self._pending_toks = self._pending_toks, next_tokens
+        if prev is not None:
+            self._consume_block(np.asarray(prev))
+
+    def _flush_pending(self):
+        prev, self._pending_toks = self._pending_toks, None
+        if prev is not None:
+            self._consume_block(np.asarray(prev))
+
+    def _consume_block(self, next_host):
         with self._lock:
             for slot in np.nonzero(self._active)[0]:
                 req = self._slot_req[slot]
