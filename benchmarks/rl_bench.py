@@ -5,7 +5,7 @@ BASELINE.json lists "RLlib samples/sec" as a north star the reference
 measures nightly without committing an absolute number; this records ours
 for the CartPole PPO config the test suite learns with.
 
-Usage: python benchmarks/rl_bench.py [--iters 6] [--workers 4]
+Usage: python benchmarks/rl_bench.py [--iters 6] [--workers 2]
 Writes one JSON line to stdout.
 """
 
@@ -24,9 +24,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--iters", type=int, default=6)
-    parser.add_argument("--workers", type=int, default=4)
-    parser.add_argument("--envs-per-worker", type=int, default=4)
-    parser.add_argument("--fragment", type=int, default=256)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--envs-per-worker", type=int, default=128)
+    parser.add_argument("--fragment", type=int, default=64)
     args = parser.parse_args()
 
     import ray_tpu

@@ -218,9 +218,75 @@ def make_env(spec, env_config: Optional[dict] = None) -> Env:
     raise TypeError(f"cannot build env from {spec!r}")
 
 
+class CartPoleVectorEnv:
+    """Batched-numpy CartPole: all N envs step in one vectorized update
+    (the rollout hot loop — reference rollout workers rely on C-speed
+    gym envs; this is the numpy equivalent). Same auto-reset + final_obs
+    contract as VectorEnv."""
+
+    def __init__(self, num_envs: int, max_steps: int = 500):
+        proto = CartPoleEnv(max_steps)
+        self.observation_space = proto.observation_space
+        self.action_space = proto.action_space
+        self.num_envs = num_envs
+        self.max_steps = max_steps
+        self._p = proto
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._t = np.zeros(num_envs, np.int64)
+        self._rng = np.random.RandomState()
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, (self.num_envs, 4))
+        self._t[:] = 0
+        return self._state.astype(np.float32).copy()
+
+    def _reset_rows(self, rows):
+        self._state[rows] = self._rng.uniform(-0.05, 0.05,
+                                              (len(rows), 4))
+        self._t[rows] = 0
+
+    def step(self, actions):
+        p = self._p
+        x, x_dot, th, th_dot = self._state.T
+        force = np.where(np.asarray(actions) == 1, p.force_mag,
+                         -p.force_mag)
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + p.polemass_length * th_dot ** 2 * sin) \
+            / p.total_mass
+        th_acc = (p.gravity * sin - cos * temp) / (
+            p.length * (4.0 / 3.0 - p.masspole * cos ** 2
+                        / p.total_mass))
+        x_acc = temp - p.polemass_length * th_acc * cos / p.total_mass
+        x = x + p.tau * x_dot
+        x_dot = x_dot + p.tau * x_acc
+        th = th + p.tau * th_dot
+        th_dot = th_dot + p.tau * th_acc
+        self._state = np.stack([x, x_dot, th, th_dot], axis=1)
+        self._t += 1
+        terms = (np.abs(x) > p.x_threshold) \
+            | (np.abs(th) > p.theta_threshold)
+        truncs = (self._t >= self.max_steps) & ~terms
+        self.final_obs = self._state.astype(np.float32).copy()
+        done_rows = np.nonzero(terms | truncs)[0]
+        if len(done_rows):
+            self._reset_rows(done_rows)
+        return (self._state.astype(np.float32).copy(),
+                np.ones(self.num_envs, np.float32), terms, truncs)
+
+
 class VectorEnv:
-    """N sequential envs behind a batched interface (reference
-    `rllib/env/vector_env.py`)."""
+    """N envs behind a batched interface (reference
+    `rllib/env/vector_env.py`). Built-in envs with a vectorized
+    implementation (CartPole) step as one numpy update; everything else
+    steps sequentially."""
+
+    def __new__(cls, spec, num_envs: int,
+                env_config: Optional[dict] = None):
+        if spec == "CartPole-v1" and not env_config:
+            return CartPoleVectorEnv(num_envs)
+        return super().__new__(cls)
 
     def __init__(self, spec, num_envs: int,
                  env_config: Optional[dict] = None):
