@@ -10,7 +10,11 @@ worker actors on CPU paths, metric reduction, and rendezvous/barriers.
 Implementation: a named rendezvous actor per group; ranks contribute
 values per operation sequence number and block until the reduction is
 complete. Collectives must be called in the same order on every rank
-(the same contract NCCL imposes).
+(the same contract NCCL imposes). Large tensors don't funnel through the
+one actor: allreduce shards them across a pool of per-chunk rendezvous
+actors (reduce-scatter + all-gather shape — each shard actor moves and
+reduces 1/K of the bytes, in parallel), so the single-actor path is only
+the small-value/control plane.
 """
 
 from __future__ import annotations
@@ -103,12 +107,20 @@ def _reduce_values(values: Dict[int, Any], op: str, root: Optional[int]):
     raise ValueError(f"unknown op {op}")
 
 
+# Tensors above this size shard across the actor pool instead of moving
+# whole through one rendezvous actor.
+_SHARD_THRESHOLD_BYTES = 256 * 1024
+_SHARD_ACTORS = 4
+
+
 class _GroupState:
-    def __init__(self, name: str, world_size: int, rank: int, actor):
+    def __init__(self, name: str, world_size: int, rank: int, actor,
+                 shard_actors=None):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.actor = actor
+        self.shard_actors = shard_actors or []
         self.seq = 0
 
     def next_seq(self) -> int:
@@ -132,26 +144,32 @@ def init_collective_group(world_size: int, rank: int,
     """Reference: `util/collective/collective.py:258` (init_collective_group).
     `backend` accepted for API parity; the object-plane rendezvous is the
     only host backend."""
-    actor_name = f"__collective::{group_name}"
-    try:
-        actor = ray_tpu.get_actor(actor_name)
-    except ValueError:
+    def get_or_create(name):
         try:
-            actor = _Rendezvous.options(
-                name=actor_name, max_concurrency=max(64, world_size * 4),
-                lifetime="detached").remote(world_size)
+            return ray_tpu.get_actor(name)
         except ValueError:
-            actor = ray_tpu.get_actor(actor_name)
-    _groups()[group_name] = _GroupState(group_name, world_size, rank, actor)
+            try:
+                return _Rendezvous.options(
+                    name=name, max_concurrency=max(64, world_size * 4),
+                    lifetime="detached").remote(world_size)
+            except ValueError:
+                return ray_tpu.get_actor(name)
+
+    actor = get_or_create(f"__collective::{group_name}")
+    shards = [get_or_create(f"__collective::{group_name}::shard{j}")
+              for j in range(_SHARD_ACTORS)]
+    _groups()[group_name] = _GroupState(group_name, world_size, rank,
+                                        actor, shards)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     st = _groups().pop(group_name, None)
     if st is not None:
-        try:
-            ray_tpu.kill(st.actor)
-        except Exception:
-            pass
+        for a in [st.actor] + list(st.shard_actors):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -179,7 +197,22 @@ def _call(group_name: str, value, op: str, root: Optional[int] = None):
 
 def allreduce(tensor, group_name: str = "default",
               op: str = ReduceOp.SUM):
-    return _call(group_name, np.asarray(tensor), op)
+    arr = np.asarray(tensor)
+    st = _groups().get(group_name)
+    if (st is None or not st.shard_actors
+            or arr.nbytes < _SHARD_THRESHOLD_BYTES
+            or op in ("gather", "broadcast", "barrier")):
+        return _call(group_name, arr, op)
+    # Sharded path: chunk j of every rank's flat tensor meets at shard
+    # actor j (reduce-scatter), each rank reads back all reduced chunks
+    # (all-gather). One seq per collective, shared by all chunks.
+    seq = st.next_seq()
+    flat = arr.reshape(-1)
+    chunks = np.array_split(flat, len(st.shard_actors))
+    refs = [a.contribute.remote(seq, st.rank, c, op)
+            for a, c in zip(st.shard_actors, chunks)]
+    reduced = ray_tpu.get(refs)
+    return np.concatenate(reduced).reshape(arr.shape)
 
 
 def allgather(tensor, group_name: str = "default") -> list:
@@ -202,10 +235,23 @@ def barrier(group_name: str = "default") -> None:
 
 def allreduce_pytree(tree, group_name: str = "default",
                      op: str = ReduceOp.MEAN):
-    """Convenience for gradient averaging: flatten, one allreduce per leaf."""
+    """Convenience for gradient averaging. Small leaves batch into one
+    rendezvous round; large leaves take the sharded allreduce path (the
+    deterministic size split keeps sequence numbers aligned across
+    ranks)."""
     import jax
 
     leaves, treedef = jax.tree.flatten(tree)
     host = [np.asarray(x) for x in leaves]
-    reduced = _call(group_name, host, op)
-    return jax.tree.unflatten(treedef, reduced)
+    small_idx = [i for i, a in enumerate(host)
+                 if a.nbytes < _SHARD_THRESHOLD_BYTES]
+    large_idx = [i for i, a in enumerate(host)
+                 if a.nbytes >= _SHARD_THRESHOLD_BYTES]
+    out: list = [None] * len(host)
+    if small_idx:
+        reduced = _call(group_name, [host[i] for i in small_idx], op)
+        for i, r in zip(small_idx, reduced):
+            out[i] = r
+    for i in large_idx:
+        out[i] = allreduce(host[i], group_name, op)
+    return jax.tree.unflatten(treedef, out)

@@ -125,6 +125,36 @@ def test_collective_allreduce_in_train_loop():
     assert result.metrics["total"] == 6.0  # 1+2+3
 
 
+def test_collective_sharded_allreduce_large_tensor():
+    """Tensors above the shard threshold split across the shard-actor
+    pool (no single-actor funnel) and still reduce exactly."""
+    def loop(config):
+        from ray_tpu.util import collective
+
+        rank = session.get_world_rank()
+        big = np.full(200_000, float(rank + 1), np.float64)  # 1.6 MB
+        total = collective.allreduce(big)
+        # Mixed pytree: one big leaf (sharded) + one small (batched).
+        tree = {"w": np.full(150_000, float(rank + 1), np.float64),
+                "b": np.array([float(rank + 1)])}
+        avg = collective.allreduce_pytree(tree, op="mean")
+        session.report({
+            "total0": float(total[0]),
+            "total_last": float(total[-1]),
+            "w_mean": float(np.mean(avg["w"])),
+            "b": float(avg["b"][0]),
+        })
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=3))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["total0"] == 6.0
+    assert result.metrics["total_last"] == 6.0
+    assert abs(result.metrics["w_mean"] - 2.0) < 1e-9
+    assert abs(result.metrics["b"] - 2.0) < 1e-9
+
+
 def test_jax_trainer_ddp_parity():
     """Host-level DDP: N workers averaging grads through the collective
     must match single-worker training on the full batch (the reference's
