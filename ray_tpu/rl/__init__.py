@@ -14,6 +14,8 @@ from ray_tpu.rl.algorithm import (  # noqa: F401
 from ray_tpu.rl.algorithms import (  # noqa: F401
     A2C,
     A2CConfig,
+    APPO,
+    APPOConfig,
     BC,
     BCConfig,
     DQN,

@@ -2,6 +2,7 @@ from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rl.algorithms.a2c import A2C, A2CConfig  # noqa: F401
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rl.algorithms.bc import (  # noqa: F401
     BC,

@@ -1,0 +1,136 @@
+"""APPO: asynchronous PPO.
+
+Reference: `rllib/algorithms/appo/` — IMPALA's async actor-learner
+architecture (stale behaviour policies, V-trace off-policy correction)
+with PPO's clipped-surrogate policy loss instead of the plain
+policy-gradient term, plus a periodically-synced target network used as
+the V-trace/value baseline anchor.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+        self.target_update_freq = 4  # learner updates between syncs
+
+
+class APPO(IMPALA):
+    config_cls = APPOConfig
+
+    def build_components(self):
+        super().build_components()
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._updates_since_sync = 0
+        self._update = jax.jit(functools.partial(
+            _appo_update, tx=self.tx, gamma=cfg.gamma,
+            clip_rho=cfg.vtrace_clip_rho, clip_c=cfg.vtrace_clip_c,
+            vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff,
+            clip_param=cfg.clip_param))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        stats_acc = []
+        steps = 0
+        import ray_tpu
+
+        if not self._sample_futures:
+            w_ref = ray_tpu.put(self.params)
+            self._sample_futures = [
+                (w, w.sample.remote(w_ref)) for w in self.workers.workers]
+        for _ in range(cfg.updates_per_iter):
+            worker, fut = self._sample_futures.pop(0)
+            batch = fut and ray_tpu.get(fut)
+            self._sample_futures.append(
+                (worker, worker.sample.remote(ray_tpu.put(self.params))))
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.target_params, self.opt_state,
+                {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()})
+            stats_acc.append(jax.device_get(stats))
+            steps += np.asarray(batch[REWARDS]).size
+            self._updates_since_sync += 1
+            if self._updates_since_sync >= cfg.target_update_freq:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+                self._updates_since_sync = 0
+        agg = {k: float(np.mean([s[k] for s in stats_acc]))
+               for k in stats_acc[0]}
+        agg["num_env_steps_sampled_this_iter"] = steps
+        return agg
+
+    def get_weights(self):
+        return {"params": self.params, "target": self.target_params}
+
+    def set_weights(self, weights):
+        if isinstance(weights, dict) and "target" in weights:
+            self.params = jax.tree.map(jnp.asarray, weights["params"])
+            self.target_params = jax.tree.map(jnp.asarray,
+                                              weights["target"])
+        else:
+            self.params = jax.tree.map(jnp.asarray, weights)
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.tx.init(self.params)
+
+
+def _appo_update(params, target_params, opt_state, batch, *, tx, gamma,
+                 clip_rho, clip_c, vf_coeff, entropy_coeff, clip_param):
+    def loss_fn(params):
+        logits, values = jax.vmap(
+            lambda o: models.actor_critic_apply(params, o))(batch[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
+        # V-trace targets/advantages from the (frozen) target network —
+        # the reference's stabilized baseline for async updates.
+        t_logits, t_values = jax.vmap(
+            lambda o: models.actor_critic_apply(target_params, o))(
+                batch[OBS])
+        t_logp = jnp.take_along_axis(
+            jax.nn.log_softmax(t_logits), batch[ACTIONS][..., None],
+            axis=-1)[..., 0]
+        _, bootstrap = models.actor_critic_apply(
+            target_params, batch[NEXT_OBS][:, -1])
+        vs, pg_adv = vtrace(
+            batch[LOGPS], jax.lax.stop_gradient(t_logp),
+            batch[REWARDS], jax.lax.stop_gradient(t_values), bootstrap,
+            batch[DONES], gamma, clip_rho, clip_c)
+        # PPO clipped surrogate against the BEHAVIOUR logp.
+        ratio = jnp.exp(target_logp - batch[LOGPS])
+        pg = jnp.minimum(ratio * pg_adv,
+                         jnp.clip(ratio, 1 - clip_param,
+                                  1 + clip_param) * pg_adv)
+        pi_loss = -pg.mean()
+        vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_ratio": ratio.mean()}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, stats

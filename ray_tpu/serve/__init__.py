@@ -22,6 +22,10 @@ from ray_tpu.serve._private.controller import (
     get_or_create_controller,
 )
 from ray_tpu.serve._private.http_proxy import HTTPProxy
+from ray_tpu.serve._private.proxy_actor import (  # noqa: F401
+    HTTPProxyActor,
+    start_proxy_fleet,
+)
 from ray_tpu.serve._private.router import ServeHandle
 from ray_tpu.serve.streaming import is_stream, iter_stream  # noqa: F401
 
@@ -109,6 +113,10 @@ def run(target, *, name: str = "default", route_prefix: Optional[str] = None,
     prefix = route_prefix if route_prefix is not None else dep.route_prefix
     if prefix is not None:
         start_http_proxy().routes.set(prefix, handle)
+        # Route table lives on the controller too: proxy-actor fleets
+        # (HTTPProxyActor) learn it via the "routes" long-poll channel.
+        controller = get_or_create_controller()
+        ray_tpu.get(controller.set_route.remote(prefix, dep.name))
     return handle
 
 

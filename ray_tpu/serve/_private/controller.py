@@ -51,10 +51,34 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._long_poll = LongPollHost()
         self._metrics: Dict[str, Dict[str, float]] = {}
+        # Route table: prefix -> deployment name. Proxy actors learn it
+        # via the "routes" long-poll channel (reference: the
+        # control->data-plane LongPollHost route updates).
+        self._routes: Dict[str, str] = {}
         self._shutdown = threading.Event()
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             daemon=True)
         self._reconciler.start()
+
+    # -- routes (consumed by HTTPProxyActor fleet) -----------------------
+
+    def set_route(self, prefix: str, deployment_name: str) -> bool:
+        with self._lock:
+            self._routes[prefix.rstrip("/") or "/"] = deployment_name
+            snapshot = dict(self._routes)
+        self._long_poll.notify_changed("routes", snapshot)
+        return True
+
+    def remove_route(self, prefix: str) -> bool:
+        with self._lock:
+            self._routes.pop(prefix.rstrip("/") or "/", None)
+            snapshot = dict(self._routes)
+        self._long_poll.notify_changed("routes", snapshot)
+        return True
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
 
     # -- API -------------------------------------------------------------
 

@@ -1,0 +1,86 @@
+"""HTTPProxyActor: a proxy running as an actor, one (or more) per node.
+
+Reference: `serve/_private/http_proxy.py:425` HTTPProxyActor +
+`http_state.py` (the controller-managed proxy fleet) — each proxy serves
+HTTP on its own process/port, learns the route table from the
+controller's "routes" long-poll channel, and builds deployment handles
+locally, so request traffic never passes through the driver. Place with
+node-affinity / SPREAD options to front every node of a cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.http_proxy import HTTPProxy
+from ray_tpu.serve._private.router import ServeHandle
+
+
+@ray_tpu.remote
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve._private.controller import (
+            get_or_create_controller,
+        )
+
+        self._controller = get_or_create_controller()
+        self._proxy = HTTPProxy(host, port)
+        self._handles: Dict[str, ServeHandle] = {}
+        self._stop = threading.Event()
+        self._sync(ray_tpu.get(self._controller.get_routes.remote()))
+        self._thread = threading.Thread(target=self._route_loop,
+                                        daemon=True, name="proxy-routes")
+        self._thread.start()
+
+    def _sync(self, routes: Dict[str, str]):
+        for prefix, deployment in routes.items():
+            handle = self._handles.get(deployment)
+            if handle is None:
+                handle = ServeHandle(self._controller, deployment)
+                self._handles[deployment] = handle
+            self._proxy.routes.set(prefix, handle)
+        known = set(routes)
+        for prefix in list(self._proxy.routes._routes):
+            if prefix not in known:
+                self._proxy.routes.remove(prefix)
+
+    def _route_loop(self):
+        version = -1
+        while not self._stop.is_set():
+            try:
+                version, snapshot = ray_tpu.get(
+                    self._controller.listen.remote("routes", version))
+                if snapshot is not None:
+                    self._sync(snapshot)
+            except Exception:
+                if not self._stop.is_set():
+                    self._stop.wait(0.5)
+
+    def address(self):
+        return (self._proxy.host, self._proxy.port)
+
+    def shutdown(self):
+        self._stop.set()
+        self._proxy.shutdown()
+        return True
+
+
+def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
+                      base_port: int = 0, spread: bool = True):
+    """Start N proxy actors (SPREAD-scheduled across nodes when
+    possible); returns [(actor_handle, (host, port)), ...]."""
+    from ray_tpu.util.scheduling_strategies import (
+        SpreadSchedulingStrategy,
+    )
+
+    actors = []
+    for i in range(num_proxies):
+        opts = {}
+        if spread:
+            opts["scheduling_strategy"] = SpreadSchedulingStrategy()
+        port = base_port + i if base_port else 0
+        a = HTTPProxyActor.options(**opts).remote(host, port)
+        actors.append((a, ray_tpu.get(a.address.remote())))
+    return actors

@@ -1,0 +1,70 @@
+"""Proxy-actor fleet: HTTP service from actors fed by the controller's
+route long-poll channel."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_proxy_actor_routes_and_updates():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    serve.run(Doubler.bind(), route_prefix="/double")
+
+    fleet = serve.start_proxy_fleet(num_proxies=2)
+    assert len(fleet) == 2
+    try:
+        for _actor, (host, port) in fleet:
+            out = _post(f"http://{host}:{port}/double", 21)
+            assert out == 42
+
+        # A route added AFTER the fleet started propagates via long-poll.
+        @serve.deployment
+        class Tripler:
+            def __call__(self, x):
+                return 3 * x
+
+        serve.run(Tripler.bind(), route_prefix="/triple")
+        _actor, (host, port) = fleet[0]
+        deadline = time.monotonic() + 15
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                ok = _post(f"http://{host}:{port}/triple", 10) == 30
+            except Exception:
+                time.sleep(0.2)
+        assert ok
+
+        # Unknown path -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        for actor, _addr in fleet:
+            ray_tpu.get(actor.shutdown.remote())
