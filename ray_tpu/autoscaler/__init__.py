@@ -10,9 +10,11 @@ can demand contiguous ones.
 """
 
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    ClusterNodeProvider,
     FakeNodeProvider,
     NodeProvider,
     TPUPodProvider,
+    cluster_demand_fn,
 )
 from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
     AutoscalerConfig,

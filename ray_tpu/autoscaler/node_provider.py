@@ -86,6 +86,70 @@ class FakeNodeProvider(NodeProvider):
         return {"node-type": t} if t else {}
 
 
+class ClusterNodeProvider(NodeProvider):
+    """Launch REAL node processes into a `cluster_utils.Cluster`
+    (reference: the fake multi-node provider,
+    `autoscaler/_private/fake_multi_node/node_provider.py`, which runs
+    actual raylets). Each create_node spawns a node subprocess that
+    registers with the head; terminate shuts it down. This is the
+    provider the end-to-end autoscaler test drives."""
+
+    def __init__(self, cluster, node_types: Dict[str, Dict[str, float]],
+                 provider_config: Optional[dict] = None):
+        super().__init__(provider_config)
+        self.cluster = cluster
+        self.node_types = node_types
+        self._types: Dict[str, str] = {}  # node_id -> node_type
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self, tag_filters=None) -> List[str]:
+        with self._lock:
+            return [n for n in self._types
+                    if self.cluster.head.nodes.get(n) is not None
+                    and self.cluster.head.nodes[n].alive]
+
+    def create_node(self, node_type: str, count: int) -> List[str]:
+        res = dict(self.node_types[node_type])
+        created = []
+        for _ in range(count):
+            node_id = self.cluster.add_node(
+                num_cpus=res.get("CPU", 1), num_tpus=res.get("TPU", 0))
+            with self._lock:
+                self._types[node_id] = node_type
+            created.append(node_id)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            self._types.pop(node_id, None)
+        try:
+            self.cluster.remove_node(node_id)
+        except Exception:
+            pass
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            t = self._types.get(node_id)
+        return {"node-type": t} if t else {}
+
+    def is_running(self, node_id: str) -> bool:
+        record = self.cluster.head.nodes.get(node_id)
+        return bool(record is not None and record.alive)
+
+
+def cluster_demand_fn(head):
+    """Pending demands from the cluster head's view: specs queued
+    cluster-wide because no node can fit them (the reference autoscaler
+    reads the same from GCS resource load). Marks autoscaling enabled so
+    infeasible tasks wait for capacity instead of failing fast."""
+    head.autoscaling_enabled = True
+
+    def fn() -> List[Dict[str, float]]:
+        return list(head.pending_demands.values())
+
+    return fn
+
+
 class TPUPodProvider(NodeProvider):
     """TPU slice provider skeleton: node types are whole slices requested
     through the Queued Resources / GKE API. Zero-egress environments stub

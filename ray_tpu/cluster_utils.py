@@ -130,6 +130,12 @@ class ClusterHead:
         self.task_pins: Dict[bytes, set] = {}          # oid -> {task_id}
         self._task_pinned: Dict[bytes, list] = {}      # task_id -> [oid]
         self.driver_released: set = set()
+        # Cluster-wide unfulfilled resource demands (task_id -> request):
+        # what the autoscaler reads (reference: GCS resource load). With
+        # autoscaling_enabled, no-node-fits tasks wait for capacity
+        # instead of failing fast.
+        self.pending_demands: Dict[bytes, Dict[str, float]] = {}
+        self.autoscaling_enabled = False
         # Placement-group bundle locations: (pg_id_binary, index) ->
         # node_id, or None for the head itself.
         self.pg_bundle_nodes: Dict[Tuple[bytes, int], Optional[str]] = {}
@@ -818,37 +824,51 @@ class ClusterBackendMixin:
         from ray_tpu._private.resources import to_milli
         from ray_tpu import exceptions as exc
 
+        tid = spec.task_id.binary()
+        self.head.pending_demands[tid] = dict(spec.resources) \
+            or {"CPU": 1.0}
+
         def loop():
-            while True:
-                feasible = False
-                for record in self.head.nodes.values():
-                    if not record.alive:
-                        continue
-                    total = to_milli(dict(record.resources))
-                    if all(total.get(k, 0) >= v
-                           for k, v in request.items()):
-                        feasible = True
-                        break
-                if not feasible:
-                    self._fail_spec(spec, exc.RayTpuError(
-                        f"task {spec.describe()} requests {spec.resources} "
-                        f"which no live cluster node can satisfy"))
-                    return
-                target = self._choose_node(spec, exclude=())
-                if target is not None:
-                    if spec.kind == TaskKind.ACTOR_CREATION:
-                        self.head.actor_nodes[spec.actor_id.binary()] = \
-                            target.node_id
-                    try:
-                        self._send(target, spec)
+            try:
+                while True:
+                    feasible = False
+                    for record in self.head.nodes.values():
+                        if not record.alive:
+                            continue
+                        total = to_milli(dict(record.resources))
+                        if all(total.get(k, 0) >= v
+                               for k, v in request.items()):
+                            feasible = True
+                            break
+                    if not feasible and \
+                            not self.head.autoscaling_enabled:
+                        # No autoscaler: nothing will ever fit — fail
+                        # fast. With one, stay pending: the demand is
+                        # what makes the autoscaler launch capacity.
+                        self._fail_spec(spec, exc.RayTpuError(
+                            f"task {spec.describe()} requests "
+                            f"{spec.resources} which no live cluster "
+                            "node can satisfy"))
                         return
-                    except (ConnectionError, OSError) as e:
-                        self.head.mark_node_dead(
-                            target.node_id, reason=f"unreachable: {e}")
+                    target = (self._choose_node(spec, exclude=())
+                              if feasible else None)
+                    if target is not None:
                         if spec.kind == TaskKind.ACTOR_CREATION:
-                            self.head.actor_nodes.pop(
-                                spec.actor_id.binary(), None)
-                time.sleep(0.1)
+                            self.head.actor_nodes[
+                                spec.actor_id.binary()] = target.node_id
+                        try:
+                            self._send(target, spec)
+                            return
+                        except (ConnectionError, OSError) as e:
+                            self.head.mark_node_dead(
+                                target.node_id,
+                                reason=f"unreachable: {e}")
+                            if spec.kind == TaskKind.ACTOR_CREATION:
+                                self.head.actor_nodes.pop(
+                                    spec.actor_id.binary(), None)
+                    time.sleep(0.1)
+            finally:
+                self.head.pending_demands.pop(tid, None)
 
         threading.Thread(target=loop, daemon=True,
                          name="ray_tpu-cluster-queue").start()
