@@ -27,6 +27,9 @@ from ray_tpu._private.worker import (  # noqa: F401
     shutdown,
     wait,
 )
+from ray_tpu._private.ray_client import (  # noqa: F401
+    enable_client_server,
+)
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
 from ray_tpu.object_ref import ObjectRef  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
@@ -74,6 +77,7 @@ __all__ = [
     "available_resources",
     "cancel",
     "cluster_resources",
+    "enable_client_server",
     "exceptions",
     "get",
     "get_actor",

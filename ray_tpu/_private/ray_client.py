@@ -1,0 +1,289 @@
+"""Ray-client mode: a thin remote driver proxying the core API.
+
+Reference: `python/ray/util/client/` (`ray://` — a client-side worker
+forwards API calls over gRPC to a server that translates them into real
+Ray calls, `util/client/server/server.py`). Here:
+
+- `ClientServer` runs inside a real driver process (usually the cluster
+  head driver) and executes submits/gets/puts on its behalf through the
+  normal worker — specs are the wire currency, so tasks, actors, named
+  actors, and nested ObjectRefs all work unchanged.
+- `ClientWorker` replaces the in-process runtime on the client:
+  `ray_tpu.init(address="host:port")` connects it, and the public API
+  (remote/get/put/wait/kill/cancel/get_actor) proxies transparently.
+- The server pins every object the client holds a handle to (its
+  `ObjectRef`s are entries in the server-side registry) and drops pins
+  as the client's handles are GC'd (client_free) or the client
+  disconnects.
+
+Scope: the core task/actor/object API. Library layers (data/train/...)
+run fine on a client for driving-side logic; state/dashboard APIs stay
+server-side.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+CLIENT_SERVER_METHODS = frozenset({
+    "client_submit", "client_put", "client_get", "client_wait",
+    "client_free", "client_kill", "client_cancel",
+    "client_get_named_actor", "client_register_named_actor",
+    "client_remove_named_actor",
+})
+
+
+class ClientServer:
+    """Hosted by a real driver: executes client calls via its worker."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        from ray_tpu._private import worker as worker_mod
+
+        self._worker = worker_mod.global_worker()
+        # Pins: every object a client holds a handle to stays registered
+        # here (oid -> ObjectRef) so cluster release can't free it.
+        self._pins: Dict[bytes, Any] = {}
+        self._lock = threading.Lock()
+        self.server = RpcServer({
+            "client_submit": self._submit,
+            "client_put": self._put,
+            "client_get": self._get,
+            "client_wait": self._wait,
+            "client_free": self._free,
+            "client_kill": self._kill,
+            "client_cancel": self._cancel,
+            "client_get_named_actor": self._get_named,
+            "client_register_named_actor": self._register_named,
+            "client_remove_named_actor": self._remove_named,
+        }, host=host, port=port,
+           dedupe_methods=frozenset({"client_submit"}))
+        self.address: Tuple[str, int] = self.server.address
+
+    def _pin(self, oid_bytes: bytes):
+        from ray_tpu.object_ref import ObjectRef
+
+        with self._lock:
+            if oid_bytes not in self._pins:
+                self._pins[oid_bytes] = ObjectRef(ObjectID(oid_bytes))
+
+    def _submit(self, spec):
+        # Deserializing the spec registered any contained ObjectRefs
+        # with this worker (borrow semantics). Return ids were assigned
+        # client-side; pin them here on the client's behalf.
+        self._worker.backend.submit(spec)
+        for oid in spec.return_ids:
+            self._pin(oid.binary())
+        return True
+
+    def _put(self, value):
+        ref = self._worker.put_object(value)
+        self._pin(ref.binary())
+        return ref.binary()
+
+    def _get(self, oids: List[bytes], timeout):
+        from ray_tpu import exceptions as exc
+        from ray_tpu.object_ref import ObjectRef
+
+        refs = [ObjectRef(ObjectID(o)) for o in oids]
+        try:
+            return {"values": self._worker.get_objects(refs, timeout)}
+        except Exception as e:  # noqa: BLE001 — shipped to the client
+            return {"error": e}
+
+    def _wait(self, oids: List[bytes], num_returns, timeout):
+        from ray_tpu.object_ref import ObjectRef
+
+        refs = [ObjectRef(ObjectID(o)) for o in oids]
+        ready, not_ready = self._worker.wait(refs, num_returns, timeout)
+        return ([r.binary() for r in ready],
+                [r.binary() for r in not_ready])
+
+    def _free(self, oids: List[bytes]):
+        with self._lock:
+            for o in oids:
+                self._pins.pop(o, None)
+        return True
+
+    def _kill(self, actor_id: bytes, no_restart: bool):
+        aid = ActorID(actor_id)
+        self._worker.gcs.remove_named_actor_by_id(aid)
+        self._worker.backend.kill_actor(aid, no_restart)
+        return True
+
+    def _cancel(self, task_id):
+        self._worker.backend.cancel(task_id)
+        return True
+
+    def _get_named(self, name: str, namespace):
+        return self._worker.gcs.get_named_actor(name, namespace)
+
+    def _register_named(self, name: str, namespace, handle):
+        self._worker.gcs.register_named_actor(name, namespace, handle)
+        return True
+
+    def _remove_named(self, actor_id: bytes):
+        self._worker.gcs.remove_named_actor_by_id(ActorID(actor_id))
+        return True
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+def enable_client_server(host: str = "0.0.0.0",
+                         port: int = 0) -> ClientServer:
+    """Start serving remote clients from this driver process."""
+    return ClientServer(host, port)
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+
+class _ClientBackend:
+    """Minimal backend surface for a proxy worker."""
+
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+
+    def submit(self, spec):
+        self._worker._rpc.call("client_submit", spec=spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._worker._rpc.call("client_kill",
+                               actor_id=actor_id.binary(),
+                               no_restart=no_restart)
+
+    def cancel(self, task_id):
+        self._worker._rpc.call("client_cancel", task_id=task_id)
+
+    def notify_blocked(self):
+        pass
+
+    def notify_unblocked(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+class _ClientGCS:
+    def __init__(self, worker: "ClientWorker"):
+        self._worker = worker
+
+    def get_named_actor(self, name: str, namespace=None):
+        from ray_tpu._private.rpc import RemoteCallError
+
+        try:
+            return self._worker._rpc.call(
+                "client_get_named_actor", name=name, namespace=namespace)
+        except RemoteCallError as e:
+            raise ValueError(str(e)) from None
+
+    def register_named_actor(self, name: str, namespace, handle):
+        self._worker._rpc.call("client_register_named_actor", name=name,
+                               namespace=namespace, handle=handle)
+
+    def remove_named_actor_by_id(self, actor_id: ActorID):
+        self._worker._rpc.call("client_remove_named_actor",
+                               actor_id=actor_id.binary())
+
+
+class ClientWorker:
+    """Drop-in for Worker on a thin client: public-API calls proxy to
+    the ClientServer. Reuses Worker's spec-building path (submit assigns
+    return ids locally; the server honours them)."""
+
+    is_client = True
+
+    def __init__(self, address: Tuple[str, int]):
+        from ray_tpu._private.ids import JobID, TaskID, WorkerID
+        from ray_tpu._private.worker import _TaskContext
+
+        self._rpc = RpcClient.dedicated(tuple(address))
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.from_random()
+        self.namespace = f"client-{self.job_id.hex()}"
+        self.task_context = _TaskContext()
+        self._driver_task_id = TaskID.from_random()
+        self._put_lock = threading.Lock()
+        self._put_idx = 0
+        self.shm_plane = None
+        self.backend = _ClientBackend(self)
+        self.gcs = _ClientGCS(self)
+        self._free_lock = threading.Lock()
+        self._handle_counts: Dict[bytes, int] = {}
+
+    # -- object API ------------------------------------------------------
+
+    def put_object(self, value):
+        from ray_tpu.object_ref import ObjectRef
+
+        oid_bytes = self._rpc.call("client_put", value=value)
+        return ObjectRef(ObjectID(oid_bytes))
+
+    def get_objects(self, refs, timeout=None):
+        out = self._rpc.call("client_get",
+                             oids=[r.binary() for r in refs],
+                             timeout=timeout)
+        if "error" in out:
+            raise out["error"]
+        return out["values"]
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        ready_b, not_ready_b = self._rpc.call(
+            "client_wait", oids=[r.binary() for r in refs],
+            num_returns=num_returns, timeout=timeout)
+        by_id = {r.binary(): r for r in refs}
+        return ([by_id[b] for b in ready_b],
+                [by_id[b] for b in not_ready_b])
+
+    # -- task API --------------------------------------------------------
+
+    def submit(self, spec):
+        from ray_tpu._private.task_spec import TaskKind
+        from ray_tpu.object_ref import ObjectRef
+
+        n = spec.num_returns
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            n = max(n, 1)
+        spec.return_ids = [ObjectID.for_task_return(spec.task_id, i)
+                           for i in range(n)]
+        refs = [ObjectRef(oid) for oid in spec.return_ids]
+        self.backend.submit(spec)
+        return refs
+
+    def current_task_id(self):
+        return self._driver_task_id
+
+    # -- handle refcounting: last local handle frees the server pin -----
+
+    def register_object_ref(self, ref) -> int:
+        with self._free_lock:
+            b = ref.binary()
+            self._handle_counts[b] = self._handle_counts.get(b, 0) + 1
+            return self._handle_counts[b]
+
+    def unregister_object_ref(self, oid: ObjectID) -> bool:
+        with self._free_lock:
+            b = oid.binary()
+            n = self._handle_counts.get(b, 0) - 1
+            if n > 0:
+                self._handle_counts[b] = n
+                return False
+            self._handle_counts.pop(b, None)
+        try:
+            self._rpc.call("client_free", oids=[b])
+        except Exception:  # noqa: BLE001 — disconnecting is fine
+            pass
+        return True
+
+    def shutdown(self):
+        try:
+            self._rpc.close()
+        except Exception:  # noqa: BLE001
+            pass

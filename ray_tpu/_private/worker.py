@@ -228,6 +228,7 @@ def init(
     namespace: Optional[str] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    address: Optional[Any] = None,
     _system_config: Optional[Dict[str, Any]] = None,
     **kwargs,
 ) -> "Worker":
@@ -235,7 +236,10 @@ def init(
 
     Reference: ``ray.init`` (``python/ray/_private/worker.py:1096``). Here a
     single-node in-process runtime is brought up; multiprocess/cluster modes
-    attach through ``ray_tpu.cluster_utils``.
+    attach through ``ray_tpu.cluster_utils``. ``address="host:port"``
+    connects as a thin client to a driver running a client server
+    (`ray_tpu.enable_client_server` — the reference's ray:// client
+    mode): the core API proxies there instead of running locally.
     """
     global _global_worker
     with _init_lock:
@@ -246,6 +250,15 @@ def init(
                 "ray_tpu.init() called twice; pass ignore_reinit_error=True "
                 "or call ray_tpu.shutdown() first."
             )
+        if address is not None:
+            from ray_tpu._private.ray_client import ClientWorker
+
+            if isinstance(address, str):
+                host, _, port = address.rpartition(":")
+                address = (host or "127.0.0.1", int(port))
+            _global_worker = ClientWorker(tuple(address))
+            atexit.register(shutdown)
+            return _global_worker
         from ray_tpu._private.config import apply_system_config
 
         apply_system_config(_system_config)

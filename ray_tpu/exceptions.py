@@ -36,6 +36,12 @@ class TaskError(RayTpuError):
             f"--- remote traceback ---\n{remote_traceback}"
         )
 
+    def __reduce__(self):
+        # Default exception pickling reconstructs from self.args (the
+        # formatted message), which would arrive as a str `cause`.
+        return (TaskError,
+                (self.cause, self.task_desc, self.remote_traceback))
+
     def as_instanceof_cause(self) -> BaseException:
         """Return an exception that is an instance of the cause's class.
 
@@ -52,11 +58,24 @@ class TaskError(RayTpuError):
                         self, te.cause, te.task_desc, te.remote_traceback
                     )
 
+                def __reduce__(self):
+                    # The dynamic dual-inheritance class doesn't survive
+                    # pickling as-is (exceptions reconstruct from
+                    # self.args — the message string). Rebuild from a
+                    # plain TaskError and re-wrap on the other side.
+                    return (_rebuild_wrapped_task_error,
+                            (TaskError(self.cause, self.task_desc,
+                                       self.remote_traceback),))
+
             _Wrapped.__name__ = f"TaskError({cause_cls.__name__})"
             _Wrapped.__qualname__ = _Wrapped.__name__
             return _Wrapped(self)
         except TypeError:
             return self
+
+
+def _rebuild_wrapped_task_error(te: "TaskError") -> BaseException:
+    return te.as_instanceof_cause()
 
 
 class ActorError(RayTpuError):

@@ -1,0 +1,144 @@
+"""Ray-client mode: a separate client process drives the cluster
+through `init(address=...)` (reference `util/client/` ray:// mode)."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+
+    # tasks
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    assert ray_tpu.get([square.remote(i) for i in range(5)]) == \\
+        [0, 1, 4, 9, 16]
+
+    # put/get + nested ref through a task
+    ref = ray_tpu.put({{"k": 41}})
+
+    @ray_tpu.remote
+    def bump(d):
+        d["k"] += 1
+        return d
+
+    assert ray_tpu.get(bump.remote(ref))["k"] == 42
+
+    # wait
+    refs = [square.remote(i) for i in range(4)]
+    ready, rest = ray_tpu.wait(refs, num_returns=2, timeout=30)
+    assert len(ready) == 2 and len(rest) == 2
+
+    # actors + named actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="client_counter").remote(start=10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    again = ray_tpu.get_actor("client_counter")
+    assert ray_tpu.get(again.inc.remote()) == 12
+
+    # exceptions propagate with their original type
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    try:
+        ray_tpu.get(boom.remote())
+        raise SystemExit("expected ValueError")
+    except ValueError as e:
+        assert "kaboom" in str(e)
+
+    ray_tpu.kill(c)
+    print("CLIENT OK")
+""")
+
+
+def test_task_error_pickle_roundtrip():
+    """TaskError (and its dynamic dual-type wrapper) must survive
+    pickling with cause/desc/traceback intact — the client ships them
+    across processes (previously both reconstructed from the message
+    string and blew up on attribute access)."""
+    import pickle
+
+    from ray_tpu.exceptions import TaskError
+
+    te = TaskError(ValueError("boom"), "f()")
+    te2 = pickle.loads(pickle.dumps(te))
+    assert isinstance(te2, TaskError)
+    assert isinstance(te2.cause, ValueError)
+    assert te2.task_desc == "f()"
+    assert "boom" in te2.remote_traceback
+
+    wrapped = te.as_instanceof_cause()
+    assert isinstance(wrapped, ValueError)
+    w2 = pickle.loads(pickle.dumps(wrapped))
+    assert isinstance(w2, ValueError) and isinstance(w2, TaskError)
+    assert "boom" in str(w2)
+
+
+def test_client_process_drives_server():
+    server = ray_tpu.enable_client_server(host="127.0.0.1", port=0)
+    try:
+        script = CLIENT_SCRIPT.format(repo=".")
+        out = subprocess.run(
+            [sys.executable, "-c", script,
+             f"{server.address[0]}:{server.address[1]}"],
+            capture_output=True, text=True, timeout=180)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "CLIENT OK" in out.stdout
+    finally:
+        server.shutdown()
+
+
+def test_client_frees_release_server_pins():
+    server = ray_tpu.enable_client_server(host="127.0.0.1", port=0)
+    try:
+        script = textwrap.dedent("""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, ".")
+            import gc
+            import ray_tpu
+
+            ray_tpu.init(address=sys.argv[1])
+            ref = ray_tpu.put(list(range(1000)))
+            assert ray_tpu.get(ref)[-1] == 999
+            del ref
+            gc.collect()
+            print("FREED")
+        """)
+        out = subprocess.run(
+            [sys.executable, "-c", script,
+             f"{server.address[0]}:{server.address[1]}"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "FREED" in out.stdout
+        assert not server._pins, list(server._pins)
+    finally:
+        server.shutdown()
