@@ -91,6 +91,11 @@ class ClientServer:
         refs = [ObjectRef(ObjectID(o)) for o in oids]
         try:
             return {"values": self._worker.get_objects(refs, timeout)}
+        except exc.GetTimeoutError:
+            # Slice timeout: the client long-polls in bounded slices (a
+            # single blocking RPC would trip the socket timeout on slow
+            # tasks) and distinguishes its own deadline from ours.
+            return {"pending": True}
         except Exception as e:  # noqa: BLE001 — shipped to the client
             return {"error": e}
 
@@ -200,7 +205,13 @@ class ClientWorker:
 
     is_client = True
 
+    # Long-poll slice: each blocking server call is bounded well below
+    # the transport's 30s socket timeout.
+    _POLL_SLICE_S = 10.0
+
     def __init__(self, address: Tuple[str, int]):
+        import queue as _queue
+
         from ray_tpu._private.ids import JobID, TaskID, WorkerID
         from ray_tpu._private.worker import _TaskContext
 
@@ -210,13 +221,34 @@ class ClientWorker:
         self.namespace = f"client-{self.job_id.hex()}"
         self.task_context = _TaskContext()
         self._driver_task_id = TaskID.from_random()
-        self._put_lock = threading.Lock()
-        self._put_idx = 0
         self.shm_plane = None
         self.backend = _ClientBackend(self)
         self.gcs = _ClientGCS(self)
         self._free_lock = threading.Lock()
         self._handle_counts: Dict[bytes, int] = {}
+        # Frees ride a background thread: __del__ can fire from a GC
+        # pass INSIDE an in-flight RPC on this same thread, and a
+        # synchronous free would self-deadlock on the client lock.
+        self._free_q: "_queue.Queue" = _queue.Queue()
+        self._free_rpc = RpcClient.dedicated(tuple(address))
+        self._free_thread = threading.Thread(
+            target=self._free_loop, daemon=True, name="client-free")
+        self._free_thread.start()
+
+    def _free_loop(self):
+        import queue as _queue
+
+        while True:
+            batch = [self._free_q.get()]
+            while True:
+                try:
+                    batch.append(self._free_q.get_nowait())
+                except _queue.Empty:
+                    break
+            try:
+                self._free_rpc.call("client_free", oids=batch)
+            except Exception:  # noqa: BLE001 — disconnect is fine
+                pass
 
     # -- object API ------------------------------------------------------
 
@@ -227,20 +259,48 @@ class ClientWorker:
         return ObjectRef(ObjectID(oid_bytes))
 
     def get_objects(self, refs, timeout=None):
-        out = self._rpc.call("client_get",
-                             oids=[r.binary() for r in refs],
-                             timeout=timeout)
-        if "error" in out:
-            raise out["error"]
-        return out["values"]
+        import time as _time
+
+        from ray_tpu import exceptions as exc
+
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        oids = [r.binary() for r in refs]
+        while True:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+            slice_t = self._POLL_SLICE_S if remaining is None \
+                else min(remaining, self._POLL_SLICE_S)
+            out = self._rpc.call("client_get", oids=oids,
+                                 timeout=slice_t)
+            if "error" in out:
+                raise out["error"]
+            if "values" in out:
+                return out["values"]
+            # pending: server slice elapsed — our own deadline?
+            if remaining is not None and remaining <= slice_t:
+                raise exc.GetTimeoutError(
+                    f"get() timed out after {timeout}s (client mode)")
 
     def wait(self, refs, num_returns, timeout, fetch_local=True):
-        ready_b, not_ready_b = self._rpc.call(
-            "client_wait", oids=[r.binary() for r in refs],
-            num_returns=num_returns, timeout=timeout)
+        import time as _time
+
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
         by_id = {r.binary(): r for r in refs}
-        return ([by_id[b] for b in ready_b],
-                [by_id[b] for b in not_ready_b])
+        while True:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+            slice_t = self._POLL_SLICE_S if remaining is None \
+                else min(remaining, self._POLL_SLICE_S)
+            ready_b, not_ready_b = self._rpc.call(
+                "client_wait", oids=list(by_id),
+                num_returns=num_returns, timeout=slice_t)
+            enough = len(ready_b) >= num_returns
+            out_of_time = remaining is not None and remaining <= slice_t
+            if enough or out_of_time:
+                return ([by_id[b] for b in ready_b],
+                        [by_id[b] for b in not_ready_b])
 
     # -- task API --------------------------------------------------------
 
@@ -276,14 +336,12 @@ class ClientWorker:
                 self._handle_counts[b] = n
                 return False
             self._handle_counts.pop(b, None)
-        try:
-            self._rpc.call("client_free", oids=[b])
-        except Exception:  # noqa: BLE001 — disconnecting is fine
-            pass
+        self._free_q.put(b)  # background thread RPCs (GC-safe)
         return True
 
     def shutdown(self):
         try:
             self._rpc.close()
+            self._free_rpc.close()
         except Exception:  # noqa: BLE001
             pass

@@ -31,6 +31,9 @@ class AutoscalerConfig:
     upscaling_speed: float = 1.0
     idle_timeout_s: float = 60.0
     interval_s: float = 1.0
+    # Max time to wait for an in-flight launch to register before
+    # demand-packing again (stuck-launch escape hatch).
+    launch_grace_s: float = 30.0
 
 
 def bin_pack_demands(demands: List[Dict[str, float]],
@@ -85,6 +88,7 @@ class StandardAutoscaler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._idle_since: Dict[str, float] = {}
+        self._launch_grace = None  # (node_count_at_launch, started_at)
         self.launches = 0
         self.terminations = 0
 
@@ -108,14 +112,31 @@ class StandardAutoscaler:
                 by_type[nt.name] = nt.min_workers
 
         if demands:
-            plan = bin_pack_demands(demands, self.config.node_types,
-                                    by_type)
-            for name, count in plan.items():
-                count = max(1, min(
-                    count,
-                    math.ceil(count * self.config.upscaling_speed)))
-                self.provider.create_node(name, count)
-                self.launches += count
+            # Launch grace: a pending demand stays visible until its
+            # task actually dispatches, which lags node startup +
+            # registration — re-packing it every tick would launch a
+            # fresh node per tick until then. Hold off while a launch is
+            # in flight until the node count actually grew (or the
+            # grace window expires as a stuck-launch escape hatch).
+            now = time.monotonic()
+            if self._launch_grace is not None:
+                prev_nodes, started = self._launch_grace
+                if len(nodes) > prev_nodes or \
+                        now - started > self.config.launch_grace_s:
+                    self._launch_grace = None
+            if self._launch_grace is None:
+                plan = bin_pack_demands(demands, self.config.node_types,
+                                        by_type)
+                launched = 0
+                for name, count in plan.items():
+                    count = max(1, min(
+                        count,
+                        math.ceil(count * self.config.upscaling_speed)))
+                    self.provider.create_node(name, count)
+                    launched += count
+                if launched:
+                    self.launches += launched
+                    self._launch_grace = (len(nodes), now)
         else:
             # Idle downscaling to min_workers.
             now = time.monotonic()
@@ -140,6 +161,11 @@ class StandardAutoscaler:
 
     def start(self):
         self._stop.clear()
+        # While (and only while) an autoscaler runs, infeasible cluster
+        # tasks wait as pending demands instead of failing fast.
+        head = getattr(self.demand_fn, "head", None)
+        if head is not None:
+            head.autoscaling_enabled = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="autoscaler")
         self._thread.start()
@@ -154,6 +180,9 @@ class StandardAutoscaler:
 
     def stop(self):
         self._stop.set()
+        head = getattr(self.demand_fn, "head", None)
+        if head is not None:
+            head.autoscaling_enabled = False
 
     def summary(self) -> dict:
         nodes = self.provider.non_terminated_nodes({})

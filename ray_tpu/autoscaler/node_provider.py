@@ -140,13 +140,15 @@ class ClusterNodeProvider(NodeProvider):
 def cluster_demand_fn(head):
     """Pending demands from the cluster head's view: specs queued
     cluster-wide because no node can fit them (the reference autoscaler
-    reads the same from GCS resource load). Marks autoscaling enabled so
-    infeasible tasks wait for capacity instead of failing fast."""
-    head.autoscaling_enabled = True
+    reads the same from GCS resource load). The returned fn carries the
+    head so `StandardAutoscaler.start/stop` can flip
+    `head.autoscaling_enabled` for its lifetime (infeasible tasks wait
+    for capacity only while an autoscaler actually runs)."""
 
     def fn() -> List[Dict[str, float]]:
         return list(head.pending_demands.values())
 
+    fn.head = head
     return fn
 
 

@@ -52,34 +52,16 @@ class APPO(IMPALA):
             vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff,
             clip_param=cfg.clip_param))
 
-    def training_step(self) -> Dict[str, Any]:
-        cfg = self.algo_config
-        stats_acc = []
-        steps = 0
-        import ray_tpu
-
-        if not self._sample_futures:
-            w_ref = ray_tpu.put(self.params)
-            self._sample_futures = [
-                (w, w.sample.remote(w_ref)) for w in self.workers.workers]
-        for _ in range(cfg.updates_per_iter):
-            worker, fut = self._sample_futures.pop(0)
-            batch = fut and ray_tpu.get(fut)
-            self._sample_futures.append(
-                (worker, worker.sample.remote(ray_tpu.put(self.params))))
-            self.params, self.opt_state, stats = self._update(
-                self.params, self.target_params, self.opt_state,
-                {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()})
-            stats_acc.append(jax.device_get(stats))
-            steps += np.asarray(batch[REWARDS]).size
-            self._updates_since_sync += 1
-            if self._updates_since_sync >= cfg.target_update_freq:
-                self.target_params = jax.tree.map(jnp.copy, self.params)
-                self._updates_since_sync = 0
-        agg = {k: float(np.mean([s[k] for s in stats_acc]))
-               for k in stats_acc[0]}
-        agg["num_env_steps_sampled_this_iter"] = steps
-        return agg
+    def _do_update(self, batch):
+        # IMPALA's async sample pipeline drives this; only the update
+        # call (target net threaded through) and the sync cadence differ.
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.target_params, self.opt_state, batch)
+        self._updates_since_sync += 1
+        if self._updates_since_sync >= self.algo_config.target_update_freq:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._updates_since_sync = 0
+        return stats
 
     def get_weights(self):
         return {"params": self.params, "target": self.target_params}

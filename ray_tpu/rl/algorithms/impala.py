@@ -112,8 +112,7 @@ class IMPALA(Algorithm):
             # resubmit immediately with current weights (stale by design)
             self._sample_futures.append(
                 (worker, worker.sample.remote(ray_tpu.put(self.params))))
-            self.params, self.opt_state, stats = self._update(
-                self.params, self.opt_state,
+            stats = self._do_update(
                 {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()})
             stats_acc.append(jax.device_get(stats))
             steps += np.asarray(batch[REWARDS]).size
@@ -121,6 +120,13 @@ class IMPALA(Algorithm):
                for k in stats_acc[0]}
         agg["num_env_steps_sampled_this_iter"] = steps
         return agg
+
+    def _do_update(self, batch):
+        """One learner update; subclasses (APPO) override to thread
+        extra state through `_update` and run post-update bookkeeping."""
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch)
+        return stats
 
     def get_weights(self):
         return self.params

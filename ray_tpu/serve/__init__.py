@@ -221,6 +221,13 @@ def status() -> Dict[str, Any]:
 def delete(name: str):
     controller = get_or_create_controller()
     ray_tpu.get(controller.delete_deployment.remote(name))
+    # Retract the deployment's routes everywhere: the controller table
+    # (proxy-actor fleets long-poll it) and the driver-local proxy.
+    ray_tpu.get(controller.remove_routes_of.remote(name))
+    if _proxy is not None:
+        for prefix, handle in list(_proxy.routes._routes.items()):
+            if getattr(handle, "_deployment", None) == name:
+                _proxy.routes.remove(prefix)
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:

@@ -76,6 +76,16 @@ class ServeController:
         self._long_poll.notify_changed("routes", snapshot)
         return True
 
+    def remove_routes_of(self, deployment_name: str) -> bool:
+        """Drop every prefix routing to a deployment (serve.delete)."""
+        with self._lock:
+            for prefix in [p for p, d in self._routes.items()
+                           if d == deployment_name]:
+                del self._routes[prefix]
+            snapshot = dict(self._routes)
+        self._long_poll.notify_changed("routes", snapshot)
+        return True
+
     def get_routes(self) -> Dict[str, str]:
         with self._lock:
             return dict(self._routes)

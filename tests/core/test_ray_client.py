@@ -116,6 +116,59 @@ def test_client_process_drives_server():
         server.shutdown()
 
 
+def test_client_get_outlives_poll_slice():
+    """A get on a task slower than the long-poll slice (and a wait with
+    a sub-slice timeout) must behave correctly — the blocking RPC is
+    sliced below the socket timeout."""
+    from ray_tpu._private import ray_client as rc
+
+    server = ray_tpu.enable_client_server(host="127.0.0.1", port=0)
+    old_slice = rc.ClientWorker._POLL_SLICE_S
+    rc.ClientWorker._POLL_SLICE_S = 1.0  # make slicing observable fast
+    try:
+        script = textwrap.dedent("""
+            import os, sys, time
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, ".")
+            import ray_tpu
+            from ray_tpu._private import ray_client as rc
+            rc.ClientWorker._POLL_SLICE_S = 1.0
+
+            ray_tpu.init(address=sys.argv[1])
+
+            @ray_tpu.remote
+            def slow():
+                time.sleep(3.5)
+                return "done"
+
+            ref = slow.remote()
+            # wait with a short timeout reports not-ready, not an error
+            ready, rest = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+            assert not ready and len(rest) == 1
+            # a multi-slice blocking get succeeds
+            assert ray_tpu.get(ref, timeout=60) == "done"
+            # and a too-short get raises GetTimeoutError
+            ref2 = slow.remote()
+            from ray_tpu.exceptions import GetTimeoutError
+            try:
+                ray_tpu.get(ref2, timeout=0.5)
+                raise SystemExit("expected timeout")
+            except GetTimeoutError:
+                pass
+            ray_tpu.get(ref2, timeout=60)
+            print("SLOW OK")
+        """)
+        out = subprocess.run(
+            [sys.executable, "-c", script,
+             f"{server.address[0]}:{server.address[1]}"],
+            capture_output=True, text=True, timeout=180)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "SLOW OK" in out.stdout
+    finally:
+        rc.ClientWorker._POLL_SLICE_S = old_slice
+        server.shutdown()
+
+
 def test_client_frees_release_server_pins():
     server = ray_tpu.enable_client_server(host="127.0.0.1", port=0)
     try:

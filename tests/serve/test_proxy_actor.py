@@ -68,3 +68,29 @@ def test_proxy_actor_routes_and_updates():
     finally:
         for actor, _addr in fleet:
             ray_tpu.get(actor.shutdown.remote())
+
+
+def test_delete_retracts_routes_from_proxies():
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo.bind(), route_prefix="/echo")
+    fleet = serve.start_proxy_fleet(num_proxies=1)
+    try:
+        _actor, (host, port) = fleet[0]
+        assert _post(f"http://{host}:{port}/echo", 7) == 7
+        serve.delete("Echo")
+        deadline = time.monotonic() + 15
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                _post(f"http://{host}:{port}/echo", 7)
+                time.sleep(0.2)
+            except urllib.error.HTTPError as e:
+                gone = e.code == 404
+        assert gone, "route survived serve.delete"
+    finally:
+        for actor, _addr in fleet:
+            ray_tpu.get(actor.shutdown.remote())
