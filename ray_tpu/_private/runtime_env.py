@@ -81,18 +81,57 @@ class _WorkingDirPlugin(RuntimeEnvPlugin):
             os.chdir(old)
 
 
+class _PyModulesPlugin(RuntimeEnvPlugin):
+    """py_modules: directories or zip files whose modules become
+    importable for the task (reference `runtime_env/py_modules.py`; the
+    reference additionally ships the files via GCS — here paths must be
+    reachable on the executing node, e.g. a shared filesystem)."""
+
+    name = "py_modules"
+
+    def validate(self, value):
+        if not isinstance(value, (list, tuple)) or not all(
+                isinstance(p, str) for p in value):
+            raise TypeError("py_modules must be a list of path strings")
+
+    @contextlib.contextmanager
+    def apply(self, value):
+        import importlib
+        import sys
+
+        added = []
+        for path in value:
+            # Each entry names a module: a package dir or a single .py
+            # imports via its PARENT directory; a zip goes on sys.path
+            # itself (zipimport).
+            p = os.path.abspath(path.rstrip("/"))
+            if os.path.isfile(p) and p.endswith(".zip"):
+                entry = p
+            else:
+                entry = os.path.dirname(p)
+            sys.path.insert(0, entry)
+            added.append(entry)
+        importlib.invalidate_caches()
+        try:
+            yield
+        finally:
+            for entry in added:
+                try:
+                    sys.path.remove(entry)
+                except ValueError:
+                    pass
+
+
 class _RecordedOnlyPlugin(RuntimeEnvPlugin):
-    """pip/conda/py_modules: validated + recorded; materialized by worker-
-    process launchers (job supervisor), not applicable to in-process
-    threads."""
+    """pip/conda: validated + recorded; materialized by worker-process
+    launchers (job supervisor), not applicable to in-process threads."""
 
     def __init__(self, name: str):
         self.name = name
 
 
-for _p in (_EnvVarsPlugin(), _WorkingDirPlugin(),
+for _p in (_EnvVarsPlugin(), _WorkingDirPlugin(), _PyModulesPlugin(),
            _RecordedOnlyPlugin("pip"), _RecordedOnlyPlugin("conda"),
-           _RecordedOnlyPlugin("py_modules"),
            _RecordedOnlyPlugin("container"),
            _RecordedOnlyPlugin("config")):
     register_plugin(_p)
@@ -115,12 +154,13 @@ def applied_runtime_env(runtime_env: Optional[dict]):
     """Apply an env around a task body. Serialized: process env/cwd are
     global, so concurrent tasks with envs take turns."""
     if not runtime_env or not any(
-            k in runtime_env for k in ("env_vars", "working_dir")):
+            k in runtime_env
+            for k in ("env_vars", "working_dir", "py_modules")):
         yield
         return
     with _env_lock:
         with contextlib.ExitStack() as stack:
-            for key in ("working_dir", "env_vars"):
+            for key in ("working_dir", "py_modules", "env_vars"):
                 if key in runtime_env:
                     stack.enter_context(
                         _PLUGINS[key].apply(runtime_env[key]))

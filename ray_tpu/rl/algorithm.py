@@ -185,6 +185,74 @@ class Algorithm(Trainable):
             metrics["episodes_this_iter"] = len(stats)
         return metrics
 
+    def compute_single_action(self, obs, explore: bool = False):
+        """Greedy (or sampled, explore=True) action from the current
+        policy — reference `Algorithm.compute_single_action`. Covers the
+        built-in policy families by parameter shape: actor-critic
+        (logits), Q-network, and tanh-Gaussian continuous."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        weights = self.get_weights()
+        params = weights.get("params", weights) \
+            if isinstance(weights, dict) else weights
+        obs_b = jnp.asarray(np.asarray(obs, np.float32))[None]
+        if isinstance(params, dict) and "pi" in params:
+            logits, _ = models.actor_critic_apply(params, obs_b)
+            if explore:
+                key = jax.random.PRNGKey(np.random.randint(2 ** 31))
+                return int(jax.random.categorical(key, logits)[0])
+            return int(jnp.argmax(logits, -1)[0])
+        if isinstance(params, dict) and "q" in params:
+            return int(jnp.argmax(models.q_net_apply(params, obs_b),
+                                  -1)[0])
+        if isinstance(params, dict) and "actor" in params:
+            mean, _ = models.gaussian_policy_apply(params["actor"],
+                                                   obs_b)
+            return np.asarray(jnp.tanh(mean)[0])
+        raise NotImplementedError(
+            f"{type(self).__name__} has no evaluable policy shape")
+
+    def evaluate(self, num_episodes: int = 5,
+                 max_steps_per_episode: int = 1000) -> Dict[str, Any]:
+        """Run the current policy WITHOUT exploration for N episodes
+        (reference `Algorithm.evaluate` / evaluation workers). Returns
+        episode_reward_mean/min/max and mean length."""
+        from ray_tpu.rl.env import Box, make_env
+
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        continuous = isinstance(env.action_space, Box)
+        rewards, lengths = [], []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=cfg.seed + 10_000 + ep)
+            total, steps = 0.0, 0
+            for _ in range(max_steps_per_episode):
+                a = self.compute_single_action(obs)
+                if continuous:
+                    low, high = env.action_space.low, \
+                        env.action_space.high
+                    a = low + (np.asarray(a) + 1.0) * 0.5 * (high - low)
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                steps += 1
+                if term or trunc:
+                    break
+            rewards.append(total)
+            lengths.append(steps)
+        env.close()
+        return {
+            "evaluation": {
+                "episode_reward_mean": float(np.mean(rewards)),
+                "episode_reward_min": float(np.min(rewards)),
+                "episode_reward_max": float(np.max(rewards)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes": num_episodes,
+            }
+        }
+
     def cleanup(self):
         if hasattr(self, "workers"):
             self.workers.stop()
