@@ -5,6 +5,12 @@ TPU mesh axes instead of `use_gpu`, RunConfig/FailureConfig/
 CheckpointConfig, the worker-side `session` API, and Result.
 """
 
+from ray_tpu.air.batch_predictor import (  # noqa: F401
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+    TorchPredictor,
+)
 from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig,

@@ -1,0 +1,56 @@
+"""BatchPredictor: checkpoint -> predictor -> dataset map with
+actor-pool compute."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.air import BatchPredictor, Checkpoint, JaxPredictor
+from ray_tpu.air.batch_predictor import TorchPredictor
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _linear_apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def test_jax_batch_prediction_over_dataset():
+    w = np.array([[2.0], [1.0]], np.float32)
+    ckpt = Checkpoint.from_dict(
+        {"params": {"w": w, "b": np.float32(3.0)}})
+    predictor = BatchPredictor.from_checkpoint(
+        ckpt, JaxPredictor, apply_fn=_linear_apply)
+
+    rows = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ds = rd.from_numpy(rows)
+    out = predictor.predict(ds, batch_size=4)
+    preds = np.concatenate(
+        [np.atleast_1d(r["predictions"]).ravel()
+         for r in out.take_all()])
+    expect = (rows @ w + 3.0).ravel()
+    np.testing.assert_allclose(np.sort(preds), np.sort(expect),
+                               rtol=1e-5)
+
+
+def test_torch_predictor_roundtrip():
+    import torch
+
+    from ray_tpu.train.torch import TorchCheckpoint
+
+    model = torch.nn.Linear(2, 1)
+    with torch.no_grad():
+        model.weight[:] = torch.tensor([[2.0, 1.0]])
+        model.bias[:] = torch.tensor([3.0])
+    ckpt = TorchCheckpoint.from_model(model)
+    pred = TorchPredictor.from_checkpoint(ckpt,
+                                          model=torch.nn.Linear(2, 1))
+    out = pred.predict({"data": np.array([[1.0, 2.0]], np.float32)})
+    np.testing.assert_allclose(out["predictions"], [[7.0]], rtol=1e-5)
