@@ -389,6 +389,36 @@ class Dataset:
             sharding=sharding, prefetch=prefetch_batches,
             drop_last=drop_last)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes: Optional[dict] = None,
+                           device: Optional[str] = None,
+                           prefetch_batches: int = 1,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """Batches as torch tensors (reference
+        `data/dataset_iterator.py:143` iter_torch_batches); CPU torch in
+        this image, `device=` passes through to `.to()`."""
+        import numpy as np
+        import torch
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                prefetch_batches=prefetch_batches, drop_last=drop_last):
+            if isinstance(batch, dict):
+                out = {}
+                for k, v in batch.items():
+                    t = torch.as_tensor(np.asarray(v))
+                    if dtypes and k in dtypes:
+                        t = t.to(dtypes[k])
+                    if device:
+                        t = t.to(device)
+                    out[k] = t
+                yield out
+            else:
+                t = torch.as_tensor(np.asarray(batch))
+                if device:
+                    t = t.to(device)
+                yield t
+
     def to_pandas(self, limit: Optional[int] = None):
         import pandas as pd
 

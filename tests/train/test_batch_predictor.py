@@ -54,3 +54,24 @@ def test_torch_predictor_roundtrip():
                                           model=torch.nn.Linear(2, 1))
     out = pred.predict({"data": np.array([[1.0, 2.0]], np.float32)})
     np.testing.assert_allclose(out["predictions"], [[7.0]], rtol=1e-5)
+
+
+def test_iter_torch_batches():
+    import torch
+
+    rows = np.arange(12, dtype=np.float32).reshape(6, 2)
+    ds = rd.from_numpy(rows)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    total = 0
+    for b in batches:
+        t = b["data"] if isinstance(b, dict) else b
+        assert isinstance(t, torch.Tensor)
+        total += t.shape[0]
+    assert total == 6
+
+    # dtype override applies
+    batches = list(ds.iter_torch_batches(
+        batch_size=4, dtypes={"data": torch.float64}))
+    t = batches[0]["data"] if isinstance(batches[0], dict) else batches[0]
+    if isinstance(batches[0], dict):
+        assert t.dtype == torch.float64
