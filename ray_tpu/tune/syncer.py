@@ -39,17 +39,27 @@ class Syncer:
 
 
 class LocalSyncer(Syncer):
-    """Filesystem copy (shutil) — the default."""
+    """Filesystem copy — the default. Tolerant of files vanishing
+    mid-copy: event-triggered syncs run concurrently with atomic
+    experiment-state saves (`*.tmp` + os.replace) and trial checkpoint
+    writes, so individual files may disappear between scandir and copy.
+    A skipped file is fine — the final forced sync (after writes
+    quiesce) captures the complete tree."""
 
     def sync_up(self, local_dir: str, remote_dir: str) -> bool:
         if not os.path.isdir(local_dir):
             return False
-        try:
-            shutil.copytree(local_dir, remote_dir, dirs_exist_ok=True)
-        except FileNotFoundError:
-            # A concurrent experiment-state save os.replace()d a file
-            # mid-copy; the tree is consistent again by now — retry once.
-            shutil.copytree(local_dir, remote_dir, dirs_exist_ok=True)
+        for root, dirs, files in os.walk(local_dir):
+            rel = os.path.relpath(root, local_dir)
+            dst_root = os.path.join(remote_dir, rel) if rel != "." \
+                else remote_dir
+            os.makedirs(dst_root, exist_ok=True)
+            for f in files:
+                try:
+                    shutil.copy2(os.path.join(root, f),
+                                 os.path.join(dst_root, f))
+                except FileNotFoundError:
+                    continue  # vanished mid-copy (atomic replace)
         return True
 
     def sync_down(self, remote_dir: str, local_dir: str) -> bool:
