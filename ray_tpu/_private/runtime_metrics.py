@@ -1,0 +1,113 @@
+"""Built-in runtime metrics (the reference's canonical stats).
+
+Reference: `src/ray/stats/metric_defs.cc` — STATS_tasks / STATS_actors /
+scheduler / object-store gauges exported alongside user metrics. Here
+the same canonical series are computed ON EXPORT from live runtime state
+(task-event buffer, actor registry, memory store, resources), so there's
+no bookkeeping on the hot path; `collect_runtime_metrics()` refreshes
+the gauges and the Prometheus endpoint calls it before rendering.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.util.metrics import Gauge
+
+_gauges: Dict[str, Gauge] = {}
+
+
+def _gauge(name: str, desc: str, tag_keys=()) -> Gauge:
+    g = _gauges.get(name)
+    if g is None:
+        g = _gauges[name] = Gauge(name, desc, tag_keys=tag_keys)
+    return g
+
+
+def collect_runtime_metrics() -> None:
+    """Refresh the canonical runtime gauges from live state. Cheap
+    (reads in-process tables); safe to call on every scrape."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is None:
+        return
+
+    # Tasks by state (reference STATS_tasks).
+    by_state: Dict[str, int] = {}
+    try:
+        for ev in w.task_events.list_events():
+            by_state[ev.state] = by_state.get(ev.state, 0) + 1
+    except Exception:
+        pass
+    g = _gauge("ray_tpu_tasks", "Tasks by state", tag_keys=("state",))
+    for state, n in by_state.items():
+        g.set(float(n), tags={"state": state})
+
+    # Actors by state (reference STATS_actors).
+    try:
+        actors = getattr(w.backend, "_actors", {})
+        a_by_state: Dict[str, int] = {}
+        for actor in list(actors.values()):
+            a_by_state[actor.state] = a_by_state.get(actor.state, 0) + 1
+        g = _gauge("ray_tpu_actors", "Actors by state",
+                   tag_keys=("state",))
+        for state, n in a_by_state.items():
+            g.set(float(n), tags={"state": state})
+    except Exception:
+        pass
+
+    # Object store occupancy (reference object_store_memory stats).
+    try:
+        store = w.memory_store
+        with store._lock:
+            entries = list(store._entries.values())
+        n_objects = len(entries)
+        n_bytes = float(sum(e.size or 0 for e in entries))
+        _gauge("ray_tpu_object_store_objects",
+               "Objects resident in the in-process store").set(
+            float(n_objects))
+        _gauge("ray_tpu_object_store_bytes",
+               "Estimated bytes resident in the in-process store").set(
+            n_bytes)
+        spilled = sum(1 for e in entries if e.spilled_url)
+        _gauge("ray_tpu_object_store_spilled_objects",
+               "Objects currently spilled to external storage").set(
+            float(spilled))
+    except Exception:
+        pass
+
+    # Resource slots (reference scheduler resource gauges).
+    try:
+        res = w.backend.resources
+        from ray_tpu._private.resources import from_milli
+
+        total = from_milli(getattr(res, "total_milli", None) or {}) \
+            if hasattr(res, "total_milli") else dict(res.total)
+        avail = dict(res.available)
+        gt = _gauge("ray_tpu_resources_total", "Total node resources",
+                    tag_keys=("resource",))
+        ga = _gauge("ray_tpu_resources_available",
+                    "Available node resources", tag_keys=("resource",))
+        for k, v in total.items():
+            gt.set(float(v), tags={"resource": k})
+        for k, v in avail.items():
+            ga.set(float(v), tags={"resource": k})
+    except Exception:
+        pass
+
+    # Shared-memory plane stats when installed (plasma stats role).
+    try:
+        plane = getattr(w, "shm_plane", None)
+        if plane is not None:
+            st = plane.store.stats()
+            items = st.items() if isinstance(st, dict) else (
+                (f, getattr(st, f)) for f in dir(st)
+                if not f.startswith("_"))
+            for field, val in items:
+                if isinstance(val, (int, float)):
+                    _gauge(f"ray_tpu_shm_{field}",
+                           f"Shared-memory store {field}").set(
+                        float(val))
+    except Exception:
+        pass

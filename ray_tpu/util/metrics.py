@@ -110,7 +110,16 @@ def _fmt_tags(key: Tuple) -> str:
 
 
 def export_prometheus() -> str:
-    """Prometheus text exposition of every registered metric."""
+    """Prometheus text exposition of every registered metric (canonical
+    runtime gauges refreshed first — `_private/runtime_metrics.py`)."""
+    try:
+        from ray_tpu._private.runtime_metrics import (
+            collect_runtime_metrics,
+        )
+
+        collect_runtime_metrics()
+    except Exception:  # noqa: BLE001 — user metrics still export
+        pass
     lines: List[str] = []
     with _registry_lock:
         metrics = list(_registry.values())

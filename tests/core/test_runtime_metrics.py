@@ -1,0 +1,37 @@
+"""Canonical runtime metrics exported alongside user metrics."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.metrics import export_prometheus
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_runtime_metrics_exported():
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get([f.remote(i) for i in range(5)] + [a.ping.remote()])
+    ray_tpu.put(list(range(100)))
+
+    text = export_prometheus()
+    assert 'ray_tpu_tasks{state="FINISHED"}' in text
+    assert "ray_tpu_actors" in text
+    assert "ray_tpu_object_store_objects" in text
+    assert 'ray_tpu_resources_total{resource="CPU"} 2' in text
+    # Prometheus exposition shape intact for the gauges.
+    assert "# TYPE ray_tpu_tasks gauge" in text
