@@ -1,0 +1,50 @@
+"""Core API tour: tasks, actors, objects, placement groups.
+
+Run: python examples/01_core_api.py
+"""
+import ray_tpu
+
+ray_tpu.init()
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k):
+        self.n += k
+        return self.n
+
+
+# Parallel tasks.
+print("squares:", ray_tpu.get([square.remote(i) for i in range(8)]))
+
+# Objects + nested refs.
+big = ray_tpu.put(list(range(10_000)))
+
+
+@ray_tpu.remote
+def tail(xs, n=3):
+    return xs[-n:]
+
+
+print("tail:", ray_tpu.get(tail.remote(big)))
+
+# Actors (ordered calls) + named actors.
+c = Counter.options(name="demo").remote()
+for _ in range(3):
+    c.add.remote(2)
+print("counter:", ray_tpu.get(ray_tpu.get_actor("demo").add.remote(0)))
+
+# wait() for completion-order consumption.
+refs = [square.remote(i) for i in range(4)]
+ready, rest = ray_tpu.wait(refs, num_returns=2)
+print("first two done:", ray_tpu.get(ready))
+
+ray_tpu.shutdown()

@@ -1,0 +1,32 @@
+"""Data: blocks, transforms, windowed pipelines, device ingest.
+
+Run: python examples/03_data_pipeline.py
+"""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+
+ray_tpu.init()
+
+ds = (rd.range(1000, parallelism=8)
+      .map(lambda r: (r["id"] if isinstance(r, dict) else r))
+      .map(lambda x: {"x": float(x), "y": 2.0 * x})
+      .filter(lambda row: row["x"] % 3 == 0))
+print("rows:", ds.count(), "| first:", ds.take(2))
+print("mean y:", ds.mean("y"))
+
+# Windowed pipeline: bounded memory, per-window shuffle, two epochs.
+pipe = (rd.range(64, parallelism=8)
+        .window(blocks_per_window=2)
+        .random_shuffle_each_window(seed=0)
+        .repeat(2))
+print("pipeline:", pipe.stats(), "| total rows:", pipe.count())
+
+# Torch-tensor ingest (iter_jax_batches is the TPU analog).
+for batch in rd.from_numpy(
+        np.arange(8, dtype=np.float32)).iter_torch_batches(batch_size=4):
+    print("torch batch:", batch)
+    break
+
+ray_tpu.shutdown()

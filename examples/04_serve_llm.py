@@ -1,0 +1,39 @@
+"""Serve: deployments, graphs, and the continuous-batching LLM engine.
+
+Run: python examples/04_serve_llm.py
+"""
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init()
+
+
+@serve.deployment(num_replicas=2)
+class Preprocess:
+    def __call__(self, x):
+        return x * 10
+
+
+@serve.deployment
+class Model:
+    def __init__(self, upstream):
+        self.upstream = upstream
+
+    def __call__(self, x):
+        return ray_tpu.get(self.upstream.remote(x)) + 1
+
+
+# A two-stage deployment graph behind an HTTP route.
+handle = serve.run(Model.bind(Preprocess.bind()), route_prefix="/model")
+print("direct call:", ray_tpu.get(handle.remote(4)))  # 41
+
+proxy = serve.start_http_proxy()
+req = urllib.request.Request(
+    f"http://{proxy.host}:{proxy.port}/model", data=b"4",
+    headers={"Content-Type": "application/json"})
+print("over HTTP:", urllib.request.urlopen(req).read())
+
+serve.shutdown()
+ray_tpu.shutdown()

@@ -1,0 +1,38 @@
+"""Tune sweeps and the RL stack.
+
+Run: python examples/05_tune_and_rl.py
+"""
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.rl import PPOConfig
+
+ray_tpu.init()
+
+# Hyperparameter sweep with ASHA early stopping.
+def objective(config):
+    acc = 0.0
+    for step in range(10):
+        acc += config["lr"] * (1 - acc)
+        tune.report({"acc": acc})
+
+tuner = tune.Tuner(
+    objective,
+    param_space={"lr": tune.grid_search([0.05, 0.1, 0.3])},
+    tune_config=tune.TuneConfig(metric="acc", mode="max",
+                                scheduler=tune.ASHAScheduler()),
+)
+best = tuner.fit().get_best_result("acc", "max")
+print("best lr:", best.config["lr"])
+
+# PPO on the built-in vectorized CartPole.
+algo = (PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=16,
+                  rollout_fragment_length=64)
+        .training(lr=3e-4)).build()
+for i in range(3):
+    r = algo.train()
+    print(f"iter {i}: reward={r.get('episode_reward_mean', 0):.1f}")
+print("greedy eval:", algo.evaluate(num_episodes=2)["evaluation"])
+algo.cleanup()
+ray_tpu.shutdown()

@@ -403,10 +403,16 @@ class Dataset:
         for batch in self.iter_batches(
                 batch_size=batch_size, batch_format="numpy",
                 prefetch_batches=prefetch_batches, drop_last=drop_last):
+            def to_tensor(v):
+                arr = np.asarray(v)
+                if not arr.flags.writeable:
+                    arr = arr.copy()  # arrow-backed views are read-only
+                return torch.as_tensor(arr)
+
             if isinstance(batch, dict):
                 out = {}
                 for k, v in batch.items():
-                    t = torch.as_tensor(np.asarray(v))
+                    t = to_tensor(v)
                     if dtypes and k in dtypes:
                         t = t.to(dtypes[k])
                     if device:
@@ -414,7 +420,7 @@ class Dataset:
                     out[k] = t
                 yield out
             else:
-                t = torch.as_tensor(np.asarray(batch))
+                t = to_tensor(batch)
                 if device:
                     t = t.to(device)
                 yield t
