@@ -188,6 +188,38 @@ def test_driver_reacquire_cancels_deferred_release(cluster):
     assert int(ray_tpu.get(ref_again, timeout=60).sum()) == 2047 * 1024
 
 
+def test_dead_borrower_unblocks_deferred_free(cluster):
+    """A node holding the only borrow dies: its borrower entry must drop
+    so the deferred free finally runs (no leak)."""
+    node_id = cluster.add_node(num_cpus=2)
+    head = cluster.head
+
+    h = _hog(cluster)
+    ref = _produce.remote()
+    holder = _Holder.remote()
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=60)
+    ray_tpu.get(h)
+    oid = ref.binary()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and oid not in head.borrowers:
+        time.sleep(0.1)
+    assert oid in head.borrowers
+
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    assert oid in head.driver_released  # deferred behind the borrow
+
+    cluster.kill_node(node_id)
+    head.mark_node_dead(node_id, reason="chaos")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and (
+            oid in head.borrowers or oid in head.driver_released):
+        time.sleep(0.1)
+    assert oid not in head.borrowers
+    assert oid not in head.driver_released, "free leaked past node death"
+
+
 def test_second_driver_handle_keeps_object(cluster):
     """Two driver handles to one object: dropping one must not release
     cluster-wide (the became-zero gate)."""
