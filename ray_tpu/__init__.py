@@ -31,7 +31,10 @@ from ray_tpu._private.ray_client import (  # noqa: F401
     enable_client_server,
 )
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
-from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.object_ref import (  # noqa: F401
+    ObjectRef,
+    ObjectRefGenerator,
+)
 from ray_tpu.remote_function import RemoteFunction, remote  # noqa: F401
 from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 
@@ -73,6 +76,7 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RemoteFunction",
     "available_resources",
     "cancel",

@@ -140,14 +140,16 @@ class NodeRuntime:
             # actor holding then releasing a ref to an object that lives
             # here) must never evict the only copy; the head's
             # free_objects is what drops it.
-            for roid in spec.return_ids:
+            dynamic = list(getattr(spec, "dynamic_return_ids", ()))
+            for roid in list(spec.return_ids) + dynamic:
                 worker.memory_store.pin_object(roid)
             # Borrow registrations first: the output report unpins this
             # task's args at the head, so any borrow the task created
             # must be on record before that (same head connection →
             # ordered).
             getattr(node, "_flush_borrows", lambda: None)()
-            oids = [oid.binary() for oid in spec.return_ids]
+            oids = [oid.binary()
+                    for oid in list(spec.return_ids) + dynamic]
             if oids:
                 try:
                     node.head.call("report_objects", oids=oids,

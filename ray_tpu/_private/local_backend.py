@@ -445,6 +445,34 @@ class LocalBackend:
             ctx.pop()
 
     def _split_returns(self, spec: TaskSpec, result: Any) -> list:
+        if spec.num_returns == "dynamic":
+            # Generator task (reference num_returns="dynamic"): each
+            # yielded value becomes its own object at return indices
+            # 1..k (index 0 is the generator ref itself); the task's
+            # single return value is an ObjectRefGenerator over them.
+            # Yielded objects are recorded on the spec so the cluster
+            # report hook advertises their locations too.
+            from ray_tpu._private.ids import ObjectID
+            from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
+
+            if not hasattr(result, "__iter__"):
+                raise ValueError(
+                    f"task {spec.describe()} declared "
+                    "num_returns='dynamic' but returned non-iterable "
+                    f"{type(result).__name__}")
+            refs = []
+            dynamic_ids = []
+            for i, value in enumerate(result):
+                oid = ObjectID.for_task_return(spec.task_id, i + 1)
+                self.worker.memory_store.put(oid, value)
+                if self.worker.shm_plane is not None:
+                    from ray_tpu._private.shm_plane import share_value
+
+                    share_value(self.worker, oid, value)
+                dynamic_ids.append(oid)
+                refs.append(ObjectRef(oid))
+            spec.dynamic_return_ids = dynamic_ids
+            return [ObjectRefGenerator(refs)]
         if spec.num_returns == 1:
             return [result]
         if spec.num_returns == 0:

@@ -86,7 +86,7 @@ class TaskSpec:
     args: tuple
     kwargs: dict
     name: str = ""
-    num_returns: int = 1
+    num_returns: "int | str" = 1  # int, or "dynamic" (generator task)
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 3
     retry_exceptions: Any = False  # False | True | list of exception types

@@ -172,10 +172,12 @@ class Worker:
 
     def submit(self, spec: TaskSpec) -> list[ObjectRef]:
         # num_returns=0: no return objects at all (call is fire-and-forget).
+        # num_returns="dynamic": ONE ref whose value is an
+        # ObjectRefGenerator over the task's yielded outputs.
         # Actor creations always carry one status object (index 0).
         from ray_tpu._private.task_spec import TaskKind
 
-        n = spec.num_returns
+        n = 1 if spec.num_returns == "dynamic" else spec.num_returns
         if spec.kind == TaskKind.ACTOR_CREATION:
             n = max(n, 1)
         spec.return_ids = [

@@ -92,3 +92,27 @@ class ObjectRef:
                     w.unregister_object_ref(self._id)
             except Exception:  # interpreter shutdown
                 pass
+
+
+class ObjectRefGenerator:
+    """The value of a ``num_returns="dynamic"`` task: an iterable of the
+    ObjectRefs created for the task's yielded outputs (reference:
+    ``ray._raylet.ObjectRefGenerator``). Holding the generator (or any
+    ref from it) keeps the corresponding objects alive."""
+
+    __slots__ = ("_refs",)
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+    def __getitem__(self, i) -> "ObjectRef":
+        return self._refs[i]
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator({len(self._refs)} refs)"

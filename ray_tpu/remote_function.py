@@ -90,8 +90,8 @@ class RemoteFunction:
         refs = w.submit(spec)
         if num_returns == 0:
             return None
-        if num_returns == 1:
-            return refs[0]
+        if num_returns == 1 or num_returns == "dynamic":
+            return refs[0]  # dynamic: the ObjectRefGenerator's ref
         return refs
 
 

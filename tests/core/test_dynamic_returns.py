@@ -1,0 +1,89 @@
+"""num_returns='dynamic' generator tasks (reference dynamic generators)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_dynamic_generator_basic():
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    gen_ref = gen.remote(5)
+    assert isinstance(gen_ref, ray_tpu.ObjectRef)
+    refs = ray_tpu.get(gen_ref)
+    assert isinstance(refs, ray_tpu.ObjectRefGenerator)
+    assert len(refs) == 5
+    assert ray_tpu.get(list(refs)) == [0, 1, 4, 9, 16]
+    assert ray_tpu.get(refs[2]) == 4
+
+
+def test_dynamic_generator_empty_and_list():
+    @ray_tpu.remote(num_returns="dynamic")
+    def empty():
+        return iter(())
+
+    assert len(ray_tpu.get(empty.remote())) == 0
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def from_list():
+        return [np.arange(3), np.arange(4)]
+
+    refs = ray_tpu.get(from_list.remote())
+    arrs = ray_tpu.get(list(refs))
+    assert [len(a) for a in arrs] == [3, 4]
+
+
+def test_dynamic_non_iterable_errors():
+    @ray_tpu.remote(num_returns="dynamic")
+    def bad():
+        return 7
+
+    with pytest.raises(Exception, match="non-iterable"):
+        ray_tpu.get(bad.remote())
+
+
+def test_dynamic_refs_flow_into_downstream_tasks():
+    @ray_tpu.remote(num_returns="dynamic")
+    def produce():
+        for i in range(3):
+            yield i + 10
+
+    @ray_tpu.remote
+    def total(xs):
+        return sum(xs)
+
+    refs = ray_tpu.get(produce.remote())
+    assert ray_tpu.get(total.remote(list(ray_tpu.get(list(refs))))) == 33
+
+
+def test_dynamic_generator_cluster_mode():
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=2, num_returns="dynamic")
+        def gen(n):
+            for i in range(n):
+                yield np.full(100, i)
+
+        refs = ray_tpu.get(gen.remote(4), timeout=60)
+        assert len(refs) == 4
+        vals = ray_tpu.get(list(refs), timeout=60)
+        assert [int(v[0]) for v in vals] == [0, 1, 2, 3]
+    finally:
+        cluster.shutdown()
