@@ -5,3 +5,7 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
     SchedulingStrategy,
     SpreadSchedulingStrategy,
 )
+from ray_tpu.util.serialization import (  # noqa: F401
+    deregister_serializer,
+    register_serializer,
+)
