@@ -462,15 +462,32 @@ class LocalBackend:
                     f"{type(result).__name__}")
             refs = []
             dynamic_ids = []
-            for i, value in enumerate(result):
-                oid = ObjectID.for_task_return(spec.task_id, i + 1)
-                self.worker.memory_store.put(oid, value)
-                if self.worker.shm_plane is not None:
-                    from ray_tpu._private.shm_plane import share_value
+            try:
+                for i, value in enumerate(result):
+                    oid = ObjectID.for_task_return(spec.task_id, i + 1)
+                    self.worker.memory_store.put(oid, value)
+                    if self.worker.shm_plane is not None:
+                        from ray_tpu._private.shm_plane import (
+                            share_value,
+                        )
 
-                    share_value(self.worker, oid, value)
-                dynamic_ids.append(oid)
-                refs.append(ObjectRef(oid))
+                        share_value(self.worker, oid, value)
+                    dynamic_ids.append(oid)
+                    refs.append(ObjectRef(oid))
+            except BaseException:
+                # Mid-iteration failure: drop the partial puts — no ref
+                # will ever exist for them, so leaving them would leak
+                # store/shm memory proportional to what was yielded.
+                refs.clear()  # handles unregister before eviction
+                self.worker.memory_store.evict(dynamic_ids)
+                plane = self.worker.shm_plane
+                if plane is not None:
+                    for oid in dynamic_ids:
+                        try:
+                            plane.release(oid)
+                        except Exception:
+                            pass
+                raise
             spec.dynamic_return_ids = dynamic_ids
             return [ObjectRefGenerator(refs)]
         if spec.num_returns == 1:

@@ -15,6 +15,7 @@ from typing import Dict, Optional
 from ray_tpu.util.metrics import Gauge
 
 _gauges: Dict[str, Gauge] = {}
+_prev_tags: Dict[str, set] = {}
 
 
 def _gauge(name: str, desc: str, tag_keys=()) -> Gauge:
@@ -22,6 +23,21 @@ def _gauge(name: str, desc: str, tag_keys=()) -> Gauge:
     if g is None:
         g = _gauges[name] = Gauge(name, desc, tag_keys=tag_keys)
     return g
+
+
+def _set_series(name: str, desc: str, tag_key: str,
+                values: Dict[str, float]) -> None:
+    """Set a tagged gauge from a fresh snapshot, zeroing series whose
+    tag vanished (a state with no members must read 0, not its last
+    nonzero value — and a fresh session must not export the previous
+    cluster's counts)."""
+    g = _gauge(name, desc, tag_keys=(tag_key,))
+    current = set(values)
+    for stale in _prev_tags.get(name, set()) - current:
+        g.set(0.0, tags={tag_key: stale})
+    for tag, v in values.items():
+        g.set(float(v), tags={tag_key: tag})
+    _prev_tags[name] = current
 
 
 def collect_runtime_metrics() -> None:
@@ -34,26 +50,22 @@ def collect_runtime_metrics() -> None:
         return
 
     # Tasks by state (reference STATS_tasks).
-    by_state: Dict[str, int] = {}
+    by_state: Dict[str, float] = {}
     try:
         for ev in w.task_events.list_events():
             by_state[ev.state] = by_state.get(ev.state, 0) + 1
     except Exception:
         pass
-    g = _gauge("ray_tpu_tasks", "Tasks by state", tag_keys=("state",))
-    for state, n in by_state.items():
-        g.set(float(n), tags={"state": state})
+    _set_series("ray_tpu_tasks", "Tasks by state", "state", by_state)
 
     # Actors by state (reference STATS_actors).
     try:
         actors = getattr(w.backend, "_actors", {})
-        a_by_state: Dict[str, int] = {}
+        a_by_state: Dict[str, float] = {}
         for actor in list(actors.values()):
             a_by_state[actor.state] = a_by_state.get(actor.state, 0) + 1
-        g = _gauge("ray_tpu_actors", "Actors by state",
-                   tag_keys=("state",))
-        for state, n in a_by_state.items():
-            g.set(float(n), tags={"state": state})
+        _set_series("ray_tpu_actors", "Actors by state", "state",
+                    a_by_state)
     except Exception:
         pass
 
@@ -80,19 +92,11 @@ def collect_runtime_metrics() -> None:
     # Resource slots (reference scheduler resource gauges).
     try:
         res = w.backend.resources
-        from ray_tpu._private.resources import from_milli
-
-        total = from_milli(getattr(res, "total_milli", None) or {}) \
-            if hasattr(res, "total_milli") else dict(res.total)
-        avail = dict(res.available)
-        gt = _gauge("ray_tpu_resources_total", "Total node resources",
-                    tag_keys=("resource",))
-        ga = _gauge("ray_tpu_resources_available",
-                    "Available node resources", tag_keys=("resource",))
-        for k, v in total.items():
-            gt.set(float(v), tags={"resource": k})
-        for k, v in avail.items():
-            ga.set(float(v), tags={"resource": k})
+        _set_series("ray_tpu_resources_total", "Total node resources",
+                    "resource", dict(res.total))
+        _set_series("ray_tpu_resources_available",
+                    "Available node resources", "resource",
+                    dict(res.available))
     except Exception:
         pass
 

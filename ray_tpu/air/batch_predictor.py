@@ -30,6 +30,16 @@ class Predictor:
         raise NotImplementedError
 
 
+def _unwrap_batch(batch):
+    """dict batch → its "data" column (or sole column); else as-is."""
+    if isinstance(batch, dict):
+        arr = batch.get("data")
+        if arr is None:
+            arr = next(iter(batch.values()))
+        return arr
+    return batch
+
+
 class JaxPredictor(Predictor):
     """Runs a jitted apply_fn(params, batch_array) (reference
     TorchPredictor's role for the JAX stack)."""
@@ -50,12 +60,7 @@ class JaxPredictor(Predictor):
     def predict(self, batch):
         import jax.numpy as jnp
 
-        if isinstance(batch, dict):
-            arr = batch.get("data")
-            if arr is None:  # single-feature-column fallback
-                arr = next(iter(batch.values()))
-        else:
-            arr = batch
+        arr = _unwrap_batch(batch)
         out = self.apply_fn(self.params, jnp.asarray(np.asarray(arr)))
         return {"predictions": np.asarray(out)}
 
@@ -80,12 +85,7 @@ class TorchPredictor(Predictor):
     def predict(self, batch):
         import torch
 
-        if isinstance(batch, dict):
-            arr = batch.get("data")
-            if arr is None:
-                arr = next(iter(batch.values()))
-        else:
-            arr = batch
+        arr = _unwrap_batch(batch)
         with torch.no_grad():
             out = self.model(torch.as_tensor(np.asarray(arr)))
         return {"predictions": out.numpy()}

@@ -403,27 +403,26 @@ class Dataset:
         for batch in self.iter_batches(
                 batch_size=batch_size, batch_format="numpy",
                 prefetch_batches=prefetch_batches, drop_last=drop_last):
-            def to_tensor(v):
+            def to_tensor(v, col=None):
                 arr = np.asarray(v)
                 if not arr.flags.writeable:
                     arr = arr.copy()  # arrow-backed views are read-only
-                return torch.as_tensor(arr)
-
-            if isinstance(batch, dict):
-                out = {}
-                for k, v in batch.items():
-                    t = to_tensor(v)
-                    if dtypes and k in dtypes:
-                        t = t.to(dtypes[k])
-                    if device:
-                        t = t.to(device)
-                    out[k] = t
-                yield out
-            else:
-                t = to_tensor(batch)
+                t = torch.as_tensor(arr)
+                # dtypes: a single torch.dtype for every column/array,
+                # or {column: dtype} for dict batches.
+                if isinstance(dtypes, dict):
+                    if col is not None and col in dtypes:
+                        t = t.to(dtypes[col])
+                elif dtypes is not None:
+                    t = t.to(dtypes)
                 if device:
                     t = t.to(device)
-                yield t
+                return t
+
+            if isinstance(batch, dict):
+                yield {k: to_tensor(v, k) for k, v in batch.items()}
+            else:
+                yield to_tensor(batch)
 
     def to_pandas(self, limit: Optional[int] = None):
         import pandas as pd

@@ -17,7 +17,17 @@ from typing import Any, Callable, Dict, Tuple
 _REGISTRY: Dict[type, Tuple[Callable, Callable]] = {}
 
 
-def _reconstruct(deserializer: Callable, payload: Any):
+def _reconstruct(cls: type, serializer: Callable, deserializer: Callable,
+                 payload: Any):
+    # Self-propagating: deserializing an instance in another process
+    # (cluster node, spawned worker) installs the serializer THERE too,
+    # so that process can send instances onward / back. A process that
+    # creates instances without ever receiving one must call
+    # register_serializer itself (e.g. at module import in the task's
+    # code), same as the reference.
+    if cls not in _REGISTRY:
+        register_serializer(cls, serializer=serializer,
+                            deserializer=deserializer)
     return deserializer(payload)
 
 
@@ -29,7 +39,8 @@ def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
         raise TypeError(f"cls must be a class, got {cls!r}")
 
     def reduce_fn(obj):
-        return (_reconstruct, (deserializer, serializer(obj)))
+        return (_reconstruct,
+                (cls, serializer, deserializer, serializer(obj)))
 
     _REGISTRY[cls] = (serializer, deserializer)
     copyreg.pickle(cls, reduce_fn)

@@ -87,3 +87,24 @@ def test_dynamic_generator_cluster_mode():
         assert [int(v[0]) for v in vals] == [0, 1, 2, 3]
     finally:
         cluster.shutdown()
+
+
+def test_dynamic_generator_midstream_failure_frees_partials():
+    import ray_tpu._private.worker as wm
+
+    @ray_tpu.remote(num_returns="dynamic", max_retries=0)
+    def flaky():
+        yield 1
+        yield 2
+        raise RuntimeError("mid-stream")
+
+    with pytest.raises(Exception, match="mid-stream"):
+        ray_tpu.get(flaky.remote())
+    # The two yielded objects must not linger in the store.
+    w = wm.global_worker()
+    import gc
+
+    gc.collect()
+    leftovers = [e for e in w.memory_store._entries.values()
+                 if e.ready and e.value in (1, 2)]
+    assert not leftovers

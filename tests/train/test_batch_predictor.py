@@ -69,9 +69,14 @@ def test_iter_torch_batches():
         total += t.shape[0]
     assert total == 6
 
-    # dtype override applies
+    # dtype override applies: per-column dict AND single dtype for
+    # bare-array batches.
     batches = list(ds.iter_torch_batches(
         batch_size=4, dtypes={"data": torch.float64}))
     t = batches[0]["data"] if isinstance(batches[0], dict) else batches[0]
     if isinstance(batches[0], dict):
         assert t.dtype == torch.float64
+    batches = list(ds.iter_torch_batches(batch_size=4,
+                                         dtypes=torch.float64))
+    t = batches[0]["data"] if isinstance(batches[0], dict) else batches[0]
+    assert t.dtype == torch.float64
