@@ -96,7 +96,8 @@ class ActorHandle:
                           if (_ctx := w.task_context.current()) else None),
         )
         refs = w.submit(spec)
-        return refs[0] if num_returns == 1 else refs
+        # dynamic: the single ref resolves to an ObjectRefGenerator
+        return refs[0] if num_returns in (1, "dynamic") else refs
 
     def __repr__(self):
         return f"ActorHandle({self._cls.__name__}, {self._actor_id.hex()[:8]})"

@@ -108,3 +108,16 @@ def test_dynamic_generator_midstream_failure_frees_partials():
     leftovers = [e for e in w.memory_store._entries.values()
                  if e.ready and e.value in (1, 2)]
     assert not leftovers
+
+
+def test_dynamic_generator_actor_method():
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i * 3
+
+    g = Gen.remote()
+    refs = ray_tpu.get(g.stream.options(num_returns="dynamic").remote(4))
+    assert isinstance(refs, ray_tpu.ObjectRefGenerator)
+    assert ray_tpu.get(list(refs)) == [0, 3, 6, 9]
