@@ -255,6 +255,12 @@ def init(
         if address is not None:
             from ray_tpu._private.ray_client import ClientWorker
 
+            if address == "auto":
+                address = os.environ.get("RAY_TPU_ADDRESS")
+                if not address:
+                    raise ValueError(
+                        'init(address="auto") requires RAY_TPU_ADDRESS='
+                        '"host:port" in the environment')
             if isinstance(address, str):
                 host, _, port = address.rpartition(":")
                 address = (host or "127.0.0.1", int(port))
