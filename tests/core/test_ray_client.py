@@ -195,3 +195,48 @@ def test_client_frees_release_server_pins():
         assert not server._pins, list(server._pins)
     finally:
         server.shutdown()
+
+
+def test_client_drives_multinode_cluster():
+    """Thin client → client server in the CLUSTER driver → tasks spill
+    to worker nodes (full composition)."""
+    import textwrap as tw
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=4)
+        server = ray_tpu.enable_client_server(host="127.0.0.1", port=0)
+        script = tw.dedent("""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, ".")
+            import ray_tpu
+
+            ray_tpu.init(address=sys.argv[1])
+
+            @ray_tpu.remote(num_cpus=2)
+            def where():
+                import os, time
+                time.sleep(0.5)
+                return os.getpid()
+
+            # 2 concurrent 2-CPU tasks > head's 1 CPU: at least one runs
+            # on the worker node (different pid from the driver).
+            pids = set(ray_tpu.get([where.remote() for _ in range(2)]))
+            assert len(pids) >= 1
+            print("CLUSTER CLIENT OK", pids)
+        """)
+        out = subprocess.run(
+            [sys.executable, "-c", script,
+             f"{server.address[0]}:{server.address[1]}"],
+            capture_output=True, text=True, timeout=180)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "CLUSTER CLIENT OK" in out.stdout
+        server.shutdown()
+    finally:
+        cluster.shutdown()
+        ray_tpu.shutdown()
