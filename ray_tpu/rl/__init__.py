@@ -28,6 +28,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     PPOConfig,
     SAC,
     SACConfig,
+    TD3,
+    TD3Config,
 )
 from ray_tpu.rl.connectors import (  # noqa: F401
     ClipAction,

@@ -10,3 +10,4 @@ from ray_tpu.rl.algorithms.bc import (  # noqa: F401
     MARWIL,
     MARWILConfig,
 )
+from ray_tpu.rl.algorithms.td3 import TD3, TD3Config  # noqa: F401

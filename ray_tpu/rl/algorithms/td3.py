@@ -1,0 +1,196 @@
+"""TD3: twin-delayed deterministic policy gradient.
+
+Reference: `rllib/algorithms/td3/` (DDPG family) — deterministic actor,
+twin Q critics with clipped-double-Q targets, target policy smoothing
+(clipped Gaussian noise on the target action), and delayed actor/target
+updates. Shares SAC's replay/rollout shape; exploration is Gaussian
+noise on the deterministic action (the worker's tanh-Gaussian sampler
+with a fixed exploration sigma)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    TERMINATEDS,
+)
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(TD3)
+        self.buffer_size = 100_000
+        self.learning_starts = 256
+        self.train_batch_size = 256
+        self.tau = 0.005
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.policy_delay = 2          # actor updates every N critic steps
+        self.target_noise = 0.2        # target policy smoothing sigma
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1   # behaviour-policy sigma
+        self.num_sgd_per_iter = 64
+        self.num_rollout_workers = 1
+        self.rollout_fragment_length = 64
+
+
+class TD3(Algorithm):
+    config_cls = TD3Config
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(np.prod(env.action_space.shape))
+        k_pi, k_q = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.params = {
+            "actor": models.gaussian_policy_init(k_pi, obs_dim, act_dim),
+            "critic": models.q_sa_init(k_q, obs_dim, act_dim),
+        }
+        self.target = jax.tree.map(jnp.copy, self.params)
+        self.tx = {"actor": optax.adam(cfg.actor_lr),
+                   "critic": optax.adam(cfg.critic_lr)}
+        self.opt_state = {
+            "actor": self.tx["actor"].init(self.params["actor"]),
+            "critic": self.tx["critic"].init(self.params["critic"]),
+        }
+        self.buffer = ReplayBuffer(cfg.buffer_size)
+        sigma = float(cfg.exploration_noise)
+
+        # Deterministic actor + fixed exploration sigma, expressed in the
+        # worker's gaussian sampler (mean=tanh^-1 target, log_std=const).
+        def behaviour(actor, obs):
+            mean, _ = models.gaussian_policy_apply(actor, obs)
+            log_std = jnp.full_like(mean, np.log(max(sigma, 1e-6)))
+            return mean, log_std
+
+        self.workers = WorkerSet(cfg, behaviour, policy_kind="gaussian")
+        self._update = jax.jit(functools.partial(
+            _td3_update_scan, tx=self.tx, gamma=cfg.gamma, tau=cfg.tau,
+            policy_delay=cfg.policy_delay,
+            target_noise=cfg.target_noise,
+            noise_clip=cfg.target_noise_clip))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batches = self.workers.sample(self.params["actor"])
+        flat = []
+        for b in batches:
+            n, t = np.asarray(b[REWARDS]).shape
+            flat.append(SampleBatch({
+                k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
+                for k, v in b.items()
+            }))
+        batch = SampleBatch.concat(flat)
+        self.buffer.add(batch)
+
+        stats = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            mbs = [self.buffer.sample(cfg.train_batch_size)
+                   for _ in range(cfg.num_sgd_per_iter)]
+            stacked = {
+                k: jnp.asarray(np.stack([np.asarray(mb[k]) for mb in mbs]))
+                for k in (OBS, ACTIONS, REWARDS, TERMINATEDS, NEXT_OBS)
+            }
+            (self.params, self.target, self.opt_state, stats) = \
+                self._update(self.params, self.target, self.opt_state,
+                             stacked,
+                             jax.random.PRNGKey(
+                                 cfg.seed + self.training_iteration))
+            stats = {k: float(v) for k, v in stats.items()}
+        return {
+            **stats,
+            "buffer_size": len(self.buffer),
+            "num_env_steps_sampled_this_iter": batch.count,
+        }
+
+    def get_weights(self):
+        return {"params": self.params, "target": self.target}
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target = jax.tree.map(jnp.asarray, weights["target"])
+
+
+def _td3_update_scan(params, target, opt_state, stacked, rng, *, tx,
+                     gamma, tau, policy_delay, target_noise, noise_clip):
+    n_steps = stacked[OBS].shape[0]
+
+    def one_step(carry, inp):
+        params, target, opt_state, step_i = carry
+        mb, step_rng = inp
+
+        # Clipped-double-Q target with target-policy smoothing.
+        t_mean, _ = models.gaussian_policy_apply(target["actor"],
+                                                 mb[NEXT_OBS])
+        noise = jnp.clip(
+            target_noise * jax.random.normal(step_rng, t_mean.shape),
+            -noise_clip, noise_clip)
+        a_next = jnp.clip(jnp.tanh(t_mean) + noise, -1.0, 1.0)
+        q1_t, q2_t = models.q_sa_apply(target["critic"], mb[NEXT_OBS],
+                                       a_next)
+        backup = mb[REWARDS] + gamma * (
+            1.0 - mb[TERMINATEDS].astype(jnp.float32)
+        ) * jnp.minimum(q1_t, q2_t)
+        backup = jax.lax.stop_gradient(backup)
+
+        def critic_loss_fn(critic):
+            q1, q2 = models.q_sa_apply(critic, mb[OBS], mb[ACTIONS])
+            return ((q1 - backup) ** 2 + (q2 - backup) ** 2).mean()
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic"])
+        upd, opt_c = tx["critic"].update(c_grads, opt_state["critic"],
+                                         params["critic"])
+        params = {**params,
+                  "critic": optax.apply_updates(params["critic"], upd)}
+
+        # Delayed deterministic actor update: maximize Q1(s, pi(s)).
+        def actor_loss_fn(actor):
+            mean, _ = models.gaussian_policy_apply(actor, mb[OBS])
+            q1, _ = models.q_sa_apply(params["critic"], mb[OBS],
+                                      jnp.tanh(mean))
+            return -q1.mean()
+
+        a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
+            params["actor"])
+        do_actor = (step_i % policy_delay) == 0
+        upd, opt_a = tx["actor"].update(a_grads, opt_state["actor"],
+                                        params["actor"])
+        new_actor = optax.apply_updates(params["actor"], upd)
+        actor = jax.tree.map(
+            lambda new, old: jnp.where(do_actor, new, old),
+            new_actor, params["actor"])
+        params = {**params, "actor": actor}
+
+        target_new = jax.tree.map(
+            lambda t, o: (1.0 - tau) * t + tau * o, target, params)
+        target = jax.tree.map(
+            lambda new, old: jnp.where(do_actor, new, old),
+            target_new, target)
+        opt_state = {"critic": opt_c, "actor": opt_a}
+        stats = {"critic_loss": c_loss, "actor_loss": a_loss}
+        return (params, target, opt_state, step_i + 1), stats
+
+    rngs = jax.random.split(rng, n_steps)
+    (params, target, opt_state, _), stats = jax.lax.scan(
+        one_step, (params, target, opt_state, jnp.int32(0)),
+        (stacked, rngs))
+    return (params, target, opt_state,
+            jax.tree.map(lambda x: x[-1], stats))
