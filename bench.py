@@ -105,8 +105,18 @@ def _measure_llama_train_step():
     envelope_util = None
     if on_tpu:
         mfu = per_chip * flops_per_token / 197e12
-        envelope_step_s = 0.650
-        envelope_util = envelope_step_s / dt
+        # Floor comes from the calibration artifact so recalibration and
+        # reporting can't drift apart (absent key → no utilization).
+        try:
+            with open(os.path.join(os.path.dirname(__file__),
+                                   "BENCH_CALIBRATION.json")) as f:
+                floors = json.load(f).get("practical_step_floor_s", {})
+            envelope_step_s = floors.get(
+                "llama-1.24B_b4_s2048_remat-gate")
+            if envelope_step_s:
+                envelope_util = envelope_step_s / dt
+        except (OSError, ValueError):
+            pass
 
     return {
         "config": f"llama-{cfg.num_params() / 1e9:.2f}B" if on_tpu

@@ -22,6 +22,7 @@ from ray_tpu.rl.env import make_env
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
+    flatten_fragments,
 )
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
@@ -93,14 +94,7 @@ class DQN(Algorithm):
         cfg = self.algo_config
         eps = self._epsilon()
         batches = self.workers.sample((self.params, jnp.float32(eps)))
-        flat = []
-        for b in batches:
-            n, t = np.asarray(b[REWARDS]).shape
-            flat.append(SampleBatch({
-                k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
-                for k, v in b.items()
-            }))
-        batch = SampleBatch.concat(flat)
+        batch = flatten_fragments(batches)
         self.buffer.add(batch)
         self._steps_sampled += batch.count
         self._steps_since_target += batch.count

@@ -22,7 +22,8 @@ import optax
 from ray_tpu.rl import models
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.env import make_env
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import (ReplayBuffer, flatten_fragments,
+                                      sample_stacked)
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
     NEXT_OBS,
@@ -90,26 +91,16 @@ class SAC(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
         batches = self.workers.sample(self.params["actor"])
-        flat = []
-        for b in batches:
-            n, t = np.asarray(b[REWARDS]).shape
-            flat.append(SampleBatch({
-                k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
-                for k, v in b.items()
-            }))
-        batch = SampleBatch.concat(flat)
+        batch = flatten_fragments(batches)
         self.buffer.add(batch)
 
         stats = {}
         if len(self.buffer) >= cfg.learning_starts:
-            # Sample all minibatches for the iteration up front, stack,
-            # and run the SGD phase as one jit dispatch.
-            mbs = [self.buffer.sample(cfg.train_batch_size)
-                   for _ in range(cfg.num_sgd_per_iter)]
-            stacked = {
-                k: jnp.asarray(np.stack([np.asarray(mb[k]) for mb in mbs]))
-                for k in (OBS, ACTIONS, REWARDS, TERMINATEDS, NEXT_OBS)
-            }
+            # All minibatches staged up front; the SGD phase is one
+            # scan-fused jit dispatch.
+            stacked = sample_stacked(
+                self.buffer, cfg.num_sgd_per_iter, cfg.train_batch_size,
+                (OBS, ACTIONS, REWARDS, TERMINATEDS, NEXT_OBS))
             (self.params, self.target_critic, self.opt_state,
              stats) = self._update(
                 self.params, self.target_critic, self.opt_state, stacked,

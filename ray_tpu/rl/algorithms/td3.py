@@ -21,7 +21,8 @@ import optax
 from ray_tpu.rl import models
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.env import make_env
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import (ReplayBuffer, flatten_fragments,
+                                      sample_stacked)
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
     NEXT_OBS,
@@ -90,24 +91,14 @@ class TD3(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
         batches = self.workers.sample(self.params["actor"])
-        flat = []
-        for b in batches:
-            n, t = np.asarray(b[REWARDS]).shape
-            flat.append(SampleBatch({
-                k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
-                for k, v in b.items()
-            }))
-        batch = SampleBatch.concat(flat)
+        batch = flatten_fragments(batches)
         self.buffer.add(batch)
 
         stats = {}
         if len(self.buffer) >= cfg.learning_starts:
-            mbs = [self.buffer.sample(cfg.train_batch_size)
-                   for _ in range(cfg.num_sgd_per_iter)]
-            stacked = {
-                k: jnp.asarray(np.stack([np.asarray(mb[k]) for mb in mbs]))
-                for k in (OBS, ACTIONS, REWARDS, TERMINATEDS, NEXT_OBS)
-            }
+            stacked = sample_stacked(
+                self.buffer, cfg.num_sgd_per_iter, cfg.train_batch_size,
+                (OBS, ACTIONS, REWARDS, TERMINATEDS, NEXT_OBS))
             (self.params, self.target, self.opt_state, stats) = \
                 self._update(self.params, self.target, self.opt_state,
                              stacked,
@@ -171,12 +162,18 @@ def _td3_update_scan(params, target, opt_state, stacked, rng, *, tx,
         a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
             params["actor"])
         do_actor = (step_i % policy_delay) == 0
-        upd, opt_a = tx["actor"].update(a_grads, opt_state["actor"],
-                                        params["actor"])
+        upd, opt_a_new = tx["actor"].update(a_grads, opt_state["actor"],
+                                            params["actor"])
         new_actor = optax.apply_updates(params["actor"], upd)
         actor = jax.tree.map(
             lambda new, old: jnp.where(do_actor, new, old),
             new_actor, params["actor"])
+        # Optimizer state must freeze on skipped steps too: otherwise
+        # Adam's moments/step-count absorb gradients from updates that
+        # were never applied and the delay degrades to averaging.
+        opt_a = jax.tree.map(
+            lambda new, old: jnp.where(do_actor, new, old),
+            opt_a_new, opt_state["actor"])
         params = {**params, "actor": actor}
 
         target_new = jax.tree.map(

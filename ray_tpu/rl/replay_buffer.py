@@ -1,4 +1,4 @@
-"""Replay buffers (reference `rllib/utils/replay_buffers/`)."""
+"""Replay helpers + buffers (reference `rllib/utils/replay_buffers/`)."""
 
 from __future__ import annotations
 
@@ -100,3 +100,32 @@ class ReservoirReplayBuffer(ReplayBuffer):
                 for k, v in arrays.items():
                     self._storage[k][j] = v[i]
         self._seen += n
+
+
+def flatten_fragments(batches) -> SampleBatch:
+    """[N, T, ...] rollout fragments (one per worker) → one flat
+    [sum(N*T), ...] SampleBatch. Shared by the off-policy algorithms'
+    replay ingestion (DQN/SAC/TD3) — keep the reshape in ONE place."""
+    from ray_tpu.rl.sample_batch import REWARDS
+
+    flat = []
+    for b in batches:
+        n, t = np.asarray(b[REWARDS]).shape
+        flat.append(SampleBatch({
+            k: np.asarray(v).reshape(n * t, *np.asarray(v).shape[2:])
+            for k, v in b.items()
+        }))
+    return SampleBatch.concat(flat)
+
+
+def sample_stacked(buffer: "ReplayBuffer", n_steps: int,
+                   batch_size: int, keys) -> dict:
+    """Draw n_steps minibatches and stack them [n_steps, batch, ...] for
+    a scan-fused SGD phase (one jit dispatch per training iteration)."""
+    import jax.numpy as jnp
+
+    mbs = [buffer.sample(batch_size) for _ in range(n_steps)]
+    return {
+        k: jnp.asarray(np.stack([np.asarray(mb[k]) for mb in mbs]))
+        for k in keys
+    }

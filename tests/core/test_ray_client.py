@@ -224,15 +224,20 @@ def test_client_drives_multinode_cluster():
                 time.sleep(0.5)
                 return os.getpid()
 
-            # 2 concurrent 2-CPU tasks > head's 1 CPU: at least one runs
-            # on the worker node (different pid from the driver).
+            # 2 concurrent 2-CPU tasks > head's 1 CPU: neither fits the
+            # head, so both must run in the worker NODE's process — not
+            # in the driver/server process (pid passed as argv[2]).
+            driver_pid = int(sys.argv[2])
             pids = set(ray_tpu.get([where.remote() for _ in range(2)]))
-            assert len(pids) >= 1
+            assert driver_pid not in pids, (driver_pid, pids)
             print("CLUSTER CLIENT OK", pids)
         """)
+        import os as _os
+
         out = subprocess.run(
             [sys.executable, "-c", script,
-             f"{server.address[0]}:{server.address[1]}"],
+             f"{server.address[0]}:{server.address[1]}",
+             str(_os.getpid())],
             capture_output=True, text=True, timeout=180)
         assert out.returncode == 0, (out.stdout, out.stderr)
         assert "CLUSTER CLIENT OK" in out.stdout
