@@ -90,7 +90,17 @@ class ClientServer:
 
         refs = [ObjectRef(ObjectID(o)) for o in oids]
         try:
-            return {"values": self._worker.get_objects(refs, timeout)}
+            values = self._worker.get_objects(refs, timeout)
+            # A generator value carries refs the client will now hold
+            # handles to; pin them so a client-side free of the generator
+            # alone can't drop yielded objects the client still uses.
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            for v in values:
+                if isinstance(v, ObjectRefGenerator):
+                    for r in v:
+                        self._pin(r.binary())
+            return {"values": values}
         except exc.GetTimeoutError:
             # Slice timeout: the client long-polls in bounded slices (a
             # single blocking RPC would trip the socket timeout on slow
@@ -305,15 +315,12 @@ class ClientWorker:
     # -- task API --------------------------------------------------------
 
     def submit(self, spec):
-        from ray_tpu._private.task_spec import TaskKind
         from ray_tpu.object_ref import ObjectRef
 
-        n = spec.num_returns
-        if spec.kind == TaskKind.ACTOR_CREATION:
-            n = max(n, 1)
-        spec.return_ids = [ObjectID.for_task_return(spec.task_id, i)
-                           for i in range(n)]
-        refs = [ObjectRef(oid) for oid in spec.return_ids]
+        # Shared return-id semantics live on TaskSpec (dynamic → one
+        # generator ref; the server pins the yielded refs when it ships
+        # the generator back, see ClientServer._get).
+        refs = [ObjectRef(oid) for oid in spec.assign_return_ids()]
         self.backend.submit(spec)
         return refs
 

@@ -23,6 +23,10 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import wire
 
+# Cap on the server-side TLS handshake so one stalled/half-open peer can
+# only pin its own connection thread, never the accept loop.
+_TLS_HANDSHAKE_TIMEOUT_S = 10.0
+
 _LEN = struct.Struct("!I")
 # Reply retention is per client (keyed by the client's id prefix), not a
 # global FIFO: a request with sequence N implicitly acks every reply with
@@ -112,6 +116,22 @@ class RpcServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                if tls_ctx is not None:
+                    # Handshake here, in the per-connection thread — never
+                    # in get_request(), where a half-open peer would wedge
+                    # the single accept loop for every node. A bounded
+                    # timeout caps how long a stalled handshake can hold
+                    # this thread. wrap_socket() detaches the raw socket,
+                    # so socketserver's shutdown_request() no longer
+                    # reaches the real fd — close the wrapped socket
+                    # ourselves in finish().
+                    try:
+                        self.request.settimeout(_TLS_HANDSHAKE_TIMEOUT_S)
+                        self.request = tls_ctx.wrap_socket(
+                            self.request, server_side=True)
+                        self.request.settimeout(None)
+                    except (OSError, ValueError):  # SSLError is OSError
+                        return
                 while True:
                     try:
                         msg = recv_msg(self.request)
@@ -141,17 +161,25 @@ class RpcServer:
                     except (ConnectionError, OSError):
                         return
 
+            def finish(self):
+                if tls_ctx is not None:
+                    # self.request is the SSL-wrapped socket (or the raw
+                    # one if the handshake failed); closing it sends
+                    # close_notify and releases the detached fd that
+                    # socketserver's shutdown_request can no longer see.
+                    try:
+                        self.request.close()
+                    except OSError:
+                        pass
+
         tls_ctx = _tls_context(server=True)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
 
-            def get_request(self):
-                sock, addr = super().get_request()
-                if tls_ctx is not None:
-                    sock = tls_ctx.wrap_socket(sock, server_side=True)
-                return sock, addr
+            # NB: no get_request() override — the TLS handshake must not
+            # run in the accept thread (see Handler.handle above).
 
         self.handlers = handlers
         self.dedupe_methods = dedupe_methods or frozenset()

@@ -121,6 +121,24 @@ class TaskSpec:
     # context in task metadata).
     trace_parent: Optional[tuple] = None
 
+    def assign_return_ids(self) -> list[ObjectID]:
+        """Populate ``return_ids`` from ``num_returns`` and return them.
+
+        Single source of truth for return-id semantics (Worker.submit and
+        client-mode ClientWorker.submit both call this — they drifted
+        once): num_returns=0 means fire-and-forget (no returns);
+        "dynamic" means ONE ref whose value is an ObjectRefGenerator over
+        the task's yielded outputs; actor creations always carry at least
+        one status object (index 0).
+        """
+        n = 1 if self.num_returns == "dynamic" else self.num_returns
+        if self.kind == TaskKind.ACTOR_CREATION:
+            n = max(n, 1)
+        self.return_ids = [
+            ObjectID.for_task_return(self.task_id, i) for i in range(n)
+        ]
+        return self.return_ids
+
     def dependencies(self) -> list[ObjectID]:
         """ObjectIDs appearing at the top level of args/kwargs."""
         from ray_tpu.object_ref import ObjectRef

@@ -171,19 +171,7 @@ class Worker:
                 share_value(self, oid, value)
 
     def submit(self, spec: TaskSpec) -> list[ObjectRef]:
-        # num_returns=0: no return objects at all (call is fire-and-forget).
-        # num_returns="dynamic": ONE ref whose value is an
-        # ObjectRefGenerator over the task's yielded outputs.
-        # Actor creations always carry one status object (index 0).
-        from ray_tpu._private.task_spec import TaskKind
-
-        n = 1 if spec.num_returns == "dynamic" else spec.num_returns
-        if spec.kind == TaskKind.ACTOR_CREATION:
-            n = max(n, 1)
-        spec.return_ids = [
-            ObjectID.for_task_return(spec.task_id, i) for i in range(n)
-        ]
-        refs = [ObjectRef(oid) for oid in spec.return_ids]
+        refs = [ObjectRef(oid) for oid in spec.assign_return_ids()]
         self.backend.submit(spec)
         return refs
 

@@ -12,12 +12,15 @@ backends.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Union
+
+logger = logging.getLogger(__name__)
 
 
 class Syncer:
@@ -131,6 +134,7 @@ class SyncerCallback:
         self.experiment_dir = experiment_dir
         self.syncer = sync_config.resolve_syncer()
         self._last_sync = 0.0
+        self.sync_errors = 0
 
     @property
     def remote_dir(self) -> Optional[str]:
@@ -139,20 +143,52 @@ class SyncerCallback:
         return os.path.join(self.config.upload_dir,
                             os.path.basename(self.experiment_dir))
 
-    def maybe_sync(self, *, force: bool = False):
+    def maybe_sync(self, *, force: bool = False,
+                   on_checkpoint: bool = False):
+        # Two independent triggers (reference SyncConfig semantics):
+        # a checkpoint event syncs immediately iff sync_on_checkpoint,
+        # while period-based syncing applies to every call regardless.
         if self.syncer is None:
             return
-        if not force and not self.config.sync_on_checkpoint:
-            return  # periodic-only mode: just the final forced sync
         now = time.monotonic()
-        if not force and now - self._last_sync < self.config.sync_period:
+        checkpoint_trigger = on_checkpoint and self.config.sync_on_checkpoint
+        period_due = not self._last_sync or \
+            now - self._last_sync >= self.config.sync_period
+        if not force and not checkpoint_trigger and not period_due:
             return  # rate limit: full-tree copies are expensive
         self._last_sync = now
-        self.syncer.sync_up(self.experiment_dir, self.remote_dir)
+        try:
+            self.syncer.sync_up(self.experiment_dir, self.remote_dir)
+        except Exception:  # noqa: BLE001
+            # One transient upload failure must not abort the experiment
+            # loop; count it and keep training. With _BackgroundSyncer
+            # the raise usually surfaces a PRIOR failed upload from its
+            # internal wait() — retry once so a single stale error can't
+            # also cancel this period's sync. close() still raises.
+            self.sync_errors += 1
+            logger.warning("background experiment sync failed "
+                           "(%d so far); training continues",
+                           self.sync_errors, exc_info=True)
+            try:
+                self.syncer.sync_up(self.experiment_dir, self.remote_dir)
+            except Exception:  # noqa: BLE001
+                self.sync_errors += 1
+                logger.warning("experiment sync retry also failed",
+                               exc_info=True)
 
     def close(self):
+        # Final sync bypasses the error-swallowing periodic path: a
+        # failure to persist the terminal experiment state must surface.
         if self.syncer is not None:
-            self.maybe_sync(force=True)
+            # Drain any stale error from an earlier transient failure so
+            # it can't abort the final upload of a now-healthy storage.
+            try:
+                self.syncer.wait()
+            except Exception:  # noqa: BLE001
+                self.sync_errors += 1
+                logger.warning("stale background sync error drained at "
+                               "close", exc_info=True)
+            self.syncer.sync_up(self.experiment_dir, self.remote_dir)
             self.syncer.wait()
 
 

@@ -121,9 +121,10 @@ class Tuner:
         with open(tmp, "wb") as f:
             f.write(cloudpickle.dumps(state))
         os.replace(tmp, target)  # atomic: a crash never corrupts state
-        self._maybe_sync()
+        self._maybe_sync(on_checkpoint=True)
 
-    def _maybe_sync(self, *, force: bool = False) -> None:
+    def _maybe_sync(self, *, force: bool = False,
+                    on_checkpoint: bool = False) -> None:
         sync_cfg = self.run_config.sync_config
         if sync_cfg is None:
             return
@@ -133,7 +134,7 @@ class Tuner:
 
             cb = self._syncer_cb = SyncerCallback(
                 sync_cfg, self._experiment_dir())
-        cb.maybe_sync(force=force)
+        cb.maybe_sync(force=force, on_checkpoint=on_checkpoint)
 
     @classmethod
     def restore(cls, path: str, trainable: Union[Callable, type]) -> "Tuner":

@@ -74,6 +74,18 @@ CLIENT_SCRIPT = textwrap.dedent("""
     except ValueError as e:
         assert "kaboom" in str(e)
 
+    # num_returns="dynamic" generator tasks (ADVICE r3: client mode
+    # raised TypeError on range('dynamic'))
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = ray_tpu.get(gen.remote(4))
+    item_refs = list(g)
+    del g  # dropping the generator must not drop the yielded objects
+    assert ray_tpu.get(item_refs) == [0, 1, 4, 9]
+
     ray_tpu.kill(c)
     print("CLIENT OK")
 """)

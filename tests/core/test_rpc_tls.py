@@ -76,3 +76,26 @@ def test_tls_requires_all_paths():
             RpcServer({"f": lambda: 1})
     finally:
         ray_config.use_tls = False
+
+
+def test_stalled_handshake_does_not_block_accept_loop(tls_env):
+    """A half-open TCP peer that never speaks TLS must not wedge the
+    accept loop for well-behaved clients (ADVICE r3: the handshake ran
+    inside get_request on the server's single accept thread)."""
+    import socket
+    import time
+
+    server = RpcServer({"f": lambda: 1})
+    try:
+        # Raw TCP connect, then silence: if the server handshook in the
+        # accept thread this would block every later connection.
+        stall = socket.create_connection(server.address)
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        client = RpcClient.dedicated(server.address)
+        assert client.call("f") == 1
+        assert time.monotonic() - t0 < 5.0
+        client.close()
+        stall.close()
+    finally:
+        server.shutdown()

@@ -79,3 +79,80 @@ def test_sync_disabled_when_no_upload_dir(tmp_path):
                              sync_config=SyncConfig(upload_dir=None)))
     tuner.fit()  # no crash, no sync
     assert not os.path.exists(tmp_path / "exp3_remote")
+
+
+def test_sync_period_fires_without_checkpoint_trigger(tmp_path, monkeypatch):
+    """sync_on_checkpoint=False disables only the checkpoint trigger;
+    period-based syncing must still upload (ADVICE r3)."""
+    from ray_tpu.tune.syncer import SyncerCallback
+
+    calls = []
+
+    class Spy(Syncer):
+        def sync_up(self, local_dir, remote_dir):
+            calls.append(local_dir)
+            return True
+
+        def sync_down(self, remote_dir, local_dir):
+            return True
+
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    cb = SyncerCallback(
+        SyncConfig(upload_dir=str(tmp_path / "up"), syncer=Spy(),
+                   sync_period=0.0, sync_on_checkpoint=False),
+        str(exp))
+    cb.maybe_sync()
+    cb.maybe_sync()
+    assert len(calls) == 2  # period elapsed (0s) => both fire
+
+    # With a long period, sync_on_checkpoint=False must NOT sync on
+    # checkpoint events after the first upload...
+    calls.clear()
+    cb2 = SyncerCallback(
+        SyncConfig(upload_dir=str(tmp_path / "up"), syncer=Spy(),
+                   sync_period=3600.0, sync_on_checkpoint=False),
+        str(exp))
+    cb2.maybe_sync(on_checkpoint=True)  # first: period_due (never synced)
+    cb2.maybe_sync(on_checkpoint=True)
+    assert len(calls) == 1
+    # ...while sync_on_checkpoint=True syncs on every checkpoint event.
+    calls.clear()
+    cb3 = SyncerCallback(
+        SyncConfig(upload_dir=str(tmp_path / "up"), syncer=Spy(),
+                   sync_period=3600.0, sync_on_checkpoint=True),
+        str(exp))
+    cb3.maybe_sync(on_checkpoint=True)
+    cb3.maybe_sync(on_checkpoint=True)
+    assert len(calls) == 2
+
+
+def test_background_sync_error_does_not_abort_experiment(tmp_path):
+    """A transient background upload failure must be swallowed by
+    maybe_sync (logged + counted), not abort the experiment loop;
+    close() still surfaces a terminal failure (ADVICE r3)."""
+    from ray_tpu.tune.syncer import SyncerCallback, _BackgroundSyncer
+
+    class Flaky(Syncer):
+        def __init__(self):
+            self.n = 0
+
+        def sync_up(self, local_dir, remote_dir):
+            self.n += 1
+            raise OSError("disk temporarily gone")
+
+        def sync_down(self, remote_dir, local_dir):
+            return True
+
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    cb = SyncerCallback(
+        SyncConfig(upload_dir=str(tmp_path / "up"),
+                   syncer=_BackgroundSyncer(Flaky()), sync_period=0.0),
+        str(exp))
+    cb.maybe_sync()  # starts background upload that fails
+    cb.maybe_sync()  # wait() re-raises inside sync_up -> must be caught
+    cb.maybe_sync()
+    assert cb.sync_errors >= 1
+    with pytest.raises(RuntimeError, match="background sync failed"):
+        cb.close()
