@@ -1,0 +1,109 @@
+"""RLlib learner north star: learner samples/sec with sampling and
+learning OVERLAPPED (the round-3 verdict's missing number).
+
+IMPALA + LearnerThread on the pixel Catch env: CPU rollout actors stream
+[N, T, 40, 40, 1] fragments into the learner queue; the conv-torso
+V-trace update runs continuously on the device. Reports
+`learner_samples_per_s` (transitions consumed by updates / wall) and
+`device_busy_fraction` (update-window time minus queue starvation, with
+every window closed by a host-scalar fetch — the only trustworthy
+barrier on the tunneled chip).
+
+Reference analog: `rllib/execution/learner_thread.py` feeding the IMPALA
+learner, measured by the nightly `rllib_tests` sample-throughput suites.
+
+Usage: python benchmarks/rl_learner_bench.py [--seconds 60]
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seconds", type=float, default=60.0)
+    parser.add_argument("--workers", type=int, default=3)
+    parser.add_argument("--envs-per-worker", type=int, default=16)
+    parser.add_argument("--fragment", type=int, default=40)
+    parser.add_argument("--num-sgd-iter", type=int, default=4)
+    parser.add_argument("--env", default="CatchPixels-v0")
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.rl import IMPALAConfig
+
+    ray_tpu.init(num_cpus=max(8, args.workers * 2),
+                 ignore_reinit_error=True)
+    config = (IMPALAConfig()
+              .environment(args.env)
+              .rollouts(num_rollout_workers=args.workers,
+                        num_envs_per_worker=args.envs_per_worker,
+                        rollout_fragment_length=args.fragment)
+              .training(lr=3e-4, updates_per_iter=8)
+              .learners(use_learner_thread=True,
+                        num_sgd_iter=args.num_sgd_iter,
+                        learner_queue_size=4)
+              .debugging(seed=0))
+    algo = config.build()
+
+    algo.train()  # warm-up: compiles the update + absorbs platform stall
+    thread = algo.learner_thread
+    base_busy = thread.busy_s
+    base_updates = thread.updates
+    base_samples = thread.samples_consumed
+
+    t0 = time.perf_counter()
+    env_steps = 0
+    while time.perf_counter() - t0 < args.seconds:
+        result = algo.train()
+        env_steps += result["num_env_steps_sampled_this_iter"]
+    wall = time.perf_counter() - t0
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    updates = thread.updates - base_updates
+    samples = thread.samples_consumed - base_samples
+    busy = thread.busy_s - base_busy
+    algo.cleanup()
+    ray_tpu.shutdown()
+
+    print(json.dumps({
+        "metric": "rl_learner_samples_per_s",
+        "value": round(samples / wall, 1),
+        "unit": "transitions/s",
+        "detail": {
+            "algo": "IMPALA+LearnerThread", "env": args.env,
+            "model": "nature-cnn(40x40x1)"
+            if "Pixels" in args.env else "mlp",
+            "device": platform,
+            "device_busy_fraction": round(busy / wall, 4),
+            "learner_updates_per_s": round(updates / wall, 2),
+            "env_steps_sampled_per_s": round(env_steps / wall, 1),
+            "num_sgd_iter": args.num_sgd_iter,
+            "workers": args.workers,
+            "envs_per_worker": args.envs_per_worker,
+            "fragment": args.fragment,
+            "batch_transitions": args.envs_per_worker * args.fragment,
+            "window_s": round(wall, 1),
+            "host_cpus": os.cpu_count(),
+            "overlap": "sampling continues while the learner thread "
+                       "updates on-device; busy excludes queue-starved "
+                       "time",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

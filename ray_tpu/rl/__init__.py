@@ -51,6 +51,11 @@ from ray_tpu.rl.env import (  # noqa: F401
     make_env,
     register_env,
 )
+from ray_tpu.rl.learner import (  # noqa: F401
+    Learner,
+    LearnerGroup,
+    LearnerThread,
+)
 from ray_tpu.rl.multi_agent import MultiAgentRolloutWorker  # noqa: F401
 from ray_tpu.rl.offline import (  # noqa: F401
     InputReader,

@@ -26,6 +26,9 @@ class AlgorithmConfig:
         self.num_rollout_workers: int = 2
         self.num_envs_per_worker: int = 1
         self.rollout_fragment_length: int = 200
+        # Where worker-side policy inference runs ("cpu" keeps the
+        # accelerator exclusively for the learner).
+        self.inference_device: str = "cpu"
         # Connector pipelines (ray_tpu.rl.connectors); pickled out to
         # each worker, so every worker gets its own copy.
         self.obs_connectors: Any = None
@@ -109,7 +112,8 @@ class WorkerSet:
                 seed=config.seed + 1000 * (i + 1),
                 policy_kind=policy_kind,
                 obs_connectors=config.obs_connectors,
-                action_connectors=config.action_connectors)
+                action_connectors=config.action_connectors,
+                inference_device=config.inference_device)
             for i in range(max(1, config.num_rollout_workers))
         ]
 
@@ -198,9 +202,16 @@ class Algorithm(Trainable):
         weights = self.get_weights()
         params = weights.get("params", weights) \
             if isinstance(weights, dict) else weights
-        obs_b = jnp.asarray(np.asarray(obs, np.float32))[None]
-        if isinstance(params, dict) and "pi" in params:
-            logits, _ = models.actor_critic_apply(params, obs_b)
+        obs_np = np.asarray(obs)
+        if obs_np.dtype == np.float64:
+            obs_np = obs_np.astype(np.float32)
+        obs_b = jnp.asarray(obs_np)[None]  # integer frames stay integer:
+        # the conv torso rescales on device (train/eval parity)
+        if isinstance(params, dict) and ("conv" in params or
+                                         "pi" in params):
+            apply = models.cnn_actor_critic_apply if "conv" in params \
+                else models.actor_critic_apply
+            logits, _ = apply(params, obs_b)
             if explore:
                 key = jax.random.PRNGKey(np.random.randint(2 ** 31))
                 return int(jax.random.categorical(key, logits)[0])

@@ -5,21 +5,30 @@ architecture (stale behaviour policies, V-trace off-policy correction)
 with PPO's clipped-surrogate policy loss instead of the plain
 policy-gradient term, plus a periodically-synced target network used as
 the V-trace/value baseline anchor.
+
+TPU shape: the target network and its sync cadence live INSIDE the
+compiled learner step as `extra` state (a device-side counter +
+`jnp.where` swap), so the async learner thread never takes a host
+round-trip for target syncs.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from ray_tpu.rl import models
-from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rl.algorithms.impala import (
+    IMPALA,
+    IMPALAConfig,
+    _cfg_fields,
+    _pick_model,
+    vtrace,
+)
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.learner import Learner
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
     DONES,
@@ -38,81 +47,106 @@ class APPOConfig(IMPALAConfig):
         self.target_update_freq = 4  # learner updates between syncs
 
 
+def appo_loss(params, target_params, batch, *, apply_fn, gamma, clip_rho,
+              clip_c, vf_coeff, entropy_coeff, clip_param):
+    logits, values = jax.vmap(
+        lambda o: apply_fn(params, o))(batch[OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
+    # V-trace targets/advantages from the (frozen) target network —
+    # the reference's stabilized baseline for async updates.
+    t_logits, t_values = jax.vmap(
+        lambda o: apply_fn(target_params, o))(batch[OBS])
+    t_logp = jnp.take_along_axis(
+        jax.nn.log_softmax(t_logits), batch[ACTIONS][..., None],
+        axis=-1)[..., 0]
+    _, bootstrap = apply_fn(target_params, batch[NEXT_OBS][:, -1])
+    vs, pg_adv = vtrace(
+        batch[LOGPS], jax.lax.stop_gradient(t_logp),
+        batch[REWARDS], jax.lax.stop_gradient(t_values), bootstrap,
+        batch[DONES], gamma, clip_rho, clip_c)
+    # PPO clipped surrogate against the BEHAVIOUR logp.
+    ratio = jnp.exp(target_logp - batch[LOGPS])
+    pg = jnp.minimum(ratio * pg_adv,
+                     jnp.clip(ratio, 1 - clip_param,
+                              1 + clip_param) * pg_adv)
+    pi_loss = -pg.mean()
+    vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy, "mean_ratio": ratio.mean(),
+                   "loss": total}
+
+
+def build_appo_learner(cfg_fields: dict, clip_param: float,
+                       target_update_freq: int, mesh=None) -> Learner:
+    """Learner whose step carries (target_params, update counter) as
+    in-program extra state."""
+    f = cfg_fields
+    env = make_env(f["env_spec"], f["env_config"])
+    rng = jax.random.PRNGKey(f["seed"])
+    apply_fn, params = _pick_model(env, rng)
+    tx = optax.chain(optax.clip_by_global_norm(f["grad_clip"]),
+                     optax.adam(f["lr"]))
+    loss = functools.partial(
+        appo_loss, apply_fn=apply_fn, gamma=f["gamma"],
+        clip_rho=f["vtrace_clip_rho"], clip_c=f["vtrace_clip_c"],
+        vf_coeff=f["vf_coeff"], entropy_coeff=f["entropy_coeff"],
+        clip_param=clip_param)
+
+    def step_fn(state, batch):
+        extra = state["extra"]
+        (_, stats), grads = jax.value_and_grad(
+            lambda p: loss(p, extra["target"], batch),
+            has_aux=True)(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        k = extra["k"] + 1
+        sync = (k % target_update_freq == 0)
+        new_target = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), extra["target"],
+            new_params)
+        return ({"params": new_params, "opt_state": opt_state,
+                 "extra": {"target": new_target, "k": k}}, stats)
+
+    state = {"params": params, "opt_state": tx.init(params),
+             "extra": {"target": jax.tree.map(jnp.copy, params),
+                       "k": jnp.zeros((), jnp.int32)}}
+    return Learner(step_fn, state, mesh=mesh, tx=tx)
+
+
 class APPO(IMPALA):
     config_cls = APPOConfig
 
-    def build_components(self):
-        super().build_components()
-        cfg = self.algo_config
-        self.target_params = jax.tree.map(jnp.copy, self.params)
-        self._updates_since_sync = 0
-        self._update = jax.jit(functools.partial(
-            _appo_update, tx=self.tx, gamma=cfg.gamma,
-            clip_rho=cfg.vtrace_clip_rho, clip_c=cfg.vtrace_clip_c,
-            vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff,
-            clip_param=cfg.clip_param))
-
-    def _do_update(self, batch):
-        # IMPALA's async sample pipeline drives this; only the update
-        # call (target net threaded through) and the sync cadence differ.
-        self.params, self.opt_state, stats = self._update(
-            self.params, self.target_params, self.opt_state, batch)
-        self._updates_since_sync += 1
-        if self._updates_since_sync >= self.algo_config.target_update_freq:
-            self.target_params = jax.tree.map(jnp.copy, self.params)
-            self._updates_since_sync = 0
-        return stats
+    def _make_learner_build(self, cfg, mesh):
+        assert cfg.num_learners == 0, \
+            "APPO's stateful target net uses the local (mesh) learner"
+        return functools.partial(
+            build_appo_learner, _cfg_fields(cfg), cfg.clip_param,
+            cfg.target_update_freq, mesh)
 
     def get_weights(self):
-        return {"params": self.params, "target": self.target_params}
+        learner = self.learner_group._learner
+        with learner._lock:  # host copies: the step donates its input
+            return jax.device_get(
+                {"params": learner.state["params"],
+                 "target": learner.state["extra"]["target"]})
 
     def set_weights(self, weights):
+        learner = self.learner_group._learner
         if isinstance(weights, dict) and "target" in weights:
-            self.params = jax.tree.map(jnp.asarray, weights["params"])
-            self.target_params = jax.tree.map(jnp.asarray,
-                                              weights["target"])
+            params = jax.tree.map(jnp.asarray, weights["params"])
+            target = jax.tree.map(jnp.asarray, weights["target"])
         else:
-            self.params = jax.tree.map(jnp.asarray, weights)
-            self.target_params = jax.tree.map(jnp.copy, self.params)
-        self.opt_state = self.tx.init(self.params)
-
-
-def _appo_update(params, target_params, opt_state, batch, *, tx, gamma,
-                 clip_rho, clip_c, vf_coeff, entropy_coeff, clip_param):
-    def loss_fn(params):
-        logits, values = jax.vmap(
-            lambda o: models.actor_critic_apply(params, o))(batch[OBS])
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
-        # V-trace targets/advantages from the (frozen) target network —
-        # the reference's stabilized baseline for async updates.
-        t_logits, t_values = jax.vmap(
-            lambda o: models.actor_critic_apply(target_params, o))(
-                batch[OBS])
-        t_logp = jnp.take_along_axis(
-            jax.nn.log_softmax(t_logits), batch[ACTIONS][..., None],
-            axis=-1)[..., 0]
-        _, bootstrap = models.actor_critic_apply(
-            target_params, batch[NEXT_OBS][:, -1])
-        vs, pg_adv = vtrace(
-            batch[LOGPS], jax.lax.stop_gradient(t_logp),
-            batch[REWARDS], jax.lax.stop_gradient(t_values), bootstrap,
-            batch[DONES], gamma, clip_rho, clip_c)
-        # PPO clipped surrogate against the BEHAVIOUR logp.
-        ratio = jnp.exp(target_logp - batch[LOGPS])
-        pg = jnp.minimum(ratio * pg_adv,
-                         jnp.clip(ratio, 1 - clip_param,
-                                  1 + clip_param) * pg_adv)
-        pi_loss = -pg.mean()
-        vf_loss = 0.5 * ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
-        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                       "entropy": entropy,
-                       "mean_ratio": ratio.mean()}
-
-    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-    updates, opt_state = tx.update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
-    return params, opt_state, stats
+            params = jax.tree.map(jnp.asarray, weights)
+            target = jax.tree.map(jnp.copy, params)
+        with learner._lock:
+            learner.state = {
+                "params": params,
+                "opt_state": learner.tx.init(params),
+                "extra": {"target": target,
+                          "k": jnp.zeros((), jnp.int32)},
+            }

@@ -3,16 +3,22 @@
 Reference: `rllib/algorithms/impala/` + the learner-thread pattern
 (`rllib/execution/learner_thread.py`): rollout workers sample
 continuously; a learner thread consumes fragments from a queue, applies
-V-trace-corrected updates, and publishes fresh weights. Here the learner
-update is one jit program; asynchrony comes from overlapping worker
-sampling futures with learner steps.
+V-trace-corrected updates, and publishes fresh weights.
+
+TPU shape: the whole update is one jit program owned by a
+`ray_tpu.rl.learner.Learner`; with `use_learner_thread=True` that
+program runs continuously on-device while rollout futures stream batches
+into the queue (true sampling/learning overlap, measured by
+`LearnerThread.stats`). `num_learners>0` shards the update across
+learner actors (`LearnerGroup`); `num_devices_per_learner>1` shards the
+batch across a device mesh inside the program instead (XLA gradient
+all-reduce over ICI — the TPU-slice mode). Pixel observations get the
+conv torso (`models.cnn_actor_critic_*`) automatically.
 """
 
 from __future__ import annotations
 
 import functools
-import queue
-import threading
 from typing import Any, Dict
 
 import numpy as np
@@ -25,6 +31,7 @@ import ray_tpu
 from ray_tpu.rl import models
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.env import make_env
+from ray_tpu.rl.learner import Learner, LearnerGroup, LearnerThread
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
     DONES,
@@ -46,6 +53,27 @@ class IMPALAConfig(AlgorithmConfig):
         self.grad_clip = 40.0
         self.learner_queue_size = 8
         self.updates_per_iter = 8
+        # new-stack learner scaling (reference LearnerGroupScalingConfig)
+        self.use_learner_thread = False
+        self.num_learners = 0
+        self.num_devices_per_learner = 1
+        self.num_sgd_iter = 1
+        self.learner_barrier_every = 8
+
+    def learners(self, *, num_learners=None, num_devices_per_learner=None,
+                 use_learner_thread=None, num_sgd_iter=None,
+                 learner_queue_size=None) -> "IMPALAConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_devices_per_learner is not None:
+            self.num_devices_per_learner = num_devices_per_learner
+        if use_learner_thread is not None:
+            self.use_learner_thread = use_learner_thread
+        if num_sgd_iter is not None:
+            self.num_sgd_iter = num_sgd_iter
+        if learner_queue_size is not None:
+            self.learner_queue_size = learner_queue_size
+        return self
 
 
 def vtrace(behaviour_logp, target_logp, rewards, values, bootstrap,
@@ -75,35 +103,113 @@ def vtrace(behaviour_logp, target_logp, rewards, values, bootstrap,
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
 
+def _pick_model(env, rng, hidden=(64, 64)):
+    """(apply_fn, params): conv torso for [H, W, C] observations, MLP
+    otherwise."""
+    shape = env.observation_space.shape
+    if len(shape) == 3:
+        params = models.cnn_actor_critic_init(
+            rng, shape, env.action_space.n)
+        return models.cnn_actor_critic_apply, params
+    obs_dim = int(np.prod(shape))
+    params = models.actor_critic_init(rng, obs_dim, env.action_space.n,
+                                      hidden)
+    return models.actor_critic_apply, params
+
+
+def impala_loss(params, batch, *, apply_fn, gamma, clip_rho, clip_c,
+                vf_coeff, entropy_coeff):
+    """V-trace actor-critic loss over [N, T] fragments."""
+    logits, values = jax.vmap(
+        lambda o: apply_fn(params, o))(batch[OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
+    _, bootstrap = apply_fn(params, batch[NEXT_OBS][:, -1])
+    vs, pg_adv = vtrace(
+        batch[LOGPS], target_logp, batch[REWARDS], values,
+        bootstrap, batch[DONES], gamma, clip_rho, clip_c)
+    pi_loss = -(target_logp * pg_adv).mean()
+    vf_loss = 0.5 * ((values - vs) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+def build_impala_learner(cfg_fields: dict, mesh=None) -> Learner:
+    """Picklable learner factory (LearnerGroup actor mode pickles this
+    via functools.partial). cfg_fields carries the plain-data subset of
+    IMPALAConfig the loss and model need."""
+    f = cfg_fields
+    env = make_env(f["env_spec"], f["env_config"])
+    rng = jax.random.PRNGKey(f["seed"])
+    apply_fn, params = _pick_model(env, rng)
+    tx = optax.chain(optax.clip_by_global_norm(f["grad_clip"]),
+                     optax.adam(f["lr"]))
+    loss = functools.partial(
+        impala_loss, apply_fn=apply_fn, gamma=f["gamma"],
+        clip_rho=f["vtrace_clip_rho"], clip_c=f["vtrace_clip_c"],
+        vf_coeff=f["vf_coeff"], entropy_coeff=f["entropy_coeff"])
+    return Learner.from_loss(loss, params, tx, mesh=mesh)
+
+
+def _cfg_fields(cfg: IMPALAConfig) -> dict:
+    return {k: getattr(cfg, k) for k in
+            ("env_spec", "env_config", "seed", "grad_clip", "lr", "gamma",
+             "vtrace_clip_rho", "vtrace_clip_c", "vf_coeff",
+             "entropy_coeff")}
+
+
 class IMPALA(Algorithm):
     config_cls = IMPALAConfig
+
+    def _make_learner_build(self, cfg, mesh):
+        """Factory hook subclasses override (APPO swaps in its
+        target-net learner) — everything else in build_components is
+        shared."""
+        return functools.partial(build_impala_learner,
+                                 _cfg_fields(cfg), mesh)
 
     def build_components(self):
         cfg = self.algo_config
         env = make_env(cfg.env_spec, cfg.env_config)
-        obs_dim = int(np.prod(env.observation_space.shape))
-        n_actions = env.action_space.n
-        self.params = models.actor_critic_init(
-            jax.random.PRNGKey(cfg.seed), obs_dim, n_actions)
-        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
-                              optax.adam(cfg.lr))
-        self.opt_state = self.tx.init(self.params)
-        self.workers = WorkerSet(cfg, models.actor_critic_apply)
-        self._update = jax.jit(functools.partial(
-            _impala_update, tx=self.tx, gamma=cfg.gamma,
-            clip_rho=cfg.vtrace_clip_rho, clip_c=cfg.vtrace_clip_c,
-            vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff))
+        apply_fn, _ = _pick_model(env, jax.random.PRNGKey(cfg.seed))
+        self.apply_fn = apply_fn
+        mesh = None
+        if cfg.num_devices_per_learner > 1:
+            from jax.sharding import Mesh
+
+            devs = jax.devices()[:cfg.num_devices_per_learner]
+            mesh = Mesh(np.array(devs), ("data",))
+        self.learner_group = LearnerGroup(
+            build_learner=self._make_learner_build(cfg, mesh),
+            num_learners=cfg.num_learners)
+        self.workers = WorkerSet(cfg, apply_fn)
+        self.learner_thread = None
+        if cfg.use_learner_thread:
+            assert self.learner_group.is_local, \
+                "learner thread drives the local (mesh) learner"
+            self.learner_thread = LearnerThread(
+                self.learner_group._learner,
+                in_queue_size=cfg.learner_queue_size,
+                num_sgd_iter=cfg.num_sgd_iter,
+                barrier_every=cfg.learner_barrier_every)
+            self.learner_thread.start()
         self._sample_futures = []
 
+    # -- synchronous-ish path (default) ---------------------------------
+
     def training_step(self) -> Dict[str, Any]:
+        if self.learner_thread is not None:
+            return self._training_step_async()
         cfg = self.algo_config
         stats_acc = []
         steps = 0
         # Async pipeline: keep one sample future in flight per worker;
-        # learner consumes whichever lands first (learner-thread pattern
-        # without the thread — futures give the overlap).
+        # learner consumes whichever lands first.
         if not self._sample_futures:
-            w_ref = ray_tpu.put(self.params)
+            w_ref = ray_tpu.put(self.get_policy_weights())
             self._sample_futures = [
                 (w, w.sample.remote(w_ref)) for w in self.workers.workers]
         for _ in range(cfg.updates_per_iter):
@@ -111,58 +217,70 @@ class IMPALA(Algorithm):
             batch = ray_tpu.get(fut)
             # resubmit immediately with current weights (stale by design)
             self._sample_futures.append(
-                (worker, worker.sample.remote(ray_tpu.put(self.params))))
-            stats = self._do_update(
-                {k: jnp.asarray(np.asarray(v)) for k, v in batch.items()})
-            stats_acc.append(jax.device_get(stats))
+                (worker, worker.sample.remote(
+                    ray_tpu.put(self.get_policy_weights()))))
+            stats = self.learner_group.update(dict(batch))
+            stats_acc.append(stats)
             steps += np.asarray(batch[REWARDS]).size
         agg = {k: float(np.mean([s[k] for s in stats_acc]))
                for k in stats_acc[0]}
         agg["num_env_steps_sampled_this_iter"] = steps
         return agg
 
-    def _do_update(self, batch):
-        """One learner update; subclasses (APPO) override to thread
-        extra state through `_update` and run post-update bookkeeping."""
-        self.params, self.opt_state, stats = self._update(
-            self.params, self.opt_state, batch)
-        return stats
+    # -- learner-thread path --------------------------------------------
+
+    def _training_step_async(self) -> Dict[str, Any]:
+        """Feed the learner queue from rollout futures until
+        updates_per_iter learner updates have happened; sampling and
+        learning overlap the whole time."""
+        cfg = self.algo_config
+        thread = self.learner_thread
+        target = thread.updates + cfg.updates_per_iter
+        steps = 0
+        if not self._sample_futures:
+            w_ref = ray_tpu.put(self.get_policy_weights())
+            self._sample_futures = [
+                (w, w.sample.remote(w_ref)) for w in self.workers.workers]
+        import queue as _q
+
+        while thread.updates < target:
+            (worker, fut) = self._sample_futures.pop(0)
+            batch = ray_tpu.get(fut)
+            self._sample_futures.append(
+                (worker, worker.sample.remote(
+                    ray_tpu.put(self.get_policy_weights()))))
+            steps += np.asarray(batch[REWARDS]).size
+            while True:  # bounded put: a dead learner raises, not wedges
+                try:
+                    thread.put(dict(batch), timeout=5.0)
+                    break
+                except _q.Full:
+                    continue
+        agg = dict(thread.stats())
+        agg["num_env_steps_sampled_this_iter"] = steps
+        return agg
+
+    # -- weights ---------------------------------------------------------
+
+    def get_policy_weights(self):
+        """Weights the rollout workers need (params only)."""
+        if self.learner_thread is not None:
+            return jax.device_get(self.learner_thread.get_weights())
+        return jax.device_get(self.learner_group.get_weights())
 
     def get_weights(self):
-        return self.params
+        return self.learner_group.get_weights()
 
     def set_weights(self, weights):
-        self.params = jax.tree.map(jnp.asarray, weights)
-        self.opt_state = self.tx.init(self.params)
+        # Checkpoint-restore semantics: fresh optimizer moments for the
+        # restored params (matches the reference learner state reset).
+        self.learner_group.set_weights(
+            jax.tree.map(jnp.asarray, weights), reset_optimizer=True)
 
     def cleanup(self):
+        if getattr(self, "learner_thread", None) is not None:
+            self.learner_thread.stop()
+        if getattr(self, "learner_group", None) is not None:
+            self.learner_group.shutdown()
         self._sample_futures = []
         super().cleanup()
-
-
-def _impala_update(params, opt_state, batch, *, tx, gamma, clip_rho,
-                   clip_c, vf_coeff, entropy_coeff):
-    def loss_fn(params):
-        n, t = batch[REWARDS].shape
-        obs = batch[OBS]
-        logits, values = jax.vmap(
-            lambda o: models.actor_critic_apply(params, o))(obs)
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
-        _, bootstrap = models.actor_critic_apply(
-            params, batch[NEXT_OBS][:, -1])
-        vs, pg_adv = vtrace(
-            batch[LOGPS], target_logp, batch[REWARDS], values,
-            bootstrap, batch[DONES], gamma, clip_rho, clip_c)
-        pi_loss = -(target_logp * pg_adv).mean()
-        vf_loss = 0.5 * ((values - vs) ** 2).mean()
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
-        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                       "entropy": entropy}
-
-    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-    updates, opt_state = tx.update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
-    return params, opt_state, stats

@@ -157,6 +157,53 @@ class PendulumEnv(Env):
         return self._obs(), -float(cost), False, self._t >= self.max_steps, {}
 
 
+class CatchPixelsEnv(Env):
+    """Pixel-observation Catch (bsuite-style): a ball falls one row per
+    step; a 3-px paddle on the bottom row moves left/stay/right; terminal
+    reward +1 if caught, -1 if missed. Observations are the rendered
+    ``size x size x 1`` float32 frame — the standard cheap pixel env that
+    gives a CNN policy real conv FLOPs without an Atari dependency
+    (reference pixel envs come from ale-py, absent in this image)."""
+
+    def __init__(self, size: int = 40):
+        # Episodes are fixed-length (the ball falls size-1 rows), so
+        # there is no separate max_steps knob.
+        self.size = size
+        # uint8 frames (Atari convention): 4x less worker->learner pipe
+        # traffic and host->HBM transfer than float32; the conv torso
+        # rescales integer inputs to [0, 1] on device.
+        self.observation_space = Box(0, 255, (size, size, 1), np.uint8)
+        self.action_space = Discrete(3)
+        self._rng = np.random.RandomState()
+        self._state = (0, 0, size // 2)  # ball_row, ball_col, paddle_col
+
+    def _render(self):
+        s = self.size
+        row, col, pad = self._state
+        frame = np.zeros((s, s, 1), np.uint8)
+        frame[row, col, 0] = 255
+        frame[s - 1, max(0, pad - 1):pad + 2, 0] = 128
+        return frame
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = (0, int(self._rng.randint(self.size)),
+                       self.size // 2)
+        return self._render(), {}
+
+    def step(self, action):
+        row, col, pad = self._state
+        pad = int(np.clip(pad + int(action) - 1, 1, self.size - 2))
+        row += 1
+        terminated = row >= self.size - 1
+        reward = 0.0
+        if terminated:
+            reward = 1.0 if abs(col - pad) <= 1 else -1.0
+        self._state = (min(row, self.size - 1), col, pad)
+        return self._render(), reward, terminated, False, {}
+
+
 class MultiAgentEnv(Env):
     """Multi-agent env protocol (reference `rllib/env/multi_agent_env.py`):
     reset/step consume and return dicts keyed by agent id; the special
@@ -187,6 +234,7 @@ class GymEnvAdapter(Env):  # pragma: no cover - needs gym installed
 _ENV_REGISTRY: Dict[str, Callable[..., Env]] = {
     "CartPole-v1": CartPoleEnv,
     "Pendulum-v1": PendulumEnv,
+    "CatchPixels-v0": CatchPixelsEnv,
 }
 
 
@@ -276,16 +324,77 @@ class CartPoleVectorEnv:
                 np.ones(self.num_envs, np.float32), terms, truncs)
 
 
+class CatchPixelsVectorEnv:
+    """Batched-numpy CatchPixels: all N frames render in one pass (the
+    pixel-env rollout hot loop). Same auto-reset + final_obs contract as
+    VectorEnv."""
+
+    def __init__(self, num_envs: int, size: int = 40):
+        proto = CatchPixelsEnv(size)
+        self.observation_space = proto.observation_space
+        self.action_space = proto.action_space
+        self.num_envs = num_envs
+        self.size = size
+        self._row = np.zeros(num_envs, np.int64)
+        self._col = np.zeros(num_envs, np.int64)
+        self._pad = np.full(num_envs, size // 2, np.int64)
+        self._rng = np.random.RandomState()
+
+    def _render(self) -> np.ndarray:
+        n, s = self.num_envs, self.size
+        frames = np.zeros((n, s, s, 1), np.uint8)
+        ar = np.arange(n)
+        frames[ar, self._row, self._col, 0] = 255
+        for off in (-1, 0, 1):
+            frames[ar, s - 1, np.clip(self._pad + off, 0, s - 1), 0] = 128
+        return frames
+
+    def _reset_rows(self, rows):
+        self._row[rows] = 0
+        self._col[rows] = self._rng.randint(self.size, size=len(rows))
+        self._pad[rows] = self.size // 2
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._reset_rows(np.arange(self.num_envs))
+        return self._render()
+
+    def step(self, actions):
+        self._pad = np.clip(self._pad + np.asarray(actions) - 1, 1,
+                            self.size - 2)
+        self._row += 1
+        terms = self._row >= self.size - 1
+        rewards = np.where(
+            terms,
+            np.where(np.abs(self._col - self._pad) <= 1, 1.0, -1.0),
+            0.0).astype(np.float32)
+        self._row = np.minimum(self._row, self.size - 1)
+        frame = self._render()  # pre-reset: the true successor obs
+        self.final_obs = frame
+        done_rows = np.nonzero(terms)[0]
+        if len(done_rows):
+            self._reset_rows(done_rows)
+            obs = self._render()
+        else:
+            obs = frame
+        truncs = np.zeros(self.num_envs, bool)
+        return obs, rewards, terms, truncs
+
+
 class VectorEnv:
     """N envs behind a batched interface (reference
     `rllib/env/vector_env.py`). Built-in envs with a vectorized
-    implementation (CartPole) step as one numpy update; everything else
-    steps sequentially."""
+    implementation (CartPole, CatchPixels) step as one numpy update;
+    everything else steps sequentially."""
 
     def __new__(cls, spec, num_envs: int,
                 env_config: Optional[dict] = None):
         if spec == "CartPole-v1" and not env_config:
             return CartPoleVectorEnv(num_envs)
+        if spec == "CatchPixels-v0" and \
+                set(env_config or {}) <= {"size"}:
+            return CatchPixelsVectorEnv(num_envs, **(env_config or {}))
         return super().__new__(cls)
 
     def __init__(self, spec, num_envs: int,

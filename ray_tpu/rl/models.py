@@ -55,6 +55,74 @@ def q_net_apply(params, obs):
     return mlp_apply(params["q"], obs)
 
 
+# -- conv torso for pixel observations -------------------------------------
+# Reference: `rllib/models/catalog.py` CNN configs (the Atari "nature
+# CNN"). NHWC layout + VALID padding so XLA tiles the convs onto the MXU
+# without layout shuffles.
+
+_CNN_SPEC = ((32, 8, 4), (64, 4, 2), (64, 3, 1))  # (out_ch, kernel, stride)
+
+
+def _conv_init(key, k, cin, cout, dtype=jnp.float32):
+    fan_in = k * k * cin
+    return {
+        "w": jax.random.normal(key, (k, k, cin, cout), dtype)
+        * np.sqrt(2.0 / fan_in),
+        "b": jnp.zeros(cout, dtype),
+    }
+
+
+def _conv_apply(layer, x, stride):
+    y = jax.lax.conv_general_dilated(
+        x, layer["w"], window_strides=(stride, stride), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return jax.nn.relu(y + layer["b"])
+
+
+def _cnn_out_dim(hw: int, cnn_spec=_CNN_SPEC) -> int:
+    for _, k, s in cnn_spec:
+        hw = (hw - k) // s + 1
+        assert hw >= 1, "observation too small for the conv stack"
+    return hw * hw * cnn_spec[-1][0]
+
+
+def cnn_actor_critic_init(rng, obs_shape, n_actions: int,
+                          hidden: int = 256) -> Dict[str, Any]:
+    """Shared conv torso + dense neck, separate pi/vf heads.
+    obs_shape = (H, W, C) with H == W."""
+    h, w, c = obs_shape
+    assert h == w, "square observations only"
+    keys = jax.random.split(rng, len(_CNN_SPEC) + 3)
+    convs = []
+    cin = c
+    for key, (cout, k, _) in zip(keys, _CNN_SPEC):
+        convs.append(_conv_init(key, k, cin, cout))
+        cin = cout
+    flat = _cnn_out_dim(h)
+    return {
+        "conv": convs,
+        "neck": mlp_init(keys[-3], (flat, hidden)),
+        "pi": mlp_init(keys[-2], (hidden, n_actions)),
+        "vf": mlp_init(keys[-1], (hidden, 1)),
+    }
+
+
+def cnn_actor_critic_apply(params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """obs [B, H, W, C] -> (logits [B, A], value [B]). Integer inputs
+    (uint8 frames — shipped that way to quarter the host->HBM traffic)
+    rescale to [0, 1] on device; float inputs pass through."""
+    x = jnp.asarray(obs)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(jnp.float32) / 255.0
+    for layer, (_, _, stride) in zip(params["conv"], _CNN_SPEC):
+        x = _conv_apply(layer, x, stride)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(mlp_apply(params["neck"], x))
+    logits = mlp_apply(params["pi"], x)
+    value = mlp_apply(params["vf"], x)[..., 0]
+    return logits, value
+
+
 # -- continuous control (SAC-style) ----------------------------------------
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0

@@ -33,10 +33,20 @@ class RolloutWorker:
                  num_envs: int = 1, env_config: Optional[dict] = None,
                  rollout_fragment_length: int = 200, seed: int = 0,
                  policy_kind: str = "actor_critic",
-                 obs_connectors=None, action_connectors=None):
+                 obs_connectors=None, action_connectors=None,
+                 inference_device: str = "cpu"):
         import jax
 
         self.vec = VectorEnv(env_spec, num_envs, env_config)
+        # Rollout inference runs on the HOST by default (reference:
+        # rollout workers are CPU actors; the accelerator belongs to the
+        # learner). Without the pin, every worker's per-step policy call
+        # would dispatch to the default backend — on a TPU host that
+        # means N processes contending for the chip against the learner.
+        try:
+            self._dev = jax.devices(inference_device)[0]
+        except RuntimeError:
+            self._dev = None
         self.apply = jax.jit(policy_apply)
         self.fragment = rollout_fragment_length
         self.kind = policy_kind
@@ -67,8 +77,16 @@ class RolloutWorker:
 
     def sample(self, weights) -> SampleBatch:
         """Collect one fragment of `fragment` steps × num_envs."""
+        import contextlib
+
         import jax
 
+        ctx = jax.default_device(self._dev) if self._dev is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            return self._sample(weights)
+
+    def _sample(self, weights) -> SampleBatch:
         rows: Dict[str, list] = {OBS: [], ACTIONS: [], REWARDS: [],
                                  DONES: [], TERMINATEDS: [], NEXT_OBS: [],
                                  LOGPS: [], VALUES: []}
