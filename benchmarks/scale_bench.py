@@ -1,0 +1,223 @@
+"""Control-plane scale envelope — scaled-down analog of the reference's
+release scalability suite (`release/benchmarks/README.md:5-31`: 2k nodes,
+40k actors, 1M queued tasks, 1 GiB broadcast to 50 nodes).
+
+This host is one throttled CPU core, so the absolute numbers are small;
+what matters is that each dimension completes, the rates are recorded,
+and collapses (timeouts, non-linear slowdowns) are visible. Sections run
+independently — one dimension failing doesn't hide the others.
+
+Dimensions (vs the reference's):
+  many_actors        1,000 actors created + one call each  (ref: 40k+)
+  queued_tasks       100,000 tasks queued on one node      (ref: 1M+)
+  concurrent_tasks   10,000 tasks in flight at once        (ref: 10k+)
+  broadcast          256 MB object fetched by every node   (ref: 1 GiB x 50)
+  placement_groups   100 PGs of 4 bundles, 2PC + removal   (ref: 1k+)
+  many_args          1,000 object args into one task       (ref: 10k+)
+  many_returns       1,000 returns from one task           (ref: 3k+)
+
+Usage: python benchmarks/scale_bench.py [--out SCALE_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def section(name, fn, out):
+    t0 = time.perf_counter()
+    try:
+        res = fn()
+        res["wall_s"] = round(time.perf_counter() - t0, 2)
+        res["ok"] = True
+    except Exception as e:  # noqa: BLE001 — recorded, not fatal
+        res = {"ok": False, "error": f"{type(e).__name__}: {e}",
+               "wall_s": round(time.perf_counter() - t0, 2)}
+        traceback.print_exc()
+    out[name] = res
+    print(f"[scale] {name}: {res}", flush=True)
+
+
+def many_actors(n=1000):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.001)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    t0 = time.perf_counter()
+    actors = [A.remote(i) for i in range(n)]
+    t_submit = time.perf_counter() - t0
+    out = ray_tpu.get([a.ping.remote() for a in actors])
+    t_all = time.perf_counter() - t0
+    assert out == list(range(n))
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "actors": n,
+        "create_submit_per_s": round(n / t_submit, 1),
+        "create_plus_call_per_s": round(n / t_all, 1),
+    }
+
+
+def queued_tasks(n=100_000, concurrency_target=10_000):
+    """Queue depth: submit far more cheap tasks than can run, then drain.
+    Covers both the 1M-queued and 10k-concurrent reference dimensions
+    (at 0.001 CPU each, ~10k of the queued tasks are runnable at once on
+    a 10-CPU head)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def noop(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [noop.remote(i) for i in range(n)]
+    t_submit = time.perf_counter() - t0
+    got = ray_tpu.get(refs, timeout=1200)
+    t_drain = time.perf_counter() - t0
+    assert got[::10_000] == list(range(0, n, 10_000))
+    return {
+        "queued": n,
+        "submit_per_s": round(n / t_submit, 1),
+        "end_to_end_per_s": round(n / t_drain, 1),
+        "max_concurrent_runnable": concurrency_target,
+    }
+
+
+def broadcast(mb=256, nodes=4):
+    """One big object fetched by a task on every node. Same-host nodes
+    share the head's segment (zero-copy); one simulated-remote node
+    exercises the native transfer plane's pull path."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1},
+                      shm_capacity=2048 * 2**20)
+    try:
+        for i in range(nodes - 1):
+            cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2, simulate_remote_host=True)
+        if cluster.shm_plane is not None:
+            cluster.shm_plane.store.wait_prefault(60)
+
+        @ray_tpu.remote(num_cpus=1)
+        def touch(x):
+            return int(x[::4096].sum())
+
+        big = np.ones(mb * 2**20, np.uint8)
+        ref = ray_tpu.put(big)
+        expect = int(big[::4096].sum())
+        t0 = time.perf_counter()
+        outs = ray_tpu.get([touch.remote(ref) for _ in range(nodes * 2)],
+                           timeout=600)
+        dt = time.perf_counter() - t0
+        assert all(o == expect for o in outs)
+        return {
+            "object_mb": mb,
+            "nodes": nodes,
+            "fetches": nodes * 2,
+            "aggregate_GBps": round(nodes * 2 * mb / 1024 / dt, 2),
+        }
+    finally:
+        cluster.shutdown()
+
+
+def placement_groups(n=100):
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.01}] * 4, strategy="PACK")
+           for _ in range(n)]
+    ray_tpu.get([pg.ready() for pg in pgs], timeout=600)
+    t_ready = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_all = time.perf_counter() - t0
+    return {
+        "placement_groups": n,
+        "bundles_per_pg": 4,
+        "create_ready_per_s": round(n / t_ready, 1),
+        "create_remove_per_s": round(n / t_all, 1),
+    }
+
+
+def many_args(n=1000):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*refs), timeout=300) == n
+    dt = time.perf_counter() - t0
+    return {"args": n, "args_per_s": round(n / dt, 1)}
+
+
+def many_returns(n=1000):
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns=n)
+    def produce():
+        return list(range(n))
+
+    t0 = time.perf_counter()
+    refs = produce.remote()
+    vals = ray_tpu.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    assert vals == list(range(n))
+    return {"returns": n, "returns_per_s": round(n / dt, 1)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--actors", type=int, default=1000)
+    parser.add_argument("--tasks", type=int, default=100_000)
+    parser.add_argument("--broadcast-mb", type=int, default=256)
+    parser.add_argument("--pgs", type=int, default=100)
+    args = parser.parse_args()
+
+    import ray_tpu
+
+    out = {"host_cpus": os.cpu_count(),
+           "note": "single-core host; reference envelope runs on a 64+"
+                   "-node AWS fleet (release/benchmarks/README.md)"}
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=10)
+    section("many_actors", lambda: many_actors(args.actors), out)
+    section("queued_tasks", lambda: queued_tasks(args.tasks), out)
+    section("many_args", many_args, out)
+    section("many_returns", many_returns, out)
+    section("placement_groups", lambda: placement_groups(args.pgs), out)
+    ray_tpu.shutdown()
+    # broadcast brings up its own multi-node cluster
+    section("broadcast", lambda: broadcast(args.broadcast_mb), out)
+
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -254,26 +254,35 @@ class NodeRuntime:
         if timeout is None:
             timeout = ray_config.fetch_deadline_s
         deadline = time.monotonic() + timeout
+        attempt = 0
         while time.monotonic() < deadline:
             if self.worker.memory_store.contains(oid):
                 return  # produced locally while we were polling
-            from ray_tpu.cluster_utils import (_try_shm_fetch,
+            from ray_tpu.cluster_utils import (_fetch_backoff,
+                                               _try_shm_fetch,
                                                _try_transfer_fetch)
 
             if _try_shm_fetch(self.worker, oid):
                 return
-            info = self.head.call("locate2", oid=oid.binary())
-            if info is not None and \
-                    tuple(info["address"]) != self.address:
-                if _try_transfer_fetch(self.worker, oid, info):
-                    return
-                ok, value, err = RpcClient.to(
-                    tuple(info["address"])).call(
-                    "get_object", oid=oid.binary())
-                if ok:
-                    self.worker.memory_store.put(oid, value, error=err)
-                    return
-            time.sleep(0.02)
+            # Local probes (memory store, shm) are cheap and run every
+            # attempt; the head locate RPC is rate-limited to every 4th
+            # fine-grained probe so sub-ms polling doesn't turn into an
+            # RPC storm.
+            if attempt % 4 == 0:
+                info = self.head.call("locate2", oid=oid.binary())
+                if info is not None and \
+                        tuple(info["address"]) != self.address:
+                    if _try_transfer_fetch(self.worker, oid, info):
+                        return
+                    ok, value, err = RpcClient.to(
+                        tuple(info["address"])).call(
+                        "get_object", oid=oid.binary())
+                    if ok:
+                        self.worker.memory_store.put(oid, value,
+                                                     error=err)
+                        return
+            _fetch_backoff(attempt)
+            attempt += 1
         raise TimeoutError(f"could not fetch {oid.hex()} from cluster")
 
     # -- RPC handlers ----------------------------------------------------

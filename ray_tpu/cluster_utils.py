@@ -31,6 +31,14 @@ from ray_tpu._private.task_spec import TaskKind
 from ray_tpu.exceptions import ActorDiedError, OwnerDiedError
 
 
+def _fetch_backoff(attempt: int) -> None:
+    """Escalating poll interval for object-arrival waits: sub-ms first
+    probes (most objects land within a few ms of submission — a flat
+    10 ms sleep put a hard floor under every cross-process get), backing
+    off to 10 ms for slow producers."""
+    time.sleep(min(0.0005 * (1.6 ** min(attempt, 10)), 0.01))
+
+
 def _try_shm_fetch(worker, oid) -> bool:
     """Zero-copy read from the node's shared segment, if the object is
     there. Faster and cheaper than any RPC — always tried first."""
@@ -789,6 +797,7 @@ class ClusterBackendMixin:
 
                 deadline = time.monotonic() + ray_config.fetch_deadline_s
                 transport_err = None
+                attempt = 0
                 while time.monotonic() < deadline:
                     if store.contains(oid):
                         return
@@ -808,7 +817,8 @@ class ClusterBackendMixin:
                         if ok:
                             store.put(oid, value, error=err)
                             return
-                    time.sleep(0.01)
+                    _fetch_backoff(attempt)
+                    attempt += 1
                 if transport_err is not None and not store.contains(oid):
                     store.put(oid, None, error=OwnerDiedError(
                         oid.hex()[:12],
@@ -961,6 +971,7 @@ class ClusterDriverMixin:
                     deadline = time.monotonic() + \
                         ray_config.fetch_deadline_s
                     transport_err = None
+                    attempt = 0
                     while time.monotonic() < deadline:
                         if _try_shm_fetch(worker, ref.id):
                             return
@@ -984,7 +995,8 @@ class ClusterDriverMixin:
                                 return
                         if worker.memory_store.contains(ref.id):
                             return
-                        time.sleep(0.01)
+                        _fetch_backoff(attempt)
+                        attempt += 1
                     if transport_err is not None and \
                             not worker.memory_store.contains(ref.id):
                         worker.memory_store.put(
@@ -1138,6 +1150,15 @@ class Cluster:
             cmd += ["--shm-name", self.shm_plane.name]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # Node subprocesses must resolve ray_tpu the same way the driver
+        # does (a driver using sys.path.insert — e.g. a checkout not on
+        # PYTHONPATH — would otherwise spawn nodes that can't import us).
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = pkg_root + (
+                os.pathsep + existing if existing else "")
         # Child output goes to a log file: a node that dies during
         # bring-up must leave evidence, not vanish silently.
         log_path = os.path.join(tempfile.gettempdir(),

@@ -28,6 +28,36 @@ uint64_t NowNs() {
 }
 }  // namespace
 
+#ifndef MADV_POPULATE_READ
+#define MADV_POPULATE_READ 22
+#define MADV_POPULATE_WRITE 23
+#endif
+
+void PopulateRange(const void* addr, uint64_t len, bool write,
+                   uint64_t step, const std::atomic<bool>* cancel) {
+  uintptr_t a = (uintptr_t)addr;
+  uintptr_t page = a & ~(uintptr_t)4095;
+  len += a - page;
+  int advice = write ? MADV_POPULATE_WRITE : MADV_POPULATE_READ;
+  for (uint64_t off = 0; off < len; off += step) {
+    if (cancel != nullptr && cancel->load(std::memory_order_relaxed)) {
+      return;
+    }
+    uint64_t n = len - off < step ? len - off : step;
+    madvise((void*)(page + off), n, advice);
+  }
+}
+
+void ShmStore::StartPrefault(bool write) {
+  uint8_t* map_base = base_;
+  uint64_t total_len = map_size_;
+  const std::atomic<bool>* cancel = &prefault_cancel_;
+  prefault_thread_ = new std::thread([map_base, total_len, write,
+                                      cancel] {
+    PopulateRange(map_base, total_len, write, 16ULL << 20, cancel);
+  });
+}
+
 struct StoreHeader {
   uint64_t magic;
   uint64_t capacity;      // arena bytes
@@ -38,6 +68,7 @@ struct StoreHeader {
   uint64_t lru_clock;
   uint64_t evictions;
   uint64_t create_failures;
+  uint64_t uuid;          // segment identity (same-host pull fast path)
   pthread_mutex_t mutex;  // process-shared
   // ObjectEntry table follows immediately after this struct.
 };
@@ -93,6 +124,17 @@ ShmStore* ShmStore::Create(const char* name, uint64_t capacity,
   h->lru_clock = 1;
   h->evictions = 0;
   h->create_failures = 0;
+  {
+    // Random identity so a same-named segment on a DIFFERENT machine
+    // can never be mistaken for this one by the transfer fast path.
+    uint64_t u = NowNs() ^ (uint64_t(getpid()) << 32);
+    FILE* f = fopen("/dev/urandom", "rb");
+    if (f != nullptr) {
+      if (fread(&u, sizeof(u), 1, f) != 1) u ^= NowNs();
+      fclose(f);
+    }
+    h->uuid = u;
+  }
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
@@ -118,18 +160,7 @@ ShmStore* ShmStore::Create(const char* name, uint64_t capacity,
   // the kernel has populated them. MADV_POPULATE_WRITE allocates the
   // pages without writing, so it is race-free against live writers.
   {
-    uint8_t* arena = s->arena_;
-    uint64_t cap = capacity;
-    std::thread([arena, cap] {
-#ifndef MADV_POPULATE_WRITE
-#define MADV_POPULATE_WRITE 23
-#endif
-      const uint64_t kStep = 16ULL << 20;
-      for (uint64_t off = 0; off < cap; off += kStep) {
-        uint64_t n = cap - off < kStep ? cap - off : kStep;
-        madvise(arena + off, n, MADV_POPULATE_WRITE);
-      }
-    }).detach();
+    s->StartPrefault(/*write=*/true);
   }
   return s;
 }
@@ -162,10 +193,21 @@ ShmStore* ShmStore::Attach(const char* name) {
   s->fd_ = fd;
   s->owner_ = false;
   snprintf(s->name_, sizeof(s->name_), "%s", name);
+  // Populate this process's page tables in the background (pages
+  // already exist; this is PTE setup only, so it is quick) — an
+  // attaching node otherwise pays a minor fault per 4K page on its
+  // first pass over the segment.
+  s->StartPrefault(/*write=*/false);
   return s;
 }
 
 ShmStore::~ShmStore() {
+  auto* t = static_cast<std::thread*>(prefault_thread_);
+  if (t != nullptr) {
+    prefault_cancel_.store(true);
+    if (t->joinable()) t->join();  // bounded by one madvise chunk
+    delete t;
+  }
   if (base_) munmap(base_, map_size_);
   if (fd_ >= 0) close(fd_);
 }
@@ -317,6 +359,8 @@ bool ShmStore::Delete(const uint8_t* id) {
   e->state = (int32_t)ObjectState::kFree;
   return true;
 }
+
+uint64_t ShmStore::uuid() const { return header_->uuid; }
 
 StoreStats ShmStore::Stats() {
   MutexGuard g(&header_->mutex);

@@ -21,10 +21,21 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
 
 namespace ray_tpu {
+
+// Batched PTE/page population over a mapped range (MADV_POPULATE_*,
+// with page-alignment handled here — madvise EINVALs on unaligned
+// addresses, which silently disabled an earlier inline version). One
+// shared implementation for the create/attach background prefaults and
+// the transfer plane's pre-copy populate. `cancel` (optional) aborts
+// between chunks so a closing store can join its prefault thread fast.
+void PopulateRange(const void* addr, uint64_t len, bool write,
+                   uint64_t step = 16ULL << 20,
+                   const std::atomic<bool>* cancel = nullptr);
 
 constexpr uint32_t kIdSize = 20;
 constexpr uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
@@ -86,6 +97,13 @@ class ShmStore {
   const char* name() const { return name_; }
   const uint8_t* base() const { return base_; }
   uint64_t map_size() const { return map_size_; }
+  // Backing tmpfs fd (open for the store's lifetime) — lets the
+  // transfer server sendfile() payloads straight from the page cache,
+  // skipping the user->kernel copy of a send() from the mapping.
+  int fd() const { return fd_; }
+  // Segment identity (random per Create) — the transfer plane's
+  // same-host detection token.
+  uint64_t uuid() const;
 
  private:
   ShmStore() = default;
@@ -95,6 +113,8 @@ class ShmStore {
   ObjectEntry* FindEntry(const uint8_t* id);
   ObjectEntry* FindFreeEntry();
 
+  void StartPrefault(bool write);
+
   StoreHeader* header_ = nullptr;
   uint8_t* base_ = nullptr;   // mmap base
   uint8_t* arena_ = nullptr;  // data arena base
@@ -102,6 +122,11 @@ class ShmStore {
   int fd_ = -1;
   bool owner_ = false;
   char name_[256] = {0};
+  // Background prefault: tracked (not detached) so the destructor can
+  // cancel + join before munmap — a detached thread would race the
+  // unmap and could madvise whatever mapping reuses the range.
+  void* prefault_thread_ = nullptr;  // std::thread*
+  std::atomic<bool> prefault_cancel_{false};
 };
 
 }  // namespace ray_tpu

@@ -7,12 +7,16 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string.h>
+#include <sys/mman.h>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
 #include <cstdio>
+#include <map>
 #include <mutex>
+#include <string>
 #include <thread>
 
 namespace ray_tpu {
@@ -20,6 +24,19 @@ namespace ray_tpu {
 namespace {
 
 std::mutex g_stats_mu;
+
+// Large socket buffers: the path is syscall/context-switch bound on
+// loopback (sender and receiver alternate on shared cores); deep
+// buffers keep both sides streaming instead of ping-ponging per 64 KB.
+constexpr int kSockBufBytes = 8 << 20;
+
+void TuneSocket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int buf = kSockBufBytes;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
 
 bool SendAll(int fd, const void* buf, uint64_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -115,8 +132,7 @@ void TransferServer::AcceptLoop() {
       if (errno == EINTR) continue;
       return;
     }
-    int one = 1;
-    setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    TuneSocket(conn);
     {
       std::lock_guard<std::mutex> g(conn_mu_);
       if (stopping_.load()) {  // Stop() may have run since accept()
@@ -135,6 +151,17 @@ void TransferServer::HandleConn(int fd) {
     if (req.magic != kTransferMagic) break;
     uint64_t size = 0;
     const uint8_t* payload = store_->Get(req.id, &size);  // pins
+    if (req.op == (uint8_t)TransferOp::kGetMeta) {
+      MetaReply meta = {};
+      meta.size = payload == nullptr ? UINT64_MAX : size;
+      meta.uuid = store_->uuid();
+      memcpy(meta.segment, store_->name(),
+               sizeof(meta.segment) - 1);  // name_ is 256B, reply 128
+      bool sent_ok = SendAll(fd, &meta, sizeof(meta));
+      if (payload != nullptr) store_->Release(req.id);
+      if (!sent_ok) break;
+      continue;
+    }
     if (payload == nullptr) {
       uint64_t missing = UINT64_MAX;
       if (!SendAll(fd, &missing, sizeof(missing))) break;
@@ -145,13 +172,32 @@ void TransferServer::HandleConn(int fd) {
       uint64_t off = req.offset < size ? req.offset : size;
       uint64_t len = req.len == 0 ? size - off : req.len;
       if (off + len > size) len = size - off;
-      // Chunked send: bounded writes so a slow peer can't pin a huge
-      // buffer and stats stay live.
+      // Zero-copy send: sendfile() streams tmpfs pages into the socket
+      // without the user->kernel copy a send()-from-mmap pays. Chunked
+      // so a slow peer can't pin a huge buffer and stats stay live.
+      // Falls back to SendAll if sendfile is refused (e.g. exotic fs).
+      off_t file_off =
+          (off_t)((payload - store_->base()) + off);
       uint64_t sent = 0;
+      bool use_sendfile = store_->fd() >= 0;
       while (ok && sent < len) {
         uint64_t n = len - sent < kChunkSize ? len - sent : kChunkSize;
-        ok = SendAll(fd, payload + off + sent, n);
-        sent += n;
+        if (use_sendfile) {
+          ssize_t w = sendfile(fd, store_->fd(), &file_off, n);
+          if (w < 0) {
+            if (errno == EINTR) continue;
+            if (sent == 0 && (errno == EINVAL || errno == ENOSYS)) {
+              use_sendfile = false;  // fall back for the whole object
+              continue;
+            }
+            ok = false;
+            break;
+          }
+          sent += (uint64_t)w;  // sendfile may short-write; loop covers it
+        } else {
+          ok = SendAll(fd, payload + off + sent, n);
+          sent += n;
+        }
       }
       std::lock_guard<std::mutex> g(g_stats_mu);
       stats_.bytes_sent += sent;
@@ -178,8 +224,69 @@ TransferStats TransferServer::stats() const {
   return stats_;
 }
 
+// Cache of peer segments this process has attached for same-host pulls.
+// Entries are validated by uuid on every use; a stale mapping (peer
+// segment recreated) is deliberately LEAKED rather than deleted — other
+// threads may be mid-memcpy on it, and the count of recreations over a
+// process lifetime is tiny.
+ShmStore* AttachPeerCached(const char* name, uint64_t uuid) {
+  static std::mutex mu;
+  static std::map<std::string, ShmStore*>* cache =
+      new std::map<std::string, ShmStore*>();
+  std::lock_guard<std::mutex> g(mu);
+  auto it = cache->find(name);
+  if (it != cache->end()) {
+    if (it->second->uuid() == uuid) return it->second;
+    cache->erase(it);  // stale; leak the old mapping (see above)
+  }
+  ShmStore* s = ShmStore::Attach(name);
+  if (s == nullptr) return nullptr;  // not on this machine
+  if (s->uuid() != uuid) {
+    delete s;  // same name, different segment (other machine / rebuilt)
+    return nullptr;
+  }
+  (*cache)[name] = s;
+  return s;
+}
+
+// Same-host fast path: copy straight between mapped segments at memory
+// bandwidth (the source object stays pinned for the duration). Returns
+// a PullObject code, or 1 if the fast path does not apply.
+int TryLocalPull(ShmStore* store, const uint8_t* id,
+                 const MetaReply& meta, TransferStats* stats) {
+  if (meta.uuid == store->uuid()) return -5;  // pulling from ourselves
+  ShmStore* peer = AttachPeerCached(meta.segment, meta.uuid);
+  if (peer == nullptr) return 1;
+  uint64_t psize = 0;
+  const uint8_t* src = peer->Get(id, &psize);  // pins against eviction
+  if (src == nullptr) return 1;  // evicted since the meta reply
+  if (psize != meta.size) {
+    peer->Release(id);
+    return 1;
+  }
+  uint8_t* dst = store->CreateObject(id, psize);
+  if (dst == nullptr) {
+    peer->Release(id);
+    return store->Contains(id) ? -5 : -3;
+  }
+  // Populate PTEs in bulk before copying: a fresh Attach mapping would
+  // otherwise take one minor fault per 4K page, which costs several
+  // times the memcpy itself for GiB objects (one syscall batches the
+  // whole range kernel-side). Advisory — the copy is correct either way.
+  PopulateRange(src, psize, /*write=*/false);
+  PopulateRange(dst, psize, /*write=*/true);
+  memcpy(dst, src, psize);
+  peer->Release(id);
+  store->Seal(id);
+  if (stats) {
+    stats->bytes_received += psize;
+    stats->objects_pulled += 1;
+  }
+  return 0;
+}
+
 int PullObject(ShmStore* store, const uint8_t* id, const char* host,
-               uint16_t port, TransferStats* stats) {
+               uint16_t port, TransferStats* stats, bool allow_local) {
   if (store->Contains(id)) return -5;
 
   addrinfo hints = {};
@@ -198,15 +305,37 @@ int PullObject(ShmStore* store, const uint8_t* id, const char* host,
     return -1;
   }
   freeaddrinfo(res);
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  TuneSocket(fd);
 
   Request req = {};
   req.magic = kTransferMagic;
-  req.op = (uint8_t)TransferOp::kGet;
   memcpy(req.id, id, kIdSize);
   req.offset = 0;
   req.len = 0;
+  if (allow_local) {
+    // Identity handshake first: if the serving segment is mapped on
+    // THIS machine, copy segment-to-segment and skip the TCP stream
+    // (loopback TCP tops out well below memcpy bandwidth).
+    req.op = (uint8_t)TransferOp::kGetMeta;
+    MetaReply meta = {};
+    if (!SendAll(fd, &req, sizeof(req)) ||
+        !RecvAll(fd, &meta, sizeof(meta))) {
+      close(fd);
+      return -4;
+    }
+    if (meta.size == UINT64_MAX) {
+      close(fd);
+      return -2;
+    }
+    meta.segment[sizeof(meta.segment) - 1] = '\0';
+    int rc = TryLocalPull(store, id, meta, stats);
+    if (rc <= 0) {
+      close(fd);
+      return rc;
+    }
+    // Fast path inapplicable: stream over the same connection.
+  }
+  req.op = (uint8_t)TransferOp::kGet;
   uint64_t size = 0;
   if (!SendAll(fd, &req, sizeof(req)) ||
       !RecvAll(fd, &size, sizeof(size))) {

@@ -31,12 +31,23 @@
 namespace ray_tpu {
 
 constexpr uint32_t kTransferMagic = 0x46585452;  // "RTXF"
-constexpr uint64_t kChunkSize = 1 << 20;         // 1 MiB
+constexpr uint64_t kChunkSize = 4 << 20;         // 4 MiB
 
 enum class TransferOp : uint8_t {
-  kGet = 1,   // pull a byte range (len 0 = to end) of an object
-  kStat = 2,  // size lookup only
+  kGet = 1,      // pull a byte range (len 0 = to end) of an object
+  kStat = 2,     // size lookup only
+  kGetMeta = 3,  // size + serving segment identity (same-host fast path)
 };
+
+// Reply to kGetMeta: lets a puller on the SAME machine as the server
+// skip TCP entirely — it shm-attaches the advertised segment (identity
+// confirmed by uuid, so a coincidentally same-named segment on another
+// machine can't alias) and memcpys the payload at memory bandwidth.
+struct MetaReply {
+  uint64_t size;  // UINT64_MAX = object not present
+  uint64_t uuid;  // serving store's segment identity
+  char segment[128];
+} __attribute__((packed));
 
 struct TransferStats {
   uint64_t bytes_sent;
@@ -81,8 +92,11 @@ class TransferServer {
 
 // Pulls object `id` from host:port into `store` (create → recv → seal).
 // Returns 0 on success, negative errno-style codes otherwise.
+// `allow_local` (default) probes the kGetMeta same-host fast path
+// first; tests pass false to exercise the TCP stream unconditionally.
 int PullObject(ShmStore* store, const uint8_t* id, const char* host,
-               uint16_t port, TransferStats* stats);
+               uint16_t port, TransferStats* stats,
+               bool allow_local = true);
 
 }  // namespace ray_tpu
 

@@ -43,7 +43,8 @@ int main() {
     assert(p);
     for (int i = 0; i < 4096; i++) p[i] = (uint8_t)(i * 7);
     assert(a->Seal(id));
-    int rc = PullObject(b, id, "127.0.0.1", srv->port(), nullptr);
+    int rc = PullObject(b, id, "127.0.0.1", srv->port(), nullptr,
+                        /*allow_local=*/false);  // cover the wire path
     assert(rc == 0);
     uint64_t size = 0;
     const uint8_t* q = b->Get(id, &size);
@@ -60,7 +61,10 @@ int main() {
   make_id(missing, 99);
   assert(PullObject(b, missing, "127.0.0.1", srv->port(), nullptr) == -2);
 
-  // 1 GiB object: chunked stream, content spot-checked.
+  // 1 GiB object, both transfer paths content-checked and timed:
+  // forced TCP stream (the true cross-host path) and the same-host
+  // segment-to-segment fast path (the default when the serving segment
+  // is mapped on this machine).
   uint8_t big_id[ray_tpu::kIdSize];
   make_id(big_id, 2);
   {
@@ -73,27 +77,42 @@ int main() {
     p[kGiB - 1] = 0x5A;
     assert(a->Seal(big_id));
 
+    auto check = [&](const char* label, double dt) {
+      uint64_t size = 0;
+      const uint8_t* q = b->Get(big_id, &size);
+      assert(q && size == kGiB);
+      for (uint64_t off = 0; off < kGiB; off += ray_tpu::kChunkSize) {
+        uint64_t v;
+        memcpy(&v, q + off, sizeof(v));
+        assert(v == off);
+      }
+      assert(q[kGiB - 1] == 0x5A);
+      b->Release(big_id);
+      printf("1GiB pull (%s): %.2f GB/s\n", label, 1.0 / dt);
+    };
+
     auto t0 = std::chrono::steady_clock::now();
-    int rc = PullObject(b, big_id, "127.0.0.1", srv->port(), nullptr);
+    int rc = PullObject(b, big_id, "127.0.0.1", srv->port(), nullptr,
+                        /*allow_local=*/false);
     auto dt = std::chrono::duration<double>(
                   std::chrono::steady_clock::now() - t0)
                   .count();
     assert(rc == 0);
-    uint64_t size = 0;
-    const uint8_t* q = b->Get(big_id, &size);
-    assert(q && size == kGiB);
-    for (uint64_t off = 0; off < kGiB; off += ray_tpu::kChunkSize) {
-      uint64_t v;
-      memcpy(&v, q + off, sizeof(v));
-      assert(v == off);
-    }
-    assert(q[kGiB - 1] == 0x5A);
-    b->Release(big_id);
-    printf("1GiB pull: %.2f GB/s\n", 1.0 / dt);
+    check("tcp stream", dt);
+
+    assert(b->Delete(big_id));
+    t0 = std::chrono::steady_clock::now();
+    rc = PullObject(b, big_id, "127.0.0.1", srv->port(), nullptr);
+    dt = std::chrono::duration<double>(
+             std::chrono::steady_clock::now() - t0)
+             .count();
+    assert(rc == 0);
+    check("same-host", dt);
   }
 
   auto st = srv->stats();
-  assert(st.objects_served == 2);
+  // TCP path streamed the small object + one 1 GiB copy; the same-host
+  // pull only cost a meta round-trip (no payload bytes on the wire).
   assert(st.bytes_sent == 4096 + kGiB);
 
   srv->Stop();
