@@ -187,6 +187,53 @@ def many_returns(n=1000):
     return {"returns": n, "returns_per_s": round(n / dt, 1)}
 
 
+def cluster_actors_and_tasks(n_actors=500, n_tasks=20_000, nodes=2):
+    """The same actor/task dimensions THROUGH the cluster control plane:
+    head RPC dispatch to node subprocesses (the path the reference's
+    envelope actually measures), not the in-process local backend."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        for _ in range(nodes):
+            cluster.add_node(num_cpus=8)
+
+        @ray_tpu.remote(num_cpus=0.001)
+        class A:
+            def ping(self):
+                return 1
+
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(n_actors)]
+        assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                               timeout=600)) == n_actors
+        t_actors = time.perf_counter() - t0
+        for a in actors:
+            ray_tpu.kill(a)
+
+        @ray_tpu.remote(num_cpus=0.001)
+        def noop(i):
+            return i
+
+        t0 = time.perf_counter()
+        refs = [noop.remote(i) for i in range(n_tasks)]
+        t_submit = time.perf_counter() - t0
+        got = ray_tpu.get(refs, timeout=1200)
+        t_drain = time.perf_counter() - t0
+        assert got[::5000] == list(range(0, n_tasks, 5000))
+        return {
+            "nodes": nodes,
+            "actors": n_actors,
+            "actor_create_call_per_s": round(n_actors / t_actors, 1),
+            "tasks": n_tasks,
+            "task_submit_per_s": round(n_tasks / t_submit, 1),
+            "task_end_to_end_per_s": round(n_tasks / t_drain, 1),
+        }
+    finally:
+        cluster.shutdown()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
@@ -210,8 +257,9 @@ def main():
     section("many_returns", many_returns, out)
     section("placement_groups", lambda: placement_groups(args.pgs), out)
     ray_tpu.shutdown()
-    # broadcast brings up its own multi-node cluster
+    # these bring up their own multi-node clusters
     section("broadcast", lambda: broadcast(args.broadcast_mb), out)
+    section("cluster_actors_and_tasks", cluster_actors_and_tasks, out)
 
     print(json.dumps(out, indent=2))
     if args.out:

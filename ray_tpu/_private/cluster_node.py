@@ -57,8 +57,13 @@ class NodeRuntime:
             else:
                 # Own segment (remote host, or simulating one): peers
                 # reach our objects through the native transfer server.
+                # Pulls from this node must take the wire even if the
+                # peer's segment happens to be mappable here — that is
+                # exactly the remote-host-on-one-machine simulation the
+                # same-host fast path would otherwise silently bypass.
                 plane = SharedPlane(f"/ray_tpu_node_{os.getpid()}",
                                     create=True)
+                plane.allow_local_pull = False
             # Server first, install last: if anything here raises the
             # worker has not been touched yet.
             port = plane.store.start_transfer_server()

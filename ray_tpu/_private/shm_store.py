@@ -102,6 +102,10 @@ def _load() -> ctypes.CDLL:
     lib.shm_transfer_pull.restype = ctypes.c_int
     lib.shm_transfer_pull.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_char_p, ctypes.c_uint16]
+    lib.shm_transfer_pull_opts.restype = ctypes.c_int
+    lib.shm_transfer_pull_opts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint16, ctypes.c_int]
     lib.shm_transfer_stats.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(TransferStats)]
     _lib = lib
@@ -252,11 +256,15 @@ class ShmObjectStore:
         self._lib.shm_transfer_stats(handle, ctypes.byref(st))
         return {f[0]: getattr(st, f[0]) for f in TransferStats._fields_}
 
-    def pull_from(self, object_id: bytes, host: str, port: int) -> int:
+    def pull_from(self, object_id: bytes, host: str, port: int,
+                  allow_local: bool = True) -> int:
         """Chunked C++ pull of a remote object into this store.
-        0 = pulled, -5 = already present, <0 = failure (transfer.h)."""
-        return self._lib.shm_transfer_pull(self._handle, object_id,
-                                           host.encode(), port)
+        0 = pulled, -5 = already present, <0 = failure (transfer.h).
+        ``allow_local=False`` forces the TCP stream even when the peer's
+        segment is mappable on this machine (remote-host simulation)."""
+        return self._lib.shm_transfer_pull_opts(
+            self._handle, object_id, host.encode(), port,
+            1 if allow_local else 0)
 
     def close(self):
         self.stop_transfer_server()

@@ -68,7 +68,9 @@ def _try_transfer_fetch(worker, oid, loc_info) -> bool:
     if transfer is None or loc_info.get("shm") == plane.name:
         return False
     try:
-        rc = plane.store.pull_from(oid.binary(), transfer[0], transfer[1])
+        rc = plane.store.pull_from(
+            oid.binary(), transfer[0], transfer[1],
+            allow_local=getattr(plane, "allow_local_pull", True))
         if rc not in (0, -5):
             return False
         return _try_shm_fetch(worker, oid)
@@ -1133,7 +1135,11 @@ class Cluster:
         """Spawn a node subprocess. With ``simulate_remote_host`` the node
         gets its own shm segment instead of attaching the head's, so the
         native transfer plane (cross-host path) is exercised on one
-        machine — the reference's fake-multinode testing idea."""
+        machine — the reference's fake-multinode testing idea. The
+        simulated node's own pulls force the TCP stream (its plane sets
+        ``allow_local_pull=False``); pulls BY other processes FROM its
+        segment may still take the same-host fast path, since the gate
+        lives on the puller."""
         import os
         import tempfile
 

@@ -165,7 +165,7 @@ ShmStore* ShmStore::Create(const char* name, uint64_t capacity,
   return s;
 }
 
-ShmStore* ShmStore::Attach(const char* name) {
+ShmStore* ShmStore::Attach(const char* name, bool prefault) {
   int fd = shm_open(name, O_RDWR, 0600);
   if (fd < 0) return nullptr;
   struct stat st;
@@ -197,7 +197,7 @@ ShmStore* ShmStore::Attach(const char* name) {
   // already exist; this is PTE setup only, so it is quick) — an
   // attaching node otherwise pays a minor fault per 4K page on its
   // first pass over the segment.
-  s->StartPrefault(/*write=*/false);
+  if (prefault) s->StartPrefault(/*write=*/false);
   return s;
 }
 

@@ -38,7 +38,10 @@ void PopulateRange(const void* addr, uint64_t len, bool write,
                    const std::atomic<bool>* cancel = nullptr);
 
 constexpr uint32_t kIdSize = 20;
-constexpr uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
+// Layout version rides in the magic: v2 added `uuid` to StoreHeader
+// BEFORE the process-shared mutex, so a v1 build attaching a v2 segment
+// would lock garbage. Mixed builds must refuse to inter-attach.
+constexpr uint64_t kMagic = 0x3255505459415253ULL;  // "SRAYTPU2"
 
 enum class ObjectState : int32_t {
   kFree = 0,
@@ -79,9 +82,12 @@ struct StoreHeader;  // opaque in public API
 class ShmStore {
  public:
   // Create a new segment (unlinks existing with same name) or attach.
+  // `prefault=false` skips the background page-table populate — used by
+  // the transfer plane's peer attaches, which populate exactly the
+  // ranges they copy instead.
   static ShmStore* Create(const char* name, uint64_t capacity,
                           uint32_t max_objects);
-  static ShmStore* Attach(const char* name);
+  static ShmStore* Attach(const char* name, bool prefault = true);
   ~ShmStore();
 
   // Returns payload pointer or null (exists / no space after eviction).

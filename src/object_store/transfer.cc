@@ -16,8 +16,10 @@
 #include <cstdio>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
+#include <utility>
 
 namespace ray_tpu {
 
@@ -233,16 +235,26 @@ ShmStore* AttachPeerCached(const char* name, uint64_t uuid) {
   static std::mutex mu;
   static std::map<std::string, ShmStore*>* cache =
       new std::map<std::string, ShmStore*>();
+  // Negative cache: a (name, uuid) that attached to a DIFFERENT
+  // segment is a same-named store on another machine — without this,
+  // every pull from that peer re-mmaps and re-unmaps the whole local
+  // segment just to re-discover the mismatch.
+  static std::set<std::pair<std::string, uint64_t>>* known_foreign =
+      new std::set<std::pair<std::string, uint64_t>>();
   std::lock_guard<std::mutex> g(mu);
   auto it = cache->find(name);
   if (it != cache->end()) {
     if (it->second->uuid() == uuid) return it->second;
     cache->erase(it);  // stale; leak the old mapping (see above)
   }
-  ShmStore* s = ShmStore::Attach(name);
+  if (known_foreign->count({name, uuid})) return nullptr;
+  // No background prefault for peer attaches: TryLocalPull populates
+  // exactly the ranges it copies.
+  ShmStore* s = ShmStore::Attach(name, /*prefault=*/false);
   if (s == nullptr) return nullptr;  // not on this machine
   if (s->uuid() != uuid) {
     delete s;  // same name, different segment (other machine / rebuilt)
+    known_foreign->insert({name, uuid});
     return nullptr;
   }
   (*cache)[name] = s;
@@ -402,6 +414,13 @@ int shm_transfer_pull(void* store, const uint8_t* id, const char* host,
                       uint16_t port) {
   return ray_tpu::PullObject(static_cast<ray_tpu::ShmStore*>(store), id,
                              host, port, nullptr);
+}
+
+int shm_transfer_pull_opts(void* store, const uint8_t* id,
+                           const char* host, uint16_t port,
+                           int allow_local) {
+  return ray_tpu::PullObject(static_cast<ray_tpu::ShmStore*>(store), id,
+                             host, port, nullptr, allow_local != 0);
 }
 
 void shm_transfer_stats(void* server, ray_tpu::TransferStats* out) {

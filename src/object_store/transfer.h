@@ -113,5 +113,11 @@ void shm_transfer_stop(void* server);
 // callers that race).
 int shm_transfer_pull(void* store, const uint8_t* id, const char* host,
                       uint16_t port);
+// As above with an explicit same-host fast-path switch (allow_local=0
+// forces the TCP stream — used when simulating remote hosts on one
+// machine, where the fast path would silently bypass the wire).
+int shm_transfer_pull_opts(void* store, const uint8_t* id,
+                           const char* host, uint16_t port,
+                           int allow_local);
 void shm_transfer_stats(void* server, ray_tpu::TransferStats* out);
 }

@@ -112,7 +112,9 @@ int main() {
 
   auto st = srv->stats();
   // TCP path streamed the small object + one 1 GiB copy; the same-host
-  // pull only cost a meta round-trip (no payload bytes on the wire).
+  // pull only cost a meta round-trip (no payload bytes on the wire,
+  // no objects_served increment).
+  assert(st.objects_served == 2);
   assert(st.bytes_sent == 4096 + kGiB);
 
   srv->Stop();
