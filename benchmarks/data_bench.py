@@ -1,0 +1,88 @@
+"""Data-engine scale benchmark: GB-class random_shuffle (both paths)
+and sort (reference: `release/nightly_tests/dataset/` shuffle suites —
+theirs run 100 TB on fleets; this records the single-host engine's
+throughput so regressions and the pull-vs-push task-graph difference
+are visible).
+
+Usage: python benchmarks/data_bench.py [--gb 2]
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gb", type=float, default=2.0)
+    parser.add_argument("--blocks", type=int, default=64)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rt_data
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+
+    total_bytes = int(args.gb * 2**30)
+    rows_per_block = total_bytes // (args.blocks * 1024)  # 1KB rows
+
+    def gen_block(i):
+        rng = np.random.RandomState(i)
+        return {
+            "key": rng.randint(0, 1 << 30, rows_per_block),
+            "payload": rng.randint(0, 255,
+                                   (rows_per_block, 1016)).astype(
+                                       np.uint8),
+        }
+
+    ds = rt_data.range(args.blocks, parallelism=args.blocks) \
+        .map_batches(lambda b: gen_block(int(b["id"][0])),
+                     batch_size=None)
+    ds = ds.materialize() if hasattr(ds, "materialize") else ds
+    # Force materialization so shuffles don't re-time generation.
+    n_rows = ds.count()
+    assert n_rows == rows_per_block * args.blocks
+
+    out = {"gb": round(total_bytes / 2**30, 2), "blocks": args.blocks,
+           "rows": n_rows, "host_cpus": os.cpu_count()}
+
+    for label, kwargs in (("shuffle_pull", {"push_based": False}),
+                          ("shuffle_push", {"push_based": True})):
+        t0 = time.perf_counter()
+        shuffled = ds.random_shuffle(seed=0, **kwargs)
+        got = shuffled.count()  # drives execution to completion
+        dt = time.perf_counter() - t0
+        assert got == n_rows
+        out[f"{label}_s"] = round(dt, 2)
+        out[f"{label}_MBps"] = round(total_bytes / 2**20 / dt, 1)
+
+    t0 = time.perf_counter()
+    sorted_ds = ds.sort("key")
+    got = sorted_ds.count()
+    dt = time.perf_counter() - t0
+    assert got == n_rows
+    out["sort_s"] = round(dt, 2)
+    out["sort_MBps"] = round(total_bytes / 2**20 / dt, 1)
+
+    ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": "data_shuffle_push_MBps",
+        "value": out["shuffle_push_MBps"],
+        "unit": "MB/s",
+        "detail": out,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Plain-deployment serving micro-benchmark: RPS + latency percentiles
+for a noop deployment through the ServeHandle path, and through the HTTP
+proxy (reference: `release/serve_tests/workloads/serve_micro_benchmark.py`
+— handle/HTTP throughput on trivial deployments, the serving control
+plane's overhead floor distinct from any model cost).
+
+Usage: python benchmarks/serve_rps_bench.py [--requests 300]
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def percentile(sorted_vals, q):
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=300)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument("--replicas", type=int, default=2)
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+
+    @serve.deployment(num_replicas=args.replicas,
+                      max_concurrent_queries=32)
+    class Noop:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    handle = serve.run(Noop.bind(), route_prefix="/noop")
+
+    # -- handle path ------------------------------------------------------
+    lat = []
+    lock = threading.Lock()
+    # warmup
+    ray_tpu.get(handle.remote("w"))
+
+    def worker(n):
+        for i in range(n):
+            t0 = time.perf_counter()
+            out = ray_tpu.get(handle.remote(i))
+            dt = time.perf_counter() - t0
+            assert out["echo"] == i
+            with lock:
+                lat.append(dt)
+
+    per = args.requests // args.concurrency
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(per,))
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    handle_stats = {
+        "rps": round(len(lat) / wall, 1),
+        "p50_ms": round(percentile(lat, 0.5) * 1e3, 2),
+        "p95_ms": round(percentile(lat, 0.95) * 1e3, 2),
+        "requests": len(lat),
+    }
+
+    # -- HTTP proxy path --------------------------------------------------
+    import json as _json
+    import urllib.request
+
+    proxy = serve.start_http_proxy()
+    url = f"http://127.0.0.1:{proxy.port}/noop"
+    http_lat = []
+
+    def http_worker(n):
+        for i in range(n):
+            t0 = time.perf_counter()
+            body = _json.dumps({"payload": i}).encode()
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type":
+                                         "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            with lock:
+                http_lat.append(time.perf_counter() - t0)
+
+    http_n = max(100, args.requests // 3)
+    per = http_n // 4
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=http_worker, args=(per,))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    http_wall = time.perf_counter() - t0
+    http_lat.sort()
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    print(json.dumps({
+        "metric": "serve_noop_handle_rps",
+        "value": handle_stats["rps"],
+        "unit": "requests/s",
+        "detail": {
+            "handle": handle_stats,
+            "http": {
+                "rps": round(len(http_lat) / http_wall, 1),
+                "p50_ms": round(percentile(http_lat, 0.5) * 1e3, 2),
+                "p95_ms": round(percentile(http_lat, 0.95) * 1e3, 2),
+                "requests": len(http_lat),
+            },
+            "replicas": args.replicas,
+            "concurrency": args.concurrency,
+            "host_cpus": os.cpu_count(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache.
+
+Role-equivalent to the reference's lack of one — serving cold starts
+there are hidden by long-lived GPU replicas; on TPU the first request
+hitting an uncompiled program costs the full XLA compile (measured 14 s
+TTFT for the LLM engine in round 3). Enabling JAX's on-disk compilation
+cache makes every process after the first load compiled executables
+instead of recompiling, and `LLMEngine.warmup()` moves the remaining
+first-process compile to deploy time.
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+DEFAULT_DIR = os.environ.get(
+    "RAY_TPU_COMPILE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu_xla"))
+
+
+def enable_persistent_cache(path: str | None = None) -> bool:
+    """Idempotently point JAX at an on-disk compilation cache. Returns
+    True if the cache is active. Set RAY_TPU_COMPILE_CACHE="" to opt
+    out."""
+    global _enabled
+    if _enabled:
+        return True
+    target = DEFAULT_DIR if path is None else path
+    if not target:
+        return False  # explicitly disabled
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu" and \
+                not os.environ.get("RAY_TPU_COMPILE_CACHE"):
+            # CPU AOT results are machine-feature-sensitive (XLA warns
+            # mismatched loads "could lead to SIGILL"); the cache's win
+            # is on accelerators, so CPU only opts in explicitly.
+            return False
+        os.makedirs(target, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", target)
+        # Cache even quick compiles: the serving path compiles many
+        # small-bucket programs whose combined cost is what hurts.
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.2)
+        except Exception:
+            pass  # older knob name; the dir alone still works
+        _enabled = True
+        return True
+    except Exception:
+        return False

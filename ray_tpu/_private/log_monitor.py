@@ -1,0 +1,90 @@
+"""Driver-side log mirroring.
+
+Role-equivalent to the reference's log monitor
+(`python/ray/_private/log_monitor.py:1`): worker/node output is written
+to per-node files; the driver tails them and re-prints each line with a
+node prefix, so `print()` inside a task on any node shows up in the
+driver's terminal — the reference's day-one usability contract.
+
+The monitor polls registered files (cheap: one stat per file per tick)
+and survives rotation/truncation by re-seeking when the file shrinks.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Callable, Dict, Optional
+
+
+class LogMonitor:
+    def __init__(self, *, poll_interval_s: float = 0.25,
+                 sink: Optional[Callable[[str], None]] = None):
+        self._files: Dict[str, str] = {}  # prefix -> path
+        self._offsets: Dict[str, int] = {}
+        self._interval = poll_interval_s
+        self._sink = sink or (lambda line: print(line, flush=True))
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._partial: Dict[str, str] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def add_file(self, prefix: str, path: str) -> None:
+        with self._lock:
+            self._files[prefix] = path
+            self._offsets.setdefault(prefix, 0)
+
+    def start(self) -> "LogMonitor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="log-monitor")
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=5)
+        if drain:
+            self._poll_once()  # final sweep: exit output must not vanish
+
+    # -- internals -------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._poll_once()
+            self._stop.wait(self._interval)
+
+    def _poll_once(self):
+        with self._lock:
+            files = dict(self._files)
+        for prefix, path in files.items():
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(prefix, 0)
+            if size < offset:
+                offset = 0  # truncated/rotated: start over
+            if size == offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(min(size - offset, 1 << 20))
+            except OSError:
+                continue
+            self._offsets[prefix] = offset + len(chunk)
+            text = self._partial.pop(prefix, "") + \
+                chunk.decode("utf-8", "replace")
+            lines = text.split("\n")
+            # Hold back a trailing partial line until its newline lands.
+            if lines and lines[-1]:
+                self._partial[prefix] = lines[-1]
+            for line in lines[:-1]:
+                if line:
+                    self._sink(f"({prefix}) {line}")

@@ -1088,7 +1088,8 @@ class Cluster:
 
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None,
-                 shm_capacity: Optional[int] = None):
+                 shm_capacity: Optional[int] = None,
+                 log_to_driver: bool = True):
         import os
 
         head_node_args = head_node_args or {}
@@ -1122,6 +1123,13 @@ class Cluster:
         self._procs: Dict[str, subprocess.Popen] = {}
         self._logs: Dict[str, str] = {}
         self._counter = 0
+        # Driver log mirroring (reference log_monitor.py role): node
+        # subprocess output re-prints here with a node prefix.
+        self._log_monitor = None
+        if log_to_driver:
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            self._log_monitor = LogMonitor().start()
 
     @property
     def address(self) -> str:
@@ -1174,6 +1182,8 @@ class Cluster:
         log_f.close()
         self._procs[node_id] = proc
         self._logs[node_id] = log_path
+        if self._log_monitor is not None:
+            self._log_monitor.add_file(node_id, log_path)
         if wait:
             # Generous deadline: imports alone can take tens of seconds
             # on a busy single-core box.
@@ -1244,6 +1254,9 @@ class Cluster:
         self.head.stop()
         for node_id in list(self._procs):
             self.remove_node(node_id)
+        if self._log_monitor is not None:
+            self._log_monitor.stop()  # final drain catches exit output
+            self._log_monitor = None
         self.head.server.shutdown()
         if self.shm_plane is not None:
             # Detach from the worker first (new fetches skip shm), then

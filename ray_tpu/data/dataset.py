@@ -184,9 +184,13 @@ class Dataset:
         return Dataset(self._plan.with_op(Repartition(
             name="Repartition", num_blocks=num_blocks)))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        return Dataset(self._plan.with_op(RandomShuffle(name="RandomShuffle",
-                                                        seed=seed)))
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       push_based: Optional[bool] = None) -> "Dataset":
+        """Global random shuffle. ``push_based`` selects the two-stage
+        pipelined-merge shuffle (reference push_based_shuffle.py);
+        None defers to RAY_TPU_PUSH_BASED_SHUFFLE."""
+        return Dataset(self._plan.with_op(RandomShuffle(
+            name="RandomShuffle", seed=seed, push_based=push_based)))
 
     def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
         import random as _random

@@ -201,12 +201,20 @@ class ServeController:
         if not cfg:
             return
         m = self._metrics.get(st.name)
-        if not m or time.monotonic() - m.get("ts", 0) > 10:
+        if not m:
             return
+        # Routers report continuously while anything is queued or in
+        # flight (Router._report_loop) and send a final 0 on drain, so
+        # scale-down normally rides FRESH zero reports. The stale branch
+        # is only the backstop for a vanished driver/router — generous
+        # threshold so a mid-request deployment whose router hiccups is
+        # never torn down under its callers.
+        stale = time.monotonic() - m.get("ts", 0) > 30
+        queued = 0.0 if stale else m["queued"]
         target_in_flight = cfg.get("target_num_ongoing_requests_per_replica",
                                    1.0)
         current = max(1, len(st.replicas))
-        desired = m["queued"] / max(target_in_flight, 1e-6)
+        desired = queued / max(target_in_flight, 1e-6)
         desired = int(min(max(desired, cfg.get("min_replicas", 1)),
                           cfg.get("max_replicas", current)))
         if desired != st.info.get("num_replicas"):

@@ -31,6 +31,17 @@ class Router:
             controller, f"replicas::{deployment_name}",
             self._update_replicas)
         self._last_report = 0.0
+        self._waiting = 0  # callers blocked on a free replica slot
+        # Periodic reporter: long-running requests dispatch once and then
+        # produce no assign_request traffic, which would let the metric
+        # go stale while replicas are mid-request (the controller reads
+        # stale as idle). Reports continue while anything is in flight
+        # and send one final 0 when drained.
+        self._reporter_stop = threading.Event()
+        self._reporter = threading.Thread(
+            target=self._report_loop, daemon=True,
+            name=f"router-metrics-{deployment_name}")
+        self._reporter.start()
 
     def _update_replicas(self, replicas):
         with self._lock:
@@ -50,41 +61,73 @@ class Router:
     def assign_request(self, method: str, args: tuple, kwargs: dict,
                        timeout: float = 30.0):
         deadline = time.monotonic() + timeout
-        while True:
-            with self._lock:
-                replicas = list(self._replicas)
-            if replicas:
-                n = len(replicas)
-                start = next(self._rr)
-                for i in range(n):
-                    replica = replicas[(start + i) % n]
-                    with self._lock:
-                        load = self._prune(replica)
-                        if load < self._max_concurrent:
-                            ref = replica.handle_request.remote(
-                                method, args, kwargs)
-                            self._in_flight[replica].append(ref)
-                            self._maybe_report()
-                            return ref
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica available for {self._deployment} "
-                    f"within {timeout}s")
-            time.sleep(0.005)
+        dispatched = False
+        with self._lock:
+            self._waiting += 1
+        try:
+            while True:
+                with self._lock:
+                    replicas = list(self._replicas)
+                if replicas:
+                    n = len(replicas)
+                    start = next(self._rr)
+                    for i in range(n):
+                        replica = replicas[(start + i) % n]
+                        with self._lock:
+                            load = self._prune(replica)
+                            if load < self._max_concurrent:
+                                ref = replica.handle_request.remote(
+                                    method, args, kwargs)
+                                self._in_flight[replica].append(ref)
+                                # No longer waiting once dispatched —
+                                # counting both would double this
+                                # request in the autoscaling signal.
+                                self._waiting -= 1
+                                dispatched = True
+                                self._maybe_report()
+                                return ref
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replica available for {self._deployment} "
+                        f"within {timeout}s")
+                # Saturated: no dispatch happens, but pressure must
+                # still reach the autoscaler — waiting requests ARE the
+                # scale-up signal (reference: handle queue metrics count
+                # queued + ongoing, `_private/autoscaling_metrics.py`).
+                with self._lock:
+                    self._maybe_report()
+                time.sleep(0.005)
+        finally:
+            if not dispatched:
+                with self._lock:
+                    self._waiting -= 1
 
     def _maybe_report(self):
         now = time.monotonic()
         if now - self._last_report < 0.5:
             return
         self._last_report = now
-        total = sum(len(v) for v in self._in_flight.values())
+        total = sum(len(v) for v in self._in_flight.values()) \
+            + self._waiting
         try:
             self._controller.record_handle_metrics.remote(
                 self._deployment, float(total))
         except Exception:
             pass
 
+    def _report_loop(self):
+        was_busy = False
+        while not self._reporter_stop.wait(1.0):
+            with self._lock:
+                busy = self._waiting > 0 or any(
+                    self._prune(r) for r in list(self._in_flight))
+                if busy or was_busy:  # final 0 on the drain edge
+                    self._last_report = 0.0  # bypass the rate limit
+                    self._maybe_report()
+                was_busy = busy
+
     def shutdown(self):
+        self._reporter_stop.set()
         self._client.stop()
 
 

@@ -106,11 +106,95 @@ class LLMEngine:
         # closing over them would bake the full weight set into every
         # compiled program as constants (one 2.5GB copy per prefill
         # bucket), exploding compile time and HBM.
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,),
-                                static_argnames=("t",))
+        from ray_tpu._private.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()  # re-deploys load, not recompile
+        # Pin the small-argument shardings at the jit boundary: the
+        # serving loop alternates host-built arrays (admission refreshes
+        # temps/last) with device carries (pipelined decode outputs),
+        # whose differing shardings otherwise key DISTINCT compiled
+        # variants — round 3's cold wave recompiled prefill/decode many
+        # times over (19 prefill + 6 decode cache entries for what
+        # should be 11 + 1 programs), serializing the first ~70 s of
+        # traffic behind XLA.
+        s1 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        # Canonicalize params too: weights initialized onto a training
+        # mesh carry a NamedSharding whose axes leak into every jit
+        # OUTPUT's aval type; warmup (plain inputs) and the serving loop
+        # (mesh-typed carries) then trace as DIFFERENT signatures and
+        # each program compiles twice. One engine = one device = one
+        # sharding vocabulary. (No-op copy when already single-device.)
+        self.params = jax.device_put(self.params, s1)
+        self.cache = jax.device_put(self.cache, s1)
+        self._rng = jax.device_put(self._rng, s1)
+        self._decode = jax.jit(
+            self._decode_impl, donate_argnums=(1,),
+            in_shardings=(None, s1, s1, s1, s1, s1, s1),
+            out_shardings=(s1, s1, s1, s1, s1))
+        self._prefill = jax.jit(
+            self._prefill_impl, donate_argnums=(1,),
+            static_argnums=(5,),  # t — positional: pjit rejects kwargs
+            in_shardings=(None, s1, s1, s1, s1),  # with in_shardings
+            out_shardings=(s1, s1))
+        # First-token sampling for an admission wave — FIXED shape
+        # [n_slots, vocab] (padded) so it is ONE program compiled at
+        # warmup; the old eager stack/categorical/argmax chain compiled
+        # a fresh variant per distinct admitted-count, which on a
+        # high-compile-latency platform serialized the first real
+        # admission wave for tens of seconds.
+        self._sample_admitted = jax.jit(
+            self._sample_admitted_impl,
+            in_shardings=(s1, s1, s1), out_shardings=(s1, s1))
+
+    def warmup(self, max_prompt_len: Optional[int] = None) -> float:
+        """Compile every program the serving path needs BEFORE the first
+        request (deploy-time AOT): prefill at each power-of-two bucket up
+        to ``max_prompt_len`` (default max_seq) plus the decode body.
+        Must run before :meth:`start`. Returns the wall seconds spent —
+        with the persistent compilation cache this is seconds on the
+        first deploy of a config and near-zero afterwards."""
+        assert self._thread is None or not self._thread.is_alive(), \
+            "warmup() must run before the engine loop starts"
+        t0 = time.perf_counter()
+        limit = min(max_prompt_len or self.max_seq, self.max_seq)
+        buckets, b = [], 1
+        while b < limit:
+            buckets.append(b)
+            b *= 2
+        buckets.append(min(b, self.max_seq))  # _admit's cap bucket
+        last = None
+        for bucket in sorted(set(buckets)):
+            tokens = jnp.zeros((1, bucket), jnp.int32)
+            self.cache, last = self._prefill(
+                self.params, self.cache, tokens, jnp.int32(0),
+                jnp.int32(1), bucket)
+        # Admission-wave sampling program (and its eager stack feeder).
+        stacked = jnp.stack([last] * self.n_slots)
+        _firsts, self._rng = self._sample_admitted(
+            stacked, jnp.asarray(np.zeros(self.n_slots, np.float32)),
+            self._rng)
+        (self.cache, toks, _last, _lens, self._rng) = self._decode(
+            self.params, self.cache,
+            jnp.zeros(self.n_slots, jnp.int32),
+            jnp.zeros(self.n_slots, jnp.int32),
+            jnp.zeros(self.n_slots, jnp.float32),
+            jnp.zeros(self.n_slots, jnp.int32), self._rng)
+        np.asarray(toks)  # host fetch = the only reliable barrier
+        # Warmup wrote garbage KV into slot 0; lengths stay 0 so every
+        # slot still reads as empty when serving starts.
+        return time.perf_counter() - t0
 
     # -- compiled bodies -------------------------------------------------
+
+    def _sample_admitted_impl(self, logits, temps, rng):
+        """logits [n_slots, vocab], temps [n_slots] → first token per
+        row (greedy at temp 0). Rows beyond the admitted count are
+        padding and ignored host-side."""
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None])
+        firsts = jnp.where(temps > 0, sampled, logits.argmax(-1))
+        return firsts.astype(jnp.int32), rng
 
     def _prefill_impl(self, params, cache, tokens, slot, length, t):
         """tokens: [1, t] padded prompt; writes KV for one slot, returns
@@ -264,21 +348,24 @@ class LLMEngine:
             tokens[0, :t_real] = prompt
             self.cache, last_logits = self._prefill(
                 self.params, self.cache, jnp.asarray(tokens),
-                jnp.int32(slot), jnp.int32(t_real), t=bucket)
+                jnp.int32(slot), jnp.int32(t_real), bucket)
             staged.append((req, slot, t_real, last_logits))
         if not staged:
             return False
         # ONE device-side sampling + ONE host sync for the whole wave:
         # per-admit argmax fetches would serialize a tunnel round-trip
-        # per request (the dominant pre-first-token cost).
-        logits = jnp.stack([s[3] for s in staged])  # [n, vocab]
-        temps = jnp.asarray([s[0].params.temperature for s in staged],
-                            jnp.float32)
-        self._rng, sub = jax.random.split(self._rng)
-        sampled = jax.random.categorical(
-            sub, logits / jnp.maximum(temps, 1e-6)[:, None])
-        firsts = np.asarray(jnp.where(temps > 0, sampled,
-                                      logits.argmax(-1)))
+        # per request (the dominant pre-first-token cost). Padded to
+        # n_slots so the program (and the eager stack feeding it) has
+        # one fixed shape, compiled once at warmup.
+        pad = self.n_slots - len(staged)
+        logits = jnp.stack([s[3] for s in staged]
+                           + [staged[0][3]] * pad)  # [n_slots, vocab]
+        temps_np = np.zeros(self.n_slots, np.float32)
+        for i, s in enumerate(staged):
+            temps_np[i] = s[0].params.temperature
+        firsts_dev, self._rng = self._sample_admitted(
+            logits, jnp.asarray(temps_np), self._rng)
+        firsts = np.asarray(firsts_dev)[:len(staged)]
         now = time.perf_counter()
         for (req, slot, t_real, _), first in zip(staged, firsts):
             first = int(first)
@@ -378,11 +465,20 @@ class LLMDeployment:
     def __init__(self, cfg: LlamaConfig, params_fn: Callable[[], Any],
                  max_batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
-                 decode_steps: int = 1):
+                 decode_steps: int = 1,
+                 warmup: bool = True,
+                 warmup_max_prompt_len: Optional[int] = None):
         params = params_fn() if callable(params_fn) else params_fn
         self.engine = LLMEngine(cfg, params, max_batch_size=max_batch_size,
                                 max_seq_len=max_seq_len,
                                 decode_steps=decode_steps)
+        # Deploy-time AOT: compile prefill buckets + decode BEFORE the
+        # replica takes traffic, so the first request's TTFT is serving
+        # latency, not XLA compile (round 3 measured 14 s cold TTFT).
+        # With the persistent compilation cache, re-deploys of the same
+        # config warm up in well under a second.
+        self.warmup_s = self.engine.warmup(warmup_max_prompt_len) \
+            if warmup else 0.0
         self.engine.start()
 
     def __call__(self, request: Dict[str, Any]):

@@ -14,8 +14,11 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (  # noqa: F401

@@ -72,7 +72,7 @@ class TrialRunner:
 
     def _start_trial(self, trial: Trial,
                      checkpoint: Optional[Checkpoint] = None):
-        res = dict(self.resources_per_trial)
+        res = dict(trial.resources or self.resources_per_trial)
         opts: Dict[str, Any] = {"num_cpus": res.pop("CPU", 1),
                                 "max_restarts": 0}
         if "TPU" in res:
@@ -130,6 +130,23 @@ class TrialRunner:
         trial.config = new_config
         self._start_trial(trial, checkpoint=donor_ckpt)
         self._submit(trial)
+
+    def update_trial_resources(self, trial: Trial,
+                               resources: Dict[str, float]):
+        """Checkpoint + restart `trial` with new resources
+        (ResourceChangingScheduler's apply step — the reference likewise
+        restarts from checkpoint; resources can't change under a live
+        actor). Called from a scheduler's on_trial_result: the trial is
+        left RUNNING and NOT resubmitted here — _handle_result's normal
+        RUNNING branch issues the next train() (submitting here too
+        would leave two concurrent futures training the trial at 2x)."""
+        self._save_checkpoint_from(trial)
+        for fut, t in list(self._in_flight.items()):
+            if t is trial:
+                del self._in_flight[fut]
+        self._stop_trial(trial, Trial.PENDING)
+        trial.resources = dict(resources)
+        self._start_trial(trial, checkpoint=trial.checkpoint)
 
     def _save_checkpoint_from(self, donor: Trial):
         if donor.actor is not None:

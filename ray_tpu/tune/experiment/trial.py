@@ -37,6 +37,9 @@ class Trial:
         self.checkpoint_manager = CheckpointManager(checkpoint_config)
         self.actor = None  # runner-owned
         self.metric_history: Dict[str, List[float]] = {}
+        # Per-trial resource override (ResourceChangingScheduler); None
+        # falls back to the runner-wide resources_per_trial.
+        self.resources: Optional[Dict[str, float]] = None
 
     @property
     def checkpoint(self) -> Optional[Checkpoint]:

@@ -1,0 +1,65 @@
+"""Driver log mirroring (reference `_private/log_monitor.py` role):
+print() inside a task on a cluster node shows up at the driver with a
+node prefix."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_node_prints_mirror_to_driver():
+    lines = []
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    # swap the sink so the test can assert instead of reading stdout
+    cluster._log_monitor._sink = lines.append
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=2)
+        def chatty():
+            print("hello-from-the-node")
+            return 1
+
+        assert ray_tpu.get(chatty.remote()) == 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any("hello-from-the-node" in l for l in lines):
+                break
+            time.sleep(0.1)
+        matching = [l for l in lines if "hello-from-the-node" in l]
+        assert matching, lines[-5:]
+        assert matching[0].startswith("(node-1) "), matching[0]
+    finally:
+        cluster.shutdown()
+
+
+def test_monitor_handles_partial_lines_and_truncation(tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    out = []
+    mon = LogMonitor(poll_interval_s=0.05, sink=out.append)
+    p = tmp_path / "node.log"
+    p.write_bytes(b"")
+    mon.add_file("n", str(p))
+    mon.start()
+    try:
+        with open(p, "ab", buffering=0) as f:
+            f.write(b"part")        # no newline yet: must be held back
+            time.sleep(0.2)
+            assert out == []
+            f.write(b"ial line\nsecond\n")
+        deadline = time.monotonic() + 5
+        while len(out) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert out == ["(n) partial line", "(n) second"]
+        # truncation: monitor re-reads from the top
+        p.write_bytes(b"fresh\n")
+        deadline = time.monotonic() + 5
+        while len(out) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert out[-1] == "(n) fresh"
+    finally:
+        mon.stop(drain=False)

@@ -1,0 +1,115 @@
+"""Serve under load: replica autoscaling driven by real queue pressure,
+and the max_concurrent_queries in-flight cap under stress (reference:
+`serve/_private/autoscaling_policy.py` + router concurrency caps,
+exercised by `release/serve_tests`)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def _wait_for(predicate, timeout=60.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_autoscaling_scales_up_under_load_then_down():
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_num_ongoing_requests_per_replica": 2},
+        max_concurrent_queries=2)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    handle = serve.run(Slow.bind())
+
+    def replica_count():
+        return serve.status()["Slow"]["num_replicas"]
+
+    assert replica_count() == 1
+
+    # Sustained pressure: a rolling window of in-flight requests keeps
+    # the router's queue metric high while the controller reconciles.
+    stop = threading.Event()
+    errors = []
+
+    def pound():
+        while not stop.is_set():
+            try:
+                ray_tpu.get(handle.remote(1), timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=pound) for _ in range(12)]
+    for t in threads:
+        t.start()
+    try:
+        assert _wait_for(lambda: replica_count() >= 2, timeout=60), \
+            f"never scaled up: {serve.status()}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[0]
+
+    # Load gone: the controller must scale back toward min_replicas.
+    assert _wait_for(lambda: replica_count() == 1, timeout=60), \
+        f"never scaled down: {serve.status()}"
+
+
+def test_max_concurrent_queries_cap_under_stress():
+    observed = {"max": 0, "now": 0}
+    lock = threading.Lock()
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=2)
+    class Capped:
+        def __call__(self, x):
+            with lock:
+                observed["now"] += 1
+                observed["max"] = max(observed["max"], observed["now"])
+            time.sleep(0.05)
+            with lock:
+                observed["now"] -= 1
+            return x
+
+    handle = serve.run(Capped.bind())
+
+    results = []
+
+    def fire(i):
+        results.append(ray_tpu.get(handle.remote(i), timeout=120))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(30)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == list(range(30))
+    # The router's per-replica in-flight cap bounds concurrency inside
+    # the replica. (Replicas run in-process here, so the closure's
+    # counter observes true concurrency.)
+    assert observed["max"] <= 2, observed
