@@ -50,7 +50,13 @@ class LogMonitor:
                 and t is not threading.current_thread():
             t.join(timeout=5)
         if drain:
-            self._poll_once()  # final sweep: exit output must not vanish
+            # Final sweep: exit output must not vanish. Each pass reads
+            # at most 1 MB per file, so loop until nothing advances.
+            for _ in range(64):
+                before = dict(self._offsets)
+                self._poll_once()
+                if self._offsets == before:
+                    break
 
     # -- internals -------------------------------------------------------
 

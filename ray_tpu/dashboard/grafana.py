@@ -1,0 +1,113 @@
+"""Grafana dashboard generation.
+
+Reference: `dashboard/modules/metrics/grafana_dashboard_factory.py` —
+emits importable Grafana dashboard JSON whose panels query the same
+Prometheus metrics the framework exports (`util/metrics.py` text
+exposition), so `ray_tpu metrics → Prometheus scrape → Grafana` works
+out of the box without hand-building panels.
+
+`generate_default_dashboard()` builds the core-runtime dashboard
+(tasks/actors/objects/shm); `generate_dashboard(panels)` builds one for
+arbitrary registered metrics. `write_dashboards(dir)` drops the JSON
+files a Grafana provisioning directory expects.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+_PANEL_W, _PANEL_H = 12, 8
+
+
+def _panel(panel_id: int, title: str, exprs: List[Tuple[str, str]], *,
+           unit: str = "short", x: int = 0, y: int = 0) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus",
+                       "uid": "${datasource}"},
+        "gridPos": {"h": _PANEL_H, "w": _PANEL_W, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+
+
+def generate_dashboard(title: str,
+                       panels_spec: List[dict],
+                       uid: Optional[str] = None) -> dict:
+    """panels_spec: [{"title", "exprs": [(promql, legend)], "unit"?}]."""
+    panels = []
+    for i, spec in enumerate(panels_spec):
+        panels.append(_panel(
+            i + 1, spec["title"], spec["exprs"],
+            unit=spec.get("unit", "short"),
+            x=(i % 2) * _PANEL_W, y=(i // 2) * _PANEL_H))
+    return {
+        "uid": uid or title.lower().replace(" ", "-")[:40],
+        "title": title,
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def generate_default_dashboard() -> dict:
+    """The core-runtime dashboard over the canonical metrics
+    (`_private/runtime_metrics.py` — the metric_defs.cc role)."""
+    return generate_dashboard("ray_tpu core", [
+        {"title": "Tasks by state",
+         "exprs": [('sum(ray_tpu_tasks) by (state)', "{{state}}")]},
+        {"title": "Actors by state",
+         "exprs": [('sum(ray_tpu_actors) by (state)', "{{state}}")]},
+        {"title": "Object store",
+         "exprs": [("ray_tpu_object_store_objects", "objects"),
+                   ("ray_tpu_object_store_spilled_objects", "spilled")]},
+        {"title": "Object store bytes", "unit": "bytes",
+         "exprs": [("ray_tpu_object_store_bytes", "bytes")]},
+        {"title": "Shared-memory segment", "unit": "bytes",
+         "exprs": [("ray_tpu_shm_allocated", "allocated"),
+                   ("ray_tpu_shm_capacity", "capacity")]},
+        {"title": "Cluster resources",
+         "exprs": [('sum(ray_tpu_resources_available) by (resource)',
+                    "available {{resource}}"),
+                   ('sum(ray_tpu_resources_total) by (resource)',
+                    "total {{resource}}")]},
+    ], uid="ray-tpu-core")
+
+
+def generate_serve_dashboard() -> dict:
+    return generate_dashboard("ray_tpu serve", [
+        {"title": "Deployment replicas",
+         "exprs": [('sum(ray_tpu_serve_replicas) by (deployment)',
+                    "{{deployment}}")]},
+        {"title": "Handle queue depth",
+         "exprs": [('sum(ray_tpu_serve_queued) by (deployment)',
+                    "{{deployment}}")]},
+    ], uid="ray-tpu-serve")
+
+
+def write_dashboards(directory: str) -> List[str]:
+    """Write all generated dashboards into a Grafana provisioning dir;
+    returns the file paths."""
+    os.makedirs(directory, exist_ok=True)
+    out = []
+    for dash in (generate_default_dashboard(),
+                 generate_serve_dashboard()):
+        path = os.path.join(directory, f"{dash['uid']}.json")
+        with open(path, "w") as f:
+            json.dump(dash, f, indent=2)
+        out.append(path)
+    return out

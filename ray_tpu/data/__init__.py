@@ -23,8 +23,10 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask  # noqa: F401
 from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401

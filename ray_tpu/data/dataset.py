@@ -628,6 +628,27 @@ def read_tfrecords(paths, *, parallelism: int = -1, **opts) -> Dataset:
         parallelism=parallelism)]))
 
 
+def read_webdataset(paths, *, parallelism: int = -1, **opts) -> Dataset:
+    """Tar shards in the WebDataset sample convention (reference
+    `data/read_api.py` read_webdataset)."""
+    return Dataset(ExecutionPlan([Read(
+        name="ReadWebDataset",
+        datasource=ds_mod.WebDatasetDatasource(paths, **opts),
+        parallelism=parallelism)]))
+
+
+def read_sql(sql: str, connection_factory, *,
+             queries: Optional[List[str]] = None,
+             parallelism: int = -1) -> Dataset:
+    """DBAPI-2 query read (reference `data/read_api.py` read_sql);
+    ``queries`` gives caller-partitioned parallel reads."""
+    return Dataset(ExecutionPlan([Read(
+        name="ReadSQL",
+        datasource=ds_mod.SQLDatasource(sql, connection_factory,
+                                        queries=queries),
+        parallelism=parallelism)]))
+
+
 def read_datasource(datasource: ds_mod.Datasource, *,
                     parallelism: int = -1) -> Dataset:
     return Dataset(ExecutionPlan([Read(

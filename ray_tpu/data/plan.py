@@ -191,12 +191,11 @@ def _concat_blocks(*parts):
     return BlockAccessor.concat(list(parts))
 
 
-@ray_tpu.remote
-def _merge_partials(*parts):
-    """Push-based shuffle merge: combine one reducer's partials from
-    every mapper in one round (each arg is already just that reducer's
-    slice — see num_returns in _random_shuffle_push)."""
-    return BlockAccessor.concat(list(parts))
+# Push-based shuffle merge: combine one reducer's partials from every
+# mapper in one round (each arg is already just that reducer's slice —
+# see num_returns in _random_shuffle_push). Same body as a concat, so it
+# IS the concat task under a stage-specific alias.
+_merge_partials = _concat_blocks
 
 
 @ray_tpu.remote
@@ -256,12 +255,41 @@ class ExecutionPlan:
         executor = getattr(self, "_streaming_executor", None)
         return executor.stats() if executor else []
 
-    # -- fusion ----------------------------------------------------------
+    # -- logical optimizer + fusion --------------------------------------
+
+    @staticmethod
+    def _optimize(ops: List[LogicalOp]) -> List[LogicalOp]:
+        """Logical rewrite rules (reference
+        `data/_internal/logical/optimizers.py`), applied before fusion:
+
+        - consecutive RandomShuffles collapse to the last (a second
+          global shuffle of a uniform permutation adds nothing);
+        - consecutive Repartitions collapse to the last.
+
+        NOT a rule here: dropping a shuffle before a sort — the sort
+        pipeline is stable end to end, so shuffle-then-sort observably
+        randomizes the order WITHIN equal-key groups and removing it
+        would silently change results.
+        """
+        out: List[LogicalOp] = []
+        for op in ops:
+            if out:
+                prev = out[-1]
+                if isinstance(op, RandomShuffle) and \
+                        isinstance(prev, RandomShuffle):
+                    out[-1] = op
+                    continue
+                if isinstance(op, Repartition) and \
+                        isinstance(prev, Repartition):
+                    out[-1] = op
+                    continue
+            out.append(op)
+        return out
 
     def _fused_stages(self) -> List[LogicalOp]:
         """Fuse consecutive MapBlocks with the same compute strategy."""
         stages: List[LogicalOp] = []
-        for op in self.ops:
+        for op in self._optimize(self.ops):
             if (isinstance(op, MapBlocks) and stages
                     and isinstance(stages[-1], MapBlocks)
                     and stages[-1].compute is None and op.compute is None):

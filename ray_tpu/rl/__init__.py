@@ -16,6 +16,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     A2CConfig,
     APPO,
     APPOConfig,
+    ApexDQN,
+    ApexDQNConfig,
     BC,
     BCConfig,
     DQN,

@@ -11,3 +11,7 @@ from ray_tpu.rl.algorithms.bc import (  # noqa: F401
     MARWILConfig,
 )
 from ray_tpu.rl.algorithms.td3 import TD3, TD3Config  # noqa: F401
+from ray_tpu.rl.algorithms.apex_dqn import (  # noqa: F401
+    ApexDQN,
+    ApexDQNConfig,
+)

@@ -1,0 +1,194 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Reference: `rllib/algorithms/apex_dqn/apex_dqn.py` (Horgan et al.) — the
+three pieces that distinguish Ape-X from plain DQN:
+
+1. Replay is SHARDED across dedicated replay actors; rollout batches are
+   pushed to a shard as they land (actor-side prioritization on insert),
+   so buffer memory and sampling throughput scale horizontally.
+2. Sampling and learning are fully asynchronous: rollout futures and
+   replay-sample futures stay in flight simultaneously; the learner
+   consumes whichever sampled minibatch arrives first and ships updated
+   priorities back to the owning shard.
+3. Per-worker exploration epsilons (worker i explores at a fixed
+   eps_i = base ** (1 + i/(n-1) * alpha) instead of a global schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, _dqn_update
+from ray_tpu.rl.replay_buffer import (
+    PrioritizedReplayBuffer,
+    flatten_fragments,
+)
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = ApexDQN
+        self.prioritized_replay = True
+        self.num_replay_shards = 2
+        self.replay_sample_inflight = 4  # sample futures kept in flight
+        # Horgan et al. per-worker epsilon ladder.
+        self.worker_eps_base = 0.4
+        self.worker_eps_alpha = 7.0
+
+
+@ray_tpu.remote
+class ReplayShard:
+    """One shard of the distributed prioritized replay (reference: the
+    replay actors `ApexDQN` creates via `ReplayBuffer.as_remote()`)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.buffer = PrioritizedReplayBuffer(capacity, seed=seed)
+
+    def add(self, batch_dict: Dict[str, Any]) -> int:
+        self.buffer.add(SampleBatch(batch_dict))
+        return len(self.buffer)
+
+    def sample(self, n: int):
+        if len(self.buffer) < n:
+            return None
+        return dict(self.buffer.sample(n))
+
+    def update_priorities(self, idx, prios) -> bool:
+        self.buffer.update_priorities(np.asarray(idx), np.asarray(prios))
+        return True
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+
+class ApexDQN(DQN):
+    config_cls = ApexDQNConfig
+
+    def build_components(self):
+        super().build_components()
+        cfg = self.algo_config
+        self.buffer = None  # replaced by the shard fleet
+        self.shards = [
+            ReplayShard.remote(
+                max(1, cfg.buffer_size // cfg.num_replay_shards),
+                seed=cfg.seed + i)
+            for i in range(cfg.num_replay_shards)
+        ]
+        self._next_shard = 0
+        self._sample_futs: List = []   # (shard, future)
+        self._rollout_futs: List = []  # (worker, future)
+        self._worker_eps = [
+            cfg.worker_eps_base ** (
+                1 + (i / max(1, len(self.workers.workers) - 1))
+                * cfg.worker_eps_alpha)
+            for i in range(len(self.workers.workers))
+        ]
+
+    def _push_rollouts(self):
+        """Keep one rollout future in flight per worker at its OWN
+        epsilon; landed batches go to replay shards round-robin."""
+        steps = 0
+        if not self._rollout_futs:
+            self._rollout_futs = [
+                (w, w.sample.remote(
+                    ray_tpu.put((self.params, jnp.float32(eps)))))
+                for w, eps in zip(self.workers.workers, self._worker_eps)
+            ]
+            return 0
+        landed, pending = ray_tpu.wait(
+            [f for _, f in self._rollout_futs],
+            num_returns=len(self._rollout_futs), timeout=0)
+        landed_set = {f.binary() if hasattr(f, "binary") else id(f)
+                      for f in landed}
+        still = []
+        for i, (w, f) in enumerate(self._rollout_futs):
+            key = f.binary() if hasattr(f, "binary") else id(f)
+            if key in landed_set:
+                batch = flatten_fragments([ray_tpu.get(f)])
+                steps += batch.count
+                shard = self.shards[self._next_shard]
+                self._next_shard = (self._next_shard + 1) \
+                    % len(self.shards)
+                shard.add.remote(dict(batch))
+                eps = self._worker_eps[
+                    self.workers.workers.index(w)]
+                still.append((w, w.sample.remote(
+                    ray_tpu.put((self.params, jnp.float32(eps))))))
+            else:
+                still.append((w, f))
+        self._rollout_futs = still
+        return steps
+
+    def _refill_samples(self):
+        cfg = self.algo_config
+        while len(self._sample_futs) < cfg.replay_sample_inflight:
+            shard = self.shards[np.random.randint(len(self.shards))]
+            self._sample_futs.append(
+                (shard, shard.sample.remote(cfg.train_batch_size)))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        steps = self._push_rollouts()
+        self._refill_samples()
+        losses = []
+        updates_done = 0
+        # Drain up to num_sgd_per_iter sampled minibatches as they land;
+        # rollouts, replay sampling and the jitted update all overlap.
+        deadline_updates = cfg.num_sgd_per_iter
+        while updates_done < deadline_updates and self._sample_futs:
+            shard, fut = self._sample_futs.pop(0)
+            mb = ray_tpu.get(fut)
+            self._refill_samples()
+            if mb is None:  # shard still below batch size
+                steps += self._push_rollouts()
+                sizes = ray_tpu.get(
+                    [sh.size.remote() for sh in self.shards])
+                if all(s < cfg.train_batch_size for s in sizes):
+                    break  # nothing learnable yet anywhere
+                continue
+            self.params, self.opt_state, loss, td = self._update(
+                self.params, self.target_params, self.opt_state,
+                {k: jnp.asarray(np.asarray(v)) for k, v in mb.items()
+                 if k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)})
+            losses.append(float(loss))
+            updates_done += 1
+            if "batch_indexes" in mb:
+                shard.update_priorities.remote(
+                    mb["batch_indexes"], np.asarray(td))
+            self._steps_since_target += cfg.train_batch_size
+        if self._steps_since_target >= cfg.target_update_freq:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._steps_since_target = 0
+        self._steps_sampled += steps
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards])
+        return {
+            "mean_td_loss": float(np.mean(losses)) if losses else None,
+            "learner_updates_this_iter": updates_done,
+            "replay_shard_sizes": sizes,
+            "buffer_size": int(sum(sizes)),
+            "worker_epsilons": [round(e, 4) for e in self._worker_eps],
+            "num_env_steps_sampled_this_iter": steps,
+        }
+
+    def cleanup(self):
+        for s in getattr(self, "shards", []):
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        super().cleanup()

@@ -17,6 +17,12 @@ from ray_tpu.train.jax_trainer import (  # noqa: F401
     allreduce_gradients,
     prepare_mesh,
 )
+from ray_tpu.train.gbdt_trainer import (  # noqa: F401
+    GBDTTrainer,
+    LightGBMTrainer,
+    XGBoostTrainer,
+)
+from ray_tpu.train.huggingface import HuggingFaceTrainer  # noqa: F401
 from ray_tpu.train.torch import (  # noqa: F401
     TorchCheckpoint,
     TorchConfig,

@@ -1,0 +1,63 @@
+"""HuggingFace Transformers trainer integration.
+
+Reference: `python/ray/train/huggingface/huggingface_trainer.py` — run a
+user-built `transformers.Trainer` inside Train workers, with the
+framework owning placement, dataset feeding, metric reporting and
+checkpointing. Same contract here: the user's ``trainer_init_per_worker
+(train_dataset, eval_dataset, **config) -> transformers.Trainer`` runs
+in each Train worker (torch CPU in this image; the TPU story for LLMs is
+the native JAX stack — `models/hf.py` converts HF checkpoints INTO it);
+a callback bridges HF's log/save events to `session.report`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+class HuggingFaceTrainer(DataParallelTrainer):
+    def __init__(self, trainer_init_per_worker: Callable, *,
+                 trainer_init_config: Optional[Dict[str, Any]] = None,
+                 **kwargs):
+        init_fn = trainer_init_per_worker
+        init_cfg = dict(trainer_init_config or {})
+
+        def train_loop(config):
+            import torch  # noqa: F401 — surface a clear error early
+
+            from transformers.trainer_callback import TrainerCallback
+
+            class _ReportCallback(TrainerCallback):
+                def on_log(self, args, state, control, logs=None,
+                           **kw):
+                    if logs:
+                        metrics = {k: v for k, v in logs.items()
+                                   if isinstance(v, (int, float))}
+                        metrics["step"] = state.global_step
+                        session.report(metrics)
+
+            train_ds = session.get_dataset_shard("train")
+            eval_ds = session.get_dataset_shard("evaluation")
+            hf_trainer = init_fn(train_ds, eval_ds, **init_cfg)
+            hf_trainer.add_callback(_ReportCallback())
+            result = hf_trainer.train()
+            final = {k: v for k, v in (result.metrics or {}).items()
+                     if isinstance(v, (int, float))}
+            # Ship the fitted weights as the terminal checkpoint.
+            state_dict = {
+                k: v.detach().cpu().numpy()
+                for k, v in hf_trainer.model.state_dict().items()
+            }
+            session.report(final or {"done": 1},
+                           checkpoint=Checkpoint.from_dict(
+                               {"state_dict": state_dict}))
+
+        super().__init__(train_loop, **kwargs)
+
+    @staticmethod
+    def get_state_dict(checkpoint: Checkpoint) -> Dict[str, Any]:
+        return checkpoint.to_dict()["state_dict"]

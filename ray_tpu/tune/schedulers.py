@@ -338,7 +338,14 @@ class PB2(PopulationBasedTraining):
                     self._X = self._X[-200:]
                     self._y = self._y[-200:]
             self._prev_score[trial.trial_id] = score
-        return super().on_trial_result(runner, trial, result)
+        old_config = trial.config
+        decision = super().on_trial_result(runner, trial, result)
+        if trial.config is not old_config:
+            # The trial was just cloned from a donor checkpoint: its next
+            # score delta reflects the checkpoint swap, not the explored
+            # config — recording it would feed the GP spurious jumps.
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
 
     def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
         import numpy as np

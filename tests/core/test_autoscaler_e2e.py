@@ -16,6 +16,10 @@ from ray_tpu.autoscaler import (
 )
 from ray_tpu.cluster_utils import Cluster
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def cluster():

@@ -16,6 +16,10 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def fast_health(monkeypatch):

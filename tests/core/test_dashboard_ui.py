@@ -67,3 +67,31 @@ def test_cluster_nodes_carry_stats():
         assert ok, nodes
     finally:
         cluster.shutdown()
+
+
+def test_grafana_dashboard_factory(tmp_path):
+    """Reference grafana_dashboard_factory.py role: valid importable
+    dashboard JSON over the canonical metrics."""
+    import json
+
+    from ray_tpu.dashboard.grafana import (generate_default_dashboard,
+                                           write_dashboards)
+
+    dash = generate_default_dashboard()
+    assert dash["uid"] == "ray-tpu-core"
+    assert len(dash["panels"]) == 6
+    for p in dash["panels"]:
+        assert p["type"] == "timeseries"
+        assert p["targets"] and all("expr" in t for t in p["targets"])
+        assert p["datasource"]["uid"] == "${datasource}"
+    # grid positions don't overlap
+    pos = {(p["gridPos"]["x"], p["gridPos"]["y"])
+           for p in dash["panels"]}
+    assert len(pos) == 6
+
+    paths = write_dashboards(str(tmp_path))
+    assert len(paths) == 2
+    for p in paths:
+        with open(p) as f:
+            loaded = json.load(f)
+        assert loaded["schemaVersion"] >= 30

@@ -9,6 +9,10 @@ import pytest
 import ray_tpu
 from ray_tpu.dashboard import shutdown_dashboard, start_dashboard
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def ray():

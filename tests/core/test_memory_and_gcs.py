@@ -14,6 +14,10 @@ import ray_tpu
 from ray_tpu._private.config import ray_config
 from ray_tpu.exceptions import WorkerCrashedError
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def ray_local():

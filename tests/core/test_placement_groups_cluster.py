@@ -24,6 +24,10 @@ from ray_tpu.util.scheduling_strategies import (
     SpreadSchedulingStrategy,
 )
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def cluster():

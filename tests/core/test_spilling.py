@@ -13,6 +13,10 @@ import pytest
 import ray_tpu
 from ray_tpu._private.config import ray_config
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def small_budget(monkeypatch):

@@ -71,3 +71,33 @@ def test_streaming_backpressure_bounds_in_flight():
     assert stats["src"]["peak_in_flight"] <= 4, stats
     assert stats["slow"]["peak_in_flight"] <= 4, stats
     assert stats["slow"]["blocks"] == 60
+
+
+def test_logical_optimizer_rules():
+    """Reference `logical/optimizers.py` role: redundant all-to-all ops
+    are rewritten away before execution."""
+    from ray_tpu.data.plan import (ExecutionPlan, RandomShuffle,
+                                   Repartition, Sort)
+
+    ds = rt_data.from_items([{"x": i} for i in range(40)], parallelism=4)
+    # shuffle ∘ shuffle → one shuffle
+    dd = ds.random_shuffle(seed=1).random_shuffle(seed=2)
+    shuffles = [op for op in dd._plan._optimize(dd._plan.ops)
+                if isinstance(op, RandomShuffle)]
+    assert len(shuffles) == 1 and shuffles[0].seed == 2
+    assert sorted(r["x"] for r in dd.take_all()) == list(range(40))
+
+    # shuffle before sort is KEPT: the stable sort pipeline preserves
+    # the shuffle's intra-group order for tied keys, so it's observable.
+    dsort = ds.random_shuffle(seed=1).sort("x")
+    opt = dsort._plan._optimize(dsort._plan.ops)
+    assert any(isinstance(op, RandomShuffle) for op in opt)
+    assert isinstance(opt[-1], Sort)
+    assert [r["x"] for r in dsort.take_all()] == list(range(40))
+
+    # repartition ∘ repartition → last wins
+    dr = ds.repartition(8).repartition(2)
+    opt = dr._plan._optimize(dr._plan.ops)
+    reps = [op for op in opt if isinstance(op, Repartition)]
+    assert len(reps) == 1 and reps[0].num_blocks == 2
+    assert dr.num_blocks() == 2

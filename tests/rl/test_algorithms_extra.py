@@ -391,3 +391,31 @@ def test_td3_runs_on_pendulum():
     out = algo.evaluate(num_episodes=1,
                         max_steps_per_episode=50)["evaluation"]
     assert out["episode_reward_mean"] < 0
+
+
+def test_apex_dqn_distributed_replay():
+    """Ape-X: sharded replay actors, async sampling, per-worker epsilon
+    ladder (reference `rllib/algorithms/apex_dqn`)."""
+    from ray_tpu.rl import ApexDQNConfig
+
+    config = (ApexDQNConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                        rollout_fragment_length=32)
+              .training(lr=1e-3, learning_starts=64, buffer_size=4096,
+                        train_batch_size=32, num_sgd_per_iter=8,
+                        num_replay_shards=2)
+              .debugging(seed=0))
+    algo = config.build()
+    result = None
+    for _ in range(4):
+        result = algo.train()
+    algo.cleanup()
+    assert result["buffer_size"] > 64
+    assert len(result["replay_shard_sizes"]) == 2
+    assert all(s > 0 for s in result["replay_shard_sizes"])
+    # per-worker epsilons form a ladder, not one global schedule
+    eps = result["worker_epsilons"]
+    assert len(eps) == 2 and eps[0] > eps[1]
+    assert result["learner_updates_this_iter"] > 0
+    assert result["mean_td_loss"] is not None

@@ -15,6 +15,10 @@ from ray_tpu.models.llama import (
 )
 from ray_tpu.serve.llm import LLMEngine, SamplingParams
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model():

@@ -1,0 +1,95 @@
+"""GBDT + HuggingFace trainer integrations (reference
+`train/gbdt_trainer.py`, `train/huggingface/huggingface_trainer.py`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+
+
+@pytest.fixture(autouse=True)
+def ray():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_gbdt_trainer_fits_and_checkpoints():
+    from ray_tpu.train.gbdt_trainer import XGBoostTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(600, 4))
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0]) + 0.01 *
+         rng.normal(size=600))
+    rows = [{"f0": X[i, 0], "f1": X[i, 1], "f2": X[i, 2],
+             "f3": X[i, 3], "y": y[i]} for i in range(600)]
+    train = rt_data.from_items(rows[:500], parallelism=4)
+    valid = rt_data.from_items(rows[500:], parallelism=2)
+
+    trainer = XGBoostTrainer(
+        label_column="y", num_boost_round=40,
+        params={"learning_rate": 0.2},
+        datasets={"train": train, "valid": valid})
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["train_score"] > 0.9
+    assert result.metrics["valid_score"] > 0.8
+    model = XGBoostTrainer.get_model(result.checkpoint)
+    pred = model.predict(X[:10])
+    assert np.abs(pred - y[:10]).mean() < 1.0
+
+
+def test_gbdt_classifier_objective():
+    from ray_tpu.train.gbdt_trainer import XGBoostTrainer
+
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(400, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = [{"a": X[i, 0], "b": X[i, 1], "c": X[i, 2], "label": y[i]}
+            for i in range(400)]
+    trainer = XGBoostTrainer(
+        label_column="label", num_boost_round=30,
+        params={"objective": "classification"},
+        datasets={"train": rt_data.from_items(rows, parallelism=4)})
+    result = trainer.fit()
+    assert result.metrics["train_score"] > 0.9
+
+
+def test_huggingface_trainer_tiny_model(tmp_path):
+    from ray_tpu.train.huggingface import HuggingFaceTrainer
+
+    def trainer_init(train_ds, eval_ds, **cfg):
+        import torch
+        from transformers import (GPT2Config, GPT2LMHeadModel, Trainer,
+                                  TrainingArguments)
+
+        model = GPT2LMHeadModel(GPT2Config(
+            n_embd=32, n_layer=2, n_head=2, vocab_size=128,
+            n_positions=32))
+
+        class TokenDataset(torch.utils.data.Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.data = rng.randint(0, 128, (64, 16))
+
+            def __len__(self):
+                return len(self.data)
+
+            def __getitem__(self, i):
+                ids = torch.tensor(self.data[i], dtype=torch.long)
+                return {"input_ids": ids, "labels": ids}
+
+        args = TrainingArguments(
+            output_dir=str(tmp_path), per_device_train_batch_size=8,
+            num_train_epochs=1, logging_steps=2, report_to=[],
+            save_strategy="no", use_cpu=True)
+        return Trainer(model=model, args=args,
+                       train_dataset=TokenDataset())
+
+    trainer = HuggingFaceTrainer(trainer_init)
+    result = trainer.fit()
+    assert result.error is None, result.error
+    sd = HuggingFaceTrainer.get_state_dict(result.checkpoint)
+    assert any("wte" in k for k in sd)

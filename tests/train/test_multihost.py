@@ -14,6 +14,10 @@ from ray_tpu.air import session
 from ray_tpu.air.config import ScalingConfig
 from ray_tpu.train.jax_trainer import JaxConfig, JaxTrainer
 
+# Multi-process / soak tests: excluded from the quick
+# tier (pytest -m 'not slow').
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def ray_4cpu():
