@@ -277,6 +277,15 @@ class ExecutionPlan:
                 prev = out[-1]
                 if isinstance(op, RandomShuffle) and \
                         isinstance(prev, RandomShuffle):
+                    if op.num_blocks is None and \
+                            prev.num_blocks is not None:
+                        # Keep the earlier shuffle's explicit output
+                        # block count — the collapse must not change
+                        # downstream parallelism.
+                        op = RandomShuffle(
+                            name=op.name, seed=op.seed,
+                            num_blocks=prev.num_blocks,
+                            push_based=op.push_based)
                     out[-1] = op
                     continue
                 if isinstance(op, Repartition) and \

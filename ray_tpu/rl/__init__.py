@@ -71,3 +71,10 @@ from ray_tpu.rl.replay_buffer import (  # noqa: F401
 )
 from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rl.sample_batch import SampleBatch  # noqa: F401
+from ray_tpu.rl.catalog import (  # noqa: F401
+    ModelConfig,
+    ModelSpec,
+    get_actor_critic_model,
+    get_q_model,
+)
+from ray_tpu.rl.external import PolicyClient, PolicyServer  # noqa: F401

@@ -170,7 +170,9 @@ class ApexDQN(DQN):
             if "batch_indexes" in mb:
                 shard.update_priorities.remote(
                     mb["batch_indexes"], np.asarray(td))
-            self._steps_since_target += cfg.train_batch_size
+        # target_update_freq counts ENV steps, same semantics as the
+        # base DQN config field — not learner updates.
+        self._steps_since_target += steps
         if self._steps_since_target >= cfg.target_update_freq:
             self.target_params = jax.tree.map(jnp.copy, self.params)
             self._steps_since_target = 0

@@ -1,0 +1,88 @@
+"""Model catalog: observation/action spaces → policy networks.
+
+Reference: `rllib/models/catalog.py` — algorithms ask the catalog for a
+model matching the env's spaces instead of hard-coding torsos. The JAX
+catalog maps:
+
+- Box/flat observations → MLP torso
+- [H, W, C] image observations → nature-CNN torso
+- Discrete actions → categorical actor-critic or Q-head
+- Box actions → tanh-squashed diagonal Gaussian
+
+returning ``(init_fn(rng) -> params, apply_fn(params, obs))`` pairs the
+rollout workers and learners share (the apply is what WorkerSet jits).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.env import Box, Discrete
+
+
+@dataclass
+class ModelConfig:
+    """Reference `MODEL_DEFAULTS` subset."""
+
+    hidden: Tuple[int, ...] = (64, 64)
+    cnn_hidden: int = 256
+
+
+@dataclass
+class ModelSpec:
+    init: Callable[[Any], Any]          # rng -> params
+    apply: Callable[[Any, Any], Any]    # (params, obs) -> outputs
+    kind: str = "actor_critic"          # WorkerSet policy_kind
+
+
+def _is_image(space) -> bool:
+    return hasattr(space, "shape") and len(space.shape) == 3
+
+
+def get_actor_critic_model(obs_space, action_space,
+                           config: Optional[ModelConfig] = None
+                           ) -> ModelSpec:
+    """Policy+value model for PG-family algorithms (PPO/IMPALA/APPO...)."""
+    cfg = config or ModelConfig()
+    if isinstance(action_space, Discrete):
+        n = action_space.n
+        if _is_image(obs_space):
+            shape = obs_space.shape
+            return ModelSpec(
+                init=lambda rng: models.cnn_actor_critic_init(
+                    rng, shape, n, hidden=cfg.cnn_hidden),
+                apply=models.cnn_actor_critic_apply,
+                kind="actor_critic")
+        obs_dim = int(np.prod(obs_space.shape))
+        return ModelSpec(
+            init=lambda rng: models.actor_critic_init(
+                rng, obs_dim, n, cfg.hidden),
+            apply=models.actor_critic_apply,
+            kind="actor_critic")
+    if isinstance(action_space, Box):
+        obs_dim = int(np.prod(obs_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+        return ModelSpec(
+            init=lambda rng: models.gaussian_policy_init(
+                rng, obs_dim, act_dim, cfg.hidden),
+            apply=models.gaussian_policy_apply,
+            kind="gaussian")
+    raise ValueError(f"unsupported action space: {action_space!r}")
+
+
+def get_q_model(obs_space, action_space,
+                config: Optional[ModelConfig] = None) -> ModelSpec:
+    """Q-network for value-based algorithms (DQN family)."""
+    cfg = config or ModelConfig()
+    assert isinstance(action_space, Discrete), \
+        "Q models need discrete actions"
+    obs_dim = int(np.prod(obs_space.shape))
+    return ModelSpec(
+        init=lambda rng: models.q_net_init(rng, obs_dim,
+                                           action_space.n, cfg.hidden),
+        apply=models.q_net_apply,
+        kind="q")

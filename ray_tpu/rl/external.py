@@ -1,0 +1,194 @@
+"""External-environment serving: PolicyServer / PolicyClient.
+
+Reference: `rllib/env/policy_server_input.py` + `policy_client.py` — an
+external simulator (a game server, a robot, another process) drives
+episodes over the wire: it asks the server for actions, logs rewards,
+and ends episodes; the server turns that traffic into SampleBatches an
+algorithm trains from, and pushes fresh weights to inference.
+
+The wire is the framework's own framed RPC (`_private/rpc.py`) — same
+channel the control plane uses, TLS-capable. Inference runs server-side
+(the client never needs model code), host-CPU by default like rollout
+workers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+    TERMINATEDS,
+    VALUES,
+)
+
+
+class _Episode:
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List[int] = []
+        self.logps: List[float] = []
+        self.values: List[float] = []
+        self.rewards: List[float] = []
+
+
+class PolicyServer:
+    """Serves actions to external simulators and accumulates their
+    experience (reference PolicyServerInput)."""
+
+    def __init__(self, apply_fn, params, *, host: str = "127.0.0.1",
+                 port: int = 0, batch_size: int = 256,
+                 deterministic: bool = False, seed: int = 0):
+        import jax
+
+        self._apply = jax.jit(apply_fn)
+        self._params = params
+        self._lock = threading.Lock()
+        self._episodes: Dict[str, _Episode] = {}
+        self._rng = np.random.RandomState(seed)
+        self._deterministic = deterministic
+        self._batch_size = batch_size
+        self._rows: Dict[str, list] = {
+            k: [] for k in (OBS, ACTIONS, REWARDS, DONES, TERMINATEDS,
+                            NEXT_OBS, LOGPS, VALUES)}
+        self._batches: "queue.Queue[SampleBatch]" = queue.Queue()
+        self.episode_returns: List[float] = []
+        self._server = RpcServer({
+            "start_episode": self._start_episode,
+            "get_action": self._get_action,
+            "log_returns": self._log_returns,
+            "end_episode": self._end_episode,
+        }, host=host, port=port)
+        self.address = self._server.address
+
+    # -- weights ---------------------------------------------------------
+
+    def set_weights(self, params) -> None:
+        with self._lock:
+            self._params = params
+
+    # -- RPC handlers ----------------------------------------------------
+
+    def _start_episode(self, episode_id: Optional[str] = None) -> str:
+        eid = episode_id or uuid.uuid4().hex[:12]
+        with self._lock:
+            self._episodes[eid] = _Episode()
+        return eid
+
+    def _compute(self, obs: np.ndarray):
+        import jax
+
+        logits, value = self._apply(self._params, obs[None])
+        logits = np.asarray(jax.device_get(logits), np.float32)[0]
+        value = float(np.asarray(jax.device_get(value))[0])
+        logp_all = logits - _logsumexp(logits)
+        if self._deterministic:
+            action = int(logits.argmax())
+        else:
+            z = self._rng.gumbel(size=logits.shape)
+            action = int((logits + z).argmax())
+        return action, float(logp_all[action]), value
+
+    def _get_action(self, episode_id: str, obs) -> int:
+        obs = np.asarray(obs, np.float32)
+        with self._lock:
+            ep = self._episodes[episode_id]
+            action, logp, value = self._compute(obs)
+            ep.obs.append(obs)
+            ep.actions.append(action)
+            ep.logps.append(logp)
+            ep.values.append(value)
+            return action
+
+    def _log_returns(self, episode_id: str, reward: float) -> bool:
+        with self._lock:
+            self._episodes[episode_id].rewards.append(float(reward))
+        return True
+
+    def _end_episode(self, episode_id: str, last_obs) -> bool:
+        last = np.asarray(last_obs, np.float32)
+        with self._lock:
+            ep = self._episodes.pop(episode_id)
+            n = len(ep.actions)
+            if n == 0:
+                return True
+            rewards = (ep.rewards + [0.0] * n)[:n]
+            self.episode_returns.append(float(sum(rewards)))
+            next_obs = ep.obs[1:] + [last]
+            for i in range(n):
+                terminated = i == n - 1
+                self._rows[OBS].append(ep.obs[i])
+                self._rows[ACTIONS].append(ep.actions[i])
+                self._rows[REWARDS].append(rewards[i])
+                self._rows[DONES].append(terminated)
+                self._rows[TERMINATEDS].append(terminated)
+                self._rows[NEXT_OBS].append(next_obs[i])
+                self._rows[LOGPS].append(ep.logps[i])
+                self._rows[VALUES].append(ep.values[i])
+            if len(self._rows[OBS]) >= self._batch_size:
+                self._batches.put(SampleBatch({
+                    k: np.asarray(v) for k, v in self._rows.items()}))
+                self._rows = {k: [] for k in self._rows}
+        return True
+
+    # -- training-side API ----------------------------------------------
+
+    def get_samples(self, timeout: Optional[float] = None
+                    ) -> Optional[SampleBatch]:
+        """Next accumulated batch (None on timeout) — the algorithm's
+        sample source, the PolicyServerInput role."""
+        try:
+            return self._batches.get(
+                timeout=timeout) if timeout is not None \
+                else self._batches.get_nowait()
+        except queue.Empty:
+            return None
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+class PolicyClient:
+    """The external simulator's handle (reference PolicyClient)."""
+
+    def __init__(self, address):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host, int(port))
+        self._rpc = RpcClient.dedicated(tuple(address))
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        return self._rpc.call("start_episode", episode_id=episode_id)
+
+    def get_action(self, episode_id: str, observation) -> int:
+        return self._rpc.call(
+            "get_action", episode_id=episode_id,
+            obs=np.asarray(observation, np.float32))
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._rpc.call("log_returns", episode_id=episode_id,
+                       reward=float(reward))
+
+    def end_episode(self, episode_id: str, observation) -> None:
+        self._rpc.call("end_episode", episode_id=episode_id,
+                       last_obs=np.asarray(observation, np.float32))
+
+    def close(self):
+        self._rpc.close()
+
+
+def _logsumexp(x):
+    m = x.max()
+    return m + np.log(np.exp(x - m).sum())

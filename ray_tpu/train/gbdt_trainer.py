@@ -56,6 +56,14 @@ class GBDTTrainer(DataParallelTrainer):
     def __init__(self, *, label_column: str,
                  params: Optional[Dict[str, Any]] = None,
                  num_boost_round: int = 100, **kwargs):
+        sc = kwargs.get("scaling_config")
+        if sc is not None and getattr(sc, "num_workers", 1) not in (None,
+                                                                    1):
+            raise ValueError(
+                "GBDTTrainer runs the tree engine in ONE worker (boost "
+                "rounds are sequential; sklearn threads the histogram "
+                "build). num_workers>1 would fit N independent models "
+                "on 1/N shards each — set num_workers=1.")
         params = dict(params or {})
         params.setdefault("max_iter", num_boost_round)
         factory = self._estimator_factory  # instance attr wins (subclass
@@ -72,8 +80,11 @@ class GBDTTrainer(DataParallelTrainer):
             if ckpt is not None:
                 prev = pickle.loads(ckpt.to_dict()[_MODEL_KEY])
                 if hasattr(prev, "n_iter_"):
-                    est.warm_start = True
                     est.__dict__.update(prev.__dict__)
+                    # AFTER the update: prev's __dict__ carries its own
+                    # warm_start=False and would clobber the flag,
+                    # silently retraining from scratch.
+                    est.warm_start = True
             est.fit(X, y)
             metrics = {
                 "train_" + metric_name: float(est.score(X, y)),

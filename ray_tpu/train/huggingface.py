@@ -23,6 +23,14 @@ class HuggingFaceTrainer(DataParallelTrainer):
     def __init__(self, trainer_init_per_worker: Callable, *,
                  trainer_init_config: Optional[Dict[str, Any]] = None,
                  **kwargs):
+        sc = kwargs.get("scaling_config")
+        if sc is not None and getattr(sc, "num_workers", 1) not in (None,
+                                                                    1):
+            raise ValueError(
+                "HuggingFaceTrainer runs the HF Trainer in ONE worker "
+                "(no cross-worker gradient sync is wired for torch "
+                "here); num_workers>1 would train N independent models "
+                "on 1/N shards each — set num_workers=1.")
         init_fn = trainer_init_per_worker
         init_cfg = dict(trainer_init_config or {})
 

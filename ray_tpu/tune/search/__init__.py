@@ -19,6 +19,9 @@ from ray_tpu.tune.search.basic_variant import (  # noqa: F401
 )
 from ray_tpu.tune.search.searcher import (  # noqa: F401
     ConcurrencyLimiter,
+    OptunaSearch,
+    RandomSearch,
     Repeater,
     Searcher,
 )
+from ray_tpu.tune.search.tpe import TPESearch  # noqa: F401

@@ -1,0 +1,182 @@
+"""Native Tree-structured Parzen Estimator searcher.
+
+Reference role: the HyperOpt wrapper (`tune/search/hyperopt/`) — the
+hyperopt package is absent from this image, so the TPE algorithm
+(Bergstra et al. 2011) is implemented directly: completed trials split
+into a good quantile l(x) and the rest g(x); each is modeled per
+dimension with a kernel density (Gaussians over normalized continuous
+values, smoothed counts over categories); candidates sampled from l(x)
+are scored by the acquisition l(x)/g(x) and the best is suggested.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search import sample as S
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class TPESearch(Searcher):
+    def __init__(self, space: Dict[str, Any], metric: str,
+                 mode: str = "max", *, n_startup: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed=None):
+        super().__init__(metric=metric, mode=mode)
+        self.space = space
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = _random.Random(seed)
+        self._observations: List[Tuple[Dict[str, Any], float]] = []
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    # -- dimension helpers ----------------------------------------------
+
+    def _dims(self):
+        # Numeric spec: (lower, upper, log, q, exclusive_upper) —
+        # RandInt/QRandInt sample with an EXCLUSIVE upper (randrange
+        # semantics), and Q-domains snap to multiples of q; TPE-phase
+        # candidates must respect both or they leave the domain the
+        # startup phase defined.
+        for key, dom in self.space.items():
+            if isinstance(dom, S.QUniform):
+                yield key, "float", (dom.lower, dom.upper, False,
+                                     dom.q, False)
+            elif isinstance(dom, S.Uniform):
+                yield key, "float", (dom.lower, dom.upper, False,
+                                     None, False)
+            elif isinstance(dom, S.LogUniform):
+                yield key, "float", (dom.lower, dom.upper, True,
+                                     None, False)
+            elif isinstance(dom, S.QRandInt):
+                yield key, "int", (dom.lower, dom.upper, False,
+                                   dom.q, True)
+            elif isinstance(dom, S.RandInt):
+                yield key, "int", (dom.lower, dom.upper, False,
+                                   None, True)
+            elif isinstance(dom, S.Choice):
+                yield key, "cat", tuple(dom.categories)
+            elif isinstance(dom, S.Domain):
+                yield key, "domain", dom
+            else:
+                yield key, "const", dom
+
+    @staticmethod
+    def _norm(v, lo, hi, log):
+        if log:
+            lo, hi, v = math.log(lo), math.log(hi), math.log(max(v, 1e-300))
+        return (v - lo) / max(hi - lo, 1e-12)
+
+    @staticmethod
+    def _denorm(u, lo, hi, log):
+        if log:
+            return math.exp(math.log(lo) + u * (math.log(hi)
+                                                - math.log(lo)))
+        return lo + u * (hi - lo)
+
+    # -- TPE core --------------------------------------------------------
+
+    def _split(self):
+        obs = sorted(self._observations, key=lambda p: -p[1])
+        k = max(1, int(len(obs) * self.gamma))
+        return obs[:k], obs[k:]
+
+    def _kde_sample(self, points: List[float]) -> float:
+        # Parzen window: pick an observed point, jitter by its bandwidth.
+        bw = max(0.1, 1.0 / max(1, len(points)) ** 0.5 * 0.5)
+        center = self._rng.choice(points) if points \
+            else self._rng.random()
+        return min(1.0, max(0.0, self._rng.gauss(center, bw)))
+
+    @staticmethod
+    def _kde_logpdf(x: float, points: List[float]) -> float:
+        if not points:
+            return 0.0
+        bw = max(0.1, 1.0 / len(points) ** 0.5 * 0.5)
+        acc = 0.0
+        for c in points:
+            acc += math.exp(-0.5 * ((x - c) / bw) ** 2)
+        return math.log(acc / (len(points) * bw) + 1e-12)
+
+    def _cat_logp(self, value, configs: List[dict], key, cats) -> float:
+        counts = {c: 1.0 for c in cats}  # +1 smoothing
+        for cfg in configs:
+            if cfg.get(key) in counts:
+                counts[cfg.get(key)] += 1.0
+        total = sum(counts.values())
+        return math.log(counts.get(value, 1.0) / total)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._observations) < self.n_startup:
+            cfg = {k: (dom.sample(self._rng)
+                       if isinstance(dom, S.Domain) else dom)
+                   for k, dom in self.space.items()}
+            self._pending[trial_id] = cfg
+            return dict(cfg)
+        good, bad = self._split()
+        good_cfgs = [c for c, _ in good]
+        bad_cfgs = [c for c, _ in bad]
+        best_cfg, best_score = None, -math.inf
+        for _ in range(self.n_candidates):
+            cand: Dict[str, Any] = {}
+            score = 0.0
+            for key, kind, spec in self._dims():
+                if kind in ("float", "int"):
+                    lo, hi, log, q, excl = spec
+                    pts_g = [self._norm(c[key], lo, hi, log)
+                             for c in good_cfgs if key in c]
+                    pts_b = [self._norm(c[key], lo, hi, log)
+                             for c in bad_cfgs if key in c]
+                    u = self._kde_sample(pts_g)
+                    score += self._kde_logpdf(u, pts_g) \
+                        - self._kde_logpdf(u, pts_b)
+                    v = self._denorm(u, lo, hi, log)
+                    if kind == "int":
+                        top = hi - 1 if excl else hi
+                        v = int(min(max(round(v), lo), top))
+                        if q:  # floor to the grid, matching QRandInt
+                            v = max((v // int(q)) * int(q), int(lo))
+                    else:
+                        v = min(max(v, lo), hi)
+                        if q:
+                            v = min(max(round(v / q) * q, lo), hi)
+                    cand[key] = v
+                elif kind == "cat":
+                    cats = spec
+                    # sample from l(x)'s smoothed categorical
+                    weights = []
+                    for c in cats:
+                        weights.append(math.exp(self._cat_logp(
+                            c, good_cfgs, key, cats)))
+                    total = sum(weights)
+                    r = self._rng.random() * total
+                    acc = 0.0
+                    value = cats[-1]
+                    for c, w in zip(cats, weights):
+                        acc += w
+                        if r <= acc:
+                            value = c
+                            break
+                    score += self._cat_logp(value, good_cfgs, key, cats) \
+                        - self._cat_logp(value, bad_cfgs, key, cats)
+                    cand[key] = value
+                elif kind == "domain":
+                    cand[key] = spec.sample(self._rng)
+                else:
+                    cand[key] = spec
+            if score > best_score:
+                best_cfg, best_score = cand, score
+        self._pending[trial_id] = best_cfg
+        return dict(best_cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or error or not result or \
+                self.metric not in result:
+            return
+        value = result[self.metric]
+        self._observations.append(
+            (cfg, value if self.mode == "max" else -value))
