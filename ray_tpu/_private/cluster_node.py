@@ -121,6 +121,14 @@ class NodeRuntime:
                                transfer=self.transfer_addr,
                                shm_name=plane.name if plane else None,
                                labels=self.labels)
+                # Events recorded in THIS process (e.g. a serve
+                # controller actor placed here) must reach the head's
+                # observable buffer, not die in a local deque.
+                from ray_tpu._private import events as _events
+
+                head = self.head
+                _events.set_forwarder(
+                    lambda **kw: head.call("gcs_record_event", **kw))
                 break
             except Exception as e:
                 last_err = e

@@ -1,0 +1,95 @@
+"""Typed GCS accessor client.
+
+Reference: `src/ray/gcs/gcs_client/gcs_client.h:61` — raylets, workers
+and the dashboard talk to GCS through typed accessors (NodeInfo, Actor,
+InternalKV, ...) instead of raw RPC strings. Same layering here: any
+process holding the head address builds a `GcsClient` and gets
+namespaced accessors over the framed control-plane RPC (driver-side
+callers can keep using the in-process `worker.gcs` GlobalState; this
+client exists for NODE processes and external tools)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from ray_tpu._private.rpc import RpcClient
+
+
+class _KvAccessor:
+    """InternalKV (reference `gcs_kv_manager.h`)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True,
+            namespace: Optional[bytes] = None) -> bool:
+        return self._call("gcs_kv_put", key=key, value=value,
+                          overwrite=overwrite, namespace=namespace)
+
+    def get(self, key: bytes,
+            namespace: Optional[bytes] = None) -> Optional[bytes]:
+        return self._call("gcs_kv_get", key=key, namespace=namespace)
+
+    def delete(self, key: bytes,
+               namespace: Optional[bytes] = None) -> None:
+        self._call("gcs_kv_del", key=key, namespace=namespace)
+
+    def keys(self, prefix: bytes = b"",
+             namespace: Optional[bytes] = None) -> List[bytes]:
+        return self._call("gcs_kv_keys", prefix=prefix,
+                          namespace=namespace)
+
+
+class _NodeAccessor:
+    """Node directory (reference `GcsNodeManager` accessor)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def list(self) -> List[dict]:
+        return self._call("get_nodes")
+
+    def alive(self) -> List[dict]:
+        return [n for n in self.list() if n.get("alive", True)]
+
+
+class _ActorAccessor:
+    """Named-actor directory (reference `GcsActorManager` accessor)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def list_named(self, all_namespaces: bool = False) -> List:
+        return self._call("gcs_named_actors",
+                          all_namespaces=all_namespaces)
+
+
+class _PlacementGroupAccessor:
+    def __init__(self, call):
+        self._call = call
+
+    def table(self) -> Dict[str, Any]:
+        return self._call("gcs_pg_table")
+
+
+class _EventAccessor:
+    def __init__(self, call):
+        self._call = call
+
+    def list(self, limit: int = 200,
+             source: Optional[str] = None) -> List[dict]:
+        return self._call("gcs_events", limit=limit, source=source)
+
+
+class GcsClient:
+    def __init__(self, address: Union[str, Tuple[str, int]]):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host, int(port))
+        self._rpc = RpcClient.to(tuple(address))
+        call = self._rpc.call
+        self.kv = _KvAccessor(call)
+        self.nodes = _NodeAccessor(call)
+        self.actors = _ActorAccessor(call)
+        self.placement_groups = _PlacementGroupAccessor(call)
+        self.events = _EventAccessor(call)

@@ -160,13 +160,27 @@ class ClusterHead:
             "get_object": self._get_object,
             "get_nodes": self._get_nodes,
             "subscribe": self._subscribe,
-        })
+            # Typed GCS accessor surface (reference gcs_client.h:61):
+            # node processes reach the head's tables through
+            # _private/gcs_client.GcsClient instead of raw RPC strings.
+            "gcs_kv_put": lambda **kw: self.worker.gcs.kv_put(**kw),
+            "gcs_kv_get": lambda **kw: self.worker.gcs.kv_get(**kw),
+            "gcs_kv_del": lambda **kw: self.worker.gcs.kv_del(**kw),
+            "gcs_kv_keys": lambda **kw: self.worker.gcs.kv_keys(**kw),
+            "gcs_named_actors":
+                lambda **kw: self.worker.gcs.list_named_actors(**kw),
+            "gcs_pg_table": self._gcs_pg_table,
+            "gcs_events": self._gcs_events,
+            "gcs_record_event": self._gcs_record_event,
+        }, dedupe_methods=frozenset({"gcs_kv_put"}))
         # Long-poll pubsub channels (reference: pubsub/publisher.h:302);
         # node lifecycle events publish here.
         from ray_tpu._private.pubsub import Publisher
 
         self.publisher = Publisher()
         self.transfer_addr: Optional[Tuple[str, int]] = None
+        # node_id -> local log path (populated by Cluster.add_node).
+        self.node_logs: Dict[str, str] = {}
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
 
@@ -180,6 +194,10 @@ class ClusterHead:
         self.publisher.publish("node_events", {
             "event": "NODE_ADDED", "node_id": node_id,
             "address": tuple(address)})
+        from ray_tpu._private.events import record_event
+
+        record_event("node", f"node {node_id} joined",
+                     node_id=node_id, resources=dict(resources or {}))
         self._ensure_health_checker()
         return True
 
@@ -374,6 +392,10 @@ class ClusterHead:
             record = self.nodes.get(node_id)
             if record is None or not record.alive:
                 return
+            from ray_tpu._private.events import record_event
+
+            record_event("node", f"node {node_id} marked dead: {reason}",
+                         severity="ERROR", node_id=node_id)
             record.alive = False
             addr = record.address
             # Objects whose only copy was there are gone.
@@ -544,6 +566,39 @@ class ClusterHead:
                 return True, value, error
             time.sleep(0.005)
         return False, None, None
+
+    @staticmethod
+    def _gcs_events(limit: int = 200, source=None):
+        from ray_tpu._private.events import list_events
+
+        return list_events(limit=limit, source=source)
+
+    @staticmethod
+    def _gcs_record_event(source: str, message: str,
+                          severity: str = "INFO", metadata=None):
+        """Node-forwarded event lands in the head's (observable) buffer."""
+        from ray_tpu._private.events import record_event
+
+        record_event(source, message, severity=severity,
+                     **(metadata or {}))
+        return True
+
+    def _gcs_pg_table(self):
+        """Placement-group table as PLAIN data: the in-process table
+        holds PlacementGroup handles whose unpickling side-effects a
+        full local runtime into an external tool's process."""
+        table = self.worker.gcs.placement_group_table()
+
+        def plain(v):
+            if isinstance(v, dict):
+                return {str(k): plain(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [plain(x) for x in v]
+            if isinstance(v, (str, int, float, bool, type(None), bytes)):
+                return v
+            return str(v)
+
+        return plain(table)
 
     def _get_nodes(self):
         with self._lock:
@@ -1182,6 +1237,9 @@ class Cluster:
         log_f.close()
         self._procs[node_id] = proc
         self._logs[node_id] = log_path
+        # Dashboard log module reads these (reference: dashboard log
+        # module serving per-node files).
+        self.head.node_logs[node_id] = log_path
         if self._log_monitor is not None:
             self._log_monitor.add_file(node_id, log_path)
         if wait:

@@ -130,13 +130,22 @@ class DashboardServer:
         if path == "/api/jobs" or path.startswith("/api/jobs/"):
             return (json.dumps(self._jobs_route(path),
                                default=str).encode(), "application/json")
+        if path == "/api/logs" or path.startswith("/api/logs/"):
+            return (json.dumps(self._logs_route(path),
+                               default=str).encode(), "application/json")
+        if path == "/api/events":
+            from ray_tpu._private.events import list_events
+
+            return (json.dumps(list_events(), default=str).encode(),
+                    "application/json")
         routes = {
             "/": lambda: {"status": "ok",
                           "endpoints": ["/ui", "/api/nodes", "/api/tasks",
                                         "/api/actors", "/api/objects",
                                         "/api/cluster_status",
                                         "/api/serve", "/api/metrics",
-                                        "/api/timeline"]},
+                                        "/api/timeline", "/api/logs",
+                                        "/api/events"]},
             "/api/nodes": state.list_nodes,
             "/api/tasks": state.list_tasks,
             "/api/actors": state.list_actors,
@@ -168,6 +177,33 @@ class DashboardServer:
         if rest.endswith("/logs"):
             return {"logs": client.get_job_logs(rest[:-len("/logs")])}
         return dataclasses.asdict(client.get_job_info(rest))
+
+    @staticmethod
+    def _logs_route(path: str):
+        """Per-node log files (reference: dashboard log module).
+        /api/logs lists nodes; /api/logs/<node_id>?  tails 16 KB."""
+        import os
+
+        from ray_tpu._private.worker import global_worker_or_none
+
+        worker = global_worker_or_none()
+        head = getattr(worker, "cluster_head", None) if worker else None
+        logs = dict(getattr(head, "node_logs", {}) or {})
+        if path == "/api/logs":
+            return {nid: {"path": p,
+                          "size": os.path.getsize(p)
+                          if os.path.exists(p) else 0}
+                    for nid, p in logs.items()}
+        node_id = path[len("/api/logs/"):]
+        p = logs.get(node_id)
+        if p is None or not os.path.exists(p):
+            return {"error": f"no log for node {node_id!r}"}
+        size = os.path.getsize(p)
+        with open(p, "rb") as f:
+            f.seek(max(0, size - (16 << 10)))
+            tail = f.read().decode("utf-8", "replace")
+        return {"node_id": node_id, "path": p, "size": size,
+                "tail": tail}
 
     @staticmethod
     def _serve_status():

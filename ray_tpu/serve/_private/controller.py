@@ -105,6 +105,11 @@ class ServeController:
                 existing.info = info
                 existing.version = info["version"]
                 existing.status = "UPDATING"
+        from ray_tpu._private.events import record_event
+
+        record_event("serve", f"deployment {name} deployed "
+                     f"(version {info['version'][:8]})",
+                     deployment=name)
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -114,6 +119,10 @@ class ServeController:
             for r in state.replicas:
                 self._stop_replica(r)
             self._broadcast(name, [])
+            from ray_tpu._private.events import record_event
+
+            record_event("serve", f"deployment {name} deleted",
+                         deployment=name)
         return True
 
     def get_deployment_info(self, name: str) -> Optional[dict]:
@@ -218,6 +227,12 @@ class ServeController:
         desired = int(min(max(desired, cfg.get("min_replicas", 1)),
                           cfg.get("max_replicas", current)))
         if desired != st.info.get("num_replicas"):
+            from ray_tpu._private.events import record_event
+
+            record_event(
+                "serve", f"autoscaling {st.name}: "
+                f"{st.info.get('num_replicas')} -> {desired} replicas "
+                f"(queued={queued:.0f})", deployment=st.name)
             st.info["num_replicas"] = desired
             st.status = "UPDATING"
 
