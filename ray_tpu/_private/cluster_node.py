@@ -84,6 +84,7 @@ class NodeRuntime:
                 except Exception:
                     pass
             self.transfer_addr = None
+        self._fn_cache: Dict[bytes, Any] = {}  # function-import cache
         self._shutdown_event = threading.Event()
         self._install_report_hook()
         self._install_borrow_hooks()
@@ -303,6 +304,13 @@ class NodeRuntime:
     def _submit_task(self, spec):
         from ray_tpu.object_ref import ObjectRef
 
+        if spec.func is None and getattr(spec, "func_id", None):
+            spec.func = self._resolve_function(spec.func_id)
+        elif spec.func is not None and getattr(spec, "func_id", None):
+            # Prime the cache from the full-body first shipment so the
+            # first STRIPPED spec doesn't pay a head-KV round trip on
+            # the dispatch hot path.
+            self._fn_cache[spec.func_id] = spec.func
         deps = [arg.id for arg in
                 list(spec.args) + list(spec.kwargs.values())
                 if isinstance(arg, ObjectRef)]
@@ -329,6 +337,25 @@ class NodeRuntime:
 
         threading.Thread(target=fetch_then_submit, daemon=True).start()
         return True
+
+    def _resolve_function(self, fid: bytes):
+        """Function-distribution import side (reference: the worker
+        import thread pulling exported definitions from GCS KV). Specs
+        shipped without a body resolve here: process cache first, head
+        KV on miss."""
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            import cloudpickle
+
+            blob = self.head.call("gcs_kv_get", key=fid,
+                                  namespace=b"__fn__")
+            if blob is None:
+                raise RuntimeError(
+                    f"function {fid.hex()[:12]} not found in the "
+                    "cluster function store")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[fid] = fn
+        return fn
 
     def _get_object(self, oid: bytes, timeout: float = 30.0):
         object_id = ObjectID(oid)

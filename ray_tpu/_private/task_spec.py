@@ -114,6 +114,11 @@ class TaskSpec:
     isolate_process: Any = False
     # Return object IDs, precomputed by the submitter (owner)
     return_ids: list = field(default_factory=list)
+    # Function-distribution cache key (reference: function_manager
+    # export via GCS KV + worker import thread). When set, cluster
+    # shipping may strip `func` from the wire copy after the first
+    # export — nodes re-resolve it from their cache or the head's KV.
+    func_id: Optional[bytes] = None
     # Depth for scheduling fairness / detection of recursive deadlock
     depth: int = 0
     # Distributed tracing: (trace_id_hex, parent_span_id_hex) propagated

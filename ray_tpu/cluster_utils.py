@@ -103,6 +103,9 @@ class _NodeRecord:
         # Latest physical-stats sample from the node's in-process agent
         # (node_stats.py), carried on resource reports.
         self.stats: Dict[str, Any] = {}
+        # Function-ids whose definitions this node has already received
+        # (function-distribution cache; see _strip_exported_func).
+        self.known_fns: set = set()
 
 
 class ClusterHead:
@@ -146,6 +149,8 @@ class ClusterHead:
         # instead of failing fast.
         self.pending_demands: Dict[bytes, Dict[str, float]] = {}
         self.autoscaling_enabled = False
+        # Function definitions exported to the KV (namespace __fn__).
+        self.exported_fns: set = set()
         # Placement-group bundle locations: (pg_id_binary, index) ->
         # node_id, or None for the head itself.
         self.pg_bundle_nodes: Dict[Tuple[bytes, int], Optional[str]] = {}
@@ -989,11 +994,65 @@ class ClusterBackendMixin:
         # only the caller retries.
         self.head.record_lineage(spec)
         self.head.record_inflight(spec, node.node_id)
+        wire_spec = self._strip_exported_func(spec, node)
         try:
-            RpcClient.to(node.address).call("submit_task", spec=spec)
+            RpcClient.to(node.address).call("submit_task",
+                                            spec=wire_spec)
         except BaseException:
             self.head.clear_inflight(spec)
             raise
+
+    def _strip_exported_func(self, spec, node: "_NodeRecord"):
+        """Function-distribution cache (reference: function_manager
+        export via GCS KV + worker import thread). The first shipment of
+        a function to the cluster exports its cloudpickle to the head KV
+        under its content hash; once a node has seen the id, later task
+        specs travel WITHOUT the function body (often the bulk of a
+        small task's wire bytes) and the node re-resolves from its local
+        cache, falling back to the head KV."""
+        fid = getattr(spec, "func_id", None)
+        if fid is None or spec.kind == TaskKind.ACTOR_TASK:
+            return spec
+        head = self.head
+        if fid not in head.exported_fns:
+            from ray_tpu.remote_function import get_export_blob
+
+            blob = get_export_blob(fid)
+            if blob is None:
+                # No registry entry in THIS process (e.g. spec arrived
+                # through the ray-client server): re-pickle, and key the
+                # export by the hash of what we actually store — the
+                # KV blob and its id must never diverge.
+                import hashlib
+
+                import cloudpickle
+
+                try:
+                    blob = cloudpickle.dumps(spec.func)
+                except Exception:
+                    return spec  # unexportable: ship inline as before
+                actual = hashlib.sha1(blob).digest()
+                if actual != fid:
+                    fid = actual
+                    import copy
+
+                    spec = copy.copy(spec)
+                    spec.func_id = fid
+            if fid not in head.exported_fns:
+                try:
+                    head.worker.gcs.kv_put(fid, blob,
+                                           namespace=b"__fn__")
+                except Exception:
+                    return spec
+                head.exported_fns.add(fid)
+        if fid in node.known_fns:
+            import copy
+
+            wire_spec = copy.copy(spec)
+            wire_spec.func = None
+            return wire_spec
+        node.known_fns.add(fid)  # first shipment carries the body
+        return spec
 
     # Delegate everything else to the local backend.
 

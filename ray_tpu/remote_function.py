@@ -29,6 +29,15 @@ _TASK_OPTIONS = {
 }
 
 
+# fid -> the exact cloudpickle whose sha1 is the fid (the function-
+# distribution cache's export source; one entry per unique definition).
+_EXPORT_BLOBS: dict = {}
+
+
+def get_export_blob(fid: bytes):
+    return _EXPORT_BLOBS.get(fid)
+
+
 class RemoteFunction:
     def __init__(self, func, **default_options):
         bad = set(default_options) - _TASK_OPTIONS
@@ -36,7 +45,34 @@ class RemoteFunction:
             raise ValueError(f"Invalid @remote options for a function: {sorted(bad)}")
         self._function = func
         self._default_options = default_options
+        # Export-cache identity, computed lazily at first .remote():
+        # hash of the cloudpickled definition. NB this freezes the
+        # function's captured state at first submission (the reference's
+        # one-time function export does the same); module-level
+        # functions are unaffected (pickled by reference).
+        self._func_id: bytes | None = None
         functools.update_wrapper(self, func)
+
+    def _export_id(self):
+        if self._func_id is None:
+            import hashlib
+
+            import cloudpickle
+
+            try:
+                blob = cloudpickle.dumps(self._function)
+            except Exception:
+                # Unpicklable closure (lock, socket, ...): fine in
+                # local mode where the function is called in-process —
+                # no export id, everything ships/runs inline as before.
+                self._func_id = False
+                return None
+            self._func_id = hashlib.sha1(blob).digest()
+            # The blob whose hash IS the id is what any export must
+            # store — re-pickling later could capture mutated closure
+            # state under the same id (divergent versions per node).
+            _EXPORT_BLOBS[self._func_id] = blob
+        return self._func_id or None
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -49,7 +85,9 @@ class RemoteFunction:
         if bad:
             raise ValueError(f"Invalid options: {sorted(bad)}")
         merged = {**self._default_options, **options}
-        return RemoteFunction(self._function, **merged)
+        rf = RemoteFunction(self._function, **merged)
+        rf._func_id = self._func_id  # same definition: share the export
+        return rf
 
     def remote(self, *args, **kwargs):
         opts = self._default_options
@@ -86,6 +124,7 @@ class RemoteFunction:
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
             trace_parent=(trace_parent_from(ctx["task_spec"])
                           if ctx else None),
+            func_id=self._export_id(),
         )
         refs = w.submit(spec)
         if num_returns == 0:
