@@ -90,6 +90,13 @@ def main():
             total_tokens[0] += count
 
     def run_wave(wave_prompts):
+        """Run one wave; resets the accumulators on entry and returns a
+        per-wave snapshot (no shared state to save/restore between
+        waves)."""
+        ttfts.clear()
+        total_tokens[0] = 0
+        first_times.clear()
+        last_times[0] = 0.0
         t_start = time.perf_counter()
         threads = [threading.Thread(target=one_request, args=(p,))
                    for p in wave_prompts]
@@ -97,35 +104,40 @@ def main():
             t.start()
         for t in threads:
             t.join()
-        return time.perf_counter() - t_start
+        return {
+            "wall": time.perf_counter() - t_start,
+            "ttfts": sorted(ttfts),
+            "tokens": total_tokens[0],
+            "first_times": list(first_times),
+            "last_time": last_times[0],
+        }
 
     # Wave 1 absorbs the platform's idle-restart stall (the tunneled
     # chip's first dispatch after an idle gap blocks for seconds —
     # measured ~3.5s on a program that runs in ~60ms warm; see
     # BENCH_CALIBRATION.json). Wave 2 is the steady-state serving number
     # a loaded server sees; wave-1 numbers ride along as cold-start.
-    cold_wall = run_wave(prompts)
-    cold_ttfts = sorted(ttfts)
-    cold_p50 = cold_ttfts[len(cold_ttfts) // 2]
-    ttfts.clear()
-    total_tokens[0] = 0
-    first_times.clear()
-    last_times[0] = 0.0
-    wall = run_wave(prompts)
+    cold = run_wave(prompts)
+    cold_p50 = cold["ttfts"][len(cold["ttfts"]) // 2]
+    steady = run_wave(prompts)
+    # Decode-rate wave: exactly batch_slots concurrent requests so the
+    # post-first-token window is pure continuous-batching decode (a
+    # multi-wave run interleaves wave N's decode with wave N+1's
+    # prefills and would misattribute the time).
+    dec_prompts = prompts[:args.batch_size]
+    dec = run_wave(dec_prompts)
+    decode_window = max(dec["last_time"] - max(dec["first_times"]), 1e-9)
+    decode_tokens = dec["tokens"] - len(dec_prompts)
+    decode_rate = round(decode_tokens / decode_window, 1)
     engine.stop()
 
-    ttfts.sort()
-    p50 = ttfts[len(ttfts) // 2]
-    p95 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))]
-    # Decode-phase rate: once every request has its first token, the
-    # remaining tokens are pure continuous-batching decode (prefill cost
-    # is what TTFT measures). Only meaningful when every request fits in
-    # one wave (requests <= slots); in multi-wave runs the first wave
-    # decodes before the last wave's first token, which would inflate
-    # the figure — report null there.
-    one_wave = args.requests <= args.batch_size
-    decode_window = max(last_times[0] - max(first_times), 1e-9)
-    decode_tokens = total_tokens[0] - len(prompts)
+    wall = steady["wall"]
+    cold_wall = cold["wall"]
+    total_tokens[0] = steady["tokens"]
+    sorted_ttfts = steady["ttfts"]
+    p50 = sorted_ttfts[len(sorted_ttfts) // 2]
+    p95 = sorted_ttfts[min(len(sorted_ttfts) - 1,
+                           int(len(sorted_ttfts) * 0.95))]
     print(json.dumps({
         "metric": "serve_ttft_p50_ms",
         "value": round(p50 * 1e3, 1),
@@ -136,7 +148,7 @@ def main():
             "cold_start_ttft_p50_ms": round(cold_p50 * 1e3, 1),
             "cold_start_wall_s": round(cold_wall, 2),
             "deploy_warmup_s": round(warmup_s, 2),
-            "decode_tokens_per_s": round(decode_tokens / decode_window, 1) if one_wave else None,
+            "decode_tokens_per_s": decode_rate,
             "end_to_end_tokens_per_s": round(total_tokens[0] / wall, 1),
             "requests": args.requests,
             "prompt_len": prompt_len,
