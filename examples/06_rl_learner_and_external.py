@@ -1,0 +1,73 @@
+"""The round-4 RL stack: async on-device learner, pixel env with the
+conv torso, distributed replay, and external-environment serving.
+
+Run: python examples/06_rl_learner_and_external.py
+"""
+import ray_tpu
+from ray_tpu.rl import (
+    ApexDQNConfig,
+    IMPALAConfig,
+    PolicyClient,
+    PolicyServer,
+    get_actor_critic_model,
+)
+from ray_tpu.rl.env import CartPoleEnv
+
+ray_tpu.init()
+
+# 1) IMPALA with the learner thread: rollout actors stream pixel
+# fragments into a queue while the conv V-trace update runs
+# continuously on the accelerator (sampling and learning overlap).
+config = (IMPALAConfig()
+          .environment("CatchPixels-v0")
+          .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                    rollout_fragment_length=40)
+          .training(lr=3e-4, updates_per_iter=4)
+          .learners(use_learner_thread=True, num_sgd_iter=2))
+algo = config.build()
+for i in range(3):
+    r = algo.train()
+    print(f"IMPALA iter {i}: updates={r['learner_updates']} "
+          f"busy={r['device_busy_fraction']:.2f} "
+          f"sampled={r['num_env_steps_sampled_this_iter']}")
+algo.cleanup()
+
+# 2) Ape-X DQN: replay sharded across actors, per-worker epsilons.
+apex = (ApexDQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                  rollout_fragment_length=32)
+        .training(learning_starts=128, num_sgd_per_iter=8)).build()
+for i in range(3):
+    r = apex.train()
+    print(f"ApexDQN iter {i}: shards={r['replay_shard_sizes']} "
+          f"eps={r['worker_epsilons']}")
+apex.cleanup()
+
+# 3) External-env serving: a simulator YOU own drives episodes against
+# a policy server (reference PolicyClient/PolicyServerInput).
+import jax
+
+env = CartPoleEnv()
+spec = get_actor_critic_model(env.observation_space, env.action_space)
+server = PolicyServer(spec.apply, spec.init(jax.random.PRNGKey(0)),
+                      batch_size=128)
+client = PolicyClient(server.address)
+for ep in range(4):
+    eid = client.start_episode()
+    obs, _ = env.reset(seed=ep)
+    for _ in range(60):
+        action = client.get_action(eid, obs)
+        obs, reward, term, trunc, _ = env.step(action)
+        client.log_returns(eid, reward)
+        if term or trunc:
+            break
+    client.end_episode(eid, obs)
+print("external episodes:", server.episode_returns)
+batch = server.get_samples(timeout=2)
+if batch is not None:
+    print("accumulated training batch:", len(batch["obs"]), "rows")
+client.close()
+server.shutdown()
+ray_tpu.shutdown()
+print("done")
