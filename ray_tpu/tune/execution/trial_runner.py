@@ -55,7 +55,9 @@ class TrialRunner:
                  failure_config: Optional[FailureConfig] = None,
                  max_concurrent_trials: Optional[int] = None,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 callbacks: Optional[List] = None):
+                 callbacks: Optional[List] = None,
+                 trial_generator: Optional[Any] = None,
+                 generator_exhausted: Optional[Any] = None):
         self.trainable_cls = trainable_cls
         self.trials = trials
         self.scheduler = scheduler or FIFOScheduler()
@@ -67,6 +69,13 @@ class TrialRunner:
         self.callbacks = callbacks or []
         self._in_flight: Dict[Any, Trial] = {}
         self._stop_all = False
+        # Lazy trial source (reference: SearchGenerator) — model-based
+        # searchers must see completed results BEFORE suggesting later
+        # configs; suggesting every trial up front would reduce them to
+        # random search. The runner pulls a new trial whenever a
+        # concurrency slot frees, until `generator_exhausted()`.
+        self._trial_generator = trial_generator
+        self._generator_exhausted = generator_exhausted or (lambda: True)
 
     # -- actor management ------------------------------------------------
 
@@ -168,6 +177,23 @@ class TrialRunner:
                 self._start_trial(trial)
                 self._submit(trial)
                 running += 1
+        while (self._trial_generator is not None and not self._stop_all
+               and running < self.max_concurrent
+               and not self._generator_exhausted()):
+            trial = self._trial_generator()
+            if trial is None:
+                # "Not now" (e.g. a ConcurrencyLimiter waiting on live
+                # trials). If nothing is running or pending, nothing
+                # will ever unblock it — drop the source (livelock
+                # guard) rather than spin forever.
+                if not any(t.status in (Trial.RUNNING, Trial.PENDING)
+                           for t in self.trials):
+                    self._trial_generator = None
+                break
+            self.trials.append(trial)
+            self._start_trial(trial)
+            self._submit(trial)
+            running += 1
         if not self._in_flight:
             return
         ready, _ = ray_tpu.wait(list(self._in_flight), num_returns=1,
@@ -240,6 +266,9 @@ class TrialRunner:
     def is_finished(self) -> bool:
         if self._stop_all:
             return True
+        if self._trial_generator is not None and \
+                not self._generator_exhausted():
+            return False
         return all(t.is_finished() for t in self.trials)
 
     def run(self):

@@ -24,4 +24,7 @@ from ray_tpu.tune.search.searcher import (  # noqa: F401
     Repeater,
     Searcher,
 )
-from ray_tpu.tune.search.tpe import TPESearch  # noqa: F401
+from ray_tpu.tune.search.tpe import (  # noqa: F401
+    BOHBSearch,
+    TPESearch,
+)

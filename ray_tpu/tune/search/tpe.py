@@ -32,6 +32,11 @@ class TPESearch(Searcher):
         self._rng = _random.Random(seed)
         self._observations: List[Tuple[Dict[str, Any], float]] = []
         self._pending: Dict[str, Dict[str, Any]] = {}
+        # Telemetry: how many suggestions came from the fitted model vs
+        # random startup (tests assert the model phase actually runs —
+        # an eagerly-suggesting driver would silently reduce TPE to
+        # random search).
+        self.model_suggestions = 0
 
     # -- dimension helpers ----------------------------------------------
 
@@ -79,10 +84,14 @@ class TPESearch(Searcher):
 
     # -- TPE core --------------------------------------------------------
 
+    @staticmethod
+    def _rank_split(obs, gamma):
+        ranked = sorted(obs, key=lambda p: -p[1])
+        k = max(1, int(len(ranked) * gamma))
+        return ranked[:k], ranked[k:]
+
     def _split(self):
-        obs = sorted(self._observations, key=lambda p: -p[1])
-        k = max(1, int(len(obs) * self.gamma))
-        return obs[:k], obs[k:]
+        return self._rank_split(self._observations, self.gamma)
 
     def _kde_sample(self, points: List[float]) -> float:
         # Parzen window: pick an observed point, jitter by its bandwidth.
@@ -116,6 +125,7 @@ class TPESearch(Searcher):
                    for k, dom in self.space.items()}
             self._pending[trial_id] = cfg
             return dict(cfg)
+        self.model_suggestions += 1
         good, bad = self._split()
         good_cfgs = [c for c, _ in good]
         bad_cfgs = [c for c, _ in bad]
@@ -180,3 +190,52 @@ class TPESearch(Searcher):
         value = result[self.metric]
         self._observations.append(
             (cfg, value if self.mode == "max" else -value))
+
+
+class BOHBSearch(TPESearch):
+    """BOHB's model half (reference `tune/search/bohb/` TuneBOHB,
+    Falkner et al. 2018): TPE fit on results at the LARGEST budget that
+    has enough observations, so cheap low-rung evaluations guide early
+    sampling and high-rung results take over as they accumulate. Pair
+    with `HyperBandScheduler` (the bracket half); report intermediate
+    results via on_trial_result so rung-level observations land even
+    for trials the scheduler stops early.
+    """
+
+    def __init__(self, space, metric, mode: str = "max", *,
+                 time_attr: str = "training_iteration",
+                 min_points_per_budget: Optional[int] = None, **kwargs):
+        super().__init__(space, metric, mode, **kwargs)
+        self.time_attr = time_attr
+        self.min_points = min_points_per_budget \
+            if min_points_per_budget is not None \
+            else len(list(self._dims())) + 1
+        # budget -> [(config, signed score)]
+        self._by_budget: Dict[float, List[Tuple[Dict[str, Any],
+                                                float]]] = {}
+
+    def on_trial_result(self, trial_id, result):
+        cfg = self._pending.get(trial_id)
+        metric = result.get(self.metric)
+        budget = result.get(self.time_attr)
+        if cfg is None or metric is None or budget is None:
+            return
+        score = metric if self.mode == "max" else -metric
+        self._by_budget.setdefault(float(budget), []).append(
+            (dict(cfg), score))
+
+    def _split(self):
+        # Largest budget with enough data wins (the BOHB rule); fall
+        # back through smaller budgets, then the terminal-result pool.
+        for budget in sorted(self._by_budget, reverse=True):
+            obs = self._by_budget[budget]
+            if len(obs) >= self.min_points:
+                return self._rank_split(obs, self.gamma)
+        return super()._split()
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        super().on_trial_complete(trial_id, result, error)
+        # Bound per-budget history like the observation pool.
+        for budget in list(self._by_budget):
+            if len(self._by_budget[budget]) > 500:
+                self._by_budget[budget] = self._by_budget[budget][-500:]

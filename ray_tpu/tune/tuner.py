@@ -177,6 +177,27 @@ class Tuner:
         tuner._restored_trials = trials
         return tuner
 
+    def _setup_lazy_suggestions(self, start: int):
+        """Install the runner-facing trial generator; returns it."""
+        tc = self.tune_config
+        ckpt_cfg = self.run_config.checkpoint_config
+        self._suggest_count = start
+
+        def next_trial():
+            if self._suggest_count >= tc.num_samples:
+                return None
+            tid = f"t{self._suggest_count:05d}"
+            cfg = tc.search_alg.suggest(tid)
+            if cfg is None:
+                return None
+            self._suggest_count += 1
+            return Trial(cfg, checkpoint_config=ckpt_cfg, trial_id=tid)
+
+        self._next_trial = next_trial
+        self._suggest_exhausted = (
+            lambda: self._suggest_count >= tc.num_samples)
+        return next_trial
+
     def _make_trials(self) -> List[Trial]:
         tc = self.tune_config
         ckpt_cfg = self.run_config.checkpoint_config
@@ -184,13 +205,20 @@ class Tuner:
         if tc.search_alg is not None:
             tc.search_alg.set_search_properties(tc.metric, tc.mode,
                                                 self.param_space)
-            for i in range(tc.num_samples):
-                tid = f"t{i:05d}"
-                cfg = tc.search_alg.suggest(tid)
-                if cfg is None:
+            # LAZY suggestion (reference: SearchGenerator): only an
+            # initial concurrency batch up front; the runner pulls the
+            # rest one-by-one as slots free, so model-based searchers
+            # (TPE/BOHB/Optuna) see completed results before suggesting
+            # later configs — suggesting all num_samples here would
+            # degrade every such searcher to random search.
+            next_trial = self._setup_lazy_suggestions(start=0)
+            cap = tc.max_concurrent_trials or min(tc.num_samples, 8)
+            for _ in range(min(cap, tc.num_samples)):
+                t = next_trial()
+                if t is None:
                     break
-                trials.append(Trial(cfg, checkpoint_config=ckpt_cfg,
-                                    trial_id=tid))
+                trials.append(t)
+            return trials
         else:
             for i, cfg in enumerate(generate_variants(
                     self.param_space, tc.num_samples, tc.seed)):
@@ -214,6 +242,12 @@ class Tuner:
             stop_criteria = stop
 
         self._trials = self._restored_trials or self._make_trials()
+        if self._restored_trials is not None and \
+                self.tune_config.search_alg is not None:
+            # Resumed searcher experiment: continue lazy generation from
+            # where the interrupted run stopped (the searcher object was
+            # pickled WITH its observation state in tune_config).
+            self._setup_lazy_suggestions(start=len(self._trials))
         callbacks = list(self.run_config.callbacks)
         if tc.search_alg:
             callbacks.append(_SearcherCallback(tc.search_alg))
@@ -228,6 +262,9 @@ class Tuner:
             max_concurrent_trials=tc.max_concurrent_trials,
             resources_per_trial=tc.resources_per_trial,
             callbacks=callbacks,
+            trial_generator=getattr(self, "_next_trial", None),
+            generator_exhausted=getattr(self, "_suggest_exhausted",
+                                        None),
         )
         runner.run()
         if self.run_config.storage_path:
