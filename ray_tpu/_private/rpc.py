@@ -17,6 +17,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -144,6 +145,7 @@ class RpcServer:
                         rid = None
                     reply = server_self._await_reply(rid) if rid else None
                     if reply is None:
+                        t0 = time.perf_counter()
                         try:
                             fn = server_self.handlers[msg.method]
                             result = fn(**(msg.kwargs or {}))
@@ -155,6 +157,9 @@ class RpcServer:
                                 ok=False,
                                 error=f"{type(e).__name__}: {e}",
                                 traceback=traceback.format_exc())
+                        server_self._record_handler(
+                            msg.method, time.perf_counter() - t0,
+                            ok=reply.ok)
                         server_self._finish_reply(rid, reply)
                     try:
                         send_msg(self.request, reply)
@@ -187,6 +192,8 @@ class RpcServer:
         self._replies: OrderedDict[str, Dict[int, Any]] = OrderedDict()
         self._inflight: Dict[str, threading.Event] = {}
         self._replies_lock = threading.Lock()
+        self._handler_stats: Dict[str, Dict[str, float]] = {}
+        self._stats_lock = threading.Lock()
         self._server = Server((host, port), Handler)
         self.address: Tuple[str, int] = self._server.server_address[:2]
         self._thread = threading.Thread(
@@ -196,6 +203,34 @@ class RpcServer:
 
     def add_handler(self, name: str, fn: Callable):
         self.handlers[name] = fn
+
+    # -- per-handler stats (reference: instrumented_io_context +
+    # event_stats — per-handler latency visibility on control loops) ----
+
+    def _record_handler(self, method: str, seconds: float, ok: bool):
+        with self._stats_lock:
+            st = self._handler_stats.setdefault(
+                method, {"calls": 0, "errors": 0, "total_s": 0.0,
+                         "max_s": 0.0})
+            st["calls"] += 1
+            if not ok:
+                st["errors"] += 1
+            st["total_s"] += seconds
+            st["max_s"] = max(st["max_s"], seconds)
+
+    def handler_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-method call counts and latency aggregates."""
+        with self._stats_lock:
+            out = {}
+            for method, st in self._handler_stats.items():
+                mean = st["total_s"] / st["calls"] if st["calls"] else 0
+                out[method] = {
+                    "calls": st["calls"], "errors": st["errors"],
+                    "mean_ms": round(mean * 1e3, 3),
+                    "max_ms": round(st["max_s"] * 1e3, 3),
+                    "total_s": round(st["total_s"], 3),
+                }
+            return out
 
     @staticmethod
     def _split_rid(rid: str) -> Tuple[str, int]:

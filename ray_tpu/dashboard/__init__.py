@@ -157,6 +157,9 @@ class DashboardServer:
                 "available_resources": ray_tpu.available_resources(),
                 "task_summary": state.summarize_tasks(),
                 "actor_summary": state.summarize_actors(),
+                # Per-handler control-plane latency (the reference's
+                # instrumented_io_context event-stats role).
+                "head_rpc_handlers": self._head_handler_stats(),
             },
             "/api/serve": self._serve_status,
             "/api/serve/applications": self._serve_applications,
@@ -177,6 +180,15 @@ class DashboardServer:
         if rest.endswith("/logs"):
             return {"logs": client.get_job_logs(rest[:-len("/logs")])}
         return dataclasses.asdict(client.get_job_info(rest))
+
+    @staticmethod
+    def _head_handler_stats():
+        from ray_tpu._private.worker import global_worker_or_none
+
+        worker = global_worker_or_none()
+        head = getattr(worker, "cluster_head", None) if worker else None
+        server = getattr(head, "server", None)
+        return server.handler_stats() if server is not None else {}
 
     @staticmethod
     def _logs_route(path: str):

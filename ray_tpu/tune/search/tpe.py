@@ -20,6 +20,10 @@ from ray_tpu.tune.search.searcher import Searcher
 
 
 class TPESearch(Searcher):
+    # Class-level default so searchers unpickled from pre-telemetry
+    # experiment state resume without AttributeError.
+    model_suggestions = 0
+
     def __init__(self, space: Dict[str, Any], metric: str,
                  mode: str = "max", *, n_startup: int = 8,
                  gamma: float = 0.25, n_candidates: int = 24,

@@ -177,6 +177,13 @@ class Tuner:
         tuner._restored_trials = trials
         return tuner
 
+    def _searcher_cap(self) -> int:
+        """Concurrency for searcher-driven runs — also the runner's cap,
+        so a resumed run can't burst-suggest past what a fresh run of
+        the same config would allow."""
+        tc = self.tune_config
+        return tc.max_concurrent_trials or max(1, min(tc.num_samples, 8))
+
     def _setup_lazy_suggestions(self, start: int):
         """Install the runner-facing trial generator; returns it."""
         tc = self.tune_config
@@ -212,13 +219,13 @@ class Tuner:
             # later configs — suggesting all num_samples here would
             # degrade every such searcher to random search.
             next_trial = self._setup_lazy_suggestions(start=0)
-            cap = tc.max_concurrent_trials or min(tc.num_samples, 8)
+            cap = self._searcher_cap()
             for _ in range(min(cap, tc.num_samples)):
                 t = next_trial()
                 if t is None:
                     break
                 trials.append(t)
-            return trials
+            return trials or [Trial({}, checkpoint_config=ckpt_cfg)]
         else:
             for i, cfg in enumerate(generate_variants(
                     self.param_space, tc.num_samples, tc.seed)):
@@ -259,7 +266,9 @@ class Tuner:
             scheduler=scheduler, stopper=stopper,
             stop_criteria=stop_criteria,
             failure_config=self.run_config.failure_config,
-            max_concurrent_trials=tc.max_concurrent_trials,
+            max_concurrent_trials=(self._searcher_cap()
+                                   if tc.search_alg is not None
+                                   else tc.max_concurrent_trials),
             resources_per_trial=tc.resources_per_trial,
             callbacks=callbacks,
             trial_generator=getattr(self, "_next_trial", None),

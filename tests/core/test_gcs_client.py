@@ -139,3 +139,26 @@ def test_events_forward_from_node_and_pg_table_plain():
         remove_placement_group(pg)
     finally:
         cluster.shutdown()
+
+
+def test_rpc_handler_stats_surface():
+    """Per-handler control-plane latency stats (instrumented_io_context
+    event-stats role) are recorded and served by the dashboard."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote(num_cpus=2)
+        def work(i):
+            return i
+
+        assert ray_tpu.get([work.remote(i) for i in range(10)],
+                           timeout=60) == list(range(10))
+        stats = cluster.head.server.handler_stats()
+        assert "report_objects" in stats, stats.keys()
+        row = stats["report_objects"]
+        assert row["calls"] >= 10
+        assert row["mean_ms"] >= 0 and row["max_ms"] >= row["mean_ms"]
+        assert row["errors"] == 0
+    finally:
+        cluster.shutdown()
