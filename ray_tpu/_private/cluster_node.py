@@ -88,6 +88,9 @@ class NodeRuntime:
         self._shutdown_event = threading.Event()
         self._install_report_hook()
         self._install_borrow_hooks()
+        self._install_cluster_actor_routing()
+        self._install_fetch_on_get()
+        self._install_cluster_named_actors()
 
         self.server = RpcServer({
             "submit_task": self._submit_task,
@@ -316,8 +319,10 @@ class NodeRuntime:
                 if isinstance(arg, ObjectRef)]
         missing = [d for d in deps
                    if not self.worker.memory_store.contains(d)]
+        submit = getattr(self, "_orig_backend_submit",
+                         self.worker.backend.submit)
         if not missing:
-            self.worker.backend.submit(spec)
+            submit(spec)
             return True
 
         # Pull remote deps off the RPC thread: ack immediately so the
@@ -327,7 +332,7 @@ class NodeRuntime:
             try:
                 for d in missing:
                     self._fetch_dependency(d)
-                self.worker.backend.submit(spec)
+                submit(spec)
             except BaseException as e:  # noqa: BLE001
                 from ray_tpu import exceptions as exc
 
@@ -337,6 +342,148 @@ class NodeRuntime:
 
         threading.Thread(target=fetch_then_submit, daemon=True).start()
         return True
+
+    def _install_cluster_actor_routing(self):
+        """Actor handles work from ANY process (reference: the direct
+        actor transport reaches actors wherever they live). A task here
+        holding a handle to an actor that does NOT live in this node
+        routes the call through the head, whose cluster backend knows
+        every actor's home; results come back over the object plane."""
+        backend = self.worker.backend
+        node = self
+        orig_submit = backend.submit
+        # Submissions ARRIVING over RPC (the head directed them here)
+        # must bypass the wrapper: routing them back to the head when a
+        # creation's mailbox isn't registered yet would ping-pong
+        # head<->node in nested blocking RPCs.
+        self._orig_backend_submit = orig_submit
+
+        def submit(spec):
+            from ray_tpu._private.task_spec import TaskKind
+
+            if spec.kind == TaskKind.ACTOR_TASK and \
+                    spec.actor_id not in backend._actors:
+                node.head.call("route_task", spec=spec)
+                return
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                # A locally-created actor must exist in the head's
+                # directory or handles to it can't route from other
+                # processes.
+                orig_submit(spec)
+                for attempt in range(3):
+                    try:
+                        node.head.call("report_actor", spec=spec,
+                                       node_id=node.node_id)
+                        break
+                    except Exception:
+                        # Unregistered = unroutable from every other
+                        # process; worth a few retries and a loud log.
+                        if attempt == 2:
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "could not register actor %s with the "
+                                "head; remote handles to it will fail",
+                                spec.actor_id.hex()[:8])
+                        time.sleep(0.2)
+                return
+            orig_submit(spec)
+
+        backend.submit = submit
+
+    def _install_fetch_on_get(self):
+        """On-demand remote-object fetch for get()/wait() issued INSIDE
+        node code (e.g. a routed actor call's result): the dep-fetch
+        machinery covers task ARGUMENTS; this covers refs acquired
+        mid-task. Mirrors the driver's ClusterDriverMixin."""
+        worker = self.worker
+        node = self
+        fetching: set = set()
+        lock = threading.Lock()
+
+        def ensure_fetch(ref):
+            if worker.memory_store.contains(ref.id):
+                return
+            key = ref.id.binary()
+            with lock:
+                if key in fetching:
+                    return
+                fetching.add(key)
+
+            def fetch(oid=ref.id):
+                from ray_tpu import exceptions as exc
+
+                try:
+                    node._fetch_dependency(oid)
+                except TimeoutError:
+                    # Deadline expiry is NOT evidence of a dead owner —
+                    # the producer may simply still be running. Give up
+                    # quietly (the caller's own get timeout governs);
+                    # dropping the fetching entry lets a later get
+                    # retry. Poisoning here would fail healthy slow
+                    # calls AND stick for every later reader.
+                    pass
+                except BaseException as e:  # noqa: BLE001
+                    if not worker.memory_store.contains(oid):
+                        worker.memory_store.put(
+                            oid, None, error=exc.OwnerDiedError(
+                                oid.hex()[:12],
+                                f"fetch failed on node "
+                                f"{node.node_id}: {e}"))
+                finally:
+                    with lock:
+                        fetching.discard(key)
+
+            threading.Thread(target=fetch, daemon=True).start()
+
+        original_get = worker.get_objects
+        original_wait = worker.wait
+
+        def get_objects(refs, timeout=None):
+            for ref in refs:
+                ensure_fetch(ref)
+            return original_get(refs, timeout)
+
+        def wait(refs, num_returns, timeout, *args, **kw):
+            for ref in refs:
+                ensure_fetch(ref)
+            return original_wait(refs, num_returns, timeout, *args,
+                                 **kw)
+
+        worker.get_objects = get_objects
+        worker.wait = wait
+
+    def _install_cluster_named_actors(self):
+        """Named actors are a CLUSTER-wide registry (reference:
+        GcsActorManager named actors); node-local registrations/lookups
+        delegate to the head."""
+        gcs = self.worker.gcs
+        head = self.head
+
+        def register(name, namespace, handle):
+            head.call("gcs_named_actor_register", name=name,
+                      namespace=namespace, handle=handle)
+
+        def get(name, namespace):
+            try:
+                return head.call("gcs_named_actor_get", name=name,
+                                 namespace=namespace)
+            except Exception as e:
+                raise ValueError(
+                    f"Failed to look up actor {name!r}") from e
+
+        def list_named(all_namespaces=False):
+            return head.call("gcs_named_actors",
+                             all_namespaces=all_namespaces)
+
+        def remove_by_id(actor_id):
+            head.call("gcs_named_actor_remove",
+                      actor_id=actor_id.binary())
+
+        gcs.register_named_actor = register
+        gcs.get_named_actor = get
+        gcs.list_named_actors = list_named
+        gcs.remove_named_actor_by_id = remove_by_id
 
     def _resolve_function(self, fid: bytes):
         """Function-distribution import side (reference: the worker

@@ -177,7 +177,18 @@ class ClusterHead:
             "gcs_pg_table": self._gcs_pg_table,
             "gcs_events": self._gcs_events,
             "gcs_record_event": self._gcs_record_event,
-        }, dedupe_methods=frozenset({"gcs_kv_put"}))
+            # Cross-node actor plumbing: nodes route actor tasks for
+            # non-local actors through the head's cluster backend
+            # (reference: the owner's direct actor transport reaches any
+            # node; here the head is the directory), and resolve named
+            # actors from the head's registry.
+            "route_task": self._route_task,
+            "report_actor": self._report_actor,
+            "gcs_named_actor_register": self._named_actor_register,
+            "gcs_named_actor_get": self._named_actor_get,
+            "gcs_named_actor_remove": self._named_actor_remove,
+        }, dedupe_methods=frozenset({"gcs_kv_put", "route_task",
+                                     "gcs_named_actor_register"}))
         # Long-poll pubsub channels (reference: pubsub/publisher.h:302);
         # node lifecycle events publish here.
         from ray_tpu._private.pubsub import Publisher
@@ -464,11 +475,13 @@ class ClusterHead:
         with self._lock:
             spec = self.actor_specs.get(actor_id)
             left = self.actor_restarts_left.get(actor_id, 0)
-            if spec is None or left <= 0:
+            # max_restarts=-1 means infinite (reference semantics).
+            if spec is None or left == 0:
                 # No restart budget: future calls fail fast.
                 self.actor_nodes.pop(actor_id, None)
                 return
-            self.actor_restarts_left[actor_id] = left - 1
+            if left > 0:
+                self.actor_restarts_left[actor_id] = left - 1
             self.actor_nodes.pop(actor_id, None)
         # Re-run the creation spec through the normal scheduler; it
         # re-registers the actor's node on dispatch.
@@ -571,6 +584,35 @@ class ClusterHead:
                 return True, value, error
             time.sleep(0.005)
         return False, None, None
+
+    def _route_task(self, spec) -> bool:
+        """Submit a node-originated spec through the head's cluster
+        backend (which knows where every actor lives); results travel
+        back through the object plane like any other output."""
+        self.worker.backend.submit(spec)
+        return True
+
+    def _report_actor(self, spec, node_id: str) -> bool:
+        """An actor created LOCALLY inside a node process registers with
+        the head's directory, so handles to it route from anywhere and
+        it gets the same restart bookkeeping as head-dispatched actors."""
+        self.record_lineage(spec)
+        with self._lock:
+            self.actor_nodes[spec.actor_id.binary()] = node_id
+        return True
+
+    def _named_actor_register(self, name, namespace, handle) -> bool:
+        self.worker.gcs.register_named_actor(name, namespace, handle)
+        return True
+
+    def _named_actor_get(self, name, namespace):
+        return self.worker.gcs.get_named_actor(name, namespace)
+
+    def _named_actor_remove(self, actor_id: bytes) -> bool:
+        from ray_tpu._private.ids import ActorID
+
+        self.worker.gcs.remove_named_actor_by_id(ActorID(actor_id))
+        return True
 
     @staticmethod
     def _gcs_events(limit: int = 200, source=None):

@@ -77,7 +77,9 @@ def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
 
     actors = []
     for i in range(num_proxies):
-        opts = {}
+        # Proxies restart indefinitely (the reference's http_state keeps
+        # the fleet alive across node failures).
+        opts = {"max_restarts": -1}
         if spread:
             opts["scheduling_strategy"] = SpreadSchedulingStrategy()
         port = base_port + i if base_port else 0

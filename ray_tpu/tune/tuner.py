@@ -225,7 +225,10 @@ class Tuner:
                 if t is None:
                     break
                 trials.append(t)
-            return trials or [Trial({}, checkpoint_config=ckpt_cfg)]
+            # Possibly empty (e.g. a limiter's "not now"): the runner's
+            # generator pulls real trials later — never fabricate a
+            # bogus empty-config trial.
+            return trials
         else:
             for i, cfg in enumerate(generate_variants(
                     self.param_space, tc.num_samples, tc.seed)):

@@ -1,0 +1,90 @@
+"""Cross-process actor semantics: handles work from ANY process and
+named actors are a cluster-wide registry (reference: direct actor
+transport + GcsActorManager named actors)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.slow  # multi-process cluster
+
+
+def test_node_task_calls_actor_on_other_process():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self, k=1):
+                self.n += k
+                return self.n
+
+        c = Counter.remote()  # lands on the driver
+        assert ray_tpu.get(c.inc.remote()) == 1
+
+        @ray_tpu.remote(num_cpus=2)
+        def bump_from_node(handle, k):
+            # routed via the head; result fetched on demand here
+            return ray_tpu.get(handle.inc.remote(k), timeout=60)
+
+        outs = ray_tpu.get([bump_from_node.remote(c, 10),
+                            bump_from_node.remote(c, 100)], timeout=120)
+        assert sorted(outs) == [11, 111] or sorted(outs) == [101, 111]
+        assert ray_tpu.get(c.inc.remote()) == 112
+        ray_tpu.kill(c)
+    finally:
+        cluster.shutdown()
+
+
+def test_named_actor_resolves_from_node_process():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote
+        class Store:
+            def __init__(self):
+                self.v = {}
+
+            def put(self, k, v):
+                self.v[k] = v
+                return True
+
+            def get(self, k):
+                return self.v.get(k)
+
+        s = Store.options(name="global_store").remote()
+        assert ray_tpu.get(s.put.remote("a", 41))
+
+        @ray_tpu.remote(num_cpus=2)
+        def use_named():
+            h = ray_tpu.get_actor("global_store")
+            ray_tpu.get(h.put.remote("b", 42), timeout=60)
+            return ray_tpu.get(h.get.remote("a"), timeout=60)
+
+        assert ray_tpu.get(use_named.remote(), timeout=120) == 41
+        assert ray_tpu.get(s.get.remote("b")) == 42
+
+        # registration FROM a node is visible at the driver
+        @ray_tpu.remote(num_cpus=2)
+        def register_one():
+            @ray_tpu.remote
+            class NodeLocal:
+                def ping(self):
+                    return "pong"
+
+            NodeLocal.options(name="from_node").remote()
+            return True
+
+        assert ray_tpu.get(register_one.remote(), timeout=120)
+        h = ray_tpu.get_actor("from_node")
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+        ray_tpu.kill(s)
+    finally:
+        cluster.shutdown()

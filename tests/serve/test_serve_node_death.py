@@ -57,3 +57,84 @@ def test_serve_survives_replica_node_death(fast_health):
     finally:
         serve.shutdown()
         cluster.shutdown()
+
+
+def test_proxy_fleet_survives_proxy_node_death(fast_health):
+    """A proxy-actor's node dies mid-traffic: requests keep succeeding
+    through surviving proxies, and the dead proxy is restarted on a
+    surviving node (actor restart budget) and serves again — the
+    reference's http_state proxy-fleet management under node failure."""
+    import json
+    import urllib.request
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps({"payload": payload}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise AssertionError(
+                f"HTTP {e.code} from {url}: {e.read()[:400]}")
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    victim = cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    try:
+        @serve.deployment(num_replicas=1)
+        class Echo:
+            def __call__(self, body):
+                # the proxy passes the parsed JSON body as the argument
+                return {"v": body["payload"]}
+
+        serve.run(Echo.bind(), route_prefix="/echo")
+        fleet = serve.start_proxy_fleet(num_proxies=3)
+        assert len(fleet) == 3
+        for _a, (host, port) in fleet:
+            assert post(f"http://{host}:{port}/echo", 7)["v"] == 7
+
+        # Kill the node that actually hosts a proxy (never a proxy-less
+        # node — that would make the restart assertion vacuous).
+        head = cluster.head
+        victim_node = victim_addr = None
+        survivors = []
+        for actor, addr in fleet:
+            nid = head.actor_nodes.get(actor._actor_id.binary())
+            if nid is not None and victim_node is None:
+                victim_node, victim_addr = nid, addr
+            else:
+                survivors.append(addr)
+        assert victim_node is not None, "SPREAD placed no proxy on a node"
+        cluster.remove_node(victim_node, graceful=False)
+
+        # Surviving proxies keep serving immediately.
+        for host, port in survivors[:2]:
+            assert post(f"http://{host}:{port}/echo", 9)["v"] == 9
+
+        # The dead proxy actor restarts elsewhere (max_restarts default)
+        # and its NEW address serves; poll via the actor handle.
+        deadline = time.monotonic() + 30
+        recovered = False
+        for actor, addr in fleet:
+            if addr != victim_addr:
+                continue
+            while time.monotonic() < deadline and not recovered:
+                try:
+                    new_addr = ray_tpu.get(actor.address.remote(),
+                                           timeout=10)
+                    recovered = post(
+                        f"http://{new_addr[0]}:{new_addr[1]}/echo",
+                        11)["v"] == 11
+                except Exception:
+                    time.sleep(0.5)
+        assert recovered, "killed proxy never came back"
+        for actor, _addr in fleet:
+            try:
+                ray_tpu.get(actor.shutdown.remote(), timeout=10)
+            except Exception:
+                pass
+    finally:
+        serve.shutdown()
+        cluster.shutdown()
