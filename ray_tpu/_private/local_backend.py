@@ -93,6 +93,9 @@ class _Actor:
         events.task_started(spec, self.backend.node_id,
                             threading.current_thread().name)
         try:
+            # Constructor args resolve top-level ObjectRefs exactly like
+            # method args (reference: core_worker actor creation task).
+            args, kwargs = self.backend.worker.resolve_args(spec)
             if spec.isolate_process:
                 # The instance lives in a dedicated worker process; the
                 # node only holds the command socket. "spawn" execs a
@@ -100,10 +103,10 @@ class _Actor:
                 # for jax.distributed ranks); True forks.
                 self._proc = self.backend.worker_pool.dedicated(
                     spawn=spec.isolate_process == "spawn", meta=spec)
-                self._proc.request(("init", spec.func, spec.args,
-                                    spec.kwargs, spec.runtime_env))
+                self._proc.request(("init", spec.func, args,
+                                    kwargs, spec.runtime_env))
             else:
-                self.instance = spec.func(*spec.args, **spec.kwargs)
+                self.instance = spec.func(*args, **kwargs)
             self.state = ActorState.ALIVE
             self.backend.worker.store_task_outputs(spec, [None])
             events.task_finished(spec)

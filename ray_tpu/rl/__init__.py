@@ -16,18 +16,28 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     A2CConfig,
     APPO,
     APPOConfig,
+    ARS,
+    ARSConfig,
     ApexDQN,
     ApexDQNConfig,
     BC,
     BCConfig,
+    CQL,
+    CQLConfig,
     DQN,
     DQNConfig,
+    ES,
+    ESConfig,
     IMPALA,
     IMPALAConfig,
     MARWIL,
     MARWILConfig,
     PPO,
     PPOConfig,
+    QMIX,
+    QMIXConfig,
+    R2D2,
+    R2D2Config,
     SAC,
     SACConfig,
     TD3,
@@ -49,6 +59,7 @@ from ray_tpu.rl.env import (  # noqa: F401
     Env,
     MultiAgentEnv,
     PendulumEnv,
+    StatelessCartPoleEnv,
     VectorEnv,
     make_env,
     register_env,
@@ -68,6 +79,7 @@ from ray_tpu.rl.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,
     ReservoirReplayBuffer,
+    SequenceReplayBuffer,
 )
 from ray_tpu.rl.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rl.sample_batch import SampleBatch  # noqa: F401

@@ -100,7 +100,8 @@ class WorkerSet:
     """Reference: `rllib/evaluation/worker_set.py` — the rollout fleet."""
 
     def __init__(self, config: AlgorithmConfig, policy_apply: Callable,
-                 policy_kind: str = "actor_critic"):
+                 policy_kind: str = "actor_critic", state_size: int = 0,
+                 append_prev_action: bool = False):
         from ray_tpu.rl.rollout_worker import RolloutWorker
 
         self.workers = [
@@ -113,7 +114,9 @@ class WorkerSet:
                 policy_kind=policy_kind,
                 obs_connectors=config.obs_connectors,
                 action_connectors=config.action_connectors,
-                inference_device=config.inference_device)
+                inference_device=config.inference_device,
+                state_size=state_size,
+                append_prev_action=append_prev_action)
             for i in range(max(1, config.num_rollout_workers))
         ]
 

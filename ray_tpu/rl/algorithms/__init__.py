@@ -15,3 +15,12 @@ from ray_tpu.rl.algorithms.apex_dqn import (  # noqa: F401
     ApexDQN,
     ApexDQNConfig,
 )
+from ray_tpu.rl.algorithms.r2d2 import R2D2, R2D2Config  # noqa: F401
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rl.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401
+from ray_tpu.rl.algorithms.es import (  # noqa: F401
+    ARS,
+    ARSConfig,
+    ES,
+    ESConfig,
+)

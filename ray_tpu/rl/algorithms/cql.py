@@ -1,0 +1,252 @@
+"""CQL: conservative Q-learning (offline RL).
+
+Reference: `rllib/algorithms/cql/cql.py` + `cql_torch_policy.py`
+(Kumar et al. 2020) — SAC machinery trained from a fixed dataset, with
+the CQL(H) regularizer added to the critic loss:
+
+    alpha_cql * ( logsumexp_a Q(s, a) - Q(s, a_data) )
+
+where the logsumexp is importance-sampled over uniform actions and
+current-policy actions at s and s' (each corrected by its log-density),
+pushing Q down on out-of-distribution actions so the learned policy
+stays inside the dataset's support. First `bc_iters` actor updates are
+plain behavior cloning (the reference's warm-start), then the actor
+switches to the SAC objective.
+
+Actions in the dataset are the squashed [-1, 1] actions (the convention
+every continuous-control piece of this stack shares); rollout workers
+record exactly that column.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.offline import InputReader, JsonReader
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+)
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(CQL)
+        self.input_ = None
+        self.cql_alpha = 1.0
+        self.num_cql_actions = 10     # sampled actions per logsumexp term
+        self.bc_iters = 200           # actor BC warm-start updates
+        self.tau = 0.005
+        self.initial_alpha = 0.2
+        self.target_entropy = "auto"
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.train_batch_size = 256
+        self.num_sgd_per_iter = 32
+        self.num_rollout_workers = 0
+
+    def offline_data(self, *, input_=None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class CQL(Algorithm):
+    config_cls = CQLConfig
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(np.prod(env.action_space.shape))
+        self._act_dim = act_dim
+        k_pi, k_q = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.params = {
+            "actor": models.gaussian_policy_init(k_pi, obs_dim, act_dim),
+            "critic": models.q_sa_init(k_q, obs_dim, act_dim),
+            "log_alpha": jnp.asarray(np.log(cfg.initial_alpha),
+                                     jnp.float32),
+        }
+        self.target_critic = jax.tree.map(jnp.copy, self.params["critic"])
+        self.tx = {
+            "actor": optax.adam(cfg.actor_lr),
+            "critic": optax.adam(cfg.critic_lr),
+            "alpha": optax.adam(cfg.alpha_lr),
+        }
+        self.opt_state = {
+            "actor": self.tx["actor"].init(self.params["actor"]),
+            "critic": self.tx["critic"].init(self.params["critic"]),
+            "alpha": self.tx["alpha"].init(self.params["log_alpha"]),
+        }
+        inp = cfg.input_
+        reader: InputReader = (inp if isinstance(inp, InputReader)
+                               else JsonReader(inp))
+        # Materialize the dataset once (offline data fits host RAM at
+        # these scales; a streaming reader slots in via InputReader).
+        data = reader.read_all()
+        self._dataset = {
+            OBS: np.asarray(data[OBS], np.float32),
+            ACTIONS: np.asarray(data[ACTIONS], np.float32),
+            REWARDS: np.asarray(data[REWARDS], np.float32),
+            TERMINATEDS: np.asarray(
+                data[TERMINATEDS] if TERMINATEDS in data
+                else data[DONES]).astype(np.float32),
+            NEXT_OBS: np.asarray(data[NEXT_OBS], np.float32),
+        }
+        self._n_rows = len(self._dataset[REWARDS])
+        self._rng = np.random.RandomState(cfg.seed)
+        self._sgd_steps = 0
+        target_entropy = (-float(act_dim)
+                          if cfg.target_entropy == "auto"
+                          else float(cfg.target_entropy))
+        self._update = jax.jit(functools.partial(
+            _cql_update, tx=self.tx, gamma=cfg.gamma, tau=cfg.tau,
+            target_entropy=target_entropy, cql_alpha=cfg.cql_alpha,
+            n_cql=cfg.num_cql_actions), static_argnames=("bc_phase",))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        stats = {}
+        for _ in range(cfg.num_sgd_per_iter):
+            idx = self._rng.randint(0, self._n_rows,
+                                    size=cfg.train_batch_size)
+            mb = {k: jnp.asarray(v[idx]) for k, v in
+                  self._dataset.items()}
+            bc_phase = self._sgd_steps < cfg.bc_iters
+            (self.params, self.target_critic, self.opt_state,
+             stats) = self._update(
+                self.params, self.target_critic, self.opt_state, mb,
+                jax.random.PRNGKey(cfg.seed + self._sgd_steps),
+                bc_phase=bc_phase)
+            self._sgd_steps += 1
+        out = {k: float(v) for k, v in stats.items()}
+        out["sgd_steps_total"] = self._sgd_steps
+        out["dataset_rows"] = self._n_rows
+        return out
+
+    def get_weights(self):
+        return {"params": self.params, "target": self.target_critic}
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights["params"])
+        self.target_critic = jax.tree.map(jnp.asarray, weights["target"])
+
+
+def _cql_update(params, target_critic, opt_state, mb, rng, *, tx, gamma,
+                tau, target_entropy, cql_alpha, n_cql, bc_phase):
+    alpha = jnp.exp(params["log_alpha"])
+    k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+    b = mb[OBS].shape[0]
+    act_dim = mb[ACTIONS].shape[-1]
+
+    # SAC bellman target.
+    mean_n, log_std_n = models.gaussian_policy_apply(
+        params["actor"], mb[NEXT_OBS])
+    a_next, logp_next = models.gaussian_sample(
+        mean_n, log_std_n, jax.random.normal(k1, mean_n.shape))
+    q1_t, q2_t = models.q_sa_apply(target_critic, mb[NEXT_OBS], a_next)
+    q_next = jnp.minimum(q1_t, q2_t) - alpha * logp_next
+    target = mb[REWARDS] + gamma * (1.0 - mb[TERMINATEDS]) * q_next
+    target = jax.lax.stop_gradient(target)
+
+    def critic_loss_fn(critic):
+        q1, q2 = models.q_sa_apply(critic, mb[OBS], mb[ACTIONS])
+        bellman = ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+        # CQL(H): importance-sampled logsumexp over uniform + policy
+        # actions (at s and s'), each corrected by its log density.
+        obs_rep = jnp.repeat(mb[OBS], n_cql, axis=0)
+
+        def q_both(actions_flat):
+            qa1, qa2 = models.q_sa_apply(critic, obs_rep, actions_flat)
+            return qa1.reshape(b, n_cql), qa2.reshape(b, n_cql)
+
+        a_rand = jax.random.uniform(k2, (b * n_cql, act_dim),
+                                    minval=-1.0, maxval=1.0)
+        logd_rand = -act_dim * jnp.log(2.0)  # uniform over [-1,1]^d
+        mean_c, log_std_c = models.gaussian_policy_apply(
+            params["actor"], mb[OBS])
+        a_pi, logp_pi = models.gaussian_sample(
+            jnp.repeat(mean_c, n_cql, 0), jnp.repeat(log_std_c, n_cql, 0),
+            jax.random.normal(k3, (b * n_cql, act_dim)))
+        a_pi_n, logp_pi_n = models.gaussian_sample(
+            jnp.repeat(mean_n, n_cql, 0), jnp.repeat(log_std_n, n_cql, 0),
+            jax.random.normal(k4, (b * n_cql, act_dim)))
+        qr = q_both(a_rand)
+        qp = q_both(jax.lax.stop_gradient(a_pi))
+        qn = q_both(jax.lax.stop_gradient(a_pi_n))
+        lp_pi = jax.lax.stop_gradient(logp_pi).reshape(b, n_cql)
+        lp_pi_n = jax.lax.stop_gradient(logp_pi_n).reshape(b, n_cql)
+        cql_pen = 0.0
+        for i, q_data in enumerate((q1, q2)):
+            cat = jnp.concatenate(
+                [qr[i] - logd_rand, qp[i] - lp_pi, qn[i] - lp_pi_n], 1)
+            lse = jax.scipy.special.logsumexp(cat, axis=1) \
+                - jnp.log(3 * n_cql)
+            cql_pen = cql_pen + (lse - q_data).mean()
+        return bellman + cql_alpha * cql_pen, (bellman, cql_pen)
+
+    (c_loss, (bellman, cql_pen)), c_grads = jax.value_and_grad(
+        critic_loss_fn, has_aux=True)(params["critic"])
+    upd, opt_c = tx["critic"].update(c_grads, opt_state["critic"],
+                                     params["critic"])
+    params = {**params,
+              "critic": optax.apply_updates(params["critic"], upd)}
+
+    # Actor: BC warm-start, then SAC objective on dataset states.
+    def actor_loss_fn(actor):
+        mean, log_std = models.gaussian_policy_apply(actor, mb[OBS])
+        a, logp = models.gaussian_sample(
+            mean, log_std, jax.random.normal(k5, mean.shape))
+        if bc_phase:
+            # log-likelihood of dataset actions under the policy
+            u = jnp.arctanh(jnp.clip(mb[ACTIONS], -0.999, 0.999))
+            std = jnp.exp(log_std)
+            ll = (-0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                          + jnp.log(2 * jnp.pi))).sum(-1)
+            return (alpha * logp - ll).mean(), logp
+        q1, q2 = models.q_sa_apply(params["critic"], mb[OBS], a)
+        return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+    (a_loss, logp), a_grads = jax.value_and_grad(
+        actor_loss_fn, has_aux=True)(params["actor"])
+    upd, opt_a = tx["actor"].update(a_grads, opt_state["actor"],
+                                    params["actor"])
+    params = {**params,
+              "actor": optax.apply_updates(params["actor"], upd)}
+
+    def alpha_loss_fn(log_alpha):
+        return -(jnp.exp(log_alpha)
+                 * jax.lax.stop_gradient(logp + target_entropy)).mean()
+
+    al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(
+        params["log_alpha"])
+    upd, opt_al = tx["alpha"].update(al_grad, opt_state["alpha"],
+                                     params["log_alpha"])
+    params = {**params,
+              "log_alpha": optax.apply_updates(params["log_alpha"], upd)}
+
+    target_critic = jax.tree.map(
+        lambda t, o: (1.0 - tau) * t + tau * o,
+        target_critic, params["critic"])
+    opt_state = {"critic": opt_c, "actor": opt_a, "alpha": opt_al}
+    stats = {"critic_loss": c_loss, "bellman_loss": bellman,
+             "cql_penalty": cql_pen, "actor_loss": a_loss,
+             "alpha": jnp.exp(params["log_alpha"]),
+             "bc_phase": jnp.float32(1.0 if bc_phase else 0.0)}
+    return params, target_critic, opt_state, stats

@@ -1,0 +1,326 @@
+"""QMIX: monotonic value factorization for cooperative multi-agent RL.
+
+Reference: `rllib/algorithms/qmix/qmix.py` + `qmix_policy.py` (Rashid et
+al. 2018) — per-agent utility networks (parameter-shared, agent-id
+one-hot appended to each obs) whose chosen utilities are combined by a
+*monotonic* mixing network: hypernetworks conditioned on the global
+state emit the mixer weights, passed through `abs` so dQ_tot/dQ_i >= 0.
+That keeps the argmax of Q_tot decomposable into per-agent argmaxes
+(the IGM property), so decentralized greedy execution matches the
+centralized training target. Double-Q targets against a periodically
+synced target copy of both nets; replay over joint transitions.
+
+The global state defaults to the concatenation of all agents' obs (the
+reference uses the env-provided state when present; `MultiAgentEnv`
+subclasses can expose `get_state()` to do the same here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(QMIX)
+        self.mixing_embed_dim = 32
+        self.hypernet_hidden = 64
+        self.agent_hidden = (64, 64)
+        self.buffer_size = 20_000
+        self.learning_starts = 256
+        self.train_batch_size = 64
+        self.num_sgd_per_iter = 16
+        self.target_update_freq = 500   # env steps
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 5000
+        self.num_rollout_workers = 1
+        self.rollout_fragment_length = 50
+
+
+def _mixer_init(rng, n_agents: int, state_dim: int, embed: int,
+                hyper_hidden: int):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    return {
+        "hyper_w1": models.mlp_init(k1, (state_dim, hyper_hidden,
+                                         n_agents * embed)),
+        "hyper_b1": models.mlp_init(k2, (state_dim, embed)),
+        "hyper_w2": models.mlp_init(k3, (state_dim, hyper_hidden, embed)),
+        "hyper_v": models.mlp_init(k4, (state_dim, hyper_hidden, 1)),
+    }
+
+
+def _mixer_apply(params, q_agents, state):
+    """q_agents [B, n], state [B, S] -> Q_tot [B]. Monotonic: the
+    state-conditioned weights pass through abs()."""
+    b, n = q_agents.shape
+    w1 = jnp.abs(models.mlp_apply(params["hyper_w1"], state))
+    w1 = w1.reshape(b, n, -1)
+    b1 = models.mlp_apply(params["hyper_b1"], state)
+    hidden = jax.nn.elu(
+        jnp.einsum("bn,bne->be", q_agents, w1) + b1)
+    w2 = jnp.abs(models.mlp_apply(params["hyper_w2"], state))
+    v = models.mlp_apply(params["hyper_v"], state)[:, 0]
+    return (hidden * w2).sum(-1) + v
+
+
+def _agent_q(params, obs_oh):
+    """Shared utility net over [B, n, obs+onehot] -> [B, n, A]."""
+    b, n, d = obs_oh.shape
+    return models.q_net_apply(params, obs_oh.reshape(b * n, d)) \
+        .reshape(b, n, -1)
+
+
+@ray_tpu.remote
+class _QMIXWorker:
+    """Steps one MultiAgentEnv recording JOINT transitions (all agents'
+    obs/actions per step + the global state) — what the mixer trains on,
+    unlike the per-policy batches of MultiAgentRolloutWorker."""
+
+    def __init__(self, env_creator, agent_ids: List[str], *,
+                 env_config=None, fragment: int = 50, seed: int = 0):
+        import jax as _jax
+
+        self.env = env_creator(env_config or {})
+        self.agent_ids = agent_ids
+        self.fragment = fragment
+        self._rng = np.random.RandomState(seed)
+        self._apply = _jax.jit(_agent_q)
+        self.obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self._completed: list = []
+
+    def _joint_obs(self, obs_dict) -> np.ndarray:
+        n = len(self.agent_ids)
+        rows = []
+        for i, aid in enumerate(self.agent_ids):
+            onehot = np.zeros(n, np.float32)
+            onehot[i] = 1.0
+            rows.append(np.concatenate([
+                np.asarray(obs_dict[aid], np.float32).ravel(), onehot]))
+        return np.stack(rows)  # [n, obs+n]
+
+    def _state(self, obs_dict) -> np.ndarray:
+        if hasattr(self.env, "get_state"):
+            return np.asarray(self.env.get_state(), np.float32)
+        return np.concatenate([
+            np.asarray(obs_dict[a], np.float32).ravel()
+            for a in self.agent_ids])
+
+    def sample(self, params, epsilon: float) -> SampleBatch:
+        rows = {"obs": [], "state": [], "actions": [], "rewards": [],
+                "dones": [], "terminateds": [], "next_obs": [],
+                "next_state": []}
+        for _ in range(self.fragment):
+            joint = self._joint_obs(self.obs)
+            state = self._state(self.obs)
+            q = np.asarray(self._apply(params, joint[None]))[0]  # [n, A]
+            acts = q.argmax(-1)
+            explore = self._rng.rand(len(acts)) < epsilon
+            rand = self._rng.randint(0, q.shape[-1], size=len(acts))
+            acts = np.where(explore, rand, acts)
+            action_dict = {aid: int(a)
+                           for aid, a in zip(self.agent_ids, acts)}
+            next_obs, rewards, terms, truncs, _ = self.env.step(
+                action_dict)
+            term = bool(terms.get("__all__", False))
+            done = bool(term or truncs.get("__all__", False))
+            team_r = float(sum(rewards.values()))
+            rows["obs"].append(joint)
+            rows["state"].append(state)
+            rows["actions"].append(acts.astype(np.int32))
+            rows["rewards"].append(team_r)
+            rows["dones"].append(done)
+            rows["terminateds"].append(term)
+            self._episode_reward += team_r
+            self._episode_len += 1
+            if done:
+                self._completed.append(
+                    (self._episode_reward, self._episode_len))
+                self._episode_reward, self._episode_len = 0.0, 0
+                final = next_obs if next_obs else self.obs
+                rows["next_obs"].append(self._joint_obs(final))
+                rows["next_state"].append(self._state(final))
+                self.obs, _ = self.env.reset()
+            else:
+                rows["next_obs"].append(self._joint_obs(next_obs))
+                rows["next_state"].append(self._state(next_obs))
+                self.obs = next_obs
+        return SampleBatch({k: np.asarray(v) for k, v in rows.items()})
+
+    def episode_stats(self, clear: bool = True):
+        stats = list(self._completed)
+        if clear:
+            self._completed = []
+        return stats
+
+
+class QMIX(Algorithm):
+    config_cls = QMIXConfig
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        self.agent_ids = list(env.agent_ids)
+        n = len(self.agent_ids)
+        obs_dim = int(np.prod(env.observation_space.shape)) + n
+        n_actions = env.action_space.n
+        state_dim = (len(np.asarray(env.get_state()).ravel())
+                     if hasattr(env, "get_state")
+                     else (obs_dim - n) * n)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.params = {
+            "agent": models.q_net_init(k1, obs_dim, n_actions,
+                                       tuple(cfg.agent_hidden)),
+            "mixer": _mixer_init(k2, n, state_dim, cfg.mixing_embed_dim,
+                                 cfg.hypernet_hidden),
+        }
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size)
+        self._steps_sampled = 0
+        self._steps_since_target = 0
+        spec = cfg.env_spec
+        creator = spec if callable(spec) and not isinstance(spec, str) \
+            else (lambda c, _s=spec: make_env(_s, c))
+        self.qworkers = [
+            _QMIXWorker.remote(
+                creator, self.agent_ids, env_config=cfg.env_config,
+                fragment=cfg.rollout_fragment_length,
+                seed=cfg.seed + 1000 * (i + 1))
+            for i in range(max(1, cfg.num_rollout_workers))
+        ]
+        self._update = jax.jit(functools.partial(
+            _qmix_update, tx=self.tx, gamma=cfg.gamma,
+            double_q=cfg.double_q))
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        ref_p = ray_tpu.put(self.params["agent"])
+        batches = ray_tpu.get([w.sample.remote(ref_p, eps)
+                               for w in self.qworkers])
+        count = 0
+        for b in batches:
+            self.buffer.add(b)
+            count += b.count
+        self._steps_sampled += count
+        self._steps_since_target += count
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()})
+                losses.append(float(loss))
+        if self._steps_since_target >= cfg.target_update_freq:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._steps_since_target = 0
+        return {
+            "mean_td_loss": float(np.mean(losses)) if losses else None,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "num_env_steps_sampled_this_iter": count,
+        }
+
+    def step(self) -> Dict[str, Any]:
+        metrics = self.training_step()
+        stats = []
+        for s in ray_tpu.get([w.episode_stats.remote()
+                              for w in self.qworkers]):
+            stats.extend(s)
+        for r, _ in stats:
+            self._episode_window.append(r)
+        self._episode_window = self._episode_window[-100:]
+        if self._episode_window:
+            metrics["episode_reward_mean"] = float(
+                np.mean(self._episode_window))
+            metrics["episodes_this_iter"] = len(stats)
+        return metrics
+
+    def compute_joint_action(self, obs_dict) -> Dict[str, int]:
+        """Decentralized greedy execution: per-agent argmax (IGM)."""
+        n = len(self.agent_ids)
+        rows = []
+        for i, aid in enumerate(self.agent_ids):
+            onehot = np.zeros(n, np.float32)
+            onehot[i] = 1.0
+            rows.append(np.concatenate([
+                np.asarray(obs_dict[aid], np.float32).ravel(), onehot]))
+        q = np.asarray(_agent_q(self.params["agent"],
+                                jnp.asarray(np.stack(rows))[None]))[0]
+        return {aid: int(a)
+                for aid, a in zip(self.agent_ids, q.argmax(-1))}
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.tx.init(self.params)
+
+    def cleanup(self):
+        for w in self.qworkers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+def _qmix_update(params, target_params, opt_state, mb, *, tx, gamma,
+                 double_q):
+    def loss_fn(params):
+        q_all = _agent_q(params["agent"], mb["obs"])          # [B, n, A]
+        acts = mb["actions"].astype(jnp.int32)
+        q_taken = jnp.take_along_axis(
+            q_all, acts[..., None], -1)[..., 0]               # [B, n]
+        q_tot = _mixer_apply(params["mixer"], q_taken, mb["state"])
+
+        q_next_tg = _agent_q(target_params["agent"], mb["next_obs"])
+        if double_q:
+            q_next_on = _agent_q(params["agent"], mb["next_obs"])
+            next_a = q_next_on.argmax(-1)
+            q_next = jnp.take_along_axis(
+                q_next_tg, next_a[..., None], -1)[..., 0]
+        else:
+            q_next = q_next_tg.max(-1)
+        q_tot_next = _mixer_apply(target_params["mixer"], q_next,
+                                  mb["next_state"])
+        # Mask the bootstrap on true termination ONLY — time-limit
+        # truncations still bootstrap through next_state (the repo-wide
+        # TERMINATEDS convention; see sample_batch.py).
+        not_term = 1.0 - mb["terminateds"].astype(jnp.float32)
+        target = mb["rewards"] + gamma * not_term * q_tot_next
+        td = q_tot - jax.lax.stop_gradient(target)
+        return (td ** 2).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss

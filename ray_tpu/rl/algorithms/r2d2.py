@@ -1,0 +1,307 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Reference: `rllib/algorithms/r2d2/r2d2.py` (Kapturowski et al. 2019) —
+a GRU Q-network over partially-observable streams, sequence replay with
+the *stored-state* strategy plus a burn-in prefix to refresh stale
+hidden states, double-Q targets, optional value rescaling
+h(x) = sign(x)(sqrt(|x|+1)-1) + eps*x, and per-sequence priorities
+p = eta*max|td| + (1-eta)*mean|td|.
+
+TPU shape: the whole update (burn-in unrolls + training-segment unroll +
+one batched next-step eval) is a single jit program; the time dimension
+is a `lax.scan`, so XLA sees three fused matmuls per step and no Python
+in the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffer import SequenceReplayBuffer
+from ray_tpu.rl.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+)
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(R2D2)
+        self.hidden_size = 64
+        self.encoder = (64,)
+        self.burn_in = 8
+        self.seq_len = 16
+        self.buffer_sequences = 2048
+        self.learning_starts = 32        # sequences
+        self.train_batch_size = 16       # sequences per SGD step
+        self.num_sgd_per_iter = 8
+        self.target_update_freq = 1000   # env steps
+        self.double_q = True
+        self.n_step = 3                  # n-step targets (paper: 5)
+        # Feed [one-hot(prev action), prev reward] to the GRU alongside
+        # the obs (paper §2.3) — the action history is what lets the net
+        # deduce latent state (velocities etc.) in POMDPs.
+        self.append_prev_action = True
+        self.use_h_transform = False     # value rescaling (Atari-scale)
+        self.priority_eta = 0.9
+        self.grad_clip = 10.0
+        self.huber_delta = 1.0           # Huber TD loss (stability)
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 8000
+        self.rollout_fragment_length = 64
+
+
+class R2D2(Algorithm):
+    config_cls = R2D2Config
+
+    def build_components(self):
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        n_actions = env.action_space.n
+        self._n_actions = n_actions
+        if cfg.append_prev_action:
+            obs_dim += n_actions + 1
+        self.params = models.recurrent_q_init(
+            jax.random.PRNGKey(cfg.seed), obs_dim, n_actions,
+            hidden=cfg.hidden_size, encoder=tuple(cfg.encoder))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = SequenceReplayBuffer(
+            cfg.buffer_sequences, seq_len=cfg.seq_len,
+            burn_in=cfg.burn_in, seed=cfg.seed)
+        self._steps_sampled = 0
+        self._steps_since_target = 0
+
+        # Behaviour policy: epsilon-greedy over the recurrent Q head,
+        # expressed as mixture logits so the worker's categorical
+        # sampling implements the exploration (same trick as DQN).
+        def behaviour(params_and_eps, obs, h):
+            params, eps = params_and_eps
+            q, h_next = models.recurrent_q_step(params, obs, h)
+            n = q.shape[-1]
+            probs = (1.0 - eps) * jax.nn.softmax(q * 50.0) + eps / n
+            return jnp.log(probs + 1e-9), h_next
+
+        self.workers = WorkerSet(cfg, behaviour, policy_kind="recurrent",
+                                 state_size=cfg.hidden_size,
+                                 append_prev_action=cfg.append_prev_action)
+        self._update = jax.jit(functools.partial(
+            _r2d2_update, tx=self.tx, gamma=cfg.gamma,
+            burn_in=cfg.burn_in, double_q=cfg.double_q,
+            use_h=cfg.use_h_transform, eta=cfg.priority_eta,
+            huber_delta=cfg.huber_delta, n_step=cfg.n_step))
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+        batches = self.workers.sample((self.params, jnp.float32(eps)))
+        count = 0
+        for b in batches:
+            self.buffer.add(b)
+            count += int(np.asarray(b[REWARDS]).size)
+        self._steps_sampled += count
+        self._steps_since_target += count
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_sgd_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                jb = {k: jnp.asarray(v) for k, v in mb.items()
+                      if k != "batch_indexes"}
+                self.params, self.opt_state, loss, prio = self._update(
+                    self.params, self.target_params, self.opt_state, jb)
+                losses.append(float(loss))
+                self.buffer.update_priorities(mb["batch_indexes"],
+                                              np.asarray(prio))
+        if self._steps_since_target >= cfg.target_update_freq:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._steps_since_target = 0
+        return {
+            "mean_td_loss": float(np.mean(losses)) if losses else None,
+            "epsilon": eps,
+            "buffer_sequences": len(self.buffer),
+            "num_env_steps_sampled_this_iter": count,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.tx.init(self.params)
+
+    def compute_single_action(self, obs, explore: bool = False,
+                              prev_reward: float = 0.0):
+        """Greedy recurrent action; maintains hidden state AND the
+        prev-action/reward augmentation across calls (call
+        `reset_eval_state()` at episode start)."""
+        cfg = self.algo_config
+        if not hasattr(self, "_eval_hidden") or self._eval_hidden is None:
+            self._eval_hidden = jnp.zeros((1, cfg.hidden_size),
+                                          jnp.float32)
+            self._eval_prev = np.zeros(self._n_actions + 1, np.float32)
+        obs_np = np.asarray(obs, np.float32).ravel()
+        if cfg.append_prev_action:
+            self._eval_prev[-1] = prev_reward
+            obs_np = np.concatenate([obs_np, self._eval_prev])
+        q, self._eval_hidden = models.recurrent_q_step(
+            self.params, jnp.asarray(obs_np)[None], self._eval_hidden)
+        a = int(jnp.argmax(q, -1)[0])
+        if cfg.append_prev_action:
+            self._eval_prev[:] = 0.0
+            self._eval_prev[a] = 1.0
+        return a
+
+    def reset_eval_state(self):
+        self._eval_hidden = None
+
+    def evaluate(self, num_episodes: int = 5,
+                 max_steps_per_episode: int = 1000) -> Dict[str, Any]:
+        cfg = self.algo_config
+        env = make_env(cfg.env_spec, cfg.env_config)
+        rewards, lengths = [], []
+        for ep in range(num_episodes):
+            self.reset_eval_state()
+            obs, _ = env.reset(seed=cfg.seed + 10_000 + ep)
+            total, steps = 0.0, 0
+            r = 0.0
+            for _ in range(max_steps_per_episode):
+                obs, r, term, trunc, _ = env.step(
+                    self.compute_single_action(obs, prev_reward=r))
+                total += r
+                steps += 1
+                if term or trunc:
+                    break
+            rewards.append(total)
+            lengths.append(steps)
+        env.close()
+        return {"evaluation": {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_reward_min": float(np.min(rewards)),
+            "episode_reward_max": float(np.max(rewards)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes": num_episodes,
+        }}
+
+
+def _h_transform(x, eps=1e-3):
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def _h_inverse(x, eps=1e-3):
+    # Closed-form inverse of the R2D2 value rescaling.
+    return jnp.sign(x) * (
+        ((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps))
+          - 1.0) / (2.0 * eps)) ** 2 - 1.0)
+
+
+def _unroll(params, obs_seq, dones, h0):
+    """GRU-Q unroll with per-step PRE-reset hiddens (see
+    models.recurrent_q_unroll — the single scan implementation)."""
+    return models.recurrent_q_unroll(params, obs_seq, h0, dones=dones,
+                                     return_hiddens=True)
+
+
+def _r2d2_update(params, target_params, opt_state, mb, *, tx, gamma,
+                 burn_in, double_q, use_h, eta, huber_delta, n_step):
+    obs, dones = mb[OBS], mb[DONES].astype(jnp.float32)
+    h0 = mb["state0"]
+
+    # Burn-in: refresh stale stored state under both nets, no gradients.
+    if burn_in > 0:
+        ob_b, d_b = obs[:, :burn_in], dones[:, :burn_in]
+        _, _, h_on = _unroll(params, ob_b, d_b, h0)
+        _, _, h_tg = _unroll(target_params, ob_b, d_b, h0)
+        h_on = jax.lax.stop_gradient(h_on)
+        h_tg = jax.lax.stop_gradient(h_tg)
+    else:
+        h_on = h_tg = h0
+    sl = slice(burn_in, None)
+    ob_t, d_t = obs[:, sl], dones[:, sl]
+    acts = mb[ACTIONS][:, sl]
+    rews = mb[REWARDS][:, sl]
+    terms = mb[TERMINATEDS][:, sl].astype(jnp.float32)
+    next_ob = mb[NEXT_OBS][:, sl]
+    w_seq = mb["weights"][:, None]
+    b, t = acts.shape
+
+    def loss_fn(params):
+        q_seq, h_on_seq, _ = _unroll(params, ob_t, d_t, h_on)
+        q_taken = jnp.take_along_axis(q_seq, acts[..., None], -1)[..., 0]
+
+        # One batched next-step eval: Q(next_obs_t, h_after_t) under the
+        # target net (and online net for double-Q action selection).
+        # h_after_t is the PRE-reset hidden (truncated episodes still
+        # bootstrap through the true successor obs).
+        _, h_tg_seq, _ = _unroll(target_params, ob_t, d_t, h_tg)
+        flat_next = next_ob.reshape(b * t, -1)
+        q_next_tg, _ = models.recurrent_q_step(
+            target_params, flat_next, h_tg_seq.reshape(b * t, -1))
+        if double_q:
+            q_next_on, _ = models.recurrent_q_step(
+                params, flat_next, h_on_seq.reshape(b * t, -1))
+            next_a = q_next_on.argmax(-1)
+            q_next = jnp.take_along_axis(
+                q_next_tg, next_a[:, None], -1)[:, 0]
+        else:
+            q_next = q_next_tg.max(-1)
+        q_next = q_next.reshape(b, t)
+        if use_h:
+            q_next = _h_inverse(q_next)
+        # n-step targets composed along the sequence (uncorrected
+        # off-policy n-step, as in the paper): G^1 is the 1-step target;
+        # each pass deepens by one step, stopping at episode boundaries
+        # and falling back to G^1 at the sequence tail.
+        tgt1 = rews + gamma * (1.0 - terms) * q_next
+        target = tgt1
+        done_mask = d_t > 0.5
+        for _ in range(max(0, n_step - 1)):
+            shifted = jnp.concatenate(
+                [target[:, 1:], target[:, -1:]], axis=1)
+            deeper = rews + gamma * shifted
+            # Sequence tail has no successor: keep the previous-depth
+            # target there (truncated n-step), never self-bootstrap.
+            deeper = deeper.at[:, -1].set(target[:, -1])
+            target = jnp.where(done_mask, tgt1, deeper)
+        if use_h:
+            target = _h_transform(target)
+        td = q_taken - jax.lax.stop_gradient(target)
+        # Huber: quadratic near zero, linear past delta — keeps one
+        # high-TD sequence from dominating the gradient.
+        abs_td = jnp.abs(td)
+        huber = jnp.where(abs_td <= huber_delta, 0.5 * td ** 2,
+                          huber_delta * (abs_td - 0.5 * huber_delta))
+        loss = (w_seq * huber).mean()
+        return loss, td
+
+    (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    abs_td = jnp.abs(td)
+    prio = eta * abs_td.max(-1) + (1.0 - eta) * abs_td.mean(-1)
+    return params, opt_state, loss, prio

@@ -111,6 +111,69 @@ class CartPoleEnv(Env):
         return self._state.copy(), 1.0, terminated, truncated, {}
 
 
+class StatelessCartPoleEnv(CartPoleEnv):
+    """CartPole with the velocity components MASKED from the observation
+    (obs = [x, theta] only) — the classic partially-observable recurrent
+    benchmark (reference: `rllib/examples/env/stateless_cartpole.py`).
+    A memoryless policy cannot estimate velocities; a recurrent one
+    (R2D2) can, so this env separates the two."""
+
+    def __init__(self, max_steps: int = 200):
+        super().__init__(max_steps)
+        high = np.array([self.x_threshold * 2,
+                         self.theta_threshold * 2], np.float32)
+        self.observation_space = Box(-high, high)
+
+    def _mask(self, obs):
+        return obs[[0, 2]]
+
+    def reset(self, *, seed: Optional[int] = None):
+        obs, info = super().reset(seed=seed)
+        return self._mask(obs), info
+
+    def step(self, action):
+        obs, r, term, trunc, info = super().step(action)
+        return self._mask(obs), r, term, trunc, info
+
+
+class MemoryCueEnv(Env):
+    """T-maze-style memory task (the classic recurrent-policy probe,
+    reference: `rllib/examples/env/` memory envs + the R2D2 paper's
+    motivation). A binary cue is visible ONLY at t=0; the episode pays
+    +1 iff the action taken at the LAST step matches the cue. A
+    memoryless policy can do no better than 0.5 in expectation; a
+    recurrent policy that carries the cue through its hidden state
+    scores 1.0. Obs = [cue0, cue1, progress]."""
+
+    def __init__(self, length: int = 8):
+        self.length = length
+        self.observation_space = Box(0.0, 1.0, shape=(3,))
+        self.action_space = Discrete(2)
+        self._rng = np.random.RandomState()
+        self._cue = 0
+        self._t = 0
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        if self._t == 0:
+            o[self._cue] = 1.0
+        o[2] = self._t / self.length
+        return o
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._cue = int(self._rng.randint(2))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        last = self._t >= self.length - 1
+        reward = float(int(action) == self._cue) if last else 0.0
+        self._t += 1
+        return self._obs(), reward, last, False, {}
+
+
 class PendulumEnv(Env):
     """Classic control Pendulum-v1 dynamics (standard constants) — the
     continuous-action test/bench workload (reference: gym pendulum, used
@@ -233,6 +296,8 @@ class GymEnvAdapter(Env):  # pragma: no cover - needs gym installed
 
 _ENV_REGISTRY: Dict[str, Callable[..., Env]] = {
     "CartPole-v1": CartPoleEnv,
+    "StatelessCartPole-v0": StatelessCartPoleEnv,
+    "MemoryCue-v0": MemoryCueEnv,
     "Pendulum-v1": PendulumEnv,
     "CatchPixels-v0": CatchPixelsEnv,
 }

@@ -123,6 +123,89 @@ def cnn_actor_critic_apply(params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return logits, value
 
 
+# -- recurrent torsos (R2D2-family) ----------------------------------------
+# Reference: `rllib/models/torch/recurrent_net.py` + the R2D2 stack
+# (`rllib/algorithms/r2d2/`). A GRU cell scanned over time: the whole
+# sequence unroll is one `lax.scan`, so the learner update over [B, T]
+# sequences stays a single XLA program (TPU-friendly: the scan body is
+# three fused matmuls, no per-step dispatch).
+
+
+def gru_init(rng, in_dim: int, hidden: int, dtype=jnp.float32):
+    k1, k2 = jax.random.split(rng)
+    scale_x = np.sqrt(1.0 / in_dim)
+    scale_h = np.sqrt(1.0 / hidden)
+    return {
+        "wx": jax.random.normal(k1, (in_dim, 3 * hidden), dtype) * scale_x,
+        "wh": jax.random.normal(k2, (hidden, 3 * hidden), dtype) * scale_h,
+        "b": jnp.zeros(3 * hidden, dtype),
+    }
+
+
+def gru_cell(params, h, x):
+    """One GRU step: x [B, in], h [B, H] -> h' [B, H]."""
+    hid = h.shape[-1]
+    gx = x @ params["wx"] + params["b"]
+    gh = h @ params["wh"]
+    rz_x, n_x = gx[..., :2 * hid], gx[..., 2 * hid:]
+    rz_h, n_h = gh[..., :2 * hid], gh[..., 2 * hid:]
+    rz = jax.nn.sigmoid(rz_x + rz_h)
+    r, z = rz[..., :hid], rz[..., hid:]
+    n = jnp.tanh(n_x + r * n_h)
+    return (1.0 - z) * n + z * h
+
+
+def recurrent_q_init(rng, obs_dim: int, n_actions: int,
+                     hidden: int = 64, encoder=(64,)):
+    """Dense encoder -> GRU -> dueling-free Q head."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "enc": mlp_init(k1, (obs_dim, *encoder)),
+        "gru": gru_init(k2, encoder[-1], hidden),
+        "q": mlp_init(k3, (hidden, n_actions)),
+    }
+
+
+def recurrent_q_step(params, obs, h):
+    """One rollout step: obs [B, obs_dim], h [B, H] -> (q [B, A], h')."""
+    x = mlp_apply(params["enc"], obs, activate_last=True)
+    h = gru_cell(params["gru"], h, x)
+    return mlp_apply(params["q"], h), h
+
+
+def recurrent_q_unroll(params, obs_seq, h0, dones=None,
+                       return_hiddens=False):
+    """Unroll over time: obs_seq [B, T, obs_dim], h0 [B, H] ->
+    (q_seq [B, T, A], h_T). If `dones` [B, T] is given, the CARRIED
+    hidden state resets to zero after any done step (episode boundaries
+    inside a stored sequence never leak state across episodes). With
+    `return_hiddens`, also returns the PRE-reset hidden after each step
+    [B, T, H] — what R2D2's bootstrap needs: truncated episodes still
+    evaluate Q(next_obs, h) with the un-reset state."""
+    def scan_fn(h, inp):
+        if dones is None:
+            obs_t, done_t = inp, None
+        else:
+            obs_t, done_t = inp
+        q, h_next = recurrent_q_step(params, obs_t, h)
+        carry = h_next
+        if done_t is not None:
+            carry = h_next * (1.0 - done_t.astype(h_next.dtype))[:, None]
+        return carry, (q, h_next)
+
+    obs_tm = jnp.swapaxes(obs_seq, 0, 1)  # [T, B, obs]
+    xs = obs_tm if dones is None else (obs_tm, jnp.swapaxes(dones, 0, 1))
+    h_final, (q_tm, h_tm) = jax.lax.scan(scan_fn, h0, xs)
+    q_seq = jnp.swapaxes(q_tm, 0, 1)
+    if return_hiddens:
+        return q_seq, jnp.swapaxes(h_tm, 0, 1), h_final
+    return q_seq, h_final
+
+
+def recurrent_hidden_size(params) -> int:
+    return params["gru"]["wh"].shape[0]
+
+
 # -- continuous control (SAC-style) ----------------------------------------
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0

@@ -42,6 +42,18 @@ class ReplayBuffer:
         return SampleBatch({k: v[idx] for k, v in self._storage.items()})
 
 
+def _proportional_sample(priorities, size, n, alpha, beta, rng):
+    """Shared PER sampling core: proportional draw over
+    priorities[:size]**alpha + max-normalized IS weights (reference
+    `rllib/utils/replay_buffers/prioritized_replay_buffer.py`)."""
+    prios = priorities[:size] ** alpha
+    probs = prios / prios.sum()
+    idx = rng.choice(size, size=n, p=probs)
+    weights = (size * probs[idx]) ** (-beta)
+    weights /= weights.max()
+    return idx, weights.astype(np.float32)
+
+
 class PrioritizedReplayBuffer(ReplayBuffer):
     """Proportional prioritization (sum-tree-free O(n) variant — fine for
     host-side buffers at these sizes)."""
@@ -61,13 +73,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self._priorities[idx] = self._max_prio
 
     def sample(self, n: int) -> SampleBatch:
-        prios = self._priorities[: self._size] ** self.alpha
-        probs = prios / prios.sum()
-        idx = self._rng.choice(self._size, size=n, p=probs)
-        weights = (self._size * probs[idx]) ** (-self.beta)
-        weights /= weights.max()
+        idx, weights = _proportional_sample(
+            self._priorities, self._size, n, self.alpha, self.beta,
+            self._rng)
         out = SampleBatch({k: v[idx] for k, v in self._storage.items()})
-        out["weights"] = weights.astype(np.float32)
+        out["weights"] = weights
         out["batch_indexes"] = idx
         return out
 
@@ -100,6 +110,85 @@ class ReservoirReplayBuffer(ReplayBuffer):
                 for k, v in arrays.items():
                     self._storage[k][j] = v[i]
         self._seen += n
+
+
+class SequenceReplayBuffer:
+    """Replay of fixed-length [L, ...] subsequences with stored initial
+    recurrent state — the R2D2 "stored state" strategy (reference:
+    `rllib/algorithms/r2d2/` + `rllib/utils/replay_buffers/
+    multi_agent_replay_buffer.py` sequence support).
+
+    `add` takes [N, T, ...] rollout fragments carrying a "state_in"
+    column ([N, T, H]); each env row is chopped into windows of
+    `burn_in + seq_len` steps (stride `seq_len`, trailing remainder
+    dropped) and the hidden state at the window start is stored as the
+    sequence's initial state. Sampling is proportional-prioritized with
+    the R2D2 mix p = eta*max|td| + (1-eta)*mean|td| supplied by the
+    learner via `update_priorities`."""
+
+    def __init__(self, capacity: int = 4096, seq_len: int = 32,
+                 burn_in: int = 8, seed: int = 0, alpha: float = 0.6,
+                 beta: float = 0.4):
+        self.capacity = capacity
+        self.L = burn_in + seq_len
+        self.burn_in = burn_in
+        self.seq_len = seq_len
+        self.alpha = alpha
+        self.beta = beta
+        self._storage: Optional[dict] = None
+        self._state0: Optional[np.ndarray] = None
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.RandomState(seed)
+        self._priorities = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def __len__(self):
+        return self._size
+
+    def add(self, batch: SampleBatch):
+        from ray_tpu.rl.sample_batch import STATE_IN
+
+        arrays = {k: np.asarray(v) for k, v in batch.items()}
+        state_in = arrays.pop(STATE_IN)
+        n, t = state_in.shape[:2]
+        if t < self.L:
+            raise ValueError(
+                f"rollout fragments are {t} steps but sequences need "
+                f"burn_in + seq_len = {self.L}; raise "
+                "rollout_fragment_length or shrink the sequence window")
+        if self._storage is None:
+            self._storage = {
+                k: np.zeros((self.capacity, self.L, *v.shape[2:]),
+                            v.dtype)
+                for k, v in arrays.items()
+            }
+            self._state0 = np.zeros((self.capacity, state_in.shape[-1]),
+                                    np.float32)
+        for row in range(n):
+            for start in range(0, t - self.L + 1, self.seq_len):
+                i = self._idx
+                for k, v in arrays.items():
+                    self._storage[k][i] = v[row, start:start + self.L]
+                self._state0[i] = state_in[row, start]
+                self._priorities[i] = self._max_prio
+                self._idx = (self._idx + 1) % self.capacity
+                self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, n: int) -> dict:
+        idx, weights = _proportional_sample(
+            self._priorities, self._size, n, self.alpha, self.beta,
+            self._rng)
+        out = {k: v[idx] for k, v in self._storage.items()}
+        out["state0"] = self._state0[idx]
+        out["weights"] = weights
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx, priorities):
+        priorities = np.abs(np.asarray(priorities)) + 1e-6
+        self._priorities[idx] = priorities
+        self._max_prio = max(self._max_prio, float(priorities.max()))
 
 
 def flatten_fragments(batches) -> SampleBatch:

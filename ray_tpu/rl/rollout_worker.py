@@ -21,6 +21,7 @@ from ray_tpu.rl.sample_batch import (
     NEXT_OBS,
     OBS,
     REWARDS,
+    STATE_IN,
     SampleBatch,
     TERMINATEDS,
     VALUES,
@@ -34,7 +35,9 @@ class RolloutWorker:
                  rollout_fragment_length: int = 200, seed: int = 0,
                  policy_kind: str = "actor_critic",
                  obs_connectors=None, action_connectors=None,
-                 inference_device: str = "cpu"):
+                 inference_device: str = "cpu",
+                 state_size: int = 0,
+                 append_prev_action: bool = False):
         import jax
 
         self.vec = VectorEnv(env_spec, num_envs, env_config)
@@ -74,6 +77,21 @@ class RolloutWorker:
         self._episode_rewards = np.zeros(num_envs, np.float64)
         self._episode_lens = np.zeros(num_envs, np.int64)
         self._completed: list = []
+        # Recurrent policies (kind="recurrent") carry a hidden state per
+        # env across sample() calls; zeroed on episode boundaries
+        # (reference: RLlib's view-requirement state columns).
+        self._hidden: Optional[np.ndarray] = (
+            np.zeros((num_envs, state_size), np.float32)
+            if policy_kind == "recurrent" else None)
+        # R2D2-style input augmentation: append [one-hot(prev action),
+        # prev reward] to the observation the recurrent policy (and the
+        # recorded OBS/NEXT_OBS columns) sees. Gives the GRU the action
+        # history it needs to deduce latent state (e.g. velocities) in
+        # partially-observable envs (Kapturowski et al. 2019 §2.3).
+        self._prev: Optional[np.ndarray] = None
+        if append_prev_action:
+            n_act = self.vec.action_space.n
+            self._prev = np.zeros((num_envs, n_act + 1), np.float32)
 
     def sample(self, weights) -> SampleBatch:
         """Collect one fragment of `fragment` steps × num_envs."""
@@ -90,8 +108,19 @@ class RolloutWorker:
         rows: Dict[str, list] = {OBS: [], ACTIONS: [], REWARDS: [],
                                  DONES: [], TERMINATEDS: [], NEXT_OBS: [],
                                  LOGPS: [], VALUES: []}
+        if self.kind == "recurrent":
+            rows[STATE_IN] = []
         for _ in range(self.fragment):
-            out = self.apply(weights, self.obs)
+            if self.kind == "recurrent":
+                obs_in = (self.obs if self._prev is None else
+                          np.concatenate([self.obs, self._prev], -1)
+                          .astype(np.float32))
+                rows[STATE_IN].append(self._hidden.copy())
+                out, h_next = self.apply(weights, obs_in, self._hidden)
+                self._hidden = np.array(h_next, np.float32)  # writable copy
+            else:
+                obs_in = self.obs
+                out = self.apply(weights, self.obs)
             if self.kind == "gaussian":
                 # Continuous control: tanh-squashed diagonal Gaussian.
                 # ACTIONS stores the squashed action in [-1, 1]; the
@@ -125,6 +154,8 @@ class RolloutWorker:
                 env_actions = self.action_connectors(env_actions)
             next_obs, rewards, terms, truncs = self.vec.step(env_actions)
             dones = np.logical_or(terms, truncs)
+            if self._hidden is not None and dones.any():
+                self._hidden[dones] = 0.0
             if dones.any():
                 # NEXT_OBS must be the true successor (pre-auto-reset) so
                 # off-policy targets bootstrap truncated episodes right;
@@ -135,7 +166,21 @@ class RolloutWorker:
                 next_obs = self._connect_obs(next_obs)
             else:
                 next_obs = true_next = self._connect_obs(next_obs)
-            rows[OBS].append(self.obs.copy())
+            if self._prev is not None:
+                # The successor frame's "previous action/reward" is this
+                # step's — record NEXT_OBS augmented the same way the
+                # policy will see it, then roll the memory (zeroed at
+                # episode starts: a fresh episode has no history).
+                next_prev = np.zeros_like(self._prev)
+                next_prev[np.arange(len(actions)), actions] = 1.0
+                next_prev[:, -1] = rewards
+                true_next = np.concatenate(
+                    [true_next, next_prev], -1).astype(np.float32)
+                self._prev = next_prev.copy()
+                self._prev[dones] = 0.0
+            rows[OBS].append(np.array(obs_in, np.float32)
+                             if self._prev is not None
+                             else self.obs.copy())
             rows[ACTIONS].append(actions)
             rows[REWARDS].append(rewards)
             rows[DONES].append(dones)

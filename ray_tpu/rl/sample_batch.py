@@ -18,6 +18,7 @@ TERMINATEDS = "terminateds"  # env-terminal only (bootstrap mask)
 NEXT_OBS = "next_obs"
 LOGPS = "action_logp"
 VALUES = "values"
+STATE_IN = "state_in"      # recurrent hidden state entering each step
 ADVANTAGES = "advantages"
 TARGETS = "value_targets"
 
