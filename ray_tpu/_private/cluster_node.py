@@ -89,6 +89,7 @@ class NodeRuntime:
         self._install_report_hook()
         self._install_borrow_hooks()
         self._install_cluster_actor_routing()
+        self._install_cluster_kv()
         self._install_fetch_on_get()
         self._install_cluster_named_actors()
 
@@ -452,6 +453,24 @@ class NodeRuntime:
 
         worker.get_objects = get_objects
         worker.wait = wait
+
+    def _install_cluster_kv(self):
+        """Internal KV is a CLUSTER-wide table living on the head
+        (reference: gcs_kv_manager.h behind the GCS client); node-local
+        kv_put/get/del/keys delegate there so components running on any
+        node (e.g. the serve controller's checkpoints) read and write
+        the same — durable, when configured — store."""
+        gcs = self.worker.gcs
+        head = self.head
+        gcs.kv_put = lambda key, value, overwrite=True, namespace=None: \
+            head.call("gcs_kv_put", key=key, value=value,
+                      overwrite=overwrite, namespace=namespace)
+        gcs.kv_get = lambda key, namespace=None: \
+            head.call("gcs_kv_get", key=key, namespace=namespace)
+        gcs.kv_del = lambda key, namespace=None: \
+            head.call("gcs_kv_del", key=key, namespace=namespace)
+        gcs.kv_keys = lambda prefix, namespace=None: \
+            head.call("gcs_kv_keys", prefix=prefix, namespace=namespace)
 
     def _install_cluster_named_actors(self):
         """Named actors are a CLUSTER-wide registry (reference:

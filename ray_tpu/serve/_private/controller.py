@@ -6,6 +6,15 @@ count, version, config) and a reconcile loop that starts/stops replica
 actors to match, performs rolling updates on version change, health-checks
 replicas, and drives autoscaling from router-reported queue metrics.
 Membership changes broadcast to routers via the long-poll host.
+
+Fault tolerance (reference `serve/_private/storage/kv_store.py:1` +
+controller recovery in `serve/controller.py:70` ff.): every target-state
+mutation checkpoints {deployments, routes, replica names} to the GCS
+internal KV (durable when the head runs with gcs_storage_path). Replicas
+are NAMED detached actors, so a restarted controller re-attaches the
+live ones instead of cold-starting the fleet; dead ones are replaced by
+the normal reconcile loop. While the controller is down, routers keep
+answering from their last long-poll snapshot.
 """
 
 from __future__ import annotations
@@ -14,6 +23,7 @@ import hashlib
 import threading
 import time
 import traceback
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -21,6 +31,8 @@ from ray_tpu.serve._private.long_poll import LongPollHost
 from ray_tpu.serve._private.replica import ServeReplica
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+_CKPT_NS = b"__serve__"
+_CKPT_KEY = b"controller_state"
 
 
 def _version_hash(payload) -> str:
@@ -40,6 +52,7 @@ class _DeploymentState:
         self.version = info["version"]
         self.replicas: List[Any] = []
         self.replica_versions: Dict[Any, str] = {}
+        self.replica_names: Dict[Any, str] = {}  # handle -> actor name
         self.status = "UPDATING"
         self.message = ""
 
@@ -48,6 +61,10 @@ class _DeploymentState:
 class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
+        # Serializes checkpoint snapshot+write so concurrent mutators
+        # cannot commit out of order (a stale snapshot overwriting a
+        # newer one would lose deployments across a crash).
+        self._ckpt_lock = threading.Lock()
         self._deployments: Dict[str, _DeploymentState] = {}
         self._long_poll = LongPollHost()
         self._metrics: Dict[str, Dict[str, float]] = {}
@@ -56,9 +73,96 @@ class ServeController:
         # control->data-plane LongPollHost route updates).
         self._routes: Dict[str, str] = {}
         self._shutdown = threading.Event()
+        self._recover()
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             daemon=True)
         self._reconciler.start()
+
+    # -- checkpoint / recovery (reference serve kv_store.py) -------------
+
+    def _kv(self):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().gcs
+
+    def _checkpoint(self):
+        import cloudpickle
+
+        with self._ckpt_lock:
+            if self._shutdown.is_set():
+                return  # never re-create the key after a wipe
+            with self._lock:
+                state = {
+                    "routes": dict(self._routes),
+                    "deployments": {
+                        name: {
+                            "info": st.info,
+                            "replicas": [
+                                (st.replica_names.get(r),
+                                 st.replica_versions.get(r))
+                                for r in st.replicas
+                                if st.replica_names.get(r)
+                            ],
+                        }
+                        for name, st in self._deployments.items()
+                    },
+                }
+            try:
+                self._kv().kv_put(_CKPT_KEY, cloudpickle.dumps(state),
+                                  namespace=_CKPT_NS)
+            except Exception:
+                traceback.print_exc()
+
+    def _recover(self):
+        import cloudpickle
+
+        try:
+            blob = self._kv().kv_get(_CKPT_KEY, namespace=_CKPT_NS)
+        except Exception:
+            blob = None
+        if not blob:
+            return
+        try:
+            state = cloudpickle.loads(blob)
+        except Exception:
+            traceback.print_exc()
+            return
+        self._routes = dict(state.get("routes") or {})
+        recovered_replicas = 0
+        for name, d in (state.get("deployments") or {}).items():
+            st = _DeploymentState(name, d["info"])
+            # Re-attach live named replicas; dead/missing ones are
+            # replaced by the first reconcile pass. An unreachable one
+            # is best-effort KILLED, never silently skipped — skipping
+            # would strand a detached actor (and its resources) forever.
+            for rname, version in d.get("replicas") or []:
+                h = None
+                try:
+                    h = ray_tpu.get_actor(rname)
+                    ray_tpu.get(h.check_health.remote(), timeout=10.0)
+                except Exception:
+                    if h is not None:
+                        try:
+                            ray_tpu.kill(h)
+                        except Exception:
+                            pass
+                    continue
+                st.replicas.append(h)
+                st.replica_versions[h] = version
+                st.replica_names[h] = rname
+                recovered_replicas += 1
+            st.status = "UPDATING"
+            self._deployments[name] = st
+        for st in self._deployments.values():
+            self._broadcast(st.name, st.replicas)
+        self._long_poll.notify_changed("routes", dict(self._routes))
+        if self._deployments:
+            from ray_tpu._private.events import record_event
+
+            record_event(
+                "serve", "controller recovered "
+                f"{len(self._deployments)} deployment(s), "
+                f"{recovered_replicas} live replica(s) from checkpoint")
 
     # -- routes (consumed by HTTPProxyActor fleet) -----------------------
 
@@ -67,6 +171,7 @@ class ServeController:
             self._routes[prefix.rstrip("/") or "/"] = deployment_name
             snapshot = dict(self._routes)
         self._long_poll.notify_changed("routes", snapshot)
+        self._checkpoint()
         return True
 
     def remove_route(self, prefix: str) -> bool:
@@ -74,6 +179,7 @@ class ServeController:
             self._routes.pop(prefix.rstrip("/") or "/", None)
             snapshot = dict(self._routes)
         self._long_poll.notify_changed("routes", snapshot)
+        self._checkpoint()
         return True
 
     def remove_routes_of(self, deployment_name: str) -> bool:
@@ -84,6 +190,7 @@ class ServeController:
                 del self._routes[prefix]
             snapshot = dict(self._routes)
         self._long_poll.notify_changed("routes", snapshot)
+        self._checkpoint()
         return True
 
     def get_routes(self) -> Dict[str, str]:
@@ -110,6 +217,7 @@ class ServeController:
         record_event("serve", f"deployment {name} deployed "
                      f"(version {info['version'][:8]})",
                      deployment=name)
+        self._checkpoint()
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -123,6 +231,7 @@ class ServeController:
 
             record_event("serve", f"deployment {name} deleted",
                          deployment=name)
+        self._checkpoint()
         return True
 
     def get_deployment_info(self, name: str) -> Optional[dict]:
@@ -151,12 +260,23 @@ class ServeController:
 
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
+        # Let the in-flight reconcile pass finish before tearing down:
+        # it could otherwise start a replica after we've iterated
+        # st.replicas (a detached-actor leak) or re-write the
+        # checkpoint after the wipe below.
+        self._reconciler.join(timeout=10.0)
         with self._lock:
             states = list(self._deployments.values())
             self._deployments.clear()
+            self._routes.clear()
         for st in states:
             for r in st.replicas:
                 self._stop_replica(r)
+        with self._ckpt_lock:  # flush any in-flight checkpoint write
+            try:
+                self._kv().kv_del(_CKPT_KEY, namespace=_CKPT_NS)
+            except Exception:
+                pass
         return True
 
     # -- reconcile -------------------------------------------------------
@@ -184,6 +304,7 @@ class ServeController:
                 victim = outdated[0]
                 st.replicas.remove(victim)
                 st.replica_versions.pop(victim, None)
+                st.replica_names.pop(victim, None)
                 self._stop_replica(victim)
                 changed = True
             while len(st.replicas) < target:
@@ -196,6 +317,7 @@ class ServeController:
             while len(st.replicas) > target:
                 victim = st.replicas.pop()
                 st.replica_versions.pop(victim, None)
+                st.replica_names.pop(victim, None)
                 self._stop_replica(victim)
                 changed = True
             if changed or st.status == "UPDATING":
@@ -204,6 +326,8 @@ class ServeController:
                 if len(st.replicas) == target and up_to_date:
                     st.status = "HEALTHY"
                 self._broadcast(st.name, st.replicas)
+            if changed:
+                self._checkpoint()
 
     def _autoscale(self, st: _DeploymentState):
         cfg = st.info.get("autoscaling_config")
@@ -255,10 +379,17 @@ class ServeController:
                 opts["num_cpus"] = res["num_cpus"]
             if "num_tpus" in res:
                 opts["num_tpus"] = res["num_tpus"]
-            return ServeReplica.options(**opts).remote(
+            # Named + detached so a recovered controller can re-attach
+            # live replicas instead of cold-starting the fleet.
+            rname = f"SERVE_REPLICA::{st.name}::{uuid.uuid4().hex[:8]}"
+            opts["name"] = rname
+            opts["lifetime"] = "detached"
+            r = ServeReplica.options(**opts).remote(
                 st.name, info["cls"], info.get("init_args"),
                 info.get("init_kwargs"), info.get("user_config"),
                 st.version)
+            st.replica_names[r] = rname
+            return r
         except Exception:
             st.message = traceback.format_exc()
             return None
@@ -280,8 +411,24 @@ def get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         try:
+            # max_restarts=-1: a crashed controller restarts in place,
+            # re-runs __init__, and recovers from the KV checkpoint —
+            # the reference's controller FT loop (serve/controller.py:70).
             return ServeController.options(
                 name=CONTROLLER_NAME, lifetime="detached",
-                max_concurrency=64, num_cpus=0).remote()
+                max_concurrency=64, num_cpus=0,
+                max_restarts=-1).remote()
         except ValueError:
             return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def resolve_live_controller(ping_timeout: float = 2.0):
+    """The ONE controller-replacement probe the data plane shares
+    (routers, proxies, long-poll clients): resolve the well-known name
+    and prove liveness with a cheap ping. Returns a handle or None."""
+    try:
+        handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(handle.get_routes.remote(), timeout=ping_timeout)
+        return handle
+    except Exception:
+        return None

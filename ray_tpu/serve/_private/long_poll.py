@@ -39,17 +39,48 @@ class LongPollHost:
 
 
 class LongPollClient:
-    """Driver/router-side: background thread keeping a local copy fresh."""
+    """Driver/router-side: background thread keeping a local copy fresh.
 
-    def __init__(self, controller, key: str, callback):
+    When the controller dies and a `reresolve` callable is provided, the
+    client polls it until a REPLACEMENT controller registers under the
+    well-known name, then resumes listening from version -1 (the
+    recovered controller re-broadcasts its checkpointed state) — the
+    reference's client-side controller-recovery path. Without
+    `reresolve` a dead controller permanently orphans the client (the
+    serve.shutdown case)."""
+
+    _RERESOLVE_WINDOW_S = 60.0
+
+    def __init__(self, controller, key: str, callback, reresolve=None):
         self._controller = controller
         self._key = key
         self._callback = callback
+        self._reresolve = reresolve
         self._version = -1
         self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"longpoll-{key}")
         self._thread.start()
+
+    def _try_reresolve(self) -> bool:
+        """Poll for a LIVE controller (the reresolver pings before
+        returning a handle — a replacement, or the same actor restarted
+        in place via max_restarts); True to resume listening from
+        scratch."""
+        import time
+
+        deadline = time.monotonic() + self._RERESOLVE_WINDOW_S
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            try:
+                new = self._reresolve()
+            except Exception:
+                new = None
+            if new is not None:
+                self._controller = new
+                self._version = -1
+                return True
+            self._stopped.wait(0.5)
+        return False
 
     def _loop(self):
         import ray_tpu
@@ -61,9 +92,12 @@ class LongPollClient:
                     self._controller.listen.remote(self._key, self._version),
                     timeout=60)
             except (ActorDiedError, ActorError):
-                # Controller is gone (serve.shutdown / crash): this
-                # client is permanently orphaned — exit instead of
+                # Controller is gone. With a reresolver, wait for its
+                # replacement (serve keeps answering from the last
+                # snapshot meanwhile); otherwise exit instead of
                 # spinning error objects forever.
+                if self._reresolve is not None and self._try_reresolve():
+                    continue
                 return
             except Exception:
                 if self._stopped.is_set():

@@ -55,8 +55,21 @@ class HTTPProxyActor:
                 if snapshot is not None:
                     self._sync(snapshot)
             except Exception:
-                if not self._stop.is_set():
-                    self._stop.wait(0.5)
+                if self._stop.is_set():
+                    return
+                # Controller may have crashed: watch for a live
+                # (replacement or restarted) controller and re-sync
+                # from scratch; the last-known routes keep serving
+                # meanwhile.
+                from ray_tpu.serve._private.controller import (
+                    resolve_live_controller,
+                )
+
+                new = resolve_live_controller()
+                if new is not None:
+                    self._controller = new
+                    version = -1
+                self._stop.wait(0.5)
 
     def address(self):
         return (self._proxy.host, self._proxy.port)

@@ -29,7 +29,7 @@ class Router:
         self._lock = threading.Condition()
         self._client = LongPollClient(
             controller, f"replicas::{deployment_name}",
-            self._update_replicas)
+            self._update_replicas, reresolve=self._reresolve_controller)
         self._last_report = 0.0
         self._waiting = 0  # callers blocked on a free replica slot
         # Periodic reporter: long-running requests dispatch once and then
@@ -42,6 +42,19 @@ class Router:
             target=self._report_loop, daemon=True,
             name=f"router-metrics-{deployment_name}")
         self._reporter.start()
+
+    def _reresolve_controller(self):
+        """Find a live (replacement or restarted) controller after a
+        crash; also swaps the metrics-report target so autoscaling
+        signals resume."""
+        from ray_tpu.serve._private.controller import (
+            resolve_live_controller,
+        )
+
+        handle = resolve_live_controller()
+        if handle is not None:
+            self._controller = handle
+        return handle
 
     def _update_replicas(self, replicas):
         with self._lock:
