@@ -234,6 +234,121 @@ def cluster_actors_and_tasks(n_actors=500, n_tasks=20_000, nodes=2):
         cluster.shutdown()
 
 
+def cluster_remote_tasks(n_tasks=3000, nodes=2):
+    """The HONEST cross-process path: 1-CPU tasks that can never run on
+    the 1-CPU head, so every one rides lease-pipelined dispatch to a
+    node subprocess and its result crosses back. (The milli-cpu
+    dimension above mostly executes head-locally.)"""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        for _ in range(nodes):
+            cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote(num_cpus=1)
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get(sq.remote(3), timeout=60) == 9  # warm export
+        t0 = time.perf_counter()
+        refs = [sq.remote(i) for i in range(n_tasks)]
+        t_submit = time.perf_counter() - t0
+        got = ray_tpu.get(refs, timeout=600)
+        t_drain = time.perf_counter() - t0
+        assert got == [i * i for i in range(n_tasks)]
+        return {
+            "nodes": nodes,
+            "tasks": n_tasks,
+            "remote_submit_per_s": round(n_tasks / t_submit, 1),
+            "remote_end_to_end_per_s": round(n_tasks / t_drain, 1),
+        }
+    finally:
+        cluster.shutdown()
+
+
+def cluster_scale_chaos(nodes=4, n_actors=200, n_tasks=8000):
+    """≥4 real node processes under combined load (actors + task fan-out
+    + a broadcast + PGs) with a chaos kill MID-DRAIN: one node dies
+    while its share of the fan-out is queued; everything still
+    completes through resubmission."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import ray_config
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    old_period = ray_config.health_check_period_s
+    ray_config.health_check_period_s = 0.3
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        node_ids = [cluster.add_node(num_cpus=4) for _ in range(nodes)]
+
+        @ray_tpu.remote(num_cpus=0.05)
+        class A:
+            def ping(self):
+                return 1
+
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(n_actors)]
+        assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                               timeout=600)) == n_actors
+        t_actors = time.perf_counter() - t0
+
+        # Broadcast: one 64 MB object read by a task on every node.
+        blob = ray_tpu.put(np.zeros(8 * 1024 * 1024, np.float64))
+
+        @ray_tpu.remote(num_cpus=1)
+        def touch(b):
+            return int(b.nbytes)
+
+        t0 = time.perf_counter()
+        sizes = ray_tpu.get([touch.remote(blob) for _ in range(nodes)],
+                            timeout=300)
+        t_bcast = time.perf_counter() - t0
+        assert all(s == 64 * 1024 * 1024 for s in sizes)
+
+        # 200 actors hold 10 of the 17 CPUs; 4 one-CPU bundles fit the
+        # remainder alongside the broadcast tasks.
+        pgs = [placement_group([{"CPU": 1}], strategy="PACK")
+               for _ in range(4)]
+        for pg in pgs:
+            assert pg.wait(timeout=60), "PG reservation stalled"
+        for pg in pgs:
+            remove_placement_group(pg)
+
+        @ray_tpu.remote(num_cpus=1, max_retries=5)
+        def work(i):
+            time.sleep(0.001)
+            return i
+
+        t0 = time.perf_counter()
+        refs = [work.remote(i) for i in range(n_tasks)]
+        # chaos: kill a node while the fan-out drains
+        time.sleep(0.5)
+        cluster.kill_node(node_ids[-1])
+        got = ray_tpu.get(refs, timeout=900)
+        t_drain = time.perf_counter() - t0
+        # Tasks killed mid-run resubmit; every result must be right.
+        assert got == list(range(n_tasks))
+        return {
+            "nodes": nodes,
+            "actors": n_actors,
+            "actor_create_call_per_s": round(n_actors / t_actors, 1),
+            "broadcast_mb_per_s": round(64 * nodes / t_bcast, 1),
+            "placement_groups": 4,
+            "tasks": n_tasks,
+            "chaos": "node killed 0.5s into drain",
+            "task_end_to_end_per_s": round(n_tasks / t_drain, 1),
+        }
+    finally:
+        ray_config.health_check_period_s = old_period
+        cluster.shutdown()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
@@ -260,6 +375,8 @@ def main():
     # these bring up their own multi-node clusters
     section("broadcast", lambda: broadcast(args.broadcast_mb), out)
     section("cluster_actors_and_tasks", cluster_actors_and_tasks, out)
+    section("cluster_remote_tasks", cluster_remote_tasks, out)
+    section("cluster_scale_chaos", cluster_scale_chaos, out)
 
     print(json.dumps(out, indent=2))
     if args.out:

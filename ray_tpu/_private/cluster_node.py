@@ -34,6 +34,9 @@ class NodeRuntime:
         # Scheduling labels (e.g. {"ici_slice": "slice-0"} marking which
         # contiguous TPU slice this host belongs to).
         self.labels = dict(labels or {})
+        # Objects whose location this node has advertised — replayed to
+        # a RESTARTED head (whose location map starts empty).
+        self._reported_oids: set = set()
 
         # Bring up a standard in-process runtime for this node.
         worker_mod.shutdown()
@@ -150,6 +153,38 @@ class NodeRuntime:
         worker = self.worker
         orig = worker.store_task_outputs
         node = self
+        # Output reports BATCH across tasks (reference: raylet object
+        # report batching): at fan-out rates a synchronous head RPC per
+        # task serializes every executor thread behind the report
+        # connection. A dedicated reporter flushes accumulated oids
+        # every couple of ms — results become cluster-visible one batch
+        # later, execution never blocks on the head.
+        import queue as _q
+
+        report_q: "_q.SimpleQueue" = _q.SimpleQueue()
+
+        def report_loop():
+            while True:
+                oids = [report_q.get()]
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 0.002:
+                    try:
+                        oids.append(report_q.get_nowait())
+                    except _q.Empty:
+                        time.sleep(0.0005)
+                # Borrow registrations first: the output report unpins
+                # these tasks' args at the head, so any borrow they
+                # created must be on record before that (same head
+                # connection → ordered).
+                getattr(node, "_flush_borrows", lambda: None)()
+                try:
+                    node.head.call("report_objects", oids=oids,
+                                   address=node.address)
+                except Exception:
+                    pass
+
+        threading.Thread(target=report_loop, daemon=True,
+                         name="output-reporter").start()
 
         def store_and_report(spec, values, error=None):
             orig(spec, values, error=error)
@@ -161,19 +196,12 @@ class NodeRuntime:
             dynamic = list(getattr(spec, "dynamic_return_ids", ()))
             for roid in list(spec.return_ids) + dynamic:
                 worker.memory_store.pin_object(roid)
-            # Borrow registrations first: the output report unpins this
-            # task's args at the head, so any borrow the task created
-            # must be on record before that (same head connection →
-            # ordered).
-            getattr(node, "_flush_borrows", lambda: None)()
             oids = [oid.binary()
                     for oid in list(spec.return_ids) + dynamic]
             if oids:
-                try:
-                    node.head.call("report_objects", oids=oids,
-                                   address=node.address)
-                except Exception:
-                    pass
+                node._reported_oids.update(oids)
+                for oid in oids:
+                    report_q.put(oid)
 
         worker.store_task_outputs = store_and_report
 
@@ -541,6 +569,7 @@ class NodeRuntime:
         fans the release out to owners — reference: FreeObjects RPC,
         `object_manager.proto:61`)."""
         object_ids = [ObjectID(o) for o in oids]
+        self._reported_oids.difference_update(oids)
         self.worker.memory_store.evict(object_ids)
         plane = getattr(self.worker, "shm_plane", None)
         if plane is not None:
@@ -633,23 +662,55 @@ class NodeRuntime:
             try:
                 from ray_tpu._private.node_stats import sample_node_stats
 
+                # Backlog rides the report (reference: raylet backlog
+                # reports in lease requests): queued-not-running task
+                # count, so lease grants see queue depth, not just the
+                # resource view.
+                backlog = self.worker.backend.backlog_count()
                 ok = self.head.call("report_resources",
                                     node_id=self.node_id,
                                     available=view, labels=self.labels,
-                                    stats=sample_node_stats())
+                                    stats=sample_node_stats(),
+                                    backlog=backlog)
                 last_sent = view
                 last_time = time.monotonic()
                 if ok is False:
-                    # Head lost us (restart?): re-register.
-                    plane = getattr(self.worker, "shm_plane", None)
-                    self.head.call(
-                        "register_node", node_id=self.node_id,
-                        address=self.address,
-                        resources=dict(
-                            self.worker.backend.resources.total),
-                        transfer=self.transfer_addr,
-                        shm_name=plane.name if plane else None,
-                        labels=self.labels)
+                    # Head lost us (restart?): re-register and
+                    # re-publish our state.
+                    self._reregister()
+            except Exception:
+                pass
+
+    def _reregister(self):
+        """Re-join a restarted head (reference:
+        `node_manager.proto:356` RayletNotifyGCSRestart → raylets
+        re-publish). Registration alone rebuilds only the node table;
+        the head's actor directory and object-location map started
+        empty, so re-report every hosted actor (restoring routing AND
+        restart bookkeeping via record_lineage) and every object this
+        node still owns."""
+        plane = getattr(self.worker, "shm_plane", None)
+        self.head.call(
+            "register_node", node_id=self.node_id,
+            address=self.address,
+            resources=dict(self.worker.backend.resources.total),
+            transfer=self.transfer_addr,
+            shm_name=plane.name if plane else None,
+            labels=self.labels)
+        for actor in list(getattr(self.worker.backend, "_actors",
+                                  {}).values()):
+            try:
+                if actor.state != "DEAD":
+                    self.head.call("report_actor", spec=actor.spec,
+                                   node_id=self.node_id)
+            except Exception:
+                pass
+        oids = [oid for oid in self._reported_oids
+                if self.worker.memory_store.contains(ObjectID(oid))]
+        if oids:
+            try:
+                self.head.call("report_objects", oids=oids,
+                               address=self.address)
             except Exception:
                 pass
 

@@ -189,6 +189,15 @@ class LocalBackend:
         self._dep_counts: dict[bytes, int] = {}  # task_id binary -> remaining deps
         self._ready: "queue.Queue[TaskSpec]" = queue.Queue()
         self._waiting_for_resources: list[TaskSpec] = []
+        # Incremental queued-demand accounting (reference: raylet
+        # backlog). Scanning the ready queue per submission made the
+        # local-fit check O(queue) -> O(n^2) over a fan-out burst.
+        self._pending_milli: dict = {}
+        self._pending_count = 0
+        # Grow-on-demand executor pool for normal tasks (see _launch).
+        self._exec_q: "queue.Queue" = queue.Queue()
+        self._exec_idle = 0
+        self._exec_lock = threading.Lock()
         self._actors: dict[ActorID, _Actor] = {}
         self._cancelled: set[bytes] = set()
         self._lock = threading.Lock()
@@ -251,6 +260,7 @@ class LocalBackend:
             for d in unresolved:
                 self.worker.memory_store.on_ready(d, self._on_dep_ready)
         else:
+            self._pending_add(spec)
             self._ready.put(spec)
 
     def _on_dep_ready(self, object_id: ObjectID) -> None:
@@ -263,6 +273,7 @@ class LocalBackend:
                     del self._dep_counts[key]
                     now_ready.append(spec)
         for spec in now_ready:
+            self._pending_add(spec)
             self._ready.put(spec)
 
     def _submit_actor_task(self, spec: TaskSpec) -> None:
@@ -318,7 +329,15 @@ class LocalBackend:
     def _dispatch_loop(self):
         while not self._shutdown.is_set():
             try:
-                spec = self._ready.get(timeout=0.1)
+                if self._waiting_for_resources:
+                    # Parked tasks exist: never block on the intake
+                    # queue — resource releases (wait_for_change below)
+                    # are the wake signal, and sleeping 0.1s here gated
+                    # deep-queue drain to slots/0.1s regardless of how
+                    # fast tasks actually finish.
+                    spec = self._ready.get_nowait()
+                else:
+                    spec = self._ready.get(timeout=0.1)
             except queue.Empty:
                 spec = None
             with self._lock:
@@ -329,6 +348,7 @@ class LocalBackend:
             still_waiting = []
             for s in candidates:
                 if s.task_id.binary() in self._cancelled:
+                    self._pending_remove(s)
                     self.worker.store_task_outputs(
                         s, None, error=exc.TaskCancelledError(s.describe())
                     )
@@ -337,6 +357,7 @@ class LocalBackend:
                     pool = self._resource_pool_for(s)
                     request = to_milli(s.resources)
                 except Exception as e:  # malformed spec must not kill dispatch
+                    self._pending_remove(s)
                     self.worker.store_task_outputs(
                         s, None,
                         error=e if isinstance(e, exc.RayTpuError)
@@ -344,6 +365,7 @@ class LocalBackend:
                     )
                     continue
                 if not pool.can_fit_total(request):
+                    self._pending_remove(s)
                     self.worker.store_task_outputs(
                         s, None, error=exc.RayTpuError(
                             f"task {s.describe()} requests {s.resources} which can "
@@ -352,6 +374,7 @@ class LocalBackend:
                     )
                     continue
                 if pool.try_acquire(request):
+                    self._pending_remove(s)
                     self._launch(s, pool, request)
                 else:
                     still_waiting.append(s)
@@ -372,11 +395,35 @@ class LocalBackend:
             actor._held_request = request
             actor.start()
         else:
-            t = threading.Thread(
-                target=self._execute_normal_task, args=(spec, pool, request),
-                name=f"worker-{spec.task_id.hex()[:8]}", daemon=True,
-            )
-            t.start()
+            # Reusable executor pool (reference: the worker pool keeps
+            # warm workers; here threads): a thread PER task made thread
+            # creation the single biggest per-task cost at fan-out
+            # rates. Grows on demand (a task blocking in get() holds its
+            # thread, idle==0 spawns another), shrinks on idle timeout.
+            with self._exec_lock:
+                self._exec_q.put((spec, pool, request))
+                if self._exec_idle == 0:
+                    threading.Thread(target=self._exec_loop,
+                                     name="task-exec", daemon=True
+                                     ).start()
+                else:
+                    self._exec_idle -= 1
+
+    def _exec_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                item = self._exec_q.get(timeout=10.0)
+            except queue.Empty:
+                with self._exec_lock:
+                    if not self._exec_q.empty():
+                        continue  # a promised item landed: serve it
+                    if self._exec_idle > 0:
+                        self._exec_idle -= 1  # surplus: retire
+                        return
+                continue
+            self._execute_normal_task(*item)
+            with self._exec_lock:
+                self._exec_idle += 1
 
     # ------------------------------------------------------------------
     # Execution
@@ -543,6 +590,7 @@ class LocalBackend:
             self._actors[actor.actor_id] = replacement
             for item in drained:
                 replacement.mailbox.put(item)
+            self._pending_add(spec)
             self._ready.put(spec)
             return
         for item in drained:
@@ -601,6 +649,7 @@ class LocalBackend:
             self._actors[actor_id] = replacement
             for item in drained:
                 replacement.mailbox.put(item)
+            self._pending_add(spec)
             self._ready.put(spec)
             return
         for item in drained:
@@ -610,21 +659,37 @@ class LocalBackend:
             )
         self._on_actor_death(actor, exc.ActorDiedError(actor_id.hex()[:8], "killed"))
 
+    def _pending_add(self, spec) -> None:
+        from ray_tpu._private.resources import to_milli as _to_milli
+
+        with self._lock:
+            self._pending_count += 1
+            for k, v in _to_milli(spec.resources).items():
+                self._pending_milli[k] = self._pending_milli.get(k, 0) + v
+
+    def _pending_remove(self, spec) -> None:
+        from ray_tpu._private.resources import to_milli as _to_milli
+
+        with self._lock:
+            self._pending_count = max(0, self._pending_count - 1)
+            for k, v in _to_milli(spec.resources).items():
+                left = self._pending_milli.get(k, 0) - v
+                if left > 0:
+                    self._pending_milli[k] = left
+                else:
+                    self._pending_milli.pop(k, None)
+
     def pending_demand_milli(self) -> Dict[str, int]:
         """Resource demand of tasks queued but not yet dispatched — the
         backlog signal the cluster scheduler and autoscaler consume
-        (reference: raylet backlog reporting in lease requests)."""
-        from ray_tpu._private.resources import to_milli as _to_milli
-
-        demand: Dict[str, int] = {}
-        with self._ready.mutex:
-            queued = list(self._ready.queue)
+        (reference: raylet backlog reporting in lease requests).
+        Maintained incrementally: O(1) per read."""
         with self._lock:
-            queued += list(self._waiting_for_resources)
-        for s in queued:
-            for k, v in _to_milli(s.resources).items():
-                demand[k] = demand.get(k, 0) + v
-        return demand
+            return dict(self._pending_milli)
+
+    def backlog_count(self) -> int:
+        with self._lock:
+            return self._pending_count
 
     def actor_state(self, actor_id: ActorID) -> str:
         actor = self._actors.get(actor_id)

@@ -114,6 +114,8 @@ class RpcServer:
                  host: str = "127.0.0.1", port: int = 0,
                  dedupe_methods: Optional[frozenset] = None):
         server_self = self
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -133,6 +135,8 @@ class RpcServer:
                         self.request.settimeout(None)
                     except (OSError, ValueError):  # SSLError is OSError
                         return
+                with server_self._conns_lock:
+                    server_self._conns.add(self.request)
                 while True:
                     try:
                         msg = recv_msg(self.request)
@@ -143,7 +147,8 @@ class RpcServer:
                     rid = msg.id or None
                     if msg.method not in server_self.dedupe_methods:
                         rid = None
-                    reply = server_self._await_reply(rid) if rid else None
+                    reply = server_self._await_reply(
+                        rid, getattr(msg, "ack", -2)) if rid else None
                     if reply is None:
                         t0 = time.perf_counter()
                         try:
@@ -167,6 +172,8 @@ class RpcServer:
                         return
 
             def finish(self):
+                with server_self._conns_lock:
+                    server_self._conns.discard(self.request)
                 if tls_ctx is not None:
                     # self.request is the SSL-wrapped socket (or the raw
                     # one if the handshake failed); closing it sends
@@ -237,7 +244,7 @@ class RpcServer:
         prefix, _, seq = rid.rpartition(":")
         return prefix, int(seq)
 
-    def _await_reply(self, rid: str):
+    def _await_reply(self, rid: str, ack: int = -2):
         """Cached reply for rid, waiting out an in-flight execution."""
         prefix, seq = self._split_rid(rid)
         with self._replies_lock:
@@ -246,9 +253,14 @@ class RpcServer:
                 cached = per_client.get(seq)
                 if cached is not None:
                     return cached
-                # Seeing seq means the client received every reply < seq
-                # (it serializes call+retry under one lock) — drop them.
-                for old in [s for s in per_client if s < seq]:
+                # Purge replies the client has CONSUMED. A serialized
+                # client (one call in flight, ack absent) implicitly
+                # acks seq-1; a pipelined client has many outstanding,
+                # so it declares its consumed watermark explicitly —
+                # purging on "saw seq N" would evict replies still on
+                # the wire and break resubmit dedupe.
+                consumed_below = seq if ack == -2 else ack + 1
+                for old in [s for s in per_client if s < consumed_below]:
                     del per_client[old]
             event = self._inflight.get(rid)
             if event is None:
@@ -284,6 +296,21 @@ class RpcServer:
     def shutdown(self):
         self._server.shutdown()
         self._server.server_close()
+        # Close established connections too — a dead server process
+        # would; leaving them open strands clients in 30s recv timeouts
+        # instead of the fast reconnect a restarted peer needs.
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class RpcClient:
@@ -328,6 +355,33 @@ class RpcClient:
             self._sock = sock
         return self._sock
 
+    def call_with_rid(self, rid: str, method: str, **kwargs) -> Any:
+        """Issue a request under a CALLER-chosen request id — the
+        resubmit path for pipelined sends: the node's dedupe cache keys
+        on the id, so a retry of an un-acked pipelined request cannot
+        execute twice."""
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    sock = self._ensure()
+                    send_msg(sock, wire.Request(id=rid, method=method,
+                                                kwargs=kwargs))
+                    reply = recv_msg(sock)
+                    break
+                except (ConnectionError, OSError):
+                    self.close_locked()
+                    if attempt:
+                        raise
+        if not isinstance(reply, wire.Reply):
+            raise RemoteCallError(
+                f"{method} on {self.address}: malformed reply "
+                f"{type(reply).__name__}")
+        if not reply.ok:
+            raise RemoteCallError(
+                f"{method} failed on {self.address}: {reply.error}\n"
+                + (reply.traceback or ""))
+        return reply.result
+
     def call(self, method: str, **kwargs) -> Any:
         with self._lock:
             self._seq += 1
@@ -368,3 +422,143 @@ class RpcClient:
 
 class RemoteCallError(RuntimeError):
     pass
+
+
+class PipelinedClient:
+    """Streaming request channel: callers enqueue requests WITHOUT
+    waiting for replies; a reader thread drains them in order and hands
+    failures to a callback. This is the lease-pipelining transport
+    (reference: `direct_task_transport.h:75` — once a worker lease is
+    held, tasks stream to it without per-task round trips; errors come
+    back asynchronously).
+
+    One instance per (submitter, target) pair, own socket — never the
+    pooled request/reply connection. TCP ordering gives reply->request
+    matching by sequence.
+    """
+
+    def __init__(self, address: Tuple[str, int],
+                 on_error: Optional[
+                     Callable[[Any, str, str, bool], None]] = None):
+        """on_error(tag, message, rid, connection_lost) fires from the
+        reader thread for failure replies and for requests left un-acked
+        when the connection drops."""
+        self.address = tuple(address)
+        self._on_error = on_error
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._pending: "OrderedDict[int, Any]" = OrderedDict()
+        self._pending_lock = threading.Lock()
+        self._seq = 0
+        self._acked = -1  # highest seq whose reply we have consumed
+        self._id_prefix = uuid.uuid4().hex[:12]
+        self._closed = threading.Event()
+        self._reader: Optional[threading.Thread] = None
+        self._drained = threading.Condition(self._pending_lock)
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection(self.address, timeout=30)
+            ctx = _tls_context(server=False)
+            if ctx is not None:
+                sock = ctx.wrap_socket(sock)
+            self._sock = sock
+            self._reader = threading.Thread(
+                target=self._drain, args=(sock,), daemon=True,
+                name=f"rpc-pipeline-{self.address[1]}")
+            self._reader.start()
+        return self._sock
+
+    def send(self, method: str, tag: Any = None, **kwargs) -> str:
+        """Enqueue one request; returns its request id (dedupe key for
+        any resubmit). `tag` is handed to on_error if the server replies
+        with a failure or the connection dies with this request
+        un-acked. Raises only on immediate transport failure — the
+        caller treats that like any node-unreachable send."""
+        with self._send_lock:
+            if self._closed.is_set():
+                raise ConnectionError("pipelined client closed")
+            sock = self._ensure()
+            self._seq += 1
+            rid = f"{self._id_prefix}:{self._seq}"
+            with self._pending_lock:
+                self._pending[self._seq] = (rid, tag)
+            try:
+                send_msg(sock, wire.Request(id=rid, method=method,
+                                            kwargs=kwargs,
+                                            ack=self._acked))
+            except (ConnectionError, OSError):
+                with self._pending_lock:
+                    self._pending.pop(self._seq, None)
+                self._teardown()
+                raise
+            return rid
+
+    def _drain(self, sock: socket.socket) -> None:
+        while not self._closed.is_set():
+            try:
+                reply = recv_msg(sock)
+            except (ConnectionError, OSError):
+                break
+            with self._pending_lock:
+                if not self._pending:
+                    continue
+                seq, (rid, tag) = self._pending.popitem(last=False)
+                self._acked = seq
+                self._drained.notify_all()
+            if isinstance(reply, wire.Reply) and not reply.ok and \
+                    self._on_error is not None:
+                try:
+                    self._on_error(tag, reply.error or "request failed",
+                                   rid, False)
+                except Exception:
+                    pass
+        # Connection gone: tear the socket down so the next send()
+        # reconnects with a fresh reader instead of black-holing into a
+        # half-closed fd, then surface everything still unacknowledged.
+        # (Only if the live socket is still OURS — a send() may already
+        # have reconnected and started a new reader.)
+        with self._send_lock:
+            if self._sock is sock:
+                self._teardown()
+        with self._pending_lock:
+            orphans = list(self._pending.values())
+            self._pending.clear()
+            self._drained.notify_all()
+        if self._on_error is not None:
+            for rid, tag in orphans:
+                try:
+                    self._on_error(tag, "connection lost before ack",
+                                   rid, True)
+                except Exception:
+                    pass
+
+    @property
+    def in_flight(self) -> int:
+        with self._pending_lock:
+            return len(self._pending)
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every sent request has been acknowledged."""
+        deadline = time.monotonic() + timeout
+        with self._pending_lock:
+            while self._pending:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drained.wait(remaining)
+        return True
+
+    def _teardown(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._reader = None
+
+    def close(self):
+        self._closed.set()
+        with self._send_lock:
+            self._teardown()

@@ -63,6 +63,14 @@ class SharedPlane:
                   timeout: float = 2.0) -> bool:
         """Serialize ``value`` into the segment if its payload crosses the
         threshold. Returns True iff the object is now readable from shm."""
+        # Cheap pre-screen: obviously-small values skip the pickle-to-
+        # measure step entirely (pickling every int/str task result just
+        # to learn it's under the threshold dominated small-task runs).
+        if value is None or isinstance(value, (bool, int, float)):
+            return False
+        if isinstance(value, (str, bytes, bytearray)) and \
+                len(value) < self.threshold:
+            return False
         oid = object_id.binary()
         if self.store.contains(oid):
             return True

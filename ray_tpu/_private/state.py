@@ -33,8 +33,30 @@ class GlobalState:
         for key, value in self._store.get_all("kv"):
             ns, _, k = key.partition(b"\x00")
             self._kv[(ns, k)] = value
+        # Named-actor and placement-group tables are durable too
+        # (reference: GcsActorManager / GcsPlacementGroupManager persist
+        # through gcs_table_storage) — a restarted head recovers both.
+        import cloudpickle
+
+        for key, value in self._store.get_all("named_actors"):
+            ns, _, name = key.partition(b"\x00")
+            try:
+                self._named_actors[(ns.decode(), name.decode())] = \
+                    cloudpickle.loads(value)
+            except Exception:
+                pass
+        for key, value in self._store.get_all("pgs"):
+            try:
+                pg = _pg_from_blob(value)
+                self._placement_groups[pg.id] = pg
+            except Exception:
+                pass
 
     # -- named actors ----------------------------------------------------
+
+    @staticmethod
+    def _named_store_key(key: tuple) -> bytes:
+        return key[0].encode() + b"\x00" + key[1].encode()
 
     def register_named_actor(self, name: str, namespace: Optional[str],
                              handle) -> None:
@@ -45,6 +67,14 @@ class GlobalState:
                     f"Actor name {name!r} already taken in namespace {key[0]!r}"
                 )
             self._named_actors[key] = handle
+            try:
+                import cloudpickle
+
+                self._store.put("named_actors",
+                                self._named_store_key(key),
+                                cloudpickle.dumps(handle))
+            except Exception:
+                pass  # unpicklable handle: stays memory-only
 
     def get_named_actor(self, name: str, namespace: Optional[str]):
         key = (namespace or self._worker.namespace, name)
@@ -70,6 +100,8 @@ class GlobalState:
             for key, handle in list(self._named_actors.items()):
                 if handle._actor_id == actor_id:
                     del self._named_actors[key]
+                    self._store.delete("named_actors",
+                                       self._named_store_key(key))
 
     # -- internal KV (reference: gcs_kv_manager.h) -----------------------
 
@@ -103,10 +135,15 @@ class GlobalState:
     def register_placement_group(self, pg) -> None:
         with self._lock:
             self._placement_groups[pg.id] = pg
+            try:
+                self._store.put("pgs", pg.id.binary(), _pg_to_blob(pg))
+            except Exception:
+                pass
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         with self._lock:
             self._placement_groups.pop(pg_id, None)
+            self._store.delete("pgs", pg_id.binary())
 
     def placement_group_table(self) -> dict:
         with self._lock:
@@ -137,3 +174,39 @@ class GlobalState:
 
     def available_resources(self) -> Dict[str, float]:
         return self._worker.backend.resources.available
+
+
+def _pg_to_blob(pg) -> bytes:
+    """Placement groups persist as PLAIN data (the handle's __reduce__
+    resolves through the live registry, which doesn't exist while a
+    restarted head is still loading its tables)."""
+    import pickle
+
+    return pickle.dumps({
+        "id": pg.id.binary(),
+        "bundles": pg.bundle_specs,
+        "strategy": pg.strategy,
+        "name": pg.name,
+        "bundle_nodes": getattr(pg, "bundle_nodes", None),
+    })
+
+
+def _pg_from_blob(blob: bytes):
+    import pickle
+
+    from ray_tpu.util.placement_group import PlacementGroup
+
+    d = pickle.loads(blob)
+    pg = PlacementGroup(PlacementGroupID(d["id"]), d["bundles"],
+                        d["strategy"], d["name"])
+    if d.get("bundle_nodes") is not None:
+        pg.bundle_nodes = d["bundle_nodes"]
+        pg._ready.set()
+    else:
+        # Persisted at registration but the head died before the
+        # reservation committed: surface a clean failure instead of a
+        # phantom-ready group whose bundles were never placed.
+        pg._failed = ("placement-group reservation was in flight when "
+                      "the head restarted; re-create the group")
+        pg._ready.set()
+    return pg

@@ -72,15 +72,45 @@ def message(name: str, version: int = 1):
     return wrap
 
 
-def _check_field(cls, fname: str, ftype, value):
-    if value is None or ftype is Any:
+_FIELDS_CACHE: dict = {}
+
+
+def _declared_fields(cls) -> dict:
+    """Per-class decode plan, computed once: field name -> (base type
+    name, isinstance check tuple or None). Resolving string annotations
+    (`from __future__ import annotations` makes every field type a
+    string) via get_type_hints PER MESSAGE dominated decode cost."""
+    plan = _FIELDS_CACHE.get(cls)
+    if plan is None:
+        hints = None
+        plan = {}
+        for f in dataclasses.fields(cls):
+            ftype = f.type
+            if isinstance(ftype, str):
+                if hints is None:
+                    try:
+                        hints = typing.get_type_hints(cls)
+                    except Exception:
+                        hints = {}
+                ftype = hints.get(f.name, Any)
+            if ftype is Any:
+                plan[f.name] = ("Any", None)
+            else:
+                origin = typing.get_origin(ftype)
+                base = origin or ftype
+                plan[f.name] = (getattr(base, "__name__", str(base)),
+                                _SCALAR_CHECKS.get(base))
+        _FIELDS_CACHE[cls] = plan
+    return plan
+
+
+def _check_field(cls, fname: str, entry, value):
+    base_name, expect = entry
+    if value is None or expect is None:
         return
-    origin = typing.get_origin(ftype)
-    base = origin or ftype
-    expect = _SCALAR_CHECKS.get(base)
-    if expect is not None and not isinstance(value, expect):
+    if not isinstance(value, expect):
         raise WireError(
-            f"{cls._wire_name}.{fname}: expected {base.__name__}, got "
+            f"{cls._wire_name}.{fname}: expected {base_name}, got "
             f"{type(value).__name__}")
 
 
@@ -249,15 +279,13 @@ class _Decoder:
                 kwargs[fname] = fval
             if self.collect is not None:
                 return None
-            declared = {f.name: f for f in dataclasses.fields(cls)}
+            declared = _declared_fields(cls)
             clean = {}
             for fname, fval in kwargs.items():
-                f = declared.get(fname)
-                if f is None:
+                entry = declared.get(fname)
+                if entry is None:
                     continue  # older receiver: skip newer fields
-                _check_field(cls, fname, f.type if not isinstance(
-                    f.type, str) else typing.get_type_hints(cls).get(
-                        fname, Any), fval)
+                _check_field(cls, fname, entry, fval)
                 clean[fname] = fval
             return cls(**clean)
         raise WireError(f"bad wire tag {tag!r}")
@@ -283,6 +311,11 @@ class Request:
     id: str = ""           # "" = no exactly-once dedupe requested
     method: str = ""
     kwargs: Any = None     # dict; values may be Opaque
+    # Highest sequence number this client has CONSUMED a reply for, or
+    # -1 when unknown. Serialized request/reply clients implicitly ack
+    # seq-1; pipelined clients have many requests outstanding, so the
+    # server must not treat "saw seq N" as "replies < N were received".
+    ack: int = -2          # -2 = field absent (legacy serialized client)
 
 
 @message("rpc.Reply", version=1)

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import state as state_mod
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.rpc import RpcClient, RpcServer
@@ -100,6 +101,10 @@ class _NodeRecord:
         # this instead of pinging the node per submission.
         self.available: Dict[str, float] = dict(resources)
         self.last_report: float = time.monotonic()
+        # Queued-not-running task count from the node's last report
+        # (reference: raylet backlog reporting) — lease grants and
+        # spill decisions prefer shallow queues.
+        self.backlog: int = 0
         # Latest physical-stats sample from the node's in-process agent
         # (node_stats.py), carried on resource reports.
         self.stats: Dict[str, Any] = {}
@@ -119,7 +124,7 @@ class ClusterHead:
     re-execution of lost work.
     """
 
-    def __init__(self, worker):
+    def __init__(self, worker, port: int = 0):
         self.worker = worker
         self._lock = threading.Lock()
         self.nodes: Dict[str, _NodeRecord] = {}
@@ -187,7 +192,8 @@ class ClusterHead:
             "gcs_named_actor_register": self._named_actor_register,
             "gcs_named_actor_get": self._named_actor_get,
             "gcs_named_actor_remove": self._named_actor_remove,
-        }, dedupe_methods=frozenset({"gcs_kv_put", "route_task",
+        }, port=port,
+           dedupe_methods=frozenset({"gcs_kv_put", "route_task",
                                      "gcs_named_actor_register"}))
         # Long-poll pubsub channels (reference: pubsub/publisher.h:302);
         # node lifecycle events publish here.
@@ -218,7 +224,7 @@ class ClusterHead:
         return True
 
     def _report_resources(self, node_id: str, available, total=None,
-                          labels=None, stats=None):
+                          labels=None, stats=None, backlog=None):
         """Pushed resource-view delta (reference: ray_syncer.h:86). Also
         treated as a liveness heartbeat by the health checker, and the
         carrier for per-node agent stats (node_stats.py)."""
@@ -227,6 +233,8 @@ class ClusterHead:
             if record is None:
                 return False  # unknown: node should re-register
             record.available = dict(available)
+            if backlog is not None:
+                record.backlog = int(backlog)
             if total:
                 record.resources = dict(total)
             if labels:
@@ -254,6 +262,10 @@ class ClusterHead:
                 self.inflight.pop(tid, None)
                 frees.extend(self._unpin_task_locked(tid))
         self._fan_out_frees(frees)
+        # Wake the driver's fetch dispatcher for anything it awaits.
+        notify = getattr(self.worker, "_fetch_notify", None)
+        if notify is not None:
+            notify(oids)
         return True
 
     # -- dispatch bookkeeping (called by ClusterBackendMixin) -----------
@@ -666,6 +678,16 @@ class ClusterBackendMixin:
         self.head = head
         self.local_backend = worker.backend
         self._rr = 0
+        # Lease-based decentralized dispatch (reference:
+        # `direct_task_transport.h:75,211` + `lease_policy.h:56`): the
+        # head's scheduler is consulted ONCE per task shape to pick a
+        # node (locality-aware); subsequent same-shape tasks stream to
+        # the leased node over a pipelined channel with no per-task
+        # scheduling or round-trip. Leases are returned after
+        # `_LEASE_IDLE_S` idle; backlog flows back on resource reports.
+        self._leases: Dict[tuple, list] = {}
+        self._lease_lock = threading.Lock()
+        self._pipes: Dict[str, Any] = {}  # node_id -> PipelinedClient
 
     def submit(self, spec) -> None:
         head = self.head
@@ -704,6 +726,29 @@ class ClusterBackendMixin:
         routed = self._route_by_strategy(spec)
         if routed is not False:
             return
+        # Plain tasks: ONE local-fit check decides — fits → straight to
+        # the local backend (the hot path; _choose_node would conclude
+        # the same after redundant work); doesn't fit → ride a held
+        # lease without per-task head scheduling.
+        from ray_tpu._private.resources import to_milli
+        from ray_tpu._private.task_spec import DefaultSchedulingStrategy
+
+        if spec.kind == TaskKind.NORMAL_TASK and \
+                isinstance(spec.scheduling_strategy,
+                           (DefaultSchedulingStrategy, type(None))):
+            request = to_milli(spec.resources)
+            local = self.local_backend.resources
+            pending = self.local_backend.pending_demand_milli()
+            with local._cond:
+                fits_local = all(
+                    local._available.get(k, 0) - pending.get(k, 0) >= v
+                    for k, v in request.items())
+            if fits_local:
+                self._ensure_local_deps(spec)
+                self.local_backend.submit(spec)
+                return
+            if self._lease_submit(spec, request):
+                return
         # Normal tasks / actor creations: try nodes until one accepts.
         attempted: set = set()
         while True:
@@ -744,6 +789,185 @@ class ClusterBackendMixin:
         store = self.worker.memory_store
         for oid in spec.return_ids:
             store.put(oid, None, error=error)
+
+    # -- lease-based dispatch (direct_task_transport role) ---------------
+
+    _LEASE_IDLE_S = 2.0
+    # How far a lease may over-subscribe its granted slots before the
+    # manager asks the head for another lease on a different node (the
+    # reference's backlog-driven extra lease requests).
+    _LEASE_BACKLOG_FACTOR = 4
+
+    def _shape_key(self, spec) -> tuple:
+        return tuple(sorted((k, float(v))
+                            for k, v in (spec.resources or {}).items()))
+
+    def _lease_submit(self, spec, request) -> bool:
+        """Dispatch through a held (or newly granted) lease; False when
+        the task should take the per-task scheduling path instead (no
+        node has capacity). Caller has already ruled out local-first."""
+        key = self._shape_key(spec)
+        now = time.monotonic()
+        with self._lease_lock:
+            leases = self._leases.get(key)
+            if leases:
+                # Prune leases on dead nodes and idle-expired ones
+                # (lease return: the node's capacity is only "ours"
+                # while we keep it busy).
+                live = []
+                for lease in leases:
+                    record = self.head.nodes.get(lease["node_id"])
+                    if record is None or not record.alive:
+                        continue
+                    if lease["pipe"].in_flight == 0 and \
+                            now - lease["last_used"] > self._LEASE_IDLE_S:
+                        continue
+                    live.append(lease)
+                if live:
+                    self._leases[key] = live
+                else:
+                    del self._leases[key]
+                leases = live or None
+            if not leases:
+                lease = self._grant_lease(key, spec)
+                if lease is None:
+                    return False
+            else:
+                lease = min(leases,
+                            key=lambda l: l["pipe"].in_flight)
+                # Saturated: ask for one more lease on another node.
+                if lease["pipe"].in_flight >= max(
+                        1, lease["slots"]) * self._LEASE_BACKLOG_FACTOR:
+                    extra = self._grant_lease(
+                        key, spec,
+                        exclude={l["node_id"] for l in leases})
+                    if extra is not None:
+                        lease = extra
+            lease["last_used"] = now
+        return self._lease_send(lease, spec)
+
+    def _grant_lease(self, key, spec, exclude=()) -> Optional[dict]:
+        """One head scheduling decision for a task SHAPE (not a task):
+        locality-aware node choice + slot count from the pushed view.
+        Caller holds _lease_lock."""
+        from ray_tpu._private.resources import to_milli
+
+        target = self._locality_target(spec, exclude)
+        if target is None:
+            target = self._choose_node(spec, exclude=exclude)
+        if target is None:
+            return None
+        request = to_milli(spec.resources)
+        slots = 1
+        if request:
+            slots = max(1, min(
+                int(target.available.get(k, 0) * 1000 // v)
+                for k, v in request.items() if v > 0))
+        pipe = self._pipes.get(target.node_id)
+        if pipe is None:
+            from ray_tpu._private.rpc import PipelinedClient
+
+            pipe = PipelinedClient(target.address,
+                                   on_error=self._pipe_error)
+            self._pipes[target.node_id] = pipe
+        lease = {"node_id": target.node_id, "pipe": pipe,
+                 "slots": slots, "last_used": time.monotonic(),
+                 "address": target.address}
+        self._leases.setdefault(key, []).append(lease)
+        return lease
+
+    def _locality_target(self, spec, exclude=()):
+        """Lease policy (reference `lease_policy.h:56`): prefer the node
+        already holding the task's largest object argument, if it has
+        capacity for the shape."""
+        from ray_tpu.object_ref import ObjectRef
+        from ray_tpu._private.resources import to_milli
+
+        best_addr = None
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(arg, ObjectRef):
+                loc = self.head.object_locations.get(arg.id.binary())
+                if loc is not None:
+                    best_addr = tuple(loc)
+                    break  # first object arg wins (sizes not tracked)
+        if best_addr is None:
+            return None
+        request = to_milli(spec.resources)
+        for node in self.head.nodes.values():
+            if node.node_id in exclude or not node.alive:
+                continue
+            if tuple(node.address) != best_addr:
+                continue
+            if all(node.available.get(k, 0) * 1000 >= v
+                   for k, v in request.items()):
+                return node
+        return None
+
+    def _lease_send(self, lease, spec) -> bool:
+        record = self.head.nodes.get(lease["node_id"])
+        if record is None or not record.alive:
+            return False
+        # Same bookkeeping as _send: lineage + inflight BEFORE the wire.
+        self.head.record_lineage(spec)
+        self.head.record_inflight(spec, lease["node_id"])
+        wire_spec = self._strip_exported_func(spec, record)
+        try:
+            lease["pipe"].send("submit_task", tag=(spec, lease),
+                               spec=wire_spec)
+            return True
+        except (ConnectionError, OSError):
+            self.head.clear_inflight(spec)
+            with self._lease_lock:
+                self._pipes.pop(lease["node_id"], None)
+                for ls in self._leases.values():
+                    ls[:] = [l for l in ls if l is not lease]
+            return False
+
+    def _pipe_error(self, tag, message: str, rid: str, lost: bool):
+        """Async failure from a pipelined channel (reader thread)."""
+        spec, lease = tag
+        if not lost:
+            # The node processed the request but its HANDLER failed —
+            # a control-plane problem (function-resolution hiccup,
+            # queue rejection), not a user-code error (those land in
+            # the result object). Re-route through the per-task
+            # scheduling path like the non-leased loop would, bounded
+            # so a deterministic failure still surfaces.
+            self.head.clear_inflight(spec)
+            retries = getattr(spec, "_lease_reroutes", 0)
+            if retries < 3:
+                spec._lease_reroutes = retries + 1
+                with self._lease_lock:
+                    for ls in self._leases.values():
+                        ls[:] = [l for l in ls if l is not lease]
+                try:
+                    self.submit(spec)
+                    return
+                except Exception:
+                    pass
+            self._fail_spec(spec, RuntimeError(
+                f"leased submit failed on {lease['node_id']} after "
+                f"{retries} reroutes: {message}"))
+            return
+        # Connection lost with the request un-acked: resubmit under the
+        # SAME request id — the node's dedupe cache makes this exactly-
+        # once whether or not the original arrived. If the node is
+        # truly dead, the inflight table resubmits via mark_node_dead.
+        record = self.head.nodes.get(lease["node_id"])
+        with self._lease_lock:
+            self._pipes.pop(lease["node_id"], None)
+            for ls in self._leases.values():
+                ls[:] = [l for l in ls if l is not lease]
+        if record is None or not record.alive:
+            return  # node-death sweep owns recovery
+        try:
+            wire_spec = self._strip_exported_func(spec, record)
+            RpcClient.to(record.address).call_with_rid(
+                rid, "submit_task", spec=wire_spec)
+        except Exception as e:
+            self.head.clear_inflight(spec)
+            self.head.mark_node_dead(lease["node_id"],
+                                     reason=f"unreachable: {e}")
 
     def _route_by_strategy(self, spec):
         """Route a spec per its scheduling strategy. Returns False when
@@ -1011,7 +1235,10 @@ class ClusterBackendMixin:
             avail = node.available
             if all(avail.get(k, 0) * 1000 >= v
                    for k, v in request.items()):
-                score = sum(avail.values())
+                # Reported backlog discounts a node that looks free but
+                # has a deep queue (lease pipelining fills queues ahead
+                # of the availability view).
+                score = sum(avail.values()) - 0.1 * node.backlog
                 if score > best_avail:
                     best, best_avail = node, score
         return best
@@ -1110,63 +1337,140 @@ class ClusterDriverMixin:
         worker.cluster_head = head
         original_get = worker.get_objects
         original_wait = worker.wait
-        fetching: set = set()
-        lock = threading.Lock()
+
+        # ONE event-driven fetch dispatcher instead of a polling thread
+        # per awaited ref (reference: pull_manager.h:52 — a single pull
+        # manager with location-notification wakeups). A thread per ref
+        # melts down at fan-out scale: 2k awaited refs = 2k threads
+        # spinning locate2 polls, starving the executors they wait on.
+        # The head's report_objects handler NOTIFIES the dispatcher, so
+        # the common case is exactly one fetch attempt per object, right
+        # when it becomes available; a slow sweep covers stragglers.
+        pending: Dict[bytes, dict] = {}
+        cond = threading.Condition()
+        hot: set = set()
+
+        def _resolved_locally(object_id):
+            # The object landed in the local store (local execution, or
+            # a completed fetch): retire its pending entry so the sweep
+            # never has to scan resolved refs.
+            with cond:
+                pending.pop(object_id.binary(), None)
 
         def ensure_fetch(ref):
             if worker.memory_store.contains(ref.id):
                 return
+            from ray_tpu._private.config import ray_config
+
             key = ref.id.binary()
-            with lock:
-                if key in fetching:
+            # First attempt only when the object is ALREADY located
+            # somewhere (get-after-completion); otherwise stay purely
+            # event-driven — probing shm/directory per awaited ref costs
+            # more than the fan-out being awaited.
+            with cond:
+                if key in pending:
                     return
-                fetching.add(key)
+                pending[key] = {
+                    "ref": ref,
+                    "deadline": time.monotonic()
+                    + ray_config.fetch_deadline_s,
+                    "err": None,
+                }
+            # Location check AFTER the pending insert: a report landing
+            # between a pre-insert check and the insert would notify
+            # nobody and strand the ref until the slow sweep.
+            if key in worker.cluster_head.object_locations:
+                with cond:
+                    hot.add(key)
+                    cond.notify()
+            worker.memory_store.on_ready(ref.id, _resolved_locally)
 
-            def fetch():
-                from ray_tpu._private.config import ray_config
+        def on_objects_reported(oids):
+            with cond:
+                wanted = [o for o in oids if o in pending]
+                if wanted:
+                    hot.update(wanted)
+                    cond.notify()
 
+        worker._fetch_notify = on_objects_reported
+
+        def try_fetch_one(key, entry) -> bool:
+            """One fetch attempt; True when resolved (or errored)."""
+            ref = entry["ref"]
+            if worker.memory_store.contains(ref.id):
+                return True
+            if _try_shm_fetch(worker, ref.id):
+                return True
+            # Read through worker.cluster_head (not the install-time
+            # capture): restart_head swaps it.
+            live_head = worker.cluster_head
+            info = live_head._locate2(key)
+            if info is not None and \
+                    tuple(info["address"]) != live_head.server.address:
+                if _try_transfer_fetch(worker, ref.id, info):
+                    return True
                 try:
-                    deadline = time.monotonic() + \
-                        ray_config.fetch_deadline_s
-                    transport_err = None
-                    attempt = 0
-                    while time.monotonic() < deadline:
-                        if _try_shm_fetch(worker, ref.id):
-                            return
-                        info = head._locate2(key)
-                        if info is not None and \
-                                tuple(info["address"]) != \
-                                head.server.address:
-                            if _try_transfer_fetch(worker, ref.id, info):
-                                return
-                            try:
-                                ok, value, err = RpcClient.to(
-                                    tuple(info["address"])).call(
-                                    "get_object", oid=key)
-                            except Exception as e:
-                                transport_err = e
-                                time.sleep(0.2)
-                                continue
-                            if ok:
-                                worker.memory_store.put(ref.id, value,
-                                                        error=err)
-                                return
-                        if worker.memory_store.contains(ref.id):
-                            return
-                        _fetch_backoff(attempt)
-                        attempt += 1
-                    if transport_err is not None and \
-                            not worker.memory_store.contains(ref.id):
-                        worker.memory_store.put(
-                            ref.id, None, error=OwnerDiedError(
-                                ref.id.hex()[:12],
-                                f"owner unreachable past the fetch deadline: "
-                                f"{transport_err}"))
-                finally:
-                    with lock:
-                        fetching.discard(key)
+                    ok, value, err = RpcClient.to(
+                        tuple(info["address"])).call("get_object",
+                                                     oid=key)
+                except Exception as e:
+                    entry["err"] = e
+                    return False
+                if ok:
+                    worker.memory_store.put(ref.id, value, error=err)
+                    return True
+            return worker.memory_store.contains(ref.id)
 
-            threading.Thread(target=fetch, daemon=True).start()
+        def dispatcher():
+            # Notifications (head reports + local-store callbacks) carry
+            # the fast path; the periodic full sweep is only the safety
+            # net for missed reports, so it can be SLOW — sweeping every
+            # pending ref at high frequency burns the very core the
+            # executors need.
+            sweep_at = 0.0
+            while True:
+                with cond:
+                    cond.wait(timeout=0.05)
+                    batch = list(hot)
+                    hot.clear()
+                    # The sweep runs ON SCHEDULE, not only on idle
+                    # cycles — steady hot traffic must never starve the
+                    # stragglers the sweep exists to rescue.
+                    if pending and time.monotonic() >= sweep_at:
+                        batch = list(pending)
+                        sweep_at = time.monotonic() + 1.0
+                now = time.monotonic()
+                for key in batch:
+                    with cond:
+                        entry = pending.get(key)
+                    if entry is None:
+                        continue
+                    try:
+                        done = try_fetch_one(key, entry)
+                    except Exception as e:
+                        entry["err"] = e
+                        done = False
+                    if not done and now > entry["deadline"]:
+                        done = True
+                        if entry["err"] is not None and \
+                                not worker.memory_store.contains(
+                                    entry["ref"].id):
+                            worker.memory_store.put(
+                                entry["ref"].id, None,
+                                error=OwnerDiedError(
+                                    entry["ref"].id.hex()[:12],
+                                    "owner unreachable past the fetch "
+                                    f"deadline: {entry['err']}"))
+                    if done:
+                        with cond:
+                            pending.pop(key, None)
+                # Drop loop locals: a lingering `entry` binding would
+                # pin its ObjectRef (blocking the driver's zero-ref
+                # release) across the next wait.
+                entry = batch = None
+
+        threading.Thread(target=dispatcher, daemon=True,
+                         name="cluster-fetch-dispatcher").start()
 
         def get_objects(refs, timeout=None):
             for ref in refs:
@@ -1405,6 +1709,51 @@ class Cluster:
         if proc is not None:
             proc.kill()
             proc.wait(timeout=10)
+
+    def restart_head(self):
+        """Head (GCS) failover: tear the head's services down and bring
+        a FRESH head up on the same address, recovering durable tables
+        from gcs_storage (reference: GCS restart +
+        `node_manager.proto:356` RayletNotifyGCSRestart).
+
+        What this simulates/recovers, and what it loses:
+        - KV, named-actor, and placement-group tables reload from the
+          configured ``gcs_storage_path`` (empty path = in-memory store
+          → tables start empty, like the non-FT reference deployment).
+        - The node table starts EMPTY; live node processes re-register
+          through their resource-report loop (the report returns False
+          for an unknown node → the node re-registers and re-reports
+          its hosted actors and owned objects — the NotifyGCSRestart
+          re-publish). Nodes that stay unreachable past the node-side
+          suicide window exit themselves.
+        - In-flight dispatch state (``inflight``) is lost: tasks already
+          running on nodes complete and re-report their outputs after
+          re-registration; callers keep waiting through the fetch
+          retry window rather than getting spurious errors.
+        - The driver process itself survives (the head is in-process
+          here); in a real deployment driver death is a separate event.
+        """
+        old = self.head
+        addr = old.server.address
+        old.stop()
+        old.server.shutdown()
+        # Fresh GlobalState: prove recovery comes from durable storage,
+        # not this process's memory.
+        self.driver_worker.gcs = state_mod.GlobalState(self.driver_worker)
+        new = ClusterHead(self.driver_worker, port=addr[1])
+        new.transfer_addr = old.transfer_addr
+        new.node_logs = dict(old.node_logs)
+        # Recover placed-bundle locations from the durable PG table.
+        for pg in self.driver_worker.gcs.placement_group_table().values():
+            for i, nid in enumerate(getattr(pg, "bundle_nodes", None)
+                                    or []):
+                if nid is not None:
+                    new.pg_bundle_nodes[(pg.id.binary(), i)] = nid
+        self.head = new
+        self.driver_worker.backend.head = new
+        self.driver_worker.cluster_head = new
+        new._ensure_health_checker()
+        return new
 
     def nodes(self) -> List[dict]:
         return self.head._get_nodes()

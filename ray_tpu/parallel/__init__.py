@@ -33,4 +33,8 @@ from ray_tpu.parallel.sharding import (  # noqa: F401
 )
 from ray_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from ray_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
-from ray_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from ray_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_train_1f1b,
+    schedule_info,
+)

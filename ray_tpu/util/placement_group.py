@@ -421,6 +421,12 @@ def _cluster_reserve(w, head, pg: PlacementGroup,
         for i, cand in enumerate(plan):
             head.pg_bundle_nodes[(pg.id.binary(), i)] = cand.node_id
         pg.bundle_nodes = [c.node_id for c in plan]
+        # Re-persist now that bundle locations are known, so a restarted
+        # head recovers the PLACED group (bundle->node map included).
+        try:
+            head.worker.gcs.register_placement_group(pg)
+        except Exception:
+            pass
         pg._ready.set()
         return
     pg._failed = "placement group reservation timed out"

@@ -1,0 +1,46 @@
+"""Per-chip HBM planning + abstract shape-check (the 8B north-star
+gate; see `ray_tpu/models/memory_plan.py`)."""
+
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.models.memory_plan import plan_llama, shape_check_llama
+
+
+def test_plan_8b_fits_v5e64():
+    cfg = LlamaConfig.llama3_8b()
+    plan = plan_llama(cfg, {"data": 1, "fsdp": 16, "tensor": 4},
+                      batch_per_chip=4, seq_len=2048, chip="v5e")
+    assert plan["chips"] == 64
+    assert plan["fits"]
+    gib = plan["per_chip_gib"]
+    # Sanity: bf16 params = 2*8.03e9/64 chips ≈ 0.23 GiB/chip.
+    assert 0.2 < gib["params"] < 0.3
+    assert gib["total"] < plan["hbm_gib"]
+    # Without sharding the same model cannot fit one chip.
+    solo = plan_llama(cfg, {"data": 1}, batch_per_chip=4,
+                      seq_len=2048, chip="v5e")
+    assert not solo["fits"]
+
+
+def test_plan_remat_policies_order():
+    cfg = LlamaConfig.llama3_8b()
+    kw = dict(batch_per_chip=4, seq_len=2048, chip="v5e")
+    mesh = {"data": 1, "fsdp": 16, "tensor": 4}
+    base = plan_llama(cfg, mesh, remat=True, **kw)
+    gate = plan_llama(cfg, mesh, remat="gate", **kw)
+    mlp = plan_llama(cfg, mesh, remat="mlp", **kw)
+    none = plan_llama(cfg, mesh, remat=False, **kw)
+    a = [p["per_chip_gib"]["activations_saved"]
+         for p in (base, gate, mlp, none)]
+    assert a[0] < a[1] < a[2] < a[3]
+
+
+def test_shape_check_small_config_on_test_mesh():
+    """The abstract-eval path itself, on the 8-device test mesh."""
+    cfg = LlamaConfig.debug()
+    out = shape_check_llama(cfg, {"data": 2, "fsdp": 2, "tensor": 2},
+                            batch_per_chip=1, seq_len=32,
+                            moment_dtype=jnp.bfloat16)
+    assert out["ok"] and out["chips"] == 8
+    assert out["sharding_resolved"]
