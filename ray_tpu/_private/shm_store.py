@@ -48,6 +48,8 @@ class TransferStats(ctypes.Structure):
         ("objects_served", ctypes.c_uint64),
         ("objects_pulled", ctypes.c_uint64),
         ("errors", ctypes.c_uint64),
+        ("objects_pushed_in", ctypes.c_uint64),
+        ("bytes_pushed_in", ctypes.c_uint64),
     ]
 
 
@@ -108,6 +110,13 @@ def _load() -> ctypes.CDLL:
         ctypes.c_uint16, ctypes.c_int]
     lib.shm_transfer_stats.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(TransferStats)]
+    lib.shm_transfer_pull_striped.restype = ctypes.c_int
+    lib.shm_transfer_pull_striped.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint16, ctypes.c_int, ctypes.c_int]
+    lib.shm_transfer_push.restype = ctypes.c_int
+    lib.shm_transfer_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_uint16]
     _lib = lib
     return lib
 
@@ -221,6 +230,16 @@ class ShmObjectStore:
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.shm_obj_contains(self._handle, object_id))
 
+    def object_size(self, object_id: bytes) -> Optional[int]:
+        """Payload size of a sealed object, or None if absent."""
+        size = ctypes.c_uint64()
+        off = self._lib.shm_obj_get(self._handle, object_id,
+                                    ctypes.byref(size))
+        if off == 2**64 - 1:
+            return None
+        self.release(object_id)  # drop the pin Get took
+        return size.value
+
     def release(self, object_id: bytes) -> bool:
         return bool(self._lib.shm_obj_release(self._handle, object_id))
 
@@ -265,6 +284,24 @@ class ShmObjectStore:
         return self._lib.shm_transfer_pull_opts(
             self._handle, object_id, host.encode(), port,
             1 if allow_local else 0)
+
+    def pull_from_striped(self, object_id: bytes, host: str, port: int,
+                          streams: int = 4,
+                          allow_local: bool = True) -> int:
+        """Parallel range-striped pull (reference: object_manager
+        chunked parallel pulls): `streams` connections each move a
+        disjoint byte range. Wins on multi-core hosts / fast NICs;
+        degrades to ~single-stream on one core."""
+        return self._lib.shm_transfer_pull_striped(
+            self._handle, object_id, host.encode(), port, streams,
+            1 if allow_local else 0)
+
+    def push_to(self, object_id: bytes, host: str, port: int) -> int:
+        """Proactively stream a LOCAL object into a remote store
+        (reference push_manager.h). 0 = pushed, -5 = remote already has
+        it, -2 = missing locally, <0 = failure."""
+        return self._lib.shm_transfer_push(
+            self._handle, object_id, host.encode(), port)
 
     def close(self):
         self.stop_transfer_server()

@@ -56,6 +56,13 @@ def _try_shm_fetch(worker, oid) -> bool:
     return True
 
 
+# Bandwidth-aware pull bounding (reference: pull_manager.h:52 — cap
+# in-flight pull bytes): at most N wire pulls at once; excess callers
+# wait their turn instead of thrashing the link with parallel streams
+# that each crawl.
+_WIRE_PULL_SLOTS = threading.BoundedSemaphore(2)
+
+
 def _try_transfer_fetch(worker, oid, loc_info) -> bool:
     """Chunked native pull from the owner's transfer server into the
     local segment, then zero-copy read — the cross-host object plane
@@ -69,9 +76,17 @@ def _try_transfer_fetch(worker, oid, loc_info) -> bool:
     if transfer is None or loc_info.get("shm") == plane.name:
         return False
     try:
-        rc = plane.store.pull_from(
-            oid.binary(), transfer[0], transfer[1],
-            allow_local=getattr(plane, "allow_local_pull", True))
+        # Bounded wait for a pull slot: a hung peer must degrade the
+        # bound, never deadlock the whole object plane (the C layer's
+        # per-syscall socket timeout reclaims the slot eventually).
+        acquired = _WIRE_PULL_SLOTS.acquire(timeout=30.0)
+        try:
+            rc = plane.store.pull_from(
+                oid.binary(), transfer[0], transfer[1],
+                allow_local=getattr(plane, "allow_local_pull", True))
+        finally:
+            if acquired:
+                _WIRE_PULL_SLOTS.release()
         if rc not in (0, -5):
             return False
         return _try_shm_fetch(worker, oid)
@@ -688,6 +703,8 @@ class ClusterBackendMixin:
         self._leases: Dict[tuple, list] = {}
         self._lease_lock = threading.Lock()
         self._pipes: Dict[str, Any] = {}  # node_id -> PipelinedClient
+        # (node_id, oid) pairs already pushed (push_manager dedupe).
+        self._pushed: set = set()
 
     def submit(self, spec) -> None:
         head = self.head
@@ -907,6 +924,7 @@ class ClusterBackendMixin:
         record = self.head.nodes.get(lease["node_id"])
         if record is None or not record.alive:
             return False
+        self._publish_local_args(record, spec)
         # Same bookkeeping as _send: lineage + inflight BEFORE the wire.
         self.head.record_lineage(spec)
         self.head.record_inflight(spec, lease["node_id"])
@@ -1243,17 +1261,65 @@ class ClusterBackendMixin:
                     best, best_avail = node, score
         return best
 
-    def _send(self, node: _NodeRecord, spec):
-        # Proactively publish local args so the node can pull them.
+    # Args at or above this size are PUSHED to the target node ahead of
+    # the task (reference push_manager.h: proactive transfers beat the
+    # node's on-demand dep pull by one full round trip + queue wait).
+    _PUSH_ARG_BYTES = 4 << 20
+
+    def _publish_local_args(self, node: _NodeRecord, spec) -> None:
+        """The ONE publish path both dispatch flavors share: report
+        driver-local arg locations to the head, then proactively push
+        big ones to the target node (off-thread, deduped — the node's
+        on-demand dep fetch remains the fallback for every miss)."""
         from ray_tpu.object_ref import ObjectRef
 
-        local_oids = []
-        for arg in list(spec.args) + list(spec.kwargs.values()):
-            if isinstance(arg, ObjectRef) and \
-                    self.worker.memory_store.contains(arg.id):
-                local_oids.append(arg.id.binary())
-        if local_oids:
-            self.head._report_objects(local_oids, self.head.server.address)
+        local_oids = [arg.id.binary()
+                      for arg in list(spec.args)
+                      + list(spec.kwargs.values())
+                      if isinstance(arg, ObjectRef)
+                      and self.worker.memory_store.contains(arg.id)]
+        if not local_oids:
+            return
+        self.head._report_objects(local_oids, self.head.server.address)
+        self._maybe_push_args(node, local_oids)
+
+    def _maybe_push_args(self, node: _NodeRecord, local_oids) -> None:
+        plane = getattr(self.worker, "shm_plane", None)
+        if plane is None or node.transfer is None or \
+                node.shm_name == plane.name:
+            return  # shared segment: dep is already zero-copy visible
+        to_push = []
+        for ob in local_oids:
+            key = (node.node_id, ob)
+            if key in self._pushed:
+                continue
+            try:
+                size = plane.store.object_size(ob)
+            except Exception:
+                size = None
+            if size is None or size < self._PUSH_ARG_BYTES:
+                continue
+            self._pushed.add(key)  # claim before the async push races
+            to_push.append(ob)
+        if not to_push:
+            return
+
+        def run(addr=node.transfer, oids=to_push, nid=node.node_id):
+            for ob in oids:
+                try:
+                    rc = plane.store.push_to(ob, addr[0], addr[1])
+                    if rc not in (0, -5):
+                        self._pushed.discard((nid, ob))
+                except Exception:
+                    self._pushed.discard((nid, ob))
+
+        # Off the dispatch path: a GB-scale push must never stall
+        # submission; the dep fetch covers the in-flight window.
+        threading.Thread(target=run, daemon=True,
+                         name="arg-push").start()
+
+    def _send(self, node: _NodeRecord, spec):
+        self._publish_local_args(node, spec)
         # Lineage + in-flight BEFORE the wire: a fast task can execute
         # and report its outputs before this function returns, and that
         # report must find (and clear) the in-flight entry — recording

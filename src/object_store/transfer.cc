@@ -19,6 +19,7 @@
 #include <set>
 #include <string>
 #include <thread>
+#include <vector>
 #include <utility>
 
 namespace ray_tpu {
@@ -38,6 +39,15 @@ void TuneSocket(int fd) {
   int buf = kSockBufBytes;
   setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
   setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+  // Per-syscall progress timeout: a half-open peer (partition, NIC
+  // death without RST) must not pin a handler or a puller — and with
+  // the Python side bounding concurrent pulls, a hung pull would
+  // otherwise starve the whole object plane. 120s of zero progress on
+  // ONE send/recv is unambiguous death, not a slow link.
+  timeval tv = {};
+  tv.tv_sec = 120;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 }
 
 bool SendAll(int fd, const void* buf, uint64_t n) {
@@ -75,6 +85,28 @@ struct Request {
   uint64_t offset;
   uint64_t len;
 } __attribute__((packed));
+
+// Connect + tune one socket to host:port; -1 on failure.
+int ConnectTo(const char* host, uint16_t port) {
+  addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char port_str[16];
+  snprintf(port_str, sizeof(port_str), "%u", port);
+  if (getaddrinfo(host, port_str, &hints, &res) != 0 || res == nullptr) {
+    return -1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd >= 0) close(fd);
+    return -1;
+  }
+  freeaddrinfo(res);
+  TuneSocket(fd);
+  return fd;
+}
 
 }  // namespace
 
@@ -151,6 +183,44 @@ void TransferServer::HandleConn(int fd) {
   Request req;
   while (!stopping_ && RecvAll(fd, &req, sizeof(req))) {
     if (req.magic != kTransferMagic) break;
+    if (req.op == (uint8_t)TransferOp::kPush) {
+      // Inbound proactive push: accept(1)/have-it(2)/refuse(0), then
+      // recv straight into a fresh arena allocation and seal.
+      if (store_->Contains(req.id)) {
+        uint8_t a = 2;
+        if (!SendAll(fd, &a, sizeof(a))) break;
+        continue;  // sender stops streaming on 2
+      }
+      uint8_t* dst = store_->CreateObject(req.id, req.len);
+      uint8_t a = dst == nullptr ? 0 : 1;
+      if (!SendAll(fd, &a, sizeof(a)) || dst == nullptr) break;
+      uint64_t got = 0;
+      bool ok = true;
+      while (ok && got < req.len) {
+        uint64_t n =
+            req.len - got < kChunkSize ? req.len - got : kChunkSize;
+        ok = RecvAll(fd, dst + got, n);
+        got += n;
+      }
+      if (!ok) {
+        store_->Release(req.id);
+        store_->Delete(req.id);
+        {
+          std::lock_guard<std::mutex> lk(g_stats_mu);
+          stats_.errors += 1;
+        }
+        break;
+      }
+      store_->Seal(req.id);
+      {
+        std::lock_guard<std::mutex> lk(g_stats_mu);
+        stats_.bytes_pushed_in += got;
+        stats_.objects_pushed_in += 1;
+      }
+      uint8_t sealed = 1;
+      if (!SendAll(fd, &sealed, sizeof(sealed))) break;
+      continue;
+    }
     uint64_t size = 0;
     const uint8_t* payload = store_->Get(req.id, &size);  // pins
     if (req.op == (uint8_t)TransferOp::kGetMeta) {
@@ -300,24 +370,8 @@ int TryLocalPull(ShmStore* store, const uint8_t* id,
 int PullObject(ShmStore* store, const uint8_t* id, const char* host,
                uint16_t port, TransferStats* stats, bool allow_local) {
   if (store->Contains(id)) return -5;
-
-  addrinfo hints = {};
-  hints.ai_family = AF_INET;
-  hints.ai_socktype = SOCK_STREAM;
-  addrinfo* res = nullptr;
-  char port_str[16];
-  snprintf(port_str, sizeof(port_str), "%u", port);
-  if (getaddrinfo(host, port_str, &hints, &res) != 0 || res == nullptr) {
-    return -1;
-  }
-  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
-    freeaddrinfo(res);
-    if (fd >= 0) close(fd);
-    return -1;
-  }
-  freeaddrinfo(res);
-  TuneSocket(fd);
+  int fd = ConnectTo(host, port);
+  if (fd < 0) return -1;
 
   Request req = {};
   req.magic = kTransferMagic;
@@ -388,6 +442,150 @@ int PullObject(ShmStore* store, const uint8_t* id, const char* host,
   return 0;
 }
 
+namespace {
+
+// Pull one byte range over its own connection into dst (pre-sized).
+bool PullRange(const uint8_t* id, const char* host, uint16_t port,
+               uint64_t offset, uint64_t len, uint8_t* dst) {
+  int fd = ConnectTo(host, port);
+  if (fd < 0) return false;
+  Request req = {};
+  req.magic = kTransferMagic;
+  req.op = (uint8_t)TransferOp::kGet;
+  memcpy(req.id, id, kIdSize);
+  req.offset = offset;
+  req.len = len;
+  uint64_t size = 0;
+  bool ok = SendAll(fd, &req, sizeof(req)) &&
+            RecvAll(fd, &size, sizeof(size)) && size != UINT64_MAX;
+  uint64_t got = 0;
+  while (ok && got < len) {
+    uint64_t n = len - got < kChunkSize ? len - got : kChunkSize;
+    ok = RecvAll(fd, dst + got, n);
+    got += n;
+  }
+  close(fd);
+  return ok;
+}
+
+}  // namespace
+
+int PullObjectStriped(ShmStore* store, const uint8_t* id,
+                      const char* host, uint16_t port, int streams,
+                      TransferStats* stats, bool allow_local) {
+  if (streams <= 1) {
+    return PullObject(store, id, host, port, stats, allow_local);
+  }
+  if (store->Contains(id)) return -5;
+  int fd = ConnectTo(host, port);
+  if (fd < 0) return -1;
+  Request req = {};
+  req.magic = kTransferMagic;
+  memcpy(req.id, id, kIdSize);
+  if (allow_local) {
+    req.op = (uint8_t)TransferOp::kGetMeta;
+    MetaReply meta = {};
+    if (!SendAll(fd, &req, sizeof(req)) ||
+        !RecvAll(fd, &meta, sizeof(meta))) {
+      close(fd);
+      return -4;
+    }
+    if (meta.size == UINT64_MAX) {
+      close(fd);
+      return -2;
+    }
+    meta.segment[sizeof(meta.segment) - 1] = '\0';
+    int rc = TryLocalPull(store, id, meta, stats);
+    if (rc <= 0) {
+      close(fd);
+      return rc;
+    }
+  }
+  // Size probe on the control connection, then fan the range pulls out.
+  req.op = (uint8_t)TransferOp::kStat;
+  uint64_t size = 0;
+  bool ok = SendAll(fd, &req, sizeof(req)) &&
+            RecvAll(fd, &size, sizeof(size));
+  close(fd);
+  if (!ok) return -4;
+  if (size == UINT64_MAX) return -2;
+
+  uint8_t* dst = store->CreateObject(id, size);
+  if (dst == nullptr) return store->Contains(id) ? -5 : -3;
+  // Stripe boundaries chunk-aligned so each stream's recv loop stays in
+  // whole chunks; last stripe takes the remainder.
+  uint64_t stripe = (size / (uint64_t)streams) / kChunkSize * kChunkSize;
+  if (stripe == 0) stripe = size;  // small object: one live stream
+  std::vector<std::thread> workers;
+  std::atomic<bool> all_ok{true};
+  uint64_t off = 0;
+  while (off < size) {
+    uint64_t len = off + stripe < size && workers.size() + 1 <
+                   (size_t)streams ? stripe : size - off;
+    workers.emplace_back([&, off, len] {
+      if (!PullRange(id, host, port, off, len, dst + off)) {
+        all_ok = false;
+      }
+    });
+    off += len;
+  }
+  for (auto& t : workers) t.join();
+  if (!all_ok) {
+    store->Release(id);
+    store->Delete(id);
+    if (stats) stats->errors += 1;
+    return -4;
+  }
+  store->Seal(id);
+  if (stats) {
+    stats->bytes_received += size;
+    stats->objects_pulled += 1;
+  }
+  return 0;
+}
+
+int PushObject(ShmStore* store, const uint8_t* id, const char* host,
+               uint16_t port, TransferStats* stats) {
+  uint64_t size = 0;
+  const uint8_t* payload = store->Get(id, &size);  // pins
+  if (payload == nullptr) return -2;
+  int fd = ConnectTo(host, port);
+  if (fd < 0) {
+    store->Release(id);
+    return -1;
+  }
+  Request req = {};
+  req.magic = kTransferMagic;
+  req.op = (uint8_t)TransferOp::kPush;
+  memcpy(req.id, id, kIdSize);
+  req.offset = 0;
+  req.len = size;
+  uint8_t accept = 0;
+  bool ok = SendAll(fd, &req, sizeof(req)) &&
+            RecvAll(fd, &accept, sizeof(accept));
+  if (ok && accept == 2) {  // remote already has it
+    close(fd);
+    store->Release(id);
+    return -5;
+  }
+  if (ok && accept != 1) ok = false;  // remote store full / refused
+  uint64_t sent = 0;
+  while (ok && sent < size) {
+    uint64_t n = size - sent < kChunkSize ? size - sent : kChunkSize;
+    ok = SendAll(fd, payload + sent, n);
+    sent += n;
+  }
+  uint8_t sealed = 0;
+  if (ok) ok = RecvAll(fd, &sealed, sizeof(sealed)) && sealed == 1;
+  close(fd);
+  store->Release(id);
+  if (!ok) {
+    if (stats) stats->errors += 1;
+    return -4;
+  }
+  return 0;
+}
+
 }  // namespace ray_tpu
 
 // ---------------------------------------------------------------------------
@@ -425,5 +623,19 @@ int shm_transfer_pull_opts(void* store, const uint8_t* id,
 
 void shm_transfer_stats(void* server, ray_tpu::TransferStats* out) {
   *out = static_cast<ray_tpu::TransferServer*>(server)->stats();
+}
+
+int shm_transfer_pull_striped(void* store, const uint8_t* id,
+                              const char* host, uint16_t port,
+                              int streams, int allow_local) {
+  return ray_tpu::PullObjectStriped(
+      static_cast<ray_tpu::ShmStore*>(store), id, host, port, streams,
+      nullptr, allow_local != 0);
+}
+
+int shm_transfer_push(void* store, const uint8_t* id, const char* host,
+                      uint16_t port) {
+  return ray_tpu::PushObject(static_cast<ray_tpu::ShmStore*>(store), id,
+                             host, port, nullptr);
 }
 }

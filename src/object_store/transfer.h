@@ -37,6 +37,8 @@ enum class TransferOp : uint8_t {
   kGet = 1,      // pull a byte range (len 0 = to end) of an object
   kStat = 2,     // size lookup only
   kGetMeta = 3,  // size + serving segment identity (same-host fast path)
+  kPush = 4,     // sender streams an object INTO this store
+                 // (reference: push_manager.h proactive transfers)
 };
 
 // Reply to kGetMeta: lets a puller on the SAME machine as the server
@@ -55,6 +57,10 @@ struct TransferStats {
   uint64_t objects_served;
   uint64_t objects_pulled;
   uint64_t errors;
+  // Inbound proactive pushes, counted separately so push-vs-pull
+  // traffic is distinguishable (push_manager diagnosis).
+  uint64_t objects_pushed_in;
+  uint64_t bytes_pushed_in;
 };
 
 class TransferServer {
@@ -98,6 +104,21 @@ int PullObject(ShmStore* store, const uint8_t* id, const char* host,
                uint16_t port, TransferStats* stats,
                bool allow_local = true);
 
+// Striped pull: `streams` parallel connections each pull a disjoint
+// byte range into the same arena allocation (reference:
+// object_manager chunked parallel pulls). On multi-core hosts with
+// fast NICs each stream rides its own core; on a single-core loopback
+// it degrades gracefully to ~single-stream throughput.
+int PullObjectStriped(ShmStore* store, const uint8_t* id,
+                      const char* host, uint16_t port, int streams,
+                      TransferStats* stats, bool allow_local = true);
+
+// PUSH path (reference push_manager.h): stream a LOCAL object into the
+// remote node's store without waiting for it to ask. Returns 0 ok,
+// -1 connect, -2 local missing, -4 io error, -5 remote already has it.
+int PushObject(ShmStore* store, const uint8_t* id, const char* host,
+               uint16_t port, TransferStats* stats);
+
 }  // namespace ray_tpu
 
 // ---------------------------------------------------------------------------
@@ -119,5 +140,12 @@ int shm_transfer_pull(void* store, const uint8_t* id, const char* host,
 int shm_transfer_pull_opts(void* store, const uint8_t* id,
                            const char* host, uint16_t port,
                            int allow_local);
+// Striped parallel pull (streams<=1 behaves like shm_transfer_pull).
+int shm_transfer_pull_striped(void* store, const uint8_t* id,
+                              const char* host, uint16_t port,
+                              int streams, int allow_local);
+// Proactive push of a local object into a remote store.
+int shm_transfer_push(void* store, const uint8_t* id, const char* host,
+                      uint16_t port);
 void shm_transfer_stats(void* server, ray_tpu::TransferStats* out);
 }

@@ -117,6 +117,65 @@ int main() {
   assert(st.objects_served == 2);
   assert(st.bytes_sent == 4096 + kGiB);
 
+  // Striped parallel pull: 4 range streams, content identical, timed.
+  {
+    assert(b->Delete(big_id));
+    auto t0 = std::chrono::steady_clock::now();
+    int rc = PullObjectStriped(b, big_id, "127.0.0.1", srv->port(), 4,
+                               nullptr, /*allow_local=*/false);
+    auto dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+    assert(rc == 0);
+    uint64_t size = 0;
+    const uint8_t* q = b->Get(big_id, &size);
+    assert(q && size == kGiB);
+    for (uint64_t off = 0; off < kGiB; off += ray_tpu::kChunkSize) {
+      uint64_t v;
+      memcpy(&v, q + off, sizeof(v));
+      assert(v == off);
+    }
+    assert(q[kGiB - 1] == 0x5A);
+    b->Release(big_id);
+    printf("1GiB pull (striped x4): %.2f GB/s\n", 1.0 / dt);
+    // Striped into a store that already has it: -5.
+    assert(PullObjectStriped(b, big_id, "127.0.0.1", srv->port(), 4,
+                             nullptr, false) == -5);
+  }
+
+  // PUSH path: b proactively streams an object into a's server-side
+  // peer... push runs against a TransferServer, so start one for b.
+  {
+    TransferServer* srv_b = TransferServer::Start(b, 0);
+    assert(srv_b && srv_b->port() != 0);
+    uint8_t push_id[ray_tpu::kIdSize];
+    make_id(push_id, 3);
+    uint8_t* p = a->CreateObject(push_id, 1 << 20);
+    assert(p);
+    for (int i = 0; i < (1 << 20); i++) p[i] = (uint8_t)(i * 13);
+    assert(a->Seal(push_id));
+    // a pushes into b's transfer server.
+    assert(PushObject(a, push_id, "127.0.0.1", srv_b->port(),
+                      nullptr) == 0);
+    uint64_t size = 0;
+    const uint8_t* q = b->Get(push_id, &size);
+    assert(q && size == (1 << 20));
+    for (int i = 0; i < (1 << 20); i++) {
+      assert(q[i] == (uint8_t)(i * 13));
+    }
+    b->Release(push_id);
+    // Re-push: remote already has it.
+    assert(PushObject(a, push_id, "127.0.0.1", srv_b->port(),
+                      nullptr) == -5);
+    // Pushing a missing local object: -2.
+    uint8_t nothere[ray_tpu::kIdSize];
+    make_id(nothere, 77);
+    assert(PushObject(a, nothere, "127.0.0.1", srv_b->port(),
+                      nullptr) == -2);
+    srv_b->Stop();
+    delete srv_b;
+  }
+
   srv->Stop();
   delete srv;
   delete a;

@@ -123,6 +123,95 @@ def test_backlog_reported_to_head():
         cluster.shutdown()
 
 
+def test_leased_task_with_driver_local_args():
+    """Leased dispatch must publish (and for big args push) the
+    driver's local objects so the node's dep fetch finds them."""
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2)
+    try:
+        big = ray_tpu.put(np.arange(1_000_000, dtype=np.float64))
+
+        @ray_tpu.remote(num_cpus=1)
+        def total(a, b):
+            return float(a.sum()) + b
+
+        refs = [total.remote(big, i) for i in range(8)]
+        expect = float(np.arange(1_000_000, dtype=np.float64).sum())
+        assert ray_tpu.get(refs, timeout=60) == [expect + i
+                                                 for i in range(8)]
+    finally:
+        cluster.shutdown()
+
+
+def test_push_path_to_simulated_remote_node():
+    """A big driver arg is PUSHED to a node on its OWN segment
+    (push_manager role): the consuming task still sees it, and the
+    node's transfer stats show inbound bytes without a pull request
+    from the node side having raced it."""
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2, simulate_remote_host=True)
+    try:
+        data = np.ones(2_000_000, dtype=np.float64)  # 16 MB > push min
+        big = ray_tpu.put(data)
+
+        @ray_tpu.remote(num_cpus=2)  # only fits the remote node
+        def consume(a):
+            return float(a.sum())
+
+        assert ray_tpu.get(consume.remote(big),
+                           timeout=60) == 2_000_000.0
+        # The push really happened (not just the dep-fetch fallback):
+        # the dispatch recorded a successful (node, oid) push.
+        backend = ray_tpu._private.worker.global_worker().backend
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not backend._pushed:
+            time.sleep(0.05)
+        assert any(oid == big.id.binary()
+                   for _, oid in backend._pushed), backend._pushed
+    finally:
+        cluster.shutdown()
+
+
+def test_striped_pull_and_push_shm_api():
+    """Direct store-level drive of the new transfer surfaces."""
+    import os
+
+    from ray_tpu._private.shm_store import ShmObjectStore
+
+    a = ShmObjectStore(name=f"/lease_xa_{os.getpid()}", create=True,
+                       capacity=256 << 20)
+    b = ShmObjectStore(name=f"/lease_xb_{os.getpid()}", create=True,
+                       capacity=256 << 20)
+    try:
+        port = a.start_transfer_server()
+        port_b = b.start_transfer_server()
+        oid = b"x" * 20
+        payload = np.random.RandomState(0).bytes(32 << 20)
+        assert a.put_bytes(oid, payload)
+        assert a.object_size(oid) == len(payload)
+        # striped pull b <- a
+        rc = b.pull_from_striped(oid, "127.0.0.1", port, streams=3,
+                                 allow_local=False)
+        assert rc == 0
+        got = b.get_bytes(oid)
+        assert got is not None and bytes(got) == payload
+        b.release(oid)
+        # push a -> b of a second object
+        oid2 = b"y" * 20
+        assert a.put_bytes(oid2, payload[: 8 << 20])
+        assert a.push_to(oid2, "127.0.0.1", port_b) == 0
+        got2 = b.get_bytes(oid2)
+        assert got2 is not None and bytes(got2) == payload[: 8 << 20]
+        b.release(oid2)
+        # re-push: remote already has it
+        assert a.push_to(oid2, "127.0.0.1", port_b) == -5
+    finally:
+        a.destroy()
+        b.destroy()
+
+
 def test_pipelined_client_error_feedback():
     """Failure replies on the pipelined channel surface through the
     error callback with the request id; successful ones don't."""
