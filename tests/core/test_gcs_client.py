@@ -157,7 +157,9 @@ def test_rpc_handler_stats_surface():
         stats = cluster.head.server.handler_stats()
         assert "report_objects" in stats, stats.keys()
         row = stats["report_objects"]
-        assert row["calls"] >= 10
+        # Output reports BATCH across tasks (round-5 reporter thread):
+        # 10 results arrive in a handful of calls, not one per task.
+        assert row["calls"] >= 1
         assert row["mean_ms"] >= 0 and row["max_ms"] >= row["mean_ms"]
         assert row["errors"] == 0
     finally:
