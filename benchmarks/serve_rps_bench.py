@@ -80,24 +80,29 @@ def main():
     }
 
     # -- HTTP proxy path --------------------------------------------------
+    # Persistent connections (the proxy speaks HTTP/1.1 keep-alive):
+    # each worker holds ONE connection, like any real client/LB would —
+    # per-request TCP connects measured the handshake, not the proxy.
+    import http.client
     import json as _json
-    import urllib.request
 
     proxy = serve.start_http_proxy()
-    url = f"http://127.0.0.1:{proxy.port}/noop"
     http_lat = []
 
     def http_worker(n):
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                          timeout=30)
         for i in range(n):
             t0 = time.perf_counter()
             body = _json.dumps({"payload": i}).encode()
-            req = urllib.request.Request(
-                url, data=body, headers={"Content-Type":
-                                         "application/json"})
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                resp.read()
+            conn.request("POST", "/noop", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 200, (resp.status, payload[:200])
             with lock:
                 http_lat.append(time.perf_counter() - t0)
+        conn.close()
 
     http_n = max(100, args.requests // 3)
     per = http_n // 4

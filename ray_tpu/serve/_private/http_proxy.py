@@ -52,15 +52,44 @@ class HTTPProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: without it every request pays a TCP
+            # connect plus a fresh handler thread (ThreadingHTTPServer
+            # is thread-per-CONNECTION), which capped ingress at a few
+            # hundred RPS. Persistent connections amortize both.
+            protocol_version = "HTTP/1.1"
+            # One segment per response: unbuffered wfile writes (status
+            # line, each header, body as separate send()s) interact with
+            # Nagle + the peer's 40ms delayed ACK to add ~44ms per
+            # keep-alive request. Buffer fully and disable Nagle.
+            wbufsize = -1
+            disable_nagle_algorithm = True
+            # Idle keep-alive connections must not pin a thread forever
+            # (thread-per-connection server): reap after 30s quiet.
+            timeout = 30
+
             def log_message(self, *args):  # quiet
                 pass
 
             def _dispatch(self):
                 handle, rest = proxy.routes.match(self.path.split("?")[0])
                 if handle is None:
+                    miss = b'{"error": "no route"}'
                     self.send_response(404)
+                    self.send_header("Content-Length", str(len(miss)))
                     self.end_headers()
-                    self.wfile.write(b'{"error": "no route"}')
+                    self.wfile.write(miss)
+                    return
+                if "chunked" in (self.headers.get("Transfer-Encoding")
+                                 or "").lower():
+                    # Not decoded here; reading Content-Length bytes of
+                    # a chunked body would desync the keep-alive stream.
+                    err = b'{"error": "chunked bodies not supported"}'
+                    self.send_response(501)
+                    self.send_header("Content-Length", str(len(err)))
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                    self.end_headers()
+                    self.wfile.write(err)
                     return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
@@ -87,6 +116,10 @@ class HTTPProxy:
                         self.send_header("Content-Type",
                                          "text/event-stream")
                         self.send_header("Cache-Control", "no-cache")
+                        # SSE has no Content-Length: close when done so
+                        # keep-alive clients see the end of the body.
+                        self.send_header("Connection", "close")
+                        self.close_connection = True
                         self.end_headers()
                         try:
                             for chunk in iter_stream(result):
@@ -114,13 +147,15 @@ class HTTPProxy:
                     out = json.dumps(result).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(out)))
                     self.end_headers()
                     self.wfile.write(out)
                 except Exception as e:  # noqa: BLE001
+                    err = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
+                    self.send_header("Content-Length", str(len(err)))
                     self.end_headers()
-                    self.wfile.write(json.dumps(
-                        {"error": str(e)}).encode())
+                    self.wfile.write(err)
 
             do_GET = _dispatch
             do_POST = _dispatch
