@@ -355,7 +355,7 @@ class LocalBackend:
                     continue
                 try:
                     pool = self._resource_pool_for(s)
-                    request = to_milli(s.resources)
+                    request = self._spec_milli(s)
                 except Exception as e:  # malformed spec must not kill dispatch
                     self._pending_remove(s)
                     self.worker.store_task_outputs(
@@ -659,20 +659,33 @@ class LocalBackend:
             )
         self._on_actor_death(actor, exc.ActorDiedError(actor_id.hex()[:8], "killed"))
 
-    def _pending_add(self, spec) -> None:
-        from ray_tpu._private.resources import to_milli as _to_milli
+    @staticmethod
+    def _spec_milli(spec) -> dict:
+        # Cached per spec: the demand conversion runs at least three
+        # times per task (pending add/remove + dispatch) otherwise.
+        m = getattr(spec, "_milli_cache", None)
+        if m is None:
+            from ray_tpu._private.resources import to_milli as _to_milli
 
+            m = _to_milli(spec.resources)
+            try:
+                spec._milli_cache = m
+            except Exception:
+                pass
+        return m
+
+    def _pending_add(self, spec) -> None:
+        milli = self._spec_milli(spec)
         with self._lock:
             self._pending_count += 1
-            for k, v in _to_milli(spec.resources).items():
+            for k, v in milli.items():
                 self._pending_milli[k] = self._pending_milli.get(k, 0) + v
 
     def _pending_remove(self, spec) -> None:
-        from ray_tpu._private.resources import to_milli as _to_milli
-
+        milli = self._spec_milli(spec)
         with self._lock:
             self._pending_count = max(0, self._pending_count - 1)
-            for k, v in _to_milli(spec.resources).items():
+            for k, v in milli.items():
                 left = self._pending_milli.get(k, 0) - v
                 if left > 0:
                     self._pending_milli[k] = left
