@@ -75,6 +75,12 @@ from ray_tpu.rl.offline import (  # noqa: F401
     JsonReader,
     JsonWriter,
 )
+from ray_tpu.rl.offline_estimators import (  # noqa: F401
+    DirectMethod,
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+)
 from ray_tpu.rl.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,
