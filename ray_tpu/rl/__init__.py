@@ -75,6 +75,7 @@ from ray_tpu.rl.offline import (  # noqa: F401
     JsonReader,
     JsonWriter,
 )
+from ray_tpu.rl.exploration import RNDModule  # noqa: F401
 from ray_tpu.rl.offline_estimators import (  # noqa: F401
     DirectMethod,
     ImportanceSampling,

@@ -47,6 +47,11 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final = 0.05
         self.epsilon_timesteps = 10_000
         self.num_sgd_per_iter = 32
+        # Intrinsic exploration: None or "rnd" (reference
+        # `rllib/utils/exploration/` curiosity family).
+        self.exploration = None
+        self.rnd_coef = 0.5
+        self.rnd_embed_dim = 32
 
 
 class DQN(Algorithm):
@@ -80,6 +85,17 @@ class DQN(Algorithm):
             return jnp.log(probs + 1e-9), jnp.zeros(obs.shape[0])
 
         self.workers = WorkerSet(cfg, behaviour)
+        self.rnd = None
+        if cfg.exploration == "rnd":
+            from ray_tpu.rl.exploration import RNDModule
+
+            self.rnd = RNDModule(obs_dim, embed_dim=cfg.rnd_embed_dim,
+                                 seed=cfg.seed)
+        elif cfg.exploration is not None:
+            raise ValueError(
+                f"exploration={cfg.exploration!r}: expected None or "
+                "'rnd' (a typo would silently train without the "
+                "intrinsic bonus)")
         self._update = jax.jit(functools.partial(
             _dqn_update, tx=self.tx, gamma=cfg.gamma,
             double_q=cfg.double_q))
@@ -95,6 +111,14 @@ class DQN(Algorithm):
         eps = self._epsilon()
         batches = self.workers.sample((self.params, jnp.float32(eps)))
         batch = flatten_fragments(batches)
+        mean_bonus = None
+        if self.rnd is not None:
+            # Novelty bonus mixes into the reward BEFORE replay: the
+            # TD targets then value poorly-predicted (novel) states.
+            bonus = self.rnd.bonus(np.asarray(batch[OBS]))
+            batch[REWARDS] = np.asarray(batch[REWARDS], np.float32) \
+                + self.algo_config.rnd_coef * bonus
+            mean_bonus = float(bonus.mean())
         self.buffer.add(batch)
         self._steps_sampled += batch.count
         self._steps_since_target += batch.count
@@ -115,12 +139,15 @@ class DQN(Algorithm):
         if self._steps_since_target >= cfg.target_update_freq:
             self.target_params = jax.tree.map(jnp.copy, self.params)
             self._steps_since_target = 0
-        return {
+        out = {
             "mean_td_loss": float(np.mean(losses)) if losses else None,
             "epsilon": eps,
             "buffer_size": len(self.buffer),
             "num_env_steps_sampled_this_iter": batch.count,
         }
+        if mean_bonus is not None:
+            out["mean_intrinsic_bonus"] = mean_bonus
+        return out
 
     def get_weights(self):
         return self.params
@@ -129,6 +156,17 @@ class DQN(Algorithm):
         self.params = jax.tree.map(jnp.asarray, weights)
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.opt_state = self.tx.init(self.params)
+
+    def save_checkpoint(self):
+        ckpt = super().save_checkpoint()
+        if self.rnd is not None:
+            ckpt["rnd"] = self.rnd.state()
+        return ckpt
+
+    def load_checkpoint(self, data):
+        super().load_checkpoint(data)
+        if self.rnd is not None and data.get("rnd"):
+            self.rnd.set_state(data["rnd"])
 
 
 def _dqn_update(params, target_params, opt_state, mb, *, tx, gamma,
