@@ -56,6 +56,11 @@ def run_point(args, workers: int, seconds: float) -> dict:
     algo = config.build()
     algo.train()  # warm-up: compiles the update + absorbs platform stall
     thread = algo.learner_thread
+    # Align busy-accounting windows with the measurement boundaries:
+    # without the flush, a window opened during warm-up banks its whole
+    # span (compile included) inside the measurement and the busy delta
+    # can exceed the wall (the round-5 `device_busy_fraction: 1.49`).
+    thread.flush_windows()
     base_busy = thread.busy_s
     base_updates = thread.updates
     base_samples = thread.samples_consumed
@@ -65,14 +70,19 @@ def run_point(args, workers: int, seconds: float) -> dict:
     while time.perf_counter() - t0 < seconds:
         result = algo.train()
         env_steps += result["num_env_steps_sampled_this_iter"]
+    thread.flush_windows()  # bank the tail inside the measured wall
     wall = time.perf_counter() - t0
+    busy_fraction = (thread.busy_s - base_busy) / wall
+    assert 0.0 <= busy_fraction <= 1.0, (
+        f"device_busy_fraction out of bounds: {busy_fraction} "
+        f"(busy delta {thread.busy_s - base_busy:.3f}s over "
+        f"{wall:.3f}s wall)")
     out = {
         "workers": workers,
         "fresh_env_steps_per_s": round(env_steps / wall, 1),
         "reused_transitions_per_s": round(
             (thread.samples_consumed - base_samples) / wall, 1),
-        "device_busy_fraction": round(
-            (thread.busy_s - base_busy) / wall, 4),
+        "device_busy_fraction": round(busy_fraction, 4),
         "learner_updates_per_s": round(
             (thread.updates - base_updates) / wall, 2),
         "window_s": round(wall, 1),
@@ -91,7 +101,11 @@ def main():
     parser.add_argument("--sweep", action="store_true",
                         help="also sweep rollout workers for the "
                              "fresh-sample knee")
-    parser.add_argument("--sweep-seconds", type=float, default=20.0)
+    parser.add_argument("--sweep-seconds", type=float, default=None,
+                        help="per-point sweep window; defaults to "
+                             "--seconds so sweep and headline numbers "
+                             "are measured over EQUAL windows and stay "
+                             "comparable")
     parser.add_argument("--env", default="CatchPixels-v0")
     args = parser.parse_args()
 
@@ -104,8 +118,10 @@ def main():
 
     sweep = []
     if args.sweep:
+        sweep_seconds = args.sweep_seconds if args.sweep_seconds \
+            else args.seconds
         for w in (1, 2, 4, 8):
-            sweep.append(run_point(args, w, args.sweep_seconds))
+            sweep.append(run_point(args, w, sweep_seconds))
 
     import jax
 

@@ -63,10 +63,56 @@ class ObjectRef:
         w.memory_store.on_ready(self._id, _on_ready)
         return fut
 
+    def as_future(self, loop=None):
+        """Return an ``asyncio.Future`` on ``loop`` (default: the running
+        loop) resolving to the value. Unlike :meth:`future` +
+        ``asyncio.wrap_future`` this is one cross-thread hop
+        (``call_soon_threadsafe``) per completion, which matters on the
+        event-loop ingress hot path. Task failures resolve to the
+        user-level exception, matching ``ray_tpu.get``."""
+        import asyncio
+
+        from ray_tpu import exceptions as _exc
+        from ray_tpu._private import worker as _worker_mod
+
+        if loop is None:
+            loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        w = _worker_mod.global_worker()
+
+        def _on_ready(_oid):
+            ready, value, error = w.memory_store.peek(self._id)
+            assert ready
+            if isinstance(error, _exc.TaskError):
+                error = error.as_instanceof_cause()
+
+            def _set():
+                if fut.cancelled():
+                    return
+                if error is not None:
+                    fut.set_exception(error)
+                else:
+                    fut.set_result(value)
+
+            try:
+                loop.call_soon_threadsafe(_set)
+            except RuntimeError:
+                # Loop closed (e.g. proxy shutdown mid-request): the
+                # future's consumer is gone; do not break the store's
+                # callback chain for other waiters.
+                pass
+
+        w.memory_store.on_ready(self._id, _on_ready)
+        return fut
+
     def __await__(self):
         import asyncio
 
-        return asyncio.wrap_future(self.future()).__await__()
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return asyncio.wrap_future(self.future()).__await__()
+        return self.as_future().__await__()
 
     def __hash__(self) -> int:
         return hash(self._id)

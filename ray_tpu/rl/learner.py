@@ -352,6 +352,7 @@ class LearnerThread(threading.Thread):
         self._window_t0 = None
         self._window_starved = 0.0
         self._pending_stats = None
+        self._flush_req: Optional[threading.Event] = None
         # A crashed update must surface at the feeder, not wedge it: the
         # thread records the error and producers see it on put().
         self.error: Optional[BaseException] = None
@@ -369,12 +370,66 @@ class LearnerThread(threading.Thread):
     def get_weights(self):
         return self.learner.get_weights()
 
+    def flush_windows(self, timeout: float = 30.0) -> None:
+        """Close the current busy-accounting window at the next safe
+        point ON the learner thread and wait for it. Benchmarks call
+        this at both measurement boundaries so busy-time deltas line up
+        with the measured wall: a window opened before the measurement
+        (e.g. spanning warm-up compile time) can otherwise bank its
+        whole busy span *inside* the measurement and push
+        device_busy_fraction past 1.0."""
+        if self.is_alive():
+            evt = threading.Event()
+            self._flush_req = evt
+            deadline = time.perf_counter() + timeout
+            # Poll liveness: a thread that crashes after the check
+            # above must not pin the caller for the full timeout (its
+            # exit path services the request, but belt and braces).
+            while not evt.wait(0.05):
+                if not self.is_alive() or \
+                        time.perf_counter() > deadline:
+                    break
+            if evt.is_set():
+                return
+            self._flush_req = None
+            if self.is_alive():
+                return  # wedged mid-update: flush is best-effort
+        # Thread exited (stopped or crashed): no concurrent access,
+        # close any leftover window directly.
+        if self._window_updates:
+            self._close_window()
+
+    def _maybe_flush(self):
+        req = self._flush_req
+        if req is not None:
+            self._flush_req = None
+            self._close_window()
+            req.set()
+
     # -- thread body -----------------------------------------------------
 
     def run(self):
+        try:
+            self._run_inner()
+        finally:
+            # Whatever the exit path (stop, crash): bank the leftover
+            # window and release any flush_windows() waiter — a crashed
+            # learner must not pin the bench/caller for its timeout.
+            if self._window_updates:
+                try:
+                    self._close_window()
+                except Exception:
+                    pass
+            req = self._flush_req
+            if req is not None:
+                self._flush_req = None
+                req.set()
+
+    def _run_inner(self):
         self._t_start = time.perf_counter()
         self._window_t0 = self._t_start
         while not self._stop_evt.is_set():
+            self._maybe_flush()
             t0 = time.perf_counter()
             try:
                 batch = self.inq.get(timeout=0.2)
@@ -399,12 +454,11 @@ class LearnerThread(threading.Thread):
                     self.samples_consumed += transitions
                     if self._window_updates >= self.barrier_every:
                         self._close_window()
+                    else:
+                        self._maybe_flush()
             except BaseException as e:  # noqa: BLE001 — surfaced at put()
                 self.error = e
                 return
-        # final barrier so busy accounting includes the tail
-        if self._window_updates:
-            self._close_window()
 
     def _close_window(self):
         """Fetch one host scalar — the only trustworthy completion

@@ -27,7 +27,11 @@ from ray_tpu.serve._private.proxy_actor import (  # noqa: F401
     start_proxy_fleet,
 )
 from ray_tpu.serve._private.router import ServeHandle
-from ray_tpu.serve.streaming import is_stream, iter_stream  # noqa: F401
+from ray_tpu.serve.streaming import (  # noqa: F401
+    aiter_stream,
+    is_stream,
+    iter_stream,
+)
 
 _proxy: Optional[HTTPProxy] = None
 
@@ -230,10 +234,24 @@ def delete(name: str):
                 _proxy.routes.remove(prefix)
 
 
-def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
+                     **proxy_options) -> HTTPProxy:
+    """Driver-local ingress. ``proxy_options`` forward to
+    :class:`HTTPProxy` (``max_in_flight``, ``queue_timeout_s``,
+    ``idle_timeout_s``); on an already-running proxy (serve.run starts
+    one for any routed deployment) they reconfigure it in place —
+    they're read per-request, so the change applies immediately."""
     global _proxy
     if _proxy is None:
-        _proxy = HTTPProxy(host, port)
+        _proxy = HTTPProxy(host, port, **proxy_options)
+    else:
+        allowed = ("max_in_flight", "queue_timeout_s", "idle_timeout_s",
+                   "result_timeout_s")
+        unknown = [k for k in proxy_options if k not in allowed]
+        if unknown:  # validate ALL keys before mutating any (atomic)
+            raise TypeError(f"unknown proxy option(s) {unknown!r}")
+        for key, value in proxy_options.items():
+            setattr(_proxy, key, value)
     return _proxy
 
 

@@ -1,20 +1,58 @@
-"""HTTP proxy: route HTTP requests to deployment handles.
+"""HTTP ingress: a single-threaded asyncio event-loop HTTP/1.1 server.
 
-Reference: `serve/_private/http_proxy.py:425` (uvicorn + ASGI). Here a
-threaded stdlib HTTP server (no external deps in the image) with
-longest-prefix routing; JSON bodies are parsed and handed to the
-deployment callable, results JSON-encoded. An ASGI front-end can be
-swapped in where starlette/uvicorn are available.
+Reference: `serve/_private/http_proxy.py:425` (uvicorn + ASGI). The
+previous ingress here was a stdlib ``ThreadingHTTPServer`` — a thread
+per *connection*, blocking ``ray_tpu.get`` per request, and streamed
+responses forced ``Connection: close`` (SSE has no Content-Length), so
+every streaming reply tore down its keep-alive connection. This module
+replaces it with an event-loop data plane, uvicorn-style but with no
+external deps:
+
+- one ``asyncio.Protocol`` per connection on a single loop thread:
+  persistent keep-alive connections, no thread per connection, idle
+  connections reaped after ``idle_timeout_s``;
+- streaming/SSE responses use **chunked transfer-encoding**, so the
+  connection survives the stream and the next request rides the same
+  socket;
+- **bounded-concurrency backpressure**: at most ``max_in_flight``
+  requests are in the router at once; beyond that the proxy sheds load
+  with ``503 + Retry-After`` instead of growing threads/queues without
+  bound. A router-queue timeout (no replica slot within
+  ``queue_timeout_s``) also maps to 503;
+- the bridge to the handle/router path is fully async:
+  ``ServeHandle.remote_async`` awaits a replica slot and
+  ``ObjectRef.as_future`` completes on this loop via one
+  ``call_soon_threadsafe`` hop — the loop never blocks in
+  ``ray_tpu.get``.
+
+Each response is written as a single ``transport.write`` (plus
+TCP_NODELAY) — the buffered-write/Nagle lesson from the threaded
+proxy's 40 ms delayed-ACK stall carries over.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import socket
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
-import ray_tpu
+from ray_tpu.serve._private.router import QueueSaturatedError
+from ray_tpu.serve.streaming import aiter_stream, is_stream
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 32 * 1024 * 1024
+_MAX_PIPELINED = 16
+
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    413: "Payload Too Large",
+    431: "Request Header Fields Too Large", 500: "Internal Server Error",
+    501: "Not Implemented", 503: "Service Unavailable",
+}
 
 
 class _RouteTable:
@@ -46,129 +84,466 @@ class _RouteTable:
         return handle, path[len(p):] or "/"
 
 
-class HTTPProxy:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
-        self.routes = _RouteTable()
-        proxy = self
+class _Request:
+    __slots__ = ("method", "path", "version", "headers", "body",
+                 "keep_alive", "chunked_body", "error")
 
-        class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.1 keep-alive: without it every request pays a TCP
-            # connect plus a fresh handler thread (ThreadingHTTPServer
-            # is thread-per-CONNECTION), which capped ingress at a few
-            # hundred RPS. Persistent connections amortize both.
-            protocol_version = "HTTP/1.1"
-            # One segment per response: unbuffered wfile writes (status
-            # line, each header, body as separate send()s) interact with
-            # Nagle + the peer's 40ms delayed ACK to add ~44ms per
-            # keep-alive request. Buffer fully and disable Nagle.
-            wbufsize = -1
-            disable_nagle_algorithm = True
-            # Idle keep-alive connections must not pin a thread forever
-            # (thread-per-connection server): reap after 30s quiet.
-            timeout = 30
+    def __init__(self):
+        self.body = b""
+        self.chunked_body = False
+        self.error: Optional[Tuple[int, bytes]] = None
 
-            def log_message(self, *args):  # quiet
+
+class _Conn(asyncio.Protocol):
+    """One keep-alive client connection on the proxy's event loop.
+
+    Headers parse with one ``split`` over the header block (no readline
+    loop); pipelined requests queue in ``backlog`` and are handled
+    strictly in order by a single task, so responses never interleave.
+    """
+
+    def __init__(self, proxy: "HTTPProxy"):
+        self.proxy = proxy
+        self.transport = None
+        self.buf = b""
+        self.backlog: deque = deque()
+        self.task: Optional[asyncio.Task] = None
+        self.closing = False
+        self.last_activity = time.monotonic()
+        self._write_paused = False
+        self._read_paused = False
+        self._drain_waiter: Optional[asyncio.Future] = None
+        self._need: Optional[Tuple[_Request, int]] = None
+        self._halt_parse = False  # unparseable framing (chunked body)
+        self.http10 = False  # version of the request being handled
+
+    # -- lifecycle -------------------------------------------------------
+
+    def connection_made(self, transport):
+        self.transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+            except OSError:
                 pass
+        self.proxy._conns.add(self)
 
-            def _dispatch(self):
-                handle, rest = proxy.routes.match(self.path.split("?")[0])
-                if handle is None:
-                    miss = b'{"error": "no route"}'
-                    self.send_response(404)
-                    self.send_header("Content-Length", str(len(miss)))
-                    self.end_headers()
-                    self.wfile.write(miss)
+    def connection_lost(self, exc):
+        self.closing = True
+        self.proxy._conns.discard(self)
+        w = self._drain_waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    # -- outgoing flow control (slow client) -----------------------------
+
+    def pause_writing(self):
+        self._write_paused = True
+
+    def resume_writing(self):
+        self._write_paused = False
+        w = self._drain_waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    async def drain(self):
+        """Park the writer until the transport buffer drains — a slow
+        streaming client backpressures its own stream pump instead of
+        buffering the whole response in proxy memory."""
+        if self._write_paused and not self.closing:
+            self._drain_waiter = self.proxy._loop.create_future()
+            try:
+                await self._drain_waiter
+            finally:
+                self._drain_waiter = None
+
+    # -- incoming --------------------------------------------------------
+
+    def data_received(self, data: bytes):
+        self.last_activity = time.monotonic()
+        self.buf += data
+        self._parse()
+        if self.backlog and self.task is None and not self.closing:
+            self.task = self.proxy._loop.create_task(self._run())
+        # Inbound flood guard: a client pipelining faster than the
+        # handlers drain must not buffer unboundedly.
+        if (len(self.backlog) > _MAX_PIPELINED
+                and not self._read_paused):
+            self._read_paused = True
+            self.transport.pause_reading()
+
+    def _fail_parse(self, status: int, body: bytes):
+        """Queue a framing-error pseudo-request (responses must stay in
+        order behind any pipelined predecessors) and stop parsing — the
+        byte stream is no longer trustworthy, so the handler closes."""
+        req = _Request()
+        req.method, req.path, req.version = "GET", "/", "HTTP/1.1"
+        req.headers = {}
+        req.keep_alive = False
+        req.error = (status, body)
+        self.backlog.append(req)
+        self._halt_parse = True
+
+    def _parse(self):
+        while not self._halt_parse:
+            if self._need is not None:
+                req, length = self._need
+                if len(self.buf) < length:
                     return
-                if "chunked" in (self.headers.get("Transfer-Encoding")
-                                 or "").lower():
-                    # Not decoded here; reading Content-Length bytes of
-                    # a chunked body would desync the keep-alive stream.
-                    err = b'{"error": "chunked bodies not supported"}'
-                    self.send_response(501)
-                    self.send_header("Content-Length", str(len(err)))
-                    self.send_header("Connection", "close")
-                    self.close_connection = True
-                    self.end_headers()
-                    self.wfile.write(err)
-                    return
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                payload: Any = None
-                if body:
-                    try:
-                        payload = json.loads(body)
-                    except ValueError:
-                        payload = body.decode("utf-8", "replace")
-                try:
-                    if payload is None:
-                        ref = handle.remote()
-                    else:
-                        ref = handle.remote(payload)
-                    result = ray_tpu.get(ref, timeout=60)
-                    from ray_tpu.serve.streaming import (is_stream,
-                                                         iter_stream)
+                req.body = self.buf[:length]
+                self.buf = self.buf[length:]
+                self._need = None
+                self.backlog.append(req)
+                continue
+            end = self.buf.find(b"\r\n\r\n")
+            if end < 0:
+                if len(self.buf) > _MAX_HEADER_BYTES:
+                    self._fail_parse(431, b'{"error": "headers too '
+                                     b'large"}')
+                return
+            head, self.buf = self.buf[:end], self.buf[end + 4:]
+            lines = head.split(b"\r\n")
+            req = _Request()
+            try:
+                req.method, req.path, version = \
+                    lines[0].decode("latin-1").split(" ", 2)
+                req.version = version.strip()
+            except ValueError:
+                self._fail_parse(400, b'{"error": "bad request"}')
+                return
+            headers: Dict[str, str] = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(b":")
+                headers[k.strip().lower().decode("latin-1")] = \
+                    v.strip().decode("latin-1")
+            req.headers = headers
+            conn_hdr = headers.get("connection", "").lower()
+            if req.version == "HTTP/1.0":
+                req.keep_alive = "keep-alive" in conn_hdr
+            else:
+                req.keep_alive = "close" not in conn_hdr
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                # Not decoded: bytes after the header block can't be
+                # framed, so stop parsing — the handler replies 501 and
+                # closes.
+                req.chunked_body = True
+                self.backlog.append(req)
+                self._halt_parse = True
+                return
+            try:
+                length = int(headers.get("content-length") or 0)
+            except ValueError:
+                length = -1
+            if length < 0:
+                # A negative length would make the body slice swallow
+                # pipelined successors (request smuggling): hard 400.
+                self._fail_parse(400, b'{"error": "bad content-'
+                                 b'length"}')
+                return
+            if length > _MAX_BODY_BYTES:
+                # Bound what one request can make the loop buffer —
+                # max_in_flight can't engage before parsing completes.
+                self._fail_parse(413, b'{"error": "body too large"}')
+                return
+            if length:
+                self._need = (req, length)
+            else:
+                self.backlog.append(req)
 
-                    if is_stream(result):
-                        # Server-sent events, flushed per chunk: tokens
-                        # reach the client while the model is still
-                        # decoding (reference: ASGI StreamingResponse).
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "text/event-stream")
-                        self.send_header("Cache-Control", "no-cache")
-                        # SSE has no Content-Length: close when done so
-                        # keep-alive clients see the end of the body.
-                        self.send_header("Connection", "close")
-                        self.close_connection = True
-                        self.end_headers()
-                        try:
-                            for chunk in iter_stream(result):
-                                self.wfile.write(
-                                    b"data: " + json.dumps(chunk).encode()
-                                    + b"\n\n")
-                                self.wfile.flush()
-                            self.wfile.write(b"data: [DONE]\n\n")
-                            self.wfile.flush()
-                        except (BrokenPipeError, ConnectionError):
-                            pass  # client went away mid-stream
-                        except Exception as stream_err:  # noqa: BLE001
-                            # Headers already sent: a mid-stream failure
-                            # must become an error *event*, never a 500
-                            # status line spliced into the SSE body.
-                            try:
-                                self.wfile.write(
-                                    b"data: " + json.dumps(
-                                        {"error": str(stream_err)}
-                                    ).encode() + b"\n\ndata: [DONE]\n\n")
-                                self.wfile.flush()
-                            except (BrokenPipeError, ConnectionError):
-                                pass
-                        return
-                    out = json.dumps(result).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(out)))
-                    self.end_headers()
-                    self.wfile.write(out)
-                except Exception as e:  # noqa: BLE001
-                    err = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Length", str(len(err)))
-                    self.end_headers()
-                    self.wfile.write(err)
+    async def _run(self):
+        try:
+            while self.backlog and not self.closing:
+                req = self.backlog.popleft()
+                if (self._read_paused
+                        and len(self.backlog) <= _MAX_PIPELINED // 2):
+                    self._read_paused = False
+                    self.transport.resume_reading()
+                self.http10 = req.version == "HTTP/1.0"
+                await self.proxy._handle(self, req)
+                self.last_activity = time.monotonic()
+        finally:
+            # No await between the loop's empty-backlog check and this
+            # reset (single loop thread), so no request can slip in
+            # unhandled.
+            self.task = None
 
-            do_GET = _dispatch
-            do_POST = _dispatch
-            do_PUT = _dispatch
-            do_DELETE = _dispatch
+    # -- outgoing --------------------------------------------------------
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.host, self.port = self._server.server_address[:2]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="serve-http-proxy")
+    def send_response(self, status: int, body: bytes, *,
+                      keep: bool = True, retry_after: bool = False,
+                      content_type: str = "application/json"):
+        if self.closing:
+            return
+        if status == 200 and keep and not self.http10 \
+                and content_type == "application/json":
+            # The hot path (every successful unary JSON reply): one
+            # bytes concatenation, no per-header string formatting.
+            self.transport.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
+                b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+            return
+        parts = [
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+        ]
+        if retry_after:
+            parts.append("Retry-After: 1")
+        if not keep:
+            parts.append("Connection: close")
+        elif self.http10:
+            # HTTP/1.0 defaults to close: persistence must be granted
+            # explicitly or the client drops the socket while the
+            # server-side connection lingers until the idle reaper.
+            parts.append("Connection: keep-alive")
+        self.transport.write(
+            ("\r\n".join(parts) + "\r\n\r\n").encode("latin-1") + body)
+        if not keep:
+            self.closing = True
+            self.transport.close()
+
+    def send_header_block(self, status: int, headers):
+        if self.closing:
+            return
+        parts = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}"]
+        parts += [f"{k}: {v}" for k, v in headers]
+        self.transport.write(
+            ("\r\n".join(parts) + "\r\n\r\n").encode("latin-1"))
+
+    def write_body(self, data: bytes, chunked: bool):
+        if self.closing:
+            return
+        if chunked:
+            self.transport.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        else:
+            self.transport.write(data)
+
+
+class HTTPProxy:
+    """The per-process ingress: an event-loop HTTP/1.1 server routing to
+    deployment handles. API-compatible with the threaded predecessor
+    (``routes`` / ``host`` / ``port`` / ``shutdown``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000, *,
+                 max_in_flight: int = 256, queue_timeout_s: float = 15.0,
+                 idle_timeout_s: float = 30.0,
+                 result_timeout_s: float = 60.0):
+        self.routes = _RouteTable()
+        self.max_in_flight = max_in_flight
+        self.queue_timeout_s = queue_timeout_s
+        self.idle_timeout_s = idle_timeout_s
+        self.result_timeout_s = result_timeout_s
+        self._in_flight = 0
+        self._served = 0
+        self._shed = 0
+        self._conns: set = set()
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._loop_main,
+                                        daemon=True,
+                                        name="serve-http-proxy")
         self._thread.start()
+        self._started.wait(10)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._start_server(host, port), self._loop)
+        try:
+            self.host, self.port = fut.result(timeout=30)
+        except BaseException:
+            # Bind failure (port in use, bad host): don't leak the loop
+            # thread behind the raised error.
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            raise
+
+    def _loop_main(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.call_soon(self._started.set)
+        self._loop.run_forever()
+        pending = asyncio.all_tasks(self._loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            self._loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True))
+        self._loop.close()
+
+    async def _start_server(self, host: str, port: int):
+        self._server = await self._loop.create_server(
+            lambda: _Conn(self), host, port)
+        self._reaper = self._loop.create_task(self._reap_idle())
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def _reap_idle(self):
+        """Keep-alive connections must not pin resources forever: close
+        any connection idle (no request in progress) past the timeout."""
+        while True:
+            await asyncio.sleep(min(5.0, self.idle_timeout_s / 2))
+            now = time.monotonic()
+            for conn in list(self._conns):
+                if (conn.task is None and not conn.backlog
+                        and not conn.closing
+                        and now - conn.last_activity
+                        > self.idle_timeout_s):
+                    conn.closing = True
+                    conn.transport.close()
+
+    # -- request handling ------------------------------------------------
+
+    async def _handle(self, conn: _Conn, req: _Request):
+        if req.error is not None:
+            status, body = req.error
+            conn.send_response(status, body, keep=False)
+            return
+        if req.chunked_body:
+            conn.send_response(
+                501, b'{"error": "chunked bodies not supported"}',
+                keep=False)
+            return
+        handle, _rest = self.routes.match(req.path.split("?", 1)[0])
+        if handle is None:
+            conn.send_response(404, b'{"error": "no route"}',
+                               keep=req.keep_alive)
+            return
+        if self._in_flight >= self.max_in_flight:
+            # Load shed: a bounded in-flight cap with an explicit 503
+            # instead of the threaded server's unbounded thread growth.
+            self._shed += 1
+            conn.send_response(503, b'{"error": "server overloaded"}',
+                               keep=req.keep_alive, retry_after=True)
+            return
+        payload: Any = None
+        if req.body:
+            try:
+                payload = json.loads(req.body)
+            except ValueError:
+                payload = req.body.decode("utf-8", "replace")
+        self._in_flight += 1
+        try:
+            args = () if payload is None else (payload,)
+            # Fast path: a free replica slot dispatches synchronously
+            # (no coroutine machinery); only saturation parks on the
+            # async queue-wait.
+            ref = handle.try_remote(*args)
+            if ref is None:
+                ref = await handle.remote_async(
+                    *args, _queue_timeout_s=self.queue_timeout_s)
+            fut = ref.as_future(self._loop)
+            try:
+                # Bounded replica execution (the threaded proxy's
+                # get(timeout=60) contract): a hung deployment becomes
+                # a 500, not a request pinning its in-flight slot — and
+                # the proxy — forever.
+                result = await asyncio.wait_for(
+                    fut, self.result_timeout_s)
+            except asyncio.TimeoutError:
+                if not fut.cancelled():
+                    # The DEPLOYMENT raised a TimeoutError (3.11+:
+                    # asyncio.TimeoutError is builtin TimeoutError);
+                    # wait_for only cancels the future when IT timed
+                    # out. Application failure -> generic 500 below.
+                    raise
+                conn.send_response(
+                    500, json.dumps({
+                        "error": f"no result within "
+                                 f"{self.result_timeout_s}s"}).encode(),
+                    keep=req.keep_alive)
+                self._served += 1
+                return
+            if is_stream(result):
+                await self._stream_response(conn, req, result)
+            else:
+                conn.send_response(200, json.dumps(result).encode(),
+                                   keep=req.keep_alive)
+            self._served += 1
+        except QueueSaturatedError as e:
+            # Router queue saturated: no replica slot within the queue
+            # timeout. Shed with Retry-After, same as the in-flight
+            # cap. A TimeoutError raised BY the deployment does NOT
+            # land here — that's an application failure (500 below).
+            self._shed += 1
+            conn.send_response(503,
+                               json.dumps({"error": str(e)}).encode(),
+                               keep=req.keep_alive, retry_after=True)
+        except Exception as e:  # noqa: BLE001
+            conn.send_response(500,
+                               json.dumps({"error": str(e)}).encode(),
+                               keep=req.keep_alive)
+            self._served += 1
+        finally:
+            self._in_flight -= 1
+
+    async def _stream_response(self, conn: _Conn, req: _Request, result):
+        """Server-sent events with chunked transfer-encoding: the client
+        sees each chunk as produced AND the connection stays usable for
+        the next request (the threaded proxy had to Connection: close
+        here, killing keep-alive for every streamed reply). HTTP/1.0
+        clients can't parse chunked framing, so they fall back to a
+        close-delimited body."""
+        chunked = req.version != "HTTP/1.0"
+        keep = req.keep_alive and chunked
+        headers = [("Content-Type", "text/event-stream"),
+                   ("Cache-Control", "no-cache")]
+        if chunked:
+            headers.append(("Transfer-Encoding", "chunked"))
+        if not keep:
+            headers.append(("Connection", "close"))
+        conn.send_header_block(200, headers)
+        try:
+            async for chunk in aiter_stream(result):
+                conn.write_body(
+                    b"data: " + json.dumps(chunk).encode() + b"\n\n",
+                    chunked)
+                await conn.drain()
+                if conn.closing:  # client went away mid-stream
+                    return
+            conn.write_body(b"data: [DONE]\n\n", chunked)
+        except Exception as stream_err:  # noqa: BLE001
+            # Headers already sent: a mid-stream failure must become an
+            # error *event*, never a 500 status line spliced into the
+            # SSE body.
+            conn.write_body(
+                b"data: " + json.dumps(
+                    {"error": str(stream_err)}).encode()
+                + b"\n\ndata: [DONE]\n\n", chunked)
+        if conn.closing:
+            return
+        if chunked:
+            conn.transport.write(b"0\r\n\r\n")
+        if not keep:
+            conn.closing = True
+            conn.transport.close()
+
+    # -- observability / lifecycle --------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        """Ingress counters. ``served`` counts requests that reached a
+        handler and got a terminal non-shed response (2xx/5xx);
+        ``shed_503`` counts load-shed requests (in-flight cap or router
+        queue timeout) — the two are disjoint."""
+        return {"in_flight": self._in_flight, "served": self._served,
+                "shed_503": self._shed,
+                "open_connections": len(self._conns)}
 
     def shutdown(self):
-        self._server.shutdown()
-        self._server.server_close()
+        if self._loop.is_closed():
+            return
+
+        def _stop():
+            for conn in list(self._conns):
+                try:
+                    conn.closing = True
+                    conn.transport.close()
+                except Exception:
+                    pass
+            self._reaper.cancel()
+            self._server.close()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(_stop)
+        except RuntimeError:
+            return
+        self._thread.join(timeout=10)

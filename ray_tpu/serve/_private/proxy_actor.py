@@ -20,13 +20,16 @@ from ray_tpu.serve._private.router import ServeHandle
 
 @ray_tpu.remote
 class HTTPProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_in_flight: int = 256,
+                 queue_timeout_s: float = 15.0):
         from ray_tpu.serve._private.controller import (
             get_or_create_controller,
         )
 
         self._controller = get_or_create_controller()
-        self._proxy = HTTPProxy(host, port)
+        self._proxy = HTTPProxy(host, port, max_in_flight=max_in_flight,
+                                queue_timeout_s=queue_timeout_s)
         self._handles: Dict[str, ServeHandle] = {}
         self._stop = threading.Event()
         self._sync(ray_tpu.get(self._controller.get_routes.remote()))
@@ -74,6 +77,11 @@ class HTTPProxyActor:
     def address(self):
         return (self._proxy.host, self._proxy.port)
 
+    def stats(self):
+        """Ingress counters (in_flight, served, shed_503, open
+        connections) — the fleet-level load/shedding signal."""
+        return self._proxy.stats()
+
     def shutdown(self):
         self._stop.set()
         self._proxy.shutdown()
@@ -81,7 +89,9 @@ class HTTPProxyActor:
 
 
 def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
-                      base_port: int = 0, spread: bool = True):
+                      base_port: int = 0, spread: bool = True,
+                      max_in_flight: int = 256,
+                      queue_timeout_s: float = 15.0):
     """Start N proxy actors (SPREAD-scheduled across nodes when
     possible); returns [(actor_handle, (host, port)), ...]."""
     from ray_tpu.util.scheduling_strategies import (
@@ -96,6 +106,7 @@ def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
         if spread:
             opts["scheduling_strategy"] = SpreadSchedulingStrategy()
         port = base_port + i if base_port else 0
-        a = HTTPProxyActor.options(**opts).remote(host, port)
+        a = HTTPProxyActor.options(**opts).remote(
+            host, port, max_in_flight, queue_timeout_s)
         actors.append((a, ray_tpu.get(a.address.remote())))
     return actors

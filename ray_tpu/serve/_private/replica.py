@@ -25,6 +25,7 @@ class ServeReplica:
         self._in_flight = 0
         self._total = 0
         self._t_busy = 0.0
+        self._async_loop = None  # lazily-started, shared across requests
         if isinstance(serialized_cls, type):
             self.callable = serialized_cls(*(init_args or ()),
                                            **(init_kwargs or {}))
@@ -60,9 +61,13 @@ class ServeReplica:
             import inspect
 
             if inspect.iscoroutine(result):
-                import asyncio
-
-                result = asyncio.run(result)
+                # One persistent loop per replica: asyncio.run() per
+                # request paid a full loop setup/teardown on the serving
+                # hot path, and broke coroutines that share loop-bound
+                # state (locks, queues) across requests.
+                result = self._run_coroutine(result)
+            if inspect.isasyncgen(result):
+                return self._start_stream(self._agen_to_gen(result))
             if inspect.isgenerator(result):
                 return self._start_stream(result)
             return result
@@ -70,6 +75,41 @@ class ServeReplica:
             with self._lock:
                 self._in_flight -= 1
                 self._t_busy += time.perf_counter() - t0
+
+    def _ensure_loop(self):
+        import asyncio
+
+        with self._lock:
+            if self._async_loop is None:
+                loop = asyncio.new_event_loop()
+                threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="serve-replica-loop").start()
+                self._async_loop = loop
+            return self._async_loop
+
+    def _run_coroutine(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._ensure_loop()).result()
+
+    def _agen_to_gen(self, agen):
+        """Drive an async-generator deployment result from the stream
+        pump thread, one chunk at a time on the replica's loop — async
+        deployments stream exactly like sync ones."""
+        import asyncio
+
+        loop = self._ensure_loop()
+        try:
+            while True:
+                try:
+                    yield asyncio.run_coroutine_threadsafe(
+                        agen.__anext__(), loop).result()
+                except StopAsyncIteration:
+                    return
+        finally:
+            asyncio.run_coroutine_threadsafe(
+                agen.aclose(), loop).result(timeout=5)
 
     def _start_stream(self, gen):
         """Generator results stream through an actor-backed queue: the

@@ -17,6 +17,13 @@ import ray_tpu
 from ray_tpu.serve._private.long_poll import LongPollClient
 
 
+class QueueSaturatedError(TimeoutError):
+    """No replica slot freed within the queue timeout. A TimeoutError
+    subclass for caller compatibility, but distinguishable from a
+    TimeoutError raised BY a deployment — the proxy maps only THIS to
+    503 load-shedding; application timeouts stay 500s."""
+
+
 class Router:
     def __init__(self, controller, deployment_name: str,
                  max_concurrent_queries: int = 100):
@@ -71,6 +78,31 @@ class Router:
             self._in_flight[replica] = list(not_ready)
         return len(self._in_flight.get(replica, []))
 
+    def _try_assign(self, method: str, args: tuple, kwargs: dict):
+        """One round-robin dispatch attempt; returns the ref or None if
+        every replica is at its in-flight cap. On success the waiting
+        count drops under the SAME lock hold as the dispatch — counting
+        a request as both waiting and in-flight would double it in the
+        autoscaling signal."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            return None
+        n = len(replicas)
+        start = next(self._rr)
+        for i in range(n):
+            replica = replicas[(start + i) % n]
+            with self._lock:
+                load = self._prune(replica)
+                if load < self._max_concurrent:
+                    ref = replica.handle_request.remote(
+                        method, args, kwargs)
+                    self._in_flight[replica].append(ref)
+                    self._waiting -= 1
+                    self._maybe_report()
+                    return ref
+        return None
+
     def assign_request(self, method: str, args: tuple, kwargs: dict,
                        timeout: float = 30.0):
         deadline = time.monotonic() + timeout
@@ -79,28 +111,12 @@ class Router:
             self._waiting += 1
         try:
             while True:
-                with self._lock:
-                    replicas = list(self._replicas)
-                if replicas:
-                    n = len(replicas)
-                    start = next(self._rr)
-                    for i in range(n):
-                        replica = replicas[(start + i) % n]
-                        with self._lock:
-                            load = self._prune(replica)
-                            if load < self._max_concurrent:
-                                ref = replica.handle_request.remote(
-                                    method, args, kwargs)
-                                self._in_flight[replica].append(ref)
-                                # No longer waiting once dispatched —
-                                # counting both would double this
-                                # request in the autoscaling signal.
-                                self._waiting -= 1
-                                dispatched = True
-                                self._maybe_report()
-                                return ref
+                ref = self._try_assign(method, args, kwargs)
+                if ref is not None:
+                    dispatched = True
+                    return ref
                 if time.monotonic() > deadline:
-                    raise TimeoutError(
+                    raise QueueSaturatedError(
                         f"no replica available for {self._deployment} "
                         f"within {timeout}s")
                 # Saturated: no dispatch happens, but pressure must
@@ -110,6 +126,50 @@ class Router:
                 with self._lock:
                     self._maybe_report()
                 time.sleep(0.005)
+        finally:
+            if not dispatched:
+                with self._lock:
+                    self._waiting -= 1
+
+    def try_assign_request(self, method: str, args: tuple,
+                           kwargs: dict):
+        """Non-blocking dispatch: the ref if a replica slot is free
+        right now, else None. The event-loop proxy's fast path — no
+        coroutine, no parking; saturation falls back to
+        :meth:`assign_request_async`."""
+        with self._lock:
+            self._waiting += 1
+        ref = self._try_assign(method, args, kwargs)
+        if ref is None:
+            with self._lock:
+                self._waiting -= 1
+        return ref
+
+    async def assign_request_async(self, method: str, args: tuple,
+                                   kwargs: dict, timeout: float = 30.0):
+        """Event-loop completion path (the asyncio HTTP proxy's bridge):
+        identical dispatch and autoscaling accounting to
+        :meth:`assign_request`, but saturation parks the coroutine with
+        ``await asyncio.sleep`` instead of blocking the loop thread."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout
+        dispatched = False
+        with self._lock:
+            self._waiting += 1
+        try:
+            while True:
+                ref = self._try_assign(method, args, kwargs)
+                if ref is not None:
+                    dispatched = True
+                    return ref
+                if time.monotonic() > deadline:
+                    raise QueueSaturatedError(
+                        f"no replica available for {self._deployment} "
+                        f"within {timeout}s")
+                with self._lock:
+                    self._maybe_report()
+                await asyncio.sleep(0.002)
         finally:
             if not dispatched:
                 with self._lock:
@@ -167,6 +227,24 @@ class ServeHandle:
     def remote(self, *args, **kwargs):
         return self._router().assign_request(self._method or "__call__",
                                              args, kwargs)
+
+    def remote_async(self, *args, _queue_timeout_s: float = 30.0,
+                     **kwargs):
+        """Awaitable dispatch for event-loop callers (the asyncio HTTP
+        proxy): resolves to the ObjectRef once a replica slot frees,
+        without ever blocking the calling loop. ``_queue_timeout_s``
+        bounds the wait for a slot — the proxy maps its expiry to
+        ``503 Retry-After`` (load shedding, not an error)."""
+        return self._router().assign_request_async(
+            self._method or "__call__", args, kwargs,
+            timeout=_queue_timeout_s)
+
+    def try_remote(self, *args, **kwargs):
+        """Non-blocking dispatch: the ref now, or None when every
+        replica is at its cap (caller then awaits
+        :meth:`remote_async` or sheds)."""
+        return self._router().try_assign_request(
+            self._method or "__call__", args, kwargs)
 
     def __getattr__(self, name: str) -> "ServeHandle":
         if name.startswith("_"):

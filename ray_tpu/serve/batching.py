@@ -78,28 +78,68 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
     list of equal length; callers pass single items."""
 
     def decorate(fn: Callable):
-        batchers: dict = {}
-
-        @functools.wraps(fn)
-        def wrapper(*args):
-            # Methods: bind per-instance so `self` stays out of the batch.
-            if len(args) == 2 and not isinstance(args[0], (list, tuple)):
-                self_obj, item = args
-                key = id(self_obj)
-                if key not in batchers:
-                    batchers[key] = _Batcher(
-                        lambda items, s=self_obj: fn(s, items),
-                        max_batch_size, batch_wait_timeout_s)
-                return batchers[key].submit(item).result()
-            (item,) = args
-            if "fn" not in batchers:
-                batchers["fn"] = _Batcher(fn, max_batch_size,
-                                          batch_wait_timeout_s)
-            return batchers["fn"].submit(item).result()
-
-        wrapper._is_serve_batch = True
-        return wrapper
+        return _BatchWrapper(fn, max_batch_size, batch_wait_timeout_s)
 
     if _fn is not None:
         return decorate(_fn)
     return decorate
+
+
+class _BatchWrapper:
+    """The decorated callable: a descriptor, so that on a method both
+    the sync call AND ``.aio`` see the bound instance (a plain function
+    attribute would lose ``self`` for ``await self.method.aio(item)``
+    — attribute lookup on a bound method reaches the raw function)."""
+
+    _is_serve_batch = True
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float, _instance=None):
+        self._fn = fn
+        self._max_batch_size = max_batch_size
+        self._timeout_s = batch_wait_timeout_s
+        self._instance = _instance
+        self._batchers: dict = {}
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        bound = _BatchWrapper.__new__(_BatchWrapper)
+        bound.__dict__ = dict(self.__dict__)
+        bound._instance = obj
+        # Share the batcher table with the unbound wrapper: per-instance
+        # keying below keeps instances separate while repeated __get__
+        # calls reuse the same batcher (a fresh table per lookup would
+        # defeat batching entirely).
+        bound._batchers = self._batchers
+        return bound
+
+    def _submit(self, args) -> Future:
+        if self._instance is not None:
+            args = (self._instance,) + args
+        # Methods: bind per-instance so `self` stays out of the batch.
+        if len(args) == 2 and not isinstance(args[0], (list, tuple)):
+            self_obj, item = args
+            key = id(self_obj)
+            if key not in self._batchers:
+                self._batchers[key] = _Batcher(
+                    lambda items, s=self_obj: self._fn(s, items),
+                    self._max_batch_size, self._timeout_s)
+            return self._batchers[key].submit(item)
+        (item,) = args
+        if "fn" not in self._batchers:
+            self._batchers["fn"] = _Batcher(
+                self._fn, self._max_batch_size, self._timeout_s)
+        return self._batchers["fn"].submit(item)
+
+    def __call__(self, *args):
+        return self._submit(args).result()
+
+    async def aio(self, *args):
+        # Async batch wakeup: the batcher thread's set_result lands on
+        # the caller's event loop instead of blocking it — N concurrent
+        # awaiters on one loop still coalesce into one batched call.
+        import asyncio
+
+        return await asyncio.wrap_future(self._submit(args))

@@ -47,3 +47,33 @@ def iter_stream(result: Any, timeout: float = 60.0) -> Iterator[Any]:
             queue.shutdown()
         except Exception:
             pass
+
+
+async def aiter_stream(result: Any, timeout: float = 60.0):
+    """Async counterpart of :func:`iter_stream` for event-loop consumers
+    (the asyncio HTTP proxy): each chunk is awaited through the queue
+    actor's ObjectRef, so a slow generator never blocks the loop other
+    requests are running on. Same contract — pass-through for
+    non-streaming results, queue torn down on exit."""
+    if not is_stream(result):
+        yield result
+        return
+    queue = result[STREAM_KEY]
+    try:
+        while True:
+            ok, item = await queue.get_async(timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"no stream chunk within {timeout}s")
+            if isinstance(item, dict) and item.get(STREAM_END_KEY):
+                error = item.get("error")
+                if error:
+                    raise RuntimeError(
+                        f"stream failed in deployment: {error}")
+                return
+            yield item
+    finally:
+        try:
+            queue.shutdown()
+        except Exception:
+            pass

@@ -70,6 +70,42 @@ class _Rendezvous:
                 del self._rounds[seq]
             return result
 
+    # -- point-to-point (send/recv) --------------------------------------
+    # Reference `util/collective/collective.py:541-615`: only the two
+    # endpoint ranks participate, so p2p traffic rides its own mailbox
+    # keyed by (src, dst, per-pair seq) — it never perturbs the
+    # group-wide round sequencing.
+
+    def p2p_put(self, key, value, timeout: float = 60.0):
+        with self._lock:
+            self._p2p().setdefault(key, {})["value"] = value
+            self._lock.notify_all()
+            ok = self._lock.wait_for(
+                lambda: self._p2p().get(key, {}).get("taken"),
+                timeout=timeout)
+            if not ok:
+                self._p2p().pop(key, None)
+                raise TimeoutError(f"send {key}: receiver never arrived")
+            self._p2p().pop(key, None)
+            return True
+
+    def p2p_get(self, key, timeout: float = 60.0):
+        with self._lock:
+            ok = self._lock.wait_for(
+                lambda: "value" in self._p2p().get(key, {}),
+                timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"recv {key}: sender never arrived")
+            slot = self._p2p()[key]
+            slot["taken"] = True
+            self._lock.notify_all()
+            return slot["value"]
+
+    def _p2p(self) -> dict:
+        if not hasattr(self, "_p2p_slots"):
+            self._p2p_slots = {}
+        return self._p2p_slots
+
 
 
 
@@ -122,10 +158,20 @@ class _GroupState:
         self.actor = actor
         self.shard_actors = shard_actors or []
         self.seq = 0
+        # Per-peer p2p sequence counters, independent per direction:
+        # sends to (and recvs from) each peer match up in program order
+        # without touching the group-wide collective sequencing.
+        self.p2p_seq: Dict[Any, int] = {}
 
     def next_seq(self) -> int:
         s = self.seq
         self.seq += 1
+        return s
+
+    def next_p2p_seq(self, src: int, dst: int) -> int:
+        key = (src, dst)
+        s = self.p2p_seq.get(key, 0)
+        self.p2p_seq[key] = s + 1
         return s
 
 
@@ -231,6 +277,63 @@ def reducescatter(tensor, group_name: str = "default",
 
 def barrier(group_name: str = "default") -> None:
     _call(group_name, 0, "barrier")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         timeout: float = 60.0) -> None:
+    """Point-to-point send to ``dst_rank`` (reference:
+    `util/collective/collective.py:541` `send`). Blocks until the
+    matching :func:`recv` takes the value — NCCL-like rendezvous
+    semantics, so a send with no receiver surfaces as a timeout rather
+    than silently buffering."""
+    st = _groups().get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized on this "
+            "worker; call init_collective_group first")
+    if dst_rank == st.rank:
+        raise ValueError("cannot send to self")
+    if not 0 <= dst_rank < st.world_size:
+        raise ValueError(f"dst_rank {dst_rank} out of range "
+                         f"[0, {st.world_size})")
+    seq = st.next_p2p_seq(st.rank, dst_rank)
+    try:
+        ray_tpu.get(st.actor.p2p_put.remote(
+            (st.rank, dst_rank, seq), np.asarray(tensor), timeout))
+    except BaseException:
+        # Roll back so a timed-out send can be retried without
+        # permanently desyncing the pair's sequence numbers.
+        st.p2p_seq[(st.rank, dst_rank)] -= 1
+        raise
+
+
+def recv(tensor, src_rank: int, group_name: str = "default",
+         timeout: float = 60.0):
+    """Point-to-point receive from ``src_rank`` (reference:
+    `util/collective/collective.py:590` `recv`): fills ``tensor``
+    in place when it's a writable ndarray of matching shape (the
+    reference's contract) and also returns the received array."""
+    st = _groups().get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized on this "
+            "worker; call init_collective_group first")
+    if src_rank == st.rank:
+        raise ValueError("cannot recv from self")
+    if not 0 <= src_rank < st.world_size:
+        raise ValueError(f"src_rank {src_rank} out of range "
+                         f"[0, {st.world_size})")
+    seq = st.next_p2p_seq(src_rank, st.rank)
+    try:
+        value = np.asarray(ray_tpu.get(st.actor.p2p_get.remote(
+            (src_rank, st.rank, seq), timeout)))
+    except BaseException:
+        st.p2p_seq[(src_rank, st.rank)] -= 1
+        raise
+    if isinstance(tensor, np.ndarray) and tensor.shape == value.shape \
+            and tensor.flags.writeable:
+        np.copyto(tensor, value)
+    return value
 
 
 def allreduce_pytree(tree, group_name: str = "default",

@@ -101,5 +101,12 @@ class Queue:
     def put_async(self, item):
         return self.actor.put.remote(item, 1e9)
 
+    def get_async(self, timeout: Optional[float] = None):
+        """ObjectRef resolving to ``(ok, item)`` — awaitable from
+        asyncio code (``ok`` False on timeout). The event-loop
+        counterpart of :meth:`get` for consumers that must not block
+        their loop (the HTTP proxy's SSE stream pump)."""
+        return self.actor.get.remote(timeout or 1e9)
+
     def shutdown(self):
         ray_tpu.kill(self.actor)

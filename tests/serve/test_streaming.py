@@ -55,6 +55,73 @@ def test_stream_error_propagates(serve_up):
         list(it)
 
 
+def test_async_deployment_unary_and_stream(serve_up):
+    """Async deployments run on the replica's persistent loop: an async
+    unary method resolves normally, an async-generator result streams
+    like a sync generator — to Python callers and over HTTP SSE."""
+
+    @serve.deployment
+    class AsyncMixed:
+        async def __call__(self, request):
+            if isinstance(request, dict) and request.get("stream"):
+                async def agen():
+                    for i in range(4):
+                        yield {"i": i}
+                return agen()
+            return {"unary": request}
+
+    handle = serve.run(AsyncMixed.bind(), route_prefix="/amixed")
+
+    out = ray_tpu.get(handle.remote({"x": 1}), timeout=60)
+    assert out == {"unary": {"x": 1}}
+
+    result = ray_tpu.get(handle.remote({"stream": True}), timeout=60)
+    assert serve.is_stream(result)
+    chunks = list(serve.iter_stream(result))
+    assert [c["i"] for c in chunks] == [0, 1, 2, 3]
+
+    proxy = serve.start_http_proxy()
+    conn = http.client.HTTPConnection(proxy.host, proxy.port, timeout=30)
+    conn.request("POST", "/amixed", body=json.dumps({"stream": True}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.headers.get("Content-Type") == "text/event-stream"
+    body = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        body += chunk
+        if b"[DONE]" in body:
+            break
+    conn.close()
+    assert body.count(b"data: ") == 5  # 4 chunks + [DONE]
+
+
+def test_aiter_stream_async_consumer(serve_up):
+    """serve.aiter_stream: the event-loop counterpart of iter_stream
+    (what the asyncio proxy uses) yields the same chunks."""
+    import asyncio
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, request):
+            def gen():
+                for i in range(5):
+                    yield {"i": i}
+            return gen()
+
+    handle = serve.run(Streamer.bind(), route_prefix="/as1")
+    result = ray_tpu.get(handle.remote({}), timeout=60)
+    assert serve.is_stream(result)
+
+    async def consume():
+        return [c async for c in serve.aiter_stream(result)]
+
+    chunks = asyncio.run(consume())
+    assert [c["i"] for c in chunks] == [0, 1, 2, 3, 4]
+
+
 def test_http_sse_streams_incrementally(serve_up):
     """Chunks arrive over HTTP while the generator is still producing —
     the first data line lands well before the slow tail finishes."""
