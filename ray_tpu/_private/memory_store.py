@@ -39,6 +39,29 @@ class _Entry:
     spilled_url: Optional[str] = None
 
 
+class _WaitGroup:
+    """One completion-event subscriber shared across a whole wait() call
+    (the completion-event queue role of the reference's memory-store
+    GetAsync path): entries signal it as they resolve, and it fires once
+    the countdown hits zero. Replaces the old per-ref callback + shared
+    condition scheme, whose cost was O(refs) lock/condvar round trips per
+    wait even when every ref was already resolved."""
+
+    __slots__ = ("event", "_needed", "_lock")
+
+    def __init__(self, needed: int):
+        self.event = threading.Event()
+        self._needed = needed
+        self._lock = threading.Lock()
+
+    def on_ready(self, _object_id) -> None:
+        with self._lock:
+            self._needed -= 1
+            if self._needed > 0:
+                return
+        self.event.set()
+
+
 class MemoryStore:
     def __init__(self, spill_manager=None):
         # RLock: ObjectRef.__del__ can fire from GC while this process holds
@@ -160,34 +183,76 @@ class MemoryStore:
 
         Returns (ready, not_ready) preserving input order, matching the
         semantics of ``ray.wait`` (reference ``_private/worker.py:2565``).
+        Event-driven: one lock pass snapshots what is already resolved;
+        only unresolved entries get a (single, shared) completion
+        subscriber, so a wait over N resolved refs costs one lock
+        acquisition, not N callback registrations.
         """
-        deadline = None if timeout is None else time.monotonic() + timeout
-        cond = threading.Condition()
-        ready_set: set[ObjectID] = set()
-
-        def _on_ready(oid: ObjectID):
-            with cond:
-                ready_set.add(oid)
-                cond.notify_all()
-
-        for oid in object_ids:
-            self.on_ready(oid, _on_ready)
-
-        with cond:
-            while len(ready_set) < min(num_returns, len(object_ids)):
-                remaining = None
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                cond.wait(remaining)
-            # At most num_returns ready refs are returned (ray.wait
-            # contract); extras stay in not_ready even if resolved.
+        target = min(num_returns, len(object_ids))
+        group: Optional[_WaitGroup] = None
+        entries = self._entries
+        with self._lock:
+            ready = []
+            unresolved: list[ObjectID] = []
+            for oid in object_ids:
+                entry = entries.get(oid)
+                if entry is not None and entry.ready:
+                    ready.append(oid)
+                else:
+                    unresolved.append(oid)
+            if len(ready) < target and (timeout is None or timeout > 0):
+                group = _WaitGroup(target - len(ready))
+                for oid in unresolved:
+                    self._entry(oid).callbacks.append(group.on_ready)
+        if group is not None:
+            group.event.wait(timeout)
+            # Re-snapshot: completions that raced the wakeup count.
+            with self._lock:
+                ready_set = {
+                    oid for oid in object_ids
+                    if (e := entries.get(oid)) is not None and e.ready
+                }
             ready = [oid for oid in object_ids if oid in ready_set]
+        # At most num_returns ready refs are returned (ray.wait
+        # contract); extras stay in not_ready even if resolved.
+        if len(ready) > num_returns:
             ready = ready[:num_returns]
+        if not unresolved and len(ready) == len(object_ids):
+            return ready, []
         ready_out = set(ready)
         not_ready = [oid for oid in object_ids if oid not in ready_out]
         return ready, not_ready
+
+    def get_many(self, object_ids: list[ObjectID],
+                 timeout: Optional[float] = None) -> list:
+        """Values for every id, in order. One lock pass serves the
+        already-resolved plain entries (the fan-out-get hot path:
+        ``get([N refs])`` after completion was N lock+event round trips);
+        pending, errored, or spilled entries fall back to the blocking
+        per-object ``get`` under a shared deadline."""
+        values = [None] * len(object_ids)
+        slow: list[int] = []
+        now = time.monotonic()
+        with self._lock:
+            for i, oid in enumerate(object_ids):
+                entry = self._entries.get(oid)
+                if entry is not None and entry.ready \
+                        and entry.error is None \
+                        and not (entry.spilled_url is not None
+                                 and entry.value is None):
+                    values[i] = entry.value
+                    entry.last_access = now
+                else:
+                    slow.append(i)
+        if slow:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            for i in slow:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.0, deadline - time.monotonic())
+                values[i] = self.get(object_ids[i], remaining)
+        return values
 
     # -- spilling hooks (called by SpillManager) --------------------------
 

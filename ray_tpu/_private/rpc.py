@@ -424,6 +424,153 @@ class RemoteCallError(RuntimeError):
     pass
 
 
+class LruTable:
+    """Tiny bounded LRU mapping for the interned-template protocol's two
+    ends (the head's per-node claim set, the node's template cache).
+    Both sides see the same ordered stream of register/reference events
+    over one pipelined channel and use the same touch discipline, so —
+    with the receiver sized LARGER than the claimer — a claimed id is
+    present on the receiver; a claim evicted here is simply re-shipped."""
+
+    __slots__ = ("_d", "_cap")
+
+    def __init__(self, capacity: int):
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._cap = capacity
+
+    def __contains__(self, key) -> bool:
+        if key in self._d:
+            self._d.move_to_end(key)
+            return True
+        return False
+
+    def get(self, key, default=None):
+        if key in self._d:
+            self._d.move_to_end(key)
+            return self._d[key]
+        return default
+
+    def add(self, key, value=True) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+
+    def discard(self, key) -> None:
+        self._d.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+def batched_object_read(get_object: Callable, oids, timeout: float = 30.0):
+    """Shared server-side loop for get_objects_batch handlers (head and
+    node expose the same RPC): one deadline covers the whole set;
+    ``get_object(oid, remaining) -> (ok, value, error)`` is the
+    per-object read."""
+    deadline = time.monotonic() + timeout
+    out = []
+    for oid in oids:
+        remaining = max(0.0, deadline - time.monotonic())
+        out.append(list(get_object(oid, remaining)))
+    return out
+
+
+class CoalescingBatcher:
+    """Group-commit frontend for a streaming channel: producers append
+    items without blocking (until the bounded queue fills — the
+    backpressure boundary); a flusher thread drains EVERYTHING
+    accumulated per cycle into one frame via ``send_frame(items)``.
+
+    There is deliberately no timer: an idle channel's first item
+    flushes immediately, and while a frame is being serialized/sent
+    (or the peer's socket pushes back), new items pile up and ride the
+    next frame — the busier the channel, the bigger the batches
+    (flush-on-idle group commit, the reference's submission-pipelining
+    shape). ``send_frame`` must handle its own failures; an exception
+    it raises is routed to ``on_error(items, exc)`` and never kills the
+    flusher. NB items are handed to send_frame strictly in add order,
+    but a caller needing cross-CHANNEL ordering (e.g. a synchronous RPC
+    that must observe prior submissions) must ``flush()`` first."""
+
+    def __init__(self, send_frame: Callable, name: str = "batcher",
+                 on_error: Optional[Callable] = None,
+                 max_items_per_frame: int = 1024,
+                 capacity: int = 16384):
+        self._send_frame = send_frame
+        self._on_error = on_error
+        self._max_items = max_items_per_frame
+        self._capacity = capacity
+        self._items: list = []
+        self._cond = threading.Condition()
+        self._in_flight = 0          # frames currently being sent
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"rpc-batch-{name}")
+        self._thread.start()
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._closed:
+                raise ConnectionError("batcher closed")
+            while len(self._items) >= self._capacity:
+                self._cond.wait(1.0)  # backpressure: queue at capacity
+                if self._closed:
+                    raise ConnectionError("batcher closed")
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._items and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._items:
+                    return  # drained: flusher retires
+                batch = self._items[:self._max_items]
+                del self._items[:self._max_items]
+                self._in_flight += 1
+                self._cond.notify_all()
+            try:
+                self._send_frame(batch)
+            except BaseException as e:  # noqa: BLE001 — surfaced per batch
+                if self._on_error is not None:
+                    try:
+                        self._on_error(batch, e)
+                    except Exception:
+                        pass
+            finally:
+                with self._cond:
+                    self._in_flight -= 1
+                    self._cond.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every added item has been handed to send_frame
+        AND those frames' sends returned (not necessarily acknowledged
+        by the peer — see the underlying channel's own flush for that)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._items or self._in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    @property
+    def backlog(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def close(self) -> None:
+        """Stop accepting items; the flusher drains what was already
+        added, then retires (a dropped channel must not leak one parked
+        thread per reconnect cycle)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
 class PipelinedClient:
     """Streaming request channel: callers enqueue requests WITHOUT
     waiting for replies; a reader thread drains them in order and hands
@@ -491,6 +638,14 @@ class PipelinedClient:
                 with self._pending_lock:
                     self._pending.pop(self._seq, None)
                 self._teardown()
+                raise
+            except BaseException:
+                # Encode failure (unpicklable payload): nothing reached
+                # the wire, so the connection is fine — but the pending
+                # entry MUST go, or every later reply pops the wrong
+                # request (ack/tag desync).
+                with self._pending_lock:
+                    self._pending.pop(self._seq, None)
                 raise
             return rid
 

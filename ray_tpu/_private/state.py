@@ -149,6 +149,24 @@ class GlobalState:
         with self._lock:
             return dict(self._placement_groups)
 
+    # -- storage lifecycle ----------------------------------------------
+
+    def flush_storage(self) -> None:
+        """Force deferred durable writes to disk (group-commit drain).
+        Called at graceful teardown boundaries — worker shutdown, head
+        failover handoff — so a successor process's fresh store
+        connection sees everything this one accepted."""
+        try:
+            self._store.flush()
+        except Exception:
+            pass
+
+    def close_storage(self) -> None:
+        try:
+            self._store.close()
+        except Exception:
+            pass
+
     # -- cluster introspection -------------------------------------------
 
     def nodes(self) -> list:

@@ -9,7 +9,9 @@ scheduling strategy.
 
 from __future__ import annotations
 
+import collections
 import enum
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
@@ -125,6 +127,12 @@ class TaskSpec:
     # from the submitting task (reference: tracing_helper.py span
     # context in task metadata).
     trace_parent: Optional[tuple] = None
+    # Content hash of the interned SpecTemplate this spec was built
+    # from, when it was (see intern_template). The cluster wire path
+    # ships the template once per node and then references it by this
+    # id, so a steady stream of same-shape submissions carries only
+    # args + a small header.
+    template_id: Optional[bytes] = None
 
     def assign_return_ids(self) -> list[ObjectID]:
         """Populate ``return_ids`` from ``num_returns`` and return them.
@@ -189,6 +197,182 @@ class TaskSpec:
         if self.kind == TaskKind.ACTOR_TASK:
             return f"{self.name} (actor={self.actor_id})"
         return f"{self.name} ({self.task_id.hex()[:8]})"
+
+
+# ---------------------------------------------------------------------------
+# Spec-template interning (control-plane fast path)
+# ---------------------------------------------------------------------------
+#
+# Every .remote() call used to rebuild the full invariant slice of its
+# TaskSpec — option validation, resource normalization, strategy
+# construction — and, in cluster mode, re-serialize all of it per call.
+# A SpecTemplate captures that invariant slice ONCE per (callable,
+# options) pair, keyed by a content hash, mirroring the reference
+# core-worker's serialize-once TaskSpec handling: per-call work shrinks
+# to args + a small header referencing the template by id.
+
+
+@dataclass
+class SpecTemplate:
+    """The invariant-across-calls slice of a TaskSpec."""
+
+    kind: TaskKind
+    func: Any
+    name: str
+    num_returns: "int | str"
+    resources: Dict[str, float]
+    milli: Dict[str, int]                 # precomputed to_milli(resources)
+    max_retries: int = 3
+    retry_exceptions: Any = False
+    scheduling_strategy: SchedulingStrategy = None
+    runtime_env: Optional[dict] = None
+    isolate_process: Any = False
+    func_id: Optional[bytes] = None
+    # Actor-creation extras (unused for NORMAL_TASK / ACTOR_TASK).
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None
+    max_pending_calls: int = -1
+    template_id: bytes = b""
+
+    def make_spec(self, task_id: TaskID, args: tuple, kwargs: dict,
+                  depth: int = 0, trace_parent: Optional[tuple] = None,
+                  actor_id: Optional[ActorID] = None,
+                  sequence_number: int = 0,
+                  num_returns: "int | str | None" = None) -> TaskSpec:
+        """Per-call spec construction: only the varying fields are new."""
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=self.kind,
+            func=self.func,
+            args=args,
+            kwargs=kwargs,
+            name=self.name,
+            num_returns=self.num_returns if num_returns is None
+            else num_returns,
+            resources=self.resources,
+            max_retries=self.max_retries,
+            retry_exceptions=self.retry_exceptions,
+            scheduling_strategy=self.scheduling_strategy,
+            actor_id=actor_id,
+            max_restarts=self.max_restarts,
+            max_task_retries=self.max_task_retries,
+            max_concurrency=self.max_concurrency,
+            actor_name=self.actor_name,
+            namespace=self.namespace,
+            lifetime=self.lifetime,
+            max_pending_calls=self.max_pending_calls,
+            sequence_number=sequence_number,
+            runtime_env=self.runtime_env,
+            isolate_process=self.isolate_process,
+            func_id=self.func_id,
+            depth=depth,
+            trace_parent=trace_parent,
+            template_id=self.template_id,
+        )
+        # The scheduler's demand conversion, computed once at intern time.
+        spec._milli_cache = self.milli
+        return spec
+
+
+# Content hash -> template. Interning is by content, so identical
+# definitions (same function bytes, same options) share one entry and a
+# REdefinition (new body under an old name) can never hit a stale one —
+# its func_id, and therefore its template_id, differs. Bounded LRU: a
+# driver minting remote functions dynamically (each closure hashes
+# differently) must not pin every captured environment forever —
+# evicted entries are safe, since live handles hold their template
+# strongly and the cluster wire path falls back to full-spec shipping
+# on an intern miss.
+_TEMPLATES: "collections.OrderedDict[bytes, SpecTemplate]" = \
+    collections.OrderedDict()
+_TEMPLATES_MAX = 4096
+_TEMPLATES_LOCK = threading.Lock()
+
+
+def _strategy_key(strategy) -> str:
+    if strategy is None:
+        return "None"
+    from dataclasses import fields as _fields
+
+    parts = [type(strategy).__name__]
+    for f in _fields(strategy):
+        parts.append(f"{f.name}={getattr(strategy, f.name)!r}")
+    return ":".join(parts)
+
+
+def intern_template(*, kind: TaskKind, func: Any, name: str,
+                    num_returns, resources: Dict[str, float],
+                    func_id: Optional[bytes] = None,
+                    **invariants) -> SpecTemplate:
+    """Build (or reuse) the interned template for one callable + option
+    set. The content hash covers the function identity (func_id — the
+    sha1 of its cloudpickle — when exportable, else a per-object token)
+    and every invariant field, so equal content dedupes and changed
+    content gets a fresh id."""
+    import hashlib
+
+    from ray_tpu._private.resources import to_milli
+
+    if func_id:
+        fn_key = func_id.hex()
+    elif isinstance(func, str):
+        fn_key = f"method:{func}"   # actor method: the name IS the content
+    else:
+        fn_key = f"local:{id(func)}"
+    h = hashlib.sha1()
+    h.update(repr((
+        kind.value, fn_key, name, num_returns,
+        sorted(resources.items()),
+        invariants.get("max_retries", 3),
+        repr(invariants.get("retry_exceptions", False)),
+        _strategy_key(invariants.get("scheduling_strategy")),
+        repr(invariants.get("runtime_env")),
+        repr(invariants.get("isolate_process", False)),
+        invariants.get("max_restarts", 0),
+        invariants.get("max_task_retries", 0),
+        invariants.get("max_concurrency", 1),
+        invariants.get("actor_name"),
+        invariants.get("namespace"),
+        invariants.get("lifetime"),
+        invariants.get("max_pending_calls", -1),
+    )).encode())
+    tid = h.digest()
+    with _TEMPLATES_LOCK:
+        tpl = _TEMPLATES.get(tid)
+        if tpl is None or tpl.func is not func:
+            # Same content but a distinct (equal-bytes) function object:
+            # reuse the id, refresh the callable so local execution uses
+            # the live object.
+            tpl = SpecTemplate(
+                kind=kind, func=func, name=name, num_returns=num_returns,
+                resources=resources, milli=to_milli(resources),
+                func_id=func_id, template_id=tid, **invariants)
+        _TEMPLATES[tid] = tpl
+        _TEMPLATES.move_to_end(tid)
+        while len(_TEMPLATES) > _TEMPLATES_MAX:
+            _TEMPLATES.popitem(last=False)
+    return tpl
+
+
+def get_template(template_id: bytes) -> Optional[SpecTemplate]:
+    with _TEMPLATES_LOCK:
+        tpl = _TEMPLATES.get(template_id)
+        if tpl is not None:
+            _TEMPLATES.move_to_end(template_id)
+        return tpl
+
+
+def register_template(tpl: SpecTemplate) -> None:
+    """Install a template received over the wire (node side)."""
+    with _TEMPLATES_LOCK:
+        _TEMPLATES[tpl.template_id] = tpl
+        _TEMPLATES.move_to_end(tpl.template_id)
+        while len(_TEMPLATES) > _TEMPLATES_MAX:
+            _TEMPLATES.popitem(last=False)
 
 
 @dataclass

@@ -332,3 +332,31 @@ class ResourceReport:
     available: dict = None
     labels: dict = None
     stats: dict = None
+
+
+@message("task.Template", version=1)
+class TaskTemplate:
+    """First shipment of an interned spec template to a node: the full
+    invariant slice (SpecTemplate, cloudpickled — it carries the user
+    function) plus its content-hash id. Subsequent submissions of the
+    same shape reference the id via TaskCall."""
+
+    template_id: bytes = b""
+    payload: Any = None    # Opaque(SpecTemplate)
+
+
+@message("task.Call", version=1)
+class TaskCall:
+    """One task submission against an interned template: only the
+    per-call fields travel. num_returns rides along (redundant with the
+    template) so the receiver can fail THIS call into its return
+    objects even when the template is missing."""
+
+    template_id: bytes = b""
+    task_id: bytes = b""
+    args: Any = None           # Opaque(tuple) — may contain ObjectRefs
+    kwargs: Any = None         # Opaque(dict)
+    num_returns: Any = 1       # int | "dynamic"
+    depth: int = 0
+    trace_parent: Any = None   # (trace_id_hex, parent_span_id_hex) | None
+    max_retries: int = 3

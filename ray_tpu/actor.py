@@ -15,11 +15,11 @@ from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    intern_template,
     trace_parent_from,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
-    TaskSpec,
 )
 
 _ACTOR_OPTIONS = {
@@ -65,6 +65,10 @@ class ActorHandle:
         self._max_task_retries = max_task_retries
         self._seq_lock = threading.Lock()
         self._seq = 0
+        # (method_name, num_returns) -> interned SpecTemplate: method
+        # calls pay only per-call fields (args, seq, trace) after the
+        # first submission through this handle.
+        self._method_templates: dict = {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -80,16 +84,20 @@ class ActorHandle:
         with self._seq_lock:
             self._seq += 1
             seq = self._seq
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
-            kind=TaskKind.ACTOR_TASK,
-            func=method_name,
-            args=args,
-            kwargs=kwargs,
-            name=f"{self._cls.__name__}.{method_name}",
-            num_returns=num_returns,
-            resources={},
-            max_retries=self._max_task_retries,
+        key = (method_name, num_returns)
+        tpl = self._method_templates.get(key)
+        if tpl is None:
+            tpl = intern_template(
+                kind=TaskKind.ACTOR_TASK,
+                func=method_name,
+                name=f"{self._cls.__name__}.{method_name}",
+                num_returns=num_returns,
+                resources={},
+                max_retries=self._max_task_retries,
+            )
+            self._method_templates[key] = tpl
+        spec = tpl.make_spec(
+            TaskID.from_random(), args, kwargs,
             actor_id=self._actor_id,
             sequence_number=seq,
             trace_parent=(trace_parent_from(_ctx["task_spec"])
@@ -116,6 +124,7 @@ class ActorClass:
             raise ValueError(f"Invalid @remote options for an actor: {sorted(bad)}")
         self._cls = cls
         self._default_options = default_options
+        self._template = None  # interned creation-spec slice (first .remote())
         self.__name__ = cls.__name__
 
     def __call__(self, *a, **kw):
@@ -140,38 +149,43 @@ class ActorClass:
                 return w.gcs.get_named_actor(name, namespace)
             except ValueError:
                 pass
-        # Actors default to 0 CPU for lifetime (1 CPU only during creation in
-        # the reference; we hold the declared request for the lifetime).
-        resources = normalize_request(
-            num_cpus=opts.get("num_cpus"),
-            num_tpus=opts.get("num_tpus"),
-            num_gpus=opts.get("num_gpus"),
-            memory=opts.get("memory"),
-            resources=opts.get("resources"),
-            default_cpus=0.0,
-        )
-        strategy = opts.get("scheduling_strategy") or DefaultSchedulingStrategy()
+        tpl = self._template
+        if tpl is None:
+            # Actors default to 0 CPU for lifetime (1 CPU only during
+            # creation in the reference; we hold the declared request for
+            # the lifetime).
+            resources = normalize_request(
+                num_cpus=opts.get("num_cpus"),
+                num_tpus=opts.get("num_tpus"),
+                num_gpus=opts.get("num_gpus"),
+                memory=opts.get("memory"),
+                resources=opts.get("resources"),
+                default_cpus=0.0,
+            )
+            strategy = opts.get("scheduling_strategy") or \
+                DefaultSchedulingStrategy()
+            tpl = self._template = intern_template(
+                kind=TaskKind.ACTOR_CREATION,
+                func=self._cls,
+                name=f"{self._cls.__name__}.__init__",
+                num_returns=1,
+                resources=resources,
+                max_restarts=opts.get("max_restarts", 0),
+                max_task_retries=opts.get("max_task_retries", 0),
+                max_concurrency=opts.get("max_concurrency", 1),
+                actor_name=name,
+                namespace=namespace,
+                lifetime=opts.get("lifetime"),
+                max_pending_calls=opts.get("max_pending_calls", -1),
+                scheduling_strategy=strategy,
+                runtime_env=opts.get("runtime_env"),
+                isolate_process=check_isolate_process(
+                    opts.get("isolate_process", False)),
+            )
         actor_id = ActorID.from_random()
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
-            kind=TaskKind.ACTOR_CREATION,
-            func=self._cls,
-            args=args,
-            kwargs=kwargs,
-            name=f"{self._cls.__name__}.__init__",
-            num_returns=1,
-            resources=resources,
+        spec = tpl.make_spec(
+            TaskID.from_random(), args, kwargs,
             actor_id=actor_id,
-            max_restarts=opts.get("max_restarts", 0),
-            max_task_retries=opts.get("max_task_retries", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
-            actor_name=name,
-            namespace=namespace,
-            lifetime=opts.get("lifetime"),
-            max_pending_calls=opts.get("max_pending_calls", -1),
-            scheduling_strategy=strategy,
-            runtime_env=opts.get("runtime_env"),
-            isolate_process=check_isolate_process(opts.get("isolate_process", False)),
             trace_parent=(trace_parent_from(_ctx["task_spec"])
                           if (_ctx := w.task_context.current()) else None),
         )

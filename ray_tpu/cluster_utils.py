@@ -94,6 +94,57 @@ def _try_transfer_fetch(worker, oid, loc_info) -> bool:
         return False
 
 
+def batch_fetch_objects(worker, oids, locate, self_address):
+    """Shared batched-pull core (driver fetch dispatcher + node dep
+    fetch): local/shm probes per object, ONE ``locate(need)`` call for
+    the rest, transfer-plane pull where possible, then one
+    ``get_objects_batch`` RPC per owner. Returns ``(resolved set,
+    failed {oid: exc}, unresolved list)`` — unresolved objects simply
+    aren't anywhere yet (slow producer) and are the caller's to retry.
+    """
+    store = worker.memory_store
+    resolved: set = set()
+    failed: Dict[Any, Exception] = {}
+    unresolved: list = []
+    need = []
+    for oid in oids:
+        if store.contains(oid) or _try_shm_fetch(worker, oid):
+            resolved.add(oid)
+        else:
+            need.append(oid)
+    if not need:
+        return resolved, failed, unresolved
+    infos = locate(need)
+    by_addr: Dict[tuple, list] = {}
+    for oid, info in zip(need, infos):
+        if info is not None and tuple(info["address"]) != tuple(self_address):
+            if _try_transfer_fetch(worker, oid, info):
+                resolved.add(oid)
+            else:
+                by_addr.setdefault(tuple(info["address"]), []).append(oid)
+        elif store.contains(oid):
+            resolved.add(oid)
+        else:
+            unresolved.append(oid)
+    for addr, group in by_addr.items():
+        try:
+            replies = RpcClient.to(addr).call(
+                "get_objects_batch",
+                oids=[o.binary() for o in group], timeout=10.0)
+        except Exception as e:
+            for oid in group:
+                failed[oid] = e
+            continue
+        for oid, reply in zip(group, replies):
+            ok, value, err = reply
+            if ok:
+                store.put(oid, value, error=err)
+                resolved.add(oid)
+            else:
+                unresolved.append(oid)
+    return resolved, failed, unresolved
+
+
 class _NodeRecord:
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float],
@@ -126,6 +177,14 @@ class _NodeRecord:
         # Function-ids whose definitions this node has already received
         # (function-distribution cache; see _strip_exported_func).
         self.known_fns: set = set()
+        # Interned spec-template ids this node has received: later
+        # submissions of the same shape ship as small TaskCall headers.
+        # LRU-bounded at HALF the node cache's capacity, so an id still
+        # claimed here cannot have been evicted node-side; an id evicted
+        # HERE is simply re-shipped on next use.
+        from ray_tpu._private.rpc import LruTable
+
+        self.known_templates = LruTable(4096)
 
 
 class ClusterHead:
@@ -182,7 +241,9 @@ class ClusterHead:
             "remove_borrowers": self._remove_borrowers,
             "locate": self._locate,
             "locate2": self._locate2,
+            "locate_batch": self._locate_batch,
             "get_object": self._get_object,
+            "get_objects_batch": self._get_objects_batch,
             "get_nodes": self._get_nodes,
             "subscribe": self._subscribe,
             # Typed GCS accessor surface (reference gcs_client.h:61):
@@ -612,6 +673,18 @@ class ClusterHead:
             time.sleep(0.005)
         return False, None, None
 
+    def _locate_batch(self, oids):
+        """One RPC locates a whole dependency set (batched arg-fetch:
+        the per-arg locate round trips were the forced-remote dispatch
+        tax)."""
+        return [self._locate2(oid) for oid in oids]
+
+    def _get_objects_batch(self, oids, timeout: float = 30.0):
+        from ray_tpu._private.rpc import batched_object_read
+
+        return batched_object_read(
+            lambda oid, t: self._get_object(oid, timeout=t), oids, timeout)
+
     def _route_task(self, spec) -> bool:
         """Submit a node-originated spec through the head's cluster
         backend (which knows where every actor lives); results travel
@@ -703,6 +776,12 @@ class ClusterBackendMixin:
         self._leases: Dict[tuple, list] = {}
         self._lease_lock = threading.Lock()
         self._pipes: Dict[str, Any] = {}  # node_id -> PipelinedClient
+        # node_id -> CoalescingBatcher feeding that node's pipe with
+        # submit_batch frames (batched control RPC: many submissions,
+        # one framed request + one server dispatch), plus the per-node
+        # lock making template-claim + enqueue atomic.
+        self._batchers: Dict[str, Any] = {}
+        self._submit_locks: Dict[str, Any] = {}
         # (node_id, oid) pairs already pushed (push_manager dedupe).
         self._pushed: set = set()
 
@@ -928,21 +1007,152 @@ class ClusterBackendMixin:
         # Same bookkeeping as _send: lineage + inflight BEFORE the wire.
         self.head.record_lineage(spec)
         self.head.record_inflight(spec, lease["node_id"])
-        wire_spec = self._strip_exported_func(spec, record)
-        try:
-            lease["pipe"].send("submit_task", tag=(spec, lease),
-                               spec=wire_spec)
-            return True
-        except (ConnectionError, OSError):
-            self.head.clear_inflight(spec)
+        # Coalesced, non-blocking enqueue: the node's batcher drains
+        # whatever accumulates while the previous frame is on the wire
+        # into ONE submit_batch request. Transport failures surface
+        # asynchronously (frame-send fallback / _pipe_error) and
+        # re-route through submit() — by then this task is recorded
+        # in-flight, so no completion can be lost. The template claim
+        # and the enqueue happen under ONE per-node lock: a racing
+        # submitter that observes the claim must enqueue BEHIND the
+        # claiming item, or its call-only header could reach the node
+        # first and hit UnknownTemplate.
+        node_id = lease["node_id"]
+        for _attempt in range(2):
+            with self._submit_lock_for(node_id):
+                call, templates = self._wire_item_for(spec, record)
+                try:
+                    self._batcher_for(node_id, lease["pipe"]).add(
+                        (call, templates, spec, lease))
+                    return True
+                except ConnectionError:
+                    # Batcher closed by a concurrent pipe drop: unwind
+                    # the claim and retry once with a fresh batcher.
+                    for t in templates:
+                        record.known_templates.discard(t.template_id)
+                    continue
+        self.head.clear_inflight(spec)
+        return False
+
+    def _submit_lock_for(self, node_id: str):
+        lock = self._submit_locks.get(node_id)
+        if lock is None:
             with self._lease_lock:
-                self._pipes.pop(lease["node_id"], None)
-                for ls in self._leases.values():
+                lock = self._submit_locks.setdefault(node_id,
+                                                     threading.Lock())
+        return lock
+
+    def _batcher_for(self, node_id: str, pipe):
+        batcher = self._batchers.get(node_id)
+        if batcher is None:
+            with self._lease_lock:
+                batcher = self._batchers.get(node_id)
+                if batcher is None:
+                    from ray_tpu._private.rpc import CoalescingBatcher
+
+                    batcher = CoalescingBatcher(
+                        lambda batch, nid=node_id, p=pipe:
+                        self._send_submit_frame(nid, p, batch),
+                        name=f"submit-{node_id}")
+                    self._batchers[node_id] = batcher
+        return batcher
+
+    def _wire_item_for(self, spec, record: "_NodeRecord"):
+        """The wire form of one submission: a TaskCall header against an
+        interned template (plus the template itself on its first trip to
+        this node), or the full spec for shapes that can't intern
+        (actor tasks, unexportable functions)."""
+        from ray_tpu._private import wire
+        from ray_tpu._private.task_spec import get_template
+
+        if spec.kind == TaskKind.NORMAL_TASK and spec.template_id \
+                and spec.func_id:
+            tpl = get_template(spec.template_id)
+            if tpl is not None:
+                templates = []
+                if spec.template_id not in record.known_templates:
+                    # Claimed optimistically; racing submitters may ship
+                    # the template twice, which registers idempotently.
+                    record.known_templates.add(spec.template_id)
+                    templates.append(wire.TaskTemplate(
+                        template_id=spec.template_id,
+                        payload=wire.Opaque(tpl)))
+                call = wire.TaskCall(
+                    template_id=spec.template_id,
+                    task_id=spec.task_id.binary(),
+                    args=wire.Opaque(spec.args) if spec.args else None,
+                    kwargs=wire.Opaque(spec.kwargs) if spec.kwargs else None,
+                    num_returns=spec.num_returns,
+                    depth=spec.depth,
+                    trace_parent=spec.trace_parent,
+                    max_retries=spec.max_retries)
+                return call, templates
+        return self._strip_exported_func(spec, record), []
+
+    def _send_submit_frame(self, node_id: str, pipe, batch) -> None:
+        """Flush one coalesced batch as a single submit_batch request.
+        Encode failures retry items individually (so one unpicklable
+        payload fails alone); transport failures re-route every item
+        through submit()."""
+        templates, calls, tags = [], [], []
+        for call, tpls, spec, lease in batch:
+            templates.extend(tpls)
+            calls.append(call)
+            tags.append((spec, lease))
+        kwargs = {"templates": templates, "calls": calls}
+        try:
+            pipe.send("submit_batch", tag=("__batch__", tags, kwargs),
+                      **kwargs)
+            return
+        except (ConnectionError, OSError):
+            # The claiming frame never arrived: un-claim its templates
+            # or every later TaskCall of these shapes to this (still
+            # alive) node would hit UnknownTemplate forever.
+            record = self.head.nodes.get(node_id)
+            if record is not None:
+                for t in templates:
+                    record.known_templates.discard(t.template_id)
+            self._drop_lease_pipe(node_id, None)
+            for spec, lease in tags:
+                self.head.clear_inflight(spec)
+                try:
+                    self.submit(spec)
+                except Exception as e:
+                    self._fail_spec(spec, e)
+            return
+        except BaseException as e:  # encode failure (unpicklable payload)
+            if len(batch) == 1:
+                # The frame (and any template it carried) never reached
+                # the node: un-claim, or later call-only headers of this
+                # shape would hit UnknownTemplate forever.
+                record = self.head.nodes.get(node_id)
+                if record is not None:
+                    for t in templates:
+                        record.known_templates.discard(t.template_id)
+                spec = batch[0][2]
+                self.head.clear_inflight(spec)
+                self._fail_spec(spec, e)
+                return
+            for item in batch:
+                self._send_submit_frame(node_id, pipe, [item])
+
+    def _drop_lease_pipe(self, node_id: str, lease) -> None:
+        with self._lease_lock:
+            self._pipes.pop(node_id, None)
+            batcher = self._batchers.pop(node_id, None)
+            for ls in self._leases.values():
+                if lease is None:
+                    ls[:] = [l for l in ls if l["node_id"] != node_id]
+                else:
                     ls[:] = [l for l in ls if l is not lease]
-            return False
+        if batcher is not None:
+            batcher.close()  # flusher drains then retires (no thread leak)
 
     def _pipe_error(self, tag, message: str, rid: str, lost: bool):
         """Async failure from a pipelined channel (reader thread)."""
+        if isinstance(tag, tuple) and len(tag) == 3 and \
+                tag[0] == "__batch__":
+            return self._batch_pipe_error(tag, message, rid, lost)
         spec, lease = tag
         if not lost:
             # The node processed the request but its HANDLER failed —
@@ -985,6 +1195,54 @@ class ClusterBackendMixin:
         except Exception as e:
             self.head.clear_inflight(spec)
             self.head.mark_node_dead(lease["node_id"],
+                                     reason=f"unreachable: {e}")
+
+    def _batch_pipe_error(self, tag, message: str, rid: str, lost: bool):
+        """Failure of one coalesced submit_batch frame. Non-lost means
+        the node received and dispatched the frame but the HANDLER
+        failed wholesale (per-call failures never reach here — the node
+        stores those into the calls' return objects): re-route every
+        item. Lost means the connection died un-acked: resubmit the
+        whole frame under the SAME request id — the node's dedupe cache
+        makes that exactly-once."""
+        _, tags, kwargs = tag
+        node_id = tags[0][1]["node_id"] if tags else None
+        record = self.head.nodes.get(node_id) if node_id else None
+        if not lost:
+            # The node rejected the frame WHOLESALE (decode/handler
+            # failure before dispatch): its templates never registered,
+            # so un-claim them or every later call-only header of these
+            # shapes fails with UnknownTemplate forever.
+            if record is not None:
+                for t in kwargs.get("templates") or []:
+                    record.known_templates.discard(t.template_id)
+            for spec, lease in tags:
+                self.head.clear_inflight(spec)
+            if node_id is not None:
+                self._drop_lease_pipe(node_id, None)
+            for spec, _lease in tags:
+                retries = getattr(spec, "_lease_reroutes", 0)
+                if retries < 3:
+                    spec._lease_reroutes = retries + 1
+                    try:
+                        self.submit(spec)
+                        continue
+                    except Exception:
+                        pass
+                self._fail_spec(spec, RuntimeError(
+                    f"batched submit failed on {node_id}: {message}"))
+            return
+        if node_id is not None:
+            self._drop_lease_pipe(node_id, None)
+        if record is None or not record.alive:
+            return  # node-death sweep owns recovery
+        try:
+            RpcClient.to(record.address).call_with_rid(
+                rid, "submit_batch", **kwargs)
+        except Exception as e:
+            for spec, _lease in tags:
+                self.head.clear_inflight(spec)
+            self.head.mark_node_dead(node_id,
                                      reason=f"unreachable: {e}")
 
     def _route_by_strategy(self, spec):
@@ -1319,6 +1577,20 @@ class ClusterBackendMixin:
                          name="arg-push").start()
 
     def _send(self, node: _NodeRecord, spec):
+        # Ordering fence: this synchronous submission must not overtake
+        # coalesced frames already enqueued for the same node on the
+        # pipelined channel (e.g. tasks submitted just before an actor
+        # creation that will pin the node's resources). Flush the
+        # batcher (frames handed to the socket) and the pipe (frames
+        # ACKED, i.e. dispatched node-side) first; both are no-ops on
+        # idle channels and best-effort on sick ones — the node-death
+        # paths own real failures.
+        batcher = self._batchers.get(node.node_id)
+        if batcher is not None:
+            batcher.flush(timeout=30.0)
+            pipe = self._pipes.get(node.node_id)
+            if pipe is not None:
+                pipe.flush(timeout=30.0)
         self._publish_local_args(node, spec)
         # Lineage + in-flight BEFORE the wire: a fast task can execute
         # and report its outputs before this function returns, and that
@@ -1460,32 +1732,29 @@ class ClusterDriverMixin:
 
         worker._fetch_notify = on_objects_reported
 
-        def try_fetch_one(key, entry) -> bool:
-            """One fetch attempt; True when resolved (or errored)."""
-            ref = entry["ref"]
-            if worker.memory_store.contains(ref.id):
-                return True
-            if _try_shm_fetch(worker, ref.id):
-                return True
+        def try_fetch_batch(items) -> set:
+            """Batched fetch round over the shared pull core (a
+            completed fan-out used to drain with one synchronous round
+            trip per object). Returns resolved keys; failures leave
+            their error on the entry for deadline handling."""
             # Read through worker.cluster_head (not the install-time
             # capture): restart_head swaps it.
             live_head = worker.cluster_head
-            info = live_head._locate2(key)
-            if info is not None and \
-                    tuple(info["address"]) != live_head.server.address:
-                if _try_transfer_fetch(worker, ref.id, info):
-                    return True
-                try:
-                    ok, value, err = RpcClient.to(
-                        tuple(info["address"])).call("get_object",
-                                                     oid=key)
-                except Exception as e:
-                    entry["err"] = e
-                    return False
-                if ok:
-                    worker.memory_store.put(ref.id, value, error=err)
-                    return True
-            return worker.memory_store.contains(ref.id)
+
+            def locate(need):
+                return [live_head._locate2(o.binary()) for o in need]
+
+            resolved, failed, _unresolved = batch_fetch_objects(
+                worker, [entry["ref"].id for _key, entry in items],
+                locate, live_head.server.address)
+            done: set = set()
+            for key, entry in items:
+                oid = entry["ref"].id
+                if oid in resolved:
+                    done.add(key)
+                elif oid in failed:
+                    entry["err"] = failed[oid]
+            return done
 
         def dispatcher():
             # Notifications (head reports + local-store callbacks) carry
@@ -1506,16 +1775,20 @@ class ClusterDriverMixin:
                         batch = list(pending)
                         sweep_at = time.monotonic() + 1.0
                 now = time.monotonic()
-                for key in batch:
-                    with cond:
+                items = []
+                with cond:
+                    for key in batch:
                         entry = pending.get(key)
-                    if entry is None:
-                        continue
-                    try:
-                        done = try_fetch_one(key, entry)
-                    except Exception as e:
+                        if entry is not None:
+                            items.append((key, entry))
+                try:
+                    done_keys = try_fetch_batch(items)
+                except Exception as e:
+                    done_keys = set()
+                    for _key, entry in items:
                         entry["err"] = e
-                        done = False
+                for key, entry in items:
+                    done = key in done_keys
                     if not done and now > entry["deadline"]:
                         done = True
                         if entry["err"] is not None and \
@@ -1533,7 +1806,7 @@ class ClusterDriverMixin:
                 # Drop loop locals: a lingering `entry` binding would
                 # pin its ObjectRef (blocking the driver's zero-ref
                 # release) across the next wait.
-                entry = batch = None
+                entry = batch = items = done_keys = None
 
         threading.Thread(target=dispatcher, daemon=True,
                          name="cluster-fetch-dispatcher").start()
@@ -1803,6 +2076,14 @@ class Cluster:
         addr = old.server.address
         old.stop()
         old.server.shutdown()
+        # Graceful handoff boundary: drain the old store's deferred
+        # group-commit batch so the fresh GlobalState's new connection
+        # recovers everything the old head accepted. (A hard crash
+        # instead loses at most the commit-interval window — the same
+        # contract as the reference's async Redis writes.)
+        flush = getattr(self.driver_worker.gcs, "flush_storage", None)
+        if flush is not None:
+            flush()
         # Fresh GlobalState: prove recovery comes from durable storage,
         # not this process's memory.
         self.driver_worker.gcs = state_mod.GlobalState(self.driver_worker)

@@ -105,6 +105,26 @@ def _expand_paths(paths) -> List[str]:
     return out
 
 
+# Per-file metadata discovery (parquet footers, size stats) fans out on
+# a thread pool: planning a many-file directory read is IO-latency
+# bound, so wall time is O(files / pool) instead of O(files)
+# (reference: parquet metadata providers prefetch footers in parallel).
+_METADATA_POOL_SIZE = 16
+
+
+def _parallel_plan(paths: List[str], plan_one) -> List[List[ReadTask]]:
+    """Run ``plan_one(path) -> [ReadTask]`` for every path, preserving
+    path order in the result. Serial under 2 paths (no pool tax)."""
+    if len(paths) < 2:
+        return [plan_one(p) for p in paths]
+    from concurrent.futures import ThreadPoolExecutor
+
+    workers = min(_METADATA_POOL_SIZE, len(paths))
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="ds-metadata") as pool:
+        return list(pool.map(plan_one, paths))
+
+
 class FileDatasource(Datasource):
     """Shared path-expansion + per-file read tasks."""
 
@@ -115,15 +135,19 @@ class FileDatasource(Datasource):
     def _read_file(self, path: str) -> Iterable[Block]:
         raise NotImplementedError
 
+    def _plan_file(self, path: str) -> List[ReadTask]:
+        """Read tasks for ONE file; subclasses needing per-file metadata
+        IO (e.g. parquet footers) override this and get it fanned out on
+        the discovery pool."""
+        return [ReadTask(lambda p=path: self._read_file(p),
+                         BlockMetadata(input_files=[path]))]
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-        # One task per file; parallelism griding beyond file count would
-        # need row-group splitting (parquet only — future work).
-        tasks = []
-        for path in self._paths:
-            tasks.append(ReadTask(
-                lambda p=path: self._read_file(p),
-                BlockMetadata(input_files=[path]),
-            ))
+        # One task per file (parquet splits further by row group);
+        # per-file metadata discovery runs on the thread pool.
+        tasks: List[ReadTask] = []
+        for per_file in _parallel_plan(self._paths, self._plan_file):
+            tasks.extend(per_file)
         return tasks
 
 
@@ -141,34 +165,31 @@ class ParquetDatasource(FileDatasource):
         table = pq.read_table(path, columns=columns)
         yield table
 
-    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+    def _plan_file(self, path: str) -> List[ReadTask]:
         import pyarrow.parquet as pq
 
         columns = self._options.get("columns")
+        try:
+            meta = pq.ParquetFile(path).metadata
+            n_groups = meta.num_row_groups
+        except Exception:
+            n_groups = 0
+        if n_groups <= 1:
+            n_rows = meta.num_rows if n_groups else None
+            return [ReadTask(lambda p=path: self._read_file(p),
+                             BlockMetadata(input_files=[path],
+                                           num_rows=n_rows))]
         tasks: List[ReadTask] = []
-        for path in self._paths:
-            try:
-                meta = pq.ParquetFile(path).metadata
-                n_groups = meta.num_row_groups
-            except Exception:
-                n_groups = 0
-            if n_groups <= 1:
-                n_rows = meta.num_rows if n_groups else None
-                tasks.append(ReadTask(
-                    lambda p=path: self._read_file(p),
-                    BlockMetadata(input_files=[path],
-                                  num_rows=n_rows)))
-                continue
-            for g in range(n_groups):
-                def read_group(p=path, g=g):
-                    f = pq.ParquetFile(p)
-                    yield f.read_row_group(g, columns=columns)
+        for g in range(n_groups):
+            def read_group(p=path, g=g):
+                f = pq.ParquetFile(p)
+                yield f.read_row_group(g, columns=columns)
 
-                tasks.append(ReadTask(
-                    read_group,
-                    BlockMetadata(
-                        input_files=[path],
-                        num_rows=meta.row_group(g).num_rows)))
+            tasks.append(ReadTask(
+                read_group,
+                BlockMetadata(
+                    input_files=[path],
+                    num_rows=meta.row_group(g).num_rows)))
         return tasks
 
 

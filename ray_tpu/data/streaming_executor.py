@@ -68,14 +68,22 @@ class PhysicalOp:
 
     def poll(self) -> bool:
         """Move completed head-of-line work to outputs (FIFO order keeps
-        the stream deterministic). Returns True if anything progressed."""
+        the stream deterministic). Returns True if anything progressed.
+
+        One batched, event-driven wait over the whole in-flight window
+        replaces the old per-ref ``wait([ref], timeout=0)`` loop (one
+        store lock round trip per ref per tick); completion is then a
+        single snapshot and the FIFO prefix pops in order."""
+        if not self.in_flight:
+            return False
+        refs = list(dict.fromkeys(ref for ref, _ in self.in_flight))
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        if not ready:
+            return False
+        ready_set = set(ready)
         progressed = False
-        while self.in_flight:
-            ref, t0 = self.in_flight[0]
-            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
-            if not ready:
-                break
-            self.in_flight.popleft()
+        while self.in_flight and self.in_flight[0][0] in ready_set:
+            ref, t0 = self.in_flight.popleft()
             self.outputs.append(ref)
             self.stats.completed += 1
             self.stats.busy_s += time.perf_counter() - t0

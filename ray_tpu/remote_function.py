@@ -15,11 +15,11 @@ from ray_tpu._private.ids import TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    intern_template,
     trace_parent_from,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
     TaskKind,
-    TaskSpec,
 )
 
 _TASK_OPTIONS = {
@@ -51,6 +51,11 @@ class RemoteFunction:
         # one-time function export does the same); module-level
         # functions are unaffected (pickled by reference).
         self._func_id: bytes | None = None
+        # Interned invariant spec slice, built at first .remote():
+        # subsequent submits pay only per-call fields (task id, args,
+        # trace context) — the serialize-once TaskSpec idea of the
+        # reference core worker, applied in-process.
+        self._template = None
         functools.update_wrapper(self, func)
 
     def _export_id(self):
@@ -89,9 +94,8 @@ class RemoteFunction:
         rf._func_id = self._func_id  # same definition: share the export
         return rf
 
-    def remote(self, *args, **kwargs):
+    def _build_template(self):
         opts = self._default_options
-        w = worker_mod.global_worker()
         resources = normalize_request(
             num_cpus=opts.get("num_cpus"),
             num_tpus=opts.get("num_tpus"),
@@ -105,28 +109,34 @@ class RemoteFunction:
             raise TypeError(
                 f"scheduling_strategy must be a SchedulingStrategy, got {strategy!r}"
             )
-        num_returns = opts.get("num_returns", 1)
-        ctx = w.task_context.current()
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
+        return intern_template(
             kind=TaskKind.NORMAL_TASK,
             func=self._function,
-            args=args,
-            kwargs=kwargs,
             name=opts.get("name") or self._function.__qualname__,
-            num_returns=num_returns,
+            num_returns=opts.get("num_returns", 1),
             resources=resources,
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=strategy,
             runtime_env=opts.get("runtime_env"),
             isolate_process=check_isolate_process(opts.get("isolate_process", False)),
+            func_id=self._export_id(),
+        )
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod.global_worker()
+        tpl = self._template
+        if tpl is None:
+            tpl = self._template = self._build_template()
+        ctx = w.task_context.current()
+        spec = tpl.make_spec(
+            TaskID.from_random(), args, kwargs,
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
             trace_parent=(trace_parent_from(ctx["task_spec"])
                           if ctx else None),
-            func_id=self._export_id(),
         )
         refs = w.submit(spec)
+        num_returns = tpl.num_returns
         if num_returns == 0:
             return None
         if num_returns == 1 or num_returns == "dynamic":

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -145,14 +146,32 @@ class LLMEngine:
         self._sample_admitted = jax.jit(
             self._sample_admitted_impl,
             in_shardings=(s1, s1, s1), out_shardings=(s1, s1))
+        # AOT-compiled executables, filled by warmup(): the bucket
+        # ladder compiles CONCURRENTLY (XLA releases the GIL; compiles
+        # parallelize across cores) and the serving path then calls the
+        # compiled objects directly — no jit-cache recompile behind the
+        # first request. Absent entries fall back to the jit functions.
+        self._prefill_exec: Dict[int, Any] = {}
+        self._decode_exec = None
+        self._sample_exec = None
 
-    def warmup(self, max_prompt_len: Optional[int] = None) -> float:
+    def warmup(self, max_prompt_len: Optional[int] = None,
+               concurrent: bool = True) -> float:
         """Compile every program the serving path needs BEFORE the first
         request (deploy-time AOT): prefill at each power-of-two bucket up
-        to ``max_prompt_len`` (default max_seq) plus the decode body.
-        Must run before :meth:`start`. Returns the wall seconds spent —
-        with the persistent compilation cache this is seconds on the
-        first deploy of a config and near-zero afterwards."""
+        to ``max_prompt_len`` (default max_seq) plus the decode body and
+        the admission sampler. Must run before :meth:`start`.
+
+        The bucket ladder compiles CONCURRENTLY: each program is
+        lowered and compiled on a thread pool (XLA compilation drops the
+        GIL and parallelizes across host cores), so a first-ever deploy
+        pays roughly the LONGEST compile, not the sum of the ladder.
+        The compiled executables then serve traffic directly (and each
+        runs once here to validate + touch device memory). Returns the
+        wall seconds spent — with the persistent compilation cache this
+        is seconds on the first deploy of a config and near-zero
+        afterwards. ``concurrent=False`` keeps the old sequential
+        jit-call path (debugging escape hatch)."""
         assert self._thread is None or not self._thread.is_alive(), \
             "warmup() must run before the engine loop starts"
         t0 = time.perf_counter()
@@ -162,27 +181,142 @@ class LLMEngine:
             buckets.append(b)
             b *= 2
         buckets.append(min(b, self.max_seq))  # _admit's cap bucket
+        buckets = sorted(set(buckets))
+        if concurrent:
+            try:
+                self._compile_ladder_concurrent(buckets)
+            except Exception:
+                # AOT path unavailable (jax version / backend quirk):
+                # the sequential jit pass below still compiles it all.
+                self._prefill_exec.clear()
+                self._decode_exec = self._sample_exec = None
         last = None
-        for bucket in sorted(set(buckets)):
+        for bucket in buckets:
             tokens = jnp.zeros((1, bucket), jnp.int32)
-            self.cache, last = self._prefill(
-                self.params, self.cache, tokens, jnp.int32(0),
-                jnp.int32(1), bucket)
+            self.cache, last = self._run_prefill(
+                tokens, jnp.int32(0), jnp.int32(1), bucket)
         # Admission-wave sampling program (and its eager stack feeder).
         stacked = jnp.stack([last] * self.n_slots)
-        _firsts, self._rng = self._sample_admitted(
-            stacked, jnp.asarray(np.zeros(self.n_slots, np.float32)),
-            self._rng)
-        (self.cache, toks, _last, _lens, self._rng) = self._decode(
-            self.params, self.cache,
+        _firsts, self._rng = self._run_sample(
+            stacked, jnp.asarray(np.zeros(self.n_slots, np.float32)))
+        (self.cache, toks, _last, _lens, self._rng) = self._run_decode(
             jnp.zeros(self.n_slots, jnp.int32),
             jnp.zeros(self.n_slots, jnp.int32),
             jnp.zeros(self.n_slots, jnp.float32),
-            jnp.zeros(self.n_slots, jnp.int32), self._rng)
+            jnp.zeros(self.n_slots, jnp.int32))
         np.asarray(toks)  # host fetch = the only reliable barrier
         # Warmup wrote garbage KV into slot 0; lengths stay 0 so every
         # slot still reads as empty when serving starts.
         return time.perf_counter() - t0
+
+    def _compile_ladder_concurrent(self, buckets) -> None:
+        """AOT-compile every serving program on a thread pool."""
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+
+        import jax.numpy as _jnp
+
+        def aval(shape, dtype=_jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        params_avals = jax.tree_util.tree_map(
+            lambda x: aval(x.shape, x.dtype), self.params)
+        cache_avals = jax.tree_util.tree_map(
+            lambda x: aval(x.shape, x.dtype), self.cache)
+        rng_aval = aval(self._rng.shape, self._rng.dtype)
+        n = self.n_slots
+
+        def compile_prefill(bucket):
+            lowered = self._prefill.lower(
+                params_avals, cache_avals, aval((1, bucket)),
+                aval(()), aval(()), bucket)
+            return bucket, lowered.compile()
+
+        def compile_decode():
+            lowered = self._decode.lower(
+                params_avals, cache_avals, aval((n,)), aval((n,)),
+                aval((n,), _jnp.float32), aval((n,)), rng_aval)
+            return "decode", lowered.compile()
+
+        def compile_sample():
+            lowered = self._sample_admitted.lower(
+                aval((n, self.cfg.vocab_size), _jnp.float32),
+                aval((n,), _jnp.float32), rng_aval)
+            return "sample", lowered.compile()
+
+        jobs = [lambda b=b: compile_prefill(b) for b in buckets]
+        jobs += [compile_decode, compile_sample]
+        workers = min(len(jobs), max(2, os.cpu_count() or 4))
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="aot-compile") as pool:
+            for key, compiled in pool.map(lambda fn: fn(), jobs):
+                if key == "decode":
+                    self._decode_exec = compiled
+                elif key == "sample":
+                    self._sample_exec = compiled
+                else:
+                    self._prefill_exec[key] = compiled
+
+    # -- compiled-or-jit call shims --------------------------------------
+    #
+    # Fallback contract: the AOT executables can only legitimately fail
+    # at ARGUMENT VALIDATION (aval/sharding drift between warmup and the
+    # serving loop) — which happens before dispatch, so no donated
+    # buffer has been consumed and the jit retry with self.cache is
+    # safe. A failure raised AFTER dispatch (device OOM etc.) may have
+    # donated the cache, making a retry unsafe — so it is logged and
+    # RE-RAISED, never silently converted into a mid-serving recompile.
+
+    @staticmethod
+    def _exec_fallback_ok(e: Exception) -> bool:
+        return isinstance(e, (TypeError, ValueError))  # pre-dispatch checks
+
+    def _run_prefill(self, tokens, slot, length, bucket):
+        compiled = self._prefill_exec.get(bucket)
+        if compiled is not None:
+            try:
+                return compiled(self.params, self.cache, tokens, slot,
+                                length)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "AOT prefill[%d] failed (%s); %s", bucket, e,
+                    "re-jitting" if self._exec_fallback_ok(e)
+                    else "re-raising")
+                self._prefill_exec.pop(bucket, None)
+                if not self._exec_fallback_ok(e):
+                    raise
+        return self._prefill(self.params, self.cache, tokens, slot,
+                             length, bucket)
+
+    def _run_decode(self, last, lengths, temps, topks):
+        if self._decode_exec is not None:
+            try:
+                return self._decode_exec(self.params, self.cache, last,
+                                         lengths, temps, topks, self._rng)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "AOT decode failed (%s); %s", e,
+                    "re-jitting" if self._exec_fallback_ok(e)
+                    else "re-raising")
+                self._decode_exec = None
+                if not self._exec_fallback_ok(e):
+                    raise
+        return self._decode(self.params, self.cache, last, lengths,
+                            temps, topks, self._rng)
+
+    def _run_sample(self, logits, temps):
+        if self._sample_exec is not None:
+            try:
+                return self._sample_exec(logits, temps, self._rng)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "AOT sampler failed (%s); %s", e,
+                    "re-jitting" if self._exec_fallback_ok(e)
+                    else "re-raising")
+                self._sample_exec = None
+                if not self._exec_fallback_ok(e):
+                    raise
+        return self._sample_admitted(logits, temps, self._rng)
 
     # -- compiled bodies -------------------------------------------------
 
@@ -346,9 +480,9 @@ class LLMEngine:
             slot = self._free_slots.pop()
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :t_real] = prompt
-            self.cache, last_logits = self._prefill(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.int32(slot), jnp.int32(t_real), bucket)
+            self.cache, last_logits = self._run_prefill(
+                jnp.asarray(tokens), jnp.int32(slot), jnp.int32(t_real),
+                bucket)
             staged.append((req, slot, t_real, last_logits))
         if not staged:
             return False
@@ -363,8 +497,8 @@ class LLMEngine:
         temps_np = np.zeros(self.n_slots, np.float32)
         for i, s in enumerate(staged):
             temps_np[i] = s[0].params.temperature
-        firsts_dev, self._rng = self._sample_admitted(
-            logits, jnp.asarray(temps_np), self._rng)
+        firsts_dev, self._rng = self._run_sample(
+            logits, jnp.asarray(temps_np))
         firsts = np.asarray(firsts_dev)[:len(staged)]
         now = time.perf_counter()
         for (req, slot, t_real, _), first in zip(staged, firsts):
@@ -397,10 +531,10 @@ class LLMEngine:
         lengths = self._dev_lengths if self._dev_lengths is not None \
             else jnp.asarray(self._lengths)
         (self.cache, next_tokens, self._dev_last, self._dev_lengths,
-         self._rng) = self._decode(
-            self.params, self.cache, last, lengths,
+         self._rng) = self._run_decode(
+            last, lengths,
             jnp.asarray(self._temps_arr),
-            jnp.asarray(self._topks_arr), self._rng)
+            jnp.asarray(self._topks_arr))
         prev, self._pending_toks = self._pending_toks, next_tokens
         if prev is not None:
             self._consume_block(np.asarray(prev))

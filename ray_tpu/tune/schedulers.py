@@ -68,7 +68,10 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self.rung_results: Dict[float, List[float]] = {r: []
                                                        for r in self.rungs}
         self._trial_rung: Dict[str, int] = {}
-        self._trial_rung_value: Dict[str, float] = {}
+        # Per-trial value recorded at EACH rung it passed (not just the
+        # last): the eager re-check below compares a trial's own
+        # rung-time score against that rung's now-populated cutoff.
+        self._trial_rung_values: Dict[str, Dict[float, float]] = {}
 
     def _sign(self, v: float) -> float:
         return v if self.mode == "max" else -v
@@ -89,22 +92,22 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if t >= self.max_t:
             return self.STOP
         idx = self._trial_rung.get(trial.trial_id, 0)
+        mine = self._trial_rung_values.setdefault(trial.trial_id, {})
         while idx < len(self.rungs) and t >= self.rungs[idx]:
             rung = self.rungs[idx]
             self.rung_results[rung].append(self._sign(metric))
-            self._trial_rung_value[trial.trial_id] = self._sign(metric)
+            mine[rung] = self._sign(metric)
             idx += 1
             self._trial_rung[trial.trial_id] = idx
             if self._below_cutoff(rung, self._sign(metric)):
                 return self.STOP
-        # Eager re-check: a trial that passed its last rung before peers
-        # arrived (e.g. lockstep execution) is re-evaluated against the
-        # now-populated rung, so promotion mistakes are corrected instead
-        # of riding to max_t.
-        if idx > 0 and trial.trial_id in self._trial_rung_value:
-            rung = self.rungs[idx - 1]
-            if self._below_cutoff(rung,
-                                  self._trial_rung_value[trial.trial_id]):
+        # Eager re-check against EVERY passed rung: a trial that sprinted
+        # past rungs before peers arrived (e.g. buffered results, lockstep
+        # execution) is re-evaluated with its OWN rung-time score once
+        # those rungs populate — checking only the last rung let such a
+        # trial escape culling and ride to max_t.
+        for rung, value in mine.items():
+            if self._below_cutoff(rung, value):
                 return self.STOP
         return self.CONTINUE
 

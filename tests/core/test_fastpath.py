@@ -1,0 +1,440 @@
+"""Control-plane fast path: spec-template interning, event-driven
+wait/get, coalesced submit frames, and deferred durable writes.
+
+Covers the contracts the hot path relies on:
+- intern cache identity: same content dedupes, redefinition invalidates;
+- wait/get correctness under concurrent completion + cancellation;
+- batched-frame flush under backpressure (order, coalescing, errors);
+- SQLite group commit: visibility boundary is flush(), not put().
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.rpc import CoalescingBatcher
+from ray_tpu._private.task_spec import TaskKind, intern_template
+
+
+# ---------------------------------------------------------------------------
+# Spec-template interning
+# ---------------------------------------------------------------------------
+
+
+def test_template_interned_once_per_function(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    r1 = f.remote(1)
+    r2 = f.remote(2)
+    assert ray_tpu.get([r1, r2]) == [2, 3]
+    # Both submissions share ONE interned template.
+    assert f._template is not None
+    assert f._template.template_id
+    assert f._template.milli == {"CPU": 1000}
+
+
+def test_template_options_get_distinct_templates(ray_start_regular):
+    @ray_tpu.remote
+    def g(x):
+        return x
+
+    g_half = g.options(num_cpus=0.5)
+    assert ray_tpu.get(g.remote(1)) == 1
+    assert ray_tpu.get(g_half.remote(2)) == 2
+    assert g._template.template_id != g_half._template.template_id
+    assert g_half._template.resources == {"CPU": 0.5}
+
+
+def test_template_cache_invalidated_on_redefinition(ray_start_regular):
+    """A redefined function body (same name) must produce a different
+    template id — the intern cache keys on content, so the new
+    definition can never hit the stale entry."""
+
+    def make(version):
+        @ray_tpu.remote
+        def worker():
+            return version
+
+        return worker
+
+    w1 = make(1)
+    w2 = make(2)
+    assert ray_tpu.get(w1.remote()) == 1
+    assert ray_tpu.get(w2.remote()) == 2  # new body executes, not cached
+    assert w1._template.template_id != w2._template.template_id
+
+
+def test_equal_content_dedupes_to_one_template():
+    tpl_a = intern_template(
+        kind=TaskKind.ACTOR_TASK, func="ping", name="A.ping",
+        num_returns=1, resources={}, max_retries=0)
+    tpl_b = intern_template(
+        kind=TaskKind.ACTOR_TASK, func="ping", name="A.ping",
+        num_returns=1, resources={}, max_retries=0)
+    assert tpl_a.template_id == tpl_b.template_id
+    tpl_c = intern_template(
+        kind=TaskKind.ACTOR_TASK, func="ping", name="A.ping",
+        num_returns=1, resources={}, max_retries=2)
+    assert tpl_c.template_id != tpl_a.template_id
+
+
+def test_spec_from_template_carries_invariants(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.25, max_retries=7, name="custom-name")
+    def h():
+        return 1
+
+    assert ray_tpu.get(h.remote()) == 1
+    tpl = h._template
+    spec = tpl.make_spec(TaskID.from_random(), (), {})
+    assert spec.name == "custom-name"
+    assert spec.resources == {"CPU": 0.25}
+    assert spec.max_retries == 7
+    assert spec.template_id == tpl.template_id
+    assert spec._milli_cache == {"CPU": 250}
+
+
+# ---------------------------------------------------------------------------
+# Event-driven wait / get
+# ---------------------------------------------------------------------------
+
+
+def test_wait_all_ready_zero_timeout():
+    store = MemoryStore()
+    oids = [ObjectID.for_task_return(TaskID.from_random(), 0)
+            for _ in range(50)]
+    for i, oid in enumerate(oids):
+        store.put(oid, i)
+    ready, not_ready = store.wait(oids, 50, timeout=0)
+    assert ready == oids and not_ready == []
+    # num_returns trims even when more are resolved.
+    ready, not_ready = store.wait(oids, 10, timeout=0)
+    assert ready == oids[:10] and not_ready == oids[10:]
+
+
+def test_wait_wakes_on_concurrent_completion():
+    store = MemoryStore()
+    oids = [ObjectID.for_task_return(TaskID.from_random(), 0)
+            for _ in range(20)]
+    for oid in oids[:5]:
+        store.put(oid, 1)
+
+    def complete_rest():
+        time.sleep(0.05)
+        for oid in oids[5:]:
+            store.put(oid, 2)
+
+    t = threading.Thread(target=complete_rest)
+    t.start()
+    ready, not_ready = store.wait(oids, 20, timeout=5)
+    t.join()
+    assert len(ready) == 20 and not not_ready
+
+
+def test_wait_timeout_returns_partial():
+    store = MemoryStore()
+    oids = [ObjectID.for_task_return(TaskID.from_random(), 0)
+            for _ in range(4)]
+    store.put(oids[0], "x")
+    t0 = time.monotonic()
+    ready, not_ready = store.wait(oids, 4, timeout=0.2)
+    assert time.monotonic() - t0 < 2.0
+    assert ready == [oids[0]]
+    assert not_ready == oids[1:]
+
+
+def test_wait_under_concurrent_completion_and_cancellation(
+        ray_start_regular):
+    """wait/get stay correct when some tasks complete while others are
+    cancelled mid-flight: every ref resolves (value or typed error) and
+    wait() accounts for all of them."""
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def slow(i):
+        time.sleep(0.05)
+        return i
+
+    refs = [slow.remote(i) for i in range(40)]
+    # Cancel a slice concurrently with execution.
+    for r in refs[::4]:
+        ray_tpu.cancel(r)
+    ready, not_ready = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=30)
+    assert len(ready) + len(not_ready) == len(refs)
+    ok, cancelled = 0, 0
+    for r in refs:
+        try:
+            val = ray_tpu.get(r, timeout=30)
+            assert isinstance(val, int)
+            ok += 1
+        except Exception:
+            cancelled += 1
+    # Cancellation is racy by contract; completed + cancelled must
+    # cover everything, and nothing may hang.
+    assert ok + cancelled == len(refs)
+    assert ok >= len(refs) - len(refs[::4])
+
+
+def test_get_many_mixed_ready_and_pending(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.01)
+    def quick(i):
+        return i
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def slow(i):
+        time.sleep(0.2)
+        return i
+
+    refs = [quick.remote(0), slow.remote(1), quick.remote(2)]
+    assert ray_tpu.get(refs, timeout=30) == [0, 1, 2]
+
+
+def test_get_many_raises_task_error(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.01)
+    def boom():
+        raise ValueError("expected-boom")
+
+    @ray_tpu.remote(num_cpus=0.01)
+    def fine():
+        return 1
+
+    refs = [fine.remote(), boom.remote(), fine.remote()]
+    with pytest.raises(ValueError, match="expected-boom"):
+        ray_tpu.get(refs, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Batched-frame flush under backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_under_backpressure():
+    """While one frame is in flight (a slow channel = backpressure),
+    items pile up and ride the NEXT frame: total frames sent is far
+    below items added, order is preserved, nothing is lost."""
+    frames = []
+    gate = threading.Event()
+
+    def send_frame(items):
+        if not gate.is_set():
+            gate.wait(5)  # first frame stalls: the backpressure window
+        frames.append(list(items))
+
+    b = CoalescingBatcher(send_frame, name="test")
+    b.add(0)
+    time.sleep(0.1)          # flusher is now stalled inside send_frame
+    for i in range(1, 200):
+        b.add(i)
+    gate.set()
+    assert b.flush(timeout=10)
+    sent = [i for frame in frames for i in frame]
+    assert sent == list(range(200))          # order preserved, no loss
+    assert len(frames) <= 3                  # coalesced, not 200 frames
+    assert len(frames[1]) >= 150             # the pile-up rode one frame
+
+
+def test_batcher_error_isolated_to_frame():
+    seen_errors = []
+    ok_frames = []
+
+    def send_frame(items):
+        if "bad" in items:
+            raise RuntimeError("frame failed")
+        ok_frames.append(list(items))
+
+    b = CoalescingBatcher(send_frame, name="test-err",
+                          on_error=lambda items, e: seen_errors.append(
+                              (list(items), str(e))))
+    b.add("bad")
+    assert b.flush(timeout=5)
+    b.add("good")
+    assert b.flush(timeout=5)
+    assert seen_errors and seen_errors[0][0] == ["bad"]
+    assert ok_frames == [["good"]]           # flusher survived the error
+
+
+def test_batcher_flush_empty_is_immediate():
+    b = CoalescingBatcher(lambda items: None, name="test-empty")
+    t0 = time.monotonic()
+    assert b.flush(timeout=5)
+    assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Deferred durable writes (SQLite group commit)
+# ---------------------------------------------------------------------------
+
+
+def test_sqlite_group_commit_flush_boundary(tmp_path):
+    """put() defers the disk transaction; flush() is the durability
+    boundary a SECOND connection observes."""
+    import sqlite3
+
+    from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+    path = str(tmp_path / "gcs.db")
+    # Huge interval: the background flusher never fires during the test.
+    store = SqliteStoreClient(path, commit_interval_s=300.0)
+    store.put("t", b"k", b"v")
+    # Same connection reads its own uncommitted write immediately.
+    assert store.get("t", b"k") == b"v"
+    other = sqlite3.connect(path)
+    row = other.execute(
+        "SELECT value FROM kv WHERE tbl='t' AND key=?", (b"k",)).fetchone()
+    assert row is None, "write visible across connections before flush"
+    store.flush()
+    row = other.execute(
+        "SELECT value FROM kv WHERE tbl='t' AND key=?", (b"k",)).fetchone()
+    assert row == (b"v",)
+    other.close()
+    store.close()
+
+
+def test_sqlite_close_commits_pending(tmp_path):
+    from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+    path = str(tmp_path / "gcs2.db")
+    store = SqliteStoreClient(path, commit_interval_s=300.0)
+    store.put("t", b"a", b"1")
+    store.delete("t", b"a")
+    store.put("t", b"b", b"2")
+    store.close()
+    reopened = SqliteStoreClient(path, commit_interval_s=0)
+    assert reopened.get("t", b"a") is None
+    assert reopened.get("t", b"b") == b"2"
+    reopened.close()
+
+
+def test_sqlite_background_flusher_commits(tmp_path):
+    import sqlite3
+
+    from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+    path = str(tmp_path / "gcs3.db")
+    store = SqliteStoreClient(path, commit_interval_s=0.01)
+    store.put("t", b"k", b"v")
+    other = sqlite3.connect(path)
+    deadline = time.monotonic() + 5
+    row = None
+    while time.monotonic() < deadline and row is None:
+        row = other.execute(
+            "SELECT value FROM kv WHERE tbl='t' AND key=?",
+            (b"k",)).fetchone()
+        time.sleep(0.02)
+    assert row == (b"v",), "background group commit never landed"
+    other.close()
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# Submit-side dispatch bypass
+# ---------------------------------------------------------------------------
+
+
+def test_fast_dispatch_falls_back_when_busy(ray_start_2_cpus):
+    """Tasks outnumbering free resources take the parked/dispatcher
+    path; everything still completes exactly once."""
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy(i):
+        time.sleep(0.05)
+        return i
+
+    refs = [heavy.remote(i) for i in range(6)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(6))
+
+
+def test_fast_dispatch_nested_submission(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.5)
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10), timeout=60) == 21
+
+
+def test_fast_dispatch_infeasible_request_errors(ray_start_2_cpus):
+    @ray_tpu.remote(num_cpus=64)
+    def impossible():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(impossible.remote(), timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Cluster wire path (interned templates + batched frames end to end)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cluster_template_stripped_submissions():
+    """Forced-remote tasks ride TaskCall headers after the first
+    shipment: the head records the node as knowing the template, and a
+    stream of submissions with args still yields correct results."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        node_id = cluster.add_node(num_cpus=4)
+
+        @ray_tpu.remote(num_cpus=1)
+        def mul(x, y):
+            return x * y
+
+        assert ray_tpu.get(mul.remote(6, 7), timeout=60) == 42
+        refs = [mul.remote(i, 2) for i in range(200)]
+        assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(200)]
+        record = cluster.head.nodes[node_id]
+        assert mul._template.template_id in record.known_templates
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_cluster_batched_arg_fetch():
+    """A forced-remote task whose args all live on the driver resolves
+    them through the batched locate/pull path."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=2)
+        arg_refs = [ray_tpu.put(np.full(1000, i)) for i in range(8)]
+
+        @ray_tpu.remote(num_cpus=2)
+        def total(*arrs):
+            return int(sum(a.sum() for a in arrs))
+
+        expect = sum(i * 1000 for i in range(8))
+        assert ray_tpu.get(total.remote(*arg_refs), timeout=120) == expect
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bench hygiene: schema-versioned perf envelope
+# ---------------------------------------------------------------------------
+
+
+def test_perf_bench_envelope_schema():
+    """The perf emitter's calibration is cheap and its schema stable:
+    cross-host comparisons rely on these exact keys existing."""
+    import benchmarks.perf_bench as pb
+
+    assert isinstance(pb.SCHEMA_VERSION, int) and pb.SCHEMA_VERSION >= 2
+    cal = pb.host_calibration(seconds=0.02)
+    assert set(cal) >= {"cpu_count", "python_spin_mops_per_s",
+                        "lock_roundtrip_mops_per_s"}
+    assert cal["python_spin_mops_per_s"] > 0
+    assert cal["lock_roundtrip_mops_per_s"] > 0

@@ -98,3 +98,51 @@ def test_repeated_iteration_caches_all_to_all(ray_local):
     assert plan._cached is not None
     second = list(plan.iter_block_refs())
     assert [r.id for r in first] == [r.id for r in second]
+
+
+def test_fifo_order_preserved_under_out_of_order_completion(ray_local):
+    """Per-op FIFO: blocks whose tasks finish OUT of submission order
+    must still stream downstream IN submission order (the batched
+    event-driven poll pops only the completed head-of-line prefix)."""
+    from ray_tpu.data.streaming_executor import (
+        MapOp,
+        SourceOp,
+        StreamingExecutor,
+    )
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def delayed(i):
+        # Earlier blocks sleep LONGER: completion order is reversed
+        # relative to submission order.
+        time.sleep((8 - i) * 0.03)
+        return [i]
+
+    refs = [delayed.remote(i) for i in range(8)]
+    source = SourceOp("source", refs=refs, max_in_flight=8)
+    map_op = MapOp("map", fn=lambda b: b, num_cpus=0.1, max_in_flight=8)
+    out = [ray_tpu.get(r)[0] for r in
+           StreamingExecutor([source, map_op]).iter_refs(window=8)]
+    assert out == list(range(8)), f"FIFO order broken: {out}"
+
+
+def test_poll_batched_wait_single_call(ray_local):
+    """poll() issues ONE batched wait over the in-flight window instead
+    of one wait per ref."""
+    from unittest import mock
+
+    from ray_tpu.data.streaming_executor import PhysicalOp
+
+    op = PhysicalOp("probe", max_in_flight=8)
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def unit(i):
+        return i
+
+    refs = [unit.remote(i) for i in range(6)]
+    ray_tpu.get(refs)  # all resolved
+    for r in refs:
+        op._track(r)
+    with mock.patch("ray_tpu.wait", wraps=ray_tpu.wait) as spy:
+        assert op.poll()
+    assert spy.call_count == 1
+    assert len(op.outputs) == 6

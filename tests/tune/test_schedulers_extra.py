@@ -26,7 +26,15 @@ def ray():
 
 def test_hyperband_culls_bad_trials_across_brackets():
     def trainable(config):
+        import time
+
         for i in range(30):
+            # Pace reports: an unthrottled loop buffers all 30 results
+            # before the scheduler processes the first milestone, so
+            # whether culling truncates the history becomes a driver/
+            # actor timing race (observed flaky on BOTH sides of the
+            # PR 2 control-plane change, ~3/8 runs).
+            time.sleep(0.002)
             tune.report({"score": config["q"] * (i + 1)})
 
     hb = HyperBandScheduler(metric="score", mode="max", max_t=30,
