@@ -48,12 +48,17 @@ def nodes():
 def timeline(filename=None):
     """Chrome-trace dump of task execution (reference: `ray.timeline`,
     `python/ray/_private/state.py:851`). Returns the event list; with
-    `filename`, writes JSON loadable in chrome://tracing or Perfetto."""
+    `filename`, writes JSON loadable in chrome://tracing or Perfetto.
+    On a cluster head the dump is CLUSTER-wide: worker-node events ship
+    to the head's aggregator, each trace event ``pid``-tagged with its
+    executing node."""
     import json
 
+    from ray_tpu._private.obs_plane import cluster_task_events
+    from ray_tpu._private.task_events import chrome_trace_events
     from ray_tpu._private.worker import global_worker
 
-    events = global_worker().task_events.chrome_trace()
+    events = chrome_trace_events(cluster_task_events(global_worker()))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

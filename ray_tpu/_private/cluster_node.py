@@ -150,6 +150,16 @@ class NodeRuntime:
                 head = self.head
                 _events.set_forwarder(
                     lambda **kw: head.call("gcs_record_event", **kw))
+                # Observability shipping: task-event deltas + metric
+                # snapshots flow to the head's aggregator so timeline/
+                # tracing/state/dashboard views are cluster-wide. Shares
+                # the node's shutdown event — the loop's exit path ships
+                # the final terminal states.
+                from ray_tpu._private.obs_plane import NodeObsShipper
+
+                self.obs_shipper = NodeObsShipper(
+                    self.worker, tuple(head_address), self.node_id,
+                    stop_event=self._shutdown_event).start()
                 break
             except Exception as e:
                 last_err = e

@@ -76,6 +76,22 @@ class RayTpuConfig:
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_ms: int = 250
 
+    # -- observability plane ---------------------------------------------
+    # Worker nodes ship task-event deltas + metric-registry snapshots to
+    # the head's aggregator at this period (reference: the GCS task
+    # manager / OpenCensus export cadence). 0 disables shipping.
+    obs_ship_period_s: float = 0.5
+    # Max task events per shipping cycle — the rest stay queued for the
+    # next cycle, so one burst never produces an unbounded frame.
+    obs_ship_max_events: int = 2000
+    # Head-side cluster event store bound (events beyond this are
+    # evicted oldest-first).
+    obs_head_max_events: int = 200_000
+    # Serve HTTP access log: one structured line per request on the
+    # "ray_tpu.serve.access" logger (method, route, status, latency_ms,
+    # trace_id). Off by default — the ingress hot path stays log-free.
+    serve_access_log: bool = False
+
     # -- GCS storage (reference: store_client/; "" = in-memory, a file
     #    path selects the durable SQLite backend in Redis's role) -------
     gcs_storage_path: str = ""

@@ -112,6 +112,9 @@ class SqliteStoreClient(StoreClient):
         # never corrupts committed state.
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
+        from ray_tpu._private import perf_stats
+
+        self._stat_writes = perf_stats.counter("gcs_writes")
         self._interval = max(0.0, float(commit_interval_s or 0.0))
         self._dirty = threading.Event()
         self._closed = threading.Event()
@@ -122,6 +125,7 @@ class SqliteStoreClient(StoreClient):
             self._flusher.start()
 
     def _mark_dirty_locked(self) -> None:
+        self._stat_writes.inc()
         if self._interval > 0:
             self._dirty.set()
         else:
@@ -168,6 +172,9 @@ class SqliteStoreClient(StoreClient):
             self.flush()
 
     def flush(self) -> None:
+        from ray_tpu._private import perf_stats
+
+        t0 = time.monotonic()
         with self._lock:
             try:
                 self._conn.commit()
@@ -186,6 +193,8 @@ class SqliteStoreClient(StoreClient):
                 return
             self._commit_err_logged = False
             self._dirty.clear()
+        perf_stats.latency("gcs_commit_seconds").record(
+            time.monotonic() - t0)
 
     def close(self) -> None:
         self._closed.set()

@@ -21,7 +21,10 @@ import threading
 import traceback
 from typing import Any, Dict, Optional
 
+from time import monotonic as _monotonic
+
 from ray_tpu import exceptions as exc
+from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu._private.resources import MILLI, ResourceSet, to_milli
 from ray_tpu._private.task_spec import (
@@ -32,6 +35,11 @@ from ray_tpu._private.task_spec import (
 )
 
 logger = logging.getLogger(__name__)
+
+# Submit→execution-start latency (normal tasks: scheduler queue +
+# dispatch; actor tasks: mailbox wait) — module-level so both execute
+# paths share one distribution.
+_SCHED_LATENCY = _perf_stats.latency("sched_submit_to_start_seconds")
 
 
 class _BlockedState(threading.local):
@@ -233,6 +241,11 @@ class LocalBackend:
     # ------------------------------------------------------------------
 
     def submit(self, spec: TaskSpec) -> None:
+        # Scheduling-latency stamp (submit→start, measured at execution
+        # start): one monotonic read + attribute write — cheap enough
+        # for the submit hot path, gated for the A/B overhead bench.
+        if _perf_stats.ENABLED:
+            spec._submit_monotonic = _monotonic()
         if spec.kind == TaskKind.ACTOR_TASK:
             self._submit_actor_task(spec)
             return
@@ -473,6 +486,9 @@ class LocalBackend:
         events = self.worker.task_events
         events.task_started(spec, self.node_id,
                             threading.current_thread().name)
+        submitted = getattr(spec, "_submit_monotonic", None)
+        if submitted is not None:
+            _SCHED_LATENCY.record(_monotonic() - submitted)
         try:
             from ray_tpu._private.runtime_env import applied_runtime_env
 
@@ -502,6 +518,11 @@ class LocalBackend:
         events = self.worker.task_events
         events.task_started(spec, self.node_id,
                             threading.current_thread().name)
+        submitted = getattr(spec, "_submit_monotonic", None)
+        if submitted is not None:
+            # For actor tasks this is mailbox queue delay — the actor-
+            # path backpressure signal.
+            _SCHED_LATENCY.record(_monotonic() - submitted)
         try:
             args, kwargs = self.worker.resolve_args(spec)
             if actor._proc is not None:

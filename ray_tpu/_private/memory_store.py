@@ -15,8 +15,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+# Wait-path observability: how often wait() resolves from the snapshot
+# pass alone vs. parking on a _WaitGroup wake-up — the event-driven
+# completion path PR 2 introduced (a wake-up storm here means waiters
+# are subscribing faster than completions batch).
+_WAIT_CALLS = _perf_stats.counter("wait_calls")
+_WAIT_SNAPSHOT_HITS = _perf_stats.counter("wait_snapshot_hits")
+_WAIT_WAKEUPS = _perf_stats.counter("wait_wakeups")
 
 
 @dataclass
@@ -204,8 +213,12 @@ class MemoryStore:
                 group = _WaitGroup(target - len(ready))
                 for oid in unresolved:
                     self._entry(oid).callbacks.append(group.on_ready)
-        if group is not None:
+        _WAIT_CALLS.inc()
+        if group is None:
+            _WAIT_SNAPSHOT_HITS.inc()
+        else:
             group.event.wait(timeout)
+            _WAIT_WAKEUPS.inc()
             # Re-snapshot: completions that raced the wakeup count.
             with self._lock:
                 ready_set = {

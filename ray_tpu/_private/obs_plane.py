@@ -1,0 +1,251 @@
+"""Cluster-wide observability plane: node→head event/metric shipping.
+
+Role-equivalent to the reference's GcsTaskManager + OpenCensus metrics
+agent plumbing (SURVEY.md: core workers buffer task events and flush
+them to the GCS; each node's metrics agent exports to the scrape
+endpoint): worker-node processes record task events and fast-path stats
+locally, and without shipping the head — where ``ray_tpu.timeline()``,
+``export_spans()``, the state API, and the dashboard run — only ever
+sees its own process.
+
+Two halves:
+
+- :class:`NodeObsShipper` (node side): a background loop that drains
+  the task-event buffer's *delta* (``drain_updates`` — a bounded dirty
+  set, not a buffer scan) and snapshots the node's metrics registry,
+  shipping both to the head over a **dedicated** RPC connection every
+  ``obs_ship_period_s``. Bounded per cycle and entirely off the
+  execution hot path: executors only ever touch the event buffer they
+  already touched before this module existed.
+
+- :class:`ObsAggregator` (head side): the ``obs_report`` RPC handler.
+  Task events merge into a bounded cluster-wide store keyed by task id
+  (a terminal update replaces its RUNNING predecessor); metric
+  snapshots are kept per node for the dashboard's merged Prometheus
+  exposition.
+
+The merge helpers at the bottom are what the user-facing views call:
+``cluster_task_events`` feeds timeline/tracing/state,
+``export_cluster_prometheus`` feeds the dashboard ``/api/metrics``.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.task_events import TaskEvent
+
+logger = logging.getLogger(__name__)
+
+
+class ObsAggregator:
+    """Head-side sink for node observability reports."""
+
+    def __init__(self, max_events: Optional[int] = None):
+        from ray_tpu._private.config import ray_config
+
+        self._lock = threading.Lock()
+        self._max = max_events or ray_config.obs_head_max_events
+        # task_id -> TaskEvent, insertion-ordered for oldest-first
+        # eviction; updates do NOT move to end (a long-running task's
+        # terminal update should not outlive contemporaries forever).
+        self._events: "collections.OrderedDict[str, TaskEvent]" = \
+            collections.OrderedDict()
+        # node_id -> latest metrics-registry snapshot (plain data).
+        self._metrics: Dict[str, dict] = {}
+        self._reports = 0
+        self._events_received = 0
+
+    # -- RPC handler -----------------------------------------------------
+
+    def report(self, node_id: str, events: Optional[list] = None,
+               metrics: Optional[dict] = None) -> bool:
+        evs = []
+        for d in events or []:
+            try:
+                evs.append(TaskEvent.from_dict(d))
+            except Exception:
+                continue  # malformed entry must not poison the frame
+        with self._lock:
+            self._reports += 1
+            self._events_received += len(evs)
+            for ev in evs:
+                self._events[ev.task_id] = ev
+            while len(self._events) > self._max:
+                self._events.popitem(last=False)
+            if metrics is not None:
+                self._metrics[node_id] = metrics
+        return True
+
+    def forget_node(self, node_id: str) -> None:
+        """Drop a dead node's metric snapshot (its task events stay —
+        history outlives the node that produced it)."""
+        with self._lock:
+            self._metrics.pop(node_id, None)
+
+    # -- read side -------------------------------------------------------
+
+    def task_events(self) -> List[TaskEvent]:
+        with self._lock:
+            return list(self._events.values())
+
+    def metrics_snapshots(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"reports": self._reports,
+                    "events_received": self._events_received,
+                    "events_stored": len(self._events),
+                    "nodes_with_metrics": len(self._metrics)}
+
+
+class NodeObsShipper:
+    """Node-side shipping loop. One dedicated connection to the head:
+    a multi-KB metrics frame must never head-of-line block the node's
+    pooled control RPCs (leases, object reports) behind it."""
+
+    def __init__(self, worker, head_address, node_id: str,
+                 stop_event: Optional[threading.Event] = None):
+        from ray_tpu._private import perf_stats
+        from ray_tpu._private.config import ray_config
+        from ray_tpu._private.rpc import RpcClient
+
+        self.worker = worker
+        self.node_id = node_id
+        self._client = RpcClient.dedicated(tuple(head_address))
+        self._stop = stop_event or threading.Event()
+        self._period = ray_config.obs_ship_period_s
+        self._max_events = ray_config.obs_ship_max_events
+        # Metric snapshots ride every Nth cycle (~2s): a fully idle
+        # node must not serialize its whole registry twice a second,
+        # and metric staleness of a couple seconds is invisible at
+        # scrape cadence. Event deltas still ship every cycle.
+        self._metrics_every = max(
+            1, int(round(2.0 / self._period))) if self._period > 0 else 1
+        self._cycle = 0
+        self._stat_shipped = perf_stats.counter("obs_shipped_events")
+        self._stat_cycles = perf_stats.counter("obs_ship_cycles")
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeObsShipper":
+        if self._period <= 0:
+            return self  # shipping disabled by config
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="obs-shipper")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            self.ship_once()
+        self.ship_once(final=True)  # terminal states beat the shutdown
+
+    def ship_once(self, final: bool = False) -> bool:
+        """One shipping cycle; returns True if a report was sent.
+        Never raises — observability must not break the node."""
+        try:
+            self._cycle += 1
+            metrics_cycle = final or self._cycle % self._metrics_every == 0
+            events = self.worker.task_events.drain_updates(
+                self._max_events)
+            if not events and not metrics_cycle:
+                return False  # idle between metric beats: no RPC
+            metrics = self._snapshot_metrics() if metrics_cycle else None
+            try:
+                self._client.call("obs_report", node_id=self.node_id,
+                                  events=events, metrics=metrics)
+            except Exception:
+                # Head unreachable / mid-restart: put the drained ids
+                # back on the cursor so these events ship next cycle
+                # instead of silently vanishing from the cluster view.
+                self.worker.task_events.remark_dirty(
+                    [d["task_id"] for d in events])
+                return False
+            self._stat_shipped.inc(len(events))
+            self._stat_cycles.inc()
+            return True
+        except Exception:
+            return False  # drain/snapshot failure: retry next cycle
+
+    def _snapshot_metrics(self) -> Optional[dict]:
+        try:
+            from ray_tpu._private.runtime_metrics import (
+                collect_runtime_metrics,
+            )
+            from ray_tpu.util.metrics import snapshot_registry
+
+            # Fold runtime gauges + fast-path stats into the registry
+            # first, so the shipped snapshot is the same view a local
+            # scrape would get.
+            collect_runtime_metrics()
+            return snapshot_registry()
+        except Exception:
+            return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2 * self._period + 1.0)
+        else:
+            self.ship_once(final=True)
+        self._client.close()
+
+
+# -- cluster-wide merge helpers ---------------------------------------------
+
+
+def _prefer(a: TaskEvent, b: TaskEvent) -> TaskEvent:
+    """Duplicate task_id (e.g. a task re-executed after node death):
+    prefer the terminal record, then the later-ending one."""
+    a_done, b_done = a.end_s is not None, b.end_s is not None
+    if a_done != b_done:
+        return a if a_done else b
+    if a_done and b_done:
+        return a if a.end_s >= b.end_s else b
+    return a if a.start_s >= b.start_s else b
+
+
+def cluster_task_events(worker) -> List[TaskEvent]:
+    """Every task event this process can see: its own buffer plus — on
+    the cluster head — the aggregator's node-shipped events, deduped by
+    task id and ordered by start time."""
+    buf = getattr(worker, "task_events", None)
+    local = buf.snapshot() if buf is not None else []  # thin client
+    head = getattr(worker, "cluster_head", None)
+    agg = getattr(head, "obs", None) if head is not None else None
+    if agg is None:
+        return local
+    merged: Dict[str, TaskEvent] = {ev.task_id: ev for ev in local}
+    for ev in agg.task_events():
+        cur = merged.get(ev.task_id)
+        merged[ev.task_id] = ev if cur is None else _prefer(ev, cur)
+    out = list(merged.values())
+    out.sort(key=lambda ev: ev.start_s)
+    return out
+
+
+def export_cluster_prometheus(worker) -> str:
+    """One Prometheus exposition for the whole cluster: the head's own
+    registry (runtime gauges refreshed first — the docstring contract
+    of `_private/runtime_metrics.py`) merged with every node's shipped
+    snapshot, node series tagged ``node="<node_id>"``."""
+    from ray_tpu._private.runtime_metrics import collect_runtime_metrics
+    from ray_tpu.util.metrics import render_prometheus, snapshot_registry
+
+    try:
+        collect_runtime_metrics()
+    except Exception:  # noqa: BLE001 — user metrics still export
+        pass
+    snaps: List[Any] = [(snapshot_registry(), None)]
+    head = getattr(worker, "cluster_head", None)
+    agg = getattr(head, "obs", None) if head is not None else None
+    if agg is not None:
+        for node_id, snap in sorted(agg.metrics_snapshots().items()):
+            snaps.append((snap, {"node": node_id}))
+    return render_prometheus(snaps)

@@ -40,10 +40,67 @@ def _set_series(name: str, desc: str, tag_key: str,
     _prev_tags[name] = current
 
 
+def _collect_fastpath_stats() -> None:
+    """Fold the lock-free fast-path stats (`_private/perf_stats.py` —
+    batcher queue delay/flush size, submit→start latency, intern hit
+    rate, SQLite group-commit latency, wait wake-ups, serve route
+    latencies) into the registry as gauges: distributions export
+    ``_p50``/``_p95``/``_count``/``_sum`` series, counters export
+    ``_total``. Computed only here, on scrape — the hot paths pay two
+    integer adds per observation, nothing more."""
+    from ray_tpu._private import perf_stats
+
+    for name, tags, stat in perf_stats.stats_items():
+        tag_keys = tuple(k for k, _ in tags)
+        tag_dict = dict(tags) or None
+        if isinstance(stat, perf_stats.Counter):
+            _gauge(f"ray_tpu_{name}_total",
+                   f"fast-path counter {name}",
+                   tag_keys=tag_keys).set(float(stat.value),
+                                          tags=tag_dict)
+            continue
+        base = f"ray_tpu_{name}"
+        _gauge(f"{base}_p50", f"fast-path {name} p50",
+               tag_keys=tag_keys).set(stat.quantile(0.5), tags=tag_dict)
+        _gauge(f"{base}_p95", f"fast-path {name} p95",
+               tag_keys=tag_keys).set(stat.quantile(0.95), tags=tag_dict)
+        _gauge(f"{base}_count", f"fast-path {name} observations",
+               tag_keys=tag_keys).set(float(stat.total), tags=tag_dict)
+        _gauge(f"{base}_sum", f"fast-path {name} sum",
+               tag_keys=tag_keys).set(stat.sum, tags=tag_dict)
+
+
+def _collect_serve_ingress() -> None:
+    """Live HTTP-ingress gauges (in-flight, open connections, shed and
+    served counters) from every proxy in this process."""
+    try:
+        from ray_tpu.serve._private.http_proxy import aggregate_stats
+    except Exception:
+        return
+    stats = aggregate_stats()
+    if stats is None:
+        return
+    for key, desc in (("in_flight", "HTTP requests in flight"),
+                      ("open_connections", "open ingress connections"),
+                      ("served", "requests served (terminal non-shed)"),
+                      ("shed_503", "requests shed with 503")):
+        _gauge(f"ray_tpu_serve_http_{key}",
+               f"Serve ingress: {desc}").set(float(stats.get(key, 0)))
+
+
 def collect_runtime_metrics() -> None:
     """Refresh the canonical runtime gauges from live state. Cheap
     (reads in-process tables); safe to call on every scrape."""
     from ray_tpu._private import worker as worker_mod
+
+    try:
+        _collect_fastpath_stats()
+    except Exception:
+        pass
+    try:
+        _collect_serve_ingress()
+    except Exception:
+        pass
 
     w = worker_mod.global_worker_or_none()
     if w is None:

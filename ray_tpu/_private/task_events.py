@@ -5,6 +5,11 @@ Role-equivalent to the reference's `TaskEventBuffer`
 execution records state transitions + timing here; the state API
 (`ray_tpu.experimental.state`) queries it and `ray_tpu.timeline()` dumps
 Chrome traces from it (reference `_private/state.py:435`).
+
+Cluster mode: worker-node buffers ship their deltas to the head's
+aggregator (`_private/obs_plane.py`) so timeline/tracing/state views are
+cluster-wide. Shipping drains ``drain_updates`` — a bounded dirty set,
+not a full-buffer scan — off the execution hot path.
 """
 
 from __future__ import annotations
@@ -12,7 +17,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.task_spec import trace_id_of as _trace_id_of
@@ -39,6 +44,38 @@ class TaskEvent:
             return None
         return self.end_s - self.start_s
 
+    def to_dict(self) -> Dict[str, Any]:
+        """Wire-friendly plain dict (str/float/None only — no pickle
+        needed on the shipping channel)."""
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TaskEvent":
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def chrome_trace_events(events) -> List[dict]:
+    """Chrome tracing format (`chrome://tracing` / Perfetto) for any
+    event iterable — the buffer's own dump and the head's cluster-wide
+    ``timeline()`` share this formatter."""
+    out = []
+    now = time.time()
+    for ev in events:
+        end = ev.end_s or now
+        out.append({
+            "name": ev.name,
+            "cat": ev.kind.lower(),
+            "ph": "X",
+            "ts": ev.start_s * 1e6,
+            "dur": (end - ev.start_s) * 1e6,
+            "pid": ev.node_id[:8],
+            "tid": ev.worker,
+            "args": {"task_id": ev.task_id, "state": ev.state,
+                     **({"error": ev.error} if ev.error else {})},
+        })
+    return out
+
 
 class TaskEventBuffer:
     def __init__(self, max_events: int = 100_000):
@@ -46,6 +83,16 @@ class TaskEventBuffer:
         self._events: "collections.OrderedDict[str, TaskEvent]" = \
             collections.OrderedDict()
         self._max = max_events
+        # task_ids updated since the last drain — THE shipping cursor
+        # (drain_updates consumes it; a finish re-marks its task so the
+        # terminal state ships too); bounded by _max through the same
+        # eviction sweep.
+        self._dirty: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+
+    @property
+    def capacity(self) -> int:
+        return self._max
 
     def task_started(self, spec, node_id, worker_name: str) -> None:
         ev = TaskEvent(
@@ -59,8 +106,10 @@ class TaskEventBuffer:
                             else ""))
         with self._lock:
             self._events[ev.task_id] = ev
+            self._dirty[ev.task_id] = None
             while len(self._events) > self._max:
-                self._events.popitem(last=False)
+                evicted, _ = self._events.popitem(last=False)
+                self._dirty.pop(evicted, None)
 
     def task_finished(self, spec, error: Optional[str] = None) -> None:
         with self._lock:
@@ -70,25 +119,49 @@ class TaskEventBuffer:
             ev.end_s = time.time()
             ev.state = "FAILED" if error else "FINISHED"
             ev.error = error or ""
+            self._dirty[ev.task_id] = None
 
     def list_events(self, limit: int = 10_000) -> List[TaskEvent]:
         with self._lock:
             return list(self._events.values())[-limit:]
 
+    def snapshot(self, limit: Optional[int] = None) -> List[TaskEvent]:
+        """The public full-buffer view: every recorded event (or the
+        most recent ``limit``), oldest first. Exporters that must not
+        truncate (span export would drop trace roots out from under
+        their children) use this instead of reaching into the buffer's
+        internals."""
+        with self._lock:
+            events = list(self._events.values())
+        return events if limit is None else events[-limit:]
+
+    def drain_updates(self, limit: int = 2000) -> List[Dict[str, Any]]:
+        """Up to ``limit`` event dicts updated since the previous drain
+        (the node→head shipping delta). Bounded: anything beyond the
+        limit stays dirty for the next cycle, so one burst can never
+        produce an unbounded frame."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            while self._dirty and len(out) < limit:
+                task_id, _ = self._dirty.popitem(last=False)
+                ev = self._events.get(task_id)
+                if ev is not None:
+                    out.append(ev.to_dict())
+        return out
+
+    def remark_dirty(self, task_ids) -> None:
+        """Put drained task ids back on the shipping cursor (the
+        shipper's RPC failed AFTER the drain — without this, events
+        completed in that window would silently never reach the head)."""
+        with self._lock:
+            for task_id in task_ids:
+                if task_id in self._events:
+                    self._dirty[task_id] = None
+
+    def dirty_count(self) -> int:
+        with self._lock:
+            return len(self._dirty)
+
     def chrome_trace(self) -> List[dict]:
         """Chrome tracing format (`chrome://tracing` / Perfetto)."""
-        out = []
-        for ev in self.list_events():
-            end = ev.end_s or time.time()
-            out.append({
-                "name": ev.name,
-                "cat": ev.kind.lower(),
-                "ph": "X",
-                "ts": ev.start_s * 1e6,
-                "dur": (end - ev.start_s) * 1e6,
-                "pid": ev.node_id[:8],
-                "tid": ev.worker,
-                "args": {"task_id": ev.task_id, "state": ev.state,
-                         **({"error": ev.error} if ev.error else {})},
-            })
-        return out
+        return chrome_trace_events(self.snapshot())

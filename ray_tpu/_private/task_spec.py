@@ -68,6 +68,29 @@ def trace_parent_from(parent_spec) -> tuple:
     return (trace_id_of(parent_spec), parent_spec.task_id.hex())
 
 
+# -- ambient trace context --------------------------------------------------
+# Submissions from OUTSIDE any task (e.g. the Serve router dispatching
+# an HTTP request to a replica) have no task context to inherit a trace
+# from; a thread-local ambient parent bridges the gap, so an ingress
+# request's trace id flows proxy → router → replica → any tasks the
+# replica submits (reference: tracing_helper.py's context propagation
+# through non-task callers).
+
+_AMBIENT_TRACE = threading.local()
+
+
+def set_ambient_trace_parent(tp: Optional[tuple]) -> Optional[tuple]:
+    """Install (trace_id_hex, parent_span_id_hex) as this thread's
+    ambient trace parent; returns the previous value for restore."""
+    prev = getattr(_AMBIENT_TRACE, "tp", None)
+    _AMBIENT_TRACE.tp = tp
+    return prev
+
+
+def get_ambient_trace_parent() -> Optional[tuple]:
+    return getattr(_AMBIENT_TRACE, "tp", None)
+
+
 def check_isolate_process(value):
     """isolate_process accepts False (in-thread), True (forked worker),
     or "spawn" (fresh interpreter); anything else is a typo that would
@@ -292,6 +315,13 @@ _TEMPLATES: "collections.OrderedDict[bytes, SpecTemplate]" = \
 _TEMPLATES_MAX = 4096
 _TEMPLATES_LOCK = threading.Lock()
 
+# Intern hit rate (a low hit rate means per-call template rebuilds are
+# back on the hot path — exactly what PR 2 removed).
+from ray_tpu._private import perf_stats as _perf_stats  # noqa: E402
+
+_INTERN_HITS = _perf_stats.counter("intern_hits")
+_INTERN_MISSES = _perf_stats.counter("intern_misses")
+
 
 def _strategy_key(strategy) -> str:
     if strategy is None:
@@ -343,6 +373,10 @@ def intern_template(*, kind: TaskKind, func: Any, name: str,
     tid = h.digest()
     with _TEMPLATES_LOCK:
         tpl = _TEMPLATES.get(tid)
+        if tpl is None:
+            _INTERN_MISSES.inc()
+        else:
+            _INTERN_HITS.inc()
         if tpl is None or tpl.func is not func:
             # Same content but a distinct (equal-bytes) function object:
             # reuse the id, refresh the callable so local execution uses

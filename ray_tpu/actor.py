@@ -15,6 +15,7 @@ from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    get_ambient_trace_parent,
     intern_template,
     trace_parent_from,
     DefaultSchedulingStrategy,
@@ -101,7 +102,8 @@ class ActorHandle:
             actor_id=self._actor_id,
             sequence_number=seq,
             trace_parent=(trace_parent_from(_ctx["task_spec"])
-                          if (_ctx := w.task_context.current()) else None),
+                          if (_ctx := w.task_context.current())
+                          else get_ambient_trace_parent()),
         )
         refs = w.submit(spec)
         # dynamic: the single ref resolves to an ObjectRefGenerator
@@ -187,7 +189,8 @@ class ActorClass:
             TaskID.from_random(), args, kwargs,
             actor_id=actor_id,
             trace_parent=(trace_parent_from(_ctx["task_spec"])
-                          if (_ctx := w.task_context.current()) else None),
+                          if (_ctx := w.task_context.current())
+                          else get_ambient_trace_parent()),
         )
         handle = ActorHandle(
             actor_id, self._cls, name, opts.get("max_task_retries", 0)

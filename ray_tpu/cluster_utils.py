@@ -268,6 +268,10 @@ class ClusterHead:
             "gcs_named_actor_register": self._named_actor_register,
             "gcs_named_actor_get": self._named_actor_get,
             "gcs_named_actor_remove": self._named_actor_remove,
+            # Observability plane: node task-event deltas + metric
+            # snapshots land in the head-side aggregator
+            # (_private/obs_plane.py — the GcsTaskManager role).
+            "obs_report": self._obs_report,
         }, port=port,
            dedupe_methods=frozenset({"gcs_kv_put", "route_task",
                                      "gcs_named_actor_register"}))
@@ -276,6 +280,12 @@ class ClusterHead:
         from ray_tpu._private.pubsub import Publisher
 
         self.publisher = Publisher()
+        # Cluster-wide observability aggregator: node-shipped task
+        # events + per-node metric snapshots (timeline/tracing/state
+        # and the dashboard's merged /api/metrics read this).
+        from ray_tpu._private.obs_plane import ObsAggregator
+
+        self.obs = ObsAggregator()
         self.transfer_addr: Optional[Tuple[str, int]] = None
         # node_id -> local log path (populated by Cluster.add_node).
         self.node_logs: Dict[str, str] = {}
@@ -534,6 +544,10 @@ class ClusterHead:
             len(resubmit), len(dead_actors))
         self.publisher.publish("node_events", {
             "event": "NODE_DEAD", "node_id": node_id, "reason": reason})
+        # A dead node stops scraping-by-proxy: drop its metric snapshot
+        # so the merged exposition doesn't freeze its last values
+        # forever (its task events stay — history outlives the node).
+        self.obs.forget_node(node_id)
         self._fan_out_frees(dead_frees)
         # Restart actors first so resubmitted / queued actor tasks find a
         # live location.
@@ -713,6 +727,9 @@ class ClusterHead:
 
         self.worker.gcs.remove_named_actor_by_id(ActorID(actor_id))
         return True
+
+    def _obs_report(self, node_id: str, events=None, metrics=None):
+        return self.obs.report(node_id, events=events, metrics=metrics)
 
     @staticmethod
     def _gcs_events(limit: int = 200, source=None):

@@ -122,9 +122,35 @@ class DashboardServer:
 
         path = path.split("?")[0].rstrip("/") or "/"
         if path == "/api/metrics":
-            from ray_tpu.util.metrics import export_prometheus
+            # CLUSTER-wide exposition: the head's registry (canonical
+            # runtime gauges refreshed first, per runtime_metrics'
+            # contract) merged with every node's shipped snapshot,
+            # node series tagged node="<id>". Content-Type per the
+            # Prometheus text exposition spec (0.0.4).
+            from ray_tpu._private.obs_plane import (
+                export_cluster_prometheus,
+            )
+            from ray_tpu._private.worker import global_worker
+            from ray_tpu.util.metrics import PROMETHEUS_CONTENT_TYPE
 
-            return export_prometheus().encode(), "text/plain"
+            return (export_cluster_prometheus(global_worker()).encode(),
+                    PROMETHEUS_CONTENT_TYPE)
+        if path == "/api/traces":
+            # OTLP-shaped span export (cluster-wide on a head): the
+            # resourceSpans/scopeSpans envelope any OpenTelemetry
+            # backend expects, spans from experimental.tracing.
+            from ray_tpu.experimental.tracing import export_spans
+
+            body = json.dumps({"resourceSpans": [{
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": "ray_tpu"}}]},
+                "scopeSpans": [{
+                    "scope": {"name": "ray_tpu.experimental.tracing"},
+                    "spans": export_spans(),
+                }],
+            }]}, default=str).encode()
+            return body, "application/json"
         if path == "/ui":
             return _UI_HTML.encode(), "text/html"
         if path == "/api/jobs" or path.startswith("/api/jobs/"):
@@ -144,8 +170,8 @@ class DashboardServer:
                                         "/api/actors", "/api/objects",
                                         "/api/cluster_status",
                                         "/api/serve", "/api/metrics",
-                                        "/api/timeline", "/api/logs",
-                                        "/api/events"]},
+                                        "/api/traces", "/api/timeline",
+                                        "/api/logs", "/api/events"]},
             "/api/nodes": state.list_nodes,
             "/api/tasks": state.list_tasks,
             "/api/actors": state.list_actors,

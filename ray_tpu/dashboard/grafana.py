@@ -96,7 +96,59 @@ def generate_serve_dashboard() -> dict:
         {"title": "Handle queue depth",
          "exprs": [('sum(ray_tpu_serve_queued) by (deployment)',
                     "{{deployment}}")]},
+        {"title": "HTTP route latency", "unit": "s",
+         "exprs": [('ray_tpu_serve_request_seconds_p50', "p50 {{route}}"),
+                   ('ray_tpu_serve_request_seconds_p95',
+                    "p95 {{route}}")]},
+        {"title": "HTTP ingress",
+         "exprs": [("ray_tpu_serve_http_in_flight", "in flight"),
+                   ("ray_tpu_serve_http_open_connections", "connections"),
+                   ("ray_tpu_serve_http_shed_503", "shed (503)")]},
+        {"title": "Replica latency", "unit": "s",
+         "exprs": [('ray_tpu_serve_replica_request_seconds_p95',
+                    "p95 {{deployment}} {{node}}")]},
     ], uid="ray-tpu-serve")
+
+
+def generate_observability_dashboard() -> dict:
+    """Fast-path + shipping-plane panels over the node-tagged series the
+    head's merged /api/metrics exposes (`_private/perf_stats.py` via
+    `runtime_metrics`)."""
+    return generate_dashboard("ray_tpu observability", [
+        {"title": "Batcher queue delay", "unit": "s",
+         "exprs": [("ray_tpu_batcher_queue_delay_seconds_p50",
+                    "p50 {{node}}"),
+                   ("ray_tpu_batcher_queue_delay_seconds_p95",
+                    "p95 {{node}}")]},
+        {"title": "Batcher flush size",
+         "exprs": [("ray_tpu_batcher_flush_items_p50", "p50 {{node}}"),
+                   ("ray_tpu_batcher_flush_items_p95", "p95 {{node}}")]},
+        {"title": "Submit→start latency", "unit": "s",
+         "exprs": [("ray_tpu_sched_submit_to_start_seconds_p50",
+                    "p50 {{node}}"),
+                   ("ray_tpu_sched_submit_to_start_seconds_p95",
+                    "p95 {{node}}")]},
+        {"title": "Template intern hit rate",
+         "exprs": [("rate(ray_tpu_intern_hits_total[1m]) / "
+                    "(rate(ray_tpu_intern_hits_total[1m]) + "
+                    "rate(ray_tpu_intern_misses_total[1m]))",
+                    "hit rate {{node}}")]},
+        {"title": "GCS group-commit", "unit": "s",
+         "exprs": [("ray_tpu_gcs_commit_seconds_p95", "p95"),
+                   ("rate(ray_tpu_gcs_writes_total[1m])",
+                    "writes/s")]},
+        {"title": "Wait path",
+         "exprs": [("rate(ray_tpu_wait_calls_total[1m])", "calls/s"),
+                   ("rate(ray_tpu_wait_snapshot_hits_total[1m])",
+                    "snapshot hits/s"),
+                   ("rate(ray_tpu_wait_wakeups_total[1m])",
+                    "wake-ups/s")]},
+        {"title": "Event shipping",
+         "exprs": [("rate(ray_tpu_obs_shipped_events_total[1m])",
+                    "events/s {{node}}"),
+                   ("rate(ray_tpu_obs_ship_cycles_total[1m])",
+                    "cycles/s {{node}}")]},
+    ], uid="ray-tpu-observability")
 
 
 def write_dashboards(directory: str) -> List[str]:
@@ -105,7 +157,8 @@ def write_dashboards(directory: str) -> List[str]:
     os.makedirs(directory, exist_ok=True)
     out = []
     for dash in (generate_default_dashboard(),
-                 generate_serve_dashboard()):
+                 generate_serve_dashboard(),
+                 generate_observability_dashboard()):
         path = os.path.join(directory, f"{dash['uid']}.json")
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)

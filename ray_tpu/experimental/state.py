@@ -20,7 +20,11 @@ def _worker():
 
 def list_tasks(*, filters: Optional[List[tuple]] = None,
                limit: int = 10_000) -> List[Dict[str, Any]]:
-    events = _worker().task_events.list_events(limit)
+    from ray_tpu._private.obs_plane import cluster_task_events
+
+    # Cluster-wide on a head (node events arrive via the shipping
+    # plane); plain process-local view everywhere else.
+    events = cluster_task_events(_worker())[-limit:]
     rows = [
         {
             "task_id": ev.task_id,
@@ -82,9 +86,11 @@ def list_nodes(**kwargs) -> List[Dict[str, Any]]:
 
 
 def summarize_tasks() -> Dict[str, Any]:
+    from ray_tpu._private.obs_plane import cluster_task_events
+
     counts: Dict[tuple, int] = collections.Counter()
     total_time: Dict[str, float] = collections.defaultdict(float)
-    for ev in _worker().task_events.list_events():
+    for ev in cluster_task_events(_worker()):
         counts[(ev.name, ev.state)] += 1
         if ev.duration_s():
             total_time[ev.name] += ev.duration_s()

@@ -21,14 +21,22 @@ from ray_tpu._private import worker as worker_mod
 
 def export_spans(worker=None) -> List[Dict[str, Any]]:
     """All recorded task spans, OTLP-shaped: traceId / spanId /
-    parentSpanId / name / kind / start-end (ns) / status / attributes."""
+    parentSpanId / name / kind / start-end (ns) / status / attributes.
+
+    On a cluster head this is the CLUSTER-wide view: worker-node events
+    arrive through the shipping plane (`_private/obs_plane.py`), so one
+    request's trace stitches across every node it touched, each span
+    tagged with the node that executed it."""
     import time
+
+    from ray_tpu._private.obs_plane import cluster_task_events
 
     w = worker or worker_mod.global_worker()
     spans = []
-    # The full buffer, not list_events' default 10k tail — a truncated
-    # export would drop trace roots out from under their children.
-    for ev in w.task_events.list_events(limit=w.task_events._max):
+    # The full buffer (public snapshot API), not list_events' default
+    # 10k tail — a truncated export would drop trace roots out from
+    # under their children.
+    for ev in cluster_task_events(w):
         running = ev.end_s is None
         end = time.time() if running else ev.end_s
         spans.append({

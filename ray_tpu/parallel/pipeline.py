@@ -32,6 +32,8 @@ import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+
+from ray_tpu.parallel.collectives import axis_size as _axis_size, shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -43,7 +45,7 @@ def _pipeline_sharded(stage_params, x_mb, stage_fn: Callable,
     [M, mb, ...] microbatched input — only stage 0's copy is consumed.
     Returns [M, mb, ...] outputs (valid on the last stage; replicated back
     by the caller via ppermute)."""
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     stage_idx = lax.axis_index(axis_name)
     n_mb = x_mb.shape[0]
     ticks = n_stages + n_mb - 1
@@ -97,7 +99,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches, *,
     if mesh is None:
         return body(stage_params, x_microbatches)
     param_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, x: body(jax.tree.map(lambda a: a[0], p), x),
         mesh=mesh,
         in_specs=(param_spec, P()),
@@ -273,7 +275,7 @@ def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
         # `pipe` rebuilds the stage-stacked layout of stage_params.
         return loss, jax.tree.map(lambda a: a[None], dstage), dhead, dx
 
-    fn = jax.shard_map(
+    fn = shard_map(
         _shard_body,
         mesh=mesh,
         in_specs=(param_spec, rep, P(), P()),

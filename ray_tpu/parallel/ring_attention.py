@@ -26,6 +26,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from ray_tpu.parallel.collectives import axis_size as _axis_size, shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -59,7 +61,7 @@ def _block_attn(q, k, v, m, l, o, mask):
 
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
     """Body executed per-shard under shard_map. Shapes are local chunks."""
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -117,7 +119,7 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
     if mesh is None:
         return _ring_attention_sharded(q, k, v, axis_name, causal)
     spec = P(("data", "fsdp"), axis_name, "tensor", None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attention_sharded, axis_name=axis_name,
                           causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

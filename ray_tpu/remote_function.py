@@ -15,6 +15,7 @@ from ray_tpu._private.ids import TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
     check_isolate_process,
+    get_ambient_trace_parent,
     intern_template,
     trace_parent_from,
     DefaultSchedulingStrategy,
@@ -133,7 +134,7 @@ class RemoteFunction:
             TaskID.from_random(), args, kwargs,
             depth=(ctx["task_spec"].depth + 1) if ctx else 0,
             trace_parent=(trace_parent_from(ctx["task_spec"])
-                          if ctx else None),
+                          if ctx else get_ambient_trace_parent()),
         )
         refs = w.submit(spec)
         num_returns = tpl.num_returns

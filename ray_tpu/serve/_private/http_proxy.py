@@ -34,18 +34,48 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
+import re
 import socket
 import threading
 import time
+import uuid
+import weakref
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import perf_stats
 from ray_tpu.serve._private.router import QueueSaturatedError
 from ray_tpu.serve.streaming import aiter_stream, is_stream
 
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 32 * 1024 * 1024
 _MAX_PIPELINED = 16
+
+# Structured access log (one line per request, JSON payload), enabled
+# by ray_config.serve_access_log — off by default so the ingress hot
+# path stays log-free.
+_access_log = logging.getLogger("ray_tpu.serve.access")
+
+# Trace ids (client-supplied or minted): token chars only.
+_TRACE_ID_OK = re.compile(r"^[0-9A-Za-z_.-]+$").match
+
+# Live proxies in this process, for the runtime-metrics gauges
+# (ray_tpu_serve_http_in_flight etc.); weak so shutdown proxies drop.
+_PROXIES: "weakref.WeakSet[HTTPProxy]" = weakref.WeakSet()
+
+
+def aggregate_stats() -> Optional[Dict[str, int]]:
+    """Summed ingress counters across every live proxy in this process
+    (None when no proxy exists) — consumed by runtime_metrics."""
+    proxies = list(_PROXIES)
+    if not proxies:
+        return None
+    out: Dict[str, int] = {}
+    for p in proxies:
+        for k, v in p.stats().items():
+            out[k] = out.get(k, 0) + v
+    return out
 
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -68,7 +98,10 @@ class _RouteTable:
         with self._lock:
             self._routes.pop(prefix.rstrip("/") or "/", None)
 
-    def match(self, path: str) -> Tuple[Optional[Any], str]:
+    def match(self, path: str) -> Tuple[Optional[Any], str, str]:
+        """(handle, rest_of_path, matched_prefix). The prefix — a
+        registered route, bounded cardinality — is what metrics and the
+        access log tag requests with, never the raw client path."""
         with self._lock:
             routes = dict(self._routes)
         best = None
@@ -79,9 +112,9 @@ class _RouteTable:
                     len(p) > best_len:
                 best, best_len = (handle, p), len(p)
         if best is None:
-            return None, path
+            return None, path, ""
         handle, p = best
-        return handle, path[len(p):] or "/"
+        return handle, path[len(p):] or "/", p or "/"
 
 
 class _Request:
@@ -116,6 +149,8 @@ class _Conn(asyncio.Protocol):
         self._need: Optional[Tuple[_Request, int]] = None
         self._halt_parse = False  # unparseable framing (chunked body)
         self.http10 = False  # version of the request being handled
+        self.last_status = 0  # status of the most recent response
+        self.trace_id = ""    # trace id of the request being handled
 
     # -- lifecycle -------------------------------------------------------
 
@@ -274,15 +309,19 @@ class _Conn(asyncio.Protocol):
     def send_response(self, status: int, body: bytes, *,
                       keep: bool = True, retry_after: bool = False,
                       content_type: str = "application/json"):
+        self.last_status = status
         if self.closing:
             return
         if status == 200 and keep and not self.http10 \
                 and content_type == "application/json":
             # The hot path (every successful unary JSON reply): one
             # bytes concatenation, no per-header string formatting.
+            trace_hdr = (b"X-Trace-Id: " + self.trace_id.encode()
+                         + b"\r\n") if self.trace_id else b""
             self.transport.write(
                 b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
-                b"\r\nContent-Length: " + str(len(body)).encode()
+                b"\r\n" + trace_hdr
+                + b"Content-Length: " + str(len(body)).encode()
                 + b"\r\n\r\n" + body)
             return
         parts = [
@@ -290,6 +329,8 @@ class _Conn(asyncio.Protocol):
             f"Content-Type: {content_type}",
             f"Content-Length: {len(body)}",
         ]
+        if self.trace_id:
+            parts.append(f"X-Trace-Id: {self.trace_id}")
         if retry_after:
             parts.append("Retry-After: 1")
         if not keep:
@@ -306,10 +347,13 @@ class _Conn(asyncio.Protocol):
             self.transport.close()
 
     def send_header_block(self, status: int, headers):
+        self.last_status = status
         if self.closing:
             return
         parts = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}"]
         parts += [f"{k}: {v}" for k, v in headers]
+        if self.trace_id:
+            parts.append(f"X-Trace-Id: {self.trace_id}")
         self.transport.write(
             ("\r\n".join(parts) + "\r\n\r\n").encode("latin-1"))
 
@@ -357,6 +401,7 @@ class HTTPProxy:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
             raise
+        _PROXIES.add(self)  # runtime-metrics gauges read live proxies
 
     def _loop_main(self):
         asyncio.set_event_loop(self._loop)
@@ -393,27 +438,79 @@ class HTTPProxy:
     # -- request handling ------------------------------------------------
 
     async def _handle(self, conn: _Conn, req: _Request):
+        """Per-request envelope: assign/propagate the trace id, time
+        the request, record per-route/status latency, and emit the
+        access-log line (when enabled). The response logic itself lives
+        in :meth:`_respond`."""
+        from ray_tpu._private.config import ray_config
+
+        t0 = time.monotonic()
+        # Honor a caller-supplied trace id so an upstream LB or client
+        # can stitch the request into ITS trace; mint one otherwise.
+        # STRICTLY sanitized before use: the value is echoed into
+        # response headers and logs, and the request parser only splits
+        # on \r\n — a bare LF smuggled inside the value would otherwise
+        # become response-header injection.
+        supplied = (req.headers.get("x-trace-id", "")
+                    if getattr(req, "headers", None) else "")
+        # Reject (don't mutate): an over-length or non-token value gets
+        # a fresh id — echoing a truncated id would silently break the
+        # caller's correlation.
+        trace_id = supplied if supplied and len(supplied) <= 64 \
+            and _TRACE_ID_OK(supplied) else uuid.uuid4().hex
+        conn.trace_id = trace_id
+        conn.last_status = 0
+        route = ""
+        try:
+            route = await self._respond(conn, req, trace_id)
+        finally:
+            latency = time.monotonic() - t0
+            conn.trace_id = ""
+            status = str(conn.last_status or 0)
+            perf_stats.dist(
+                "serve_request_seconds",
+                tags={"route": route or "(unmatched)",
+                      "status": status},
+                bounds=perf_stats.SERVE_LATENCY_BOUNDS).record(latency)
+            if ray_config.serve_access_log:
+                try:
+                    _access_log.info(json.dumps({
+                        "method": getattr(req, "method", ""),
+                        "route": route or "(unmatched)",
+                        "path": getattr(req, "path", ""),
+                        "status": conn.last_status or 0,
+                        "latency_ms": round(latency * 1e3, 3),
+                        "trace_id": trace_id,
+                    }))
+                except Exception:
+                    pass  # the access log must never break serving
+
+    async def _respond(self, conn: _Conn, req: _Request,
+                       trace_id: str) -> str:
+        """Handle one parsed request; returns the matched route prefix
+        (for metrics/logging)."""
         if req.error is not None:
             status, body = req.error
             conn.send_response(status, body, keep=False)
-            return
+            return ""
         if req.chunked_body:
             conn.send_response(
                 501, b'{"error": "chunked bodies not supported"}',
                 keep=False)
-            return
-        handle, _rest = self.routes.match(req.path.split("?", 1)[0])
+            return ""
+        handle, _rest, route = self.routes.match(
+            req.path.split("?", 1)[0])
         if handle is None:
             conn.send_response(404, b'{"error": "no route"}',
                                keep=req.keep_alive)
-            return
+            return ""
         if self._in_flight >= self.max_in_flight:
             # Load shed: a bounded in-flight cap with an explicit 503
             # instead of the threaded server's unbounded thread growth.
             self._shed += 1
             conn.send_response(503, b'{"error": "server overloaded"}',
                                keep=req.keep_alive, retry_after=True)
-            return
+            return route
         payload: Any = None
         if req.body:
             try:
@@ -423,13 +520,18 @@ class HTTPProxy:
         self._in_flight += 1
         try:
             args = () if payload is None else (payload,)
+            # The request is the trace ROOT: the replica call's parent
+            # span is the request itself, so proxy→router→replica→tasks
+            # all share one trace id.
+            trace = (trace_id, trace_id)
             # Fast path: a free replica slot dispatches synchronously
             # (no coroutine machinery); only saturation parks on the
             # async queue-wait.
-            ref = handle.try_remote(*args)
+            ref = handle.try_remote(*args, _trace=trace)
             if ref is None:
                 ref = await handle.remote_async(
-                    *args, _queue_timeout_s=self.queue_timeout_s)
+                    *args, _queue_timeout_s=self.queue_timeout_s,
+                    _trace=trace)
             fut = ref.as_future(self._loop)
             try:
                 # Bounded replica execution (the threaded proxy's
@@ -451,7 +553,7 @@ class HTTPProxy:
                                  f"{self.result_timeout_s}s"}).encode(),
                     keep=req.keep_alive)
                 self._served += 1
-                return
+                return route
             if is_stream(result):
                 await self._stream_response(conn, req, result)
             else:
@@ -474,6 +576,7 @@ class HTTPProxy:
             self._served += 1
         finally:
             self._in_flight -= 1
+        return route
 
     async def _stream_response(self, conn: _Conn, req: _Request, result):
         """Server-sent events with chunked transfer-encoding: the client
@@ -528,6 +631,7 @@ class HTTPProxy:
                 "open_connections": len(self._conns)}
 
     def shutdown(self):
+        _PROXIES.discard(self)
         if self._loop.is_closed():
             return
 

@@ -19,12 +19,23 @@ import ray_tpu
 class ServeReplica:
     def __init__(self, deployment_name: str, serialized_cls, init_args,
                  init_kwargs, user_config=None, version: str = ""):
+        from ray_tpu._private import perf_stats
+
         self.deployment_name = deployment_name
         self.version = version
         self._lock = threading.Lock()
         self._in_flight = 0
         self._total = 0
         self._t_busy = 0.0
+        # Per-deployment execution latency, recorded in the REPLICA's
+        # process — on a worker node it rides the metric-snapshot
+        # shipping plane to the head's merged /api/metrics.
+        self._stat_latency = perf_stats.dist(
+            "serve_replica_request_seconds",
+            tags={"deployment": deployment_name},
+            bounds=perf_stats.SERVE_LATENCY_BOUNDS)
+        self._stat_errors = perf_stats.counter(
+            "serve_replica_errors", tags={"deployment": deployment_name})
         self._async_loop = None  # lazily-started, shared across requests
         if isinstance(serialized_cls, type):
             self.callable = serialized_cls(*(init_args or ()),
@@ -71,10 +82,15 @@ class ServeReplica:
             if inspect.isgenerator(result):
                 return self._start_stream(result)
             return result
+        except BaseException:
+            self._stat_errors.inc()
+            raise
         finally:
+            elapsed = time.perf_counter() - t0
+            self._stat_latency.record(elapsed)
             with self._lock:
                 self._in_flight -= 1
-                self._t_busy += time.perf_counter() - t0
+                self._t_busy += elapsed
 
     def _ensure_loop(self):
         import asyncio

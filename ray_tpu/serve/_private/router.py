@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.task_spec import set_ambient_trace_parent
 from ray_tpu.serve._private.long_poll import LongPollClient
 
 
@@ -78,12 +79,18 @@ class Router:
             self._in_flight[replica] = list(not_ready)
         return len(self._in_flight.get(replica, []))
 
-    def _try_assign(self, method: str, args: tuple, kwargs: dict):
+    def _try_assign(self, method: str, args: tuple, kwargs: dict,
+                    trace=None):
         """One round-robin dispatch attempt; returns the ref or None if
         every replica is at its in-flight cap. On success the waiting
         count drops under the SAME lock hold as the dispatch — counting
         a request as both waiting and in-flight would double it in the
-        autoscaling signal."""
+        autoscaling signal.
+
+        ``trace`` is the request's (trace_id, parent_span_id): it rides
+        the dispatching thread's ambient trace context so the replica's
+        actor task — and every task the replica then submits — joins
+        the HTTP request's trace."""
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
@@ -95,8 +102,14 @@ class Router:
             with self._lock:
                 load = self._prune(replica)
                 if load < self._max_concurrent:
-                    ref = replica.handle_request.remote(
-                        method, args, kwargs)
+                    prev = set_ambient_trace_parent(trace) \
+                        if trace is not None else None
+                    try:
+                        ref = replica.handle_request.remote(
+                            method, args, kwargs)
+                    finally:
+                        if trace is not None:
+                            set_ambient_trace_parent(prev)
                     self._in_flight[replica].append(ref)
                     self._waiting -= 1
                     self._maybe_report()
@@ -104,14 +117,14 @@ class Router:
         return None
 
     def assign_request(self, method: str, args: tuple, kwargs: dict,
-                       timeout: float = 30.0):
+                       timeout: float = 30.0, trace=None):
         deadline = time.monotonic() + timeout
         dispatched = False
         with self._lock:
             self._waiting += 1
         try:
             while True:
-                ref = self._try_assign(method, args, kwargs)
+                ref = self._try_assign(method, args, kwargs, trace)
                 if ref is not None:
                     dispatched = True
                     return ref
@@ -132,21 +145,22 @@ class Router:
                     self._waiting -= 1
 
     def try_assign_request(self, method: str, args: tuple,
-                           kwargs: dict):
+                           kwargs: dict, trace=None):
         """Non-blocking dispatch: the ref if a replica slot is free
         right now, else None. The event-loop proxy's fast path — no
         coroutine, no parking; saturation falls back to
         :meth:`assign_request_async`."""
         with self._lock:
             self._waiting += 1
-        ref = self._try_assign(method, args, kwargs)
+        ref = self._try_assign(method, args, kwargs, trace)
         if ref is None:
             with self._lock:
                 self._waiting -= 1
         return ref
 
     async def assign_request_async(self, method: str, args: tuple,
-                                   kwargs: dict, timeout: float = 30.0):
+                                   kwargs: dict, timeout: float = 30.0,
+                                   trace=None):
         """Event-loop completion path (the asyncio HTTP proxy's bridge):
         identical dispatch and autoscaling accounting to
         :meth:`assign_request`, but saturation parks the coroutine with
@@ -159,7 +173,7 @@ class Router:
             self._waiting += 1
         try:
             while True:
-                ref = self._try_assign(method, args, kwargs)
+                ref = self._try_assign(method, args, kwargs, trace)
                 if ref is not None:
                     dispatched = True
                     return ref
@@ -224,27 +238,29 @@ class ServeHandle:
             self._router_holder["r"] = r
         return r
 
-    def remote(self, *args, **kwargs):
+    def remote(self, *args, _trace=None, **kwargs):
         return self._router().assign_request(self._method or "__call__",
-                                             args, kwargs)
+                                             args, kwargs, trace=_trace)
 
     def remote_async(self, *args, _queue_timeout_s: float = 30.0,
-                     **kwargs):
+                     _trace=None, **kwargs):
         """Awaitable dispatch for event-loop callers (the asyncio HTTP
         proxy): resolves to the ObjectRef once a replica slot frees,
         without ever blocking the calling loop. ``_queue_timeout_s``
         bounds the wait for a slot — the proxy maps its expiry to
-        ``503 Retry-After`` (load shedding, not an error)."""
+        ``503 Retry-After`` (load shedding, not an error). ``_trace``
+        is the request's (trace_id, parent_span_id); the replica call
+        joins that trace."""
         return self._router().assign_request_async(
             self._method or "__call__", args, kwargs,
-            timeout=_queue_timeout_s)
+            timeout=_queue_timeout_s, trace=_trace)
 
-    def try_remote(self, *args, **kwargs):
+    def try_remote(self, *args, _trace=None, **kwargs):
         """Non-blocking dispatch: the ref now, or None when every
         replica is at its cap (caller then awaits
         :meth:`remote_async` or sheds)."""
         return self._router().try_assign_request(
-            self._method or "__call__", args, kwargs)
+            self._method or "__call__", args, kwargs, trace=_trace)
 
     def __getattr__(self, name: str) -> "ServeHandle":
         if name.startswith("_"):

@@ -102,11 +102,110 @@ class Histogram(Metric):
                     k, [0] * (len(self.boundaries) + 1)))}
 
 
+# Prometheus text exposition format 0.0.4 — scrape endpoints return
+# this Content-Type per the exposition spec.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
 def _fmt_tags(key: Tuple) -> str:
     if not key:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def snapshot_registry() -> dict:
+    """Plain-data snapshot of the whole registry — wire-encodable
+    (str/float/list only), so cluster nodes ship it to the head's
+    aggregator and the dashboard merges snapshots from every node into
+    one exposition. Shape per metric::
+
+        {name: {"kind", "description", "boundaries"?, "series": [...]}}
+
+    Counter/gauge series entries are ``[tag_pairs, value]``; histogram
+    entries are ``[tag_pairs, bucket_counts, sum, count]`` where
+    ``tag_pairs`` is ``[[k, v], ...]`` sorted by key.
+    """
+    with _registry_lock:
+        metrics = list(_registry.values())
+    snap: dict = {}
+    for m in metrics:
+        entry: dict = {"kind": m.kind, "description": m.description}
+        if isinstance(m, (Counter, Gauge)):
+            with m._lock:
+                entry["series"] = [
+                    [[list(kv) for kv in k], v]
+                    for k, v in m._values.items()]
+        elif isinstance(m, Histogram):
+            entry["boundaries"] = list(m.boundaries)
+            with m._lock:
+                entry["series"] = [
+                    [[list(kv) for kv in k], list(counts),
+                     m._sums.get(k, 0.0), m._totals.get(k, 0)]
+                    for k, counts in m._counts.items()]
+        else:
+            entry["series"] = []
+        snap[m.name] = entry
+    return snap
+
+
+def _render_series(lines: List[str], name: str, entry: dict,
+                   extra_tags: Optional[dict]) -> None:
+    extra = tuple(sorted((extra_tags or {}).items()))
+    if entry["kind"] in ("counter", "gauge", "untyped"):
+        for tag_pairs, v in entry.get("series", []):
+            key = tuple(sorted(
+                tuple(kv) for kv in list(tag_pairs) + [list(t) for t
+                                                       in extra]))
+            lines.append(f"{name}{_fmt_tags(key)} {v}")
+        return
+    boundaries = entry.get("boundaries", [])
+    for tag_pairs, counts, total_sum, total in entry.get("series", []):
+        base = {k: v for k, v in tag_pairs}
+        base.update(dict(extra))
+        acc = 0
+        for b, c in zip(boundaries, counts):
+            acc += c
+            tags = dict(base)
+            tags["le"] = str(b)
+            lines.append(
+                f"{name}_bucket{_fmt_tags(tuple(sorted(tags.items())))}"
+                f" {acc}")
+        tags = dict(base)
+        tags["le"] = "+Inf"
+        lines.append(
+            f"{name}_bucket{_fmt_tags(tuple(sorted(tags.items())))}"
+            f" {total}")
+        key = tuple(sorted(base.items()))
+        lines.append(f"{name}_sum{_fmt_tags(key)} {total_sum}")
+        lines.append(f"{name}_count{_fmt_tags(key)} {total}")
+
+
+def render_prometheus(snapshots) -> str:
+    """Merge registry snapshots into one Prometheus text exposition.
+
+    ``snapshots`` is ``[(snapshot, extra_tags_or_None), ...]`` — the
+    dashboard passes the head's snapshot untagged plus one
+    ``{"node": node_id}``-tagged snapshot per cluster node, so every
+    node's series land under shared metric names with a ``node`` label
+    distinguishing them. HELP/TYPE headers are emitted once per name.
+    """
+    by_name: "dict[str, list]" = {}
+    order: List[str] = []
+    for snap, extra in snapshots:
+        for name, entry in snap.items():
+            if name not in by_name:
+                by_name[name] = []
+                order.append(name)
+            by_name[name].append((entry, extra))
+    lines: List[str] = []
+    for name in order:
+        entries = by_name[name]
+        lines.append(f"# HELP {name} {entries[0][0]['description']}")
+        lines.append(f"# TYPE {name} {entries[0][0]['kind']}")
+        for entry, extra in entries:
+            _render_series(lines, name, entry, extra)
+    return "\n".join(lines) + "\n"
 
 
 def export_prometheus() -> str:
@@ -120,28 +219,4 @@ def export_prometheus() -> str:
         collect_runtime_metrics()
     except Exception:  # noqa: BLE001 — user metrics still export
         pass
-    lines: List[str] = []
-    with _registry_lock:
-        metrics = list(_registry.values())
-    for m in metrics:
-        lines.append(f"# HELP {m.name} {m.description}")
-        lines.append(f"# TYPE {m.name} {m.kind}")
-        if isinstance(m, (Counter, Gauge)):
-            for k, v in m._values.items():
-                lines.append(f"{m.name}{_fmt_tags(k)} {v}")
-        elif isinstance(m, Histogram):
-            for k, counts in m._counts.items():
-                acc = 0
-                for b, c in zip(m.boundaries, counts):
-                    acc += c
-                    tags = dict(k)
-                    tags["le"] = str(b)
-                    lines.append(
-                        f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {acc}")
-                tags = dict(k)
-                tags["le"] = "+Inf"
-                lines.append(
-                    f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {m._totals[k]}")
-                lines.append(f"{m.name}_sum{_fmt_tags(k)} {m._sums[k]}")
-                lines.append(f"{m.name}_count{_fmt_tags(k)} {m._totals[k]}")
-    return "\n".join(lines) + "\n"
+    return render_prometheus([(snapshot_registry(), None)])

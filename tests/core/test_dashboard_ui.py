@@ -90,8 +90,19 @@ def test_grafana_dashboard_factory(tmp_path):
     assert len(pos) == 6
 
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 2
+    assert len(paths) == 3  # core, serve, observability
     for p in paths:
         with open(p) as f:
             loaded = json.load(f)
         assert loaded["schemaVersion"] >= 30
+
+    from ray_tpu.dashboard.grafana import (
+        generate_observability_dashboard,
+    )
+
+    obs = generate_observability_dashboard()
+    assert obs["uid"] == "ray-tpu-observability"
+    exprs = " ".join(t["expr"] for p in obs["panels"]
+                     for t in p["targets"])
+    assert "ray_tpu_batcher_queue_delay_seconds_p95" in exprs
+    assert "ray_tpu_sched_submit_to_start_seconds_p95" in exprs

@@ -423,6 +423,115 @@ def test_chunked_request_body_rejected(serve_up):
     conn.close()
 
 
+def test_access_log_and_trace_header_on_keepalive(serve_up, caplog):
+    """The structured access log (off by default, enabled via
+    ray_config.serve_access_log): one JSON line per request — method,
+    route, status, latency_ms, trace_id — across keep-alive stream and
+    unary requests on ONE connection; the response echoes the trace id
+    in X-Trace-Id."""
+    import logging
+
+    from ray_tpu._private.config import ray_config
+
+    @serve.deployment
+    class Mixed:
+        def __call__(self, request):
+            if isinstance(request, dict) and request.get("stream"):
+                def gen():
+                    for i in range(2):
+                        yield {"i": i}
+                return gen()
+            return {"unary": request}
+
+    serve.run(Mixed.bind(), route_prefix="/logged")
+    proxy = serve.start_http_proxy()
+
+    ray_config.serve_access_log = True
+    try:
+        with caplog.at_level(logging.INFO,
+                             logger="ray_tpu.serve.access"):
+            conn = http.client.HTTPConnection(proxy.host, proxy.port,
+                                              timeout=30)
+            for payload in [{"stream": True}, {"x": 1}]:
+                conn.request(
+                    "POST", "/logged", body=json.dumps(payload),
+                    headers={"Content-Type": "application/json",
+                             "X-Trace-Id": "trace-ka-1"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                # Trace id echoes on both unary and streamed replies.
+                assert resp.headers.get("X-Trace-Id") == "trace-ka-1"
+                if payload.get("stream"):
+                    _read_sse(resp)
+                    resp.read()
+                else:
+                    resp.read()
+            conn.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(
+                    caplog.records) < 2:
+                time.sleep(0.05)
+    finally:
+        ray_config.serve_access_log = False
+
+    lines = [json.loads(r.getMessage()) for r in caplog.records]
+    assert len(lines) >= 2
+    for line in lines[:2]:
+        assert line["method"] == "POST"
+        assert line["route"] == "/logged"
+        assert line["status"] == 200
+        assert line["latency_ms"] > 0
+        assert line["trace_id"] == "trace-ka-1"
+
+    # And the request landed in the per-route/status latency stats.
+    from ray_tpu._private import perf_stats
+
+    stat = perf_stats.latency("serve_request_seconds",
+                              tags={"route": "/logged",
+                                    "status": "200"})
+    assert stat.total >= 2
+
+
+def test_request_trace_flows_to_replica_and_tasks(serve_up):
+    """An HTTP request's trace id flows proxy → router → replica actor
+    task → tasks the replica submits: one traceId, parent chain rooted
+    at the request."""
+    from ray_tpu.experimental import tracing
+
+    @serve.deployment
+    class Traced:
+        def __call__(self, request):
+            @ray_tpu.remote
+            def nested(x):
+                return x
+
+            return {"nested": ray_tpu.get(nested.remote(7))}
+
+    serve.run(Traced.bind(), route_prefix="/traced")
+    proxy = serve.start_http_proxy()
+    conn = http.client.HTTPConnection(proxy.host, proxy.port,
+                                      timeout=30)
+    conn.request("POST", "/traced", body=json.dumps({}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    trace_id = resp.headers.get("X-Trace-Id")
+    assert trace_id
+    assert json.loads(resp.read()) == {"nested": 7}
+    conn.close()
+
+    spans = tracing.get_trace(trace_id)
+    names = [s["name"] for s in spans]
+    replica_span = next(s for s in spans
+                        if "handle_request" in s["name"])
+    nested_span = next(s for s in spans if "nested" in s["name"])
+    # The request is the trace root: the replica call hangs off it, the
+    # replica-submitted task hangs off the replica call.
+    assert replica_span["parentSpanId"] == trace_id
+    assert nested_span["traceId"] == trace_id
+    assert nested_span["parentSpanId"] == replica_span["spanId"], names
+
+
 @pytest.mark.slow
 def test_no_head_of_line_starvation_under_load(serve_up):
     """Concurrent keep-alive clients + one slow-streaming client: the
