@@ -85,18 +85,18 @@ def main():
     print(f"proj+CE fwd+bwd:      {t:8.2f} ms")
 
     # one transformer layer fwd at bench shapes (no vocab proj)
-    from ray_tpu.models.llama import DEFAULT_RULES, _init_layer, _layer_fn
+    from ray_tpu.models.llama import DEFAULT_RULES, _init_layer, layer_fn
     from ray_tpu.ops.rope import rope_frequencies
     lp = _init_layer(cfg, key)
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
     xact = jax.random.normal(key, (batch, seq, cfg.dim), jnp.bfloat16)
-    layer_f = jax.jit(lambda x, lp: _layer_fn(
+    layer_f = jax.jit(lambda x, lp: layer_fn(
         cfg, None, DEFAULT_RULES, cos, sin, x, lp, None))
     t = timeit(lambda: layer_f(xact, lp))
     print(f"layer fwd (1 layer):  {t:8.2f} ms  x{cfg.n_layers} = "
           f"{t * cfg.n_layers:.1f}")
 
-    layer_b = jax.jit(jax.grad(lambda x, lp: _layer_fn(
+    layer_b = jax.jit(jax.grad(lambda x, lp: layer_fn(
         cfg, None, DEFAULT_RULES, cos, sin, x, lp, None)
         .astype(jnp.float32).sum(), argnums=(0, 1)))
     t = timeit(lambda: layer_b(xact, lp))

@@ -257,7 +257,7 @@ class NodeRuntime:
 
         def flush():
             with flush_lock:
-                _flush_inner()
+                _flush_inner()  # raylint: disable=R2 -- flush_lock exists ONLY to serialize this flush RPC (loop + pre-report flushes race); nothing else ever contends on it, so holding it across the head call is its entire job
 
         def _flush_inner():
             with lock:
@@ -327,11 +327,11 @@ class NodeRuntime:
         while time.monotonic() < deadline:
             if self.worker.memory_store.contains(oid):
                 return  # produced locally while we were polling
-            from ray_tpu.cluster_utils import (_fetch_backoff,
-                                               _try_shm_fetch,
-                                               _try_transfer_fetch)
+            from ray_tpu.cluster_utils import (fetch_backoff,
+                                               try_shm_fetch,
+                                               try_transfer_fetch)
 
-            if _try_shm_fetch(self.worker, oid):
+            if try_shm_fetch(self.worker, oid):
                 return
             # Local probes (memory store, shm) are cheap and run every
             # attempt; the head locate RPC is rate-limited to every 4th
@@ -341,7 +341,7 @@ class NodeRuntime:
                 info = self.head.call("locate2", oid=oid.binary())
                 if info is not None and \
                         tuple(info["address"]) != self.address:
-                    if _try_transfer_fetch(self.worker, oid, info):
+                    if try_transfer_fetch(self.worker, oid, info):
                         return
                     ok, value, err = RpcClient.to(
                         tuple(info["address"])).call(
@@ -350,7 +350,7 @@ class NodeRuntime:
                         self.worker.memory_store.put(oid, value,
                                                      error=err)
                         return
-            _fetch_backoff(attempt)
+            fetch_backoff(attempt)
             attempt += 1
         raise TimeoutError(f"could not fetch {oid.hex()} from cluster")
 

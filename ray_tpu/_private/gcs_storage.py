@@ -42,7 +42,9 @@ class StoreClient:
         """Make every accepted write durable (no-op for in-memory)."""
 
     def close(self) -> None:
-        pass
+        # API contract (raylint R4): teardown makes accepted writes
+        # durable. Backends overriding close() must keep that promise.
+        self.flush()
 
 
 class InMemoryStoreClient(StoreClient):

@@ -18,7 +18,6 @@ import inspect
 import logging
 import queue
 import threading
-import traceback
 from typing import Any, Dict, Optional
 
 from time import monotonic as _monotonic
@@ -26,7 +25,7 @@ from time import monotonic as _monotonic
 from ray_tpu import exceptions as exc
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
-from ray_tpu._private.resources import MILLI, ResourceSet, to_milli
+from ray_tpu._private.resources import ResourceSet, to_milli
 from ray_tpu._private.task_spec import (
     DefaultSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -451,7 +450,7 @@ class LocalBackend:
             # rates. Grows on demand (a task blocking in get() holds its
             # thread, idle==0 spawns another), shrinks on idle timeout.
             with self._exec_lock:
-                self._exec_q.put((spec, pool, request))
+                self._exec_q.put((spec, pool, request))  # raylint: disable=R2 -- _exec_q is unbounded, so put() cannot block; enqueue + idle-count bookkeeping must be one atomic step or _exec_loop's retire check double-counts idle threads
                 if self._exec_idle == 0:
                     threading.Thread(target=self._exec_loop,
                                      name="task-exec", daemon=True

@@ -13,7 +13,6 @@ and survives rotation/truncation by re-seeking when the file shrinks.
 from __future__ import annotations
 
 import os
-import sys
 import threading
 from typing import Callable, Dict, Optional
 

@@ -24,7 +24,7 @@ server-side.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from ray_tpu._private.ids import ActorID, ObjectID
 from ray_tpu._private.rpc import RpcClient, RpcServer

@@ -363,10 +363,10 @@ class RpcClient:
         with self._lock:
             for attempt in (0, 1):
                 try:
-                    sock = self._ensure()
-                    send_msg(sock, wire.Request(id=rid, method=method,
+                    sock = self._ensure()  # raylint: disable=R2 -- per-connection request/reply serialization IS this client's design: one in-flight call per socket, callers needing concurrency use dedicated/pipelined clients
+                    send_msg(sock, wire.Request(id=rid, method=method,  # raylint: disable=R2 -- see above: the lock IS the request/reply framing discipline for this socket
                                                 kwargs=kwargs))
-                    reply = recv_msg(sock)
+                    reply = recv_msg(sock)  # raylint: disable=R2 -- see above: reply must be read under the same hold that sent the request (TCP ordering is the match)
                     break
                 except (ConnectionError, OSError):
                     self.close_locked()
@@ -388,10 +388,10 @@ class RpcClient:
             rid = f"{self._id_prefix}:{self._seq}"
             for attempt in (0, 1):
                 try:
-                    sock = self._ensure()
-                    send_msg(sock, wire.Request(id=rid, method=method,
+                    sock = self._ensure()  # raylint: disable=R2 -- per-connection request/reply serialization IS this client's design: one in-flight call per socket, callers needing concurrency use dedicated/pipelined clients
+                    send_msg(sock, wire.Request(id=rid, method=method,  # raylint: disable=R2 -- see above: the lock IS the request/reply framing discipline for this socket
                                                 kwargs=kwargs))
-                    reply = recv_msg(sock)
+                    reply = recv_msg(sock)  # raylint: disable=R2 -- see above: reply must be read under the same hold that sent the request (TCP ordering is the match)
                     break
                 except (ConnectionError, OSError):
                     self.close_locked()
@@ -585,13 +585,23 @@ class CoalescingBatcher:
         with self._cond:
             return len(self._items)
 
-    def close(self) -> None:
+    def close(self, drain_timeout: float = 0.0) -> None:
         """Stop accepting items; the flusher drains what was already
         added, then retires (a dropped channel must not leak one parked
-        thread per reconnect cycle)."""
+        thread per reconnect cycle).
+
+        ``drain_timeout > 0`` additionally blocks (via :meth:`flush`)
+        until every already-added item has been handed to send_frame
+        and those sends returned — the shutdown/failover-boundary form,
+        so a group-committed batch cannot die buffered. The default
+        non-blocking form is for failure paths that may run ON the
+        flusher thread itself (where waiting on our own in-flight send
+        could only time out)."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        if drain_timeout > 0:
+            self.flush(drain_timeout)
 
 
 class PipelinedClient:
@@ -648,13 +658,13 @@ class PipelinedClient:
         with self._send_lock:
             if self._closed.is_set():
                 raise ConnectionError("pipelined client closed")
-            sock = self._ensure()
+            sock = self._ensure()  # raylint: disable=R2 -- send lock serializes pipelined writes on one socket by design; replies drain on a separate reader thread, so holds are bounded by sendall
             self._seq += 1
             rid = f"{self._id_prefix}:{self._seq}"
             with self._pending_lock:
                 self._pending[self._seq] = (rid, tag)
             try:
-                send_msg(sock, wire.Request(id=rid, method=method,
+                send_msg(sock, wire.Request(id=rid, method=method,  # raylint: disable=R2 -- see above: frame ordering on the shared socket is the invariant the lock provides
                                             kwargs=kwargs,
                                             ack=self._acked))
             except (ConnectionError, OSError):
@@ -736,7 +746,21 @@ class PipelinedClient:
             self._sock = None
             self._reader = None
 
-    def close(self):
+    def close(self, flush_timeout: float = 0.0):
+        """Tear the channel down. ``flush_timeout > 0`` first waits
+        (via :meth:`flush`) for every sent request to be acknowledged —
+        the clean-shutdown form; a closing channel must not silently
+        drop requests the peer never confirmed. The default immediate
+        form is for failure paths where the peer is already gone and
+        waiting for acks could only time out.
+
+        The flush runs BEFORE ``_closed`` is set: the reader thread
+        exits its drain loop once ``_closed`` is visible, and an early
+        exit would sweep still-pending (about-to-be-acked) requests
+        into the orphan path — exactly the spurious failure-resubmit a
+        clean shutdown exists to avoid."""
+        if flush_timeout > 0:
+            self.flush(flush_timeout)
         self._closed.set()
         with self._send_lock:
             self._teardown()

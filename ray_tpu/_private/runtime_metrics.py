@@ -10,12 +10,36 @@ the gauges and the Prometheus endpoint calls it before rendering.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Callable, Dict, Tuple
 
 from ray_tpu.util.metrics import Gauge
 
 _gauges: Dict[str, Gauge] = {}
 _prev_tags: Dict[str, set] = {}
+
+# Library-side stats sources (serve ingress, …). The core exporter must
+# not import upward into library packages (raylint R3), so libraries
+# register a provider here at import time instead: ``provider() ->
+# Optional[Dict[key, number]]`` plus a key -> (gauge_name, description)
+# series map. A provider returning None contributes nothing this scrape.
+_EXT_PROVIDERS: Dict[str, Tuple[Callable, Dict[str, Tuple[str, str]]]] = {}
+
+
+def register_stats_provider(name: str, provider: Callable,
+                            series: Dict[str, Tuple[str, str]]) -> None:
+    _EXT_PROVIDERS[name] = (provider, series)
+
+
+def _collect_ext_providers() -> None:
+    for provider, series in list(_EXT_PROVIDERS.values()):
+        try:
+            stats = provider()
+        except Exception:
+            continue
+        if stats is None:
+            continue
+        for key, (gauge_name, desc) in series.items():
+            _gauge(gauge_name, desc).set(float(stats.get(key, 0)))
 
 
 def _gauge(name: str, desc: str, tag_keys=()) -> Gauge:
@@ -70,24 +94,6 @@ def _collect_fastpath_stats() -> None:
                tag_keys=tag_keys).set(stat.sum, tags=tag_dict)
 
 
-def _collect_serve_ingress() -> None:
-    """Live HTTP-ingress gauges (in-flight, open connections, shed and
-    served counters) from every proxy in this process."""
-    try:
-        from ray_tpu.serve._private.http_proxy import aggregate_stats
-    except Exception:
-        return
-    stats = aggregate_stats()
-    if stats is None:
-        return
-    for key, desc in (("in_flight", "HTTP requests in flight"),
-                      ("open_connections", "open ingress connections"),
-                      ("served", "requests served (terminal non-shed)"),
-                      ("shed_503", "requests shed with 503")):
-        _gauge(f"ray_tpu_serve_http_{key}",
-               f"Serve ingress: {desc}").set(float(stats.get(key, 0)))
-
-
 def collect_runtime_metrics() -> None:
     """Refresh the canonical runtime gauges from live state. Cheap
     (reads in-process tables); safe to call on every scrape."""
@@ -97,10 +103,7 @@ def collect_runtime_metrics() -> None:
         _collect_fastpath_stats()
     except Exception:
         pass
-    try:
-        _collect_serve_ingress()
-    except Exception:
-        pass
+    _collect_ext_providers()
 
     w = worker_mod.global_worker_or_none()
     if w is None:

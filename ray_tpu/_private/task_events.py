@@ -17,7 +17,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.task_spec import trace_id_of as _trace_id_of

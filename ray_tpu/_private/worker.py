@@ -17,7 +17,7 @@ from typing import Any, Dict, Optional, Sequence
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import state as state_mod
-from ray_tpu._private.ids import JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.local_backend import LocalBackend
 from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.task_spec import TaskSpec

@@ -27,7 +27,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, List, Optional
+from typing import Any, List
 
 import cloudpickle
 
@@ -147,10 +147,10 @@ class WorkerProcess:
         WorkerCrashedError and marks the worker dead."""
         with self._req_lock:
             try:
-                _send_frame(self.sock, msg)
-                kind, payload = _recv_frame(self.sock)
+                _send_frame(self.sock, msg)  # raylint: disable=R2 -- the frame protocol has no request ids: _req_lock IS the one-in-flight request/reply discipline for this worker socket
+                kind, payload = _recv_frame(self.sock)  # raylint: disable=R2 -- see above: the reply must be read under the same hold that sent the request (frame ordering is the match)
             except (EOFError, OSError, BrokenPipeError):
-                self.kill()
+                self.kill()  # raylint: disable=R2 -- the socket is already dead here; kill/reap of a SIGKILLed child returns promptly and racing requesters must observe the dead state, not interleave with it
                 raise exc.WorkerCrashedError(
                     f"worker process {self.pid} died executing a task")
         if kind == "ok":

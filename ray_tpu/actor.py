@@ -8,20 +8,17 @@ an ``ActorHandle`` whose method accessors submit ordered actor tasks.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional
+from typing import Optional
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.resources import normalize_request
-from ray_tpu._private.task_spec import (
-    check_isolate_process,
-    get_ambient_trace_parent,
-    intern_template,
-    trace_parent_from,
-    DefaultSchedulingStrategy,
-    SchedulingStrategy,
-    TaskKind,
-)
+from ray_tpu._private.task_spec import (check_isolate_process,
+                                        get_ambient_trace_parent,
+                                        intern_template,
+                                        trace_parent_from,
+                                        DefaultSchedulingStrategy,
+                                        TaskKind)
 
 _ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources", "name",

@@ -10,7 +10,7 @@ jit-compiled once per actor and batches stream through it.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Type
+from typing import Any, Callable, Type
 
 import numpy as np
 

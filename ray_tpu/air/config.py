@@ -10,7 +10,6 @@ whole ICI slices for the group.
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Union
 

@@ -65,7 +65,9 @@ def _session() -> TrainSession:
     return s
 
 
-def _set_session(s: Optional[TrainSession]) -> None:
+def set_session(s: Optional[TrainSession]) -> None:
+    """Install (or clear, with None) the ambient per-thread train session.
+    Public: the train/tune worker loops are the callers."""
     _local.session = s
 
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 
 class NodeProvider:

@@ -32,7 +32,7 @@ from ray_tpu._private.task_spec import TaskKind
 from ray_tpu.exceptions import ActorDiedError, OwnerDiedError
 
 
-def _fetch_backoff(attempt: int) -> None:
+def fetch_backoff(attempt: int) -> None:
     """Escalating poll interval for object-arrival waits: sub-ms first
     probes (most objects land within a few ms of submission — a flat
     10 ms sleep put a hard floor under every cross-process get), backing
@@ -40,7 +40,7 @@ def _fetch_backoff(attempt: int) -> None:
     time.sleep(min(0.0005 * (1.6 ** min(attempt, 10)), 0.01))
 
 
-def _try_shm_fetch(worker, oid) -> bool:
+def try_shm_fetch(worker, oid) -> bool:
     """Zero-copy read from the node's shared segment, if the object is
     there. Faster and cheaper than any RPC — always tried first."""
     plane = getattr(worker, "shm_plane", None)
@@ -63,7 +63,7 @@ def _try_shm_fetch(worker, oid) -> bool:
 _WIRE_PULL_SLOTS = threading.BoundedSemaphore(2)
 
 
-def _try_transfer_fetch(worker, oid, loc_info) -> bool:
+def try_transfer_fetch(worker, oid, loc_info) -> bool:
     """Chunked native pull from the owner's transfer server into the
     local segment, then zero-copy read — the cross-host object plane
     (reference: ObjectManager Pull, `pull_manager.h:52`). Skipped when
@@ -89,7 +89,7 @@ def _try_transfer_fetch(worker, oid, loc_info) -> bool:
                 _WIRE_PULL_SLOTS.release()
         if rc not in (0, -5):
             return False
-        return _try_shm_fetch(worker, oid)
+        return try_shm_fetch(worker, oid)
     except Exception:
         return False
 
@@ -108,7 +108,7 @@ def batch_fetch_objects(worker, oids, locate, self_address):
     unresolved: list = []
     need = []
     for oid in oids:
-        if store.contains(oid) or _try_shm_fetch(worker, oid):
+        if store.contains(oid) or try_shm_fetch(worker, oid):
             resolved.add(oid)
         else:
             need.append(oid)
@@ -118,7 +118,7 @@ def batch_fetch_objects(worker, oids, locate, self_address):
     by_addr: Dict[tuple, list] = {}
     for oid, info in zip(need, infos):
         if info is not None and tuple(info["address"]) != tuple(self_address):
-            if _try_transfer_fetch(worker, oid, info):
+            if try_transfer_fetch(worker, oid, info):
                 resolved.add(oid)
             else:
                 by_addr.setdefault(tuple(info["address"]), []).append(oid)
@@ -1153,9 +1153,24 @@ class ClusterBackendMixin:
             for item in batch:
                 self._send_submit_frame(node_id, pipe, [item])
 
+    def drain_channels(self, timeout: float = 2.0) -> None:
+        """Shutdown-boundary drain: flush-and-close every submit
+        batcher and pipelined channel so accepted submissions reach the
+        wire (and are acked) before the cluster tears down."""
+        with self._lease_lock:
+            batchers = list(self._batchers.values())
+            pipes = list(self._pipes.values())
+            self._batchers.clear()
+            self._pipes.clear()
+            self._leases.clear()
+        for batcher in batchers:
+            batcher.close(drain_timeout=timeout)
+        for pipe in pipes:
+            pipe.close(flush_timeout=timeout)
+
     def _drop_lease_pipe(self, node_id: str, lease) -> None:
         with self._lease_lock:
-            self._pipes.pop(node_id, None)
+            pipe = self._pipes.pop(node_id, None)
             batcher = self._batchers.pop(node_id, None)
             for ls in self._leases.values():
                 if lease is None:
@@ -1164,6 +1179,8 @@ class ClusterBackendMixin:
                     ls[:] = [l for l in ls if l is not lease]
         if batcher is not None:
             batcher.close()  # flusher drains then retires (no thread leak)
+        if pipe is not None:
+            pipe.close()  # immediate: the channel is already broken
 
     def _pipe_error(self, tag, message: str, rid: str, lost: bool):
         """Async failure from a pipelined channel (reader thread)."""
@@ -1407,7 +1424,7 @@ class ClusterBackendMixin:
                    if isinstance(a, ObjectRef) and not store.contains(a.id)]
         for oid in missing:
             def fetch(oid=oid):
-                if _try_shm_fetch(self.worker, oid):
+                if try_shm_fetch(self.worker, oid):
                     return
                 # Transport failures are retried until the deadline (a
                 # brief owner stall must not poison the object); if the
@@ -1425,7 +1442,7 @@ class ClusterBackendMixin:
                     info = head._locate2(oid.binary())
                     if info is not None and \
                             tuple(info["address"]) != head.server.address:
-                        if _try_transfer_fetch(self.worker, oid, info):
+                        if try_transfer_fetch(self.worker, oid, info):
                             return
                         try:
                             ok, value, err = RpcClient.to(
@@ -1438,7 +1455,7 @@ class ClusterBackendMixin:
                         if ok:
                             store.put(oid, value, error=err)
                             return
-                    _fetch_backoff(attempt)
+                    fetch_backoff(attempt)
                     attempt += 1
                 if transport_err is not None and not store.contains(oid):
                     store.put(oid, None, error=OwnerDiedError(
@@ -2123,6 +2140,13 @@ class Cluster:
         return self.head._get_nodes()
 
     def shutdown(self):
+        # Drain the group-committed submit channels BEFORE tearing nodes
+        # down: a batch parked in a CoalescingBatcher or an un-acked
+        # pipelined request is an accepted submission, and the shutdown
+        # boundary is exactly where a non-draining close would lose it.
+        backend = getattr(self.driver_worker, "backend", None)
+        if isinstance(backend, ClusterBackendMixin):
+            backend.drain_channels(timeout=2.0)
         self.head.stop()
         for node_id in list(self._procs):
             self.remove_node(node_id)

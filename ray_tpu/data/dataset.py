@@ -11,28 +11,24 @@ from the object store and stages them host→HBM ahead of the train step).
 from __future__ import annotations
 
 import builtins
-import functools
-from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from typing import Any, Callable, Iterator, List, Optional, Union
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data import datasource as ds_mod
-from ray_tpu.data.plan import (
-    ActorPoolStrategy,
-    ExecutionPlan,
-    FromBlocks,
-    Limit,
-    LogicalOp,
-    MapBlocks,
-    RandomShuffle,
-    Read,
-    Repartition,
-    Sort,
-    Union as UnionOp,
-    Zip,
-)
+from ray_tpu.data.plan import (ActorPoolStrategy,
+                               ExecutionPlan,
+                               FromBlocks,
+                               Limit,
+                               MapBlocks,
+                               RandomShuffle,
+                               Read,
+                               Repartition,
+                               Sort,
+                               Union as UnionOp,
+                               Zip)
 
 
 def _batch_formatter(fmt: str):

@@ -9,7 +9,7 @@ on the pipeline apply per window; iteration drains windows in order.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, Optional
+from typing import Any, Iterator, List, Optional
 
 from ray_tpu.data.plan import ExecutionPlan, FromBlocks, Read, ReadTasks
 

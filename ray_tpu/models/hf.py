@@ -15,7 +15,7 @@ split-half convention, so Q/K weights are permuted accordingly (standard
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 

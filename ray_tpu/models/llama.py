@@ -19,14 +19,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
-from ray_tpu.ops.attention import flash_attention, _attention_reference
+from ray_tpu.ops.attention import flash_attention, attention_reference
 from ray_tpu.ops.cross_entropy import (fused_linear_cross_entropy,
                                        softmax_cross_entropy)
 from ray_tpu.ops.norms import rms_norm_reference
@@ -215,7 +215,7 @@ def _attention(cfg: LlamaConfig, q, k, v, mesh, rules):
         return fn(q, k, v, mesh=mesh, axis_name="seq", causal=True)
     # reference
     rep = cfg.n_heads // cfg.n_kv_heads
-    out = _attention_reference(
+    out = attention_reference(
         q.transpose(0, 2, 1, 3),
         jnp.repeat(k, rep, axis=2).transpose(0, 2, 1, 3),
         jnp.repeat(v, rep, axis=2).transpose(0, 2, 1, 3),
@@ -223,7 +223,7 @@ def _attention(cfg: LlamaConfig, q, k, v, mesh, rules):
     return out.transpose(0, 2, 1, 3)
 
 
-def _layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
+def layer_fn(cfg: LlamaConfig, mesh, rules, cos, sin, x, lp, positions):
     """One transformer block. x: [B, S, D]."""
     h = rms_norm_reference(x, lp["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
@@ -295,7 +295,7 @@ def forward_hidden(params, tokens, cfg: LlamaConfig, *, mesh=None,
     x = with_logical_constraint(x, "batch", "seq", "act_embed",
                                 mesh=mesh, rules=rules)
 
-    body = functools.partial(_layer_fn, cfg, mesh, rules, cos, sin)
+    body = functools.partial(layer_fn, cfg, mesh, rules, cos, sin)
 
     def scan_body(x, lp):
         return body(x, lp, positions), None

@@ -17,8 +17,7 @@ round.
 
 from __future__ import annotations
 
-import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 HBM_PER_CHIP = {
     "v5e": 16.0,       # GiB

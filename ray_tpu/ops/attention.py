@@ -526,7 +526,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
 # ---------------------------------------------------------------------------
 
 
-def _attention_reference(q, k, v, causal: bool, sm_scale: float):
+def attention_reference(q, k, v, causal: bool, sm_scale: float):
     """[B, H, S, D] layout. GQA-aware."""
     b, h, sq, d = q.shape
     h_kv = k.shape[1]
@@ -601,5 +601,5 @@ def flash_attention(q, k, v, *, causal: bool = True,
         out = _flash(qt, kt, vt, causal, sm_scale, block_q, block_k,
                      bool(interpret) and not on_tpu)
     else:
-        out = _attention_reference(qt, kt, vt, causal, sm_scale)
+        out = attention_reference(qt, kt, vt, causal, sm_scale)
     return out.transpose(0, 2, 1, 3)

@@ -15,7 +15,7 @@ for host-side data) lives in `ray_tpu.util.collective`.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp

@@ -27,7 +27,7 @@ collectives (all-reduce per layer) are the most latency-sensitive.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 from typing import Optional, Sequence
 
 import numpy as np

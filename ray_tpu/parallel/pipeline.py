@@ -321,8 +321,8 @@ def llama_pp_parts(cfg, params, *, n_stages: int):
 
     def stage_fn(layers_slice, x):
         def body(h, lp):
-            return _llama._layer_fn(cfg, None, _llama.DEFAULT_RULES,
-                                    cos, sin, h, lp, None), None
+            return _llama.layer_fn(cfg, None, _llama.DEFAULT_RULES,
+                                   cos, sin, h, lp, None), None
 
         x, _ = lax.scan(body, x, layers_slice)
         return x

@@ -30,7 +30,7 @@ import jax
 from ray_tpu.parallel.collectives import axis_size as _axis_size, shard_map
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG_INF = -1e30
 

@@ -15,7 +15,6 @@ from __future__ import annotations
 import functools
 from typing import Callable, Optional
 
-import jax
 
 from ray_tpu.parallel.collectives import shard_map
 from jax import lax

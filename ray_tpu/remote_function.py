@@ -8,7 +8,6 @@ handle whose ``.remote(...)`` submits a TaskSpec and returns ObjectRef(s);
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import TaskID

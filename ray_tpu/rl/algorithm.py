@@ -95,6 +95,16 @@ class AlgorithmConfig:
              if k not in ("algo_class",)}
         return d
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "AlgorithmConfig":
+        """Round-trip counterpart of :meth:`to_dict` (raylint R5:
+        serialization contracts come in pairs). ``algo_class`` is not
+        serialized; re-bind with ``.build()`` via a bound subclass."""
+        cfg = cls.__new__(cls)
+        cfg.__dict__.update(copy.deepcopy(d))
+        cfg.algo_class = None
+        return cfg
+
 
 class WorkerSet:
     """Reference: `rllib/evaluation/worker_set.py` — the rollout fleet."""

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 import ray_tpu
-from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, _dqn_update
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
     flatten_fragments,

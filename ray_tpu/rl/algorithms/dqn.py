@@ -24,14 +24,7 @@ from ray_tpu.rl.replay_buffer import (
     ReplayBuffer,
     flatten_fragments,
 )
-from ray_tpu.rl.sample_batch import (
-    ACTIONS,
-    DONES,
-    NEXT_OBS,
-    OBS,
-    REWARDS,
-    SampleBatch,
-)
+from ray_tpu.rl.sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS
 
 
 class DQNConfig(AlgorithmConfig):

@@ -32,15 +32,12 @@ from ray_tpu.rl import models
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.env import make_env
 from ray_tpu.rl.learner import Learner, LearnerGroup, LearnerThread
-from ray_tpu.rl.sample_batch import (
-    ACTIONS,
-    DONES,
-    LOGPS,
-    NEXT_OBS,
-    OBS,
-    REWARDS,
-    SampleBatch,
-)
+from ray_tpu.rl.sample_batch import (ACTIONS,
+                                     DONES,
+                                     LOGPS,
+                                     NEXT_OBS,
+                                     OBS,
+                                     REWARDS)
 
 
 class IMPALAConfig(AlgorithmConfig):

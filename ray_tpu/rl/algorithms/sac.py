@@ -24,14 +24,11 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, WorkerSet
 from ray_tpu.rl.env import make_env
 from ray_tpu.rl.replay_buffer import (ReplayBuffer, flatten_fragments,
                                       sample_stacked)
-from ray_tpu.rl.sample_batch import (
-    ACTIONS,
-    NEXT_OBS,
-    OBS,
-    REWARDS,
-    SampleBatch,
-    TERMINATEDS,
-)
+from ray_tpu.rl.sample_batch import (ACTIONS,
+                                     NEXT_OBS,
+                                     OBS,
+                                     REWARDS,
+                                     TERMINATEDS)
 
 
 class SACConfig(AlgorithmConfig):

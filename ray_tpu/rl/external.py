@@ -17,7 +17,7 @@ from __future__ import annotations
 import queue
 import threading
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 

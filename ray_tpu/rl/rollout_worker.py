@@ -8,12 +8,12 @@ Policy inference on workers is CPU jax (batched over the vector env).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.env import VectorEnv
 from ray_tpu.rl.sample_batch import (
     ACTIONS,
     DONES,

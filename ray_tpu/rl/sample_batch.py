@@ -6,7 +6,7 @@ concat/split/shuffle, plus the standard column names.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List
+from typing import Iterator, List
 
 import numpy as np
 

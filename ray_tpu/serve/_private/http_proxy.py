@@ -77,6 +77,24 @@ def aggregate_stats() -> Optional[Dict[str, int]]:
             out[k] = out.get(k, 0) + v
     return out
 
+
+# The core exporter must not import serve (raylint R3): the ingress
+# registers its stats source with runtime_metrics instead, keeping the
+# dependency pointing downward. Gauge names are unchanged.
+from ray_tpu._private import runtime_metrics as _runtime_metrics  # noqa: E402
+
+_runtime_metrics.register_stats_provider(
+    "serve_http_ingress", aggregate_stats, {
+        "in_flight": ("ray_tpu_serve_http_in_flight",
+                      "Serve ingress: HTTP requests in flight"),
+        "open_connections": ("ray_tpu_serve_http_open_connections",
+                             "Serve ingress: open ingress connections"),
+        "served": ("ray_tpu_serve_http_served",
+                   "Serve ingress: requests served (terminal non-shed)"),
+        "shed_503": ("ray_tpu_serve_http_shed_503",
+                     "Serve ingress: requests shed with 503"),
+    })
+
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     413: "Payload Too Large",

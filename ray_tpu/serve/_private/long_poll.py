@@ -9,7 +9,7 @@ route-table changes this way instead of polling hot loops.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 
 class LongPollHost:

@@ -11,7 +11,7 @@ node-affinity / SPREAD options to front every node of a cluster.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 import ray_tpu
 from ray_tpu.serve._private.http_proxy import HTTPProxy

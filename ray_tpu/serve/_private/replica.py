@@ -9,8 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
-import traceback
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import ray_tpu
 

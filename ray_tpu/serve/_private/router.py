@@ -11,7 +11,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import ray_tpu
 from ray_tpu._private.task_spec import set_ambient_trace_parent
@@ -34,6 +34,11 @@ class Router:
         self._replicas: List[Any] = []
         self._rr = itertools.count()
         self._in_flight: Dict[Any, List] = {}
+        # Slots claimed under the lock but whose dispatch RPC is still
+        # being sent OUTSIDE it (see _try_assign): counted against the
+        # per-replica cap so concurrent dispatchers can't oversubscribe
+        # a replica while a send is in flight.
+        self._reserved: Dict[Any, int] = {}
         self._lock = threading.Condition()
         self._client = LongPollClient(
             controller, f"replicas::{deployment_name}",
@@ -83,9 +88,15 @@ class Router:
                     trace=None):
         """One round-robin dispatch attempt; returns the ref or None if
         every replica is at its in-flight cap. On success the waiting
-        count drops under the SAME lock hold as the dispatch — counting
-        a request as both waiting and in-flight would double it in the
-        autoscaling signal.
+        count drops under the SAME lock hold as the slot accounting —
+        counting a request as both waiting and in-flight would double
+        it in the autoscaling signal.
+
+        The dispatch RPC itself runs OUTSIDE the lock (raylint R2: a
+        `.remote()` submission can stall on batcher backpressure, and
+        the router lock serializes every other dispatcher). The slot is
+        claimed under the lock via ``_reserved`` first, so the cap
+        stays exact while the send is in flight.
 
         ``trace`` is the request's (trace_id, parent_span_id): it rides
         the dispatching thread's ambient trace context so the replica's
@@ -100,20 +111,37 @@ class Router:
         for i in range(n):
             replica = replicas[(start + i) % n]
             with self._lock:
-                load = self._prune(replica)
-                if load < self._max_concurrent:
-                    prev = set_ambient_trace_parent(trace) \
-                        if trace is not None else None
-                    try:
-                        ref = replica.handle_request.remote(
-                            method, args, kwargs)
-                    finally:
-                        if trace is not None:
-                            set_ambient_trace_parent(prev)
-                    self._in_flight[replica].append(ref)
-                    self._waiting -= 1
-                    self._maybe_report()
-                    return ref
+                load = self._prune(replica) \
+                    + self._reserved.get(replica, 0)
+                if load >= self._max_concurrent:
+                    continue
+                self._reserved[replica] = \
+                    self._reserved.get(replica, 0) + 1
+            dispatched = False
+            try:
+                prev = set_ambient_trace_parent(trace) \
+                    if trace is not None else None
+                try:
+                    ref = replica.handle_request.remote(
+                        method, args, kwargs)
+                finally:
+                    if trace is not None:
+                        set_ambient_trace_parent(prev)
+                dispatched = True
+            finally:
+                # Reserved→in-flight handoff under ONE hold: a gap
+                # between the decrement and the append would leave the
+                # dispatched request counted by neither, letting a
+                # concurrent dispatcher oversubscribe the cap.
+                with self._lock:
+                    self._reserved[replica] -= 1
+                    if dispatched:
+                        self._in_flight.setdefault(
+                            replica, []).append(ref)
+                        self._waiting -= 1
+                        total = self._pending_report_locked()
+            self._send_report(total)
+            return ref
         return None
 
     def assign_request(self, method: str, args: tuple, kwargs: dict,
@@ -137,7 +165,8 @@ class Router:
                 # scale-up signal (reference: handle queue metrics count
                 # queued + ongoing, `_private/autoscaling_metrics.py`).
                 with self._lock:
-                    self._maybe_report()
+                    total = self._pending_report_locked()
+                self._send_report(total)
                 time.sleep(0.005)
         finally:
             if not dispatched:
@@ -169,7 +198,7 @@ class Router:
 
         deadline = time.monotonic() + timeout
         dispatched = False
-        with self._lock:
+        with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
             self._waiting += 1
         try:
             while True:
@@ -181,37 +210,48 @@ class Router:
                     raise QueueSaturatedError(
                         f"no replica available for {self._deployment} "
                         f"within {timeout}s")
-                with self._lock:
-                    self._maybe_report()
+                with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
+                    total = self._pending_report_locked()
+                self._send_report(total)
                 await asyncio.sleep(0.002)
         finally:
             if not dispatched:
-                with self._lock:
+                with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
                     self._waiting -= 1
 
-    def _maybe_report(self):
+    def _pending_report_locked(self):
+        """Under the lock: the metric total to ship, or None inside the
+        rate-limit window. The RPC itself (`_send_report`) happens with
+        the lock RELEASED — a slow/backpressured controller send must
+        never stall request dispatch (raylint R2)."""
         now = time.monotonic()
         if now - self._last_report < 0.5:
-            return
+            return None
         self._last_report = now
-        total = sum(len(v) for v in self._in_flight.values()) \
-            + self._waiting
+        return float(sum(len(v) for v in self._in_flight.values())
+                     + self._waiting)
+
+    def _send_report(self, total):
+        if total is None:
+            return
         try:
             self._controller.record_handle_metrics.remote(
-                self._deployment, float(total))
+                self._deployment, total)
         except Exception:
             pass
 
     def _report_loop(self):
         was_busy = False
         while not self._reporter_stop.wait(1.0):
+            total = None
             with self._lock:
                 busy = self._waiting > 0 or any(
                     self._prune(r) for r in list(self._in_flight))
                 if busy or was_busy:  # final 0 on the drain edge
                     self._last_report = 0.0  # bypass the rate limit
-                    self._maybe_report()
+                    total = self._pending_report_locked()
                 was_busy = busy
+            self._send_report(total)
 
     def shutdown(self):
         self._reporter_stop.set()

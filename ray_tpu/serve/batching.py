@@ -13,10 +13,12 @@ import functools
 import queue
 import threading
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional
+from typing import Callable, List, Optional
 
 
 class _Batcher:
+    _STOP = object()  # drain sentinel: queued work ahead of it still runs
+
     def __init__(self, fn: Callable, max_batch_size: int,
                  batch_wait_timeout_s: float):
         self.fn = fn
@@ -25,9 +27,12 @@ class _Batcher:
         self.queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._closed = False
 
     def _ensure_thread(self):
         with self._lock:
+            if self._closed:
+                return
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(target=self._loop,
                                                 daemon=True,
@@ -40,14 +45,47 @@ class _Batcher:
                 first = self.queue.get(timeout=5.0)
             except queue.Empty:
                 return  # idle thread exits; recreated on demand
+            if first is self._STOP:
+                return
             batch = [first]
             deadline = self.timeout
             while len(batch) < self.max_batch_size:
                 try:
-                    batch.append(self.queue.get(timeout=deadline))
+                    item = self.queue.get(timeout=deadline)
                 except queue.Empty:
                     break
+                if item is self._STOP:
+                    # Re-queue so the outer get observes it AFTER this
+                    # (already accepted) batch has run.
+                    self.queue.put(self._STOP)
+                    break
+                batch.append(item)
             self._run(batch)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop the drain thread. Work queued before the call still
+        runs — the sentinel lands behind it — and anything that raced
+        past the closed check gets its Future failed, so no accepted
+        request is left permanently pending."""
+        with self._lock:
+            self._closed = True
+            t = self._thread
+        if t is not None and t.is_alive():
+            self.queue.put(self._STOP)
+            t.join(timeout)
+        # A submit() that passed the closed check before we set it may
+        # have enqueued BEHIND the sentinel; fail those futures rather
+        # than strand their callers.
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is self._STOP:
+                continue
+            fut, _ = item
+            if not fut.done():
+                fut.set_exception(RuntimeError("batcher is shut down"))
 
     def _run(self, batch: List[tuple]):
         futures = [f for f, _ in batch]
@@ -67,7 +105,15 @@ class _Batcher:
 
     def submit(self, item) -> Future:
         f: Future = Future()
-        self.queue.put((f, item))
+        # Check-and-enqueue under the lock: shutdown() sets _closed
+        # under the same lock before its final drain, so an accepted
+        # put is always visible to that drain (or to a live thread) —
+        # no caller can be stranded between the two.
+        with self._lock:
+            if self._closed:
+                f.set_exception(RuntimeError("batcher is shut down"))
+                return f
+            self.queue.put((f, item))  # raylint: disable=R2 -- unbounded queue, put() cannot block; closed-check + enqueue must be one atomic step or shutdown's final drain can miss an accepted item
         self._ensure_thread()
         return f
 
@@ -135,6 +181,12 @@ class _BatchWrapper:
 
     def __call__(self, *args):
         return self._submit(args).result()
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Drain and stop every per-instance batcher thread (replica
+        teardown hook); queued work still runs before threads retire."""
+        for b in list(self._batchers.values()):
+            b.shutdown(timeout)
 
     async def aio(self, *args):
         # Async batch wakeup: the batcher thread's set_result lands on

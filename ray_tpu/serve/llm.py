@@ -554,12 +554,12 @@ class LLMEngine:
                 for k in range(next_host.shape[1]):
                     tok = int(next_host[slot, k])
                     req.tokens.append(tok)
-                    req.out_queue.put(tok)
+                    req.out_queue.put(tok)  # raylint: disable=R2 -- per-request stream queues are unbounded, so put() cannot block; token delivery and slot-state mutation must share one hold or a racing admit could reuse the slot mid-block
                     self._lengths[slot] += 1
                     self._last_token[slot] = tok
                     if self._finished(req, tok) or \
                             self._lengths[slot] >= self.max_seq - 1:
-                        self._retire(slot)
+                        self._retire(slot)  # raylint: disable=R2 -- _retire only pushes the unbounded-queue end-of-stream sentinel and frees the slot; both must be atomic with the walk above
                         break
 
     def _finished(self, req: _Request, token: int) -> bool:

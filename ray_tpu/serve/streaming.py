@@ -73,7 +73,11 @@ async def aiter_stream(result: Any, timeout: float = 60.0):
                 return
             yield item
     finally:
+        # Non-blocking teardown: the kill is a synchronous control
+        # RPC, and this finally runs ON the proxy's event loop — the
+        # blocking form would stall every other in-flight request
+        # until the round-trip finished.
         try:
-            queue.shutdown()
+            queue.shutdown(block=False)
         except Exception:
             pass

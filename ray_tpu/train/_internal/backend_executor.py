@@ -130,12 +130,12 @@ def _wrap_with_collective(train_fn: Callable, world_size: int, rank: int,
         collective.init_collective_group(world_size, rank,
                                          group_name=group_name)
         # The default group alias lets user code omit the group name.
-        collective._groups()["default"] = collective._groups()[group_name]
+        collective.set_default_group(group_name)
         try:
             if config is not None:
                 return train_fn(config)
             return train_fn()
         finally:
-            collective._groups().pop("default", None)
+            collective.clear_default_group()
 
     return wrapped

@@ -14,7 +14,6 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air import session as session_mod
-from ray_tpu.air.checkpoint import Checkpoint
 
 
 @ray_tpu.remote
@@ -41,7 +40,7 @@ class TrainWorker:
         self._error = None
 
         def run():
-            session_mod._set_session(self._session)
+            session_mod.set_session(self._session)
             try:
                 if config is not None:
                     train_fn(config)
@@ -51,7 +50,7 @@ class TrainWorker:
                 self._error = traceback.format_exc()
                 self._error_obj = e
             finally:
-                session_mod._set_session(None)
+                session_mod.set_session(None)
                 self._done.set()
 
         self._thread = threading.Thread(target=run, daemon=True,

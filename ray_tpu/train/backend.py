@@ -10,7 +10,6 @@ backends instead wire host-level collective groups and/or
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 
 @dataclass

@@ -18,10 +18,10 @@ equivalent; see `prepare_ddp`/`allreduce_gradients`.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Optional
 
 from ray_tpu.air import session
-from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.config import ScalingConfig
 from ray_tpu.parallel.mesh import MeshConfig, create_mesh
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer

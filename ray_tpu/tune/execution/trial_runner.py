@@ -11,13 +11,12 @@ clone-and-perturb via `clone_trial`.
 
 from __future__ import annotations
 
-import time
 import traceback
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
-from ray_tpu.air.config import CheckpointConfig, FailureConfig
+from ray_tpu.air.config import FailureConfig
 from ray_tpu.tune.experiment.trial import Trial
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
 from ray_tpu.tune.stopper import Stopper

@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List
 
 from ray_tpu.air.result import Result
 from ray_tpu.tune.experiment.trial import Trial

@@ -8,7 +8,7 @@ semantics used by the variant generator.
 from __future__ import annotations
 
 import random as _random
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, Sequence
 
 
 class Domain:

@@ -11,7 +11,6 @@ from __future__ import annotations
 import random as _random
 from typing import Any, Dict, Optional
 
-from ray_tpu.tune.search.sample import Domain
 
 
 class Searcher:

@@ -102,7 +102,7 @@ class FunctionTrainable(Trainable):
             checkpoint=self._restore_checkpoint)
 
         def run():
-            session_mod._set_session(self._session)
+            session_mod.set_session(self._session)
             try:
                 try:
                     self._fn(config)
@@ -115,7 +115,7 @@ class FunctionTrainable(Trainable):
                 self._error = e
                 self._tb = traceback.format_exc()
             finally:
-                session_mod._set_session(None)
+                session_mod.set_session(None)
                 self._finished.set()
 
         self._thread = threading.Thread(target=run, daemon=True,

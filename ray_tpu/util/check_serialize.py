@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import inspect
 import pickle
-from typing import Any, List, Set, Tuple
+from typing import Any, Set, Tuple
 
 
 def inspect_serializability(obj: Any, name: str = None,

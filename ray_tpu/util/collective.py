@@ -208,6 +208,18 @@ def init_collective_group(world_size: int, rank: int,
                                         actor, shards)
 
 
+def set_default_group(group_name: str) -> None:
+    """Alias an initialized group as ``"default"`` so user code can call
+    the collective ops without naming a group (the train-loop wrapper's
+    contract). Public: reaching into the registry from other packages
+    is a layering violation (raylint R3)."""
+    _groups()["default"] = _groups()[group_name]
+
+
+def clear_default_group() -> None:
+    _groups().pop("default", None)
+
+
 def destroy_collective_group(group_name: str = "default") -> None:
     st = _groups().pop(group_name, None)
     if st is not None:

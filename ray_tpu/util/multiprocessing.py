@@ -6,7 +6,6 @@ are remote tasks instead of forked processes.
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu

@@ -23,9 +23,6 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private.ids import PlacementGroupID
 from ray_tpu._private.resources import ResourceSet, to_milli
-from ray_tpu._private.task_spec import (
-    PlacementGroupSchedulingStrategy,
-)
 from ray_tpu._private import worker as worker_mod
 from ray_tpu import exceptions as exc
 

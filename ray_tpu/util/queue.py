@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import queue as _pyqueue
 import threading
-from typing import Any, List, Optional
+from typing import Optional
 
 import ray_tpu
 
@@ -108,5 +108,19 @@ class Queue:
         their loop (the HTTP proxy's SSE stream pump)."""
         return self.actor.get.remote(timeout or 1e9)
 
-    def shutdown(self):
-        ray_tpu.kill(self.actor)
+    def shutdown(self, block: bool = True):
+        """Kill the backing actor. ``block=False`` hands the kill (a
+        synchronous control-plane RPC in cluster mode) to a daemon
+        thread — the variant event-loop consumers must use, since the
+        blocking form would stall every coroutine on their loop."""
+        if block:
+            ray_tpu.kill(self.actor)
+            return
+        threading.Thread(target=self._kill_quietly, daemon=True,
+                         name="queue-shutdown").start()
+
+    def _kill_quietly(self):
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass  # actor already dead / session torn down

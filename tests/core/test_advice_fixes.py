@@ -54,7 +54,7 @@ def test_flash_attention_ragged_blocks(sq, sk, causal):
     import jax
     import jax.numpy as jnp
 
-    from ray_tpu.ops.attention import _attention_reference, flash_attention
+    from ray_tpu.ops.attention import attention_reference, flash_attention
 
     key = jax.random.PRNGKey(0)
     b, h, d = 2, 2, 32
@@ -63,7 +63,7 @@ def test_flash_attention_ragged_blocks(sq, sk, causal):
     v = jax.random.normal(jax.random.PRNGKey(2), (b, sk, h, d), jnp.float32)
     out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
                           interpret=True)
-    ref = _attention_reference(
+    ref = attention_reference(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), causal, d ** -0.5).transpose(0, 2, 1, 3)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

@@ -1,0 +1,548 @@
+"""Per-rule raylint fixtures: each rule demonstrably catches its seeded
+violation (positive), stays quiet on the compliant twin (negative), and
+honors a justified inline suppression (suppressed).
+
+These are the analyzer's own regression tests — `test_raylint.py` only
+proves the tree is clean, which would also be true of an analyzer that
+checks nothing.
+"""
+
+import os
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:  # `tools` must resolve from the repo root
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.raylint.core import analyze_source  # noqa: E402
+from tools.raylint.rules import select_rules  # noqa: E402
+
+
+def lint(src, rule_ids, module="ray_tpu.fixture_mod", relpath=None):
+    return analyze_source(textwrap.dedent(src), select_rules(rule_ids),
+                          module=module, relpath=relpath)
+
+
+def active(violations):
+    return [v for v in violations if not v.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# R1 async-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_r1_flags_time_sleep_in_coroutine():
+    vs = active(lint("""
+        import time
+
+
+        async def handler():
+            time.sleep(0.1)
+    """, ["R1"]))
+    assert len(vs) == 1 and vs[0].rule == "R1"
+    assert "time.sleep" in vs[0].message
+    assert vs[0].line == 6
+
+
+def test_r1_flags_lock_future_and_queue_on_loop():
+    vs = active(lint("""
+        async def handler(self, fut, q):
+            with self._lock:
+                pass
+            fut.result()
+            q.get()
+    """, ["R1"]))
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3
+    assert "self._lock" in msgs and "fut.result" in msgs \
+        and "q.get" in msgs
+
+
+def test_r1_negative_awaited_and_nested_sync_def():
+    vs = active(lint("""
+        import asyncio
+        import time
+
+
+        async def handler(loop):
+            await asyncio.sleep(0.1)
+
+            def blocking_helper():  # runs in an executor, not the loop
+                time.sleep(1.0)
+
+            await loop.run_in_executor(None, blocking_helper)
+    """, ["R1"]))
+    assert vs == []
+
+
+def test_r1_suppressed_with_justification():
+    vs = lint("""
+        import time
+
+
+        async def handler():
+            time.sleep(0.1)  # raylint: disable=R1 -- startup-only path, loop not yet serving
+    """, ["R1"])
+    assert len(vs) == 1 and vs[0].suppressed
+    assert vs[0].justification.startswith("startup-only")
+    assert active(vs) == []
+
+
+# ---------------------------------------------------------------------------
+# R2 lock discipline
+# ---------------------------------------------------------------------------
+
+LOCK_ORDER_CYCLE = """
+    import threading
+
+
+    class Store:
+        def __init__(self):
+            self._meta_lock = threading.Lock()
+            self._data_lock = threading.Lock()
+
+        def read(self):
+            with self._meta_lock:
+                with self._data_lock:
+                    return 1
+
+        def write(self):
+            with self._data_lock:
+                with self._meta_lock:
+                    return 2
+"""
+
+
+def test_r2_lock_order_cycle_fixture():
+    vs = active(lint(LOCK_ORDER_CYCLE, ["R2"]))
+    cycles = [v for v in vs if "lock-order cycle" in v.message]
+    assert len(cycles) == 1
+    assert "_meta_lock" in cycles[0].message
+    assert "_data_lock" in cycles[0].message
+
+
+def test_r2_consistent_order_is_clean():
+    vs = active(lint("""
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._meta_lock = threading.Lock()
+                self._data_lock = threading.Lock()
+
+            def read(self):
+                with self._meta_lock:
+                    with self._data_lock:
+                        return 1
+
+            def write(self):
+                with self._meta_lock:
+                    with self._data_lock:
+                        return 2
+    """, ["R2"]))
+    assert vs == []
+
+
+def test_r2_blocking_rpc_under_lock_direct_and_transitive():
+    vs = active(lint("""
+        import threading
+
+
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _push(self, sock, payload):
+                sock.sendall(payload)
+
+            def direct(self, sock, payload):
+                with self._lock:
+                    sock.sendall(payload)
+
+            def transitive(self, sock, payload):
+                with self._lock:
+                    self._push(sock, payload)
+    """, ["R2"]))
+    assert len(vs) == 2
+    direct = [v for v in vs if "blocking call `sock.sendall`" in v.message]
+    trans = [v for v in vs if "call to `_push` which blocks" in v.message]
+    assert len(direct) == 1 and len(trans) == 1
+
+
+def test_r2_remote_submission_and_callback_under_lock():
+    vs = active(lint("""
+        import threading
+
+
+        class Dispatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dispatch(self, replica, on_done):
+                with self._lock:
+                    ref = replica.handle.remote()
+                    on_done(ref)
+    """, ["R2"]))
+    msgs = "\n".join(v.message for v in vs)
+    assert ".remote()` submission" in msgs
+    assert "user callback `on_done`" in msgs
+
+
+def test_r2_condvar_own_lock_wait_is_clean():
+    vs = active(lint("""
+        import threading
+
+
+        class WaitGroup:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def wait(self):
+                with self._cond:
+                    while not self._ready():
+                        self._cond.wait()
+
+            def _ready(self):
+                return True
+    """, ["R2"]))
+    assert vs == []
+
+
+def test_r2_suppressed_with_justification():
+    vs = lint("""
+        import threading
+
+
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def call(self, sock, payload):
+                with self._lock:
+                    sock.sendall(payload)  # raylint: disable=R2 -- the lock IS the per-socket framing discipline
+    """, ["R2"])
+    assert len(vs) == 1 and vs[0].suppressed
+    assert active(vs) == []
+
+
+# ---------------------------------------------------------------------------
+# R3 layering
+# ---------------------------------------------------------------------------
+
+
+def test_r3_core_importing_library_flagged():
+    vs = active(lint("""
+        from ray_tpu.serve.llm import LLMEngine
+    """, ["R3"], module="ray_tpu._private.metrics_exporter",
+        relpath="ray_tpu/_private/metrics_exporter.py"))
+    assert len(vs) == 1
+    assert "imports library package `ray_tpu.serve`" in vs[0].message
+
+
+def test_r3_cross_package_private_import_and_attr_read():
+    vs = active(lint("""
+        from ray_tpu.serve._private.router import Router
+        from ray_tpu._private import task_events
+
+        buffered = task_events._max
+    """, ["R3"], module="ray_tpu.tune.trainable",
+        relpath="ray_tpu/tune/trainable.py"))
+    msgs = "\n".join(v.message for v in vs)
+    assert "private namespace" in msgs
+    assert "task_events._max" in msgs
+
+
+def test_r3_own_package_private_use_is_clean():
+    vs = active(lint("""
+        from ray_tpu.serve._private.router import Router
+    """, ["R3"], module="ray_tpu.serve.api",
+        relpath="ray_tpu/serve/api.py"))
+    assert vs == []
+
+
+def test_r3_library_importing_core_public_is_clean():
+    vs = active(lint("""
+        from ray_tpu.util.metrics import Gauge
+    """, ["R3"], module="ray_tpu.serve.llm",
+        relpath="ray_tpu/serve/llm.py"))
+    assert vs == []
+
+
+def test_r3_suppressed_with_justification():
+    vs = lint("""
+        from ray_tpu.serve._private.router import Router  # raylint: disable=R3 -- test-only shim, removed with the next router API rev
+    """, ["R3"], module="ray_tpu.tune.trainable",
+        relpath="ray_tpu/tune/trainable.py")
+    assert len(vs) == 1 and vs[0].suppressed
+    assert active(vs) == []
+
+
+# ---------------------------------------------------------------------------
+# R4 resource lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_r4_thread_attr_without_teardown():
+    vs = active(lint("""
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                pass
+    """, ["R4"]))
+    assert len(vs) == 1
+    assert "no teardown method" in vs[0].message
+
+
+def test_r4_thread_attr_with_teardown_is_clean():
+    vs = active(lint("""
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                self._thread.join()
+    """, ["R4"]))
+    assert vs == []
+
+
+def test_r4_group_commit_close_without_flush():
+    vs = active(lint("""
+        class Writer:
+            def flush(self):
+                pass
+
+            def close(self):
+                self._conn = None
+    """, ["R4"]))
+    assert len(vs) == 1
+    assert "without flush()/commit()" in vs[0].message
+
+
+def test_r4_group_commit_close_with_flush_is_clean():
+    vs = active(lint("""
+        class Writer:
+            def flush(self):
+                pass
+
+            def close(self):
+                self.flush()
+                self._conn = None
+    """, ["R4"]))
+    assert vs == []
+
+
+def test_r4_unclosed_socket_and_nondaemon_thread():
+    vs = active(lint("""
+        import socket
+        import threading
+
+
+        def probe(addr):
+            sock = socket.create_connection(addr)
+            sock.sendall(b"ping")
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn).start()
+    """, ["R4"]))
+    msgs = "\n".join(v.message for v in vs)
+    assert "`sock` is never closed" in msgs
+    assert "non-daemon fire-and-forget Thread" in msgs
+
+
+def test_r4_socket_closed_or_returned_is_clean():
+    vs = active(lint("""
+        import socket
+
+
+        def probe(addr):
+            sock = socket.create_connection(addr)
+            try:
+                sock.sendall(b"ping")
+            finally:
+                sock.close()
+
+        def connect(addr):
+            sock = socket.create_connection(addr)
+            return sock
+    """, ["R4"]))
+    assert vs == []
+
+
+def test_r4_suppressed_with_justification():
+    vs = lint("""
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._loop, daemon=True)  # raylint: disable=R4 -- process-lifetime pump, dies with the interpreter by design
+    """, ["R4"])
+    assert len(vs) == 1 and vs[0].suppressed
+    assert active(vs) == []
+
+
+# ---------------------------------------------------------------------------
+# R5 wire hygiene
+# ---------------------------------------------------------------------------
+
+WIRE_KW = dict(module="ray_tpu._private.wire",
+               relpath="ray_tpu/_private/wire.py")
+
+
+def test_r5_unregistered_frame_flagged():
+    vs = active(lint("""
+        class TaskCall:
+            task_id: bytes
+            depth: int
+    """, ["R5"], **WIRE_KW))
+    assert len(vs) == 1
+    assert "not registered with @message" in vs[0].message
+
+
+def test_r5_registered_frame_with_scalar_fields_is_clean():
+    vs = active(lint("""
+        @message("TaskCall", version=1)
+        class TaskCall:
+            task_id: bytes
+            depth: int
+    """, ["R5"], **WIRE_KW))
+    assert vs == []
+
+
+def test_r5_duplicate_name_bad_version_and_rich_field():
+    vs = active(lint("""
+        @message("Frame", version=1)
+        class A:
+            x: int
+
+
+        @message("Frame", version=VERSION)
+        class B:
+            ref: ObjectRef
+    """, ["R5"], **WIRE_KW))
+    msgs = "\n".join(v.message for v in vs)
+    assert "duplicate wire name 'Frame'" in msgs
+    assert "version must be a literal int" in msgs
+    assert "unsupported wire field type `ObjectRef`" in msgs
+
+
+def test_r5_to_dict_without_from_dict_any_module():
+    vs = active(lint("""
+        class TaskEvent:
+            def to_dict(self):
+                return {}
+    """, ["R5"]))
+    assert len(vs) == 1
+    assert "to_dict without from_dict" in vs[0].message
+
+
+def test_r5_matched_pair_with_classmethod_is_clean():
+    vs = active(lint("""
+        class TaskEvent:
+            def to_dict(self):
+                return {}
+
+            @classmethod
+            def from_dict(cls, d):
+                return cls()
+    """, ["R5"]))
+    assert vs == []
+
+
+def test_r5_instance_method_from_dict_flagged():
+    vs = active(lint("""
+        class TaskEvent:
+            def to_dict(self):
+                return {}
+
+            def from_dict(self, d):
+                return TaskEvent()
+    """, ["R5"]))
+    assert len(vs) == 1
+    assert "classmethod/staticmethod" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# R6 unused imports
+# ---------------------------------------------------------------------------
+
+
+def test_r6_unused_import_flagged_used_and_noqa_clean():
+    vs = active(lint("""
+        import os
+        import sys
+        from typing import Dict  # noqa: F401  (re-export)
+
+
+        def f():
+            return sys.platform
+    """, ["R6"]))
+    assert len(vs) == 1
+    assert "`os`" in vs[0].message
+
+
+def test_r6_init_py_reexports_skipped():
+    vs = active(lint("""
+        from ray_tpu.serve.api import deployment
+    """, ["R6"], module="ray_tpu.serve",
+        relpath="ray_tpu/serve/__init__.py"))
+    assert vs == []
+
+
+def test_r6_string_annotation_counts_as_use():
+    vs = active(lint("""
+        from typing import Optional
+
+
+        def f(x: "Optional") -> None:
+            return None
+    """, ["R6"]))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# R0 meta rule: suppressions must be justified
+# ---------------------------------------------------------------------------
+
+
+def test_r0_bare_suppression_fails_and_does_not_suppress():
+    vs = lint("""
+        import time
+
+
+        async def handler():
+            time.sleep(0.1)  # raylint: disable=R1
+    """, ["R1"])
+    act = active(vs)
+    rules = sorted(v.rule for v in act)
+    assert rules == ["R0", "R1"], (
+        "a bare disable must both fail R0 and leave the original "
+        "violation active")
+
+
+def test_suppression_only_covers_named_rules():
+    vs = lint("""
+        import time
+
+
+        async def handler():
+            time.sleep(0.1)  # raylint: disable=R2 -- wrong rule named
+    """, ["R1"])
+    act = active(vs)
+    assert [v.rule for v in act] == ["R1"]

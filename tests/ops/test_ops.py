@@ -13,7 +13,7 @@ from ray_tpu.ops import (
     rope_frequencies,
     softmax_cross_entropy,
 )
-from ray_tpu.ops.attention import _attention_reference
+from ray_tpu.ops.attention import attention_reference
 from ray_tpu.ops.cross_entropy import softmax_cross_entropy_reference
 from ray_tpu.ops.norms import rms_norm_pallas, rms_norm_reference
 
@@ -28,7 +28,7 @@ def test_flash_attention_interpret_matches_reference(causal):
     v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
     got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
                           interpret=True)
-    expected = _attention_reference(
+    expected = attention_reference(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), causal, d ** -0.5,
     ).transpose(0, 2, 1, 3)
@@ -44,7 +44,7 @@ def test_flash_attention_gqa():
     k = jax.random.normal(kk, (b, s, h_kv, d), jnp.float32)
     v = jax.random.normal(kv, (b, s, h_kv, d), jnp.float32)
     got = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
-    expected = _attention_reference(
+    expected = attention_reference(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), True, d ** -0.5,
     ).transpose(0, 2, 1, 3)
@@ -63,7 +63,7 @@ def test_flash_attention_grad():
                                interpret=True).sum()
 
     def loss_ref(q, k, v):
-        return _attention_reference(
+        return attention_reference(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), True, d ** -0.5).sum()
 
@@ -94,7 +94,7 @@ def test_flash_attention_grad_pallas_bwd(causal, shape):
                                block_k=64, interpret=True)
 
     def ref(q, k, v):
-        return _attention_reference(
+        return attention_reference(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), causal, d ** -0.5,
         ).transpose(0, 2, 1, 3)

@@ -1,0 +1,291 @@
+"""Regression tests for the concurrency fixes the raylint rules drove.
+
+Each test pins ONE fixed invariant:
+
+- R2 (router): the dispatch RPC runs with the router lock RELEASED,
+  and the ``_reserved`` slot accounting keeps the per-replica cap exact
+  while a send is in flight (no oversubscription, no lock-holding).
+- R2 (router): the controller metrics report is sent with the lock
+  released — a stalled controller send must never block dispatchers.
+- R1 (util.queue): ``Queue.shutdown(block=False)`` returns without
+  waiting on the kill RPC — the form event-loop consumers
+  (``aiter_stream`` teardown) must use.
+- R4 (rpc): ``CoalescingBatcher.close(drain_timeout=...)`` hands every
+  accepted item to send_frame before returning (the shutdown-boundary
+  contract); the default close stays non-blocking.
+- R4 (serve.batch): ``_Batcher.shutdown`` retires the drain thread and
+  still completes work queued before the call.
+- R4 (gcs): the base ``StoreClient.close`` flushes, so every backend
+  inherits durability at teardown unless it overrides both.
+- R4 (cluster): ``drain_channels`` flush-closes every submit batcher
+  and pipelined channel exactly once at the shutdown boundary.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.rpc import CoalescingBatcher
+from ray_tpu.serve._private.router import Router
+
+
+class _FakeMethod:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _FakeController:
+    """Enough controller surface for a Router: long-poll listens fail
+    (the client backs off quietly) and metric reports are recorded."""
+
+    def __init__(self):
+        self.reports = []
+        self.listen = _FakeMethod(self._listen)
+        self.record_handle_metrics = _FakeMethod(
+            lambda dep, total: self.reports.append((dep, total)))
+
+    def _listen(self, *a, **k):
+        raise RuntimeError("no controller in this test")
+
+
+class _Replica:
+    def __init__(self, fn):
+        self.handle_request = _FakeMethod(fn)
+
+
+def _make_router(replica, max_concurrent):
+    router = Router(_FakeController(), "dep",
+                    max_concurrent_queries=max_concurrent)
+    router._update_replicas([replica])
+    return router
+
+
+def test_router_lock_released_during_dispatch(ray_start_regular):
+    """The fixed invariant itself: while the dispatch RPC executes,
+    another thread can take the router lock."""
+    lock_free_during_send = []
+
+    def handle(method, args, kwargs):
+        # Probe from ANOTHER thread: the router lock is a Condition
+        # over an RLock, so probing from this thread would succeed
+        # reentrantly even if dispatch still held it.
+        result = []
+
+        def probe():
+            got = router._lock.acquire(timeout=1.0)
+            result.append(got)
+            if got:
+                router._lock.release()
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        lock_free_during_send.append(result[0])
+        return ray_tpu.put("ok")
+
+    router = _make_router(_Replica(handle), max_concurrent=4)
+    try:
+        ref = router.try_assign_request("__call__", (), {})
+        assert ref is not None and ray_tpu.get(ref) == "ok"
+        assert lock_free_during_send == [True], (
+            "router lock was held across the dispatch RPC")
+    finally:
+        router.shutdown()
+
+
+def test_router_reserved_slots_prevent_oversubscription(
+        ray_start_regular):
+    """A dispatch mid-send counts against the cap: a concurrent
+    dispatcher must get None, not a second slot on the same replica."""
+    in_send = threading.Event()
+    release = threading.Event()
+    refs = []
+
+    def handle(method, args, kwargs):
+        in_send.set()
+        assert release.wait(5.0)
+        return ray_tpu.put("ok")
+
+    router = _make_router(_Replica(handle), max_concurrent=1)
+    try:
+        t = threading.Thread(
+            target=lambda: refs.append(
+                router.try_assign_request("__call__", (), {})))
+        t.start()
+        assert in_send.wait(5.0)
+        # First dispatch is parked inside the send; its slot is only
+        # *reserved* (not yet in _in_flight) — the cap must still hold.
+        assert router.try_assign_request("__call__", (), {}) is None
+        release.set()
+        t.join(5.0)
+        assert refs and refs[0] is not None
+        assert ray_tpu.get(refs[0]) == "ok"
+    finally:
+        router.shutdown()
+
+
+def test_router_metrics_report_sent_outside_lock(ray_start_regular):
+    """A controller send that itself needs the router lock (worst-case
+    stand-in for 'slow send') must not deadlock the reporter path."""
+    controller = _FakeController()
+    recorded = []
+
+    def record(dep, total):
+        # Would deadlock if _send_report ran under router._lock.
+        got = router._lock.acquire(timeout=1.0)
+        assert got, "metrics report was sent while holding router lock"
+        router._lock.release()
+        recorded.append(total)
+
+    controller.record_handle_metrics = _FakeMethod(record)
+    router = Router(controller, "dep", max_concurrent_queries=4)
+    router._update_replicas(
+        [_Replica(lambda m, a, k: ray_tpu.put("ok"))])
+    try:
+        router._last_report = 0.0  # open the rate-limit window
+        ref = router.try_assign_request("__call__", (), {})
+        assert ref is not None
+        assert recorded, "dispatch did not ship a metrics report"
+    finally:
+        router.shutdown()
+
+
+def test_queue_shutdown_nonblocking_returns_promptly(
+        ray_start_regular, monkeypatch):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+
+    killed = threading.Event()
+    real_kill = ray_tpu.kill
+
+    def slow_kill(actor, **kw):
+        time.sleep(0.5)
+        real_kill(actor, **kw)
+        killed.set()
+
+    monkeypatch.setattr(ray_tpu, "kill", slow_kill)
+    t0 = time.monotonic()
+    q.shutdown(block=False)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.3, (
+        f"shutdown(block=False) blocked for {elapsed:.2f}s — it must "
+        f"hand the kill RPC to a worker thread (event-loop callers)")
+    assert killed.wait(5.0), "async shutdown never killed the actor"
+
+
+def test_batcher_close_drain_timeout_delivers_everything():
+    sent = []
+    gate = threading.Event()
+
+    def send(batch):
+        gate.wait(5.0)  # first frame parks until the test says go
+        sent.extend(batch)
+        time.sleep(0.01)
+
+    batcher = CoalescingBatcher(send, name="test-drain")
+    for i in range(50):
+        batcher.add(i)
+    gate.set()
+    batcher.close(drain_timeout=5.0)
+    assert sorted(sent) == list(range(50)), (
+        "close(drain_timeout) returned before every accepted item was "
+        "handed to send_frame")
+    with pytest.raises(ConnectionError):
+        batcher.add(99)
+
+
+def test_batcher_default_close_stays_nonblocking():
+    release = threading.Event()
+
+    def send(batch):
+        release.wait(5.0)
+
+    batcher = CoalescingBatcher(send, name="test-noblock")
+    batcher.add(1)
+    time.sleep(0.05)  # let the flusher pick the frame up and park
+    t0 = time.monotonic()
+    batcher.close()  # failure-path form: must not wait on our own send
+    assert time.monotonic() - t0 < 0.2
+    release.set()
+
+
+def test_serve_batch_shutdown_drains_then_retires():
+    from ray_tpu.serve.batching import batch
+
+    calls = []
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    def handler(items):
+        calls.append(list(items))
+        return [x * 2 for x in items]
+
+    futures = [handler._submit((i,)) for i in range(6)]
+    handler.shutdown(timeout=5.0)
+    assert [f.result(timeout=5.0) for f in futures] == [
+        0, 2, 4, 6, 8, 10], "queued work was dropped by shutdown"
+    for b in handler._batchers.values():
+        assert not b._thread.is_alive(), "batcher thread not retired"
+
+
+def test_serve_batch_submit_after_shutdown_fails_fast():
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+    def handler(items):
+        return items
+
+    assert handler._submit((1,)).result(timeout=5.0) == 1
+    handler.shutdown(timeout=5.0)
+    f = handler._submit((2,))
+    with pytest.raises(RuntimeError, match="shut down"):
+        f.result(timeout=5.0)
+
+
+def test_store_client_base_close_flushes():
+    from ray_tpu._private.gcs_storage import StoreClient
+
+    class Recorder(StoreClient):
+        def __init__(self):
+            self.flushed = 0
+
+        def flush(self):
+            self.flushed += 1
+
+    rec = Recorder()
+    rec.close()
+    assert rec.flushed == 1, (
+        "StoreClient.close must flush — backends inheriting close() "
+        "get the at-teardown durability contract for free")
+
+
+def test_cluster_drain_channels_flush_closes_once():
+    from ray_tpu.cluster_utils import ClusterBackendMixin
+
+    class FakeChannel:
+        def __init__(self):
+            self.closed_with = []
+
+        def close(self, drain_timeout=None, flush_timeout=None):
+            self.closed_with.append((drain_timeout, flush_timeout))
+
+    backend = ClusterBackendMixin.__new__(ClusterBackendMixin)
+    backend._lease_lock = threading.Lock()
+    batcher, pipe = FakeChannel(), FakeChannel()
+    backend._batchers = {"n1": batcher}
+    backend._pipes = {"n1": pipe}
+    backend._leases = {"shape": [{"node_id": "n1"}]}
+
+    backend.drain_channels(timeout=1.5)
+    assert batcher.closed_with == [(1.5, None)]
+    assert pipe.closed_with == [(None, 1.5)]
+    assert not backend._batchers and not backend._pipes \
+        and not backend._leases
+    backend.drain_channels(timeout=1.5)  # idempotent
+    assert batcher.closed_with == [(1.5, None)]

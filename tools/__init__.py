@@ -1,0 +1,1 @@
+"""Developer tooling for the ray_tpu repo (not shipped with the runtime)."""

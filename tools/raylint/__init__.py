@@ -1,0 +1,14 @@
+"""raylint: concurrency- and invariant-checking static analysis for the
+ray_tpu codebase. Run ``python -m tools.raylint ray_tpu/`` or see the
+"Static analysis" section of the README for the rule catalog."""
+
+from tools.raylint.core import (  # noqa: F401
+    FileInfo,
+    Report,
+    Rule,
+    Violation,
+    analyze,
+    analyze_source,
+    collect_files,
+    run_rules,
+)

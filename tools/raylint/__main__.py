@@ -1,0 +1,74 @@
+"""CLI: ``python -m tools.raylint [paths] [--json] [--rule R1,R2] ...``
+
+Exit-code contract (stable; the tier-1 test and any CI hook rely on it):
+  0  no unsuppressed violations
+  1  unsuppressed violations found
+  2  usage error / analysis crash (bad path, unknown rule, parse error)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.raylint",
+        description="AST-based concurrency/invariant linter for ray_tpu")
+    parser.add_argument(
+        "paths", nargs="*", default=["ray_tpu"],
+        help="files or directories to lint (default: ray_tpu)")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable JSON report on stdout")
+    parser.add_argument(
+        "--rule", default=None,
+        help="comma-separated rule ids to run (e.g. R1,R3); default all")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed violations (pretty mode)")
+    args = parser.parse_args(argv)
+
+    from tools.raylint.core import analyze
+    from tools.raylint.rules import rules_by_id, select_rules
+
+    if args.list_rules:
+        for rid, cls in sorted(rules_by_id().items()):
+            print(f"{rid}  {cls.name:<18} {cls.description}")
+        return 0
+
+    try:
+        rules = select_rules(
+            args.rule.split(",") if args.rule else None)
+    except KeyError as e:
+        print(f"raylint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"raylint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    try:
+        report = analyze(args.paths, rules=rules)
+    except SyntaxError as e:
+        print(f"raylint: parse error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(report.to_json())
+    else:
+        if args.show_suppressed:
+            for v in report.suppressed:
+                print(v.render())
+        print(report.render_pretty())
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

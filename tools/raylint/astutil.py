@@ -1,0 +1,172 @@
+"""Shared AST helpers: dotted-name resolution and the blocking-call
+predicate that R1 (async-blocking) and R2 (lock discipline) both use.
+
+"Blocking" here means: may park the calling thread for an unbounded or
+operator-visible time — sleeps, socket/file I/O, subprocess, sync RPC
+(``RpcClient.call`` / ``PipelinedClient.send`` / framed ``send_msg`` /
+``recv_msg``), untimed ``Condition.wait`` / ``Thread.join``, sync
+ObjectRef resolution (``ray_tpu.get`` / ``ray_tpu.wait`` with a nonzero
+timeout, ``ray_tpu.kill``), ``Future.result``, and the actor-backed
+``util.queue.Queue`` methods (each is a round-trip through an actor).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional, Tuple
+
+QUEUE_RECEIVER = re.compile(r"^(q|queue|.*_q|.*_queue)$")
+THREAD_RECEIVER = re.compile(r"^(t|th|thread|proc|process|worker"
+                             r"|.*_thread|.*_proc(ess)?|flusher|reaper"
+                             r"|reporter|pump)$")
+CALLBACK_NAME = re.compile(r"^(cb|callback|callbacks?|fn|func|handler"
+                           r"|hook|listener|on_[a-z_]+|user_[a-z_]+)$")
+
+# Dotted calls that block wherever they appear.
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.waitpid",
+    "ray_tpu.kill",
+}
+
+# Attribute suffixes that block regardless of receiver name: socket and
+# framed-RPC primitives.
+BLOCKING_SUFFIXES = {
+    "recv", "recv_into", "accept", "sendall", "connect",
+    "call", "call_with_rid",
+}
+
+# Module-level helper names (the rpc.py framing primitives).
+BLOCKING_BARE = {"send_msg", "recv_msg"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def receiver_name(func: ast.AST) -> Optional[str]:
+    """For a call ``recv.attr(...)``, the final receiver segment name
+    ('queue' for ``self.queue.get``), else None."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    value = func.value
+    if isinstance(value, ast.Name):
+        return value.id
+    if isinstance(value, ast.Attribute):
+        return value.attr
+    return None
+
+
+def call_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def is_zero_or_false(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and (
+        node.value == 0 or node.value is False)
+
+
+def has_timeout(call: ast.Call, positional_index: Optional[int] = None) \
+        -> bool:
+    """True when the call passes any timeout-like bound (kwarg
+    ``timeout``/``timeout_s``, or a positional arg at ``positional_index``)."""
+    if call_kwarg(call, "timeout") is not None:
+        return True
+    if call_kwarg(call, "timeout_s") is not None:
+        return True
+    if positional_index is not None and len(call.args) > positional_index:
+        return True
+    return False
+
+
+def classify_blocking(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, detail) when this call can block the calling thread, else
+    None. ``kind`` distinguishes rpc/sleep/io/sync-get/... for rule
+    messages."""
+    func = call.func
+    dotted = dotted_name(func)
+    if dotted in BLOCKING_DOTTED:
+        kind = "sleep" if dotted == "time.sleep" else (
+            "sync-get" if dotted == "ray_tpu.kill" else "io")
+        return kind, dotted
+    if isinstance(func, ast.Name):
+        if func.id in BLOCKING_BARE:
+            return "rpc", func.id
+        if func.id == "open":
+            return "io", "open"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = receiver_name(func) or ""
+
+    if dotted in ("ray_tpu.get", "ray_tpu.wait") or (
+            attr in ("get", "wait") and recv in ("ray_tpu", "worker")
+            and dotted in ("worker.get", "worker.wait",
+                           "ray_tpu.get", "ray_tpu.wait")):
+        if is_zero_or_false(call_kwarg(call, "timeout")):
+            return None  # poll, not a wait
+        return "sync-get", dotted or attr
+    if attr in BLOCKING_SUFFIXES:
+        return "rpc" if attr in ("call", "call_with_rid") else "io", \
+            f"{recv}.{attr}" if recv else attr
+    if attr == "acquire":
+        if is_zero_or_false(call_kwarg(call, "blocking")):
+            return None
+        return "lock", f"{recv}.acquire" if recv else "acquire"
+    if attr in ("wait", "wait_for"):
+        # Condition/Event wait. A timeout bounds it but it still parks
+        # the thread — callers decide per-rule how strict to be; we
+        # report untimed waits as blocking, timed waits as "timed-wait".
+        pos = 1 if attr == "wait_for" else 0
+        if has_timeout(call, positional_index=pos):
+            return "timed-wait", f"{recv}.{attr}" if recv else attr
+        return "untimed-wait", f"{recv}.{attr}" if recv else attr
+    if attr == "join" and THREAD_RECEIVER.match(recv):
+        if has_timeout(call, positional_index=0):
+            return "timed-wait", f"{recv}.join"
+        return "untimed-wait", f"{recv}.join"
+    if attr == "result":
+        return "sync-get", f"{recv}.result" if recv else "result"
+    if QUEUE_RECEIVER.match(recv):
+        if attr in ("get", "put", "shutdown"):
+            if is_zero_or_false(call_kwarg(call, "block")):
+                return None  # explicit non-blocking variant
+            # util.queue.Queue: an actor round-trip; stdlib Queue: may
+            # park on capacity/emptiness.
+            return "sync-get", f"{recv}.{attr}"
+        if attr in ("qsize", "empty", "full"):
+            # Never parks on a stdlib queue; on the actor-backed Queue
+            # it is still an RPC round-trip — only the event-loop rule
+            # (R1) treats it as blocking.
+            return "queue-stat", f"{recv}.{attr}"
+    return None
+
+
+def iter_calls_outside_nested_defs(fn: ast.AST):
+    """Yield every Call node in ``fn``'s body, not descending into
+    nested function/class definitions (their bodies run elsewhere)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,272 @@
+"""raylint core: the visitor framework the rules plug into.
+
+The runtime is deeply concurrent (locks in ``_private/rpc.py``, shared
+wait-groups in ``memory_store.py``, a single-threaded asyncio ingress in
+``serve/_private/http_proxy.py``) and every invariant those layers
+introduced used to live only in reviewers' heads. raylint turns them
+into machine-checked rules:
+
+- each ``Rule`` sees every file (``check_file``) and, after the whole
+  tree has been collected, the cross-file picture (``finalize``) — the
+  lock-order graph and layering checks are inter-file by nature;
+- violations anchor to a (path, line) and can be suppressed inline with
+  ``# raylint: disable=<rule> -- <justification>`` on the flagged line;
+  the justification is REQUIRED — a bare disable is itself a violation
+  (rule R0) that cannot be suppressed;
+- reporters render pretty (human) or JSON (tooling) output; exit code 1
+  means unsuppressed violations exist, 0 means clean, 2 means usage or
+  internal error.
+
+The tier-1 test ``tests/core/test_raylint.py`` runs this over all of
+``ray_tpu/`` and asserts an empty baseline, so every future PR is
+checked with no extra CI infrastructure.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*disable=([A-Za-z0-9_,\s-]+?)\s*"
+    r"(?:--\s*(?P<why>.+?)\s*)?$")
+
+# Rule R0 is the meta-rule: suppressions themselves must carry a
+# justification. It is not suppressible.
+META_RULE = "R0"
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str              # "R1"
+    name: str              # "async-blocking"
+    path: str              # repo-relative posix path
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} " \
+               f"[{self.name}]{tag} {self.message}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    justification: str
+
+
+class FileInfo:
+    """One parsed source file plus its inline suppressions."""
+
+    def __init__(self, path: str, relpath: str, module: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.module = module            # e.g. "ray_tpu.serve.streaming"
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions: Dict[int, Suppression] = {}
+        self.noqa_lines: set = set()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "#" not in text:
+                continue
+            if "noqa" in text:
+                self.noqa_lines.add(lineno)
+            m = SUPPRESS_RE.search(text)
+            if m:
+                rules = tuple(
+                    r.strip().upper() for r in m.group(1).split(",")
+                    if r.strip())
+                self.suppressions[lineno] = Suppression(
+                    lineno, rules, (m.group("why") or "").strip())
+
+    @property
+    def package(self) -> Optional[str]:
+        """Top-level package inside ray_tpu ("" for ray_tpu/*.py files,
+        "serve" for anything under ray_tpu/serve/, None for files
+        outside ray_tpu entirely). Computed from the file path, so a
+        package ``__init__.py`` belongs to its own package."""
+        parts = self.relpath.split("/")
+        if parts[0] != "ray_tpu":
+            return None
+        return parts[1] if len(parts) > 2 else ""
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        sup = self.suppressions.get(line)
+        if sup is not None and rule in sup.rules:
+            return sup
+        return None
+
+
+class Rule:
+    """Base class. ``check_file`` yields (line, message) per file;
+    ``finalize`` yields (fileinfo, line, message) once all files have
+    been seen — the hook for cross-file analyses."""
+
+    id = "R?"
+    name = "unnamed"
+    description = ""
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        return ()
+
+    def finalize(self, project: "Project") \
+            -> Iterable[Tuple[FileInfo, int, str]]:
+        return ()
+
+
+class Project:
+    """All parsed files plus a scratch space rules share across the
+    per-file and finalize phases (keyed by rule id)."""
+
+    def __init__(self, files: List[FileInfo]):
+        self.files = files
+        self.scratch: Dict[str, dict] = {}
+
+    def scratch_for(self, rule_id: str) -> dict:
+        return self.scratch.setdefault(rule_id, {})
+
+
+def _module_name(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def collect_files(paths: List[str], root: Optional[str] = None) \
+        -> List[FileInfo]:
+    """Parse every .py file under ``paths`` (skipping caches/build
+    output). ``root`` anchors repo-relative names; defaults to cwd."""
+    root = os.path.abspath(root or os.getcwd())
+    seen = set()
+    out: List[FileInfo] = []
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            candidates = [path]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "build", ".eggs")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        candidates.append(os.path.join(dirpath, fn))
+        for cand in candidates:
+            if cand in seen:
+                continue
+            seen.add(cand)
+            rel = os.path.relpath(cand, root).replace(os.sep, "/")
+            with open(cand, "r", encoding="utf-8") as f:
+                source = f.read()
+            out.append(FileInfo(cand, rel, _module_name(rel), source))
+    return out
+
+
+def run_rules(files: List[FileInfo], rules: List[Rule]) -> List[Violation]:
+    """Run every rule over every file, then finalize; returns ALL
+    violations (suppressed ones included, marked) plus R0 meta
+    violations for unjustified or unused-looking suppressions."""
+    project = Project(files)
+    raw: List[Tuple[FileInfo, Rule, int, str]] = []
+    for rule in rules:
+        for fi in files:
+            for line, message in rule.check_file(fi) or ():
+                raw.append((fi, rule, line, message))
+    for rule in rules:
+        for fi, line, message in rule.finalize(project) or ():
+            raw.append((fi, rule, line, message))
+
+    out: List[Violation] = []
+    emitted = set()
+    for fi, rule, line, message in raw:
+        key = (rule.id, fi.relpath, line, message)
+        if key in emitted:
+            continue  # nested-scope walks can visit a site twice
+        emitted.add(key)
+        sup = fi.suppression_for(rule.id, line)
+        out.append(Violation(
+            rule=rule.id, name=rule.name, path=fi.relpath, line=line,
+            message=message,
+            suppressed=sup is not None and bool(sup.justification),
+            justification=sup.justification if sup else ""))
+
+    # Meta pass: every suppression must carry a justification. (An
+    # unjustified suppression also fails to suppress, above.)
+    for fi in files:
+        for sup in fi.suppressions.values():
+            if not sup.justification:
+                out.append(Violation(
+                    rule=META_RULE, name="unjustified-suppression",
+                    path=fi.relpath, line=sup.line,
+                    message="suppression without a justification: use "
+                            "`# raylint: disable=<rule> -- <reason>`"))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+@dataclasses.dataclass
+class Report:
+    violations: List[Violation]
+    files_checked: int
+    elapsed_s: float
+
+    @property
+    def active(self) -> List[Violation]:
+        return [v for v in self.violations if not v.suppressed]
+
+    @property
+    def suppressed(self) -> List[Violation]:
+        return [v for v in self.violations if v.suppressed]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "files_checked": self.files_checked,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "violations": [v.to_dict() for v in self.active],
+            "suppressed": [v.to_dict() for v in self.suppressed],
+        }, indent=2)
+
+    def render_pretty(self) -> str:
+        lines = [v.render() for v in self.active]
+        lines.append(
+            f"raylint: {self.files_checked} files, "
+            f"{len(self.active)} violation(s), "
+            f"{len(self.suppressed)} suppressed, "
+            f"{self.elapsed_s:.2f}s")
+        return "\n".join(lines)
+
+
+def analyze(paths: List[str], rules: Optional[List[Rule]] = None,
+            root: Optional[str] = None) -> Report:
+    from tools.raylint.rules import all_rules
+
+    t0 = time.monotonic()
+    files = collect_files(paths, root=root)
+    violations = run_rules(files, rules if rules is not None
+                           else all_rules())
+    return Report(violations=violations, files_checked=len(files),
+                  elapsed_s=time.monotonic() - t0)
+
+
+def analyze_source(source: str, rules: List[Rule],
+                   module: str = "fixture_mod",
+                   relpath: Optional[str] = None) -> List[Violation]:
+    """Test/fixture entry point: lint one in-memory snippet."""
+    rel = relpath or module.replace(".", "/") + ".py"
+    fi = FileInfo(path=rel, relpath=rel, module=module, source=source)
+    return run_rules([fi], rules)

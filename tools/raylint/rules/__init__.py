@@ -1,0 +1,45 @@
+"""Rule registry: one place the CLI, tests, and tier-1 gate agree on."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tools.raylint.core import Rule
+from tools.raylint.rules.r1_async_blocking import AsyncBlockingRule
+from tools.raylint.rules.r2_lock_discipline import LockDisciplineRule
+from tools.raylint.rules.r3_layering import LayeringRule
+from tools.raylint.rules.r4_lifecycle import ResourceLifecycleRule
+from tools.raylint.rules.r5_wire_hygiene import WireHygieneRule
+from tools.raylint.rules.r6_hygiene import HygieneRule
+
+_RULE_CLASSES = (
+    AsyncBlockingRule,
+    LockDisciplineRule,
+    LayeringRule,
+    ResourceLifecycleRule,
+    WireHygieneRule,
+    HygieneRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def rules_by_id() -> Dict[str, type]:
+    return {cls.id: cls for cls in _RULE_CLASSES}
+
+
+def select_rules(ids: Optional[List[str]]) -> List[Rule]:
+    """Instantiate the requested rule ids (case-insensitive), or all."""
+    if not ids:
+        return all_rules()
+    table = rules_by_id()
+    out = []
+    for rid in ids:
+        rid = rid.strip().upper()
+        if rid not in table:
+            raise KeyError(
+                f"unknown rule {rid!r}; known: {', '.join(sorted(table))}")
+        out.append(table[rid]())
+    return out

@@ -1,0 +1,109 @@
+"""R1 async-blocking: no synchronous blocking work on an event loop.
+
+Flags, inside ``async def`` bodies (nested sync ``def``/``lambda``
+bodies excluded — they run elsewhere), any non-awaited call that can
+park the loop thread: ``time.sleep``, sync ``ObjectRef`` resolution
+(``ray_tpu.get``/``ray_tpu.wait``/``ray_tpu.kill``/``worker.wait``),
+``Future.result``, ``Lock.acquire`` / ``with <lock>``, ``Condition`` /
+``Event`` waits (timed or not — a timed wait still stalls every other
+coroutine), file/socket I/O (``open``, ``recv``, ``sendall``,
+``accept``, ``connect``, ``socket.create_connection``), ``subprocess``,
+and the actor-backed ``util.queue.Queue`` methods (each is a blocking
+actor round-trip; use the ``*_async`` variants or an executor).
+
+Targets: ``serve/_private/``, ``serve/streaming.py``,
+``serve/batching.py``, ``util/queue.py`` — any module that runs
+coroutines on the ingress/replica loops.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Tuple
+
+from tools.raylint.astutil import (
+    classify_blocking,
+    dotted_name,
+    receiver_name,
+)
+from tools.raylint.core import FileInfo, Rule
+
+# `with self._lock:` inside a coroutine acquires a *threading* lock on
+# the loop thread. Matched by attribute naming convention.
+LOCKISH = re.compile(r"(^_?(lock|mutex|cond|condition)$)"
+                     r"|(_lock$)|(_mutex$)|(_cond$)|(_condition$)")
+
+_KIND_HINT = {
+    "sleep": "use `await asyncio.sleep(...)`",
+    "sync-get": "await the async variant or run it in an executor",
+    "rpc": "move the RPC off the loop (executor/thread)",
+    "io": "use loop-native I/O or an executor",
+    "lock": "keep loop code lock-free or use asyncio primitives",
+    "untimed-wait": "never park the loop on a thread primitive",
+    "timed-wait": "a timed wait still stalls every coroutine",
+    "queue-stat": "an actor-queue stat is an RPC round-trip",
+}
+
+
+def _awaited_calls(fn: ast.AST) -> set:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+def _walk_async_body(fn: ast.AST):
+    """Nodes of ``fn``'s body that execute on the coroutine itself
+    (nested defs/lambdas/classes excluded)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class AsyncBlockingRule(Rule):
+    id = "R1"
+    name = "async-blocking"
+    description = ("synchronous blocking call inside an `async def` "
+                   "body (event-loop stall)")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            yield from self._check_coroutine(node)
+
+    def _check_coroutine(self, fn: ast.AsyncFunctionDef):
+        awaited = _awaited_calls(fn)
+        for node in _walk_async_body(fn):
+            if isinstance(node, (ast.With,)):
+                for item in node.items:
+                    expr = item.context_expr
+                    target = expr.func if isinstance(expr, ast.Call) \
+                        else expr
+                    dn = dotted_name(target)
+                    last = dn.rsplit(".", 1)[-1] if dn else ""
+                    if last and LOCKISH.match(last):
+                        yield (node.lineno,
+                               f"`with {dn}` acquires a threading lock "
+                               f"inside `async def {fn.name}` — "
+                               f"{_KIND_HINT['lock']}")
+                continue
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            recv = receiver_name(node.func) or ""
+            if recv == "asyncio":
+                continue  # asyncio.* primitives are loop-native
+            hit = classify_blocking(node)
+            if hit is None:
+                continue
+            kind, detail = hit
+            yield (node.lineno,
+                   f"blocking call `{detail}` ({kind}) inside "
+                   f"`async def {fn.name}` — {_KIND_HINT[kind]}")

@@ -1,0 +1,453 @@
+"""R2 lock-discipline: lock ordering and what may run under a lock.
+
+Builds an inter-procedural lock-acquisition graph from ``with
+self._lock`` / ``.acquire()`` patterns across the analyzed tree and
+reports:
+
+- **lock-order cycles**: lock A is taken while B is held on one path
+  and B while A is held on another (classic AB/BA deadlock). Locks are
+  identified per class attribute (``module.Class._lock``) or per
+  assigned name, with ``threading.Condition(existing_lock)`` aliased to
+  its underlying lock;
+- **blocking under a lock**: a lock held across a sleep, socket/RPC
+  send (``send_msg``/``recv``/``.call``), sync ObjectRef resolution,
+  ``.remote()`` submission (can stall on batcher backpressure), an
+  untimed ``Condition.wait`` on a *different* lock's condition, or a
+  ``Thread.join`` — directly or via a same-class method call
+  (transitive, fixpoint);
+- **user callbacks under a lock**: invoking a callback-shaped value
+  (``cb``/``callback``/``handler``/``on_*``/``fn``) while holding a
+  lock hands your lock to arbitrary user code (re-entrancy deadlock).
+
+Waiting on a condition **whose own lock is the only one held** is the
+normal condvar protocol and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.astutil import (
+    CALLBACK_NAME,
+    classify_blocking,
+    dotted_name,
+)
+from tools.raylint.core import FileInfo, Project, Rule
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _lock_factory(value: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """(factory, wrapped_attr_or_name) when ``value`` constructs a
+    threading lock/condition; wrapped is the Condition's lock arg."""
+    if not isinstance(value, ast.Call):
+        return None
+    dn = dotted_name(value.func)
+    if dn is None:
+        return None
+    last = dn.rsplit(".", 1)[-1]
+    if last not in _LOCK_FACTORIES:
+        return None
+    if not (dn.startswith("threading.") or dn == last):
+        return None
+    wrapped = None
+    if last == "Condition" and value.args:
+        wrapped = dotted_name(value.args[0])
+    return last, wrapped
+
+
+@dataclasses.dataclass
+class _FnSummary:
+    key: str                      # "module.Class.method" or "module.fn"
+    cls: Optional[str]
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    # (held_lock, message, line) — direct violations
+    direct: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)
+    # (held_tuple, callee_bare_name, line) — unresolved until fixpoint
+    calls_under_lock: List[Tuple[Tuple[str, ...], str, int]] = \
+        dataclasses.field(default_factory=list)
+    callees: Set[str] = dataclasses.field(default_factory=set)
+    blocks: Optional[str] = None   # human label of first blocking site
+    # (outer, inner, line) lock-order edges observed in this body
+    edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)
+
+
+class _ClassLocks:
+    def __init__(self):
+        self.attrs: Dict[str, str] = {}    # attr -> canonical lock id
+        self.alias: Dict[str, str] = {}    # condition attr -> lock attr
+
+
+class LockDisciplineRule(Rule):
+    id = "R2"
+    name = "lock-discipline"
+    description = ("lock-order cycles; blocking calls, RPC sends, "
+                   "submissions, or user callbacks while holding a lock")
+
+    # -- collection -------------------------------------------------------
+
+    def finalize(self, project: Project) \
+            -> Iterable[Tuple[FileInfo, int, str]]:
+        summaries: Dict[str, _FnSummary] = {}
+        per_file_fns: Dict[str, List[str]] = {}
+        fn_sites: Dict[str, Tuple[FileInfo, int]] = {}
+
+        for fi in project.files:
+            keys = []
+            class_locks = self._collect_class_locks(fi)
+            global_locks = self._collect_global_locks(fi)
+            for cls_name, fn in self._iter_functions(fi.tree):
+                key = f"{fi.module}.{cls_name + '.' if cls_name else ''}" \
+                      f"{fn.name}"
+                summary = self._summarize(
+                    fi, fn, cls_name, class_locks, global_locks, key)
+                summaries[key] = summary
+                fn_sites[key] = (fi, fn.lineno)
+                keys.append(key)
+            per_file_fns[fi.module] = keys
+
+        self._propagate(summaries)
+
+        violations: List[Tuple[FileInfo, int, str]] = []
+        edges: Dict[Tuple[str, str], Tuple[FileInfo, int]] = {}
+
+        for key, s in summaries.items():
+            fi, _ = fn_sites[key]
+            for _, message, line in s.direct:
+                violations.append((fi, line, message))
+            for held, callee, line in s.calls_under_lock:
+                callee_key = self._resolve_callee(
+                    key, callee, s.cls, summaries)
+                if callee_key is None:
+                    continue
+                cs = summaries[callee_key]
+                if cs.blocks is not None:
+                    violations.append((
+                        fi, line,
+                        f"lock(s) {', '.join(sorted(held))} held across "
+                        f"call to `{callee}` which blocks "
+                        f"({cs.blocks})"))
+                for inner in cs.acquires:
+                    for outer in held:
+                        if inner != outer:
+                            edges.setdefault((outer, inner), (fi, line))
+            for outer, inner, line in s.edges:
+                edges.setdefault((outer, inner), (fi, line))
+
+        violations.extend(self._find_cycles(edges))
+        return violations
+
+    # -- helpers ----------------------------------------------------------
+
+    def _collect_class_locks(self, fi: FileInfo) -> Dict[str, _ClassLocks]:
+        out: Dict[str, _ClassLocks] = {}
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            locks = _ClassLocks()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                    continue
+                target = sub.targets[0]
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                fac = _lock_factory(sub.value)
+                if fac is None:
+                    continue
+                factory, wrapped = fac
+                attr = target.attr
+                if factory == "Condition" and wrapped \
+                        and wrapped.startswith("self."):
+                    locks.alias[attr] = wrapped.split(".", 1)[1]
+                locks.attrs[attr] = f"{fi.module}.{node.name}.{attr}"
+            if locks.attrs:
+                out[node.name] = locks
+        return out
+
+    def _collect_global_locks(self, fi: FileInfo) -> Dict[str, str]:
+        """Any ``name = threading.Lock()``-style assignment in the file
+        (module level or closure-local) — closures share them across
+        nested functions, so resolve by bare name file-wide."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _lock_factory(node.value) is not None:
+                name = node.targets[0].id
+                out[name] = f"{fi.module}.{name}"
+        return out
+
+    def _iter_functions(self, tree: ast.AST):
+        """(class_name_or_None, fn) for every def/async def, nested ones
+        included (each is summarized independently)."""
+        def walk(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    yield from walk(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    yield cls, child
+                    yield from walk(child, cls)
+                else:
+                    yield from walk(child, cls)
+        yield from walk(tree, None)
+
+    def _lock_id(self, expr: ast.AST, cls: Optional[str],
+                 class_locks: Dict[str, _ClassLocks],
+                 global_locks: Dict[str, str]) -> Optional[str]:
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        if dn.startswith("self.") and cls and cls in class_locks:
+            attr = dn.split(".", 1)[1]
+            locks = class_locks[cls]
+            attr = locks.alias.get(attr, attr)
+            return locks.attrs.get(attr)
+        return global_locks.get(dn)
+
+    # -- per-function summarization ---------------------------------------
+
+    def _summarize(self, fi: FileInfo, fn, cls: Optional[str],
+                   class_locks: Dict[str, _ClassLocks],
+                   global_locks: Dict[str, str], key: str) -> _FnSummary:
+        s = _FnSummary(key=key, cls=cls)
+
+        def lock_of(expr):
+            return self._lock_id(expr, cls, class_locks, global_locks)
+
+        def visit_call(call: ast.Call, held: Tuple[str, ...]):
+            func = call.func
+            dn = dotted_name(func)
+            # .acquire() outside a with: function-scoped acquisition.
+            if isinstance(func, ast.Attribute) and func.attr == "acquire":
+                lid = lock_of(func.value)
+                if lid is not None:
+                    s.acquires.add(lid)
+                    for outer in held:
+                        if outer != lid:
+                            s.edges.append((outer, lid, call.lineno))
+                return (lid,) if lid is not None else ()
+            if not held:
+                if s.blocks is None:
+                    hit = classify_blocking(call)
+                    if hit is not None and hit[0] not in (
+                            "timed-wait", "queue-stat"):
+                        s.blocks = f"{hit[1]}:{call.lineno}"
+                    elif isinstance(func, ast.Attribute) \
+                            and func.attr in ("remote", "remote_async"):
+                        s.blocks = f"{dn or func.attr}:{call.lineno} " \
+                                   f"(.remote submission)"
+                # Still record callees for transitive acquire edges.
+                self._note_callee(s, func, dn, call, held)
+                return ()
+            # -- a lock is held --
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("wait", "wait_for"):
+                cond_lock = lock_of(func.value)
+                if cond_lock is not None and cond_lock in held:
+                    others = [h for h in held if h != cond_lock]
+                    if others:
+                        s.direct.append((
+                            others[0],
+                            f"lock(s) {', '.join(others)} held across "
+                            f"`{dn}` (condvar wait releases only its "
+                            f"own lock)", call.lineno))
+                    return ()
+            hit = classify_blocking(call)
+            if hit is not None:
+                kind, detail = hit
+                if kind not in ("lock", "queue-stat"):
+                    s.direct.append((
+                        held[0],
+                        f"lock(s) {', '.join(held)} held across "
+                        f"blocking call `{detail}` ({kind})",
+                        call.lineno))
+                return ()
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("remote", "remote_async"):
+                s.direct.append((
+                    held[0],
+                    f"lock(s) {', '.join(held)} held across `.{func.attr}"
+                    f"()` submission (RPC; can stall on batcher "
+                    f"backpressure)", call.lineno))
+                return ()
+            cb_name = None
+            if isinstance(func, ast.Name) and CALLBACK_NAME.match(func.id):
+                cb_name = func.id
+            elif isinstance(func, ast.Attribute) \
+                    and CALLBACK_NAME.match(func.attr) \
+                    and not dn.startswith(("self.", "cls.")):
+                cb_name = dn
+            if cb_name is not None:
+                s.direct.append((
+                    held[0],
+                    f"lock(s) {', '.join(held)} held while invoking "
+                    f"user callback `{cb_name}`", call.lineno))
+                return ()
+            self._note_callee(s, func, dn, call, held)
+            return ()
+
+        def walk(node, held: Tuple[str, ...]):
+            acquired_here: Tuple[str, ...] = ()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return  # separate summaries / deferred execution
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new = list(held)
+                for item in node.items:
+                    expr = item.context_expr
+                    lid = lock_of(expr) if not isinstance(expr, ast.Call) \
+                        else None
+                    if lid is not None:
+                        s.acquires.add(lid)
+                        for outer in new:
+                            if outer != lid:
+                                s.edges.append(
+                                    (outer, lid, node.lineno))
+                        if lid not in new:
+                            new.append(lid)
+                for child in node.body:
+                    walk(child, tuple(new))
+                return
+            if isinstance(node, ast.Call):
+                acquired_here = visit_call(node, held)
+            new_held = held + tuple(
+                l for l in acquired_here if l not in held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, new_held)
+
+        for child in ast.iter_child_nodes(fn):
+            walk(child, ())
+        return s
+
+    def _note_callee(self, s: _FnSummary, func, dn: Optional[str],
+                     call: ast.Call, held: Tuple[str, ...]):
+        name = None
+        if dn and dn.startswith("self."):
+            rest = dn.split(".", 1)[1]
+            if "." not in rest:
+                name = rest
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name is None:
+            return
+        s.callees.add(name)
+        if held:
+            s.calls_under_lock.append((held, name, call.lineno))
+
+    def _resolve_callee(self, caller_key: str, callee: str,
+                        cls: Optional[str],
+                        summaries: Dict[str, _FnSummary]) -> Optional[str]:
+        module = caller_key.rsplit(".", 2 if cls else 1)[0]
+        if cls:
+            key = f"{module}.{cls}.{callee}"
+            if key in summaries:
+                return key
+        key = f"{module}.{callee}"
+        return key if key in summaries else None
+
+    # -- fixpoint + cycles -------------------------------------------------
+
+    def _propagate(self, summaries: Dict[str, _FnSummary]):
+        """Transitive closure of "blocks" and "acquires" through
+        same-module/class bare and self calls."""
+        changed = True
+        while changed:
+            changed = False
+            for key, s in summaries.items():
+                for callee in s.callees:
+                    ck = self._resolve_callee(key, callee, s.cls,
+                                              summaries)
+                    if ck is None or ck == key:
+                        continue
+                    cs = summaries[ck]
+                    if cs.blocks is not None and s.blocks is None:
+                        s.blocks = f"via {callee}: {cs.blocks}"
+                        changed = True
+                    before = len(s.acquires)
+                    s.acquires |= cs.acquires
+                    if len(s.acquires) != before:
+                        changed = True
+
+    def _find_cycles(self, edges) -> List[Tuple[FileInfo, int, str]]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # Tarjan SCC; any SCC with >1 node is a lock-order cycle.
+        index_counter = [0]
+        stack: List[str] = []
+        lowlink: Dict[str, int] = {}
+        index: Dict[str, int] = {}
+        on_stack: Dict[str, bool] = {}
+        sccs: List[List[str]] = []
+
+        def strongconnect(v):
+            worklist = [(v, iter(sorted(graph[v])))]
+            index[v] = lowlink[v] = index_counter[0]
+            index_counter[0] += 1
+            stack.append(v)
+            on_stack[v] = True
+            while worklist:
+                node, it = worklist[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = lowlink[w] = index_counter[0]
+                        index_counter[0] += 1
+                        stack.append(w)
+                        on_stack[w] = True
+                        worklist.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    elif on_stack.get(w):
+                        lowlink[node] = min(lowlink[node], index[w])
+                if advanced:
+                    continue
+                worklist.pop()
+                if worklist:
+                    parent = worklist[-1][0]
+                    lowlink[parent] = min(lowlink[parent], lowlink[node])
+                if lowlink[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        comp.append(w)
+                        if w == node:
+                            break
+                    sccs.append(comp)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+
+        out = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            sites = sorted(
+                (fi.relpath, line, a, b)
+                for (a, b), (fi, line) in edges.items()
+                if a in comp_set and b in comp_set)
+            site_desc = "; ".join(
+                f"{a}->{b} at {p}:{ln}" for p, ln, a, b in sites)
+            anchor = None
+            for (a, b), (fi, line) in sorted(
+                    edges.items(), key=lambda kv: (kv[1][0].relpath,
+                                                   kv[1][1])):
+                if a in comp_set and b in comp_set:
+                    anchor = (fi, line)
+                    break
+            fi, line = anchor
+            out.append((fi, line,
+                        f"lock-order cycle among "
+                        f"{{{', '.join(sorted(comp_set))}}}: {site_desc}"))
+        return out

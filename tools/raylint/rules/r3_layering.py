@@ -1,0 +1,99 @@
+"""R3 layering: dependency direction and private-surface hygiene.
+
+Two checks, both purely from imports/attribute syntax:
+
+1. **No upward imports from the core**: modules under
+   ``ray_tpu/_private/`` (and ``ray_tpu/util/``) are the substrate the
+   libraries build on; importing ``serve``/``tune``/``data``/``rl``/
+   ``train`` from there inverts the layering and creates import cycles
+   the next refactor trips over.
+
+2. **No cross-package private reach-ins**: importing another package's
+   ``_private``/``_internal`` modules, or reading a ``_underscore``
+   attribute off a module imported from another package, couples a
+   consumer to internals that carry no compatibility promise (the
+   PR 3 ``TaskEventBuffer.snapshot()`` cleanup, generalized). A
+   package's own code may of course use its own internals.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from tools.raylint.core import FileInfo, Rule
+
+LIBRARY_PACKAGES = ("serve", "tune", "data", "rl", "train")
+CORE_PACKAGES = ("_private", "util")
+
+
+def _imported_ray_module(node) -> Iterable[Tuple[str, str, int]]:
+    """(alias_name, imported_module_path, line) for ray_tpu imports."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            if alias.name.startswith("ray_tpu"):
+                bound = alias.asname or alias.name.split(".")[0]
+                yield bound, alias.name, node.lineno
+    elif isinstance(node, ast.ImportFrom) and node.module \
+            and node.module.startswith("ray_tpu") and node.level == 0:
+        for alias in node.names:
+            full = f"{node.module}.{alias.name}"
+            yield alias.asname or alias.name, full, node.lineno
+
+
+class LayeringRule(Rule):
+    id = "R3"
+    name = "layering"
+    description = ("core packages must not import libraries; no "
+                   "cross-package private imports or underscore "
+                   "attribute reads")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        my_pkg = fi.package
+        if my_pkg is None:
+            return
+        module_aliases = {}
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            for bound, target, line in _imported_ray_module(node):
+                parts = target.split(".")
+                target_pkg = parts[1] if len(parts) > 1 else ""
+                # 1. core -> library imports
+                if my_pkg in CORE_PACKAGES \
+                        and target_pkg in LIBRARY_PACKAGES:
+                    yield (line,
+                           f"core module `{fi.module}` imports library "
+                           f"package `ray_tpu.{target_pkg}` — invert "
+                           f"the dependency (register a hook/provider "
+                           f"from the library side)")
+                # 2. cross-package private imports
+                private_hops = [
+                    p for p in parts[2:]
+                    if p.startswith("_") and not p.startswith("__")]
+                if private_hops and target_pkg != my_pkg:
+                    yield (line,
+                           f"`{fi.module}` (package "
+                           f"`{my_pkg or 'ray_tpu'}`) imports "
+                           f"`{target}` through another package's "
+                           f"private namespace "
+                           f"(`{'.'.join(private_hops)}`)")
+                if target_pkg != my_pkg:
+                    module_aliases[bound] = target_pkg
+
+        # 3. underscore attribute reads on cross-package module aliases
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (node.attr.startswith("_")
+                    and not node.attr.startswith("__")):
+                continue
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in module_aliases:
+                pkg = module_aliases[node.value.id]
+                yield (node.lineno,
+                       f"reads private attribute "
+                       f"`{node.value.id}.{node.attr}` of package "
+                       f"`ray_tpu.{pkg}` from package "
+                       f"`{my_pkg or 'ray_tpu'}` — use/introduce a "
+                       f"public accessor")

@@ -1,0 +1,224 @@
+"""R4 resource-lifecycle: created resources need a reachable teardown.
+
+Checks, per creation site:
+
+- ``threading.Thread(...)`` assigned to an attribute: the owning class
+  must expose a teardown method (``close``/``shutdown``/``stop``/
+  ``join``/``__exit__``/``__del__``) — a runtime full of unjoinable
+  threads cannot drain on failover. Fire-and-forget threads (started
+  inline, never stored) must at least be ``daemon=True`` so they can't
+  wedge interpreter exit.
+- ``socket.socket(...)`` / ``socket.create_connection(...)``: the
+  socket must be closed in the creating function (``with`` /
+  ``.close()`` on the variable), stored on ``self`` in a class with a
+  teardown method, or returned (ownership transfer).
+- ``sqlite3.connect(...)``: same containment contract as sockets.
+- **group-commit writers** (the ``gcs_storage.py`` pattern): a class
+  that defines both ``flush`` and a teardown method must make its
+  accepted writes durable on the way out — the teardown must reference
+  ``flush``/``commit``; otherwise buffered writes die with the process
+  at exactly the shutdown/failover boundary flush exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from tools.raylint.astutil import dotted_name
+from tools.raylint.core import FileInfo, Rule
+
+TEARDOWN_NAMES = {"close", "shutdown", "stop", "join", "wait",
+                  "__exit__", "__del__", "release", "disconnect"}
+
+
+def _is_teardown_name(name: str) -> bool:
+    return name in TEARDOWN_NAMES or any(
+        part in name for part in ("shutdown", "teardown", "close"))
+
+
+def _creation_kind(call: ast.Call) -> Optional[str]:
+    dn = dotted_name(call.func)
+    if dn in ("threading.Thread", "Thread"):
+        return "thread"
+    if dn in ("socket.socket", "socket.create_connection"):
+        return "socket"
+    if dn in ("sqlite3.connect",):
+        return "sqlite"
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    v = _kwarg(call, "daemon")
+    return isinstance(v, ast.Constant) and v.value is True
+
+
+def _assigned_target(node: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(self_attr, local_name) the statement assigns to, if any."""
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        t = node.targets[0]
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr, None
+        if isinstance(t, ast.Name):
+            return None, t.id
+    return None, None
+
+
+def _fn_closes_name(fn: ast.AST, name: str) -> bool:
+    """Does ``fn`` call ``name.close()`` anywhere, use ``with name``-
+    style management, or return ``name`` (ownership transfer)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("close", "shutdown", "detach") \
+                and dotted_name(node.func.value) == name:
+            return True
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                dn = dotted_name(item.context_expr)
+                if dn == name:
+                    return True
+                if isinstance(item.context_expr, ast.Call):
+                    for arg in item.context_expr.args:
+                        if dotted_name(arg) == name:
+                            return True  # contextlib.closing(name)
+        if isinstance(node, ast.Return) and node.value is not None:
+            if dotted_name(node.value) == name:
+                return True
+            for sub in ast.walk(node.value):
+                if dotted_name(sub) == name:
+                    return True
+        if isinstance(node, ast.Call):
+            # handed to another function/constructor: ownership transfer
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if dotted_name(arg) == name:
+                    return True
+    return False
+
+
+class ResourceLifecycleRule(Rule):
+    id = "R4"
+    name = "resource-lifecycle"
+    description = ("threads/sockets/sqlite connections need a reachable "
+                   "shutdown/close path; group-commit writers must "
+                   "flush at teardown")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        yield from self._check_classes(fi)
+        yield from self._check_functions(fi)
+
+    # -- class-scoped resources -------------------------------------------
+
+    def _check_classes(self, fi: FileInfo):
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = {
+                c.name for c in node.body
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            has_teardown = any(_is_teardown_name(m) for m in methods)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                attr, _ = _assigned_target(sub)
+                if attr is None or not isinstance(sub.value, ast.Call):
+                    continue
+                kind = _creation_kind(sub.value)
+                if kind is None:
+                    continue
+                if not has_teardown:
+                    yield (sub.lineno,
+                           f"class `{node.name}` stores a {kind} in "
+                           f"`self.{attr}` but defines no teardown "
+                           f"method ({'/'.join(sorted(TEARDOWN_NAMES))})")
+            yield from self._check_group_commit(fi, node, methods)
+
+    def _check_group_commit(self, fi: FileInfo, node: ast.ClassDef,
+                            methods: set):
+        if "flush" not in methods:
+            return
+        teardowns = [
+            c for c in node.body
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and c.name in ("close", "shutdown", "stop", "__exit__")]
+        for td in teardowns:
+            refs = {
+                n.attr for n in ast.walk(td)
+                if isinstance(n, ast.Attribute)}
+            names = {
+                n.id for n in ast.walk(td) if isinstance(n, ast.Name)}
+            if not ({"flush", "commit"} & (refs | names)):
+                yield (td.lineno,
+                       f"group-commit writer `{node.name}.{td.name}` "
+                       f"tears down without flush()/commit() — buffered "
+                       f"writes are lost at the shutdown/failover "
+                       f"boundary")
+
+    # -- function-scoped resources ----------------------------------------
+
+    def _check_functions(self, fi: FileInfo):
+        for fn in ast.walk(fi.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.Expr)):
+                    continue
+                value = node.value
+                # Inline fire-and-forget: threading.Thread(...).start()
+                if isinstance(value, ast.Call) \
+                        and isinstance(value.func, ast.Attribute) \
+                        and value.func.attr == "start" \
+                        and isinstance(value.func.value, ast.Call) \
+                        and _creation_kind(value.func.value) == "thread":
+                    if not _is_daemon(value.func.value):
+                        yield (value.lineno,
+                               "non-daemon fire-and-forget Thread: pass "
+                               "daemon=True or store and join it")
+                    continue
+                if not isinstance(value, ast.Call):
+                    continue
+                kind = _creation_kind(value)
+                if kind is None:
+                    continue
+                attr, local = _assigned_target(node)
+                if kind == "thread":
+                    # Stored on self: class-scoped pass. Local-var
+                    # thread: must be daemon or joined somewhere here.
+                    if attr is None and not _is_daemon(value) \
+                            and local is not None \
+                            and not self._fn_joins(fn, local):
+                        yield (value.lineno,
+                               f"non-daemon Thread `{local}` is never "
+                               f"joined in `{fn.name}`")
+                    continue
+                if attr is not None:
+                    continue  # handled by the class-scoped pass
+                if local is None:
+                    if isinstance(node, ast.Expr):
+                        yield (value.lineno,
+                               f"{kind} created and dropped without a "
+                               f"close path")
+                    continue
+                if not _fn_closes_name(fn, local):
+                    yield (value.lineno,
+                           f"{kind} `{local}` is never closed/returned "
+                           f"in `{fn.name}` — close it in a "
+                           f"finally/with or transfer ownership")
+
+    @staticmethod
+    def _fn_joins(fn: ast.AST, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and dotted_name(node.func.value) == name:
+                return True
+        return False

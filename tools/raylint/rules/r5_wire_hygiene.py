@@ -1,0 +1,160 @@
+"""R5 wire-hygiene: every control-plane frame is declared, registered,
+and round-trippable.
+
+The typed wire layer (``_private/wire.py``) is the one place the
+cluster's processes agree on byte formats; a frame that drifts out of
+the contract fails at the worst possible time (cross-version decode on
+a live cluster). Checks:
+
+- every class with annotated fields defined in a ``wire`` module must
+  be registered for dispatch with the ``@message("Name", version=N)``
+  decorator (a bare dataclass silently falls back to opaque pickle);
+- wire names must be unique within the module;
+- ``version`` must be a literal int >= 1 (the breaking-change gate has
+  to be diffable);
+- every declared field's annotation must be a wire-supported type
+  (``int``/``float``/``str``/``bytes``/``bool``/``dict``/``list``/
+  ``tuple``/``Any``) — anything richer must travel as an explicit
+  ``Opaque`` field typed ``Any``;
+- codebase-wide: a class defining ``to_dict`` must define ``from_dict``
+  and vice versa (one-way serialization can be shipped but never
+  received — the ``TaskEvent`` shipping contract, generalized), and
+  ``from_dict`` must be a classmethod/staticmethod.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.raylint.core import FileInfo, Rule
+
+SUPPORTED_FIELD_TYPES = {
+    "int", "float", "str", "bytes", "bool", "dict", "list", "tuple",
+    "Any", "typing.Any",
+}
+
+
+def _message_decorator(dec: ast.AST) -> Optional[Tuple[Optional[str],
+                                                       Optional[ast.AST]]]:
+    """(wire_name, version_node) when ``dec`` is ``message(...)`` or
+    ``wire.message(...)``."""
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = dec.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name != "message":
+        return None
+    wire_name = None
+    if dec.args and isinstance(dec.args[0], ast.Constant) \
+            and isinstance(dec.args[0].value, str):
+        wire_name = dec.args[0].value
+    version = None
+    for kw in dec.keywords:
+        if kw.arg == "version":
+            version = kw.value
+    if version is None and len(dec.args) > 1:
+        version = dec.args[1]
+    return wire_name, version
+
+
+def _annotation_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _annotation_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Subscript):
+        return _annotation_name(node.value)
+    return ast.dump(node)
+
+
+class WireHygieneRule(Rule):
+    id = "R5"
+    name = "wire-hygiene"
+    description = ("wire frames must be @message-registered with "
+                   "literal versions and supported field types; "
+                   "to_dict/from_dict must come in matched pairs")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        if fi.module.rsplit(".", 1)[-1] == "wire":
+            yield from self._check_wire_module(fi)
+        yield from self._check_dict_pairs(fi)
+
+    def _check_wire_module(self, fi: FileInfo):
+        seen_names = {}
+        for node in fi.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            fields = [
+                c for c in node.body if isinstance(c, ast.AnnAssign)]
+            registrations = [
+                m for m in (
+                    _message_decorator(d) for d in node.decorator_list)
+                if m is not None]
+            if not registrations:
+                if fields:
+                    yield (node.lineno,
+                           f"frame class `{node.name}` declares fields "
+                           f"but is not registered with @message(...) "
+                           f"— it would ship as opaque pickle, not a "
+                           f"typed frame")
+                continue
+            wire_name, version = registrations[0]
+            if wire_name is None:
+                yield (node.lineno,
+                       f"`{node.name}`: @message name must be a string "
+                       f"literal")
+            elif wire_name in seen_names:
+                yield (node.lineno,
+                       f"duplicate wire name {wire_name!r} (also "
+                       f"registered at line {seen_names[wire_name]}) — "
+                       f"the registry keeps only one")
+            else:
+                seen_names[wire_name] = node.lineno
+            if version is not None and not (
+                    isinstance(version, ast.Constant)
+                    and isinstance(version.value, int)
+                    and version.value >= 1):
+                yield (node.lineno,
+                       f"`{node.name}`: @message version must be a "
+                       f"literal int >= 1")
+            for field in fields:
+                ann = _annotation_name(field.annotation)
+                if ann not in SUPPORTED_FIELD_TYPES:
+                    target = field.target.id \
+                        if isinstance(field.target, ast.Name) else "?"
+                    yield (field.lineno,
+                           f"`{node.name}.{target}`: unsupported wire "
+                           f"field type `{ann}` — use a wire scalar/"
+                           f"container or `Any` (explicit Opaque)")
+
+    def _check_dict_pairs(self, fi: FileInfo):
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            defs = {
+                c.name: c for c in node.body
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            has_to, has_from = "to_dict" in defs, "from_dict" in defs
+            if has_to and not has_from:
+                yield (defs["to_dict"].lineno,
+                       f"`{node.name}` defines to_dict without "
+                       f"from_dict — one-way wire serialization")
+            if has_from and not has_to:
+                yield (defs["from_dict"].lineno,
+                       f"`{node.name}` defines from_dict without "
+                       f"to_dict — one-way wire serialization")
+            if has_from:
+                fd = defs["from_dict"]
+                decs = {
+                    d.id for d in fd.decorator_list
+                    if isinstance(d, ast.Name)}
+                if not ({"classmethod", "staticmethod"} & decs):
+                    yield (fd.lineno,
+                           f"`{node.name}.from_dict` must be a "
+                           f"classmethod/staticmethod (decoders have "
+                           f"no instance yet)")

@@ -1,0 +1,75 @@
+"""R6 hygiene: unused module-scope imports.
+
+The mechanical-debt rule: an import bound at module scope that no code
+in the file references is dead weight (and often a stale layering edge
+R3 can no longer see). ``__init__.py`` files are skipped — their
+imports ARE the public surface — and so are lines carrying a ``noqa``
+marker (the established re-export convention in this repo).
+
+Name-usage detection is conservative: a name counts as used if it
+appears as any ``Name`` load, as the root of an attribute chain, in
+``__all__``, or anywhere in a docstring-free string annotation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from tools.raylint.core import FileInfo, Rule
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # quoted annotations / __all__ entries
+            token = node.value.strip()
+            if token.isidentifier():
+                used.add(token)
+            elif "." in token and token.split(".")[0].isidentifier():
+                used.add(token.split(".")[0])
+    return used
+
+
+class HygieneRule(Rule):
+    id = "R6"
+    name = "unused-import"
+    description = "module-scope import never referenced in the file"
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        if fi.relpath.endswith("__init__.py"):
+            return
+        used = _used_names(fi.tree)
+        for node in fi.tree.body:
+            if isinstance(node, ast.Try):
+                stmts = node.body + [
+                    s for h in node.handlers for s in h.body]
+            elif isinstance(node, ast.If):
+                stmts = node.body + node.orelse
+            else:
+                stmts = [node]
+            for stmt in stmts:
+                if not isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                    continue
+                if stmt.lineno in fi.noqa_lines:
+                    continue
+                if isinstance(stmt, ast.ImportFrom) \
+                        and stmt.module == "__future__":
+                    continue
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if bound not in used:
+                        yield (stmt.lineno,
+                               f"`{bound}` (from `import "
+                               f"{alias.name}`) is never used")
