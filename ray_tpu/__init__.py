@@ -45,20 +45,24 @@ def nodes():
     return global_worker().gcs.nodes()
 
 
-def timeline(filename=None):
+def timeline(filename=None, job_id=None):
     """Chrome-trace dump of task execution (reference: `ray.timeline`,
     `python/ray/_private/state.py:851`). Returns the event list; with
     `filename`, writes JSON loadable in chrome://tracing or Perfetto.
     On a cluster head the dump is CLUSTER-wide: worker-node events ship
     to the head's aggregator, each trace event ``pid``-tagged with its
-    executing node."""
+    executing node. ``job_id`` restricts the dump to one job's events
+    (each event also carries its job tag in ``args.job``)."""
     import json
 
     from ray_tpu._private.obs_plane import cluster_task_events
     from ray_tpu._private.task_events import chrome_trace_events
     from ray_tpu._private.worker import global_worker
 
-    events = chrome_trace_events(cluster_task_events(global_worker()))
+    events = cluster_task_events(global_worker())
+    if job_id is not None:
+        events = [ev for ev in events if ev.job_id == job_id]
+    events = chrome_trace_events(events)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

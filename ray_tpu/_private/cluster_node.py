@@ -435,6 +435,7 @@ class NodeRuntime:
             trace_parent=tuple(call.trace_parent)
             if call.trace_parent else None,
             num_returns=call.num_returns,
+            job_id=getattr(call, "job_id", "") or "",
         )
         spec.max_retries = call.max_retries
         spec.assign_return_ids()

@@ -89,8 +89,33 @@ class RayTpuConfig:
     obs_head_max_events: int = 200_000
     # Serve HTTP access log: one structured line per request on the
     # "ray_tpu.serve.access" logger (method, route, status, latency_ms,
-    # trace_id). Off by default — the ingress hot path stays log-free.
+    # trace_id, job_id). Off by default — the ingress hot path stays
+    # log-free.
     serve_access_log: bool = False
+
+    # -- SLO / health plane (_private/health.py) -------------------------
+    # Per-route latency SLO targets: "route=latency_s[:objective],..."
+    # (e.g. "/chat=0.25:0.999,/embed=0.1"). Routes not listed use the
+    # defaults below. The burn-rate gauges and /api/healthz verdicts
+    # are computed against these.
+    serve_slo_targets: str = ""
+    serve_slo_default_latency_s: float = 0.5
+    serve_slo_default_objective: float = 0.99
+    # Multi-window burn rates (the classic short/long burn-rate alert
+    # shape) diffed from periodic cumulative-count snapshots.
+    slo_burn_short_window_s: float = 30.0
+    slo_burn_long_window_s: float = 300.0
+    # Event-loop lag sampling period on the Serve proxy/replica loops
+    # (0 disables the sampler).
+    loop_lag_sample_period_s: float = 0.25
+    # Degraded-verdict thresholds: memory usage fraction, scheduler
+    # backlog (queued undispatched tasks), event-loop scheduling lag,
+    # and SLO burn multiple (1.0 = burning the error budget exactly at
+    # the sustainable rate).
+    health_memory_pressure_threshold: float = 0.92
+    health_backlog_threshold: int = 2000
+    health_loop_lag_threshold_s: float = 0.25
+    health_slo_burn_threshold: float = 4.0
 
     # -- GCS storage (reference: store_client/; "" = in-memory, a file
     #    path selects the durable SQLite backend in Redis's role) -------

@@ -265,8 +265,12 @@ class LocalBackend:
         deps = spec.dependencies()
         unresolved = [d for d in deps if not self.worker.memory_store.contains(d)]
         with self._lock:
-            self._dep_counts[spec.task_id.binary()] = len(unresolved)
+            # Only dep-parked tasks get an entry (a zero entry would
+            # never be removed — _on_dep_ready deletes at zero — so
+            # the dict and the waiting_for_deps gauge would grow with
+            # every dep-free task ever submitted).
             if unresolved:
+                self._dep_counts[spec.task_id.binary()] = len(unresolved)
                 for d in unresolved:
                     self._pending_deps.setdefault(d, []).append(spec)
         if unresolved:
@@ -572,7 +576,8 @@ class LocalBackend:
             try:
                 for i, value in enumerate(result):
                     oid = ObjectID.for_task_return(spec.task_id, i + 1)
-                    self.worker.memory_store.put(oid, value)
+                    self.worker.memory_store.put(oid, value,
+                                                 job_id=spec.job_id or "")
                     if self.worker.shm_plane is not None:
                         from ray_tpu._private.shm_plane import (
                             share_value,
@@ -760,6 +765,18 @@ class LocalBackend:
     def backlog_count(self) -> int:
         with self._lock:
             return self._pending_count
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Scheduler-pressure snapshot for the health plane: tasks
+        queued but not dispatched (``backlog``), the subset parked
+        waiting for resources, and tasks parked on unresolved
+        dependencies. O(1) except the parked list length."""
+        with self._lock:
+            return {
+                "backlog": self._pending_count,
+                "parked_for_resources": len(self._waiting_for_resources),
+                "waiting_for_deps": len(self._dep_counts),
+            }
 
     def actor_state(self, actor_id: ActorID) -> str:
         actor = self._actors.get(actor_id)

@@ -23,6 +23,29 @@ from ray_tpu._private.config import ray_config
 
 logger = logging.getLogger(__name__)
 
+# Last sampled usage fraction (value, monotonic ts) — the health plane
+# reads this instead of re-walking /proc on every verdict. Written by
+# whichever monitor loop sampled last; a plain tuple swap is atomic
+# under the GIL.
+_last_sample: "tuple[float, float] | None" = None
+
+
+def current_pressure(max_age_s: float = 2.0) -> float:
+    """Node memory usage fraction for the health/metrics plane: the
+    monitor loop's latest sample when fresh, else sampled inline (the
+    no-monitor case — drivers, tests — still gets a live value; the
+    read is two small file reads)."""
+    import time
+
+    global _last_sample
+    sample = _last_sample
+    now = time.monotonic()
+    if sample is not None and now - sample[1] <= max_age_s:
+        return sample[0]
+    value = system_memory_usage_fraction()
+    _last_sample = (value, now)
+    return value
+
 
 def system_memory_usage_fraction() -> float:
     """Used fraction of node memory: cgroup v2 limit when present (the
@@ -75,12 +98,16 @@ class MemoryMonitor:
         self._stop.set()
 
     def _loop(self) -> None:
+        import time
+
+        global _last_sample
         while not self._stop.wait(
                 ray_config.memory_monitor_refresh_ms / 1000.0):
             try:
                 usage = self.usage_fn()
             except Exception:  # pragma: no cover - sampling must not kill
                 continue
+            _last_sample = (usage, time.monotonic())
             if usage <= ray_config.memory_usage_threshold:
                 continue
             if self.kill_one(usage):
@@ -121,4 +148,37 @@ class MemoryMonitor:
                 ray_config.memory_usage_threshold * 100, proc.pid,
                 spec.describe() if spec is not None else "<unknown>")
             proc.kill()
+        self._record_kill_event(proc.pid, spec, usage)
         return True
+
+    def _record_kill_event(self, pid: int, spec, usage: float) -> None:
+        """The kill decision as a task event (victim task id, usage
+        fraction, job tag): OOM kills show up in ``timeline()`` and the
+        cluster-wide state views — shipped to the head like any task
+        event — instead of only in this node's log. A synthetic task id
+        keeps the incident distinct from the victim task's own record,
+        which a retry will overwrite."""
+        import time
+
+        from ray_tpu._private import perf_stats
+        from ray_tpu._private.task_events import TaskEvent
+
+        try:
+            victim = spec.task_id.hex() if spec is not None else ""
+            now = time.time()
+            self.backend.worker.task_events.record_event(TaskEvent(
+                task_id=f"memkill:{victim or pid}:{self.num_killed}",
+                name="memory_monitor.kill_worker",
+                kind="NORMAL_TASK", state="MEMORY_KILLED",
+                start_s=now, end_s=now,
+                node_id=getattr(self.backend, "node_id", None).hex()
+                if getattr(self.backend, "node_id", None) else "",
+                worker=f"pid={pid}",
+                error=f"worker killed at memory usage {usage:.3f} "
+                      f"(threshold "
+                      f"{ray_config.memory_usage_threshold:.3f}); "
+                      f"victim task {victim or '<unknown>'}",
+                job_id=(spec.job_id or "") if spec is not None else ""))
+            perf_stats.counter("memory_monitor_kills").inc()
+        except Exception:  # pragma: no cover — accounting must not
+            pass           # interfere with the kill itself

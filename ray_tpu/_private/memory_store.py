@@ -46,6 +46,9 @@ class _Entry:
     size: int = 0
     last_access: float = 0.0
     spilled_url: Optional[str] = None
+    # Job/tenant tag of the task (or driver put) that produced this
+    # object — the per-job object-store accounting key ("" = untagged).
+    job_id: str = ""
 
 
 class _WaitGroup:
@@ -90,7 +93,8 @@ class MemoryStore:
         return entry
 
     def put(self, object_id: ObjectID, value: Any,
-            error: Optional[BaseException] = None) -> None:
+            error: Optional[BaseException] = None,
+            job_id: str = "") -> None:
         manager = self.spill_manager
         with self._lock:
             entry = self._entry(object_id)
@@ -99,6 +103,8 @@ class MemoryStore:
             entry.value = value
             entry.error = error
             entry.ready = True
+            if job_id:
+                entry.job_id = job_id
             entry.last_access = time.monotonic()
             if manager is not None and error is None:
                 from ray_tpu._private.spilling import estimate_size
@@ -117,6 +123,22 @@ class MemoryStore:
         with self._lock:
             entry = self._entries.get(object_id)
             return entry is not None and entry.ready
+
+    def job_object_stats(self) -> dict:
+        """Per-job object accounting: job_id -> (objects, bytes) over
+        resident entries (spilled values count — the job still owns
+        them). Untagged entries roll up under ``""`` so the per-job
+        rows always sum to the store's real footprint. Sizes are only
+        estimated when a spill budget is configured; counts are always
+        exact."""
+        out: dict = {}
+        with self._lock:
+            for entry in self._entries.values():
+                if not entry.ready:
+                    continue
+                n, b = out.get(entry.job_id, (0, 0))
+                out[entry.job_id] = (n + 1, b + (entry.size or 0))
+        return out
 
     def on_ready(self, object_id: ObjectID, callback: Callable[[ObjectID], None]) -> None:
         """Invoke callback when object resolves (immediately if already done)."""

@@ -58,6 +58,10 @@ class ObsAggregator:
         self._metrics: Dict[str, dict] = {}
         self._reports = 0
         self._events_received = 0
+        # Bumped whenever the event store changes — pairs with
+        # TaskEventBuffer.mutation_seq as the change fingerprint that
+        # lets per-scrape aggregations skip an unchanged merge.
+        self._mutations = 0
 
     # -- RPC handler -----------------------------------------------------
 
@@ -72,6 +76,8 @@ class ObsAggregator:
         with self._lock:
             self._reports += 1
             self._events_received += len(evs)
+            if evs:
+                self._mutations += 1
             for ev in evs:
                 self._events[ev.task_id] = ev
             while len(self._events) > self._max:
@@ -87,6 +93,11 @@ class ObsAggregator:
             self._metrics.pop(node_id, None)
 
     # -- read side -------------------------------------------------------
+
+    @property
+    def mutation_seq(self) -> int:
+        with self._lock:
+            return self._mutations
 
     def task_events(self) -> List[TaskEvent]:
         with self._lock:
@@ -211,10 +222,13 @@ def _prefer(a: TaskEvent, b: TaskEvent) -> TaskEvent:
     return a if a.start_s >= b.start_s else b
 
 
-def cluster_task_events(worker) -> List[TaskEvent]:
+def cluster_task_events(worker, sort: bool = True) -> List[TaskEvent]:
     """Every task event this process can see: its own buffer plus — on
     the cluster head — the aggregator's node-shipped events, deduped by
-    task id and ordered by start time."""
+    task id and ordered by start time. Aggregating callers that only
+    fold counts (the per-job metric collection, ``job_summary``) pass
+    ``sort=False``: the sort is the O(n log n) term on a walk that runs
+    every scrape/ship cycle, and order is irrelevant to them."""
     buf = getattr(worker, "task_events", None)
     local = buf.snapshot() if buf is not None else []  # thin client
     head = getattr(worker, "cluster_head", None)
@@ -226,7 +240,8 @@ def cluster_task_events(worker) -> List[TaskEvent]:
         cur = merged.get(ev.task_id)
         merged[ev.task_id] = ev if cur is None else _prefer(ev, cur)
     out = list(merged.values())
-    out.sort(key=lambda ev: ev.start_s)
+    if sort:
+        out.sort(key=lambda ev: ev.start_s)
     return out
 
 

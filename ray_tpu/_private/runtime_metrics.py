@@ -55,12 +55,20 @@ def _set_series(name: str, desc: str, tag_key: str,
     tag vanished (a state with no members must read 0, not its last
     nonzero value — and a fresh session must not export the previous
     cluster's counts)."""
-    g = _gauge(name, desc, tag_keys=(tag_key,))
+    _set_multi_series(name, desc, (tag_key,),
+                      {(tag,): v for tag, v in values.items()})
+
+
+def _set_multi_series(name: str, desc: str, tag_keys: Tuple[str, ...],
+                      values: Dict[Tuple[str, ...], float]) -> None:
+    """_set_series for composite tag sets (e.g. (job, state)): same
+    fresh-snapshot semantics with vanished tag combinations zeroed."""
+    g = _gauge(name, desc, tag_keys=tag_keys)
     current = set(values)
     for stale in _prev_tags.get(name, set()) - current:
-        g.set(0.0, tags={tag_key: stale})
-    for tag, v in values.items():
-        g.set(float(v), tags={tag_key: tag})
+        g.set(0.0, tags=dict(zip(tag_keys, stale)))
+    for tags, v in values.items():
+        g.set(float(v), tags=dict(zip(tag_keys, tags)))
     _prev_tags[name] = current
 
 
@@ -94,6 +102,97 @@ def _collect_fastpath_stats() -> None:
                tag_keys=tag_keys).set(stat.sum, tags=tag_dict)
 
 
+def _collect_node_stats() -> None:
+    """Physical node stats (`node_stats.sample_node_stats` — the
+    reporter-agent psutil sample) as ``ray_tpu_node_*`` gauges: every
+    process exports its own node's sample, so worker-node snapshots
+    ship them and the head's merged exposition carries one
+    ``node="<id>"``-tagged series set per node."""
+    from ray_tpu._private.node_stats import sample_node_stats
+
+    stats = sample_node_stats()
+    for key, gauge_name, desc in (
+            ("cpu_percent", "ray_tpu_node_cpu_percent",
+             "Node CPU utilization percent"),
+            ("cpu_count", "ray_tpu_node_cpu_count", "Node CPU count"),
+            ("mem_total", "ray_tpu_node_mem_total_bytes",
+             "Node total memory bytes"),
+            ("mem_available", "ray_tpu_node_mem_available_bytes",
+             "Node available memory bytes"),
+            ("mem_percent", "ray_tpu_node_mem_percent",
+             "Node memory utilization percent"),
+            ("disk_total", "ray_tpu_node_disk_total_bytes",
+             "Node root-disk total bytes"),
+            ("disk_free", "ray_tpu_node_disk_free_bytes",
+             "Node root-disk free bytes"),
+            ("disk_percent", "ray_tpu_node_disk_percent",
+             "Node root-disk utilization percent"),
+            ("pid_count", "ray_tpu_node_pid_count",
+             "Node process count")):
+        v = stats.get(key)
+        if v is not None:
+            _gauge(gauge_name, desc).set(float(v))
+    la = stats.get("load_avg")
+    if la:
+        _gauge("ray_tpu_node_load_1m", "Node 1-minute load average").set(
+            float(la[0]))
+
+
+def _collect_job_metrics(w) -> None:
+    """Per-job resource accounting as ``job="<id>"``-tagged series. On
+    a cluster head the task-event side is CLUSTER-wide (the shipping
+    plane's merged view); object accounting is per process — node
+    snapshots ship their own, node-tagged in the merged exposition.
+
+    The event fold is fingerprint-cached: the cluster merge is O(all
+    stored events) and runs every scrape/ship cycle on the head, so an
+    idle cluster must not pay a repeated 200k-event walk — the buffer
+    and aggregator mutation seqs tell us when nothing moved."""
+    from ray_tpu._private.obs_plane import cluster_task_events
+
+    buf = getattr(w, "task_events", None)
+    head = getattr(w, "cluster_head", None)
+    agg = getattr(head, "obs", None) if head is not None else None
+    fp = (buf.mutation_seq if buf is not None else -1,
+          agg.mutation_seq if agg is not None else -1)
+    cached = getattr(w, "_job_metrics_cache", None)
+    if cached is not None and cached[0] == fp:
+        tasks, cpu = cached[1], cached[2]
+    else:
+        tasks: Dict[Tuple[str, ...], float] = {}
+        cpu: Dict[Tuple[str, ...], float] = {}
+        for ev in cluster_task_events(w, sort=False):
+            if not ev.job_id:
+                continue
+            key = (ev.job_id, ev.state)
+            tasks[key] = tasks.get(key, 0) + 1
+            dur = ev.duration_s()
+            if dur:
+                ckey = (ev.job_id,)
+                cpu[ckey] = cpu.get(ckey, 0.0) + dur
+        w._job_metrics_cache = (fp, tasks, cpu)
+    _set_multi_series("ray_tpu_job_tasks", "Tasks by job and state",
+                      ("job", "state"), tasks)
+    _set_multi_series("ray_tpu_job_cpu_seconds",
+                      "Cumulative task execution seconds by job",
+                      ("job",), cpu)
+    store = getattr(w, "memory_store", None)
+    if store is not None and hasattr(store, "job_object_stats"):
+        objs: Dict[Tuple[str, ...], float] = {}
+        obj_bytes: Dict[Tuple[str, ...], float] = {}
+        for job, (n, nbytes) in store.job_object_stats().items():
+            if not job:
+                continue  # untagged: no job="" metric series
+            objs[(job,)] = float(n)
+            obj_bytes[(job,)] = float(nbytes)
+        _set_multi_series("ray_tpu_job_objects",
+                          "Objects owned in the object store by job",
+                          ("job",), objs)
+        _set_multi_series("ray_tpu_job_object_store_bytes",
+                          "Estimated object-store bytes owned by job",
+                          ("job",), obj_bytes)
+
+
 def collect_runtime_metrics() -> None:
     """Refresh the canonical runtime gauges from live state. Cheap
     (reads in-process tables); safe to call on every scrape."""
@@ -104,6 +203,19 @@ def collect_runtime_metrics() -> None:
     except Exception:
         pass
     _collect_ext_providers()
+    try:
+        _collect_node_stats()
+    except Exception:
+        pass
+    # Health/SLO plane: burn-rate + loop-lag + pressure + scheduler
+    # queue-depth gauges (what per-node /api/healthz verdicts read out
+    # of shipped snapshots).
+    try:
+        from ray_tpu._private.health import collect_health_metrics
+
+        collect_health_metrics()
+    except Exception:
+        pass
 
     w = worker_mod.global_worker_or_none()
     if w is None:
@@ -117,6 +229,12 @@ def collect_runtime_metrics() -> None:
     except Exception:
         pass
     _set_series("ray_tpu_tasks", "Tasks by state", "state", by_state)
+
+    # Per-job attribution series (job-tagged tasks/cpu/objects).
+    try:
+        _collect_job_metrics(w)
+    except Exception:
+        pass
 
     # Actors by state (reference STATS_actors).
     try:

@@ -38,6 +38,10 @@ class TaskEvent:
     # Span linkage: the task's own id is its span id.
     trace_id: str = ""
     parent_span_id: str = ""
+    # Job/tenant tag carried by the spec ("" = untagged): the per-job
+    # attribution key for state.job_summary(), the job-tagged metric
+    # series, and timeline filtering.
+    job_id: str = ""
 
     def duration_s(self) -> Optional[float]:
         if self.end_s is None:
@@ -72,6 +76,7 @@ def chrome_trace_events(events) -> List[dict]:
             "pid": ev.node_id[:8],
             "tid": ev.worker,
             "args": {"task_id": ev.task_id, "state": ev.state,
+                     **({"job": ev.job_id} if ev.job_id else {}),
                      **({"error": ev.error} if ev.error else {})},
         })
     return out
@@ -89,10 +94,18 @@ class TaskEventBuffer:
         # eviction sweep.
         self._dirty: "collections.OrderedDict[str, None]" = \
             collections.OrderedDict()
+        # Bumped on every insert/update: a cheap change fingerprint so
+        # per-scrape aggregations (the job-metric fold) can skip their
+        # full-buffer walk when nothing moved between scrapes.
+        self._mutations = 0
 
     @property
     def capacity(self) -> int:
         return self._max
+
+    @property
+    def mutation_seq(self) -> int:
+        return self._mutations
 
     def task_started(self, spec, node_id, worker_name: str) -> None:
         ev = TaskEvent(
@@ -103,8 +116,10 @@ class TaskEventBuffer:
             actor_id=spec.actor_id.hex() if spec.actor_id else None,
             trace_id=_trace_id_of(spec),
             parent_span_id=(spec.trace_parent[1] if spec.trace_parent
-                            else ""))
+                            else ""),
+            job_id=spec.job_id or "")
         with self._lock:
+            self._mutations += 1
             self._events[ev.task_id] = ev
             self._dirty[ev.task_id] = None
             while len(self._events) > self._max:
@@ -116,10 +131,24 @@ class TaskEventBuffer:
             ev = self._events.get(spec.task_id.hex())
             if ev is None:
                 return
+            self._mutations += 1
             ev.end_s = time.time()
             ev.state = "FAILED" if error else "FINISHED"
             ev.error = error or ""
             self._dirty[ev.task_id] = None
+
+    def record_event(self, ev: TaskEvent) -> None:
+        """Insert a fully-formed event (runtime incidents that are not a
+        task execution — e.g. the memory monitor's worker-kill
+        decisions — use this so they show up in timeline()/state views
+        and ship to the head like any task event)."""
+        with self._lock:
+            self._mutations += 1
+            self._events[ev.task_id] = ev
+            self._dirty[ev.task_id] = None
+            while len(self._events) > self._max:
+                evicted, _ = self._events.popitem(last=False)
+                self._dirty.pop(evicted, None)
 
     def list_events(self, limit: int = 10_000) -> List[TaskEvent]:
         with self._lock:

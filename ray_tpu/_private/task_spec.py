@@ -91,6 +91,56 @@ def get_ambient_trace_parent() -> Optional[tuple]:
     return getattr(_AMBIENT_TRACE, "tp", None)
 
 
+# -- ambient job/tenant context ---------------------------------------------
+# Multi-tenant attribution (reference: every TaskSpec carries a JobID
+# assigned at driver connect, and the state API slices by it): a spec's
+# job tag is inherited from the submitting TASK's spec when the
+# submission happens inside a task, so one tag set at the entry point
+# flows through arbitrary .remote() chains. Submissions from outside
+# any task (a driver, the Serve router dispatching an HTTP request)
+# read a thread-local ambient tag — same bridge as the ambient trace
+# parent above — with a process-wide default taken from RAY_TPU_JOB_ID,
+# the env channel job_submission uses for entrypoint subprocesses.
+
+_AMBIENT_JOB = threading.local()
+_default_job_id: "str | None" = None
+
+
+def default_job_id() -> str:
+    """Process-wide fallback job tag: RAY_TPU_JOB_ID when the process
+    is a job entrypoint (job_submission sets it), else ""."""
+    global _default_job_id
+    if _default_job_id is None:
+        import os
+
+        _default_job_id = os.environ.get("RAY_TPU_JOB_ID", "")
+    return _default_job_id
+
+
+def set_ambient_job_id(job_id: Optional[str]) -> Optional[str]:
+    """Install a job tag for submissions from this thread (None clears
+    back to the process default); returns the previous value for
+    restore."""
+    prev = getattr(_AMBIENT_JOB, "job", None)
+    _AMBIENT_JOB.job = job_id
+    return prev
+
+
+def get_ambient_job_id() -> str:
+    job = getattr(_AMBIENT_JOB, "job", None)
+    return job if job is not None else default_job_id()
+
+
+def job_id_for_submit(ctx_spec) -> str:
+    """The job tag a new submission carries: the submitting task's own
+    tag in-task (executor threads are pooled, so their thread-local
+    ambient could belong to an unrelated job), the thread's ambient /
+    process default otherwise."""
+    if ctx_spec is not None:
+        return ctx_spec.job_id or ""
+    return get_ambient_job_id()
+
+
 def check_isolate_process(value):
     """isolate_process accepts False (in-thread), True (forked worker),
     or "spawn" (fresh interpreter); anything else is a typo that would
@@ -150,6 +200,11 @@ class TaskSpec:
     # from the submitting task (reference: tracing_helper.py span
     # context in task metadata).
     trace_parent: Optional[tuple] = None
+    # Job/tenant tag: assigned at submission (job_submission entrypoint,
+    # Serve ingress, or any ambient scope) and inherited down .remote()
+    # chains, so every task/event/metric of one workload is attributable
+    # end-to-end. "" = untagged.
+    job_id: str = ""
     # Content hash of the interned SpecTemplate this spec was built
     # from, when it was (see intern_template). The cluster wire path
     # ships the template once per node and then references it by this
@@ -265,7 +320,8 @@ class SpecTemplate:
                   depth: int = 0, trace_parent: Optional[tuple] = None,
                   actor_id: Optional[ActorID] = None,
                   sequence_number: int = 0,
-                  num_returns: "int | str | None" = None) -> TaskSpec:
+                  num_returns: "int | str | None" = None,
+                  job_id: str = "") -> TaskSpec:
         """Per-call spec construction: only the varying fields are new."""
         spec = TaskSpec(
             task_id=task_id,
@@ -294,6 +350,7 @@ class SpecTemplate:
             func_id=self.func_id,
             depth=depth,
             trace_parent=trace_parent,
+            job_id=job_id,
             template_id=self.template_id,
         )
         # The scheduler's demand conversion, computed once at intern time.

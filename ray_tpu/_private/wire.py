@@ -360,3 +360,7 @@ class TaskCall:
     depth: int = 0
     trace_parent: Any = None   # (trace_id_hex, parent_span_id_hex) | None
     max_retries: int = 3
+    # Job/tenant tag (added field: older receivers skip it) — rides the
+    # header exactly like trace_parent so attribution survives the
+    # interned fast path.
+    job_id: str = ""

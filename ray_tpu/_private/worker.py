@@ -100,8 +100,13 @@ class Worker:
             raise TypeError(
                 "Calling put() on an ObjectRef is not allowed; pass the ref directly."
             )
+        from ray_tpu._private.task_spec import job_id_for_submit
+
+        ctx = self.task_context.current()
         oid = self.next_put_id()
-        self.memory_store.put(oid, value)
+        self.memory_store.put(
+            oid, value,
+            job_id=job_id_for_submit(ctx["task_spec"] if ctx else None))
         if self.shm_plane is not None:
             from ray_tpu._private.shm_plane import share_value
 
@@ -159,12 +164,13 @@ class Worker:
         return args, kwargs
 
     def store_task_outputs(self, spec: TaskSpec, values, error=None):
+        job = getattr(spec, "job_id", "") or ""
         if error is not None:
             for oid in spec.return_ids:
-                self.memory_store.put(oid, None, error=error)
+                self.memory_store.put(oid, None, error=error, job_id=job)
             return
         for oid, value in zip(spec.return_ids, values):
-            self.memory_store.put(oid, value)
+            self.memory_store.put(oid, value, job_id=job)
             if self.shm_plane is not None:
                 from ray_tpu._private.shm_plane import share_value
 

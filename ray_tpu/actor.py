@@ -16,6 +16,7 @@ from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (check_isolate_process,
                                         get_ambient_trace_parent,
                                         intern_template,
+                                        job_id_for_submit,
                                         trace_parent_from,
                                         DefaultSchedulingStrategy,
                                         TaskKind)
@@ -94,13 +95,15 @@ class ActorHandle:
                 max_retries=self._max_task_retries,
             )
             self._method_templates[key] = tpl
+        _ctx = w.task_context.current()
+        _ctx_spec = _ctx["task_spec"] if _ctx else None
         spec = tpl.make_spec(
             TaskID.from_random(), args, kwargs,
             actor_id=self._actor_id,
             sequence_number=seq,
-            trace_parent=(trace_parent_from(_ctx["task_spec"])
-                          if (_ctx := w.task_context.current())
-                          else get_ambient_trace_parent()),
+            trace_parent=(trace_parent_from(_ctx_spec)
+                          if _ctx else get_ambient_trace_parent()),
+            job_id=job_id_for_submit(_ctx_spec),
         )
         refs = w.submit(spec)
         # dynamic: the single ref resolves to an ObjectRefGenerator
@@ -182,12 +185,14 @@ class ActorClass:
                     opts.get("isolate_process", False)),
             )
         actor_id = ActorID.from_random()
+        _ctx = w.task_context.current()
+        _ctx_spec = _ctx["task_spec"] if _ctx else None
         spec = tpl.make_spec(
             TaskID.from_random(), args, kwargs,
             actor_id=actor_id,
-            trace_parent=(trace_parent_from(_ctx["task_spec"])
-                          if (_ctx := w.task_context.current())
-                          else get_ambient_trace_parent()),
+            trace_parent=(trace_parent_from(_ctx_spec)
+                          if _ctx else get_ambient_trace_parent()),
+            job_id=job_id_for_submit(_ctx_spec),
         )
         handle = ActorHandle(
             actor_id, self._cls, name, opts.get("max_task_retries", 0)

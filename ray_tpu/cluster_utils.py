@@ -1102,7 +1102,8 @@ class ClusterBackendMixin:
                     num_returns=spec.num_returns,
                     depth=spec.depth,
                     trace_parent=spec.trace_parent,
-                    max_retries=spec.max_retries)
+                    max_retries=spec.max_retries,
+                    job_id=spec.job_id or "")
                 return call, templates
         return self._strip_exported_func(spec, record), []
 

@@ -151,6 +151,16 @@ class DashboardServer:
                 }],
             }]}, default=str).encode()
             return body, "application/json"
+        if path == "/api/healthz":
+            # Node + cluster overload verdict (SLO burn, event-loop
+            # lag, scheduler backlog, memory pressure) with reasons
+            # naming the overloaded signal — the load-shedding /
+            # autoscaling signal surface. Always 200; readers key off
+            # the "status" field ("ok" | "degraded").
+            from ray_tpu._private.health import evaluate_health
+
+            return (json.dumps(evaluate_health(), default=str).encode(),
+                    "application/json")
         if path == "/ui":
             return _UI_HTML.encode(), "text/html"
         if path == "/api/jobs" or path.startswith("/api/jobs/"):
@@ -171,13 +181,18 @@ class DashboardServer:
                                         "/api/cluster_status",
                                         "/api/serve", "/api/metrics",
                                         "/api/traces", "/api/timeline",
-                                        "/api/logs", "/api/events"]},
+                                        "/api/logs", "/api/events",
+                                        "/api/healthz",
+                                        "/api/job_summary"]},
             "/api/nodes": state.list_nodes,
             "/api/tasks": state.list_tasks,
             "/api/actors": state.list_actors,
             "/api/objects": state.list_objects,
             "/api/placement_groups": state.list_placement_groups,
             "/api/timeline": ray_tpu.timeline,
+            # Per-job resource accounting (tasks by state, CPU-seconds,
+            # object-store footprint, serve requests by route).
+            "/api/job_summary": state.job_summary,
             "/api/cluster_status": lambda: {
                 "cluster_resources": ray_tpu.cluster_resources(),
                 "available_resources": ray_tpu.available_resources(),

@@ -151,6 +151,36 @@ def generate_observability_dashboard() -> dict:
     ], uid="ray-tpu-observability")
 
 
+def generate_jobs_dashboard() -> dict:
+    """Per-job (tenant) attribution + SLO/health panels over the
+    job-tagged series (`_private/runtime_metrics._collect_job_metrics`,
+    the ingress `serve_requests{job,route}` counter) and the health
+    plane's burn/lag/pressure gauges (`_private/health.py`)."""
+    return generate_dashboard("ray_tpu jobs", [
+        {"title": "Top jobs by CPU-seconds", "unit": "s",
+         "exprs": [('topk(10, sum(ray_tpu_job_cpu_seconds) by (job))',
+                    "{{job}}")]},
+        {"title": "Tasks by job",
+         "exprs": [('sum(ray_tpu_job_tasks) by (job, state)',
+                    "{{job}} {{state}}")]},
+        {"title": "Object-store bytes by job", "unit": "bytes",
+         "exprs": [('sum(ray_tpu_job_object_store_bytes) by (job)',
+                    "{{job}}")]},
+        {"title": "Serve requests by job",
+         "exprs": [('sum(rate(ray_tpu_serve_requests_total[1m])) '
+                    'by (job, route)', "{{job}} {{route}}")]},
+        {"title": "Serve SLO burn rate",
+         "exprs": [('ray_tpu_serve_slo_burn_rate',
+                    "{{route}} {{window}}")]},
+        {"title": "Overload signals",
+         "exprs": [("ray_tpu_event_loop_lag_last_seconds",
+                    "loop lag {{component}} {{node}}"),
+                   ("ray_tpu_memory_pressure",
+                    "memory pressure {{node}}"),
+                   ("ray_tpu_sched_backlog", "backlog {{node}}")]},
+    ], uid="ray-tpu-jobs")
+
+
 def write_dashboards(directory: str) -> List[str]:
     """Write all generated dashboards into a Grafana provisioning dir;
     returns the file paths."""
@@ -158,7 +188,8 @@ def write_dashboards(directory: str) -> List[str]:
     out = []
     for dash in (generate_default_dashboard(),
                  generate_serve_dashboard(),
-                 generate_observability_dashboard()):
+                 generate_observability_dashboard(),
+                 generate_jobs_dashboard()):
         path = os.path.join(directory, f"{dash['uid']}.json")
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)

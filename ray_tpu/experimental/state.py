@@ -38,6 +38,7 @@ def list_tasks(*, filters: Optional[List[tuple]] = None,
             "worker": ev.worker,
             "error_message": ev.error,
             "actor_id": ev.actor_id,
+            "job_id": ev.job_id,
         }
         for ev in events
     ]
@@ -101,6 +102,55 @@ def summarize_tasks() -> Dict[str, Any]:
         entry["states"][state] = n
         entry["total_time_s"] = round(total_time.get(name, 0.0), 6)
     return summary
+
+
+def job_summary() -> Dict[str, Any]:
+    """Per-job resource accounting (cluster-wide on a head): task counts
+    by state, cumulative task CPU-seconds (summed execution time over
+    retained events), objects + estimated bytes owned in this process's
+    store, and serve requests by route. Untagged work rolls up under
+    the ``""`` key so tenant totals always reconcile against the whole
+    cluster."""
+    from ray_tpu._private import perf_stats
+    from ray_tpu._private.obs_plane import cluster_task_events
+
+    w = _worker()
+    jobs: Dict[str, Any] = {}
+
+    def entry(job: str) -> Dict[str, Any]:
+        e = jobs.get(job)
+        if e is None:
+            e = jobs[job] = {"tasks": {}, "cpu_seconds": 0.0,
+                             "objects": 0, "object_store_bytes": 0,
+                             "serve_requests": {}}
+        return e
+
+    for ev in cluster_task_events(w, sort=False):
+        e = entry(ev.job_id or "")
+        e["tasks"][ev.state] = e["tasks"].get(ev.state, 0) + 1
+        dur = ev.duration_s()
+        if dur:
+            e["cpu_seconds"] += dur
+    store = getattr(w, "memory_store", None)
+    if store is not None and hasattr(store, "job_object_stats"):
+        for job, (n, nbytes) in store.job_object_stats().items():
+            e = entry(job)
+            e["objects"] = n
+            e["object_store_bytes"] = nbytes
+    # Serve requests by (job, route) — recorded by the ingress in this
+    # process (the proxy normally runs in the head/driver).
+    for name, tags, stat in perf_stats.stats_items():
+        if name != "serve_requests" or \
+                not isinstance(stat, perf_stats.Counter):
+            continue
+        t = dict(tags)
+        e = entry(t.get("job", ""))
+        route = t.get("route", "(unmatched)")
+        e["serve_requests"][route] = \
+            e["serve_requests"].get(route, 0) + stat.value
+    for e in jobs.values():
+        e["cpu_seconds"] = round(e["cpu_seconds"], 6)
+    return jobs
 
 
 def summarize_actors() -> Dict[str, Any]:

@@ -63,6 +63,11 @@ class JobSupervisor:
         renv = self.info.runtime_env or {}
         env.update({str(k): str(v)
                     for k, v in (renv.get("env_vars") or {}).items()})
+        # The attribution channel into the entrypoint: a driver process
+        # started under this env tags every submission with the job id
+        # (task_spec.default_job_id), so the job's tasks/metrics/objects
+        # are attributable cluster-wide without code changes.
+        env["RAY_TPU_JOB_ID"] = self.info.job_id
         cwd = renv.get("working_dir") or None
         self.info.status = JobStatus.RUNNING
         self.info.start_time = time.time()
@@ -117,10 +122,20 @@ class JobSubmissionClient:
         job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         if job_id in self._jobs:
             raise ValueError(f"job {job_id} already exists")
-        supervisor = JobSupervisor.options(
-            name=f"_job_supervisor:{job_id}", lifetime="detached",
-            max_concurrency=4,
-        ).remote(job_id, entrypoint, runtime_env, metadata or {})
+        # The supervisor actor (and anything it spawns in-process) is
+        # part of the job it supervises: tag its creation so the job's
+        # footprint starts at the supervisor, not at the first
+        # entrypoint task.
+        from ray_tpu._private.task_spec import set_ambient_job_id
+
+        prev = set_ambient_job_id(job_id)
+        try:
+            supervisor = JobSupervisor.options(
+                name=f"_job_supervisor:{job_id}", lifetime="detached",
+                max_concurrency=4,
+            ).remote(job_id, entrypoint, runtime_env, metadata or {})
+        finally:
+            set_ambient_job_id(prev)
         self._jobs[job_id] = supervisor
         return job_id
 

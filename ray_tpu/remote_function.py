@@ -16,6 +16,7 @@ from ray_tpu._private.task_spec import (
     check_isolate_process,
     get_ambient_trace_parent,
     intern_template,
+    job_id_for_submit,
     trace_parent_from,
     DefaultSchedulingStrategy,
     SchedulingStrategy,
@@ -129,11 +130,13 @@ class RemoteFunction:
         if tpl is None:
             tpl = self._template = self._build_template()
         ctx = w.task_context.current()
+        ctx_spec = ctx["task_spec"] if ctx else None
         spec = tpl.make_spec(
             TaskID.from_random(), args, kwargs,
-            depth=(ctx["task_spec"].depth + 1) if ctx else 0,
-            trace_parent=(trace_parent_from(ctx["task_spec"])
+            depth=(ctx_spec.depth + 1) if ctx else 0,
+            trace_parent=(trace_parent_from(ctx_spec)
                           if ctx else get_ambient_trace_parent()),
+            job_id=job_id_for_submit(ctx_spec),
         )
         refs = w.submit(spec)
         num_returns = tpl.num_returns

@@ -88,6 +88,32 @@ def cmd_job(args):
         print("stopped")
 
 
+def cmd_jobs(args):
+    """Per-job attribution view: task counts by state, CPU-seconds,
+    object-store footprint, and serve requests by route, per job tag
+    (cluster-wide on a head)."""
+    import ray_tpu
+    from ray_tpu.experimental import state
+
+    ray_tpu.init(ignore_reinit_error=True)
+    summary = state.job_summary()
+    if args.job_id:
+        summary = {args.job_id: summary.get(args.job_id, {})}
+    print(json.dumps(summary, indent=2, default=str))
+
+
+def cmd_health(args):
+    """Node + cluster health verdict (the /api/healthz payload). Exits
+    nonzero when degraded so scripts can gate on it."""
+    import ray_tpu
+    from ray_tpu._private.health import evaluate_health
+
+    ray_tpu.init(ignore_reinit_error=True)
+    verdict = evaluate_health()
+    print(json.dumps(verdict, indent=2, default=str))
+    sys.exit(0 if verdict["status"] == "ok" else 1)
+
+
 def cmd_serve(args):
     """`serve deploy/run/status/shutdown` (reference
     `serve/scripts.py` CLI over the REST schema)."""
@@ -160,6 +186,12 @@ def main(argv=None):
     p.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory").set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("jobs")
+    p.add_argument("job_id", nargs="?", default=None)
+    p.set_defaults(fn=cmd_jobs)
+
+    sub.add_parser("health").set_defaults(fn=cmd_health)
 
     p = sub.add_parser("job")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -396,7 +396,16 @@ class ServeController:
 
     def _stop_replica(self, replica):
         try:
-            replica.prepare_for_shutdown.remote()
+            # Await the drain (bounded slightly above the replica's own
+            # 10s in-flight wait): a fire-and-forget send would race the
+            # kill below, skipping both the graceful drain and the
+            # replica's teardown (request-loop stop + lag-sampler
+            # component retirement).
+            try:
+                ray_tpu.get(replica.prepare_for_shutdown.remote(),
+                            timeout=12.0)
+            except Exception:
+                pass
             ray_tpu.kill(replica)
         except Exception:
             pass

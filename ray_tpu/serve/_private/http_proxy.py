@@ -51,13 +51,18 @@ from ray_tpu.serve.streaming import aiter_stream, is_stream
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 32 * 1024 * 1024
 _MAX_PIPELINED = 16
+# Distinct X-Job-Id values one proxy will account before new tags
+# degrade to untagged (metric/event cardinality bound).
+_MAX_JOB_TAGS = 512
 
 # Structured access log (one line per request, JSON payload), enabled
 # by ray_config.serve_access_log — off by default so the ingress hot
 # path stays log-free.
 _access_log = logging.getLogger("ray_tpu.serve.access")
 
-# Trace ids (client-supplied or minted): token chars only.
+# Trace ids (client-supplied or minted) and job/tenant tags: token
+# chars only — both are echoed into response headers and logs, so the
+# same header-injection sanitizing applies.
 _TRACE_ID_OK = re.compile(r"^[0-9A-Za-z_.-]+$").match
 
 # Live proxies in this process, for the runtime-metrics gauges
@@ -169,6 +174,7 @@ class _Conn(asyncio.Protocol):
         self.http10 = False  # version of the request being handled
         self.last_status = 0  # status of the most recent response
         self.trace_id = ""    # trace id of the request being handled
+        self.job_id = ""      # job/tenant tag of the request in flight
 
     # -- lifecycle -------------------------------------------------------
 
@@ -336,6 +342,9 @@ class _Conn(asyncio.Protocol):
             # bytes concatenation, no per-header string formatting.
             trace_hdr = (b"X-Trace-Id: " + self.trace_id.encode()
                          + b"\r\n") if self.trace_id else b""
+            if self.job_id:
+                trace_hdr += (b"X-Job-Id: " + self.job_id.encode()
+                              + b"\r\n")
             self.transport.write(
                 b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
                 b"\r\n" + trace_hdr
@@ -349,6 +358,8 @@ class _Conn(asyncio.Protocol):
         ]
         if self.trace_id:
             parts.append(f"X-Trace-Id: {self.trace_id}")
+        if self.job_id:
+            parts.append(f"X-Job-Id: {self.job_id}")
         if retry_after:
             parts.append("Retry-After: 1")
         if not keep:
@@ -372,6 +383,8 @@ class _Conn(asyncio.Protocol):
         parts += [f"{k}: {v}" for k, v in headers]
         if self.trace_id:
             parts.append(f"X-Trace-Id: {self.trace_id}")
+        if self.job_id:
+            parts.append(f"X-Job-Id: {self.job_id}")
         self.transport.write(
             ("\r\n".join(parts) + "\r\n\r\n").encode("latin-1"))
 
@@ -402,6 +415,13 @@ class HTTPProxy:
         self._served = 0
         self._shed = 0
         self._conns: set = set()
+        # Distinct job tags this proxy has accounted. X-Job-Id is
+        # client-controlled: without a cap, a client cycling random
+        # tokens mints one permanent (route, job) counter series — and
+        # one job-tagged task-event key head-side — per value. Real
+        # tenant counts are far below this; overflow tags degrade to
+        # untagged rather than growing the registry.
+        self._job_tags_seen: set = set()
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._loop_main,
@@ -437,6 +457,12 @@ class HTTPProxy:
         self._server = await self._loop.create_server(
             lambda: _Conn(self), host, port)
         self._reaper = self._loop.create_task(self._reap_idle())
+        # Overload signal for /api/healthz: how late timed callbacks
+        # fire on THIS loop — the single-threaded ingress's canonical
+        # saturation measure (the sampler task dies with the loop).
+        from ray_tpu._private.health import install_loop_lag_sampler
+
+        install_loop_lag_sampler(self._loop, "http_proxy")
         return self._server.sockets[0].getsockname()[:2]
 
     async def _reap_idle(self):
@@ -476,20 +502,43 @@ class HTTPProxy:
         # caller's correlation.
         trace_id = supplied if supplied and len(supplied) <= 64 \
             and _TRACE_ID_OK(supplied) else uuid.uuid4().hex
+        # Job/tenant tag (X-Job-Id): same sanitizing as the trace id
+        # (echoed into headers/logs), but never minted — an untagged
+        # request falls through to the proxy process's ambient/default
+        # tag, and a malformed value is dropped rather than replaced.
+        raw_job = (req.headers.get("x-job-id", "")
+                   if getattr(req, "headers", None) else "")
+        job_id = raw_job if raw_job and len(raw_job) <= 64 \
+            and _TRACE_ID_OK(raw_job) else ""
+        if job_id and job_id not in self._job_tags_seen:
+            if len(self._job_tags_seen) >= _MAX_JOB_TAGS:
+                job_id = ""  # cardinality guard: overflow -> untagged
+            else:
+                self._job_tags_seen.add(job_id)
         conn.trace_id = trace_id
+        conn.job_id = job_id
         conn.last_status = 0
         route = ""
         try:
-            route = await self._respond(conn, req, trace_id)
+            route = await self._respond(conn, req, trace_id, job_id)
         finally:
             latency = time.monotonic() - t0
             conn.trace_id = ""
+            conn.job_id = ""
             status = str(conn.last_status or 0)
             perf_stats.dist(
                 "serve_request_seconds",
                 tags={"route": route or "(unmatched)",
                       "status": status},
                 bounds=perf_stats.SERVE_LATENCY_BOUNDS).record(latency)
+            # Per-(job, route) request accounting — the serve half of
+            # state.job_summary() and the ray_tpu_serve_requests_total
+            # job-tagged series. Route prefixes bound the cardinality;
+            # jobs are real tenants, also bounded.
+            perf_stats.counter(
+                "serve_requests",
+                tags={"route": route or "(unmatched)",
+                      "job": job_id}).inc()
             if ray_config.serve_access_log:
                 try:
                     _access_log.info(json.dumps({
@@ -499,12 +548,13 @@ class HTTPProxy:
                         "status": conn.last_status or 0,
                         "latency_ms": round(latency * 1e3, 3),
                         "trace_id": trace_id,
+                        "job_id": job_id,
                     }))
                 except Exception:
                     pass  # the access log must never break serving
 
     async def _respond(self, conn: _Conn, req: _Request,
-                       trace_id: str) -> str:
+                       trace_id: str, job_id: str = "") -> str:
         """Handle one parsed request; returns the matched route prefix
         (for metrics/logging)."""
         if req.error is not None:
@@ -540,16 +590,19 @@ class HTTPProxy:
             args = () if payload is None else (payload,)
             # The request is the trace ROOT: the replica call's parent
             # span is the request itself, so proxy→router→replica→tasks
-            # all share one trace id.
+            # all share one trace id. The job tag rides the same
+            # dispatch (None = untagged: the replica call inherits the
+            # proxy's ambient/default tag instead).
             trace = (trace_id, trace_id)
+            job = job_id or None
             # Fast path: a free replica slot dispatches synchronously
             # (no coroutine machinery); only saturation parks on the
             # async queue-wait.
-            ref = handle.try_remote(*args, _trace=trace)
+            ref = handle.try_remote(*args, _trace=trace, _job=job)
             if ref is None:
                 ref = await handle.remote_async(
                     *args, _queue_timeout_s=self.queue_timeout_s,
-                    _trace=trace)
+                    _trace=trace, _job=job)
             fut = ref.as_future(self._loop)
             try:
                 # Bounded replica execution (the threaded proxy's

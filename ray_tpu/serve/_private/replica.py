@@ -7,11 +7,18 @@ in-flight load for the router's capacity decisions.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Dict
 
 import ray_tpu
+
+# Lag-sampler component keys need a per-instance discriminator: two
+# replicas of one deployment can share a process, and under a shared
+# key the second install's supersede token would silently stop the
+# first replica's sampler — leaving exactly one loop unmonitored.
+_loop_seq = itertools.count(1)
 
 
 @ray_tpu.remote
@@ -36,6 +43,7 @@ class ServeReplica:
         self._stat_errors = perf_stats.counter(
             "serve_replica_errors", tags={"deployment": deployment_name})
         self._async_loop = None  # lazily-started, shared across requests
+        self._loop_lag_component = None
         if isinstance(serialized_cls, type):
             self.callable = serialized_cls(*(init_args or ()),
                                            **(init_kwargs or {}))
@@ -100,6 +108,20 @@ class ServeReplica:
                 threading.Thread(target=loop.run_forever, daemon=True,
                                  name="serve-replica-loop").start()
                 self._async_loop = loop
+                # Health-plane overload signal: lag on the replica's
+                # shared request loop (an async deployment blocking it
+                # stalls every other request on this replica). Recorded
+                # in THIS process, so on a worker node it ships to the
+                # head with the rest of the metric snapshot.
+                from ray_tpu._private.health import (
+                    install_loop_lag_sampler,
+                )
+
+                self._loop_lag_component = (
+                    f"replica:{self.deployment_name}"
+                    f"#{next(_loop_seq)}")
+                install_loop_lag_sampler(
+                    loop, self._loop_lag_component)
             return self._async_loop
 
     def _run_coroutine(self, coro):
@@ -170,10 +192,46 @@ class ServeReplica:
 
     def prepare_for_shutdown(self) -> bool:
         # Graceful: wait for in-flight to drain (bounded).
+        drained = False
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             with self._lock:
                 if self._in_flight == 0:
-                    return True
+                    drained = True
+                    break
             time.sleep(0.02)
-        return False
+        # Stop the request loop (kills its lag sampler with it) and
+        # retire the sampler's component entry — a retired replica must
+        # not keep an idle-~0 lag series alive under its unique key.
+        with self._lock:
+            loop, comp = self._async_loop, self._loop_lag_component
+            self._async_loop = None
+            self._loop_lag_component = None
+        if loop is not None:
+            import asyncio
+
+            # Cancel everything still on the loop (the lag sampler, any
+            # straggler requests past the drain deadline) and give the
+            # cancellations one pass to unwind BEFORE stopping — a task
+            # still pending at loop teardown warns "Task was destroyed
+            # but it is pending!" on every replica stop.
+            async def _cancel_all_and_stop():
+                cur = asyncio.current_task()
+                for t in asyncio.all_tasks():
+                    if t is not cur:
+                        t.cancel()
+                await asyncio.sleep(0)
+                loop.stop()
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _cancel_all_and_stop(), loop).result(timeout=2)
+            except Exception:
+                pass
+        if comp is not None:
+            from ray_tpu._private.health import (
+                remove_loop_lag_component,
+            )
+
+            remove_loop_lag_component(comp)
+        return drained

@@ -14,7 +14,8 @@ import time
 from typing import Any, Dict, List
 
 import ray_tpu
-from ray_tpu._private.task_spec import set_ambient_trace_parent
+from ray_tpu._private.task_spec import (set_ambient_job_id,
+                                        set_ambient_trace_parent)
 from ray_tpu.serve._private.long_poll import LongPollClient
 
 
@@ -85,7 +86,7 @@ class Router:
         return len(self._in_flight.get(replica, []))
 
     def _try_assign(self, method: str, args: tuple, kwargs: dict,
-                    trace=None):
+                    trace=None, job=None):
         """One round-robin dispatch attempt; returns the ref or None if
         every replica is at its in-flight cap. On success the waiting
         count drops under the SAME lock hold as the slot accounting —
@@ -101,7 +102,10 @@ class Router:
         ``trace`` is the request's (trace_id, parent_span_id): it rides
         the dispatching thread's ambient trace context so the replica's
         actor task — and every task the replica then submits — joins
-        the HTTP request's trace."""
+        the HTTP request's trace. ``job`` rides the ambient job tag the
+        same way: the replica call's spec carries it, so one tenant's
+        serve traffic stays attributable through the tasks it fans
+        into."""
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
@@ -121,12 +125,16 @@ class Router:
             try:
                 prev = set_ambient_trace_parent(trace) \
                     if trace is not None else None
+                prev_job = set_ambient_job_id(job) \
+                    if job is not None else None
                 try:
                     ref = replica.handle_request.remote(
                         method, args, kwargs)
                 finally:
                     if trace is not None:
                         set_ambient_trace_parent(prev)
+                    if job is not None:
+                        set_ambient_job_id(prev_job)
                 dispatched = True
             finally:
                 # Reserved→in-flight handoff under ONE hold: a gap
@@ -145,14 +153,14 @@ class Router:
         return None
 
     def assign_request(self, method: str, args: tuple, kwargs: dict,
-                       timeout: float = 30.0, trace=None):
+                       timeout: float = 30.0, trace=None, job=None):
         deadline = time.monotonic() + timeout
         dispatched = False
         with self._lock:
             self._waiting += 1
         try:
             while True:
-                ref = self._try_assign(method, args, kwargs, trace)
+                ref = self._try_assign(method, args, kwargs, trace, job)
                 if ref is not None:
                     dispatched = True
                     return ref
@@ -174,14 +182,14 @@ class Router:
                     self._waiting -= 1
 
     def try_assign_request(self, method: str, args: tuple,
-                           kwargs: dict, trace=None):
+                           kwargs: dict, trace=None, job=None):
         """Non-blocking dispatch: the ref if a replica slot is free
         right now, else None. The event-loop proxy's fast path — no
         coroutine, no parking; saturation falls back to
         :meth:`assign_request_async`."""
         with self._lock:
             self._waiting += 1
-        ref = self._try_assign(method, args, kwargs, trace)
+        ref = self._try_assign(method, args, kwargs, trace, job)
         if ref is None:
             with self._lock:
                 self._waiting -= 1
@@ -189,7 +197,7 @@ class Router:
 
     async def assign_request_async(self, method: str, args: tuple,
                                    kwargs: dict, timeout: float = 30.0,
-                                   trace=None):
+                                   trace=None, job=None):
         """Event-loop completion path (the asyncio HTTP proxy's bridge):
         identical dispatch and autoscaling accounting to
         :meth:`assign_request`, but saturation parks the coroutine with
@@ -202,7 +210,7 @@ class Router:
             self._waiting += 1
         try:
             while True:
-                ref = self._try_assign(method, args, kwargs, trace)
+                ref = self._try_assign(method, args, kwargs, trace, job)
                 if ref is not None:
                     dispatched = True
                     return ref
@@ -278,29 +286,32 @@ class ServeHandle:
             self._router_holder["r"] = r
         return r
 
-    def remote(self, *args, _trace=None, **kwargs):
+    def remote(self, *args, _trace=None, _job=None, **kwargs):
         return self._router().assign_request(self._method or "__call__",
-                                             args, kwargs, trace=_trace)
+                                             args, kwargs, trace=_trace,
+                                             job=_job)
 
     def remote_async(self, *args, _queue_timeout_s: float = 30.0,
-                     _trace=None, **kwargs):
+                     _trace=None, _job=None, **kwargs):
         """Awaitable dispatch for event-loop callers (the asyncio HTTP
         proxy): resolves to the ObjectRef once a replica slot frees,
         without ever blocking the calling loop. ``_queue_timeout_s``
         bounds the wait for a slot — the proxy maps its expiry to
         ``503 Retry-After`` (load shedding, not an error). ``_trace``
         is the request's (trace_id, parent_span_id); the replica call
-        joins that trace."""
+        joins that trace. ``_job`` is the request's job/tenant tag —
+        the replica call (and tasks it submits) carries it."""
         return self._router().assign_request_async(
             self._method or "__call__", args, kwargs,
-            timeout=_queue_timeout_s, trace=_trace)
+            timeout=_queue_timeout_s, trace=_trace, job=_job)
 
-    def try_remote(self, *args, _trace=None, **kwargs):
+    def try_remote(self, *args, _trace=None, _job=None, **kwargs):
         """Non-blocking dispatch: the ref now, or None when every
         replica is at its cap (caller then awaits
         :meth:`remote_async` or sheds)."""
         return self._router().try_assign_request(
-            self._method or "__call__", args, kwargs, trace=_trace)
+            self._method or "__call__", args, kwargs, trace=_trace,
+            job=_job)
 
     def __getattr__(self, name: str) -> "ServeHandle":
         if name.startswith("_"):

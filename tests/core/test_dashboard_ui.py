@@ -90,7 +90,7 @@ def test_grafana_dashboard_factory(tmp_path):
     assert len(pos) == 6
 
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 3  # core, serve, observability
+    assert len(paths) == 4  # core, serve, observability, jobs
     for p in paths:
         with open(p) as f:
             loaded = json.load(f)
@@ -106,3 +106,16 @@ def test_grafana_dashboard_factory(tmp_path):
                      for t in p["targets"])
     assert "ray_tpu_batcher_queue_delay_seconds_p95" in exprs
     assert "ray_tpu_sched_submit_to_start_seconds_p95" in exprs
+
+    from ray_tpu.dashboard.grafana import generate_jobs_dashboard
+
+    jobs = generate_jobs_dashboard()
+    assert jobs["uid"] == "ray-tpu-jobs"
+    exprs = " ".join(t["expr"] for p in jobs["panels"]
+                     for t in p["targets"])
+    # Per-job attribution panels read the job-tagged series, the SLO
+    # burn panel the health plane's gauge.
+    assert "ray_tpu_job_cpu_seconds" in exprs
+    assert "ray_tpu_job_tasks" in exprs
+    assert "ray_tpu_serve_slo_burn_rate" in exprs
+    assert "ray_tpu_memory_pressure" in exprs

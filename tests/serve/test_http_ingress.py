@@ -454,13 +454,16 @@ def test_access_log_and_trace_header_on_keepalive(serve_up, caplog):
                                               timeout=30)
             for payload in [{"stream": True}, {"x": 1}]:
                 conn.request(
-                    "POST", "/logged", body=json.dumps(payload),
+                    "POST", "/logged?tenant=1", body=json.dumps(payload),
                     headers={"Content-Type": "application/json",
-                             "X-Trace-Id": "trace-ka-1"})
+                             "X-Trace-Id": "trace-ka-1",
+                             "X-Job-Id": "tenant-log"})
                 resp = conn.getresponse()
                 assert resp.status == 200
-                # Trace id echoes on both unary and streamed replies.
+                # Trace id and job tag echo on unary and streamed
+                # replies alike.
                 assert resp.headers.get("X-Trace-Id") == "trace-ka-1"
+                assert resp.headers.get("X-Job-Id") == "tenant-log"
                 if payload.get("stream"):
                     _read_sse(resp)
                     resp.read()
@@ -478,10 +481,14 @@ def test_access_log_and_trace_header_on_keepalive(serve_up, caplog):
     assert len(lines) >= 2
     for line in lines[:2]:
         assert line["method"] == "POST"
+        # Route is the NORMALIZED matched prefix (bounded cardinality);
+        # the raw client path (query string and all) rides separately.
         assert line["route"] == "/logged"
+        assert line["path"] == "/logged?tenant=1"
         assert line["status"] == 200
         assert line["latency_ms"] > 0
         assert line["trace_id"] == "trace-ka-1"
+        assert line["job_id"] == "tenant-log"
 
     # And the request landed in the per-route/status latency stats.
     from ray_tpu._private import perf_stats

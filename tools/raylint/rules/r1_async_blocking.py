@@ -74,7 +74,7 @@ class AsyncBlockingRule(Rule):
                    "body (event-loop stall)")
 
     def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, ast.AsyncFunctionDef):
                 continue
             yield from self._check_coroutine(node)

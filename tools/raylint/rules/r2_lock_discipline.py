@@ -145,7 +145,7 @@ class LockDisciplineRule(Rule):
 
     def _collect_class_locks(self, fi: FileInfo) -> Dict[str, _ClassLocks]:
         out: Dict[str, _ClassLocks] = {}
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, ast.ClassDef):
                 continue
             locks = _ClassLocks()
@@ -175,7 +175,7 @@ class LockDisciplineRule(Rule):
         (module level or closure-local) — closures share them across
         nested functions, so resolve by bare name file-wide."""
         out: Dict[str, str] = {}
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
                     and _lock_factory(node.value) is not None:

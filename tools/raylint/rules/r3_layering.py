@@ -53,7 +53,7 @@ class LayeringRule(Rule):
         if my_pkg is None:
             return
         module_aliases = {}
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, (ast.Import, ast.ImportFrom)):
                 continue
             for bound, target, line in _imported_ray_module(node):
@@ -82,7 +82,7 @@ class LayeringRule(Rule):
                     module_aliases[bound] = target_pkg
 
         # 3. underscore attribute reads on cross-package module aliases
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, ast.Attribute):
                 continue
             if not (node.attr.startswith("_")

@@ -118,7 +118,7 @@ class ResourceLifecycleRule(Rule):
     # -- class-scoped resources -------------------------------------------
 
     def _check_classes(self, fi: FileInfo):
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, ast.ClassDef):
                 continue
             methods = {
@@ -165,7 +165,7 @@ class ResourceLifecycleRule(Rule):
     # -- function-scoped resources ----------------------------------------
 
     def _check_functions(self, fi: FileInfo):
-        for fn in ast.walk(fi.tree):
+        for fn in fi.nodes():
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             for node in ast.walk(fn):

@@ -133,7 +133,7 @@ class WireHygieneRule(Rule):
                            f"container or `Any` (explicit Opaque)")
 
     def _check_dict_pairs(self, fi: FileInfo):
-        for node in ast.walk(fi.tree):
+        for node in fi.nodes():
             if not isinstance(node, ast.ClassDef):
                 continue
             defs = {

@@ -19,9 +19,9 @@ from typing import Iterable, Tuple
 from tools.raylint.core import FileInfo, Rule
 
 
-def _used_names(tree: ast.AST) -> set:
+def _used_names(nodes) -> set:
     used = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Name):
             used.add(node.id)
         elif isinstance(node, ast.Attribute):
@@ -48,7 +48,7 @@ class HygieneRule(Rule):
     def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
         if fi.relpath.endswith("__init__.py"):
             return
-        used = _used_names(fi.tree)
+        used = _used_names(fi.nodes())
         for node in fi.tree.body:
             if isinstance(node, ast.Try):
                 stmts = node.body + [
