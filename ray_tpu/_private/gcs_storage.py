@@ -169,8 +169,13 @@ class SqliteStoreClient(StoreClient):
             if self._closed.is_set():
                 return
             # Group-commit window: let the burst accumulate, then one
-            # transaction covers all of it.
-            time.sleep(self._interval)
+            # transaction covers all of it. The window waits on the
+            # CLOSED event, not a bare sleep — close() commits pending
+            # writes itself, and a flusher stuck in a long window
+            # outlives its store otherwise (a 300s test interval held
+            # the thread for 300s after close).
+            if self._closed.wait(self._interval):
+                return
             self.flush()
 
     def flush(self) -> None:

@@ -229,6 +229,37 @@ class SloTracker:
 tracker = SloTracker()
 
 
+def snapshot_state() -> dict:
+    """Plain-data snapshot of this module's process-global state: the
+    global tracker's burn-rate history plus the loop-lag sample/token
+    tables. With :func:`restore_state` this is the reset-capable API
+    tests use to guarantee one test's health recordings (a 5xx burst,
+    an installed lag sampler) never read as live signal in the next —
+    the structural fix for the order-dependent healthz flake."""
+    with tracker._lock:
+        samples = list(tracker._samples)
+    with _LAG_LOCK:
+        lag = dict(_LAST_LAG)
+        tokens = dict(_SAMPLER_TOKENS)
+    return {"tracker_samples": samples, "loop_lag": lag,
+            "sampler_components": tokens}
+
+
+def restore_state(snapshot: dict) -> None:
+    """Restore :func:`snapshot_state` state. Sampler tokens are
+    restored too: a sampler installed during the restored-over window
+    loses its token and retires itself at its next tick (the same
+    supersede mechanism a redeploy uses)."""
+    with tracker._lock:
+        tracker._samples.clear()
+        tracker._samples.extend(snapshot["tracker_samples"])
+    with _LAG_LOCK:
+        _LAST_LAG.clear()
+        _LAST_LAG.update(snapshot["loop_lag"])
+        _SAMPLER_TOKENS.clear()
+        _SAMPLER_TOKENS.update(snapshot["sampler_components"])
+
+
 # -- scrape-time collection --------------------------------------------------
 
 

@@ -182,6 +182,20 @@ class _Actor:
             if not already_dead:
                 for _ in (self._threads or [None]):
                     self.mailbox.put(None)
+        # Abrupt-stop hook, OUTSIDE mb_lock (it may take the instance's
+        # own locks): an instance that spawned background threads or
+        # parked waiters has no other way to learn it was killed — a
+        # real process death would reap them, but this runtime's actors
+        # are threads, so an un-hooked kill leaks every one of them
+        # (the leak sanitizer caught the serve controller's reconciler
+        # and long-poll waiters surviving crash-simulation kills).
+        if not already_dead:
+            hook = getattr(self.instance, "_on_actor_stop", None)
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass
         return drained
 
 
@@ -206,6 +220,12 @@ class LocalBackend:
         self._exec_q: "queue.Queue" = queue.Queue()
         self._exec_idle = 0
         self._exec_lock = threading.Lock()
+        # Every executor thread ever spawned (pruned of dead ones at
+        # spawn): shutdown() wakes each blocked get() with a None
+        # sentinel — without it an idle executor sits out its full 10s
+        # poll after shutdown, which the leak sanitizer rightly calls a
+        # leaked thread.
+        self._exec_threads: list[threading.Thread] = []
         self._actors: dict[ActorID, _Actor] = {}
         self._cancelled: set[bytes] = set()
         self._lock = threading.Lock()
@@ -456,9 +476,12 @@ class LocalBackend:
             with self._exec_lock:
                 self._exec_q.put((spec, pool, request))  # raylint: disable=R2 -- _exec_q is unbounded, so put() cannot block; enqueue + idle-count bookkeeping must be one atomic step or _exec_loop's retire check double-counts idle threads
                 if self._exec_idle == 0:
-                    threading.Thread(target=self._exec_loop,
-                                     name="task-exec", daemon=True
-                                     ).start()
+                    t = threading.Thread(target=self._exec_loop,
+                                         name="task-exec", daemon=True)
+                    self._exec_threads = [
+                        th for th in self._exec_threads if th.is_alive()]
+                    self._exec_threads.append(t)
+                    t.start()
                 else:
                     self._exec_idle -= 1
 
@@ -474,6 +497,8 @@ class LocalBackend:
                         self._exec_idle -= 1  # surplus: retire
                         return
                 continue
+            if item is None:
+                return  # shutdown sentinel: retire immediately
             self._execute_normal_task(*item)
             with self._exec_lock:
                 self._exec_idle += 1
@@ -821,4 +846,24 @@ class LocalBackend:
             self._memory_monitor.stop()
         if self._worker_pool is not None:
             self._worker_pool.shutdown()
+        # Wake every executor blocked in its 10s mailbox poll with a
+        # sentinel, then join what can be joined (bounded; never joins
+        # the calling thread — shutdown can arrive from a task). A
+        # daemon thread would die with the process anyway, but a
+        # LONG-LIVED process (a test suite, a driver serving many jobs)
+        # must get its threads back at shutdown, not at exit — the leak
+        # sanitizer enforces exactly this.
+        with self._exec_lock:
+            exec_threads = [t for t in self._exec_threads
+                            if t.is_alive()]
+            for _ in exec_threads:
+                self._exec_q.put(None)  # raylint: disable=R2 -- _exec_q is unbounded so put() cannot block; the sentinel count must match the thread census taken under this same hold
         self._dispatcher.join(timeout=1.0)
+        me = threading.current_thread()
+        joinable = exec_threads + [
+            t for actor in list(self._actors.values())
+            for t in actor._threads]
+        deadline = _monotonic() + 2.0  # shared budget, not per-thread
+        for t in joinable:
+            if t is not me:
+                t.join(timeout=max(0.0, deadline - _monotonic()))

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ray_tpu._private import perf_stats as _perf_stats
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
 
@@ -95,6 +96,7 @@ class MemoryStore:
     def put(self, object_id: ObjectID, value: Any,
             error: Optional[BaseException] = None,
             job_id: str = "") -> None:
+        sanitize_hooks.sched_point("store.put")
         manager = self.spill_manager
         with self._lock:
             entry = self._entry(object_id)
@@ -219,6 +221,7 @@ class MemoryStore:
         subscriber, so a wait over N resolved refs costs one lock
         acquisition, not N callback registrations.
         """
+        sanitize_hooks.sched_point("store.wait")
         target = min(num_returns, len(object_ids))
         group: Optional[_WaitGroup] = None
         entries = self._entries

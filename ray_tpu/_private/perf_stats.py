@@ -157,3 +157,47 @@ def reset() -> None:
                 stat.sum = 0.0
             else:
                 stat.value = 0
+
+
+def snapshot_records(name: str) -> dict:
+    """Plain-data snapshot of every stat registered under ``name``:
+    ``{tags_tuple: (counts_tuple, total, sum)}`` for dists,
+    ``{tags_tuple: value}`` for counters. With :func:`restore_records`
+    this is the reset-capable API around process-global records that
+    tests (the ambient sanitizer, the conftest baseline fixture) use to
+    guarantee one test's recordings never leak into the next."""
+    out: dict = {}
+    with _registry_lock:
+        for (n, tags), stat in _stats.items():
+            if n != name:
+                continue
+            if isinstance(stat, Dist):
+                out[tags] = (tuple(stat.counts), stat.total, stat.sum)
+            else:
+                out[tags] = stat.value
+    return out
+
+
+def restore_records(name: str, snapshot: dict) -> None:
+    """Restore ``name``'s stats to a :func:`snapshot_records` snapshot
+    IN PLACE (same aliasing constraint as :func:`reset`). Tagged series
+    created since the snapshot are zeroed — they cannot be deleted
+    without orphaning live references, and zero is what the snapshot
+    implies for them."""
+    with _registry_lock:
+        for (n, tags), stat in _stats.items():
+            if n != name:
+                continue
+            saved = snapshot.get(tags)
+            if isinstance(stat, Dist):
+                if saved is None:
+                    stat.counts = [0] * (len(stat.bounds) + 1)
+                    stat.total = 0
+                    stat.sum = 0.0
+                else:
+                    counts, total, total_sum = saved
+                    stat.counts = list(counts)
+                    stat.total = total
+                    stat.sum = total_sum
+            else:
+                stat.value = 0 if saved is None else saved

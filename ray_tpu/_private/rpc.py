@@ -22,7 +22,7 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from ray_tpu._private import wire
+from ray_tpu._private import sanitize_hooks, wire
 
 # Cap on the server-side TLS handshake so one stalled/half-open peer can
 # only pin its own connection thread, never the accept loop.
@@ -521,6 +521,7 @@ class CoalescingBatcher:
         self._thread.start()
 
     def add(self, item: Any) -> None:
+        sanitize_hooks.sched_point("rpc.batcher.add")
         with self._cond:
             if self._closed:
                 raise ConnectionError("batcher closed")
@@ -554,6 +555,10 @@ class CoalescingBatcher:
                     self._first_enq = now
                 self._in_flight += 1
                 self._cond.notify_all()
+            # Deterministic-schedule seam: the drained-but-unsent window
+            # (items are out of the queue, the frame not yet on the
+            # wire) is the batcher's racy boundary.
+            sanitize_hooks.sched_point("rpc.batcher.flush")
             try:
                 self._send_frame(batch)
             except BaseException as e:  # noqa: BLE001 — surfaced per batch
@@ -655,6 +660,7 @@ class PipelinedClient:
         with a failure or the connection dies with this request
         un-acked. Raises only on immediate transport failure — the
         caller treats that like any node-unreachable send."""
+        sanitize_hooks.sched_point("rpc.pipeline.send")
         with self._send_lock:
             if self._closed.is_set():
                 raise ConnectionError("pipelined client closed")
@@ -683,7 +689,16 @@ class PipelinedClient:
             return rid
 
     def _drain(self, sock: socket.socket) -> None:
-        while not self._closed.is_set():
+        while True:
+            # Loop-edge yield point BEFORE the closed check: the edge
+            # is exactly where the historical close-before-flush bug
+            # raced (a close() setting _closed between a processed
+            # reply and this re-check swept about-to-be-acked requests
+            # into the orphan path) — the schedule harness parks the
+            # reader here to replay that window deterministically.
+            sanitize_hooks.sched_point("rpc.pipeline.reader_edge")
+            if self._closed.is_set():
+                break
             try:
                 reply = recv_msg(sock)
             except (ConnectionError, OSError):
@@ -694,6 +709,7 @@ class PipelinedClient:
                 seq, (rid, tag) = self._pending.popitem(last=False)
                 self._acked = seq
                 self._drained.notify_all()
+            sanitize_hooks.sched_point("rpc.pipeline.reply_handled")
             if isinstance(reply, wire.Reply) and not reply.ok and \
                     self._on_error is not None:
                 try:
@@ -762,5 +778,10 @@ class PipelinedClient:
         if flush_timeout > 0:
             self.flush(flush_timeout)
         self._closed.set()
+        # Schedule seam AFTER the closed flag: the race-replay fixture
+        # scripts this against the reader's loop edge to prove the
+        # flush-before-closed ordering holds (reverting it swept
+        # about-to-be-acked requests into the orphan path).
+        sanitize_hooks.sched_point("rpc.pipeline.closed_set")
         with self._send_lock:
             self._teardown()

@@ -41,6 +41,13 @@ def register_plugin(plugin: RuntimeEnvPlugin):
     _PLUGINS[plugin.name] = plugin
 
 
+def unregister_plugin(name: str) -> None:
+    """Remove a plugin (raylint R7: the registry needs a bounded
+    lifetime — tests register throwaway plugins and must be able to
+    take them back out)."""
+    _PLUGINS.pop(name, None)
+
+
 class _EnvVarsPlugin(RuntimeEnvPlugin):
     name = "env_vars"
 

@@ -30,6 +30,22 @@ def register_stats_provider(name: str, provider: Callable,
     _EXT_PROVIDERS[name] = (provider, series)
 
 
+def unregister_stats_provider(name: str) -> None:
+    """Remove a library stats provider (a redeployed/stopped library
+    must be able to retire its scrape hook; raylint R7)."""
+    _EXT_PROVIDERS.pop(name, None)
+
+
+def reset_interned() -> None:
+    """Drop the interned gauge handles and fresh-snapshot tag memory
+    (raylint R7's reset-capable API for this module's registries).
+    Safe at any time: ``_gauge`` re-interns on the next scrape and the
+    underlying ``util.metrics`` registry keys by name, so re-created
+    handles alias the same exported series."""
+    _gauges.clear()
+    _prev_tags.clear()
+
+
 def _collect_ext_providers() -> None:
     for provider, series in list(_EXT_PROVIDERS.values()):
         try:

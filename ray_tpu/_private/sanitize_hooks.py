@@ -1,0 +1,67 @@
+"""Sanitizer/scheduler instrumentation seams.
+
+The runtime sanitizers (``tools/raysan``) and the deterministic
+interleaving harness (``tools.raysan.sched``) need hooks *inside* the
+concurrency-critical paths — the router's reserved→in-flight handoff,
+the coalescing batcher's drain, the pipelined client's reader loop —
+but ``ray_tpu`` must not import ``tools`` (the dependency points the
+other way: tooling observes the runtime). This module is the seam:
+near-zero-cost no-ops by default, installed into by raysan when a
+sanitizer or schedule is active.
+
+Cost when nothing is installed: one global load and a ``None`` check
+per site. The sites are control-plane boundaries (a dispatch, a frame
+flush, a teardown) — not per-object hot loops — so this stays far
+below measurement noise; the A/B observability bench budget covers it.
+
+Two hooks:
+
+- ``sched_point(name)``: a named yield point. A deterministic schedule
+  (``tools.raysan.sched.Schedule``) installs a callable that can park
+  the calling thread until the scripted/seeded interleaving lets it
+  cross. Points are crossed on every call in instrumented builds, so
+  names must be stable identifiers (``"router.handoff"``, not
+  per-request strings).
+- ``ambient_set(kind, value)``: observation tap fired by the
+  thread-local ambient setters in ``task_spec`` so the ambient
+  sanitizer can see per-thread residue it cannot otherwise reach
+  (C ``_thread._local`` storage is invisible from other threads).
+  The calling thread's ident is derived here and handed to the
+  installed observer as ``(kind, ident, value)``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+_sched_point: Optional[Callable[[str], None]] = None
+_ambient_set: Optional[Callable[[str, int, object], None]] = None
+
+
+def sched_point(name: str) -> None:
+    """Cross the named yield point (no-op unless a schedule is
+    installed; see module docstring for cost)."""
+    hook = _sched_point
+    if hook is not None:
+        hook(name)
+
+
+def install_sched_point(fn: Optional[Callable[[str], None]]) -> None:
+    global _sched_point
+    _sched_point = fn
+
+
+def ambient_set(kind: str, value: object) -> None:
+    """Report an ambient thread-local write to the installed observer
+    (called by ``task_spec.set_ambient_*`` with the NEW value)."""
+    hook = _ambient_set
+    if hook is not None:
+        import threading
+
+        hook(kind, threading.get_ident(), value)
+
+
+def install_ambient_observer(
+        fn: Optional[Callable[[str, int, object], None]]) -> None:
+    global _ambient_set
+    _ambient_set = fn

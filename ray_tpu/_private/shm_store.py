@@ -308,6 +308,22 @@ class ShmObjectStore:
         if self._handle:
             self._lib.shm_store_close(self._handle)
             self._handle = None
+        # Drop this process's own mapping too: the mmap holds a dup'd
+        # fd on the segment, so an unlinked store otherwise pins its
+        # tmpfs pages via a "(deleted)" descriptor for the process
+        # lifetime. Best-effort — zero-copy readers still holding
+        # exported buffers keep the mapping valid (BufferError), which
+        # is exactly the no-segfault guarantee they rely on.
+        self.wait_prefault(timeout=5.0)
+        view, self._view = self._view, None
+        try:
+            if view is not None:
+                view.release()
+            if self._map is not None:
+                self._map.close()
+                self._map = None
+        except (BufferError, ValueError):
+            pass
 
     def destroy(self):
         self.close()

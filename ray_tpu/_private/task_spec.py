@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private.ids import ActorID, ObjectID, PlacementGroupID, TaskID
 
 
@@ -84,6 +85,7 @@ def set_ambient_trace_parent(tp: Optional[tuple]) -> Optional[tuple]:
     ambient trace parent; returns the previous value for restore."""
     prev = getattr(_AMBIENT_TRACE, "tp", None)
     _AMBIENT_TRACE.tp = tp
+    sanitize_hooks.ambient_set("trace_parent", tp)
     return prev
 
 
@@ -123,6 +125,7 @@ def set_ambient_job_id(job_id: Optional[str]) -> Optional[str]:
     restore."""
     prev = getattr(_AMBIENT_JOB, "job", None)
     _AMBIENT_JOB.job = job_id
+    sanitize_hooks.ambient_set("job_id", job_id)
     return prev
 
 

@@ -51,7 +51,7 @@ class Opaque:
 
 # -- message registry -------------------------------------------------------
 
-_REGISTRY: Dict[str, Tuple[type, int]] = {}
+_REGISTRY: Dict[str, Tuple[type, int]] = {}  # raylint: disable=R7 -- the wire message catalog is append-only BY CONTRACT: entries are versioned decode targets registered at import, removal would make in-flight frames of a still-spoken version undecodable; bounded by the set of @message classes in the codebase
 
 _SCALAR_CHECKS = {
     int: int, float: (int, float), str: str, bytes: bytes, bool: bool,
@@ -72,7 +72,7 @@ def message(name: str, version: int = 1):
     return wrap
 
 
-_FIELDS_CACHE: dict = {}
+_FIELDS_CACHE: dict = {}  # raylint: disable=R7 -- decode-plan memo keyed by registered message class: bounded by the catalog above and holds only derived (recomputable) data, so eviction could never reclaim anything the registry itself doesn't pin
 
 
 def _declared_fields(cls) -> dict:

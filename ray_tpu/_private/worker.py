@@ -190,6 +190,12 @@ class Worker:
         return self.memory_store.remove_local_ref(oid)
 
     def shutdown(self):
+        # Cluster-driver plumbing first (fetch dispatcher + release
+        # batcher, installed by ClusterDriverMixin): both block on
+        # their own wakeups and must be told the worker is going away.
+        stop_plumbing = getattr(self, "stop_cluster_plumbing", None)
+        if stop_plumbing is not None:
+            stop_plumbing()
         self.backend.shutdown()
         # Drain deferred durable writes before the process lets go of
         # the store (group-commit makes the window between accept and

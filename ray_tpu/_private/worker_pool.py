@@ -32,6 +32,7 @@ from typing import Any, List
 import cloudpickle
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import sanitize_hooks
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -203,6 +204,7 @@ class WorkerPool:
         one-shot fresh interpreter (never pooled — pristine process
         globals are the whole point). ``meta`` (the TaskSpec) feeds the
         worker-killing policy."""
+        sanitize_hooks.sched_point("workerpool.run")
         worker = WorkerProcess(spawn=True) if spawn else self._checkout()
         with self._lock:
             self.active[worker.pid] = (worker, meta, time.time())

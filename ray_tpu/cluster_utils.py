@@ -1710,6 +1710,12 @@ class ClusterDriverMixin:
         worker.cluster_head = head
         original_get = worker.get_objects
         original_wait = worker.wait
+        # Both driver-plumbing threads (fetch dispatcher + release
+        # batcher) stop through this event at worker shutdown: daemon
+        # threads die with the PROCESS, but a long-lived process
+        # (test suite, multi-job driver) reconnects and must get its
+        # threads back — the leak sanitizer enforces it.
+        plumbing_stop = threading.Event()
 
         # ONE event-driven fetch dispatcher instead of a polling thread
         # per awaited ref (reference: pull_manager.h:52 — a single pull
@@ -1798,7 +1804,7 @@ class ClusterDriverMixin:
             # pending ref at high frequency burns the very core the
             # executors need.
             sweep_at = 0.0
-            while True:
+            while not plumbing_stop.is_set():
                 with cond:
                     cond.wait(timeout=0.05)
                     batch = list(hot)
@@ -1843,8 +1849,10 @@ class ClusterDriverMixin:
                 # release) across the next wait.
                 entry = batch = items = done_keys = None
 
-        threading.Thread(target=dispatcher, daemon=True,
-                         name="cluster-fetch-dispatcher").start()
+        dispatcher_thread = threading.Thread(
+            target=dispatcher, daemon=True,
+            name="cluster-fetch-dispatcher")
+        dispatcher_thread.start()
 
         def get_objects(refs, timeout=None):
             for ref in refs:
@@ -1889,8 +1897,11 @@ class ClusterDriverMixin:
         def release_loop():
             from ray_tpu._private.ids import ObjectID as _OID
 
-            while True:
-                batch = [release_q.get()]
+            while not plumbing_stop.is_set():
+                first = release_q.get()
+                if first is None:
+                    return  # shutdown sentinel
+                batch = [first]
                 time.sleep(0.05)
                 while True:
                     try:
@@ -1902,7 +1913,8 @@ class ClusterDriverMixin:
                 # hook's synchronous unrelease covers the post-apply
                 # window; this covers the pre-apply one).
                 batch = [ob for ob in batch
-                         if worker.memory_store.local_ref_count(
+                         if ob is not None
+                         and worker.memory_store.local_ref_count(
                              _OID(ob)) == 0]
                 try:
                     if batch:
@@ -1915,6 +1927,16 @@ class ClusterDriverMixin:
         t = threading.Thread(target=release_loop, daemon=True,
                              name="ray_tpu-release")
         t.start()
+
+        def stop_cluster_plumbing():
+            plumbing_stop.set()
+            release_q.put(None)  # wake the blocking get
+            with cond:
+                cond.notify_all()
+            dispatcher_thread.join(timeout=1.0)
+            t.join(timeout=1.0)
+
+        worker.stop_cluster_plumbing = stop_cluster_plumbing
 
 
 class Cluster:

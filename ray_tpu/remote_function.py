@@ -32,7 +32,7 @@ _TASK_OPTIONS = {
 
 # fid -> the exact cloudpickle whose sha1 is the fid (the function-
 # distribution cache's export source; one entry per unique definition).
-_EXPORT_BLOBS: dict = {}
+_EXPORT_BLOBS: dict = {}  # raylint: disable=R7 -- the function-cache export source: one entry per unique function DEFINITION (sha1-keyed), and a late-joining node may fetch any still-referenced fid at any time, so eviction here would break cluster-wide function resolution; bounded by the program's distinct remote definitions
 
 
 def get_export_blob(fid: bytes):

@@ -308,6 +308,12 @@ def register_env(name: str, creator: Callable[..., Env]):
     _ENV_REGISTRY[name] = creator
 
 
+def unregister_env(name: str) -> None:
+    """Remove a registered env creator (tests registering throwaway
+    envs must be able to take them back out; raylint R7)."""
+    _ENV_REGISTRY.pop(name, None)
+
+
 def make_env(spec, env_config: Optional[dict] = None) -> Env:
     if isinstance(spec, Env):
         return spec

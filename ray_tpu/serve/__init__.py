@@ -257,6 +257,14 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
 
 def shutdown():
     global _proxy
+    from ray_tpu.serve._private.router import shutdown_all_routers
+    from ray_tpu.serve.batching import retire_all_batchers
+
+    # Routers first: their stop flags must be set before the
+    # controller dies so the long-poll threads exit on the resulting
+    # error instead of re-resolving a replacement controller.
+    shutdown_all_routers()
+    retire_all_batchers()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote())

@@ -260,6 +260,10 @@ class ServeController:
 
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
+        # Release long-poll waiters FIRST: an in-flight listen would
+        # otherwise hold an executor thread (and its client's get) in a
+        # 30s condvar wait long after this actor is gone.
+        self._long_poll.shutdown()
         # Let the in-flight reconcile pass finish before tearing down:
         # it could otherwise start a replica after we've iterated
         # st.replicas (a detached-actor leak) or re-write the
@@ -278,6 +282,16 @@ class ServeController:
             except Exception:
                 pass
         return True
+
+    def _on_actor_stop(self):
+        """Runtime abrupt-stop hook (`_Actor.stop`): fires on ANY stop
+        — kill, crash-simulation, restart-in-place — where
+        graceful_shutdown never ran. Retires the reconciler thread and
+        releases parked long-poll listeners; without it a killed
+        controller leaks both (threads outlive their thread-simulated
+        'process')."""
+        self._shutdown.set()
+        self._long_poll.shutdown()
 
     # -- reconcile -------------------------------------------------------
 

@@ -19,6 +19,7 @@ class LongPollHost:
         self._cond = threading.Condition()
         self._snapshots: Dict[str, Any] = {}
         self._versions: Dict[str, int] = {}
+        self._poisoned = False
 
     def notify_changed(self, key: str, snapshot: Any) -> None:
         with self._cond:
@@ -29,13 +30,30 @@ class LongPollHost:
     def listen(self, key: str, known_version: int = -1,
                timeout: float = 30.0) -> Tuple[int, Any]:
         """Block until version(key) > known_version (or timeout); returns
-        (version, snapshot)."""
+        (version, snapshot). A poisoned host (see :meth:`shutdown`)
+        answers after a token delay instead of blocking."""
         with self._cond:
             self._cond.wait_for(
-                lambda: self._versions.get(key, 0) > known_version,
+                lambda: self._poisoned
+                or self._versions.get(key, 0) > known_version,
                 timeout=timeout)
+            if self._poisoned:
+                # Not 0: a client that missed its stop signal would
+                # otherwise hot-loop listen/return for the rest of the
+                # shutdown window.
+                self._cond.wait(0.05)
             return (self._versions.get(key, 0),
                     self._snapshots.get(key))
+
+    def shutdown(self) -> None:
+        """Poison the host: every parked listener wakes now and future
+        listens return immediately. Without this, a killed controller's
+        in-flight ``listen`` task pins its executor thread for the full
+        30s wait (and the client's ``get`` with it) — the exact leak
+        the sanitizer flagged on every serve test teardown."""
+        with self._cond:
+            self._poisoned = True
+            self._cond.notify_all()
 
 
 class LongPollClient:
@@ -84,13 +102,25 @@ class LongPollClient:
 
     def _loop(self):
         import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError, ActorError
+        from ray_tpu.exceptions import (ActorDiedError, ActorError,
+                                        GetTimeoutError)
 
         while not self._stopped.is_set():
             try:
-                version, snapshot = ray_tpu.get(
-                    self._controller.listen.remote(self._key, self._version),
-                    timeout=60)
+                ref = self._controller.listen.remote(
+                    self._key, self._version)
+                # Bounded get so stop() takes effect within one slice
+                # even while the server holds the poll open — an
+                # un-interruptible 60s get kept this thread alive long
+                # past every teardown.
+                while True:
+                    if self._stopped.is_set():
+                        return
+                    try:
+                        version, snapshot = ray_tpu.get(ref, timeout=0.5)
+                        break
+                    except GetTimeoutError:
+                        continue
             except (ActorDiedError, ActorError):
                 # Controller is gone. With a reresolver, wait for its
                 # replacement (serve keeps answering from the last

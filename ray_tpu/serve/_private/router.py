@@ -11,9 +11,32 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from typing import Any, Dict, List
 
+# Every live Router (weakly held: handles are GC'd freely). Routers own
+# two daemon threads each (metrics reporter + long-poll listener), and
+# ServeHandles are minted ad hoc — by drivers, replicas, deployment
+# graphs — with nothing above them tracking lifetime, so
+# ``serve.shutdown()`` sweeps this registry to take the threads back
+# down (the leak sanitizer caught them outliving every serve test).
+_ROUTERS: "weakref.WeakSet[Router]" = weakref.WeakSet()
+
+
+def shutdown_all_routers() -> None:
+    """Stop every live router's reporter/long-poll threads. Called by
+    ``serve.shutdown()`` BEFORE the controller is killed: stop flags
+    are set here, then the controller's death errors any in-flight
+    long-poll listen, so both threads exit promptly instead of timing
+    out a 30s poll."""
+    for router in list(_ROUTERS):
+        try:
+            router.shutdown()
+        except Exception:
+            pass
+
 import ray_tpu
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private.task_spec import (set_ambient_job_id,
                                         set_ambient_trace_parent)
 from ray_tpu.serve._private.long_poll import LongPollClient
@@ -56,6 +79,7 @@ class Router:
             target=self._report_loop, daemon=True,
             name=f"router-metrics-{deployment_name}")
         self._reporter.start()
+        _ROUTERS.add(self)
 
     def _reresolve_controller(self):
         """Find a live (replacement or restarted) controller after a
@@ -140,7 +164,12 @@ class Router:
                 # Reserved→in-flight handoff under ONE hold: a gap
                 # between the decrement and the append would leave the
                 # dispatched request counted by neither, letting a
-                # concurrent dispatcher oversubscribe the cap.
+                # concurrent dispatcher oversubscribe the cap. The
+                # yield point marks the handoff boundary for the
+                # deterministic-schedule harness: raysan's regression
+                # fixture parks a dispatcher here and proves a
+                # concurrent one still sees the reserved slot.
+                sanitize_hooks.sched_point("router.handoff")
                 with self._lock:
                     self._reserved[replica] -= 1
                     if dispatched:
@@ -264,6 +293,7 @@ class Router:
     def shutdown(self):
         self._reporter_stop.set()
         self._client.stop()
+        _ROUTERS.discard(self)
 
 
 class ServeHandle:

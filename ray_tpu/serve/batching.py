@@ -12,8 +12,25 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+import weakref
 from concurrent.futures import Future
 from typing import Callable, List, Optional
+
+
+_ALL_BATCHERS: "weakref.WeakSet[_Batcher]" = weakref.WeakSet()
+
+
+def retire_all_batchers() -> None:
+    """Ask every live batcher's drain thread to retire (queued work
+    still runs first; the batcher itself stays usable — a later submit
+    just respawns its thread). ``serve.shutdown()`` calls this so
+    driver-side ``@serve.batch`` handlers that nobody explicitly shut
+    down don't keep their 5s-idle threads past teardown."""
+    for b in list(_ALL_BATCHERS):
+        try:
+            b.retire()
+        except Exception:
+            pass
 
 
 class _Batcher:
@@ -28,6 +45,7 @@ class _Batcher:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._closed = False
+        _ALL_BATCHERS.add(self)
 
     def _ensure_thread(self):
         with self._lock:
@@ -46,6 +64,7 @@ class _Batcher:
             except queue.Empty:
                 return  # idle thread exits; recreated on demand
             if first is self._STOP:
+                self._handoff_if_stale_stop()
                 return
             batch = [first]
             deadline = self.timeout
@@ -61,6 +80,34 @@ class _Batcher:
                     break
                 batch.append(item)
             self._run(batch)
+
+    def _handoff_if_stale_stop(self) -> None:
+        """Called on consuming a STOP sentinel. retire() checks
+        ``is_alive`` without holding the thread's idle-exit race, so a
+        sentinel can land in an EMPTY queue after the thread already
+        retired — and the next submit's respawned thread would then eat
+        the stale sentinel and exit with that submit's item queued
+        behind it, stranding the caller's future. submit() enqueues
+        BEFORE _ensure_thread, so real work behind a stale sentinel is
+        always visible here: spawn a successor for it."""
+        with self._lock:
+            if not self._closed and not self.queue.empty() \
+                    and self._thread is threading.current_thread():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="serve-batcher")
+                self._thread.start()
+
+    def retire(self, timeout: float = 5.0) -> None:
+        """Stop the drain thread WITHOUT closing the batcher: queued
+        work still runs (the sentinel lands behind it), and a later
+        submit simply respawns the thread. The teardown-sweep form —
+        ``shutdown`` is the permanent one."""
+        with self._lock:
+            t = self._thread
+        if t is not None and t.is_alive():
+            self.queue.put(self._STOP)
+            t.join(timeout)
 
     def shutdown(self, timeout: float = 5.0) -> None:
         """Stop the drain thread. Work queued before the call still

@@ -102,6 +102,23 @@ class Histogram(Metric):
                     k, [0] * (len(self.boundaries) + 1)))}
 
 
+def reset_values() -> None:
+    """Zero every registered metric's recorded values IN PLACE,
+    keeping registrations (metrics are interned by name — dropping
+    registry entries would orphan the instances call sites hold, so
+    recordings would keep landing in objects the exposition no longer
+    sees). The reset-capable API raylint R7 requires of process-global
+    registries; tests use it to start from a clean exposition."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        with m._lock:
+            for attr in ("_values", "_counts", "_sums", "_totals"):
+                d = getattr(m, attr, None)
+                if d is not None:
+                    d.clear()
+
+
 # Prometheus text exposition format 0.0.4 — scrape endpoints return
 # this Content-Type per the exposition spec.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"

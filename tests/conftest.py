@@ -14,11 +14,45 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # `tools` (raylint/raysan) resolves from root
+    sys.path.insert(0, REPO_ROOT)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+# Runtime sanitizers (opt-in: `pytest --sanitize=leaks,ambient ...`);
+# registering unconditionally just adds the CLI options.
+pytest_plugins = ("tools.raysan.pytest_plugin",)
+
+
+@pytest.fixture(autouse=True)
+def _global_state_baseline():
+    """Snapshot/restore the process-global serve+health records around
+    EVERY test.
+
+    ``serve_request_seconds`` (fast-path dists) and ``health.tracker``
+    (burn-rate history) are process-global by design; a test that
+    records into them — a 5xx burst, an SLO fixture — used to poison
+    every later healthz assertion unless it remembered the manual
+    reset convention (the order-dependent flake documented in
+    CHANGES.md PR 6). This fixture replaces that convention
+    structurally: whatever a test records is rolled back at teardown
+    via the runtime's own reset hooks, and the ambient sanitizer
+    (``--sanitize=ambient``) independently verifies nothing escapes.
+    Cost is two small dict snapshots per test."""
+    from ray_tpu._private import health, perf_stats
+
+    serve_snap = perf_stats.snapshot_records("serve_request_seconds")
+    health_snap = health.snapshot_state()
+    yield
+    perf_stats.restore_records("serve_request_seconds", serve_snap)
+    health.restore_state(health_snap)
 
 
 @pytest.fixture

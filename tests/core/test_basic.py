@@ -97,7 +97,10 @@ def test_error_propagates_through_dependency(ray_start_regular):
 def test_get_timeout(ray_start_regular):
     @ray_tpu.remote
     def slow():
-        time.sleep(5)
+        # Long enough to outlive the 0.1s get-timeout by orders of
+        # magnitude, short enough that shutdown's bounded thread join
+        # reclaims the executor (threads can't preempt a sleep).
+        time.sleep(1.5)
 
     with pytest.raises(GetTimeoutError):
         ray_tpu.get(slow.remote(), timeout=0.1)
@@ -109,7 +112,7 @@ def test_wait(ray_start_regular):
         time.sleep(t)
         return t
 
-    refs = [sleepy.remote(0.01), sleepy.remote(5)]
+    refs = [sleepy.remote(0.01), sleepy.remote(1.5)]
     ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=2)
     assert ready == [refs[0]] and not_ready == [refs[1]]
 
@@ -117,7 +120,7 @@ def test_wait(ray_start_regular):
 def test_wait_timeout(ray_start_regular):
     @ray_tpu.remote
     def sleepy():
-        time.sleep(5)
+        time.sleep(1.5)
 
     refs = [sleepy.remote()]
     ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=0.05)

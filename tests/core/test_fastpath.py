@@ -236,6 +236,7 @@ def test_batcher_coalesces_under_backpressure():
     assert sent == list(range(200))          # order preserved, no loss
     assert len(frames) <= 3                  # coalesced, not 200 frames
     assert len(frames[1]) >= 150             # the pile-up rode one frame
+    b.close()
 
 
 def test_batcher_error_isolated_to_frame():
@@ -256,6 +257,7 @@ def test_batcher_error_isolated_to_frame():
     assert b.flush(timeout=5)
     assert seen_errors and seen_errors[0][0] == ["bad"]
     assert ok_frames == [["good"]]           # flusher survived the error
+    b.close()
 
 
 def test_batcher_flush_empty_is_immediate():
@@ -263,6 +265,7 @@ def test_batcher_flush_empty_is_immediate():
     t0 = time.monotonic()
     assert b.flush(timeout=5)
     assert time.monotonic() - t0 < 1.0
+    b.close()
 
 
 # ---------------------------------------------------------------------------

@@ -24,10 +24,13 @@ _REPORT = None
 
 def _run_full():
     # One full analysis shared by every assertion in this module: the
-    # 10-second budget below is per-run, not per-test.
+    # 10-second budget below is per-run, not per-test. tools/raysan is
+    # linted alongside the runtime — the sanitizer layer enforces
+    # concurrency invariants, so it holds itself to the same rules.
     global _REPORT
     if _REPORT is None:
-        _REPORT = analyze([os.path.join(REPO_ROOT, "ray_tpu")],
+        _REPORT = analyze([os.path.join(REPO_ROOT, "ray_tpu"),
+                           os.path.join(REPO_ROOT, "tools", "raysan")],
                           root=REPO_ROOT)
     return _REPORT
 
@@ -56,6 +59,19 @@ def test_every_suppression_carries_a_justification():
                                 f"{v.render()}"
     assert not [v for v in report.active if v.rule == "R0"], (
         "bare `# raylint: disable` without `-- <reason>` found")
+
+
+def test_no_stale_suppressions():
+    """Every disable comment still earns its keep: a suppression whose
+    line no longer triggers the named rule is dead weight that would
+    silently mask a NEW violation if the code regresses — the
+    `--show-suppressed` audit is enforced here so the set can only
+    shrink deliberately."""
+    report = _run_full()
+    assert not report.stale, (
+        "stale suppressions found (the named rule no longer fires on "
+        "that line — delete the disable comment):\n"
+        + "\n".join(f"{v.path}:{v.line}: {v.rule}" for v in report.stale))
 
 
 def test_full_run_stays_under_ten_seconds():

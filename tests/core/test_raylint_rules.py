@@ -546,3 +546,187 @@ def test_suppression_only_covers_named_rules():
     """, ["R1"])
     act = active(vs)
     assert [v.rule for v in act] == ["R1"]
+
+
+# ---------------------------------------------------------------------------
+# R7 ambient-state hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_r7_discarded_ambient_token_flagged():
+    vs = active(lint("""
+        from ray_tpu._private.task_spec import set_ambient_job_id
+
+
+        def tag(job):
+            set_ambient_job_id(job)
+    """, ["R7"]))
+    assert len(vs) == 1 and vs[0].rule == "R7"
+    assert "discards the restore token" in vs[0].message
+
+
+def test_r7_captured_token_without_finally_restore_flagged():
+    vs = active(lint("""
+        from ray_tpu._private.task_spec import set_ambient_job_id
+
+
+        def tag(job):
+            prev = set_ambient_job_id(job)
+            do_work()
+            set_ambient_job_id(prev)  # restore NOT in a finally
+    """, ["R7"]))
+    # The restore outside a finally is itself a discarded-token set,
+    # and the guarded set never restores on the exception path.
+    assert vs and all(v.rule == "R7" for v in vs)
+    msgs = "\n".join(v.message for v in vs)
+    assert "never restored" in msgs or "discards" in msgs
+
+
+def test_r7_token_try_finally_pattern_clean():
+    vs = active(lint("""
+        from ray_tpu._private.task_spec import (set_ambient_job_id,
+                                                set_ambient_trace_parent)
+
+
+        def tag(job, trace):
+            prev = set_ambient_job_id(job) if job is not None else None
+            tp = set_ambient_trace_parent(trace)
+            try:
+                return do_work()
+            finally:
+                set_ambient_job_id(prev)
+                if trace is not None:
+                    set_ambient_trace_parent(tp)
+    """, ["R7"]))
+    assert vs == []
+
+
+def test_r7_nested_try_finally_restore_is_seen():
+    """The restore may live in an inner try/finally — containment must
+    follow real finally scoping, not flat tree order."""
+    vs = active(lint("""
+        from ray_tpu._private.task_spec import set_ambient_job_id
+
+
+        def tag(job):
+            prev = set_ambient_job_id(job)
+            try:
+                before()
+                try:
+                    return do_work()
+                finally:
+                    set_ambient_job_id(prev)
+            finally:
+                after()
+    """, ["R7"]))
+    assert vs == []
+
+
+def test_r7_grow_only_registry_flagged_and_reset_api_clean():
+    grow_only = """
+        _REGISTRY = {}
+
+
+        def register(name, value):
+            _REGISTRY[name] = value
+    """
+    vs = active(lint(grow_only, ["R7"]))
+    assert len(vs) == 1 and "only ever grows" in vs[0].message
+
+    with_removal = grow_only + """
+
+        def unregister(name):
+            _REGISTRY.pop(name, None)
+    """
+    assert active(lint(with_removal, ["R7"])) == []
+
+    # A reset-NAMED function referencing the registry also counts,
+    # even when it mutates entries in place (the perf_stats.reset
+    # shape).
+    with_reset = grow_only + """
+
+        def reset():
+            for k in _REGISTRY:
+                _REGISTRY[k] = None
+    """
+    assert active(lint(with_reset, ["R7"])) == []
+
+
+def test_r7_import_time_memo_table_and_slot_box_clean():
+    vs = active(lint("""
+        _TABLE = []
+        for _i in range(256):
+            _TABLE.append(_i * 31)
+
+        _BOX = [None]
+
+
+        def set_box(v):
+            _BOX[0] = v
+
+
+        def lookup(i):
+            return _TABLE[i & 0xFF]
+    """, ["R7"]))
+    assert vs == []
+
+
+def test_r7_suppressed_with_justification():
+    vs = lint("""
+        _CATALOG = {}  # raylint: disable=R7 -- append-only by contract
+
+
+        def register(name, cls):
+            _CATALOG[name] = cls
+    """, ["R7"])
+    assert active(vs) == []
+    assert len([v for v in vs if v.suppressed]) == 1
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit
+# ---------------------------------------------------------------------------
+
+
+def test_stale_suppression_flagged_and_live_one_not():
+    import textwrap
+
+    from tools.raylint.core import (FileInfo, run_rules,
+                                    stale_suppressions)
+    from tools.raylint.rules import select_rules
+
+    src = textwrap.dedent("""
+        import time
+
+
+        async def live():
+            time.sleep(0.1)  # raylint: disable=R1 -- still fires here
+
+
+        def stale():
+            return 1  # raylint: disable=R1 -- nothing fires here
+    """)
+    fi = FileInfo(path="fixture.py", relpath="fixture.py",
+                  module="fixture", source=src)
+    violations = run_rules([fi], select_rules(["R1"]))
+    stale = stale_suppressions([fi], violations)
+    assert len(stale) == 1
+    assert stale[0].line == 10 and stale[0].rule == "R1"
+    assert "stale" in stale[0].message
+
+
+def test_analyze_reports_stale_only_for_rules_it_ran(tmp_path):
+    """A rule the analyzer did not run cannot prove its suppressions
+    stale — `--rule R6` must not call an R1 suppression dead."""
+    from tools.raylint.core import analyze
+    from tools.raylint.rules import select_rules
+
+    f = tmp_path / "mod.py"
+    f.write_text("def f():\n"
+                 "    return 1  # raylint: disable=R1 -- was blocking\n")
+    report = analyze([str(f)], rules=select_rules(["R6"]),
+                     root=str(tmp_path))
+    assert report.stale == []
+    report = analyze([str(f)], rules=select_rules(["R1"]),
+                     root=str(tmp_path))
+    assert [v.line for v in report.stale] == [2]

@@ -1,0 +1,379 @@
+"""raysan framework: findings, policy, sanitizer units, CLI contract.
+
+The per-sanitizer units drive snapshot→mutate→diff directly (no inner
+pytest), so they pin the detection semantics cheaply; the CLI test runs
+``python -m tools.raysan`` end-to-end on tiny out-of-tree fixtures to
+pin the exit-code/report contract the CI leg relies on.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.raysan.core import (  # noqa: E402
+    Allow,
+    Finding,
+    Session,
+    apply_policy,
+    make_sanitizers,
+)
+
+
+# -- core policy/report ------------------------------------------------------
+
+
+def test_apply_policy_suppression_requires_justification():
+    findings = [
+        Finding("leaks", "t::a", "thread leaked: 'x'"),
+        Finding("leaks", "t::b", "fd leaked: socket fd=3"),
+    ]
+    out = apply_policy(findings, [
+        Allow("leaks", r"thread leaked", reason="deliberate fixture"),
+        Allow("leaks", r"fd leaked"),  # no reason: must NOT suppress
+    ])
+    by_msg = {f.message: f for f in out if f.sanitizer == "leaks"}
+    assert by_msg["thread leaked: 'x'"].suppressed
+    assert by_msg["thread leaked: 'x'"].justification == \
+        "deliberate fixture"
+    assert not by_msg["fd leaked: socket fd=3"].suppressed
+    meta = [f for f in out if f.sanitizer == "policy"]
+    assert len(meta) == 1 and "no justification" in meta[0].message
+
+
+def test_allow_scoped_to_sanitizer():
+    f = Finding("ambient", "t", "thread leaked: 'x'")
+    assert not Allow("leaks", "thread leaked", reason="r").matches(f)
+    assert Allow("ambient", "thread leaked", reason="r").matches(f)
+
+
+def test_make_sanitizers_unknown_name():
+    try:
+        make_sanitizers(["leaks", "valgrind"])
+    except KeyError as e:
+        assert "valgrind" in e.args[0] and "leaks" in e.args[0]
+    else:
+        raise AssertionError("unknown sanitizer accepted")
+
+
+def test_session_report_json_contract():
+    import json
+
+    session = Session(make_sanitizers(["leaks"]))
+    session.before_test("t::one")
+    session.after_test("t::one")
+    report = session.report()
+    data = json.loads(report.to_json())
+    assert data["sanitizers"] == ["leaks"]
+    assert data["tests_checked"] == 1
+    assert data["findings"] == [] and data["suppressed"] == []
+
+
+# -- leak sanitizer ----------------------------------------------------------
+
+
+def test_leak_sanitizer_flags_thread_and_fd_and_clears():
+    import socket
+
+    san = make_sanitizers(["leaks"])[0]
+    san.grace_s = 0.2
+    san.before_test("t::leaky")
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, daemon=True,
+                         name="leak-fixture")
+    t.start()
+    sock = socket.socket()
+    try:
+        findings = san.after_test("t::leaky")
+        msgs = [f.message for f in findings]
+        assert any("thread leaked: 'leak-fixture'" in m for m in msgs)
+        assert any("fd leaked" in m and "socket" in m for m in msgs)
+    finally:
+        stop.set()
+        sock.close()
+        t.join(2.0)
+    # Same census with the resources released: clean.
+    san.before_test("t::clean")
+    assert san.after_test("t::clean") == []
+
+
+def test_leak_sanitizer_thread_grace_tolerates_retiring_threads():
+    """A thread observing its shutdown flag within the grace window is
+    NOT a leak — teardown latency must not read as a finding."""
+    san = make_sanitizers(["leaks"])[0]
+    san.grace_s = 1.0
+    san.before_test("t::grace")
+    t = threading.Thread(target=lambda: time.sleep(0.15), daemon=True)
+    t.start()
+    assert san.after_test("t::grace") == []
+
+
+def test_leak_sanitizer_memory_store_growth(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    san = make_sanitizers(["leaks"])[0]
+    san.grace_s = 0.2
+    san.before_test("t::store")
+    # Pin an entry so teardown GC cannot collect it (a module-global
+    # ref is exactly the leak shape this guards against).
+    leak_holder.append(ray_tpu.put(list(range(256))))
+    findings = san.after_test("t::store")
+    assert any("memory_store leaked" in f.message for f in findings), \
+        [f.message for f in findings]
+    leak_holder.clear()
+    assert global_worker() is not None
+
+
+leak_holder: list = []
+
+
+# -- ambient sanitizer -------------------------------------------------------
+
+
+def test_ambient_sanitizer_serve_records_self_heal():
+    from ray_tpu._private import perf_stats
+
+    san = make_sanitizers(["ambient"])[0]
+    san.start_session()
+    try:
+        san.before_test("t::records")
+        stat = perf_stats.dist(
+            "serve_request_seconds",
+            tags={"route": "/raysan-unit", "status": "503"},
+            bounds=perf_stats.SERVE_LATENCY_BOUNDS)
+        before_total = stat.total
+        stat.record(0.001)
+        findings = san.after_test("t::records")
+        assert any("serve_request_seconds records mutated" in f.message
+                   for f in findings)
+        # Self-heal: the records were rolled back, so the next test
+        # starts clean instead of cascading.
+        assert stat.total == before_total
+        san.before_test("t::after-heal")
+        assert san.after_test("t::after-heal") == []
+    finally:
+        san.stop_session()
+
+
+def test_ambient_sanitizer_tracker_and_lag_state():
+    from ray_tpu._private import health
+
+    san = make_sanitizers(["ambient"])[0]
+    san.start_session()
+    try:
+        san.before_test("t::tracker")
+        health.tracker.sample()
+        health.note_loop_lag("raysan-unit-component", 0.5)
+        findings = san.after_test("t::tracker")
+        assert any("health tracker/loop-lag state mutated" in f.message
+                   for f in findings)
+        assert "raysan-unit-component" not in health.recent_loop_lag()
+    finally:
+        san.stop_session()
+
+
+def test_ambient_sanitizer_thread_local_residue():
+    from ray_tpu._private.task_spec import set_ambient_job_id
+
+    san = make_sanitizers(["ambient"])[0]
+    san.start_session()
+    try:
+        san.before_test("t::tag")
+        prev = set_ambient_job_id("raysan-unit-tenant")
+        findings = san.after_test("t::tag")
+        set_ambient_job_id(prev)
+        assert any("ambient job_id 'raysan-unit-tenant'" in f.message
+                   for f in findings)
+        # A proper token-restore pattern is clean.
+        san.before_test("t::tag2")
+        tok = set_ambient_job_id("raysan-unit-tenant2")
+        set_ambient_job_id(tok)
+        assert san.after_test("t::tag2") == []
+    finally:
+        san.stop_session()
+
+
+# -- loop sanitizer ----------------------------------------------------------
+
+
+def test_loop_sanitizer_flags_blocking_callback_with_stack():
+    import asyncio
+
+    from tools.raysan.loop_blocking import LoopBlockingSanitizer
+
+    san = LoopBlockingSanitizer(threshold_ms=60.0)
+    san.start_session()
+    try:
+        san.before_test("t::loop")
+
+        def stall():
+            time.sleep(0.2)
+
+        async def main():
+            asyncio.get_event_loop().call_soon(stall)
+            await asyncio.sleep(0.35)
+
+        asyncio.run(main())
+        findings = san.after_test("t::loop")
+        assert len(findings) == 1
+        assert "event loop blocked" in findings[0].message
+        assert "stall" in findings[0].message
+        # The watchdog sampled the loop thread MID-stall: the offending
+        # synchronous frame is in the detail.
+        assert "time.sleep(0.2)" in findings[0].detail
+
+        # Clean async code: no findings.
+        san.before_test("t::loop2")
+        asyncio.run(asyncio.sleep(0.01))
+        assert san.after_test("t::loop2") == []
+    finally:
+        san.stop_session()
+
+
+# -- lock witness edge semantics --------------------------------------------
+
+
+def test_lock_witness_reports_cycle_once():
+    from tools.raysan.lock_witness import LockOrderSanitizer
+
+    src = ("import threading\n"
+           "la = threading.Lock()\n"
+           "lb = threading.Lock()\n"
+           "def ab():\n    with la:\n        with lb:\n            pass\n"
+           "def ba():\n    with lb:\n        with la:\n            pass\n")
+    san = LockOrderSanitizer()
+    san.start_session()
+    try:
+        san.before_test("t::first")
+        ns = {}
+        exec(compile(src, "/tmp/raysan_once_fixture.py", "exec"), ns)
+        ns["ab"]()
+        ns["ba"]()
+        first = san.after_test("t::first")
+        assert len(first) == 1 \
+            and "lock-order cycle" in first[0].message
+        # The cycle's edges were retired with the finding: later tests
+        # are not re-failed for the same inversion.
+        san.before_test("t::second")
+        assert san.after_test("t::second") == []
+    finally:
+        san.stop_session()
+
+
+def test_lock_witness_condition_aliases_to_its_lock():
+    """``threading.Condition(existing_lock)`` must share the lock's
+    identity (raylint R2's aliasing): waiting on your own condition
+    while holding only its lock is the normal protocol, not a cycle."""
+    from tools.raysan.lock_witness import (
+        LockOrderSanitizer,
+        witnessed_edges,
+    )
+
+    san = LockOrderSanitizer()
+    san.start_session()
+    try:
+        san.before_test("t::cond")
+        src = ("import threading\n"
+               "lk = threading.Lock()\n"
+               "cv = threading.Condition(lk)\n"
+               "def use():\n"
+               "    with cv:\n"
+               "        cv.notify_all()\n"
+               "    with lk:\n"
+               "        pass\n")
+        ns = {}
+        exec(compile(src, "/tmp/raysan_cond_fixture.py", "exec"), ns)
+        ns["use"]()
+        assert san.after_test("t::cond") == []
+        # No self-edges between the condition and its own lock.
+        assert all(a != b for a, b in witnessed_edges())
+    finally:
+        san.stop_session()
+
+
+# -- CLI contract ------------------------------------------------------------
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.raysan", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exit_codes_and_json_report(tmp_path):
+    import json
+
+    clean = tmp_path / "test_cli_clean.py"
+    clean.write_text("def test_ok():\n    assert True\n")
+    leaky = tmp_path / "test_cli_leaky.py"
+    leaky.write_text(
+        "import threading\n"
+        "def test_leak():\n"
+        "    e = threading.Event()\n"
+        "    t = threading.Thread(target=e.wait, daemon=True)\n"
+        "    t.start()\n"
+        "    globals()['_keep'] = (t, e)\n")
+
+    out = _run_cli([str(clean), "--sanitize", "leaks",
+                    "--report", "json"], cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout[out.stdout.index("{"):])
+    assert report["findings"] == [] and report["tests_checked"] == 1
+
+    report_file = tmp_path / "report.json"
+    out = _run_cli([str(leaky), "--sanitize", "leaks",
+                    "--report", "json",
+                    "--report-file", str(report_file)], cwd=REPO_ROOT)
+    assert out.returncode == 1, out.stdout + out.stderr
+    saved = json.loads(report_file.read_text())
+    assert any("thread leaked" in f["message"]
+               for f in saved["findings"])
+
+    out = _run_cli(["--sanitize", "tsan"], cwd=REPO_ROOT)
+    assert out.returncode == 2
+    out = _run_cli([str(tmp_path / "missing.py")], cwd=REPO_ROOT)
+    assert out.returncode == 2
+
+
+def test_ambient_sanitizer_flags_in_place_lag_value_mutation():
+    """Key-set comparison would miss an existing component's lag being
+    overwritten; the sanitizer must diff values, not just keys."""
+    from ray_tpu._private import health
+
+    health.note_loop_lag("raysan-mut-component", 0.001)
+    san = make_sanitizers(["ambient"])[0]
+    san.start_session()
+    try:
+        san.before_test("t::mutate")
+        health.note_loop_lag("raysan-mut-component", 5.0)
+        findings = san.after_test("t::mutate")
+        assert any("health tracker/loop-lag state mutated" in f.message
+                   for f in findings)
+        # Self-heal restored the original sample.
+        assert health.recent_loop_lag()["raysan-mut-component"] == 0.001
+    finally:
+        san.stop_session()
+        health.remove_loop_lag_component("raysan-mut-component")
+
+
+def test_session_reports_bad_allow_once_not_per_test():
+    """One reason-less session-level Allow is one authorship error:
+    it must fail once, not cascade a policy finding onto every test
+    in the run (the R0 analog reports a bare disable once)."""
+    session = Session(make_sanitizers(["leaks"]),
+                      extra_allows=[Allow("leaks", "whatever")])
+    session.before_test("t::one")
+    first = session.after_test("t::one")
+    assert [f.sanitizer for f in first] == ["policy"]
+    session.before_test("t::two")
+    assert session.after_test("t::two") == []

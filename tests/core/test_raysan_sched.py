@@ -1,0 +1,210 @@
+"""raysan.sched: the deterministic interleaving harness itself.
+
+These pin the schedule semantics the race-replay fixtures
+(``test_concurrency_races.py``) build on: scripted gate ordering,
+occurrence suffixes, free passage of unlisted points, the loud timeout
+instead of a hang, and seeded exploration recording a replayable trace.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import sanitize_hooks
+from tools.raysan.sched import Schedule, ScheduleTimeout, find_race
+
+
+def _spawn(*fns):
+    threads = [threading.Thread(target=fn, name=f"sched-t{i}")
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+    assert not any(t.is_alive() for t in threads)
+
+
+def test_scripted_order_is_enforced():
+    log = []
+    sched = Schedule(order=["b.step", "a.step"], timeout_s=3.0)
+
+    def a():
+        log.append("a-before")
+        sched.cross("a.step")
+        log.append("a-after")
+
+    def b():
+        time.sleep(0.05)  # wall-clock says a first; the script says b
+        log.append("b-before")
+        sched.cross("b.step")
+
+    with sched:
+        _spawn(a, b)
+    assert log == ["a-before", "b-before", "a-after"]
+    assert sched.completed
+    assert sched.trace_order() == ["b.step#1", "a.step#1"]
+
+
+def test_occurrence_suffix_gates_the_kth_crossing():
+    log = []
+    sched = Schedule(order=["other.go", "loop.edge#3"], timeout_s=3.0)
+
+    def looper():
+        for i in range(3):
+            sched.cross("loop.edge")  # #1 and #2 pass freely
+            log.append(i)
+
+    def other():
+        time.sleep(0.05)
+        log.append("other")
+        sched.cross("other.go")
+
+    with sched:
+        _spawn(looper, other)
+    assert log == [0, 1, "other", 2]
+
+
+def test_unlisted_points_pass_freely_and_are_traced():
+    sched = Schedule(order=[], timeout_s=1.0)
+    with sched:
+        sched.cross("free.one")
+        sched.cross("free.one")
+        sched.cross("free.two")
+    assert sched.trace_order() == ["free.one#1", "free.one#2",
+                                   "free.two#1"]
+
+
+def test_gate_timeout_raises_with_diagnostic():
+    sched = Schedule(order=["never.happens", "a.step"], timeout_s=0.3)
+    with sched:
+        with pytest.raises(ScheduleTimeout) as e:
+            sched.cross("a.step")
+    msg = str(e.value)
+    assert "never.happens" in msg and "a.step" in msg
+
+
+def test_parked_at_observes_gated_thread():
+    sched = Schedule(order=["release", "gate.point"], timeout_s=3.0)
+
+    def gated():
+        sched.cross("gate.point")
+
+    t = threading.Thread(target=gated)
+    with sched:
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not sched.parked_at("gate.point"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        sched.cross("release")
+        t.join(3.0)
+    assert not t.is_alive() and sched.completed
+
+
+def test_install_routes_product_yield_points():
+    """``sanitize_hooks.sched_point`` (the seam product code calls) is
+    a no-op without a schedule and gates under one; exiting restores
+    the previous hook."""
+    sanitize_hooks.sched_point("no.schedule")  # must not raise
+    sched = Schedule(order=[], timeout_s=1.0)
+    with sched:
+        sanitize_hooks.sched_point("seamed.point")
+    assert sched.trace_order() == ["seamed.point#1"]
+    assert sanitize_hooks._sched_point is None
+
+
+def test_exit_releases_parked_threads():
+    """Tearing the schedule down mid-park releases the thread instead
+    of stranding it behind a gate nobody will open."""
+    sched = Schedule(order=["never", "stuck.point"], timeout_s=30.0)
+
+    def stuck():
+        sched.cross("stuck.point")
+
+    t = threading.Thread(target=stuck)
+    with sched:
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not sched.parked_at("stuck.point"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+    t.join(2.0)
+    assert not t.is_alive()
+
+
+def test_seeded_schedule_records_replayable_trace():
+    """A seeded run records crossings; replaying the filtered trace as
+    a script reproduces the same crossing order deterministically."""
+    order_seen = []
+
+    def run(sched):
+        def a():
+            sched.cross("x.a")
+            order_seen.append("a")
+
+        def b():
+            sched.cross("x.b")
+            order_seen.append("b")
+
+        _spawn(a, b)
+        return False  # not hunting a race, just recording
+
+    sched = Schedule(seed=7, pause_max_s=0.05)
+    with sched:
+        run(sched)
+    trace = [k for k in sched.trace_order() if k.startswith("x.")]
+    assert sorted(trace) == ["x.a#1", "x.b#1"]
+
+    replayed = []
+    replay = Schedule(order=trace, timeout_s=3.0)
+
+    def ra():
+        replay.cross("x.a")
+        replayed.append("x.a#1")
+
+    def rb():
+        replay.cross("x.b")
+        replayed.append("x.b#1")
+
+    with replay:
+        _spawn(ra, rb)
+    assert replayed == trace
+    assert replay.completed
+
+
+def test_find_race_returns_none_when_no_race():
+    assert find_race(lambda sched: False, seeds=range(3)) is None
+
+
+def test_order_and_seed_are_mutually_exclusive():
+    with pytest.raises(ValueError):
+        Schedule(order=["a"], seed=1)
+    with pytest.raises(ValueError):
+        Schedule(order=["a", "a"])
+
+
+def test_completed_stays_false_when_gate_never_crossed():
+    """Tearing down a schedule must not forge completion: `completed`
+    is the acceptance signal the race fixtures assert on, so a script
+    that never played out has to read False after the with block."""
+    sched = Schedule(order=["never.crossed"], timeout_s=0.5)
+    with sched:
+        sched.cross("unrelated.point")
+    assert not sched.completed
+    # A released gate passes threads through but still doesn't count.
+    sched2 = Schedule(order=["other.first", "gate.point"], timeout_s=30.0)
+
+    def gated():
+        sched2.cross("gate.point")
+
+    t = threading.Thread(target=gated)
+    with sched2:
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not sched2.parked_at("gate.point"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+    t.join(2.0)
+    assert not t.is_alive()
+    assert not sched2.completed

@@ -289,3 +289,25 @@ def test_cluster_drain_channels_flush_closes_once():
         and not backend._leases
     backend.drain_channels(timeout=1.5)  # idempotent
     assert batcher.closed_with == [(1.5, None)]
+
+
+def test_serve_batch_stale_retire_sentinel_does_not_strand_work():
+    """retire() can race the drain thread's idle exit, leaving its
+    sentinel in an empty queue; the next submit's respawned thread
+    must hand off past the stale sentinel instead of eating it and
+    stranding the submitted item's future."""
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+    def handler(items):
+        return [x + 1 for x in items]
+
+    assert handler._submit((1,)).result(timeout=5.0) == 2
+    b = next(iter(handler._batchers.values()))
+    b._thread.join(6.0)  # let the drain thread idle out (5s poll)
+    assert not b._thread.is_alive()
+    b.queue.put(b._STOP)  # the lost-race retire sentinel
+    f = handler._submit((41,))
+    assert f.result(timeout=5.0) == 42, (
+        "stale retire sentinel stranded a submitted item")
+    handler.shutdown(timeout=5.0)

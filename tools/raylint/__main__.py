@@ -31,7 +31,9 @@ def main(argv=None) -> int:
         help="print the rule catalog and exit")
     parser.add_argument(
         "--show-suppressed", action="store_true",
-        help="also print suppressed violations (pretty mode)")
+        help="also print suppressed violations, flagging STALE "
+             "suppressions whose line no longer triggers the named "
+             "rule (pretty mode)")
     args = parser.parse_args(argv)
 
     from tools.raylint.core import analyze
@@ -66,6 +68,9 @@ def main(argv=None) -> int:
         if args.show_suppressed:
             for v in report.suppressed:
                 print(v.render())
+            for v in report.stale:
+                print(f"{v.path}:{v.line}: {v.rule} STALE suppression "
+                      f"(rule no longer fires here; drop it)")
         print(report.render_pretty())
     return 1 if report.active else 0
 

@@ -231,11 +231,40 @@ def run_rules(files: List[FileInfo], rules: List[Rule]) -> List[Violation]:
     return out
 
 
+def stale_suppressions(files: List[FileInfo],
+                       violations: List[Violation]) -> List[Violation]:
+    """Suppression comments whose line no longer triggers the named
+    rule — dead weight that silently re-opens the hole if the code
+    regresses later (the suppression would mask the NEW violation).
+    One entry per (suppression line, named rule) that matched nothing;
+    reported via ``--show-suppressed`` and gated stale-free by tier-1.
+
+    A rule the analyzer wasn't asked to run cannot prove its
+    suppressions stale, so callers running a rule subset must filter —
+    :func:`analyze` handles that."""
+    fired = {(v.path, v.line, v.rule) for v in violations}
+    out: List[Violation] = []
+    for fi in files:
+        for sup in fi.suppressions.values():
+            for rule_id in sup.rules:
+                if (fi.relpath, sup.line, rule_id) not in fired:
+                    out.append(Violation(
+                        rule=rule_id, name="stale-suppression",
+                        path=fi.relpath, line=sup.line,
+                        message=f"suppression for {rule_id} is stale: "
+                                f"the rule no longer fires on this "
+                                f"line — drop the disable comment",
+                        justification=sup.justification))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
 @dataclasses.dataclass
 class Report:
     violations: List[Violation]
     files_checked: int
     elapsed_s: float
+    stale: List[Violation] = dataclasses.field(default_factory=list)
 
     @property
     def active(self) -> List[Violation]:
@@ -251,6 +280,7 @@ class Report:
             "elapsed_s": round(self.elapsed_s, 3),
             "violations": [v.to_dict() for v in self.active],
             "suppressed": [v.to_dict() for v in self.suppressed],
+            "stale_suppressions": [v.to_dict() for v in self.stale],
         }, indent=2)
 
     def render_pretty(self) -> str:
@@ -269,10 +299,13 @@ def analyze(paths: List[str], rules: Optional[List[Rule]] = None,
 
     t0 = time.monotonic()
     files = collect_files(paths, root=root)
-    violations = run_rules(files, rules if rules is not None
-                           else all_rules())
+    active_rules = rules if rules is not None else all_rules()
+    violations = run_rules(files, active_rules)
+    ran = {r.id for r in active_rules}
+    stale = [v for v in stale_suppressions(files, violations)
+             if v.rule in ran]
     return Report(violations=violations, files_checked=len(files),
-                  elapsed_s=time.monotonic() - t0)
+                  elapsed_s=time.monotonic() - t0, stale=stale)
 
 
 def analyze_source(source: str, rules: List[Rule],

@@ -11,6 +11,7 @@ from tools.raylint.rules.r3_layering import LayeringRule
 from tools.raylint.rules.r4_lifecycle import ResourceLifecycleRule
 from tools.raylint.rules.r5_wire_hygiene import WireHygieneRule
 from tools.raylint.rules.r6_hygiene import HygieneRule
+from tools.raylint.rules.r7_ambient import AmbientStateRule
 
 _RULE_CLASSES = (
     AsyncBlockingRule,
@@ -19,6 +20,7 @@ _RULE_CLASSES = (
     ResourceLifecycleRule,
     WireHygieneRule,
     HygieneRule,
+    AmbientStateRule,
 )
 
 

@@ -1,0 +1,238 @@
+"""R7 ambient-state hygiene: thread-local tags and global registries.
+
+The order-dependent flakes PR 6 documented all reduced to two shapes
+of ambient state escaping its scope, so both are now machine-checked:
+
+- **Ambient setter without token/try-finally reset.** The thread-local
+  ambient setters (``set_ambient_job_id``, ``set_ambient_trace_parent``)
+  return the previous value precisely so callers can restore it; a
+  call that discards that token, or captures it but never restores in
+  a ``finally``, leaves the tag on the calling thread — and executor
+  threads are pooled, so the residue silently tags unrelated work.
+  The sanctioned shape is::
+
+      prev = set_ambient_job_id(job)
+      try:
+          ...
+      finally:
+          set_ambient_job_id(prev)
+
+- **Grow-only module-level mutable registry.** A module-level dict/
+  list/set that functions in the module only ever ADD to, with no
+  removal path and no reset-capable API (a ``reset``/``restore``/
+  ``clear``/``remove``-style function referencing it), is state no
+  test can isolate and no long-lived process can bound. Either give it
+  a reset/removal API (what ``perf_stats.reset`` and
+  ``health.remove_loop_lag_component`` do) or justify-suppress why
+  append-only is the contract (e.g. the wire message catalog).
+
+The runtime counterpart is raysan's ambient sanitizer
+(``tools/raysan/ambient.py``): R7 proves the reset path exists,
+the sanitizer proves it ran.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.astutil import dotted_name
+from tools.raylint.core import FileInfo, Rule
+
+_AMBIENT_SETTERS = ("set_ambient_job_id", "set_ambient_trace_parent")
+
+# Mutations that only ever ADD entries...
+_GROW_METHODS = {"append", "add", "update", "setdefault", "extend",
+                 "insert", "appendleft"}
+# ...vs. ones that remove/reset (their presence anywhere in the module
+# means a bounded-lifetime path exists).
+_SHRINK_METHODS = {"pop", "popitem", "clear", "remove", "discard",
+                   "popleft"}
+_RESET_FN_RE = re.compile(
+    r"reset|restore|clear|remove|retire|purge|evict|delete|uninstall"
+    r"|invalidate|close|shutdown|stop|teardown")
+_REGISTRY_FACTORIES = {"dict", "list", "set", "OrderedDict",
+                       "defaultdict", "deque", "WeakValueDictionary"}
+
+
+def _setter_name(call: ast.Call) -> Optional[str]:
+    dn = dotted_name(call.func)
+    if dn is None:
+        return None
+    last = dn.rsplit(".", 1)[-1]
+    return last if last in _AMBIENT_SETTERS else None
+
+
+class AmbientStateRule(Rule):
+    id = "R7"
+    name = "ambient-hygiene"
+    description = ("ambient thread-local setters without token/"
+                   "try-finally reset; grow-only module-level mutable "
+                   "registries without a reset-capable API")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        for fn in self._functions(fi):
+            out.extend(self._check_ambient_fn(fn))
+        out.extend(self._check_registries(fi))
+        return out
+
+    # -- ambient setters ---------------------------------------------------
+
+    def _functions(self, fi: FileInfo):
+        return [n for n in fi.nodes()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _check_ambient_fn(self, fn) -> List[Tuple[int, str]]:
+        sets: List[Tuple[ast.Call, str, bool]] = []  # (call, setter, captured)
+        restores: Set[str] = set()
+
+        def scan(node, in_finally: bool, captured: frozenset):
+            """Recursive descent over fn's own statements (nested defs
+            are their own functions) tracking finally containment —
+            ``ast.walk`` would flatten a nested try/finally's restore
+            calls into the surrounding context."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.NamedExpr)) \
+                    and node.value is not None:
+                captured = captured | {
+                    id(sub) for sub in ast.walk(node.value)
+                    if isinstance(sub, ast.Call)
+                    and _setter_name(sub) is not None}
+            if isinstance(node, ast.Call):
+                setter = _setter_name(node)
+                if setter is not None:
+                    if in_finally:
+                        restores.add(setter)
+                    else:
+                        sets.append((node, setter, id(node) in captured))
+            if isinstance(node, ast.Try):
+                for child in node.body + node.handlers + node.orelse:
+                    scan(child, in_finally, captured)
+                for child in node.finalbody:
+                    scan(child, True, captured)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_finally, captured)
+
+        for child in fn.body:
+            scan(child, False, frozenset())
+
+        out: List[Tuple[int, str]] = []
+        for call, setter, captured in sets:
+            if not captured:
+                out.append((
+                    call.lineno,
+                    f"`{setter}(...)` discards the restore token — "
+                    f"capture it and restore in a finally: "
+                    f"`prev = {setter}(x) ... finally: {setter}(prev)`"))
+            elif setter not in restores:
+                out.append((
+                    call.lineno,
+                    f"`{setter}(...)` token captured but never restored "
+                    f"in a `finally` in this function — the ambient tag "
+                    f"outlives its scope on a pooled thread"))
+        return out
+
+    # -- module-level registries -------------------------------------------
+
+    def _check_registries(self, fi: FileInfo) -> List[Tuple[int, str]]:
+        candidates = {}  # name -> (lineno, is_mapping)
+        for node in ast.iter_child_nodes(fi.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target, node.value
+            else:
+                continue
+            is_registry = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            is_mapping = isinstance(value, ast.Dict)
+            if isinstance(value, ast.Call):
+                dn = dotted_name(value.func)
+                last = dn.rsplit(".", 1)[-1] if dn else ""
+                if last in _REGISTRY_FACTORIES and not value.args \
+                        and not value.keywords:
+                    is_registry = True
+                    is_mapping = last in ("dict", "OrderedDict",
+                                          "defaultdict",
+                                          "WeakValueDictionary")
+            if is_registry:
+                candidates[target.id] = (node.lineno, is_mapping)
+        if not candidates:
+            return []
+
+        grows: Set[str] = set()
+        shrinks: Set[str] = set()
+
+        def ref_name(expr) -> Optional[str]:
+            return expr.id if isinstance(expr, ast.Name) else None
+
+        # Only RUNTIME mutations count — import-time construction of a
+        # memo table (e.g. a CRC table filled by a module-level loop)
+        # is a constant, not unbounded ambient state — so the scan
+        # covers function bodies only.
+        fn_nodes = [sub for fn in self._functions(fi)
+                    for sub in ast.walk(fn)]
+        for node in fn_nodes:
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        name = ref_name(t.value)
+                        # Subscript store only GROWS a mapping; on a
+                        # list/box it replaces an existing slot.
+                        if name in candidates and candidates[name][1]:
+                            grows.add(name)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        name = ref_name(t.value)
+                        if name in candidates:
+                            shrinks.add(name)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                name = ref_name(node.func.value)
+                if name in candidates:
+                    if node.func.attr in _GROW_METHODS:
+                        grows.add(name)
+                    elif node.func.attr in _SHRINK_METHODS:
+                        shrinks.add(name)
+
+        # A reset-named function that references the registry is a
+        # reset-capable API even when it mutates entries in place
+        # (perf_stats.reset zeroes stat objects without touching the
+        # dict). Function-level reassignment (`name = {}` under a
+        # `global` decl) counts the same way.
+        for fn in self._functions(fi):
+            body_names = {n.id for n in ast.walk(fn)
+                          if isinstance(n, ast.Name)}
+            if not body_names & set(candidates):
+                continue
+            if _RESET_FN_RE.search(fn.name):
+                shrinks.update(body_names & set(candidates))
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id in candidates:
+                            shrinks.add(t.id)
+
+        out = []
+        for name, (lineno, _) in sorted(candidates.items(),
+                                        key=lambda kv: kv[1][0]):
+            if name in grows and name not in shrinks:
+                out.append((
+                    lineno,
+                    f"module-level registry `{name}` only ever grows — "
+                    f"add a reset-capable API (reset/clear/removal "
+                    f"path) so tests can isolate it and long-lived "
+                    f"processes can bound it, or justify-suppress"))
+        return out

@@ -1,0 +1,37 @@
+"""raysan: runtime concurrency/leak sanitizers + deterministic-schedule
+race replay for the ray_tpu runtime.
+
+The dynamic half of the concurrency story (raylint, ``tools/raylint``,
+is the static half — same rule numbering, opposite phase):
+
+- ``locks``   — lock-order witness: runtime held-before graph with
+  cycle detection over wrapped ``threading`` locks (dynamic R2);
+- ``loop``    — event-loop blocking detector: times every asyncio
+  callback, samples the offending stack mid-stall (dynamic R1);
+- ``leaks``   — per-test accounting of threads, fds (sockets, sqlite),
+  actors, and ``memory_store`` entries with teardown diffing
+  (dynamic R4);
+- ``ambient`` — thread-local ambient tags and process-global
+  registries (``serve_request_seconds``, ``health.tracker``) mutated
+  by a test but not reset — the order-dependent-flake class
+  (dynamic R7).
+
+Run via pytest (``pytest --sanitize=leaks,ambient tests/core``) or the
+CLI (``python -m tools.raysan --report json``). ``raysan.sched``
+(:class:`Schedule`, :func:`find_race`) is the deterministic
+interleaving harness the race-replay regression fixtures use.
+"""
+
+from tools.raysan.core import (  # noqa: F401
+    Allow,
+    Finding,
+    Report,
+    Sanitizer,
+    Session,
+    make_sanitizers,
+)
+from tools.raysan.sched import (  # noqa: F401
+    Schedule,
+    ScheduleTimeout,
+    find_race,
+)

@@ -1,0 +1,117 @@
+"""CLI: ``python -m tools.raysan [paths] [--sanitize LIST] [--report json]``
+
+Wraps a pytest run with the raysan plugin enabled and emits the
+session's sanitizer report — the form CI archives as an artifact.
+
+Exit-code contract (raylint's, extended over test outcomes):
+  0  tests passed and no unsuppressed sanitizer findings
+  1  test failures and/or unsuppressed findings
+  2  usage error (unknown sanitizer, bad path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+DEFAULT_PATHS = ("tests/core/test_concurrency_races.py",
+                 "tests/serve/test_concurrency_fixes.py")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.raysan",
+        description="runtime concurrency/leak sanitizers for ray_tpu")
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help="test files/directories to run under the sanitizers "
+             f"(default: the concurrency regression suites "
+             f"{', '.join(DEFAULT_PATHS)})")
+    parser.add_argument(
+        "--sanitize", default="leaks,ambient", metavar="LIST",
+        help="sanitizers to enable (default: leaks,ambient — the "
+             "bounded CI leg; 'all' adds locks,loop)")
+    parser.add_argument(
+        "--report", choices=("json", "pretty"), default="pretty",
+        help="report format on stdout")
+    parser.add_argument(
+        "--report-file", default="", metavar="PATH",
+        help="also write the JSON report to PATH")
+    parser.add_argument(
+        "--loop-threshold-ms", type=float, default=100.0)
+    parser.add_argument(
+        "--pytest-args", default="-q", metavar="ARGS",
+        help="extra arguments handed to pytest (default: -q)")
+    args = parser.parse_args(argv)
+
+    from tools.raysan.core import SANITIZER_NAMES
+
+    for name in args.sanitize.split(","):
+        if name.strip() and name.strip() != "all" \
+                and name.strip() not in SANITIZER_NAMES:
+            print(f"raysan: unknown sanitizer {name.strip()!r}; known: "
+                  f"{', '.join(SANITIZER_NAMES)}", file=sys.stderr)
+            return 2
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"raysan: no such path: {path}", file=sys.stderr)
+            return 2
+
+    import pytest
+
+    fd, report_path = tempfile.mkstemp(prefix="raysan-", suffix=".json")
+    os.close(fd)
+    try:
+        rc = pytest.main(
+            args.paths + args.pytest_args.split() + [
+                "-p", "tools.raysan.pytest_plugin",
+                f"--sanitize={args.sanitize}",
+                f"--sanitize-report={report_path}",
+                "--sanitize-loop-threshold-ms",
+                str(args.loop_threshold_ms),
+            ])
+        try:
+            with open(report_path, "r", encoding="utf-8") as f:
+                report = json.load(f)
+        except (OSError, ValueError):
+            print("raysan: pytest run produced no report",
+                  file=sys.stderr)
+            return 2
+    finally:
+        if args.report_file:
+            try:
+                # shutil.move copies across filesystems (the tmp report
+                # honors TMPDIR/tmpfs; os.replace would EXDEV there and
+                # silently drop the CI artifact).
+                import shutil
+
+                shutil.move(report_path, args.report_file)
+            except OSError as e:
+                print(f"raysan: could not write report file "
+                      f"{args.report_file}: {e}", file=sys.stderr)
+        elif os.path.exists(report_path):
+            os.unlink(report_path)
+
+    if args.report == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for f in report["findings"]:
+            print(f"[{f['sanitizer']}] {f['test']}: {f['message']}")
+        print(f"raysan[{','.join(report['sanitizers'])}]: "
+              f"{report['tests_checked']} tests, "
+              f"{len(report['findings'])} finding(s), "
+              f"{len(report['suppressed'])} suppressed, "
+              f"{report['elapsed_s']:.2f}s")
+
+    if int(rc) == 4:  # pytest usage error
+        return 2
+    if report["findings"] or int(rc) != 0:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Ambient/global-state sanitizer: residue that outlives its test.
+
+Two recurring flake classes motivated this (CHANGES.md PR 6):
+
+1. **Thread-local ambient tags on pooled threads.** The ambient job id
+   and trace parent (``task_spec.set_ambient_job_id`` /
+   ``set_ambient_trace_parent``) ride ``threading.local`` — invisible
+   from any other thread, so a set without a try/finally restore on a
+   pooled executor thread silently tags every later task that thread
+   runs. The sanitizer taps the setters through
+   ``sanitize_hooks.install_ambient_observer`` (the only way to see
+   per-thread residue from the outside) and flags any *live* thread
+   whose tag is still set at teardown.
+
+2. **Process-global registries mutated without reset.** The
+   ``serve_request_seconds`` fast-path distributions, the global
+   ``health.tracker`` burn-rate history, and the loop-lag sample/token
+   tables are process-global by design; a test that records into them
+   and exits poisons every later test that assumes a clean baseline —
+   the order-dependent healthz flake, exactly. The sanitizer snapshots
+   them before each test (via the runtime's own reset hooks:
+   ``perf_stats.snapshot_records`` / ``health.snapshot_state``) and
+   flags any un-restored mutation.
+
+Findings **self-heal**: after flagging, the sanitizer restores the
+baseline (and adopts ambient residue into it), so one offending test
+produces one finding instead of cascading failures through the rest of
+the run. The autouse fixture in ``tests/conftest.py`` restores the
+same state unconditionally, which is why the suite passes this
+sanitizer clean — remove the fixture and the sanitizer tells you which
+test needed it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+from tools.raysan.core import Finding, Sanitizer
+
+_AMBIENT_KINDS = ("job_id", "trace_parent")
+
+
+class AmbientSanitizer(Sanitizer):
+    name = "ambient"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (kind, thread ident) -> last value the setter wrote
+        self._ambient: Dict[Tuple[str, int], object] = {}
+        self._ambient_base: Dict[Tuple[str, int], object] = {}
+        self._serve_snap = None
+        self._health_snap = None
+        self._prev_observer = None
+
+    # -- session -----------------------------------------------------------
+
+    def start_session(self) -> None:
+        from ray_tpu._private import sanitize_hooks
+
+        self._prev_observer = sanitize_hooks._ambient_set
+        sanitize_hooks.install_ambient_observer(self._observe)
+
+    def stop_session(self) -> None:
+        from ray_tpu._private import sanitize_hooks
+
+        sanitize_hooks.install_ambient_observer(self._prev_observer)
+
+    def _observe(self, kind: str, ident: int, value: object) -> None:
+        with self._lock:
+            self._ambient[(kind, ident)] = value
+
+    # -- per-test ----------------------------------------------------------
+
+    def before_test(self, test_id: str) -> None:
+        from ray_tpu._private import health, perf_stats
+
+        with self._lock:
+            self._ambient_base = dict(self._ambient)
+        self._serve_snap = perf_stats.snapshot_records(
+            "serve_request_seconds")
+        self._health_snap = health.snapshot_state()
+
+    def after_test(self, test_id: str) -> List[Finding]:
+        from ray_tpu._private import health, perf_stats
+
+        findings: List[Finding] = []
+
+        # -- ambient thread-local residue --------------------------------
+        live = {t.ident: t.name for t in threading.enumerate()
+                if t.is_alive()}
+        with self._lock:
+            current = dict(self._ambient)
+        for (kind, ident), value in sorted(current.items(),
+                                           key=lambda kv: repr(kv[0])):
+            if value is None or ident not in live:
+                continue
+            if self._ambient_base.get((kind, ident)) == value:
+                continue  # pre-existing residue: flagged at its source
+            findings.append(Finding(
+                sanitizer=self.name, test=test_id,
+                message=f"ambient {kind} {value!r} left set on live "
+                        f"thread {live[ident]!r} — a pooled executor "
+                        f"thread will silently tag unrelated work",
+                detail="set without a token/try-finally restore "
+                       "(raylint R7's dynamic counterpart)"))
+
+        # -- serve_request_seconds records -------------------------------
+        # Zeroed == absent: a series first created during the test and
+        # rolled back by restore_records stays registered with empty
+        # records (dropping it would orphan live references) — that is
+        # a clean restore, not residue.
+        now = self._nonzero(
+            perf_stats.snapshot_records("serve_request_seconds"))
+        base = self._nonzero(self._serve_snap)
+        if now != base:
+            changed = sorted(
+                {tags for tags in set(now) | set(base)
+                 if now.get(tags) != base.get(tags)})
+            findings.append(Finding(
+                sanitizer=self.name, test=test_id,
+                message=f"serve_request_seconds records mutated without "
+                        f"reset ({len(changed)} tagged series): "
+                        f"{changed[:4]}",
+                detail="process-global dist: un-reset records read as "
+                       "live SLO burn in every later healthz test "
+                       "(the PR 6 order-dependent flake class)"))
+            perf_stats.restore_records("serve_request_seconds",
+                                       self._serve_snap)
+
+        # -- health tracker + loop-lag tables ----------------------------
+        now_health = health.snapshot_state()
+        if not self._health_equiv(now_health, self._health_snap):
+            findings.append(Finding(
+                sanitizer=self.name, test=test_id,
+                message="health tracker/loop-lag state mutated without "
+                        "reset (burn-rate history or lag components "
+                        "survived the test)",
+                detail=self._health_diff(self._health_snap, now_health)))
+            health.restore_state(self._health_snap)
+        return findings
+
+    @staticmethod
+    def _nonzero(snap: dict) -> dict:
+        out = {}
+        for tags, rec in snap.items():
+            if isinstance(rec, tuple):
+                counts, total, total_sum = rec
+                if total == 0 and total_sum == 0 and not any(counts):
+                    continue
+            elif not rec:
+                continue
+            out[tags] = rec
+        return out
+
+    @staticmethod
+    def _health_equiv(a: dict, b: dict) -> bool:
+        # Full dict equality: key-only comparison would miss in-place
+        # VALUE mutations (an existing component's lag overwritten, a
+        # sampler token replaced) — the exact residue being hunted.
+        return (a["tracker_samples"] == b["tracker_samples"]
+                and a["loop_lag"] == b["loop_lag"]
+                and a["sampler_components"] == b["sampler_components"])
+
+    @staticmethod
+    def _health_diff(before: dict, after: dict) -> str:
+        parts = []
+        if after["tracker_samples"] != before["tracker_samples"]:
+            parts.append(
+                f"tracker snapshots: {len(before['tracker_samples'])} "
+                f"-> {len(after['tracker_samples'])}")
+        for key in ("loop_lag", "sampler_components"):
+            gained = sorted(set(after[key]) - set(before[key]))
+            lost = sorted(set(before[key]) - set(after[key]))
+            if gained:
+                parts.append(f"{key} gained {gained}")
+            if lost:
+                parts.append(f"{key} lost {lost}")
+        return "; ".join(parts) or "(content drift)"

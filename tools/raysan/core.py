@@ -1,0 +1,238 @@
+"""raysan core: findings, suppression policy, and the sanitizer session.
+
+raylint (``tools/raylint``) is the static half of the concurrency
+story; raysan is the dynamic half. A **sanitizer** observes one class
+of runtime state (held locks, event-loop stalls, process resources,
+ambient/global mutations) across a test and reports :class:`Finding`\\ s
+at teardown. The pytest plugin (``tools.raysan.pytest_plugin``) drives
+the per-test snapshot/diff cycle; ``python -m tools.raysan`` wraps a
+whole run and emits the JSON artifact CI archives.
+
+Suppression mirrors raylint's contract: a finding is only suppressed
+by an :class:`Allow` entry that carries a justification — the default
+policy (``tools/raysan/policy.py``) and per-test
+``@pytest.mark.sanitize_allow(sanitizer, pattern, reason=...)``
+markers both use it, and a reason-less allow is itself a finding
+(the ``policy`` meta sanitizer, raylint's R0 analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import time
+from typing import Iterable, List, Optional
+
+SANITIZER_NAMES = ("locks", "loop", "leaks", "ambient")
+
+
+@dataclasses.dataclass
+class Finding:
+    sanitizer: str          # "locks" | "loop" | "leaks" | "ambient" | "policy"
+    test: str               # pytest nodeid ("" outside any test)
+    message: str            # one-line defect statement
+    detail: str = ""        # stacks / diffs / edge sites
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Finding":
+        return cls(**data)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        head = f"[{self.sanitizer}]{tag} {self.test or '<session>'}: " \
+               f"{self.message}"
+        if self.detail:
+            indented = "\n".join("    " + ln
+                                 for ln in self.detail.splitlines())
+            return head + "\n" + indented
+        return head
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    """One justified suppression: findings from ``sanitizer`` whose
+    message matches ``pattern`` (regex, searched) are suppressed,
+    carrying ``reason`` into the report. A reason-less Allow does not
+    suppress and is reported by the policy meta-check instead."""
+
+    sanitizer: str
+    pattern: str
+    reason: str = ""
+
+    def matches(self, finding: Finding) -> bool:
+        return (self.sanitizer == finding.sanitizer
+                and re.search(self.pattern, finding.message) is not None)
+
+
+def apply_policy(findings: Iterable[Finding],
+                 allows: List[Allow],
+                 reported_bad: Optional[set] = None) -> List[Finding]:
+    """Mark suppressed findings in place (raylint semantics: an allow
+    without a reason fails to suppress, and surfaces as a ``policy``
+    finding once per offending allow). ``reported_bad`` carries the
+    already-reported reason-less allows across calls — the Session
+    passes one per run, so a bad SESSION-LEVEL allow fails once (the
+    R0 analog reports a bare disable once), not on every test."""
+    out = list(findings)
+    bad_allows = []
+    for allow in allows:
+        if not allow.reason:
+            if reported_bad is not None:
+                if allow in reported_bad:
+                    continue
+                reported_bad.add(allow)
+            if allow not in bad_allows:
+                bad_allows.append(allow)
+    for f in out:
+        for allow in allows:
+            if allow.reason and allow.matches(f):
+                f.suppressed = True
+                f.justification = allow.reason
+                break
+    for allow in bad_allows:
+        out.append(Finding(
+            sanitizer="policy", test="",
+            message=f"allow({allow.sanitizer!r}, {allow.pattern!r}) has "
+                    f"no justification: every suppression needs "
+                    f"`reason=...` (raylint R0 analog)"))
+    return out
+
+
+class Sanitizer:
+    """Base class: a sanitizer installs process-wide observation at
+    session start, snapshots before each test, and diffs at teardown.
+
+    ``after_test`` runs after every fixture finalizer for the test has
+    completed, so anything a fixture tears down has already been torn
+    down — what is left is what leaked."""
+
+    name = "?"
+
+    def start_session(self) -> None:
+        pass
+
+    def stop_session(self) -> None:
+        pass
+
+    def before_test(self, test_id: str) -> None:
+        pass
+
+    def after_test(self, test_id: str) -> List[Finding]:
+        return []
+
+
+@dataclasses.dataclass
+class Report:
+    sanitizers: List[str]
+    findings: List[Finding]
+    tests_checked: int
+    elapsed_s: float
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "sanitizers": self.sanitizers,
+            "tests_checked": self.tests_checked,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "findings": [f.to_dict() for f in self.active],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+        }, indent=2)
+
+    def render_pretty(self) -> str:
+        lines = [f.render() for f in self.active]
+        lines.append(
+            f"raysan[{','.join(self.sanitizers)}]: "
+            f"{self.tests_checked} tests, {len(self.active)} finding(s), "
+            f"{len(self.suppressed)} suppressed, {self.elapsed_s:.2f}s")
+        return "\n".join(lines)
+
+
+def make_sanitizers(names: Iterable[str], **options) -> List[Sanitizer]:
+    """Instantiate the requested sanitizers (unknown name -> KeyError
+    listing the catalog). Options are passed to the sanitizers that
+    take them (currently ``loop_threshold_ms``)."""
+    from tools.raysan.ambient import AmbientSanitizer
+    from tools.raysan.leaks import LeakSanitizer
+    from tools.raysan.lock_witness import LockOrderSanitizer
+    from tools.raysan.loop_blocking import LoopBlockingSanitizer
+
+    table = {
+        "locks": LockOrderSanitizer,
+        "loop": lambda: LoopBlockingSanitizer(
+            threshold_ms=options.get("loop_threshold_ms", 100.0)),
+        "leaks": LeakSanitizer,
+        "ambient": AmbientSanitizer,
+    }
+    out: List[Sanitizer] = []
+    for name in names:
+        name = name.strip()
+        if not name:
+            continue
+        if name not in table:
+            raise KeyError(
+                f"unknown sanitizer {name!r}; known: "
+                f"{', '.join(SANITIZER_NAMES)}")
+        out.append(table[name]())
+    return out
+
+
+class Session:
+    """One sanitizer run: owns the active sanitizers, accumulates
+    findings, applies the suppression policy, renders the report."""
+
+    def __init__(self, sanitizers: List[Sanitizer],
+                 extra_allows: Optional[List[Allow]] = None):
+        from tools.raysan.policy import DEFAULT_POLICY
+
+        self.sanitizers = sanitizers
+        self.allows = list(DEFAULT_POLICY) + list(extra_allows or [])
+        self.findings: List[Finding] = []
+        self.tests_checked = 0
+        self._reported_bad_allows: set = set()
+        self._t0 = time.monotonic()
+
+    def start(self) -> None:
+        for s in self.sanitizers:
+            s.start_session()
+
+    def stop(self) -> None:
+        for s in self.sanitizers:
+            s.stop_session()
+
+    def before_test(self, test_id: str) -> None:
+        for s in self.sanitizers:
+            s.before_test(test_id)
+
+    def after_test(self, test_id: str,
+                   test_allows: Optional[List[Allow]] = None) \
+            -> List[Finding]:
+        """Diff every sanitizer, apply policy + per-test allows, record
+        into the session report; returns this test's findings."""
+        self.tests_checked += 1
+        new: List[Finding] = []
+        for s in self.sanitizers:
+            new.extend(s.after_test(test_id))
+        new = apply_policy(new, self.allows + list(test_allows or []),
+                           reported_bad=self._reported_bad_allows)
+        self.findings.extend(new)
+        return new
+
+    def report(self) -> Report:
+        return Report(
+            sanitizers=[s.name for s in self.sanitizers],
+            findings=list(self.findings),
+            tests_checked=self.tests_checked,
+            elapsed_s=time.monotonic() - self._t0)

@@ -1,0 +1,383 @@
+"""Lock-order witness: runtime held-before graph with cycle detection.
+
+raylint R2 derives the lock-order graph *statically* (per-class lock
+attributes, Tarjan SCC over ``with self._lock`` nesting). This is the
+dynamic cross-check: while the sanitizer is installed, every lock built
+through ``threading.Lock`` / ``threading.RLock`` /
+``threading.Condition`` is wrapped so acquisitions record **held-before
+edges** — "lock at site A was held while the lock at site B was
+acquired" — with the acquiring stack attached to each edge's first
+observation. At test teardown any cycle in the edge graph (the classic
+AB/BA deadlock shape, any length) becomes a finding naming every edge
+site in the cycle.
+
+Lock identity is the **creation site** (``path:line`` of the
+``threading.Lock()`` call), matching raylint R2's per-class-attribute
+aggregation: every instance of ``Router._lock`` shares one node, so an
+ordering inversion between two *instances* of the same pair of classes
+is still a cycle. The cross-check test
+(``tests/core/test_concurrency_races.py``) asserts the runtime SCC and
+R2's static SCC agree on the same fixture code.
+
+Only locks created while the sanitizer is installed are witnessed
+(wrapping live C-lock instances retroactively is impossible); that is
+the right scope for per-test sanitization — the locks a test's code
+creates are the ones whose ordering the test exercises. Reacquisition
+after a ``Condition.wait`` deliberately records no edge: the condvar
+protocol's reacquire is not an ordering decision.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raysan.core import Finding, Sanitizer
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+# (outer_site, inner_site) -> (stack text, test_id at first observation)
+_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_edges_lock = _REAL_LOCK()
+_held = threading.local()        # per-thread list of site strings
+_installed = False
+_current_test = ""
+# Repo root derived from this module's own location (tools/raysan/..):
+# site keys must be repo-relative like raylint's relpaths, on ANY
+# checkout path — not just ones containing a '/repo/' component.
+_REPO_ROOT = __file__.replace("\\", "/").rsplit("/", 3)[0] + "/"
+
+
+def _site() -> Optional[str]:
+    """Creation site of the lock: the first frame outside this module
+    and ``threading``. Returns None for raysan-internal creations
+    (witnessing the witness's own synchronization would only add noise
+    edges)."""
+    for frame in traceback.extract_stack()[-2::-1]:
+        fn = frame.filename.replace("\\", "/")
+        if fn.endswith(("threading.py", "/lock_witness.py")):
+            continue
+        if "/raysan/" in fn:
+            return None
+        if fn.startswith(_REPO_ROOT):
+            fn = fn[len(_REPO_ROOT):]
+        return f"{fn}:{frame.lineno}"
+    return None
+
+
+def _note_acquire(site: str, record_edges: bool = True) -> None:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    if record_edges and _installed:
+        for outer in stack:
+            if outer != site:
+                key = (outer, site)
+                if key not in _edges:
+                    tb = "".join(traceback.format_stack(limit=8)[:-2])
+                    with _edges_lock:
+                        _edges.setdefault(key, (tb, _current_test))
+    stack.append(site)
+
+
+def _note_release(site: str) -> None:
+    stack = getattr(_held, "stack", None)
+    if stack and site in stack:
+        # Remove the innermost occurrence (lock sets are small).
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == site:
+                del stack[i]
+                break
+
+
+class _WitnessLock:
+    """Duck-typed stand-in for a ``threading.Lock``/``RLock``: records
+    held-before edges around the real lock. RLock reentrancy is depth-
+    counted per thread so only the 0→1 acquire and 1→0 release touch
+    the held stack."""
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        self._depth: Dict[int, int] = {}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            ident = threading.get_ident()
+            depth = self._depth.get(ident, 0) + 1
+            self._depth[ident] = depth
+            if depth == 1 or not self._reentrant:
+                _note_acquire(self._site)
+        return got
+
+    def release(self):
+        ident = threading.get_ident()
+        depth = self._depth.get(ident, 1) - 1
+        if depth <= 0:
+            self._depth.pop(ident, None)
+        else:
+            self._depth[ident] = depth
+        self._inner.release()
+        if depth <= 0 or not self._reentrant:
+            _note_release(self._site)
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition-protocol hooks: a real ``threading.Condition`` built
+    # before the sanitizer installed may wrap a witnessed lock; these
+    # keep its wait() releasing/restoring the full reentrant depth.
+    def _release_save(self):
+        state = getattr(self._inner, "_release_save", None)
+        ident = threading.get_ident()
+        depth = self._depth.pop(ident, 0)
+        _note_release(self._site)
+        if state is not None:
+            return (state(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        if state is not None:
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        if depth:
+            self._depth[threading.get_ident()] = depth
+        _note_acquire(self._site, record_edges=False)
+
+    def _is_owned(self):
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<WitnessLock {self._site} over {self._inner!r}>"
+
+
+class _WitnessCondition:
+    """Condition facade: delegates to a real Condition over the real
+    underlying lock, recording acquisition ordering under the wrapped
+    (or implicit) lock's identity. ``Condition(existing_lock)`` aliases
+    to that lock's site — the same aliasing raylint R2 applies."""
+
+    def __init__(self, lock=None, site: str = "?"):
+        if isinstance(lock, _WitnessLock):
+            self._site = lock._site
+            inner = lock._inner
+        elif lock is not None:
+            self._site = site
+            inner = lock
+        else:
+            self._site = site
+            inner = _REAL_RLOCK()
+        self._cond = _REAL_CONDITION(inner)
+
+    def acquire(self, *args, **kwargs):
+        got = self._cond.acquire(*args, **kwargs)
+        if got:
+            _note_acquire(self._site)
+        return got
+
+    def release(self):
+        self._cond.release()
+        _note_release(self._site)
+
+    def __enter__(self):
+        self._cond.__enter__()
+        _note_acquire(self._site)
+        return self
+
+    def __exit__(self, *exc):
+        _note_release(self._site)
+        return self._cond.__exit__(*exc)
+
+    def wait(self, timeout=None):
+        _note_release(self._site)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _note_acquire(self._site, record_edges=False)
+
+    def wait_for(self, predicate, timeout=None):
+        _note_release(self._site)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _note_acquire(self._site, record_edges=False)
+
+    def notify(self, n=1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<WitnessCondition {self._site}>"
+
+
+def _make_lock():
+    site = _site()
+    inner = _REAL_LOCK()
+    if site is None or not _installed:
+        return inner
+    return _WitnessLock(inner, site, reentrant=False)
+
+
+def _make_rlock():
+    site = _site()
+    inner = _REAL_RLOCK()
+    if site is None or not _installed:
+        return inner
+    return _WitnessLock(inner, site, reentrant=True)
+
+
+def _make_condition(lock=None):
+    site = _site()
+    if site is None or not _installed:
+        if isinstance(lock, _WitnessLock):
+            lock = lock._inner
+        return _REAL_CONDITION(lock)
+    return _WitnessCondition(lock, site=site)
+
+
+def find_cycles(edges: Optional[Dict] = None) -> List[List[str]]:
+    """SCCs of size > 1 in the held-before graph (each is a lock-order
+    cycle). Iterative Tarjan — the graphs are tiny but recursion limits
+    are not ours to burn."""
+    edge_map = edges if edges is not None else dict(_edges)
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edge_map:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def connect(root):
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            connect(v)
+    return [sorted(c) for c in sccs if len(c) > 1]
+
+
+def witnessed_edges() -> Dict[Tuple[str, str], Tuple[str, str]]:
+    with _edges_lock:
+        return dict(_edges)
+
+
+def reset() -> None:
+    with _edges_lock:
+        _edges.clear()
+
+
+class LockOrderSanitizer(Sanitizer):
+    name = "locks"
+
+    def start_session(self) -> None:
+        global _installed
+        reset()
+        _installed = True
+        threading.Lock = _make_lock
+        threading.RLock = _make_rlock
+        threading.Condition = _make_condition
+
+    def stop_session(self) -> None:
+        global _installed
+        _installed = False
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+
+    def before_test(self, test_id: str) -> None:
+        global _current_test
+        _current_test = test_id
+
+    def after_test(self, test_id: str) -> List[Finding]:
+        """Cycles over the FULL session graph, reported when this test
+        contributed at least one participating edge — cross-test
+        inversions (test A locks X→Y, test B locks Y→X on the same
+        classes) are real deadlocks and must not escape by arriving one
+        half at a time."""
+        with _edges_lock:
+            edges = dict(_edges)
+        findings = []
+        for cycle in find_cycles(edges):
+            comp = set(cycle)
+            sites = [(a, b, tb, owner) for (a, b), (tb, owner)
+                     in sorted(edges.items())
+                     if a in comp and b in comp]
+            if not any(owner == test_id for _, _, _, owner in sites):
+                continue
+            detail = []
+            for a, b, tb, owner in sites:
+                detail.append(f"{a} held while acquiring {b} "
+                              f"(first seen in {owner or '<session>'}):")
+                detail.extend("  " + ln for ln in tb.splitlines()[-4:])
+            findings.append(Finding(
+                sanitizer=self.name, test=test_id,
+                message=f"lock-order cycle among {{{', '.join(cycle)}}}",
+                detail="\n".join(detail)))
+            # Break the cycle's edges out of the graph so every later
+            # test is not re-failed for the same inversion.
+            with _edges_lock:
+                for key in [k for k in _edges
+                            if k[0] in comp and k[1] in comp]:
+                    del _edges[key]
+        return findings

@@ -1,0 +1,135 @@
+"""Event-loop blocking detector: dynamic raylint R1.
+
+R1 statically flags synchronous calls inside ``async def``; this is
+the runtime complement: every callback an asyncio loop runs is timed
+(a patch over ``asyncio.events.Handle._run``, which both plain and
+timer handles funnel through), and a callback that holds the loop for
+longer than the threshold becomes a finding.
+
+The *offending stack* is captured live, not reconstructed: a watchdog
+thread wakes at a fraction of the threshold and, when it sees a
+callback that has already overstayed, samples the loop thread's
+current frame via ``sys._current_frames()`` — i.e. the stack of
+whatever synchronous work is actually wedging the loop mid-stall,
+which is the thing the static rule can only guess at.
+
+Per test, stalls aggregate by callback description (one finding per
+offender with count + worst-case duration) so a hot callback cannot
+flood the report.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Tuple
+
+from tools.raysan.core import Finding, Sanitizer
+
+
+class LoopBlockingSanitizer(Sanitizer):
+    name = "loop"
+
+    def __init__(self, threshold_ms: float = 100.0):
+        self.threshold_s = threshold_ms / 1000.0
+        self._orig_run = None
+        # loop-thread ident -> (handle, t0, stack_holder)
+        self._running: Dict[int, Tuple[object, float, list]] = {}
+        self._lock = threading.Lock()
+        # desc -> (count, worst_s, stack) for the current test
+        self._stalls: Dict[str, Tuple[int, float, str]] = {}
+        self._watchdog_stop = threading.Event()
+        self._watchdog = None
+
+    # -- installation ------------------------------------------------------
+
+    def start_session(self) -> None:
+        import asyncio.events
+
+        sanitizer = self
+        self._orig_run = orig = asyncio.events.Handle._run
+
+        def timed_run(handle):
+            ident = threading.get_ident()
+            holder: list = []
+            sanitizer._running[ident] = (handle, time.monotonic(), holder)
+            try:
+                return orig(handle)
+            finally:
+                entry = sanitizer._running.pop(ident, None)
+                if entry is not None:
+                    elapsed = time.monotonic() - entry[1]
+                    if elapsed >= sanitizer.threshold_s:
+                        sanitizer._record(handle, elapsed, holder)
+
+        asyncio.events.Handle._run = timed_run
+        self._watchdog_stop.clear()
+        self._watchdog = threading.Thread(  # raylint: disable=R4 -- stop_session() (the Sanitizer-protocol teardown the pytest plugin invokes at session end) sets the stop event and joins this watchdog; R4's name list just doesn't know the sanitizer lifecycle verbs
+            target=self._watch, daemon=True, name="raysan-loop-watchdog")
+        self._watchdog.start()
+
+    def stop_session(self) -> None:
+        import asyncio.events
+
+        if self._orig_run is not None:
+            asyncio.events.Handle._run = self._orig_run
+            self._orig_run = None
+        self._watchdog_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    # -- sampling ----------------------------------------------------------
+
+    def _watch(self) -> None:
+        period = max(self.threshold_s / 4.0, 0.005)
+        while not self._watchdog_stop.wait(period):
+            now = time.monotonic()
+            for ident, (handle, t0, holder) in list(
+                    self._running.items()):
+                if now - t0 < self.threshold_s or holder:
+                    continue
+                frame = sys._current_frames().get(ident)
+                if frame is not None:
+                    holder.append("".join(
+                        traceback.format_stack(frame, limit=12)))
+
+    @staticmethod
+    def _describe(handle) -> str:
+        cb = getattr(handle, "_callback", None)
+        if cb is None:
+            return repr(handle)
+        name = getattr(cb, "__qualname__", None) or repr(cb)
+        mod = getattr(cb, "__module__", "")
+        return f"{mod}.{name}" if mod else name
+
+    def _record(self, handle, elapsed: float, holder: list) -> None:
+        desc = self._describe(handle)
+        stack = holder[0] if holder else "(stall ended before the " \
+                                        "watchdog sampled a stack)"
+        with self._lock:
+            count, worst, first_stack = self._stalls.get(
+                desc, (0, 0.0, stack))
+            self._stalls[desc] = (count + 1, max(worst, elapsed),
+                                  first_stack)
+
+    # -- per-test ----------------------------------------------------------
+
+    def before_test(self, test_id: str) -> None:
+        with self._lock:
+            self._stalls.clear()
+
+    def after_test(self, test_id: str) -> List[Finding]:
+        with self._lock:
+            stalls, self._stalls = self._stalls, {}
+        return [
+            Finding(
+                sanitizer=self.name, test=test_id,
+                message=f"event loop blocked {worst * 1e3:.0f}ms by "
+                        f"{desc} ({count} stall(s) over "
+                        f"{self.threshold_s * 1e3:.0f}ms)",
+                detail=stack)
+            for desc, (count, worst, stack) in sorted(stalls.items())
+        ]

@@ -1,0 +1,38 @@
+"""Default suppression policy: the justified, deliberate exceptions.
+
+raylint keeps its suppressions inline (a disable comment carrying a
+justification) because static findings anchor to a source line.
+Runtime findings anchor to *state* (an fd, a thread, a registry key),
+so the justified exceptions live here instead — one :class:`Allow` per
+deliberately-leaked resource class, justification REQUIRED (a
+reason-less entry is itself reported; see ``core.apply_policy``).
+
+Keep this list short and specific: every entry is a hole in the
+sanitizer. Per-test exceptions belong on the test as
+``@pytest.mark.sanitize_allow(...)``, not here.
+"""
+
+from __future__ import annotations
+
+from tools.raysan.core import Allow
+
+DEFAULT_POLICY = [
+    Allow(
+        "leaks", r"pooled RpcClient",
+        reason="RpcClient._pools is process-lifetime by design: one "
+               "connection per (process, address), reused across "
+               "tests the way production reuses it across jobs; "
+               "closing per test would retest connection setup, not "
+               "the runtime"),
+    Allow(
+        "leaks", r"thread leaked: 'pydev|thread leaked: 'IPython",
+        reason="debugger/REPL host threads are owned by the tool "
+               "running the suite, not by the code under test"),
+    Allow(
+        "leaks", r"fd leaked: file fd=\d+ \(/dev/shm/ray_tpu",
+        reason="SharedPlane.destroy(unmap=False) at cluster teardown "
+               "unlinks the segment but DELIBERATELY leaves the "
+               "driver's mapping (and its dup'd fd) intact: fetch "
+               "threads mid-read keep a valid mapping instead of "
+               "segfaulting; the unlinked pages free at process exit"),
+]

@@ -1,0 +1,121 @@
+"""pytest integration: ``pytest --sanitize=locks,loop,leaks,ambient``.
+
+The plugin owns the sanitizer lifecycle around the ordinary test
+protocol:
+
+- session start: instantiate the requested sanitizers and install
+  their process-wide observation (lock-factory wrappers, the asyncio
+  handle timer, the ambient-setter tap);
+- per test: snapshot BEFORE fixture setup (so fixture-created
+  resources are attributed to the test that requested them) and diff
+  AFTER every fixture finalizer has run (so anything a fixture tears
+  down is already gone) — an unsuppressed finding raises at the end of
+  teardown and fails the test like any teardown error, pointing at the
+  exact test that leaked;
+- session end: the accumulated findings (suppressed ones included,
+  with their justifications) are written as a JSON report when
+  ``--sanitize-report=PATH`` is given — the CI artifact.
+
+Per-test suppression: ``@pytest.mark.sanitize_allow(sanitizer,
+pattern, reason="...")`` — the reason is required (raylint R0
+semantics: a bare allow does not suppress and is itself reported).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+
+def pytest_addoption(parser):
+    group = parser.getgroup("raysan", "runtime sanitizers")
+    group.addoption(
+        "--sanitize", default="", metavar="LIST",
+        help="comma-separated runtime sanitizers to enable: "
+             "locks,loop,leaks,ambient (or 'all')")
+    group.addoption(
+        "--sanitize-report", default="", metavar="PATH",
+        help="write the session's sanitizer findings as JSON to PATH")
+    group.addoption(
+        "--sanitize-loop-threshold-ms", type=float, default=100.0,
+        metavar="MS",
+        help="loop sanitizer: flag event-loop callbacks holding the "
+             "loop longer than MS milliseconds (default 100)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "sanitize_allow(sanitizer, pattern, reason=...): suppress "
+        "matching raysan findings for this test; reason is REQUIRED "
+        "(a reason-less allow is itself a finding)")
+    spec = config.getoption("--sanitize")
+    if not spec:
+        return
+    from tools.raysan.core import SANITIZER_NAMES, Session, \
+        make_sanitizers
+
+    names = list(SANITIZER_NAMES) if spec.strip() == "all" \
+        else [n for n in spec.split(",") if n.strip()]
+    try:
+        sanitizers = make_sanitizers(
+            names,
+            loop_threshold_ms=config.getoption(
+                "--sanitize-loop-threshold-ms"))
+    except KeyError as e:
+        raise pytest.UsageError(f"--sanitize: {e.args[0]}")
+    config._raysan = Session(sanitizers)
+    config._raysan.start()
+
+
+def pytest_unconfigure(config):
+    session = getattr(config, "_raysan", None)
+    if session is None:
+        return
+    session.stop()
+    path = config.getoption("--sanitize-report")
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(session.report().to_json())
+    config._raysan = None
+
+
+def _test_allows(item):
+    from tools.raysan.core import Allow
+
+    allows = []
+    for mark in item.iter_markers("sanitize_allow"):
+        sanitizer = mark.args[0] if mark.args else ""
+        pattern = mark.args[1] if len(mark.args) > 1 else ".*"
+        allows.append(Allow(sanitizer, pattern,
+                            mark.kwargs.get("reason", "")))
+    return allows
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_runtest_setup(item):
+    session = getattr(item.config, "_raysan", None)
+    if session is not None:
+        session.before_test(item.nodeid)
+
+
+class SanitizerFailure(Exception):
+    """Raised at the end of teardown when a test left unsuppressed
+    sanitizer findings; pytest reports it as a teardown error on the
+    offending test."""
+
+    # Hide the plugin frame from the traceback pytest prints.
+    __module__ = "builtins"
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_runtest_teardown(item, nextitem):
+    session = getattr(item.config, "_raysan", None)
+    if session is None:
+        return
+    findings = session.after_test(item.nodeid,
+                                  test_allows=_test_allows(item))
+    active = [f for f in findings if not f.suppressed]
+    if active:
+        raise SanitizerFailure(
+            "raysan: %d unsuppressed finding(s):\n%s" % (
+                len(active), "\n".join(f.render() for f in active)))

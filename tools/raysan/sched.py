@@ -1,0 +1,244 @@
+"""raysan.sched: deterministic interleaving schedules over yield points.
+
+The runtime's fixed races (router reserved-slot oversubscription, the
+``PipelinedClient`` close-before-flush orphan sweep) were all *ordering*
+bugs: two threads crossing a handful of well-known boundaries in an
+unlucky order. This module makes that order a first-class, replayable
+test input instead of a property of the OS scheduler.
+
+Product code exposes named **yield points** at its concurrency
+boundaries via ``ray_tpu._private.sanitize_hooks.sched_point`` (the
+router's reserved→in-flight handoff, the batcher drain, the pipelined
+reader's loop edge, ...). With no schedule installed a point is a
+no-op. Under a :class:`Schedule` a crossing can be *gated*:
+
+- **Scripted mode** (``Schedule(order=[...])``): ``order`` is the exact
+  sequence of point crossings the test demands. A thread crossing a
+  listed point parks until every earlier entry has been crossed;
+  unlisted crossings pass freely. Entries are ``"name"`` (first
+  crossing of ``name``) or ``"name#k"`` (the k-th crossing). This is
+  fully deterministic: the same script forces the same interleaving on
+  every run — the replay half of the harness.
+- **Seeded mode** (``Schedule(seed=n)``): every crossing consults a
+  seeded RNG to decide whether to pause briefly — long enough for any
+  concurrently-running thread to overtake through the window — before
+  proceeding. Pauses are bounded (``pause_max_s``), so exploration can
+  never deadlock; the crossing log (:attr:`trace`) converts to a
+  script via :meth:`trace_order` for exact replay of whatever a seed
+  found.
+
+Tests mark their own side of an interleaving with
+:meth:`Schedule.cross` (a manual point), so scripts can order test
+actions against internal threads the test never created (e.g. the
+pipelined client's reader).
+
+A gated thread that waits longer than ``timeout_s`` raises
+:class:`ScheduleTimeout` naming every pending entry and every parked
+thread — a wrong script fails loudly in seconds, never hangs a suite.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import sanitize_hooks
+
+
+class ScheduleTimeout(RuntimeError):
+    """A gated crossing waited out ``timeout_s`` — the script demands
+    an ordering the code under test never produced (or the schedule
+    deadlocked against a real lock)."""
+
+
+class Schedule:
+    """One deterministic (scripted) or seeded (exploring) interleaving.
+
+    Use as a context manager to install the yield-point hook::
+
+        sched = Schedule(order=["pipe.closed_set", "pipe.reader_loop#2"])
+        with sched:
+            ...   # run the threads under test
+        assert sched.completed
+
+    Only one schedule can be installed at a time (they are process-wide
+    by design: internal runtime threads must see the same schedule as
+    the test's own threads).
+    """
+
+    def __init__(self, order: Optional[List[str]] = None,
+                 seed: Optional[int] = None,
+                 timeout_s: float = 5.0,
+                 pause_prob: float = 0.5,
+                 pause_max_s: float = 0.05):
+        if order is not None and seed is not None:
+            raise ValueError("order= and seed= are mutually exclusive")
+        self._order = list(order) if order else []
+        if len(set(self._order)) != len(self._order):
+            raise ValueError(f"duplicate entries in order: {self._order}")
+        self._rng = random.Random(seed) if seed is not None else None
+        self._timeout = timeout_s
+        self._pause_prob = pause_prob
+        self._pause_max = pause_max_s
+        self._cond = threading.Condition()
+        self._counts: Dict[str, int] = {}   # name -> crossings so far
+        self._done = [False] * len(self._order)
+        self._generation = 0                # bumps on every crossing
+        self._parked: Dict[int, str] = {}   # thread ident -> entry/point
+        self._released = False              # __exit__ opened all gates
+        self.trace: List[Tuple[str, str]] = []  # (key, thread name)
+        self._prev_hook = None
+
+    # -- installation ------------------------------------------------------
+
+    def __enter__(self) -> "Schedule":
+        self._prev_hook = sanitize_hooks._sched_point
+        sanitize_hooks.install_sched_point(self.point)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        sanitize_hooks.install_sched_point(self._prev_hook)
+        # Release anything still parked so stray threads don't hold the
+        # suite hostage after the test body is done with the schedule —
+        # WITHOUT forging `_done`: `completed` must keep reporting
+        # whether the script actually played out (the race fixtures'
+        # acceptance assertions read it after this block).
+        with self._cond:
+            self._released = True
+            self._cond.notify_all()
+
+    # -- crossing ----------------------------------------------------------
+
+    def cross(self, name: str) -> None:
+        """A test-side yield point: identical to product code crossing
+        ``sanitize_hooks.sched_point(name)``."""
+        self.point(name)
+
+    def point(self, name: str) -> None:
+        with self._cond:
+            occ = self._counts.get(name, 0) + 1
+            self._counts[name] = occ
+            key = f"{name}#{occ}"
+            idx = self._entry_index(name, occ)
+        if idx is not None:
+            self._gate(idx, key)
+        elif self._rng is not None:
+            self._maybe_pause(key)
+        else:
+            self._record(key)
+
+    def _entry_index(self, name: str, occ: int) -> Optional[int]:
+        key = f"{name}#{occ}"
+        if key in self._order:
+            return self._order.index(key)
+        if occ == 1 and name in self._order:
+            return self._order.index(name)
+        return None
+
+    def _gate(self, idx: int, key: str) -> None:
+        deadline = time.monotonic() + self._timeout
+        ident = threading.get_ident()
+        with self._cond:
+            self._parked[ident] = self._order[idx]
+            try:
+                while not all(self._done[:idx]):
+                    if self._released:
+                        # Torn down mid-park: pass the thread through
+                        # but do NOT mark the entry done — the script
+                        # did not play out, and `completed` says so.
+                        self._record_locked(key)
+                        return
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ScheduleTimeout(self._timeout_msg(idx))
+                    self._cond.wait(remaining)
+            finally:
+                self._parked.pop(ident, None)
+            self._done[idx] = True
+            self._record_locked(key)
+            self._cond.notify_all()
+
+    def _maybe_pause(self, key: str) -> None:
+        pause = self._rng.random() < self._pause_prob
+        with self._cond:
+            if pause:
+                # Hold this thread in the window until some OTHER
+                # crossing happens (another thread overtaking through
+                # the race window) or the bounded pause expires.
+                gen = self._generation
+                deadline = time.monotonic() + self._pause_max
+                while self._generation == gen:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            self._record_locked(key)
+            self._cond.notify_all()
+
+    def _record(self, key: str) -> None:
+        with self._cond:
+            self._record_locked(key)
+            self._cond.notify_all()
+
+    def _record_locked(self, key: str) -> None:
+        self._generation += 1
+        self.trace.append((key, threading.current_thread().name))
+
+    def _timeout_msg(self, idx: int) -> str:
+        pending = [self._order[i] for i in range(idx)
+                   if not self._done[i]]
+        parked = {threading.current_thread().name: self._order[idx]}
+        for ident, entry in self._parked.items():
+            for t in threading.enumerate():
+                if t.ident == ident:
+                    parked[t.name] = entry
+        return (f"schedule timeout at {self._order[idx]!r}: waiting on "
+                f"{pending}; parked threads: {parked}; "
+                f"crossed so far: {[k for k, _ in self.trace]}")
+
+    def parked_at(self, name: str) -> bool:
+        """True while some thread is parked at the gate for ``name``
+        (exact entry, or any ``name#k`` occurrence of it) — the test-
+        side synchronization for 'wait until A is in the window'."""
+        with self._cond:
+            return any(entry == name or entry.split("#")[0] == name
+                       for entry in self._parked.values())
+
+    # -- results -----------------------------------------------------------
+
+    @property
+    def completed(self) -> bool:
+        """True when every scripted entry was crossed."""
+        with self._cond:
+            return all(self._done)
+
+    def trace_order(self) -> List[str]:
+        """The crossing log as a script: feed to ``Schedule(order=...)``
+        to replay exactly the interleaving this (seeded) run produced."""
+        with self._cond:
+            return [key for key, _ in self.trace]
+
+
+def find_race(run, seeds=range(16), **schedule_kwargs):
+    """Exploration driver: run ``run(schedule)`` under each seed until
+    one reproduces the race. ``run`` returns truthy when the race
+    manifested (or raises — treated the same, with the exception
+    swallowed into the result).
+
+    Returns ``(seed, trace_order)`` for the first reproducing seed, or
+    ``None`` when no seed in the sweep found it. The returned trace
+    replays the interleaving deterministically via
+    ``Schedule(order=trace_order)``.
+    """
+    for seed in seeds:
+        sched = Schedule(seed=seed, **schedule_kwargs)
+        try:
+            with sched:
+                hit = run(sched)
+        except Exception:
+            hit = True
+        if hit:
+            return seed, sched.trace_order()
+    return None
