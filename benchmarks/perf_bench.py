@@ -208,6 +208,108 @@ def ab_observability(repeats: int = 5, attempts: int = 3) -> dict:
     return result
 
 
+# -- yield-point hook tax guard (--ab-hooks) ---------------------------------
+#
+# raysan/raymc grow the sanitize_hooks yield-point map over time; each
+# crossing costs one global load + None check when nothing is
+# installed. A direct uninstalled-vs-uninstalled A/B cannot measure
+# that (the crossing is compiled into the call sites), so the guard
+# multiplies two robust numbers instead: the measured ns/crossing of an
+# UNINSTALLED sched_point, and a census of crossings-per-op taken by
+# installing a counting hook over the same dep-parked submit /
+# resolved-wait workload the observability A/B pins. Their product
+# bounds the hook tax on each hot path; the budget is <1%. The census
+# itself is also pinned: a future PR that drops a crossing into a
+# per-object hot loop trips the count ceiling even if this host is too
+# noisy to see the time.
+
+HOOKS_TAX_BUDGET = 0.01    # <1% of submit / wait op time
+# Census ceiling: total crossings per workload unit (one unit = one
+# task + one put + one wait round). Today the whole workload crosses
+# ~1 per unit (store.put per completion/put, store.wait per round); a
+# crossing added inside a per-object or per-poll hot loop multiplies
+# this and trips the guard even when host noise hides the time.
+HOOKS_MAX_PER_UNIT = 2.0
+
+
+def ab_hooks() -> dict:
+    import ray_tpu
+    from ray_tpu._private import sanitize_hooks
+
+    # The production default must BE the uninstalled fast path.
+    uninstalled = (sanitize_hooks._sched_point is None
+                   and sanitize_hooks._crash_point is None)
+
+    # ns per uninstalled crossing, best-of-3 chunks.
+    n = 200_000
+    best_ns = float("inf")
+    crossing = sanitize_hooks.sched_point
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            crossing("router.handoff")
+        best_ns = min(best_ns,
+                      (time.perf_counter() - t0) / n * 1e9)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        n_tasks, n_refs, wait_rounds = 5000, 1000, 200
+        _measure_submit_wait(n_tasks, n_refs, wait_rounds)  # warm-up
+        base = _measure_submit_wait(n_tasks, n_refs, wait_rounds)
+
+        counts = {}
+        counts_lock = threading.Lock()
+
+        def census(name):
+            # Crossings fire concurrently from driver + executor pool
+            # threads; a bare dict increment would lose counts and let
+            # the per-unit ceiling under-read.
+            with counts_lock:
+                counts[name] = counts.get(name, 0) + 1
+
+        sanitize_hooks.install_sched_point(census)
+        sanitize_hooks.install_crash_point(census)
+        try:
+            _measure_submit_wait(n_tasks, n_refs, wait_rounds)
+            total = sum(counts.values())
+        finally:
+            sanitize_hooks.install_sched_point(None)
+            sanitize_hooks.install_crash_point(None)
+    finally:
+        ray_tpu.shutdown()
+
+    # Attribute the census to ops conservatively: every crossing the
+    # whole workload made is charged to BOTH paths (puts, executor
+    # drains and teardown crossings included), so each per-op tax is
+    # an overcount — if the overcount passes the 1% budget, the true
+    # tax certainly does.
+    per_submit = total / n_tasks
+    per_wait_round = total / wait_rounds
+    units = n_tasks + n_refs + wait_rounds
+    per_unit = total / units
+    submit_op_ns = 1e9 / base["submit_per_s"]
+    wait_op_ns = 1e9 / base["wait_rounds_per_s"]
+    submit_tax = per_submit * best_ns / submit_op_ns
+    wait_tax = per_wait_round * best_ns / wait_op_ns
+    ok = (uninstalled
+          and submit_tax < HOOKS_TAX_BUDGET
+          and wait_tax < HOOKS_TAX_BUDGET
+          and per_unit <= HOOKS_MAX_PER_UNIT)
+    return {
+        "budget": HOOKS_TAX_BUDGET,
+        "uninstalled_by_default": uninstalled,
+        "ns_per_crossing_uninstalled": round(best_ns, 1),
+        "crossings_total": total,
+        "crossings_by_point": dict(sorted(counts.items())),
+        "crossings_per_workload_unit": round(per_unit, 4),
+        "per_unit_ceiling": HOOKS_MAX_PER_UNIT,
+        "submit_tax_fraction": round(submit_tax, 6),
+        "wait_tax_fraction": round(wait_tax, 6),
+        "pass": ok,
+    }
+
+
 def ab_job_tagging(repeats: int = 5, attempts: int = 3) -> dict:
     """Job-tag propagation A/B over the same submit/wait hot paths:
     every spec/put carrying an ambient tenant tag (job_id_for_submit +
@@ -446,9 +548,30 @@ def main() -> dict:
                         help="run ONLY the observability overhead A/B "
                              "guard (submit/wait hot paths, "
                              "instrumented vs baseline)")
+    parser.add_argument("--ab-hooks", action="store_true",
+                        help="run ONLY the sanitize_hooks yield-point "
+                             "tax guard (uninstalled crossing cost x "
+                             "per-op crossing census, <1% budget)")
     args = parser.parse_args()
 
     cal = host_calibration()
+
+    if args.ab_hooks:
+        hooks = ab_hooks()
+        envelope = {
+            "schema_version": SCHEMA_VERSION,
+            "suite": "hooks_ab",
+            "harness": "benchmarks/perf_bench.py --ab-hooks",
+            "host_calibration": cal,
+            "metrics": {"hooks": hooks},
+        }
+        print(json.dumps(envelope, indent=2))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(envelope, f, indent=2)
+        if not hooks["pass"]:
+            sys.exit(f"yield-point hook tax guard FAILED: {hooks}")
+        return envelope
 
     if args.ab_observability:
         ab = ab_observability()

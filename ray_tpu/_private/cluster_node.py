@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.rpc import RpcClient, RpcServer, routable_host
@@ -404,6 +405,11 @@ class NodeRuntime:
         referencing it); calls then dispatch in order. Per-call failures
         land in that call's return objects — the frame itself only fails
         on transport/decode problems, where nothing was dispatched."""
+        # Yield point at the frame boundary: everything before this
+        # crossing is "the frame arrived but nothing dispatched" —
+        # where a node death leaves the driver's exactly-once resubmit
+        # (same frame rid, server-side dedupe) to do the recovery.
+        sanitize_hooks.sched_point("cluster.submit_batch")
         for t in templates or []:
             payload = t.payload
             if payload is not None:

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import sanitize_hooks
+
 
 class StoreClient:
     """Typed-table KV: (table, key) -> bytes."""
@@ -134,6 +136,12 @@ class SqliteStoreClient(StoreClient):
             self._conn.commit()
 
     def put(self, table: str, key: bytes, value: bytes) -> None:
+        # Yield point BEFORE the lock: the accept-vs-commit ordering is
+        # the group-commit protocol's racy surface (a write accepted in
+        # the window rides the next COMMIT; raymc's durability check
+        # explores every placement of this accept against the commit
+        # and against an injected crash).
+        sanitize_hooks.sched_point("gcs.put")
         with self._lock:
             self._conn.execute(
                 "INSERT INTO kv (tbl, key, value) VALUES (?, ?, ?)"
@@ -183,6 +191,14 @@ class SqliteStoreClient(StoreClient):
 
         t0 = time.monotonic()
         with self._lock:
+            # Crash-fault seams, UNDER the write lock so the kill
+            # boundary is exact: death at `before` loses everything the
+            # pending transaction accumulated (WAL rolls it back);
+            # death at `after` is post-COMMIT — those writes must
+            # survive restart even though this flush() never returned.
+            # No concurrent put can interleave between the commit and
+            # the `after` point (both sit inside one lock hold).
+            sanitize_hooks.crash_point("gcs.commit.before")
             try:
                 self._conn.commit()
             except Exception:
@@ -198,6 +214,7 @@ class SqliteStoreClient(StoreClient):
                         "GCS group commit failed; will retry",
                         exc_info=True)
                 return
+            sanitize_hooks.crash_point("gcs.commit.after")
             self._commit_err_logged = False
             self._dirty.clear()
         perf_stats.latency("gcs_commit_seconds").record(

@@ -137,6 +137,18 @@ class RpcServer:
                         return
                 with server_self._conns_lock:
                     server_self._conns.add(self.request)
+                try:
+                    self._serve_requests()
+                except sanitize_hooks.SimulatedCrash:
+                    # Injected death mid-handler: the connection drops
+                    # without a reply, exactly like the process dying —
+                    # never an ok=False Reply (the catch-all below must
+                    # not convert a simulated crash into a handled
+                    # application error, or crash-fault exploration
+                    # would silently explore nothing).
+                    return
+
+            def _serve_requests(self):
                 while True:
                     try:
                         msg = recv_msg(self.request)
@@ -152,9 +164,36 @@ class RpcServer:
                     if reply is None:
                         t0 = time.perf_counter()
                         try:
+                            # Yield point on the execute side of the
+                            # dedupe decision: a connection death lands
+                            # either before this crossing (request
+                            # never ran — the rid resubmit executes it
+                            # once) or between here and
+                            # `rpc.server.reply` (it ran, the reply is
+                            # cached — the resubmit must get the cache,
+                            # never a second execution). INSIDE the try
+                            # so a crash injected at the crossing
+                            # itself tombstones the in-flight claim
+                            # taken just above — stranding it would
+                            # hang every retry under this rid.
+                            sanitize_hooks.sched_point(
+                                "rpc.server.dispatch")
                             fn = server_self.handlers[msg.method]
                             result = fn(**(msg.kwargs or {}))
                             reply = wire.Reply(ok=True, result=result)
+                        except sanitize_hooks.SimulatedCrash as e:
+                            # Tombstone the claim before dying: the
+                            # PROCESS survived this injected death, so
+                            # its dedupe contract must too — a retry
+                            # under this rid gets a failure reply,
+                            # never a second execution (releasing the
+                            # claim instead let the client's built-in
+                            # retry double-execute the handler), and
+                            # any parked waiter wakes instead of
+                            # hanging on the in-flight event.
+                            server_self._finish_reply(rid, wire.Reply(
+                                ok=False, error=f"SimulatedCrash: {e}"))
+                            raise
                         except BaseException as e:  # noqa: BLE001
                             import traceback
 
@@ -166,6 +205,7 @@ class RpcServer:
                             msg.method, time.perf_counter() - t0,
                             ok=reply.ok)
                         server_self._finish_reply(rid, reply)
+                    sanitize_hooks.sched_point("rpc.server.reply")
                     try:
                         send_msg(self.request, reply)
                     except (ConnectionError, OSError):
@@ -561,6 +601,11 @@ class CoalescingBatcher:
             sanitize_hooks.sched_point("rpc.batcher.flush")
             try:
                 self._send_frame(batch)
+            except sanitize_hooks.SimulatedCrash:
+                # Injected death mid-frame: the flusher dies with the
+                # "process" — routing it into on_error would convert a
+                # simulated crash into a handled send failure.
+                raise
             except BaseException as e:  # noqa: BLE001 — surfaced per batch
                 if self._on_error is not None:
                     try:

@@ -14,7 +14,7 @@ per site. The sites are control-plane boundaries (a dispatch, a frame
 flush, a teardown) — not per-object hot loops — so this stays far
 below measurement noise; the A/B observability bench budget covers it.
 
-Two hooks:
+Three hooks:
 
 - ``sched_point(name)``: a named yield point. A deterministic schedule
   (``tools.raysan.sched.Schedule``) installs a callable that can park
@@ -22,19 +22,86 @@ Two hooks:
   cross. Points are crossed on every call in instrumented builds, so
   names must be stable identifiers (``"router.handoff"``, not
   per-request strings).
+- ``crash_point(name)``: a named crash-fault point at a protocol
+  boundary (the group-commit window, a frame dispatch). The bounded
+  model checker (``tools.raymc``) or a replay schedule may install a
+  hook that raises :class:`SimulatedCrash` here, modelling a process
+  dying at exactly this instant; the checking harness catches it at
+  the top of the faulted activity and performs the kill/restart. A
+  crash point doubles as a yield point for interleaving control.
 - ``ambient_set(kind, value)``: observation tap fired by the
   thread-local ambient setters in ``task_spec`` so the ambient
   sanitizer can see per-thread residue it cannot otherwise reach
   (C ``_thread._local`` storage is invisible from other threads).
   The calling thread's ident is derived here and handed to the
   installed observer as ``(kind, ident, value)``.
+
+Every product call site must use a literal name registered below in
+``SCHED_POINTS``/``CRASH_POINTS`` (raylint R8 enforces it): a typo'd
+name would silently never gate, and the registry IS the raymc point
+catalog — the checker's map of where it can seize control.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
+
+class SimulatedCrash(BaseException):
+    """An injected crash fault: the process/component notionally dies at
+    the crash point that raised this. A ``BaseException`` deliberately:
+    product recovery code that catches ``Exception`` (or routes
+    ``BaseException`` into an error *reply*) must not convert a
+    simulated death into a handled error — the fault harness alone
+    catches this, at the boundary of the activity it chose to kill."""
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at {point!r}")
+        self.point = point
+
+
+# The registered yield-point catalog. Grouped by component; the first
+# dotted segment is the point's conflict domain (raymc's partial-order
+# reduction treats crossings in different domains as independent).
+SCHED_POINTS = frozenset({
+    # serve router: the reserved→in-flight slot handoff
+    "router.handoff",
+    # memory store: object publication and wait-path snapshot
+    "store.put",
+    "store.wait",
+    # rpc batcher / pipelined channel / server dispatch
+    "rpc.batcher.add",
+    "rpc.batcher.flush",
+    "rpc.pipeline.send",
+    "rpc.pipeline.reader_edge",
+    "rpc.pipeline.reply_handled",
+    "rpc.pipeline.closed_set",
+    "rpc.server.dispatch",
+    "rpc.server.reply",
+    # worker pool execution edge
+    "workerpool.run",
+    # gcs registry writes (the group-commit frontend)
+    "gcs.put",
+    # serve long-poll membership channel
+    "longpoll.listen",
+    "longpoll.notify",
+    "longpoll.client.loop",
+    # cluster node: one coalesced submit_batch frame dispatch
+    "cluster.submit_batch",
+})
+
+CRASH_POINTS = frozenset({
+    # sqlite group commit: death before the fsync-bearing COMMIT (the
+    # window's accepted-but-undurable writes must roll back) vs. death
+    # after it but before the ack returns (they must survive).
+    "gcs.commit.before",
+    "gcs.commit.after",
+})
+
+POINTS = SCHED_POINTS | CRASH_POINTS
+
 _sched_point: Optional[Callable[[str], None]] = None
+_crash_point: Optional[Callable[[str], None]] = None
 _ambient_set: Optional[Callable[[str, int, object], None]] = None
 
 
@@ -49,6 +116,20 @@ def sched_point(name: str) -> None:
 def install_sched_point(fn: Optional[Callable[[str], None]]) -> None:
     global _sched_point
     _sched_point = fn
+
+
+def crash_point(name: str) -> None:
+    """Cross the named crash-fault point. No-op unless a fault harness
+    is installed; the installed hook may raise :class:`SimulatedCrash`
+    to kill the calling activity at exactly this boundary."""
+    hook = _crash_point
+    if hook is not None:
+        hook(name)
+
+
+def install_crash_point(fn: Optional[Callable[[str], None]]) -> None:
+    global _crash_point
+    _crash_point = fn
 
 
 def ambient_set(kind: str, value: object) -> None:

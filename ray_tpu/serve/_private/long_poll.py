@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Tuple
 
+from ray_tpu._private import sanitize_hooks
+
 
 class LongPollHost:
     """Lives inside the controller actor (thread-safe)."""
@@ -22,6 +24,11 @@ class LongPollHost:
         self._poisoned = False
 
     def notify_changed(self, key: str, snapshot: Any) -> None:
+        # Yield point: a membership broadcast racing listener arrivals
+        # and a controller kill is the convergence protocol's surface —
+        # raymc orders this crossing against parked listens and the
+        # injected controller death.
+        sanitize_hooks.sched_point("longpoll.notify")
         with self._cond:
             self._snapshots[key] = snapshot
             self._versions[key] = self._versions.get(key, 0) + 1
@@ -32,6 +39,7 @@ class LongPollHost:
         """Block until version(key) > known_version (or timeout); returns
         (version, snapshot). A poisoned host (see :meth:`shutdown`)
         answers after a token delay instead of blocking."""
+        sanitize_hooks.sched_point("longpoll.listen")
         with self._cond:
             self._cond.wait_for(
                 lambda: self._poisoned
@@ -106,6 +114,11 @@ class LongPollClient:
                                         GetTimeoutError)
 
         while not self._stopped.is_set():
+            # Loop-edge yield point: between two polls is where a
+            # controller death lands (the next listen hits a dead
+            # actor) — the crossing the checker parks to interleave a
+            # kill/restart against an in-flight poll cycle.
+            sanitize_hooks.sched_point("longpoll.client.loop")
             try:
                 ref = self._controller.listen.remote(
                     self._key, self._version)

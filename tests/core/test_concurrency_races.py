@@ -206,13 +206,17 @@ def test_router_handoff_race_found_by_seeded_exploration(
     assert found is not None, (
         "no seed in 0..7 reproduced the reverted router race")
     seed, trace = found
-    # Replay: the recorded crossing order, restricted to the points of
-    # interest (reporter/store noise crossings are timing-dependent and
-    # must not become gates). A racing seed records B's gap crossing
-    # (#2) BEFORE A's (#1) — gating that exact order forces the
-    # overtake deterministically.
-    script = [k for k in trace if k.startswith("router.buggy_gap")]
-    assert script, f"seed {seed} trace never crossed the gap: {trace}"
+    assert any(k.startswith("router.buggy_gap") for k in trace), (
+        f"seed {seed} trace never crossed the gap: {trace}")
+    # Replay: the race the sweep found means B's lock-free cap check
+    # ran inside A's handoff window. Global occurrence keys cannot
+    # always express that (when A's paused crossing RECORDS first the
+    # trace reads [#1, #2] even though B overtook), so the replay
+    # script pins each dispatcher by thread role: B (the main thread)
+    # crosses the gap first, then A — the role-qualified form raymc
+    # emits for exactly this reason.
+    script = ["router.buggy_gap@MainThread",
+              "router.buggy_gap@dispatcher-a"]
     replica = _Replica(lambda m, a, k: _pending_ref())
     router = _make_router(replica, max_concurrent=1)
     try:

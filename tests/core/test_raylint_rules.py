@@ -730,3 +730,121 @@ def test_analyze_reports_stale_only_for_rules_it_ran(tmp_path):
     report = analyze([str(f)], rules=select_rules(["R1"]),
                      root=str(tmp_path))
     assert [v.line for v in report.stale] == [2]
+
+
+# ---------------------------------------------------------------------------
+# R8 yield-point hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_r8_registered_literal_points_clean():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def handoff():
+            sanitize_hooks.sched_point("router.handoff")
+            sanitize_hooks.crash_point("gcs.commit.before")
+    """, ["R8"]))
+    assert vs == []
+
+
+def test_r8_unregistered_name_flagged():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def handoff():
+            sanitize_hooks.sched_point("router.handofff")
+    """, ["R8"]))
+    assert len(vs) == 1 and vs[0].rule == "R8"
+    assert "not in the registered point catalog" in vs[0].message
+    assert "silently never gates" in vs[0].message
+
+
+def test_r8_computed_name_flagged():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def cross(which):
+            sanitize_hooks.sched_point(f"router.{which}")
+    """, ["R8"]))
+    assert len(vs) == 1
+    assert "must be a literal string" in vs[0].message
+
+
+def test_r8_wrong_hook_kind_gets_a_hint():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def commit():
+            sanitize_hooks.sched_point("gcs.commit.before")
+    """, ["R8"]))
+    assert len(vs) == 1
+    assert "wrong hook?" in vs[0].message
+
+
+def test_r8_bare_imported_name_form_is_checked():
+    vs = active(lint("""
+        from ray_tpu._private.sanitize_hooks import sched_point
+
+
+        def cross():
+            sched_point("totally.bogus")
+    """, ["R8"]))
+    assert len(vs) == 1 and "not in the registered" in vs[0].message
+
+
+def test_r8_missing_argument_flagged():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def cross():
+            sanitize_hooks.sched_point()
+    """, ["R8"]))
+    assert len(vs) == 1 and "without a point name" in vs[0].message
+
+
+def test_r8_tools_and_tests_exempt():
+    # The scheduler side of the seam crosses synthetic/test-local
+    # names by design (mc.start.*, router.buggy_gap) — only ray_tpu
+    # product files are held to the registry.
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def drive(role):
+            sanitize_hooks.sched_point(f"mc.start.{role}")
+            sanitize_hooks.sched_point("router.buggy_gap")
+    """, ["R8"], module="tools.raymc.fixture",
+        relpath="tools/raymc/fixture.py"))
+    assert vs == []
+
+
+def test_r8_suppression_with_justification_honored():
+    vs = lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def cross():
+            sanitize_hooks.sched_point("experimental.point")  # raylint: disable=R8 -- staged rollout: registered in the next PR alongside its raymc scenario
+    """, ["R8"])
+    assert all(v.suppressed for v in vs if v.rule == "R8")
+
+
+def test_r8_aliased_imports_still_checked():
+    # `as` renames must not smuggle a typo'd point past the rule.
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks as sh
+        from ray_tpu._private.sanitize_hooks import sched_point as sp
+
+
+        def cross():
+            sh.sched_point("router.handofff")
+            sp("also.bogus")
+    """, ["R8"]))
+    assert len(vs) == 2, vs
+    assert all("not in the registered" in v.message for v in vs)

@@ -208,3 +208,198 @@ def test_completed_stays_false_when_gate_never_crossed():
     t.join(2.0)
     assert not t.is_alive()
     assert not sched2.completed
+
+
+# -- role-qualified entries, crash injection, diagnostics (raymc seams) ------
+
+
+def test_role_qualified_entries_pin_threads_not_occurrences():
+    """Two same-named crossings by different threads: @role entries
+    order them by WHO crosses, which global occurrence keys cannot do
+    when arrival order is the thing under test."""
+    log = []
+    sched = Schedule(order=["sym.point@second", "sym.point@first"],
+                     timeout_s=3.0)
+
+    def body(tag):
+        def run():
+            sched.cross("sym.point")
+            log.append(tag)
+        return run
+
+    first = threading.Thread(target=body("first"), name="first")
+    second = threading.Thread(target=body("second"), name="second")
+    with sched:
+        first.start()
+        # `first` must park even though it arrives first (global occ 1
+        # would have let it through) — its @role entry is second.
+        deadline = time.monotonic() + 2.0
+        while not sched.parked_at("sym.point"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        second.start()
+        first.join(3.0)
+        second.join(3.0)
+    assert log == ["second", "first"]
+    assert sched.completed
+
+
+def test_role_qualified_occurrence_suffix():
+    log = []
+    sched = Schedule(order=["other.point", "loop.edge@worker#2"],
+                     timeout_s=3.0)
+
+    def worker():
+        sched.cross("loop.edge")   # occ 1: unlisted → passes freely
+        log.append(1)
+        sched.cross("loop.edge")   # @worker#2 gates THIS crossing
+        log.append(2)
+
+    t = threading.Thread(target=worker, name="worker")
+    with sched:
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not sched.parked_at("loop.edge"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert log == [1], "worker should be parked at its 2nd crossing"
+        sched.cross("other.point")
+        t.join(3.0)
+    assert log == [1, 2]
+    assert sched.completed
+
+
+def test_crash_at_raises_simulated_crash_after_gating():
+    """crash_at kills the matching crossing AFTER it is recorded and
+    its gate marked done — the raymc counterexample replay contract."""
+    crashes = []
+
+    sched = Schedule(order=["boom.point"], crash_at=["boom.point"],
+                     timeout_s=3.0)
+
+    def body():
+        try:
+            sanitize_hooks.sched_point("boom.point")
+        except sanitize_hooks.SimulatedCrash as e:
+            crashes.append(e.point)
+
+    with sched:
+        _spawn(body)
+    assert crashes == ["boom.point"]
+    assert sched.completed, "the crashed crossing still counts"
+    assert [k for k, _ in sched.trace] == ["boom.point#1"]
+
+
+def test_crash_at_fires_once_per_entry():
+    crashes = []
+
+    sched = Schedule(crash_at=["re.point"], timeout_s=3.0)
+
+    def body():
+        for _ in range(3):
+            try:
+                sanitize_hooks.sched_point("re.point")
+            except sanitize_hooks.SimulatedCrash:
+                crashes.append(1)
+
+    with sched:
+        _spawn(body)
+    assert crashes == [1], "a crash entry is a single death, not a curse"
+
+
+def test_crash_point_hook_is_gated_and_crashable():
+    """Product crash_point() crossings route through the installed
+    schedule exactly like sched_point() ones."""
+    order = []
+
+    sched = Schedule(order=["gate.open", "gcs.commit.before"],
+                     crash_at=["gcs.commit.before"], timeout_s=3.0)
+
+    def faulty():
+        try:
+            sanitize_hooks.crash_point("gcs.commit.before")
+            order.append("survived")
+        except sanitize_hooks.SimulatedCrash:
+            order.append("crashed")
+
+    t = threading.Thread(target=faulty, name="faulty")
+    with sched:
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not sched.parked_at("gcs.commit.before"):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        sched.cross("gate.open")
+        t.join(3.0)
+    assert order == ["crashed"]
+    assert sched.completed
+
+
+def test_timeout_diagnostic_names_last_crossed_point():
+    sched = Schedule(order=["a.step", "never.happens", "b.step"],
+                     timeout_s=0.3)
+    with sched:
+        sched.cross("a.step")
+        with pytest.raises(ScheduleTimeout) as e:
+            sched.cross("b.step")
+    msg = str(e.value)
+    assert "last successfully crossed point" in msg
+    assert "a.step#1" in msg, msg
+    assert "never.happens" in msg
+
+
+def test_timeout_diagnostic_when_nothing_crossed():
+    sched = Schedule(order=["never.happens", "b.step"], timeout_s=0.2)
+    with sched:
+        with pytest.raises(ScheduleTimeout) as e:
+            sched.cross("b.step")
+    assert "no point was ever crossed" in str(e.value)
+
+
+def test_on_cross_seam_observes_every_crossing():
+    seen = []
+    sched = Schedule(on_cross=lambda key, role: seen.append((key, role)))
+
+    def body():
+        sanitize_hooks.sched_point("x.one")
+        sanitize_hooks.sched_point("x.one")
+
+    t = threading.Thread(target=body, name="observer-target")
+    with sched:
+        t.start()
+        t.join(3.0)
+    assert seen == [("x.one#1", "observer-target"),
+                    ("x.one#2", "observer-target")]
+
+
+def test_crash_at_server_dispatch_tombstones_the_dedupe_claim():
+    """A crash injected at the rpc.server.dispatch crossing itself
+    (after the in-flight dedupe claim is taken) must tombstone the
+    claim: the connection dies, and a retry under the same rid gets a
+    SimulatedCrash failure reply promptly — never a hang on the
+    stranded event, never a second execution."""
+    from ray_tpu._private.rpc import (RemoteCallError, RpcClient,
+                                      RpcServer)
+
+    calls = []
+    server = RpcServer({"apply": lambda **kw: calls.append(1)},
+                       dedupe_methods=frozenset({"apply"}))
+    sched = Schedule(crash_at=["rpc.server.dispatch"], timeout_s=3.0)
+    try:
+        with sched:
+            client = RpcClient.dedicated(server.address)
+            t0 = time.monotonic()
+            try:
+                client.call("apply")
+                raise AssertionError(
+                    "call succeeded through a simulated crash")
+            except RemoteCallError as e:
+                assert "SimulatedCrash" in str(e), e
+            except (ConnectionError, OSError):
+                pass  # retry raced the teardown window: also a death
+            assert time.monotonic() - t0 < 3.0, "retry hung"
+        assert calls == [], (
+            "the crash fired BEFORE dispatch; the handler must not "
+            "have run")
+    finally:
+        server.shutdown()

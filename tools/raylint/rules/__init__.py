@@ -12,6 +12,7 @@ from tools.raylint.rules.r4_lifecycle import ResourceLifecycleRule
 from tools.raylint.rules.r5_wire_hygiene import WireHygieneRule
 from tools.raylint.rules.r6_hygiene import HygieneRule
 from tools.raylint.rules.r7_ambient import AmbientStateRule
+from tools.raylint.rules.r8_yield_points import YieldPointHygieneRule
 
 _RULE_CLASSES = (
     AsyncBlockingRule,
@@ -21,6 +22,7 @@ _RULE_CLASSES = (
     WireHygieneRule,
     HygieneRule,
     AmbientStateRule,
+    YieldPointHygieneRule,
 )
 
 

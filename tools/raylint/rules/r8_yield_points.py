@@ -1,0 +1,137 @@
+"""R8 yield-point hygiene: product crossings use registered literals.
+
+``sanitize_hooks.sched_point`` / ``crash_point`` call sites in product
+code are the contract surface three tools share: raysan schedules gate
+them, raymc's explorer seizes them, and the catalog in
+``sanitize_hooks.SCHED_POINTS``/``CRASH_POINTS`` is how those tools
+know what exists. A typo'd or unregistered name silently never gates —
+the schedule that should have caught a regression just passes through —
+and a dynamically-built name can't be gated deterministically at all.
+
+So, for every call site inside ``ray_tpu/``:
+
+- the point name must be a LITERAL string (no f-strings, no variables);
+- the literal must be registered in the catalog, in the set matching
+  the call (``sched_point`` ↔ ``SCHED_POINTS``, ``crash_point`` ↔
+  ``CRASH_POINTS``).
+
+Tooling and tests are exempt (they're the scheduler, not the
+scheduled): the rule only fires on files under the ``ray_tpu``
+package. The defining module itself is exempt too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.raylint.core import FileInfo, Rule
+
+_HOOK_FNS = ("sched_point", "crash_point")
+
+
+def _default_catalogs():
+    from ray_tpu._private.sanitize_hooks import CRASH_POINTS, SCHED_POINTS
+
+    return {"sched_point": frozenset(SCHED_POINTS),
+            "crash_point": frozenset(CRASH_POINTS)}
+
+
+class YieldPointHygieneRule(Rule):
+    id = "R8"
+    name = "yield-point-hygiene"
+    description = ("sanitize_hooks crossings must use literal, "
+                   "registered point names")
+
+    def __init__(self, catalogs: Optional[dict] = None):
+        # Injectable for fixture tests; defaults to the live registry
+        # so the rule can never drift from the code.
+        self._catalogs = catalogs
+
+    def _catalog(self, fn: str) -> frozenset:
+        if self._catalogs is None:
+            self._catalogs = _default_catalogs()
+        return self._catalogs.get(fn, frozenset())
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        if fi.package is None:
+            return  # tooling/tests: the scheduler side of the seam
+        if fi.relpath.endswith("_private/sanitize_hooks.py"):
+            return  # the registry itself
+        module_aliases, fn_aliases = self._import_aliases(fi)
+        for node in fi.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            fn_name = self._hook_call_name(node.func, module_aliases,
+                                           fn_aliases)
+            if fn_name is None:
+                continue
+            if not node.args:
+                yield (node.lineno,
+                       f"`{fn_name}()` called without a point name")
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield (node.lineno,
+                       f"`{fn_name}` point name must be a literal "
+                       f"string (a computed name cannot be gated "
+                       f"deterministically or registered)")
+                continue
+            if arg.value not in self._catalog(fn_name):
+                other = [f for f in _HOOK_FNS if f != fn_name][0]
+                hint = ""
+                if arg.value in self._catalog(other):
+                    hint = (f" (it is registered for `{other}` — "
+                            f"wrong hook?)")
+                yield (node.lineno,
+                       f"`{fn_name}({arg.value!r})` is not in the "
+                       f"registered point catalog "
+                       f"(sanitize_hooks.{'SCHED' if fn_name == 'sched_point' else 'CRASH'}"
+                       f"_POINTS){hint} — a typo'd name silently "
+                       f"never gates")
+
+    @staticmethod
+    def _import_aliases(fi: FileInfo):
+        """Names this file binds to the sanitize_hooks module (incl.
+        `as` renames) and to the hook functions themselves — an aliased
+        import must not smuggle a typo'd point past the rule."""
+        module_aliases = {"sanitize_hooks"}
+        fn_aliases = {}
+        for node in fi.nodes():
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith("sanitize_hooks"):
+                        module_aliases.add(
+                            alias.asname or alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    if alias.name == "sanitize_hooks":
+                        module_aliases.add(alias.asname or alias.name)
+                    elif mod.endswith("sanitize_hooks") \
+                            and alias.name in _HOOK_FNS:
+                        fn_aliases[alias.asname or alias.name] = \
+                            alias.name
+        return module_aliases, fn_aliases
+
+    @staticmethod
+    def _hook_call_name(func, module_aliases,
+                        fn_aliases) -> Optional[str]:
+        """"<sanitize_hooks-alias>.sched_point" / bare (possibly
+        renamed) imported-name call shapes; None for anything else."""
+        if isinstance(func, ast.Attribute) and func.attr in _HOOK_FNS:
+            root = func.value
+            if isinstance(root, ast.Name) and root.id in module_aliases:
+                return func.attr
+            # dotted module path ray_tpu._private.sanitize_hooks.X
+            if isinstance(root, ast.Attribute) \
+                    and root.attr == "sanitize_hooks":
+                return func.attr
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in fn_aliases:
+                return fn_aliases[func.id]
+            if func.id in _HOOK_FNS:
+                return func.id
+        return None

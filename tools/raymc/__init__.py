@@ -1,0 +1,23 @@
+"""raymc: bounded model checking for ray_tpu's distributed protocols.
+
+The third leg of the analysis ladder — raylint (static structure),
+raysan (one schedule at a time, replayed), raymc (ALL schedules within
+a bound, discovered): drive real product code through its
+``sanitize_hooks`` yield points, systematically exploring thread
+interleavings and crash-fault placements, checking declarative
+``Invariant``/``Liveness`` properties at every state, and shrinking any
+violation to a minimized counterexample that replays deterministically
+as a ``tools.raysan.sched.Schedule`` script.
+"""
+
+from tools.raymc.checker import CheckResult, check  # noqa: F401
+from tools.raymc.explorer import (Decision, Execution,  # noqa: F401
+                                  ExecutionResult, ExplorerConfig)
+from tools.raymc.minimize import (build_counterexample,  # noqa: F401
+                                  minimize_decisions,
+                                  script_from_result)
+from tools.raymc.props import (Counterexample, Finding,  # noqa: F401
+                               Invariant, Liveness)
+from tools.raymc.scenario import Scenario  # noqa: F401
+from tools.raymc.scenarios import (DEFAULT_SCENARIOS,  # noqa: F401
+                                   SCENARIOS)

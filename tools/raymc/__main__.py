@@ -1,0 +1,118 @@
+"""CLI: ``python -m tools.raymc [--scenario a,b] [--report json] ...``
+
+Runs the named scenarios' bounded model checks and reports findings —
+the form CI archives as ``RAYMC_REPORT.json``.
+
+Exit-code contract (raylint's):
+  0  every property held over the explored schedule/crash space
+  1  at least one violation (or harness-detected wedge) was found
+  2  usage error (unknown scenario, bad arguments)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.raymc",
+        description="bounded model checker for ray_tpu protocol "
+                    "invariants")
+    parser.add_argument(
+        "--scenario", default="", metavar="LIST",
+        help="comma-separated scenario names (default: the bounded "
+             "tier-1 set; 'all' for every registered scenario)")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered scenarios and exit")
+    parser.add_argument("--report", choices=("json", "pretty"),
+                        default="pretty")
+    parser.add_argument("--report-file", default="", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--max-schedules", type=int, default=400,
+                        help="per-scenario execution budget")
+    parser.add_argument("--max-steps", type=int, default=0,
+                        help="override scenarios' per-execution "
+                             "decision bound (0 = scenario default)")
+    parser.add_argument("--time-budget-s", type=float, default=45.0,
+                        help="per-scenario wall-clock budget")
+    parser.add_argument("--no-dpor", action="store_true",
+                        help="disable sleep-set pruning (debugging "
+                             "the reduction itself)")
+    parser.add_argument("--no-minimize", action="store_true",
+                        help="emit raw, unminimized counterexamples")
+    args = parser.parse_args(argv)
+
+    from tools.raymc.explorer import ExplorerConfig
+    from tools.raymc.scenarios import DEFAULT_SCENARIOS, SCENARIOS
+
+    if args.list:
+        for name, cls in sorted(SCENARIOS.items()):
+            print(f"{name:20s} {cls.description}")
+        return 0
+
+    if args.scenario.strip() == "all":
+        names = sorted(SCENARIOS)
+    elif args.scenario.strip():
+        names = [n.strip() for n in args.scenario.split(",")
+                 if n.strip()]
+    else:
+        names = list(DEFAULT_SCENARIOS)
+    for name in names:
+        if name not in SCENARIOS:
+            print(f"raymc: unknown scenario {name!r}; known: "
+                  f"{', '.join(sorted(SCENARIOS))}", file=sys.stderr)
+            return 2
+
+    if any(SCENARIOS[n].needs_ray for n in names):
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=4)
+
+    from tools.raymc.checker import check
+
+    cfg = ExplorerConfig(
+        max_schedules=args.max_schedules,
+        max_steps=args.max_steps,
+        time_budget_s=args.time_budget_s,
+        dpor=not args.no_dpor,
+        minimize=not args.no_minimize)
+
+    results = []
+    for name in names:
+        results.append(check(SCENARIOS[name], cfg))
+
+    report = {
+        "schema_version": 1,
+        "harness": "python -m tools.raymc",
+        "scenarios": [r.to_dict() for r in results],
+        "pass": all(r.ok for r in results),
+    }
+    if args.report == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for r in results:
+            status = "EXHAUSTIVE" if r.exhausted else "bounded"
+            verdict = "ok" if r.ok else \
+                f"{len(r.findings)} FINDING(S)"
+            print(f"raymc[{r.scenario}]: {verdict} — "
+                  f"{r.executions} schedules ({status}), "
+                  f"{r.steps_total} decisions, {r.pruned} pruned, "
+                  f"{r.elapsed_s:.2f}s")
+            for f in r.findings:
+                print("  " + f.render().replace("\n", "\n  "))
+    if args.report_file:
+        try:
+            with open(args.report_file, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=2)
+        except OSError as e:
+            print(f"raymc: could not write report file "
+                  f"{args.report_file}: {e}", file=sys.stderr)
+
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,560 @@
+"""raymc explorer: stateless bounded model checking over real threads.
+
+The approach is CHESS-style systematic concurrency testing: one
+execution at a time, the scenario's threads run REAL product code but
+are serialized at ``sanitize_hooks`` yield points — every controlled
+thread is either parked at a point, provably finished, or (after a
+grace window) blocked on real synchronization. At each quiescent state
+the explorer evaluates the scenario's invariants, then picks ONE parked
+crossing to proceed (optionally injecting a :class:`SimulatedCrash` at
+a crash-capable point), records the decision, and waits for the system
+to quiesce again.
+
+Exploration is stateless DFS over decision prefixes: the first
+execution runs under a deterministic default policy; every step's
+unchosen enabled crossings become backtrack prefixes (replayed
+decision-for-decision, then default policy again). Sleep sets prune
+commuting reorderings: an alternative independent of everything
+explored from the same state is skipped (independence = different
+thread AND different conflict domains per ``Scenario.conflict_key``,
+never across a crash decision) — the classic partial-order reduction,
+applied with a deliberately conservative relation.
+
+An execution ends when every action thread finished (internal runtime
+threads the scenario adopted mid-run are then released to free-run),
+when an invariant breaks, when the step bound trips (the tail
+free-runs; the check is marked non-exhaustive), or when nothing can
+move (deadlock — itself a finding). A drained DFS stack with no
+truncations/divergences means the property held over EVERY bounded
+interleaving and crash placement: ``CheckResult.exhausted``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import sanitize_hooks
+
+from tools.raymc.scenario import DONE_POINT_PREFIX, Scenario
+
+# Thread states.
+RUNNING = "running"    # granted (or just started): owns the CPU turn
+PARKED = "parked"      # waiting at a yield-point gate for a grant
+BLOCKED = "blocked"    # ran past the grace window without parking:
+#                        stuck on real synchronization; re-enters via
+#                        its next crossing or termination
+DONE = "done"
+
+
+class Decision(tuple):
+    """One scheduling choice: (role, point, role_occ, crash)."""
+
+    __slots__ = ()
+
+    def __new__(cls, role: str, point: str, role_occ: int, crash: bool):
+        return super().__new__(cls, (role, point, role_occ, crash))
+
+    role = property(lambda self: self[0])
+    point = property(lambda self: self[1])
+    role_occ = property(lambda self: self[2])
+    crash = property(lambda self: self[3])
+
+    def to_dict(self) -> dict:
+        return {"role": self[0], "point": self[1],
+                "occurrence": self[2], "crash": self[3]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Decision":
+        return cls(data["role"], data["point"], data["occurrence"],
+                   data["crash"])
+
+    def render(self) -> str:
+        tag = " CRASH" if self[3] else ""
+        return f"{self[1]}@{self[0]}#{self[2]}{tag}"
+
+
+@dataclasses.dataclass
+class _Cross:
+    """A completed (granted) crossing, in completion order.
+
+    ``order_key`` is the crossing's position in the replay script's
+    timeline: normally the grant event (segments begin at grants), but
+    a done gate carries no post-segment — its only meaning is "this
+    thread's final segment has completed", which happened at its
+    ARRIVAL — so done gates are keyed by arrival instead. That is what
+    lets an emitted script order e.g. a writer's post-put bookkeeping
+    STRICTLY before a committer's snapshot (the committer's next entry
+    gates behind the done crossing)."""
+
+    point: str
+    role: str
+    global_occ: int
+    role_occ: int
+    crashed: bool
+    action_role: bool
+    order_key: int = 0
+
+
+@dataclasses.dataclass
+class _Step:
+    chosen: Decision
+    enabled: Tuple[Decision, ...]
+
+
+class _ThreadInfo:
+    __slots__ = ("role", "state", "action_role", "granted_at",
+                 "parked", "grant", "arrival_seq")
+
+    def __init__(self, role: str, action_role: bool):
+        self.role = role
+        self.action_role = action_role
+        self.state = RUNNING
+        self.granted_at = time.monotonic()
+        self.parked: Optional[Tuple[str, int, int]] = None  # point, gocc, rocc
+        self.grant: Optional[Decision] = None
+        self.arrival_seq = 0
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    status: str                      # ok|violation|deadlock|divergence|timeout
+    steps: List[_Step]
+    crossings: List[_Cross]
+    pending: List[_Cross]            # parked at end, reverse-arrival order
+    violations: List[str]            # "prop: detail"
+    truncated: bool = False
+    errors: List[str] = dataclasses.field(default_factory=list)
+    sleep_leaves: int = 0
+
+
+class ExplorerConfig:
+    def __init__(self, max_schedules: int = 500, max_steps: int = 0,
+                 time_budget_s: float = 60.0, crash_budget: int = -1,
+                 dpor: bool = True, minimize: bool = True,
+                 verify_replays: bool = True,
+                 exec_timeout_s: float = 15.0,
+                 settle_s: float = 0.008,
+                 stop_on_first: bool = True):
+        self.max_schedules = max_schedules
+        self.max_steps = max_steps          # 0 = scenario's own bound
+        self.time_budget_s = time_budget_s
+        self.crash_budget = crash_budget    # -1 = scenario's own budget
+        self.dpor = dpor
+        self.minimize = minimize
+        self.verify_replays = verify_replays
+        self.exec_timeout_s = exec_timeout_s
+        self.settle_s = settle_s
+        self.stop_on_first = stop_on_first
+
+
+class Execution:
+    """One bounded execution of a scenario under a decision prefix."""
+
+    def __init__(self, scenario: Scenario, prefix: List[Decision],
+                 cfg: ExplorerConfig, sleep=frozenset()):
+        self.scn = scenario
+        self.prefix = prefix
+        self.cfg = cfg
+        # Sleep set at the state the prefix reaches: transitions whose
+        # subtrees are fully explored elsewhere. The default policy
+        # must never CHOOSE one (classic sleep-set blocking) — doing so
+        # would re-explore a covered subtree and report zero pruning.
+        self._sleep = set(sleep)
+        self.sleep_leaves = 0
+        self.max_steps = cfg.max_steps or scenario.max_steps
+        self.crash_budget = scenario.crash_budget \
+            if cfg.crash_budget < 0 else cfg.crash_budget
+        self.grace = scenario.block_grace_s
+        self._points = set(scenario.points)
+        self._crash_points = set(scenario.crash_points)
+        self._lock = threading.Condition()
+        self._threads: Dict[int, _ThreadInfo] = {}
+        self._action_roles: set = set()
+        self._adopt_counts: Dict[str, int] = {}
+        self._gcounts: Dict[str, int] = {}
+        self._rcounts: Dict[Tuple[str, str], int] = {}
+        self._released = False
+        self._arrivals = 0
+        self._crashes_used = 0
+        self._crossings: List[_Cross] = []
+        self._steps: List[_Step] = []
+        self._errors: List[str] = []
+        self._action_threads: List[threading.Thread] = []
+        self._last_grant: Dict[str, int] = {}
+        self._truncated = False
+        self._controller_ident: Optional[int] = None
+
+    # -- the installed yield/crash hook ------------------------------------
+
+    def _relevant(self, name: str) -> bool:
+        # Any "mc."-prefixed point is scenario-harness territory and
+        # always gated: the start/done brackets, env crash points, and
+        # ad-hoc sync gates scenarios add to pin lock handoffs that
+        # would otherwise be sub-yield-point nondeterminism.
+        return (name in self._points or name in self._crash_points
+                or name.startswith("mc."))
+
+    def _hook(self, name: str) -> None:
+        if not self._relevant(name):
+            return
+        ident = threading.get_ident()
+        if ident == self._controller_ident:
+            # The controller must never gate itself (an invariant
+            # predicate or scenario bookkeeping touching product code
+            # would deadlock the whole execution).
+            return
+        with self._lock:
+            if self._released:
+                return
+            info = self._threads.get(ident)
+            if info is None or info.state is DONE:
+                # DONE + crossing again = the OS reused a finished
+                # thread's ident for a fresh runtime-internal thread;
+                # resurrecting the dead record would corrupt both
+                # threads' scheduling state.
+                info = self._adopt(ident)
+            gocc = self._gcounts.get(name, 0) + 1
+            self._gcounts[name] = gocc
+            rocc = self._rcounts.get((name, info.role), 0) + 1
+            self._rcounts[(name, info.role)] = rocc
+            self._arrivals += 1
+            arrival = self._arrivals
+            info.parked = (name, gocc, rocc)
+            info.arrival_seq = arrival
+            info.state = PARKED
+            self._lock.notify_all()
+            while info.grant is None and not self._released:
+                self._lock.wait(0.2)
+            grant = info.grant
+            info.grant = None
+            info.parked = None
+            if info.state is not DONE:
+                info.state = RUNNING
+                info.granted_at = time.monotonic()
+            crashed = bool(grant is not None and grant.crash)
+            if grant is not None:
+                # Released-without-grant passes (teardown free-run) are
+                # not part of the explored interleaving: keep them out
+                # of the crossing log the replay script is built from.
+                self._arrivals += 1  # the grant is a timeline event too
+                key = arrival if name.startswith(DONE_POINT_PREFIX) \
+                    else self._arrivals
+                self._crossings.append(_Cross(
+                    name, info.role, gocc, rocc, crashed,
+                    info.role in self._action_roles, order_key=key))
+            self._lock.notify_all()
+        try:
+            self.scn.on_point(name, info.role)
+        except Exception as e:
+            self._errors.append(f"on_point({name}) raised: {e!r}")
+        if crashed:
+            raise sanitize_hooks.SimulatedCrash(name)
+
+    def _adopt(self, ident: int) -> _ThreadInfo:
+        """A runtime-internal thread (pipelined reader, batcher
+        flusher) crossed a relevant point: bring it under scheduling
+        control with a run-stable role name (thread names carry ports
+        and ids — strip digits, count per base)."""
+        tname = threading.current_thread().name
+        base = re.sub(r"[\s(].*$", "", tname)
+        base = re.sub(r"[-_]?\d+$", "", base) or "thread"
+        n = self._adopt_counts.get(base, 0)
+        self._adopt_counts[base] = n + 1
+        role = base if n == 0 else f"{base}~{n}"
+        info = _ThreadInfo(role, action_role=False)
+        self._threads[ident] = info
+        return info
+
+    # -- controlled action threads -----------------------------------------
+
+    def _wrap(self, role: str, fn) -> threading.Thread:
+        def run():
+            ident = threading.get_ident()
+            with self._lock:
+                info = _ThreadInfo(role, action_role=True)
+                self._threads[ident] = info
+            crashed = False
+            try:
+                self._hook(self.scn.start_point(role))
+                fn()
+            except sanitize_hooks.SimulatedCrash as e:
+                crashed = True
+                try:
+                    self.scn.on_crash(e.point)
+                except Exception as e2:
+                    self._errors.append(
+                        f"on_crash({e.point}) raised: {e2!r}")
+            except Exception as e:
+                self._errors.append(f"action {role!r} raised: {e!r}")
+            try:
+                if not crashed:
+                    # The done gate: keeps the segment after this
+                    # action's last product crossing schedulable (and
+                    # so replayable — see scenario.py).
+                    self._hook(self.scn.done_point(role))
+            finally:
+                with self._lock:
+                    info.state = DONE
+                    info.parked = None
+                    self._lock.notify_all()
+        return threading.Thread(target=run, name=f"mc-{role}",
+                                daemon=True)
+
+    # -- controller --------------------------------------------------------
+
+    def run(self) -> ExecutionResult:
+        self._controller_ident = threading.get_ident()
+        prev_sched = sanitize_hooks._sched_point
+        prev_crash = sanitize_hooks._crash_point
+        # Setup runs BEFORE the hooks go in: it is "before time zero",
+        # and its crossings (initial broadcasts, warmup writes) are not
+        # part of the explored interleaving. Runtime-internal threads
+        # it spawns get adopted at their first post-install crossing.
+        self.scn.setup()
+        sanitize_hooks.install_sched_point(self._hook)
+        sanitize_hooks.install_crash_point(self._hook)
+        try:
+            for role, fn in self.scn.actions():
+                self._action_roles.add(role)
+                self._action_threads.append(self._wrap(role, fn))
+            for t in self._action_threads:
+                t.start()
+            status, violations = self._control_loop()
+            pending = self._pending_snapshot()
+            self._release_all()
+            for t in self._action_threads:
+                t.join(3.0)
+            if any(t.is_alive() for t in self._action_threads):
+                status = "timeout"
+                self._errors.append("action threads outlived release")
+            if status == "ok":
+                # End-state pass: invariants again (the last transition
+                # may have broken one) plus bounded liveness.
+                violations = self.scn.violations(include_liveness=True)
+                if violations:
+                    status = "violation"
+            return ExecutionResult(
+                status=status, steps=self._steps,
+                crossings=self._crossings, pending=pending,
+                violations=violations, truncated=self._truncated,
+                errors=self._errors, sleep_leaves=self.sleep_leaves)
+        finally:
+            sanitize_hooks.install_sched_point(prev_sched)
+            sanitize_hooks.install_crash_point(prev_crash)
+            try:
+                self.scn.teardown()
+            except Exception as e:
+                self._errors.append(f"teardown raised: {e!r}")
+
+    def _control_loop(self) -> Tuple[str, List[str]]:
+        deadline = time.monotonic() + self.cfg.exec_timeout_s
+        step = 0
+        while True:
+            if not self._wait_quiescent(deadline):
+                return "timeout", []
+            violations = self.scn.violations(include_liveness=False)
+            if violations:
+                return "violation", violations
+            with self._lock:
+                actions_live = any(
+                    i.action_role and i.state is not DONE
+                    for i in self._threads.values())
+                parked = self._parked_infos()
+            if not actions_live:
+                return "ok", []
+            if not parked:
+                # Everything live is blocked on real synchronization.
+                # Give timed product waits a chance to expire, then
+                # call it a deadlock (which IS a finding).
+                if self._wait_for_park(deadline):
+                    continue
+                return "deadlock", []
+            if step >= self.max_steps:
+                self._truncated = True
+                return "ok", []
+            decision, diverged = self._decide(step, parked, deadline)
+            if diverged:
+                return "divergence", []
+            if decision is None:
+                # Every enabled transition is asleep: this whole
+                # subtree is covered by branches explored earlier.
+                # Free-run the tail (the end state is still real and
+                # still checked) and stop branching here.
+                self.sleep_leaves += 1
+                return "ok", []
+            if step >= len(self.prefix):
+                self._sleep = {t for t in self._sleep
+                               if self._indep(t, decision)}
+            self._grant(decision)
+            step += 1
+
+    def _indep(self, a, b) -> bool:
+        """Same doubt-answers-dependent guard as checker._independent:
+        a scenario's overridden relation raising must degrade pruning,
+        never kill the execution."""
+        try:
+            return bool(self.scn.independent(a, b))
+        except Exception:
+            return False
+
+    def _parked_infos(self) -> List[_ThreadInfo]:
+        return sorted(
+            (i for i in self._threads.values()
+             if i.state is PARKED and i.grant is None),
+            key=lambda i: (i.role, i.parked[0]))
+
+    def _wait_quiescent(self, deadline: float) -> bool:
+        """Until no controlled thread is RUNNING: each either parks,
+        finishes, or exceeds the grace window (→ BLOCKED). A short
+        settle window after that catches blocked threads that were
+        woken by the last grant and are about to park."""
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                if now > deadline:
+                    return False
+                running = [i for i in self._threads.values()
+                           if i.state is RUNNING]
+                if running:
+                    horizon = min(i.granted_at + self.grace
+                                  for i in running)
+                    if now >= horizon:
+                        for i in running:
+                            if now - i.granted_at >= self.grace:
+                                i.state = BLOCKED
+                        continue
+                    self._lock.wait(min(horizon - now, 0.05))
+                    continue
+                if not any(i.state is BLOCKED
+                           for i in self._threads.values()):
+                    return True
+                # Settle: woken-but-unparked threads surface here.
+                before = self._arrivals
+                self._lock.wait(self.cfg.settle_s)
+                if self._arrivals == before and not any(
+                        i.state is RUNNING
+                        for i in self._threads.values()):
+                    return True
+
+    def _wait_for_park(self, deadline: float,
+                       max_wait_s: float = 3.5) -> bool:
+        """All live threads look blocked: wait (bounded) for any of
+        them to reach a gate or finish — timed product waits (condvar
+        timeouts, bounded gets) legitimately take a moment."""
+        end = min(deadline, time.monotonic() + max_wait_s)
+        with self._lock:
+            while time.monotonic() < end:
+                if self._parked_infos():
+                    return True
+                if not any(i.action_role and i.state is not DONE
+                           for i in self._threads.values()):
+                    return True
+                self._lock.wait(0.05)
+        return False
+
+    def _decide(self, step: int, parked: List[_ThreadInfo],
+                deadline: float):
+        """The decision (from the replay prefix, or default policy)
+        plus the enabled-set snapshot for DFS backtracking."""
+        if step < len(self.prefix):
+            want = self.prefix[step]
+            info = self._await_crossing(want, deadline)
+            if info is None:
+                return None, True
+            with self._lock:
+                parked = self._parked_infos()
+            enabled = self._enabled(parked)
+            chosen = Decision(want.role, want.point, want.role_occ,
+                              want.crash)
+            self._steps.append(_Step(chosen, enabled))
+            return chosen, False
+        enabled = self._enabled(parked)
+        awake = parked
+        if self.cfg.dpor and self._sleep:
+            awake = [
+                i for i in parked
+                if Decision(i.role, i.parked[0], i.parked[2], False)
+                not in self._sleep]
+            if not awake:
+                return None, False  # sleep leaf: subtree covered
+        # Default policy: deterministic round-robin fairness — the
+        # least-recently-granted role first (action roles before
+        # adopted ones on ties) so free-looping internal threads can't
+        # starve the actions driving the execution forward.
+        info = min(awake, key=self._fairness_key)
+        point, _gocc, rocc = info.parked
+        chosen = Decision(info.role, point, rocc, False)
+        self._steps.append(_Step(chosen, enabled))
+        return chosen, False
+
+    def _fairness_key(self, info: _ThreadInfo):
+        return (self._last_grant.get(info.role, -1),
+                not info.action_role, info.role)
+
+    def _enabled(self, parked: List[_ThreadInfo]) -> Tuple[Decision, ...]:
+        out = []
+        for i in parked:
+            point, _gocc, rocc = i.parked
+            out.append(Decision(i.role, point, rocc, False))
+            if point in self._crash_points \
+                    and self._crashes_used < self.crash_budget:
+                out.append(Decision(i.role, point, rocc, True))
+        return tuple(out)
+
+    def _await_crossing(self, want: Decision,
+                        deadline: float) -> Optional[_ThreadInfo]:
+        """Wait for the prefix-decided crossing to be parked (its
+        thread may still be running or mid-wake)."""
+        end = min(deadline, time.monotonic() + 3.0)
+        with self._lock:
+            while time.monotonic() < end:
+                for i in self._threads.values():
+                    if i.state is PARKED and i.grant is None \
+                            and i.role == want.role \
+                            and i.parked[0] == want.point \
+                            and i.parked[2] == want.role_occ:
+                        return i
+                self._lock.wait(0.05)
+        return None
+
+    def _grant(self, decision: Decision) -> None:
+        with self._lock:
+            for i in self._threads.values():
+                if i.state is PARKED and i.grant is None \
+                        and i.role == decision.role \
+                        and i.parked[0] == decision.point \
+                        and i.parked[2] == decision.role_occ:
+                    if decision.crash:
+                        self._crashes_used += 1
+                    i.grant = decision
+                    i.state = RUNNING
+                    i.granted_at = time.monotonic()
+                    self._last_grant[i.role] = len(self._steps)
+                    self._lock.notify_all()
+                    return
+        self._errors.append(f"grant target vanished: {decision!r}")
+
+    def _pending_snapshot(self) -> List[_Cross]:
+        """Crossings parked when the execution ended, newest arrival
+        first: the replay script lists them after every completed
+        crossing, so each parked thread stays held until everything
+        that overtook it has run — the order that reproduces the
+        overtake (see minimize.py)."""
+        with self._lock:
+            parked = [i for i in self._threads.values()
+                      if i.state is PARKED]
+            parked.sort(key=lambda i: -i.arrival_seq)
+            return [_Cross(i.parked[0], i.role, i.parked[1],
+                           i.parked[2], False,
+                           i.role in self._action_roles,
+                           order_key=i.arrival_seq)
+                    for i in parked]
+
+    def _release_all(self) -> None:
+        with self._lock:
+            self._released = True
+            self._lock.notify_all()

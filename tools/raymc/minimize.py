@@ -1,0 +1,179 @@
+"""raymc counterexample pipeline: shrink, script, verify.
+
+A raw violating execution carries every scheduling decision the DFS
+happened to make — most of them irrelevant noise. This module:
+
+1. **delta-debugs** the decision list (classic ddmin over chunks, with
+   a bounded probe budget): a candidate sublist replays decision-for-
+   decision (divergence = candidate rejected) with the default policy
+   finishing the run, and survives only if the SAME property still
+   breaks. The result is 1-minimal: dropping any single remaining
+   decision loses the bug.
+2. **emits a Schedule script** from the minimal failing run's crossing
+   log: completed crossings in completion order, then the crossings
+   still parked when the violation was detected in REVERSE arrival
+   order — a thread that parked early and was overtaken stays gated
+   until everything that overtook it has crossed, which is exactly the
+   overtake the bug needs. Scenario action threads get role-qualified
+   keys (``point@role[#k]``); runtime-internal threads keep global
+   occurrence keys. Crash injections become ``crash_at`` entries.
+3. **verifies** the script by running the scenario under a plain
+   ``tools.raysan.sched.Schedule`` (no explorer) and checking the same
+   property fails — what lands in the report is known-replayable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from tools.raymc.explorer import Decision, ExecutionResult, _Cross
+from tools.raymc.props import Counterexample
+
+
+def _prop_names(violations: List[str]) -> set:
+    return {v.split(":", 1)[0] for v in violations}
+
+
+def minimize_decisions(
+        run: Callable[[List[Decision]], ExecutionResult],
+        decisions: List[Decision],
+        target_props: set,
+        max_probes: int = 48) -> Tuple[List[Decision], ExecutionResult]:
+    """ddmin over the decision list; returns (minimal decisions, the
+    minimal run's result). ``run`` executes a fresh scenario instance
+    under the candidate prefix."""
+    probes = [0]
+
+    def fails(candidate: List[Decision]) -> Optional[ExecutionResult]:
+        if probes[0] >= max_probes:
+            return None
+        probes[0] += 1
+        res = run(candidate)
+        if res.status in ("violation", "deadlock") \
+                and (_prop_names(res.violations) & target_props
+                     or (res.status == "deadlock"
+                         and "deadlock" in target_props)):
+            return res
+        return None
+
+    current = list(decisions)
+    best_res = None
+
+    # Fast path: does the empty prefix (pure default policy) fail?
+    res = fails([])
+    if res is not None:
+        return [], res
+
+    n = 2
+    while len(current) >= 2 and probes[0] < max_probes:
+        chunk = max(1, len(current) // n)
+        reduced = False
+        i = 0
+        while i < len(current):
+            candidate = current[:i] + current[i + chunk:]
+            res = fails(candidate)
+            if res is not None:
+                current = candidate
+                best_res = res
+                n = max(n - 1, 2)
+                reduced = True
+            else:
+                i += chunk
+        if not reduced:
+            if n >= len(current):
+                break
+            n = min(n * 2, len(current))
+
+    if best_res is None:
+        best_res = run(current)
+    return current, best_res
+
+
+def script_from_result(result: ExecutionResult) \
+        -> Tuple[List[str], List[str]]:
+    """(order, crash_at) Schedule entries for the failing run."""
+    per_role: dict = {}
+    per_name: dict = {}
+    order: List[str] = []
+    crash_at: List[str] = []
+
+    def key_for(c: _Cross) -> str:
+        if c.action_role or c.point.startswith("mc."):
+            rocc = per_role.get((c.point, c.role), 0) + 1
+            per_role[(c.point, c.role)] = rocc
+            key = f"{c.point}@{c.role}"
+            return key if rocc == 1 else f"{key}#{rocc}"
+        gocc = per_name.get(c.point, 0) + 1
+        per_name[c.point] = gocc
+        return c.point if gocc == 1 else f"{c.point}#{gocc}"
+
+    # NB occurrence numbers are recomputed over the EMITTED log (not
+    # copied from the explorer's counters): the replay's Schedule
+    # counts crossings from zero, and the emitted log is exactly what
+    # it will see gate-worthy crossings of. Sorting by order_key puts
+    # done gates at their ARRIVAL position (see explorer._Cross), so a
+    # thread's final segment is strictly ordered before anything that
+    # read its effects — and that applies to done gates still PENDING
+    # at the end too (a crash-ended run leaves finished-but-ungranted
+    # threads parked there; their final segments already ran). Other
+    # pending crossings stay at the tail in reverse-arrival order:
+    # they hold overtaken threads parked through everything that
+    # overtook them.
+    from tools.raymc.scenario import DONE_POINT_PREFIX
+
+    done_pending = [c for c in result.pending
+                    if c.point.startswith(DONE_POINT_PREFIX)]
+    hold_pending = [c for c in result.pending
+                    if not c.point.startswith(DONE_POINT_PREFIX)]
+    timeline = sorted(result.crossings + done_pending,
+                      key=lambda c: c.order_key)
+    for c in timeline:
+        key = key_for(c)
+        order.append(key)
+        if c.crashed:
+            crash_at.append(key)
+    for c in hold_pending:
+        order.append(key_for(c))
+    return order, crash_at
+
+
+def build_counterexample(scenario_factory, cfg, decisions: List[Decision],
+                         result: ExecutionResult,
+                         target_props: set) -> Counterexample:
+    """Minimize → script → verify; see module docstring."""
+    from tools.raymc.explorer import Execution
+
+    def run(prefix: List[Decision]) -> ExecutionResult:
+        return Execution(scenario_factory(), list(prefix), cfg).run()
+
+    minimal, minimal_res = (decisions, result)
+    if cfg.minimize:
+        minimal, minimal_res = minimize_decisions(
+            run, decisions, target_props)
+        if minimal_res.status not in ("violation", "deadlock"):
+            # Defensive: ddmin's final answer must fail; if a rerun
+            # went non-deterministic fall back to the original trace.
+            minimal, minimal_res = decisions, result
+
+    order, crash_at = script_from_result(minimal_res)
+    ce = Counterexample(
+        decisions=[d.to_dict() for d in minimal],
+        schedule_order=order,
+        crash_at=crash_at)
+
+    if cfg.verify_replays and order:
+        from tools.raysan.sched import Schedule
+
+        scn = scenario_factory()
+        try:
+            sched = Schedule(order=order, crash_at=crash_at or None,
+                             timeout_s=5.0)
+            msgs = scn.replay_under_schedule(sched)
+            ce.verified_replays = bool(
+                _prop_names(msgs) & target_props)
+            if not ce.verified_replays:
+                ce.verify_messages = msgs
+        except Exception as e:
+            ce.verified_replays = False
+            ce.verify_messages = [f"verification raised: {e!r}"]
+    return ce

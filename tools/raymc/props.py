@@ -1,0 +1,186 @@
+"""raymc property DSL: what the model checker proves.
+
+Two declarative property kinds, both evaluated against a scenario's
+:meth:`~tools.raymc.scenario.Scenario.state` snapshot:
+
+- :class:`Invariant`: must hold in EVERY reachable state. The explorer
+  evaluates all invariants at each quiescent point (after every
+  scheduling decision plays out) and once more at the end of each
+  bounded execution; the first violated state becomes the
+  counterexample. Scenarios should phrase invariants so a violation is
+  *persistent* (e.g. "requests dispatched to a cap-1 replica ≤ 1" with
+  requests that never complete): the minimized replay re-checks the
+  property at the END of a schedule-driven run, and a self-healing
+  violation would be invisible there.
+- :class:`Liveness`: must hold *eventually* within a bound. Evaluated
+  once per execution after every action thread finished and all gates
+  were released, by polling the predicate until ``timeout_s`` —
+  bounded liveness, the only kind a bounded checker can decide (e.g.
+  "long-poll membership converges after the controller restart").
+
+Predicates return truthy for "holds" and falsy for "violated" (the
+property's description becomes the detail). Returning a non-empty
+string reports a violation with that string as the detail — handy for
+naming the exact keys/counters that went wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Invariant:
+    """A safety property over scenario state: ``check(state)`` must be
+    truthy in every explored state."""
+
+    kind = "invariant"
+
+    def __init__(self, name: str, check: Callable[[Any], Any],
+                 description: str = ""):
+        self.name = name
+        self.check = check
+        self.description = description or name
+
+    def violation(self, state) -> Optional[str]:
+        """None when the property holds, else the violation detail."""
+        try:
+            result = self.check(state)
+        except Exception as e:  # a predicate that crashes is a finding
+            return (f"invariant predicate raised "
+                    f"{type(e).__name__}: {e}")
+        if isinstance(result, str) and result:
+            return result
+        return None if result else self.description
+
+
+class Liveness:
+    """A bounded liveness property: ``check(state)`` must become truthy
+    within ``timeout_s`` of the execution's actions completing."""
+
+    kind = "liveness"
+
+    def __init__(self, name: str, check: Callable[[Any], Any],
+                 timeout_s: float = 3.0, description: str = ""):
+        self.name = name
+        self.check = check
+        self.timeout_s = timeout_s
+        self.description = description or name
+
+    def violation(self, state) -> Optional[str]:
+        import time
+
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                if self.check(state):
+                    return None
+            except Exception as e:
+                return (f"liveness predicate raised "
+                        f"{type(e).__name__}: {e}")
+            if time.monotonic() >= deadline:
+                return (f"{self.description} (did not hold within "
+                        f"{self.timeout_s:.1f}s)")
+            time.sleep(0.01)
+
+
+@dataclasses.dataclass
+class Counterexample:
+    """A minimized, replayable witness of a property violation.
+
+    ``schedule_order``/``crash_at`` are a ready-to-run
+    ``tools.raysan.sched.Schedule`` script: crossing keys in the exact
+    order the failing interleaving produced them (role-qualified
+    ``name@role[#k]`` for scenario action threads, global ``name[#k]``
+    for runtime-internal threads), with ``crash_at`` naming the
+    crossings at which a :class:`~ray_tpu._private.sanitize_hooks.
+    SimulatedCrash` was injected. ``decisions`` is the explorer's own
+    scheduling-choice encoding (for re-exploration); ``verified_replays``
+    records whether the emitted Schedule script reproduced the
+    violation when re-run outside the explorer.
+    """
+
+    decisions: List[Dict[str, Any]]
+    schedule_order: List[str]
+    crash_at: List[str]
+    verified_replays: Optional[bool] = None
+    # When verification did NOT reproduce: what the replay returned
+    # instead (hangs, action/on_point exceptions, other violations) —
+    # a maintainer debugs the harness from this, not from a bare
+    # "REPLAY UNVERIFIED".
+    verify_messages: Optional[List[str]] = None
+
+    def replay_snippet(self, scenario_name: str = "<scenario>") -> str:
+        lines = ["from tools.raysan.sched import Schedule",
+                 "sched = Schedule("]
+        lines.append("    order=[")
+        for key in self.schedule_order:
+            lines.append(f"        {key!r},")
+        lines.append("    ],")
+        if self.crash_at:
+            lines.append(f"    crash_at={self.crash_at!r},")
+        lines.append(")")
+        lines.append(f"# drive the {scenario_name} actions under "
+                     f"`with sched:` to replay the violation")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "decisions": self.decisions,
+            "schedule_order": self.schedule_order,
+            "crash_at": self.crash_at,
+            "verified_replays": self.verified_replays,
+            "verify_messages": self.verify_messages,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Counterexample":
+        return cls(**data)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One property violation (or harness-detected failure) with its
+    counterexample."""
+
+    scenario: str
+    prop: str               # property name ("router-cap", ...)
+    kind: str               # invariant | liveness | deadlock | exception
+    message: str
+    counterexample: Optional[Counterexample] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "property": self.prop,
+            "kind": self.kind,
+            "message": self.message,
+            "counterexample": (self.counterexample.to_dict()
+                               if self.counterexample else None),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Finding":
+        ce = data.get("counterexample")
+        return cls(scenario=data["scenario"], prop=data["property"],
+                   kind=data["kind"], message=data["message"],
+                   counterexample=Counterexample.from_dict(ce)
+                   if ce else None)
+
+    def render(self) -> str:
+        out = (f"[{self.scenario}] {self.kind} violated: {self.prop} — "
+               f"{self.message}")
+        if self.counterexample:
+            ce = self.counterexample
+            verified = {True: "replays deterministically",
+                        False: "REPLAY UNVERIFIED",
+                        None: "replay not verified"}[ce.verified_replays]
+            out += (f"\n  counterexample ({len(ce.decisions)} decisions,"
+                    f" {verified}):")
+            out += "\n    Schedule(order=["
+            out += ", ".join(repr(k) for k in ce.schedule_order)
+            out += "]"
+            if ce.crash_at:
+                out += f", crash_at={ce.crash_at!r}"
+            out += ")"
+        return out

@@ -1,0 +1,247 @@
+"""raymc scenario contract: a checkable slice of the real runtime.
+
+A scenario wires REAL product objects (a ``Router``, a
+``SqliteStoreClient``, a ``PipelinedClient`` + ``RpcServer`` pair, a
+``LongPollHost``/``LongPollClient``) into a small closed system, names
+the yield points whose interleavings matter, and declares the
+properties that must hold. The explorer owns scheduling: it runs the
+scenario's action threads, seizes control at every relevant
+``sanitize_hooks`` crossing, and enumerates interleavings and
+crash-fault placements.
+
+The same scenario object also knows how to run under a plain
+``tools.raysan.sched.Schedule`` (:meth:`replay_under_schedule`) — that
+is what makes every raymc counterexample directly usable as a
+deterministic regression test: the minimizer emits a Schedule script,
+verifies it reproduces the violation through THIS path (no explorer
+involved), and a test can pin it forever.
+
+Design rules for scenarios:
+
+- violations should be *persistent*: observable from the end state of a
+  completed run, not only in the instant they occur (see props.py) —
+  both the explorer's end check and schedule replays rely on it;
+- actions must terminate on their own (bounded waits only): the
+  explorer bounds each execution, but a wedged action turns every
+  explored schedule into a timeout;
+- ``on_crash`` performs the kill (and any restart) for an injected
+  :class:`~ray_tpu._private.sanitize_hooks.SimulatedCrash`; it runs on
+  the crashed thread, which terminates right after.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ray_tpu._private import sanitize_hooks
+
+from tools.raymc.props import Invariant, Liveness
+
+# Synthetic per-role gates BRACKETING every action body: the start
+# gate gives the explorer control over code before a thread's first
+# product yield point (the very first overtake window); the done gate
+# gives Schedule replays a handle on the segment AFTER a thread's last
+# product crossing (under the explorer that segment runs to quiescence
+# before the next grant, but a plain Schedule has no quiescence — the
+# done entry is what keeps e.g. a writer's post-put bookkeeping ordered
+# before a committer's snapshot in a replayed script). Scripts
+# reference these as "mc.start.<role>" / "mc.done.<role>". A crashed
+# action crosses no done gate — the thread is dead.
+START_POINT_PREFIX = "mc.start."
+DONE_POINT_PREFIX = "mc.done."
+
+
+class Scenario:
+    """Base class; subclasses are the property catalog (scenarios.py)."""
+
+    name = "unnamed"
+    description = ""
+    # Yield-point names the explorer gates; crossings of any other
+    # point pass through ungated (keeping the interleaving space the
+    # size of the protocol under test, not the whole runtime).
+    points: Tuple[str, ...] = ()
+    # Points where the explorer may inject a SimulatedCrash (these are
+    # gated too, whether or not they also appear in `points`).
+    crash_points: Tuple[str, ...] = ()
+    # Max injected crashes per execution (crash branching is the most
+    # expensive dimension; 1 matches "a single fault" protocol specs).
+    crash_budget = 1
+    # Scheduling decisions per execution before the explorer stops
+    # branching and free-runs the tail (marks the check non-exhaustive).
+    max_steps = 48
+    # Whether the scenario touches the ray_tpu runtime (ObjectRefs,
+    # ray_tpu.wait/put) and needs ray_tpu.init() before checking.
+    needs_ray = False
+    # How long a granted-but-not-parked thread may run before the
+    # explorer treats it as blocked on real synchronization and
+    # schedules around it.
+    block_grace_s = 0.05
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def setup(self) -> None:
+        """Build a FRESH instance of the system under test (called once
+        per explored execution)."""
+
+    def actions(self) -> List[Tuple[str, Callable[[], None]]]:
+        """(role, body) pairs; each runs on its own controlled thread
+        named after the role."""
+        raise NotImplementedError
+
+    def teardown(self) -> None:
+        """Tear the system down (threads, sockets, files). Must be safe
+        after a crash injection killed part of the system."""
+
+    # -- properties --------------------------------------------------------
+
+    def state(self):
+        """The snapshot object invariant/liveness predicates receive.
+        Defaults to the scenario itself."""
+        return self
+
+    def invariants(self) -> List[Invariant]:
+        return []
+
+    def liveness(self) -> List[Liveness]:
+        return []
+
+    # -- fault + observation seams ----------------------------------------
+
+    def on_crash(self, point: str) -> None:
+        """Perform the kill/restart an injected crash at ``point``
+        models. Runs on the crashed thread; the action ends after."""
+
+    def on_point(self, point: str, role: str) -> None:
+        """State-snapshot seam: called after every relevant crossing
+        completes (same thread, same instant in both explorer runs and
+        Schedule replays) — the place to record protocol bookkeeping
+        like "the commit boundary just passed"."""
+
+    def conflict_key(self, point: str) -> Optional[str]:
+        """Partial-order-reduction domain for ``point``: crossings by
+        different threads in different domains commute (their
+        reorderings are not separately explored). Default: the first
+        dotted segment of a REGISTERED product point ("router.handoff"
+        → "router"); None (conflicts with everything) for synthetic
+        mc.* points and anything unregistered — a start gate's
+        follow-on transition can touch any state, so it must never be
+        pruned against."""
+        if point in sanitize_hooks.POINTS:
+            return point.split(".", 1)[0]
+        return None
+
+    def independent(self, a, b) -> bool:
+        """Do two transitions commute? ``a``/``b`` are explorer
+        decisions ``(role, point, occurrence, crash)``. The default is
+        deliberately conservative: same thread never commutes with
+        itself, crash injections commute with nothing, and two points
+        commute only when both declare conflict domains and the
+        domains differ. Scenarios that KNOW finer structure (two
+        writers touching distinct keys) override this to unlock more
+        sleep-set pruning — unsound overrides mean missed
+        interleavings, so only claim independence you can argue from
+        the data."""
+        if a[0] == b[0] or a[3] or b[3]:
+            return False
+        da = self.conflict_key(a[1])
+        db = self.conflict_key(b[1])
+        return da is not None and db is not None and da != db
+
+    # -- schedule replay ---------------------------------------------------
+
+    def start_point(self, role: str) -> str:
+        return START_POINT_PREFIX + role
+
+    def done_point(self, role: str) -> str:
+        return DONE_POINT_PREFIX + role
+
+    def violations(self, include_liveness: bool = True) -> List[str]:
+        """Evaluate every property against the current state; returns
+        ``"prop-name: detail"`` strings (the shared judge for explorer
+        end checks, schedule replays, and minimizer probes)."""
+        out = []
+        state = self.state()
+        for inv in self.invariants():
+            detail = inv.violation(state)
+            if detail is not None:
+                out.append(f"{inv.name}: {detail}")
+        if include_liveness:
+            for live in self.liveness():
+                detail = live.violation(state)
+                if detail is not None:
+                    out.append(f"{live.name}: {detail}")
+        return out
+
+    def replay_under_schedule(self, schedule,
+                              join_timeout_s: float = 8.0) -> List[str]:
+        """Run this scenario's actions under a plain raysan
+        ``Schedule`` (no explorer) and return the violated properties —
+        the counterexample-verification path, and the exact shape a
+        regression test pins.
+
+        The schedule's ``on_cross`` seam is wired to :meth:`on_point`
+        so protocol bookkeeping (commit boundaries, ack watermarks)
+        observes the same crossings it would under the explorer.
+        """
+        self.setup()
+        self._replay_errors: List[str] = []
+        try:
+            schedule.set_on_cross(self._schedule_on_cross)
+            threads = []
+            crash_seen: List[str] = []
+
+            def body(role, fn):
+                def run():
+                    try:
+                        sanitize_hooks.sched_point(self.start_point(role))
+                        fn()
+                    except sanitize_hooks.SimulatedCrash as e:
+                        crash_seen.append(e.point)
+                        try:
+                            self.on_crash(e.point)
+                        except Exception as e2:
+                            self._replay_errors.append(
+                                f"on_crash({e.point}) raised: {e2!r}")
+                        return  # crashed: no done gate for the dead
+                    except Exception as e:
+                        # End-state properties are the judge, but a
+                        # raising action is diagnosable, not silent —
+                        # the explorer path records the same thing as
+                        # a no-unhandled-exception finding.
+                        self._replay_errors.append(
+                            f"action {role!r} raised: {e!r}")
+                    sanitize_hooks.sched_point(self.done_point(role))
+                return run
+
+            with schedule:
+                for role, fn in self.actions():
+                    t = threading.Thread(target=body(role, fn),
+                                         name=role, daemon=True)
+                    threads.append(t)
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(join_timeout_s)
+            # Gates are released now; give stragglers a moment.
+            for t in threads:
+                t.join(1.0)
+            hung = [t.name for t in threads if t.is_alive()]
+            msgs = []
+            if hung:
+                msgs.append(f"replay-hang: action threads never "
+                            f"finished: {hung}")
+            msgs.extend(f"replay-exception: {e}"
+                        for e in self._replay_errors)
+            msgs.extend(self.violations())
+            return msgs
+        finally:
+            self.teardown()
+
+    def _schedule_on_cross(self, key: str, role: str) -> None:
+        point = key.split("#")[0].split("@")[0]
+        try:
+            self.on_point(point, role)
+        except Exception as e:
+            self._replay_errors.append(
+                f"on_point({point}) raised: {e!r}")

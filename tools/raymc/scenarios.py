@@ -1,0 +1,585 @@
+"""raymc built-in scenarios: the checked protocol property catalog.
+
+Each scenario drives REAL product objects; fakes are limited to the
+environment around them (a replica that records dispatches, a
+controller handle that dies on demand) — the same stand-ins the
+concurrency regression tests use. Properties:
+
+================== ==========================================================
+scenario           property
+================== ==========================================================
+router_cap         a replica never holds more outstanding dispatches than
+                   ``max_concurrent_queries`` (reserved-slot handoff)
+pipelined_close    a clean ``PipelinedClient.close(flush_timeout=...)``
+                   never orphan-sweeps an about-to-be-acked request
+gcs_durability     sqlite group commit: acked (flushed) writes survive a
+                   crash at either commit boundary; writes no COMMIT ever
+                   covered never resurrect after restart
+exactly_once       a submit frame resubmitted under its rid after a
+                   connection death executes exactly once (server dedupe)
+longpoll_recovery  long-poll membership converges after a controller
+                   kill/restart with listeners parked mid-poll
+================== ==========================================================
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import threading
+import time
+from types import SimpleNamespace
+from typing import List, Tuple
+
+from ray_tpu._private import sanitize_hooks
+
+from tools.raymc.props import Invariant, Liveness
+from tools.raymc.scenario import Scenario
+
+
+# -- shared fakes ------------------------------------------------------------
+
+
+class _FakeMethod:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _Replica:
+    """Hashable (router keys replicas into dicts) dispatch recorder."""
+
+    def __init__(self, fn):
+        self.handle_request = _FakeMethod(fn)
+
+
+class _FakeController:
+    """Enough controller surface for a Router: metrics reports are
+    swallowed, long-poll listens fail fast (no membership churn in the
+    scenario — the replica set is pinned at setup)."""
+
+    def __init__(self):
+        self.listen = _FakeMethod(self._listen)
+        self.record_handle_metrics = _FakeMethod(lambda dep, total: None)
+
+    def _listen(self, *a, **k):
+        raise RuntimeError("no controller in this scenario")
+
+
+def _pending_ref():
+    """An ObjectRef that never resolves, so dispatched requests stay
+    in-flight for the whole execution and an oversubscription cannot
+    self-heal before the invariant looks."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.object_ref import ObjectRef
+
+    return ObjectRef(ObjectID.from_random(), _register=False)
+
+
+# -- router reserved-slot cap ------------------------------------------------
+
+
+class RouterCapScenario(Scenario):
+    name = "router_cap"
+    description = ("concurrent dispatchers against a cap-1 replica: "
+                   "outstanding dispatches never exceed the cap")
+    points = ("router.handoff", "router.buggy_gap")
+    max_steps = 16
+    needs_ray = True
+
+    def __init__(self, dispatchers: int = 2, cap: int = 1):
+        self.n_dispatchers = dispatchers
+        self.cap = cap
+
+    def setup(self) -> None:
+        from ray_tpu.serve._private.router import Router
+
+        self.dispatched = 0
+        self._dlock = threading.Lock()
+
+        def handle(method, args, kwargs):
+            with self._dlock:
+                self.dispatched += 1
+            return _pending_ref()
+
+        self.replica = _Replica(handle)
+        self.router = Router(_FakeController(), "dep",
+                             max_concurrent_queries=self.cap)
+        self.router._update_replicas([self.replica])
+        self.results: List = []
+
+    def actions(self):
+        def dispatch():
+            self.results.append(
+                self.router.try_assign_request("__call__", (), {}))
+        return [(f"dispatch-{chr(ord('a') + i)}", dispatch)
+                for i in range(self.n_dispatchers)]
+
+    def invariants(self):
+        return [Invariant(
+            "router-cap",
+            lambda s: (s.dispatched <= s.cap
+                       or f"{s.dispatched} requests dispatched to a "
+                          f"cap-{s.cap} replica"),
+            description="per-replica in-flight cap holds mid-handoff")]
+
+    def teardown(self) -> None:
+        self.router.shutdown()
+
+
+# -- pipelined close vs reader sweep ----------------------------------------
+
+
+class PipelinedCloseScenario(Scenario):
+    name = "pipelined_close"
+    description = ("clean close with an in-flight, about-to-be-acked "
+                   "request: the reader must never orphan-sweep it")
+    points = ("rpc.pipeline.reader_edge", "rpc.pipeline.reply_handled",
+              "rpc.pipeline.closed_set")
+    max_steps = 24
+    block_grace_s = 0.04
+
+    def setup(self) -> None:
+        from ray_tpu._private.rpc import PipelinedClient, RpcServer
+
+        self.release = threading.Event()
+        self.errors: List[Tuple] = []
+
+        def fast(**kwargs):
+            return "ok"
+
+        def slow(**kwargs):
+            self.release.wait(5.0)
+            return "ok"
+
+        self.server = RpcServer({"fast": fast, "slow": slow})
+        self.client = PipelinedClient(
+            self.server.address,
+            on_error=lambda tag, msg, rid, lost: self.errors.append(
+                (tag, lost)))
+
+    def actions(self):
+        def driver():
+            self.client.send("fast", tag="req1")
+            self.client.flush(3.0)
+            self.client.send("slow", tag="req2")
+            self.release.set()  # the peer acks while close() flushes
+            self.client.close(flush_timeout=3.0)
+        return [("driver", driver)]
+
+    def invariants(self):
+        return [Invariant(
+            "close-no-orphan",
+            lambda s: (not s.errors
+                       or f"clean close produced orphan errors: "
+                          f"{s.errors}"),
+            description="close(flush_timeout) never sweeps an "
+                        "about-to-be-acked request into the orphan "
+                        "path")]
+
+    def liveness(self):
+        return [Liveness(
+            "close-acks-all",
+            lambda s: s.client._acked == 2, timeout_s=3.0,
+            description="both requests acknowledged by close")]
+
+    def teardown(self) -> None:
+        self.release.set()
+        try:
+            self.client.close()
+        except Exception:
+            pass
+        self.server.shutdown()
+
+
+# -- sqlite group-commit durability under crash ------------------------------
+
+
+class GroupCommitDurabilityScenario(Scenario):
+    name = "gcs_durability"
+    description = ("writers vs group commit vs injected crash: acked "
+                   "writes survive, uncommitted writes never resurrect")
+    points = ("gcs.put",)
+    crash_points = ("gcs.commit.before", "gcs.commit.after")
+    crash_budget = 1
+    max_steps = 24
+    # Writers block on the store lock whenever the committer is parked
+    # inside the commit window — a certain, immediate block, so a
+    # short grace keeps per-step cost down.
+    block_grace_s = 0.02
+
+    def __init__(self, writers: int = 1):
+        # One writer is the exhaustive small scope (the property is
+        # about put-vs-commit-vs-crash ordering, which one writer
+        # fully exercises across the two commit windows); more writers
+        # widen coverage but grow the space factorially — use bounded
+        # budgets there.
+        self.n_writers = writers
+
+    def setup(self) -> None:
+        from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+        fd, self.path = tempfile.mkstemp(prefix="raymc-gcs-",
+                                         suffix=".db")
+        os.close(fd)
+        os.unlink(self.path)
+        # Group-commit mode WITHOUT the background flusher: construct
+        # synchronous (interval 0 starts no thread), then widen the
+        # interval so puts defer their COMMIT to the scenario's
+        # explicit committer action — the checker owns every commit
+        # boundary instead of racing a timer.
+        self.store = SqliteStoreClient(self.path, commit_interval_s=0)
+        self.store._interval = 3600.0
+        self.accepted: List[bytes] = []
+        self.acked: set = set()
+        self.durable: set = set()
+        self.present: set = set()
+        self.crashed: str = ""
+
+    def actions(self):
+        def writer(key):
+            def body():
+                try:
+                    self.store.put("t", key, b"v")
+                except Exception:
+                    return  # store died under us: the write never took
+                self.accepted.append(key)
+            return body
+
+        def committer():
+            # TWO commit windows: a crash inside the first flush can
+            # only lose never-acked writes (vacuous for durability —
+            # flush() hasn't returned, nothing was promised). The
+            # placement that bites is a death AFTER a completed, acked
+            # flush: the second window provides it, with writers free
+            # to interleave around both.
+            for window in range(2):
+                snap = list(self.accepted)
+                self.store.flush()
+                self.acked.update(snap)
+                if window == 0:
+                    # Sync gate OUTSIDE the store lock: without it the
+                    # committer can barge straight from window 1 into
+                    # window 2's lock hold, and whether a lock-blocked
+                    # writer squeezes through between the windows is OS
+                    # lock-queue luck — exactly the sub-yield-point
+                    # nondeterminism that makes explorations diverge.
+                    # Parked here, the lock handoff is a decision.
+                    sanitize_hooks.sched_point("mc.sync.commit_gap")
+
+        acts = [(f"writer-{chr(ord('a') + i)}",
+                 writer(b"k%d" % i)) for i in range(self.n_writers)]
+        acts.append(("committer", committer))
+        return acts
+
+    def independent(self, a, b) -> bool:
+        # Scenario-specific structure that makes the two-writer config
+        # tractable to exhaust (argued from the code, not vibes):
+        # - two writers' puts commute: each writes its OWN key;
+        # - a writer's start transition is PURE — the segment between
+        #   its start gate and its put gate executes nothing (the
+        #   gcs.put crossing is the first statement of put()) — so it
+        #   commutes with every other thread's transition. The
+        #   committer's start is NOT pure (it snapshots `accepted`)
+        #   and keeps full conflicts.
+        if a[0] == b[0] or a[3] or b[3]:
+            return False
+        if a[1] == "gcs.put" and b[1] == "gcs.put":
+            return True
+        if a[1].startswith("mc.start.writer") \
+                or b[1].startswith("mc.start.writer"):
+            return True
+        return super().independent(a, b)
+
+    def on_point(self, point: str, role: str) -> None:
+        if point == "gcs.commit.after":
+            # Crossed INSIDE the store lock right after COMMIT: exactly
+            # the accepted-so-far writes are durable now (a writer
+            # mid-put is blocked on the same lock and not yet in
+            # `accepted`).
+            self.durable.update(self.accepted)
+
+    def on_crash(self, point: str) -> None:
+        from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+        try:
+            # Process death: the connection drops with the pending
+            # transaction uncommitted (sqlite rolls it back). UNDER the
+            # store lock: closing a sqlite connection while another
+            # thread is inside conn.execute() on it is a C-level
+            # use-after-free (segfaulted under full-suite load when a
+            # lock-blocked writer woke the instant the crashing flush
+            # released the lock). The lock sequences the close after
+            # any in-flight statement; later puts hit a clean
+            # ProgrammingError on the closed connection, which the
+            # writer action treats as "the store died under us".
+            with self.store._lock:
+                self.store._conn.close()
+        except Exception:
+            pass
+        survivor = SqliteStoreClient(self.path, commit_interval_s=0)
+        try:
+            self.present = {k for k, _ in survivor.get_all("t")}
+        finally:
+            survivor.close()
+        self.crashed = point  # LAST: invariants key off it
+
+    def invariants(self):
+        def durability(s):
+            if not s.crashed:
+                return True
+            lost = s.acked - s.present
+            return (not lost
+                    or f"acked writes lost across crash at "
+                       f"{s.crashed}: {sorted(lost)}")
+
+        def no_resurrection(s):
+            if not s.crashed:
+                return True
+            ghosts = s.present - s.durable
+            return (not ghosts
+                    or f"uncommitted writes resurrected after crash "
+                       f"at {s.crashed}: {sorted(ghosts)}")
+
+        return [
+            Invariant("gcs-durability", durability,
+                      description="flushed writes survive crash"),
+            Invariant("gcs-no-resurrection", no_resurrection,
+                      description="unflushed writes stay dead"),
+        ]
+
+    def teardown(self) -> None:
+        try:
+            if not self.crashed:
+                self.store.close()
+        except Exception:
+            pass
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                os.unlink(self.path + suffix)
+            except OSError:
+                pass
+
+
+# -- exactly-once resubmit across connection death ---------------------------
+
+
+class ExactlyOnceResubmitScenario(Scenario):
+    name = "exactly_once"
+    description = ("connection killed around a submit frame: the rid "
+                   "resubmit (cluster_utils lost-frame path) executes "
+                   "the frame exactly once")
+    points = ("rpc.pipeline.send", "rpc.pipeline.reader_edge",
+              "rpc.server.dispatch", "rpc.server.reply")
+    crash_points = ("mc.env.conn_kill",)
+    crash_budget = 1
+    max_steps = 24
+    block_grace_s = 0.04
+
+    def setup(self) -> None:
+        from ray_tpu._private.rpc import PipelinedClient, RpcServer
+
+        self.executed = {}
+        self._xlock = threading.Lock()
+        self.resubmits = 0
+        self.tids = ["t1"]
+        self.server = RpcServer({"apply": self._apply},
+                                dedupe_methods=frozenset({"apply"}))
+        self.client = PipelinedClient(self.server.address,
+                                      on_error=self._pipe_error)
+
+    def _apply(self, task_ids=()):
+        with self._xlock:
+            for t in task_ids:
+                self.executed[t] = self.executed.get(t, 0) + 1
+        return True
+
+    def _pipe_error(self, tag, message, rid, lost):
+        """The driver-side recovery contract, verbatim from
+        ``cluster_utils._batch_pipe_error``'s lost branch: a frame that
+        died un-acked is resubmitted under the SAME request id so the
+        node's dedupe cache makes it exactly-once."""
+        if not lost:
+            return
+        from ray_tpu._private.rpc import RpcClient
+
+        self.resubmits += 1
+        try:
+            RpcClient.to(self.server.address).call_with_rid(
+                rid, "apply", task_ids=self.tids)
+        except Exception:
+            pass  # node truly dead → the death-sweep path owns recovery
+
+    def actions(self):
+        def driver():
+            self.rid = self.client.send("apply", tag="frame",
+                                        task_ids=self.tids)
+            # The injected fault: the checker may kill the submit
+            # connection at any point relative to the server's
+            # dispatch/reply and the reader's drain.
+            sanitize_hooks.crash_point("mc.env.conn_kill")
+
+        def awaiter():
+            # Keeps the execution (and so the explorer's control over
+            # server/reader crossings) alive until the protocol
+            # settles; must finish well inside the explorer's
+            # blocked-threads grace (_wait_for_park) so a settled-but-
+            # polling awaiter is never mistaken for a deadlock.
+            deadline = time.monotonic() + 2.5
+            while time.monotonic() < deadline:
+                with self._xlock:
+                    done = self.executed.get("t1", 0) >= 1
+                if done and self.client.in_flight == 0:
+                    return
+                time.sleep(0.01)
+
+        return [("driver", driver), ("awaiter", awaiter)]
+
+    def on_crash(self, point: str) -> None:
+        sock = self.client._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def invariants(self):
+        return [Invariant(
+            "exactly-once",
+            lambda s: (s.executed.get("t1", 0) <= 1
+                       or f"frame executed "
+                          f"{s.executed['t1']} times"),
+            description="a resubmitted frame never double-executes")]
+
+    def liveness(self):
+        return [Liveness(
+            "frame-executes",
+            lambda s: s.executed.get("t1", 0) == 1, timeout_s=4.0,
+            description="the frame executes despite the kill")]
+
+    def teardown(self) -> None:
+        from ray_tpu._private.rpc import RpcClient
+
+        try:
+            self.client.close()
+        except Exception:
+            pass
+        self.server.shutdown()
+        addr = tuple(self.server.address)
+        with RpcClient._pools_lock:
+            pooled = RpcClient._pools.pop(addr, None)
+        if pooled is not None:
+            pooled.close()
+
+
+# -- long-poll convergence across controller restart -------------------------
+
+
+class LongPollRecoveryScenario(Scenario):
+    name = "longpoll_recovery"
+    description = ("controller killed with a listener parked mid-poll: "
+                   "membership converges after the restart")
+    points = ("longpoll.listen", "longpoll.notify",
+              "longpoll.client.loop")
+    crash_points = ("mc.env.controller_kill",)
+    crash_budget = 1
+    # The product client polls in an unbounded loop, so executions
+    # truncate at the step bound by design: this scenario is a bounded
+    # heuristic check, never an exhaustive one.
+    max_steps = 18
+    needs_ray = True
+    block_grace_s = 0.06
+
+    def setup(self) -> None:
+        from ray_tpu.serve._private.long_poll import (LongPollClient,
+                                                      LongPollHost)
+
+        self.key = "replicas::dep"
+        self.gen = 0
+        self.host = LongPollHost()
+        self.host.notify_changed(self.key, ("r1",))
+        self.observed: List = []
+        self.client = LongPollClient(
+            self._make_handle(), self.key,
+            lambda snap: self.observed.append(tuple(snap or ())),
+            reresolve=self._make_handle)
+
+    def _make_handle(self):
+        """A controller handle bound to the CURRENT incarnation: calls
+        against a superseded one raise ActorDiedError, exactly like a
+        handle to a killed actor."""
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+
+        scenario = self
+        gen = self.gen
+
+        def listen(key, known):
+            if scenario.gen != gen:
+                raise ActorDiedError("controller incarnation "
+                                     f"{gen} is dead")
+            result = scenario.host.listen(key, known, timeout=0.4)
+            if scenario.gen != gen:
+                # Died while we were parked: the poisoned answer of a
+                # dead controller surfaces as the actor-death the real
+                # transport would raise.
+                raise ActorDiedError("controller died mid-listen")
+            return ray_tpu.put(result)
+
+        return SimpleNamespace(listen=_FakeMethod(listen))
+
+    def actions(self):
+        def env():
+            self.host.notify_changed(self.key, ("r1", "r2"))
+            sanitize_hooks.crash_point("mc.env.controller_kill")
+        return [("env", env)]
+
+    def on_crash(self, point: str) -> None:
+        from ray_tpu.serve._private.long_poll import LongPollHost
+
+        old = self.host
+        replacement = LongPollHost()
+        # The recovered controller re-broadcasts its checkpointed
+        # state; clients resume from version -1 via reresolve.
+        replacement.notify_changed(self.key, ("r1", "r2"))
+        self.gen += 1
+        self.host = replacement
+        old.shutdown()  # poison: parked listeners wake NOW
+
+    def invariants(self):
+        valid = {("r1",), ("r1", "r2")}
+        return [Invariant(
+            "membership-sane",
+            lambda s: (all(o in valid for o in s.observed)
+                       or f"client observed garbage membership: "
+                          f"{s.observed}"),
+            description="observed snapshots are real memberships")]
+
+    def liveness(self):
+        return [Liveness(
+            "membership-converges",
+            lambda s: bool(s.observed)
+            and s.observed[-1] == ("r1", "r2"),
+            timeout_s=5.0,
+            description="client converges to the post-restart "
+                        "membership")]
+
+    def teardown(self) -> None:
+        self.client.stop()
+        self.host.shutdown()
+        self.client._thread.join(2.0)
+
+
+SCENARIOS = {
+    cls.name: cls
+    for cls in (RouterCapScenario, PipelinedCloseScenario,
+                GroupCommitDurabilityScenario,
+                ExactlyOnceResubmitScenario, LongPollRecoveryScenario)
+}
+
+# The bounded tier-1 leg: real code, small configs, exhaustive where
+# the scenario supports it (see test_raymc_ci_leg.py).
+DEFAULT_SCENARIOS = ("router_cap", "gcs_durability", "pipelined_close")

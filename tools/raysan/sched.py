@@ -16,9 +16,20 @@ no-op. Under a :class:`Schedule` a crossing can be *gated*:
   sequence of point crossings the test demands. A thread crossing a
   listed point parks until every earlier entry has been crossed;
   unlisted crossings pass freely. Entries are ``"name"`` (first
-  crossing of ``name``) or ``"name#k"`` (the k-th crossing). This is
-  fully deterministic: the same script forces the same interleaving on
-  every run — the replay half of the harness.
+  crossing of ``name``), ``"name#k"`` (the k-th crossing), or —
+  when symmetric threads cross the same point and global occurrence
+  numbers can't tell them apart — ``"name@role"`` / ``"name@role#k"``
+  (the k-th crossing of ``name`` by the thread NAMED ``role``; raymc's
+  emitted counterexamples use this form so each scenario thread is
+  pinned individually). This is fully deterministic: the same script
+  forces the same interleaving on every run — the replay half of the
+  harness.
+- **Crash injection** (``Schedule(order=[...], crash_at=[...])``):
+  each ``crash_at`` entry (same key syntax) raises
+  ``sanitize_hooks.SimulatedCrash`` out of the matching crossing after
+  it is gated and recorded — the replay half of raymc's crash-fault
+  exploration: a minimized counterexample that killed a component at a
+  crash point replays that death at exactly the same crossing.
 - **Seeded mode** (``Schedule(seed=n)``): every crossing consults a
   seeded RNG to decide whether to pause briefly — long enough for any
   concurrently-running thread to overtake through the window — before
@@ -72,7 +83,9 @@ class Schedule:
                  seed: Optional[int] = None,
                  timeout_s: float = 5.0,
                  pause_prob: float = 0.5,
-                 pause_max_s: float = 0.05):
+                 pause_max_s: float = 0.05,
+                 crash_at: Optional[List[str]] = None,
+                 on_cross=None):
         if order is not None and seed is not None:
             raise ValueError("order= and seed= are mutually exclusive")
         self._order = list(order) if order else []
@@ -84,22 +97,43 @@ class Schedule:
         self._pause_max = pause_max_s
         self._cond = threading.Condition()
         self._counts: Dict[str, int] = {}   # name -> crossings so far
+        # (name, thread name) -> crossings so far, for @role entries.
+        self._role_counts: Dict[Tuple[str, str], int] = {}
         self._done = [False] * len(self._order)
         self._generation = 0                # bumps on every crossing
         self._parked: Dict[int, str] = {}   # thread ident -> entry/point
         self._released = False              # __exit__ opened all gates
+        self._crash_at = set(crash_at or [])
+        self._crashes_fired: set = set()
+        # State-snapshot seam: called as on_cross(key, thread_name)
+        # after every recorded crossing, in the crossing thread, so a
+        # checker can snapshot protocol state at exactly this boundary
+        # (raymc's invariant bookkeeping rides it during replays).
+        self._on_cross = on_cross
         self.trace: List[Tuple[str, str]] = []  # (key, thread name)
         self._prev_hook = None
+        self._prev_crash_hook = None
+
+    def set_on_cross(self, fn) -> None:
+        """Install the state-snapshot seam after construction (raymc
+        wires a scenario's bookkeeping into replayed counterexamples
+        this way)."""
+        self._on_cross = fn
 
     # -- installation ------------------------------------------------------
 
     def __enter__(self) -> "Schedule":
         self._prev_hook = sanitize_hooks._sched_point
+        self._prev_crash_hook = sanitize_hooks._crash_point
         sanitize_hooks.install_sched_point(self.point)
+        # Crash points gate like yield points under a schedule (and are
+        # the targets crash_at kills), so install into that seam too.
+        sanitize_hooks.install_crash_point(self.point)
         return self
 
     def __exit__(self, *exc) -> None:
         sanitize_hooks.install_sched_point(self._prev_hook)
+        sanitize_hooks.install_crash_point(self._prev_crash_hook)
         # Release anything still parked so stray threads don't hold the
         # suite hostage after the test body is done with the schedule —
         # WITHOUT forging `_done`: `completed` must keep reporting
@@ -117,25 +151,68 @@ class Schedule:
         self.point(name)
 
     def point(self, name: str) -> None:
+        role = threading.current_thread().name
         with self._cond:
             occ = self._counts.get(name, 0) + 1
             self._counts[name] = occ
+            rocc = self._role_counts.get((name, role), 0) + 1
+            self._role_counts[(name, role)] = rocc
             key = f"{name}#{occ}"
-            idx = self._entry_index(name, occ)
+            candidates = self._candidate_keys(name, role, occ, rocc)
+            idx = self._entry_index(candidates)
         if idx is not None:
             self._gate(idx, key)
         elif self._rng is not None:
             self._maybe_pause(key)
         else:
             self._record(key)
+        self._after_cross(name, role, key, candidates)
 
-    def _entry_index(self, name: str, occ: int) -> Optional[int]:
-        key = f"{name}#{occ}"
-        if key in self._order:
-            return self._order.index(key)
-        if occ == 1 and name in self._order:
-            return self._order.index(name)
+    @staticmethod
+    def _candidate_keys(name: str, role: str, occ: int,
+                        rocc: int) -> List[str]:
+        """Entry keys this crossing can satisfy, most specific first
+        (a role-qualified entry wins over a global-occurrence one)."""
+        cands = [f"{name}@{role}#{rocc}"]
+        if rocc == 1:
+            cands.append(f"{name}@{role}")
+        cands.append(f"{name}#{occ}")
+        if occ == 1:
+            cands.append(name)
+        return cands
+
+    def _entry_index(self, candidates: List[str]) -> Optional[int]:
+        for key in candidates:
+            if key in self._order:
+                return self._order.index(key)
         return None
+
+    def _after_cross(self, name: str, role: str, key: str,
+                     candidates: List[str]) -> None:
+        """Post-crossing seams: the on_cross snapshot callback, then
+        crash injection — the crossing is recorded and its gate marked
+        done BEFORE the simulated death, so `completed` and the trace
+        reflect that the kill really happened at this boundary."""
+        cb = self._on_cross
+        if cb is not None:
+            try:
+                cb(key, role)
+            except Exception:
+                pass
+        if not self._crash_at:
+            return
+        with self._cond:
+            if self._released:
+                return  # torn down: don't kill cleanup-phase threads
+            hit = None
+            for k in candidates:
+                if k in self._crash_at and k not in self._crashes_fired:
+                    hit = k
+                    break
+            if hit is not None:
+                self._crashes_fired.add(hit)
+        if hit is not None:
+            raise sanitize_hooks.SimulatedCrash(name)
 
     def _gate(self, idx: int, key: str) -> None:
         deadline = time.monotonic() + self._timeout
@@ -194,16 +271,24 @@ class Schedule:
             for t in threading.enumerate():
                 if t.ident == ident:
                     parked[t.name] = entry
+        if self.trace:
+            last_key, last_thread = self.trace[-1]
+            last = f"{last_key} (by {last_thread})"
+        else:
+            last = "<none - no point was ever crossed>"
         return (f"schedule timeout at {self._order[idx]!r}: waiting on "
-                f"{pending}; parked threads: {parked}; "
+                f"{pending}; last successfully crossed point: {last}; "
+                f"parked threads: {parked}; "
                 f"crossed so far: {[k for k, _ in self.trace]}")
 
     def parked_at(self, name: str) -> bool:
         """True while some thread is parked at the gate for ``name``
-        (exact entry, or any ``name#k`` occurrence of it) — the test-
-        side synchronization for 'wait until A is in the window'."""
+        (exact entry, or any ``name#k`` / ``name@role[#k]`` occurrence
+        of it) — the test-side synchronization for 'wait until A is in
+        the window'."""
         with self._cond:
-            return any(entry == name or entry.split("#")[0] == name
+            return any(entry == name
+                       or entry.split("#")[0].split("@")[0] == name
                        for entry in self._parked.values())
 
     # -- results -----------------------------------------------------------
