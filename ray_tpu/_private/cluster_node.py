@@ -189,11 +189,11 @@ class NodeRuntime:
 
         def report_loop():
             while True:
-                oids = [report_q.get()]
+                items = [report_q.get()]
                 t0 = time.monotonic()
                 while time.monotonic() - t0 < 0.002:
                     try:
-                        oids.append(report_q.get_nowait())
+                        items.append(report_q.get_nowait())
                     except _q.Empty:
                         time.sleep(0.0005)
                 # Borrow registrations first: the output report unpins
@@ -202,8 +202,12 @@ class NodeRuntime:
                 # connection → ordered).
                 getattr(node, "_flush_borrows", lambda: None)()
                 try:
-                    node.head.call("report_objects", oids=oids,
-                                   address=node.address)
+                    # Sizes ride the report: the head's directory feeds
+                    # locality-aware placement (bytes, not just where).
+                    node.head.call("report_objects",
+                                   oids=[ob for ob, _ in items],
+                                   address=node.address,
+                                   sizes=[sz for _, sz in items])
                 except Exception:
                     pass
 
@@ -220,12 +224,12 @@ class NodeRuntime:
             dynamic = list(getattr(spec, "dynamic_return_ids", ()))
             for roid in list(spec.return_ids) + dynamic:
                 worker.memory_store.pin_object(roid)
-            oids = [oid.binary()
-                    for oid in list(spec.return_ids) + dynamic]
-            if oids:
-                node._reported_oids.update(oids)
-                for oid in oids:
-                    report_q.put(oid)
+            returns = list(spec.return_ids) + dynamic
+            if returns:
+                node._reported_oids.update(r.binary() for r in returns)
+                for roid in returns:
+                    report_q.put((roid.binary(),
+                                  worker.memory_store.entry_size(roid)))
 
         worker.store_task_outputs = store_and_report
 
@@ -685,13 +689,18 @@ class NodeRuntime:
             time.sleep(0.005)
         return False, None, None
 
-    def _get_objects_batch(self, oids, timeout: float = 30.0):
-        """Batched peer read: one RPC returns (ok, value, error) for
-        every requested object under a shared deadline."""
-        from ray_tpu._private.rpc import batched_object_read
+    def _get_objects_batch(self, oids, timeout: float = 30.0,
+                           shm=None, can_pull: bool = False):
+        """Batched peer read: one RPC returns, per object, either an
+        ObjectDescriptor (requester can reach the sealed bytes — same
+        segment or our transfer server) or (ok, value, error) with the
+        framed-pickle value for small/plane-less objects."""
+        from ray_tpu.cluster_utils import descriptor_object_read
 
-        return batched_object_read(
-            lambda oid, t: self._get_object(oid, timeout=t), oids, timeout)
+        return descriptor_object_read(
+            self.worker, self.transfer_addr,
+            lambda oid, t: self._get_object(oid, timeout=t), oids,
+            timeout, shm=shm, can_pull=can_pull)
 
     def _contains_object(self, oid: bytes):
         return self.worker.memory_store.contains(ObjectID(oid))
@@ -707,7 +716,10 @@ class NodeRuntime:
         if plane is not None:
             for object_id in object_ids:
                 try:
-                    plane.release(object_id)
+                    # Owner-side free: drop the pin AND reclaim the
+                    # arena block (a released-but-undeleted object only
+                    # leaves under later LRU pressure).
+                    plane.evict_object(object_id)
                 except Exception:
                     pass
         return True

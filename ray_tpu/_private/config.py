@@ -41,6 +41,35 @@ class RayTpuConfig:
     object_spilling_threshold: float = 0.8
     object_store_memory_bytes: int = 2 * 1024 ** 3
     min_spilling_size_bytes: int = 1024 * 1024
+    # Bandwidth-aware pull bounding (reference: pull_manager.h caps
+    # in-flight pull bytes): at most this many native wire pulls run at
+    # once; excess callers wait for a slot (wait time lands in
+    # perf_stats `object_pull_slot_wait_seconds`).
+    object_pull_max_concurrent: int = 2
+    # Parallel range-striped streams per native pull (transfer.h
+    # pull_striped): each stream moves a disjoint byte range.
+    object_pull_streams: int = 4
+    # Object-arrival poll curve (cluster_utils.fetch_backoff): sleep
+    # base * 1.6^attempt, capped. Sub-ms first probes — most objects
+    # land within a few ms of submission — backing off for slow
+    # producers.
+    object_fetch_backoff_base_s: float = 0.0005
+    object_fetch_backoff_cap_s: float = 0.01
+    # Shared-segment arena spill: on create-failure backpressure the
+    # owner spills its cold, unpinned shm objects to disk (URL on the
+    # store entry, transparent restore on get) instead of looping on
+    # eviction waits. Off = legacy wait-then-heap-fallback behavior.
+    shm_spill_enabled: bool = True
+
+    # -- locality-aware scheduling (reference: lease_policy.h locality-
+    #    aware lease policy) ---------------------------------------------
+    # Score lease placement by resident argument bytes so tasks with
+    # large args run where the bytes already live instead of pulling
+    # them to follow a small spec.
+    locality_aware_scheduling: bool = True
+    # Arguments below this many resident bytes never influence
+    # placement (pulling them costs less than disturbing the pack).
+    locality_min_arg_bytes: int = 1024 * 1024
 
     # -- lineage / reconstruction (reference: object_recovery_manager.h,
     #    task_manager.h lineage pinning) ---------------------------------

@@ -47,6 +47,18 @@ class _Entry:
     size: int = 0
     last_access: float = 0.0
     spilled_url: Optional[str] = None
+    # value is a zero-copy view over the node's shm segment (the bytes
+    # live in the arena, not this heap): excluded from the heap spill
+    # budget and from heap spill candidacy — the SharedPlane owns its
+    # lifecycle (pin released on entry drop, arena spill under
+    # pressure).
+    shm_backed: bool = False
+    # the shm-backed value has been handed to an in-process reader
+    # (get/peek/get_many) since the swap: such a reader may retain an
+    # INNER array viewing the arena pages (invisible to a refcount
+    # check on the container), so the entry is no longer arena-spill
+    # eligible — its block must never be reused under a live view.
+    shm_read: bool = False
     # Job/tenant tag of the task (or driver put) that produced this
     # object — the per-job object-store accounting key ("" = untagged).
     job_id: str = ""
@@ -95,7 +107,11 @@ class MemoryStore:
 
     def put(self, object_id: ObjectID, value: Any,
             error: Optional[BaseException] = None,
-            job_id: str = "") -> None:
+            job_id: str = "", shm: bool = False) -> None:
+        """``shm=True`` marks the value as a zero-copy view over the
+        node segment (a shm/transfer fetch): its bytes are arena-
+        resident, so it is excluded from the heap spill budget and the
+        plane's pin (released on entry drop) owns its lifetime."""
         sanitize_hooks.sched_point("store.put")
         manager = self.spill_manager
         with self._lock:
@@ -105,6 +121,7 @@ class MemoryStore:
             entry.value = value
             entry.error = error
             entry.ready = True
+            entry.shm_backed = shm and error is None
             if job_id:
                 entry.job_id = job_id
             entry.last_access = time.monotonic()
@@ -112,7 +129,8 @@ class MemoryStore:
                 from ray_tpu._private.spilling import estimate_size
 
                 entry.size = estimate_size(value)
-                manager.note_put(entry.size)
+                if not entry.shm_backed:
+                    manager.note_put(entry.size)
             callbacks = entry.callbacks
             entry.callbacks = []
         entry.event.set()
@@ -165,6 +183,8 @@ class MemoryStore:
         with self._lock:
             error, value, url = entry.error, entry.value, entry.spilled_url
             entry.last_access = time.monotonic()
+            if entry.shm_backed and value is not None:
+                entry.shm_read = True
         if error is not None:
             raise error
         if url is not None and value is None:
@@ -180,6 +200,8 @@ class MemoryStore:
                 return False, None, None
             error, value, url = entry.error, entry.value, entry.spilled_url
             entry.last_access = time.monotonic()
+            if entry.shm_backed and value is not None:
+                entry.shm_read = True
         if error is None and url is not None and value is None:
             return True, self._restore(object_id, entry, url), None
         return True, value, error
@@ -280,6 +302,8 @@ class MemoryStore:
                                  and entry.value is None):
                     values[i] = entry.value
                     entry.last_access = now
+                    if entry.shm_backed and entry.value is not None:
+                        entry.shm_read = True
                 else:
                     slow.append(i)
         if slow:
@@ -291,6 +315,88 @@ class MemoryStore:
                     remaining = max(0.0, deadline - time.monotonic())
                 values[i] = self.get(object_ids[i], remaining)
         return values
+
+    # -- shm-backed entries (SharedPlane swap/spill) ----------------------
+
+    def swap_to_shm(self, object_id: ObjectID, shm_value: Any) -> bool:
+        """Replace a resident heap value with its zero-copy shm view
+        (the producer just published it into the arena): the heap copy
+        is released and the entry's bytes stop counting against the
+        heap spill budget. True when the entry is (now) shm-backed."""
+        manager = self.spill_manager
+        heap_size = 0
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready or \
+                    entry.error is not None:
+                return False
+            if entry.shm_backed:
+                return True  # idempotent: already swapped
+            if entry.value is None or entry.spilled_url is not None:
+                return False
+            entry.value = shm_value
+            entry.shm_backed = True
+            # Pre-swap readers got the HEAP value; view-retention
+            # tracking restarts with the fresh shm view.
+            entry.shm_read = False
+            heap_size = entry.size
+        if manager is not None and heap_size:
+            manager.note_drop(heap_size)
+        return True
+
+    def entry_size(self, object_id: ObjectID) -> int:
+        """Estimated payload size of a ready entry (0 when unknown) —
+        what object-location reports carry for locality scoring."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return 0 if entry is None else (entry.size or 0)
+
+    def spill_shm_entry(self, object_id: ObjectID, plane) -> Optional[int]:
+        """Spill a swapped (shm-backed) entry's payload to disk and
+        flip the entry to URL-backed, so the caller (the plane's
+        pressure sweep) can drop its pin and reclaim the arena block.
+        Returns the payload size, or None when the entry is ineligible:
+        not shm-backed, errored, or possibly still viewed by an
+        in-process reader (whose zero-copy arrays would dangle if the
+        arena block were reused) — any local read since the swap
+        disqualifies it, since a reader may retain an INNER array the
+        container's refcount cannot witness."""
+        import sys
+
+        manager = self.spill_manager
+        if manager is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready or \
+                    entry.error is not None or not entry.shm_backed \
+                    or entry.value is None or entry.shm_read:
+                return None
+            # Belt over the read-tracking braces: entry.value slot +
+            # getrefcount's argument temporary = 2; anything above
+            # means someone holds the container right now.
+            if sys.getrefcount(entry.value) > 2:
+                return None
+        payload = plane.payload_bytes(object_id.binary())
+        if payload is None:
+            return None
+        url = manager.spill_payload(object_id, payload)
+        sanitize_hooks.sched_point("spill.mark")
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.ready or entry.value is None \
+                    or not entry.shm_backed or entry.shm_read \
+                    or sys.getrefcount(entry.value) > 2:
+                stale = True
+            else:
+                entry.value = None
+                entry.spilled_url = url
+                entry.shm_backed = False
+                stale = False
+        if stale:
+            manager.delete([url])
+            return None
+        return len(payload)
 
     # -- spilling hooks (called by SpillManager) --------------------------
 
@@ -304,6 +410,7 @@ class MemoryStore:
                 (e.last_access, oid, e.value, e.size, e.spilled_url)
                 for oid, e in self._entries.items()
                 if e.ready and e.error is None and e.value is not None
+                and not e.shm_backed
                 and e.size >= ray_config.min_spilling_size_bytes
             ]
         # last_access captured under the lock: entries may be deleted
@@ -314,10 +421,13 @@ class MemoryStore:
 
     def mark_spilled(self, object_id: ObjectID, url: str) -> bool:
         """Drop the in-memory value, keeping the disk URL. Returns False
-        if the entry disappeared (released meanwhile)."""
+        if the entry disappeared (released meanwhile) — or became
+        shm-backed (a publish swap raced the sweep's snapshot: the
+        arena owns the bytes now, the heap sweep must not flip it)."""
         with self._lock:
             entry = self._entries.get(object_id)
-            if entry is None or not entry.ready or entry.value is None:
+            if entry is None or not entry.ready or entry.value is None \
+                    or entry.shm_backed:
                 return False
             entry.value = None
             entry.spilled_url = url
@@ -328,7 +438,7 @@ class MemoryStore:
         spill URL for deletion."""
         manager = self.spill_manager
         if manager is not None and entry.ready and entry.error is None \
-                and entry.value is not None:
+                and entry.value is not None and not entry.shm_backed:
             manager.note_drop(entry.size)
         return entry.spilled_url
 
@@ -409,6 +519,7 @@ class MemoryStore:
                         urls.append(url)
                         entry.spilled_url = None
                     entry.value = None
+                    entry.shm_backed = False
                     entry.error = ObjectLostError(oid.hex(), f"object {oid} was freed")
         if urls and self.spill_manager is not None:
             self.spill_manager.delete(urls)

@@ -88,6 +88,11 @@ SCHED_POINTS = frozenset({
     "longpoll.client.loop",
     # cluster node: one coalesced submit_batch frame dispatch
     "cluster.submit_batch",
+    # object plane: spill pipeline (disk write done → entry flip) and
+    # transparent restore; one native descriptor-pull about to start
+    "spill.mark",
+    "spill.restore",
+    "objplane.pull",
 })
 
 CRASH_POINTS = frozenset({
@@ -96,6 +101,10 @@ CRASH_POINTS = frozenset({
     # after it but before the ack returns (they must survive).
     "gcs.commit.before",
     "gcs.commit.after",
+    # spill pipeline: death with the disk copy written but the store
+    # entry not yet flipped (the file is an orphan, the value must
+    # still be served from memory — never lost, never double-freed).
+    "spill.write.after",
 })
 
 POINTS = SCHED_POINTS | CRASH_POINTS

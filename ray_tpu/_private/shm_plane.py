@@ -8,17 +8,27 @@ shm segment (`src/object_store/store.cc`) with pickle protocol 5 —
 array buffers go out-of-band, so a reader on the same host reconstructs
 numpy arrays as zero-copy views over the mapped segment.
 
+This is the DEFAULT large-object path, not a best-effort probe: task
+outputs are published here and the producer's heap entry is swapped to
+the zero-copy shm view (`publish_task_output`), so a large value lives
+ONCE — in the arena — instead of heap+arena; the control plane then
+moves `wire.ObjectDescriptor`s (segment name, transfer endpoint, size)
+instead of pickled payloads whenever both ends can reach a segment.
+
 Lifecycle: readers pin objects on get (store refcount) and the pin is
 released when the local MemoryStore entry is dropped — i.e. zero-copy
 views are valid while an ObjectRef is in scope, the reference's
 documented contract for plasma-backed numpy. Creates that fail for lack
-of space retry after waiting out eviction (the reference's
-create-request-queue backpressure, `plasma/create_request_queue.h`),
-then fall back to the heap/RPC path.
+of space first spill the owner's cold, otherwise-unpinned objects to
+disk (URL on the store entry, transparent restore on get — the
+reference's LocalObjectManager spill pipeline applied to the arena),
+then wait out cross-process eviction, then fall back to the heap/RPC
+path.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import pickle
 import threading
@@ -27,6 +37,7 @@ from typing import Any, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.shm_store import ShmObjectStore
 
@@ -37,9 +48,45 @@ DEFAULT_THRESHOLD = int(os.environ.get("RAY_TPU_SHM_THRESHOLD", 64 * 1024))
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_SHM_CAPACITY",
                                       1024 * 2**20))
 
+# Object-plane observability (satellite of the bandwidth overhaul):
+# folded into /api/metrics as ray_tpu_object_* series by
+# runtime_metrics._collect_fastpath_stats, node-tagged on the head's
+# merged exposition via the PR 3 snapshot-shipping plane.
+_BACKPRESSURE_WAITS = _perf_stats.counter("object_create_backpressure_waits")
+_SHM_SPILLS = _perf_stats.counter("object_shm_spills")
+
 
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _parse_header(buf):
+    """(pickle_bytes, [(offset, length)]) from an RTS1 payload header.
+    Offsets are relative to the payload start."""
+    if bytes(buf[:4]) != _MAGIC:
+        return None, None
+    npik = int.from_bytes(bytes(buf[4:8]), "little")
+    nbuf = int.from_bytes(bytes(buf[8:12]), "little")
+    cur = 12
+    offs = []
+    for _ in range(nbuf):
+        boff = int.from_bytes(bytes(buf[cur:cur + 8]), "little")
+        blen = int.from_bytes(bytes(buf[cur + 8:cur + 16]), "little")
+        offs.append((boff, blen))
+        cur += 16
+    return bytes(buf[cur:cur + npik]), offs
+
+
+def decode_payload(raw) -> Any:
+    """Reconstruct a value from a self-contained RTS1 payload (a spilled
+    copy read back from disk). Array buffers view ``raw`` — immutable
+    and kept alive by the arrays' base reference."""
+    pik, offs = _parse_header(raw)
+    if pik is None:
+        raise ValueError("not an RTS1 object payload")
+    mv = memoryview(raw)
+    views = [mv[boff:boff + blen] for boff, blen in offs]
+    return pickle.loads(pik, buffers=views)
 
 
 class SharedPlane:
@@ -55,7 +102,15 @@ class SharedPlane:
                                     max_objects=max_objects, create=create)
         self._lock = threading.Lock()
         self._pinned: set[bytes] = set()
+        # Objects THIS process wrote, oldest-first with their total
+        # payload size: the spill victim scan (an owner can only spill
+        # what it owns — its pin is the one it may drop).
+        self._written: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
         self._owner = create
+        # Set by install(): the worker whose memory store carries the
+        # spill URLs for objects swapped out of this arena.
+        self._worker = None
 
     # -- write side ------------------------------------------------------
 
@@ -102,8 +157,16 @@ class SharedPlane:
                 self.store._handle, oid, total)
             if off != 2**64 - 1:
                 break
+            # Arena full even after the C side evicted every unpinned
+            # object: spill our own cold swapped entries to disk (URL
+            # on the store entry, restore on get) so the create can
+            # proceed, instead of looping on cross-process releases.
+            if self._spill_for_space(total, exclude=oid) > 0:
+                continue
             # Create-queue backpressure: eviction may need releases from
-            # other processes; wait briefly and retry.
+            # other processes; wait briefly and retry (the reference's
+            # create-request-queue, `plasma/create_request_queue.h`).
+            _BACKPRESSURE_WAITS.inc()
             if time.monotonic() >= deadline:
                 return False
             time.sleep(0.01)
@@ -123,7 +186,12 @@ class SharedPlane:
         for (boff, blen), r in zip(offs, raws):
             if blen:
                 view[off + boff:off + boff + blen] = r.cast("B")
-        return bool(self.store._lib.shm_obj_seal(self.store._handle, oid))
+        ok = bool(self.store._lib.shm_obj_seal(self.store._handle, oid))
+        if ok:
+            with self._lock:
+                self._written[oid] = total
+                self._written.move_to_end(oid)
+        return ok
 
     # -- read side -------------------------------------------------------
 
@@ -135,20 +203,10 @@ class SharedPlane:
         if buf is None:
             return False, None
         try:
-            if bytes(buf[:4]) != _MAGIC:
+            pik, offs = _parse_header(buf)
+            if pik is None:
                 self.store.release(oid)
                 return False, None
-            npik = int.from_bytes(bytes(buf[4:8]), "little")
-            nbuf = int.from_bytes(bytes(buf[8:12]), "little")
-            cur = 12
-            offs = []
-            for _ in range(nbuf):
-                boff = int.from_bytes(bytes(buf[cur:cur + 8]), "little")
-                blen = int.from_bytes(bytes(buf[cur + 8:cur + 16]),
-                                      "little")
-                offs.append((boff, blen))
-                cur += 16
-            pik = bytes(buf[cur:cur + npik])
             base = self.store._view
             # Offsets are relative to the object payload; rebase onto the
             # process-wide mapping so views outlive `buf`.
@@ -180,6 +238,17 @@ class SharedPlane:
         self.store.release(oid)  # balance the extra pin from the lookup
         return off
 
+    def payload_bytes(self, oid: bytes) -> Optional[bytes]:
+        """Self-contained copy of the sealed RTS1 payload (the spill
+        write source; `decode_payload` reverses it)."""
+        buf = self.store.get_bytes(oid)  # pins on success
+        if buf is None:
+            return None
+        try:
+            return bytes(buf)
+        finally:
+            self.store.release(oid)
+
     def contains(self, object_id: ObjectID) -> bool:
         return self.store.contains(object_id.binary())
 
@@ -191,6 +260,68 @@ class SharedPlane:
             self._pinned.discard(oid)
         self.store.release(oid)
 
+    def evict_object(self, object_id: ObjectID) -> None:
+        """Owner-side free: drop our pin and reclaim the arena block if
+        no other process still pins it (driver refcount hit zero — the
+        head's free fan-out). A pinned object is left to the C store's
+        LRU eviction once its readers release."""
+        oid = object_id.binary()
+        self.release(object_id)
+        try:
+            self.store.delete(oid)
+        except Exception:
+            pass
+        with self._lock:
+            self._written.pop(oid, None)
+
+    # -- spill-to-disk under arena pressure ------------------------------
+
+    def _spill_for_space(self, needed: int, exclude: bytes = b"") -> int:
+        """Spill this owner's cold swapped objects until ``needed``
+        arena bytes are reclaimed (or no eligible victim remains).
+        Eligible = written by us, pinned ONLY by us (shm refcount 1 —
+        no other process holds a zero-copy view), and the memory-store
+        entry's sole-holder check passes (`spill_shm_entry`). Returns
+        bytes reclaimed."""
+        from ray_tpu._private.config import ray_config
+
+        if not ray_config.shm_spill_enabled:
+            return 0
+        worker = self._worker
+        if worker is None:
+            return 0
+        store = worker.memory_store
+        if store.spill_manager is None:
+            return 0
+        freed = 0
+        with self._lock:
+            candidates = [ob for ob in self._written if ob != exclude]
+        for ob in candidates:
+            if freed >= needed:
+                break
+            rc = self.store.refcount(ob)
+            if rc < 0:
+                # Evicted/deleted behind our back: drop the stale entry.
+                with self._lock:
+                    self._written.pop(ob, None)
+                continue
+            if rc != 1:
+                continue  # another process's view pins it, or nobody
+                #           pins it (C eviction owns refcount-0 objects)
+            with self._lock:
+                if ob not in self._pinned:
+                    continue  # the one pin is not ours to drop
+            if store.spill_shm_entry(ObjectID(ob), self) is None:
+                continue
+            size = self.store.object_size(ob) or 0
+            self.release(ObjectID(ob))
+            if self.store.delete(ob):
+                freed += size
+                _SHM_SPILLS.inc()
+            with self._lock:
+                self._written.pop(ob, None)
+        return freed
+
     def stats(self) -> dict:
         return self.store.stats()
 
@@ -200,6 +331,7 @@ class SharedPlane:
         """Attach this plane to a Worker: large puts/outputs get shared,
         and MemoryStore entry GC releases shm pins."""
         worker.shm_plane = self
+        self._worker = worker
         store = worker.memory_store
         plane = self
 
@@ -255,5 +387,30 @@ def share_value(worker, object_id: ObjectID, value: Any) -> bool:
         return False
     try:
         return plane.maybe_put(object_id, value)
+    except Exception:
+        return False
+
+
+def publish_task_output(worker, object_id: ObjectID, value: Any) -> bool:
+    """Publish a task output into the node segment AND swap the local
+    heap entry to the zero-copy shm view: a large output then lives
+    ONCE, in the (budgeted, spillable) arena, instead of heap+arena —
+    the reference's plasma promotion of worker return values."""
+    plane: Optional[SharedPlane] = getattr(worker, "shm_plane", None)
+    if plane is None or value is None:
+        return False
+    try:
+        if not plane.maybe_put(object_id, value):
+            return False
+        found, view_value = plane.get(object_id)  # pins on success
+        if not found:
+            return True  # raced an eviction: the heap copy stands
+        if not worker.memory_store.swap_to_shm(object_id, view_value):
+            # Entry gone or errored (freed/failed concurrently): nothing
+            # will ever release this pin, so drop it now. (An already-
+            # swapped entry reports success and keeps the pin, which
+            # get()'s dedup made singular.)
+            plane.release(object_id)
+        return True
     except Exception:
         return False

@@ -92,6 +92,8 @@ def _load() -> ctypes.CDLL:
     lib.shm_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_refcount.restype = ctypes.c_int32
+    lib.shm_obj_refcount.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_store_stats.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(StoreStats)]
     lib.shm_store_mmap_size.restype = ctypes.c_uint64
@@ -245,6 +247,11 @@ class ShmObjectStore:
 
     def delete(self, object_id: bytes) -> bool:
         return bool(self._lib.shm_obj_delete(self._handle, object_id))
+
+    def refcount(self, object_id: bytes) -> int:
+        """Pin count of a sealed object across ALL attached processes,
+        or -1 when absent/unsealed (spill victim selection)."""
+        return int(self._lib.shm_obj_refcount(self._handle, object_id))
 
     def stats(self) -> dict:
         st = StoreStats()

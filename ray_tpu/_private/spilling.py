@@ -26,8 +26,15 @@ from typing import Dict, List, Optional
 
 import cloudpickle
 
+from ray_tpu._private import perf_stats as _perf_stats
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private.config import ray_config
 from ray_tpu._private.ids import ObjectID
+
+# Object-plane observability: spill/restore volume, exported as
+# ray_tpu_object_*_total by the runtime-metrics fold.
+_SPILL_BYTES = _perf_stats.counter("object_spill_bytes")
+_RESTORE_BYTES = _perf_stats.counter("object_restore_bytes")
 
 
 def estimate_size(value) -> int:
@@ -85,7 +92,13 @@ class FileSystemStorage(ExternalStorage):
 
     def spill(self, object_id: ObjectID, payload: bytes) -> str:
         os.makedirs(self.directory, exist_ok=True)
-        path = os.path.join(self.directory, object_id.hex())
+        # Unique per WRITE, not per object: the heap sweep and the
+        # arena spill can both write a copy of the same oid (a swap
+        # racing a sweep snapshot); with a deterministic path the
+        # loser's cleanup would unlink the winner's live file.
+        path = os.path.join(
+            self.directory,
+            f"{object_id.hex()}-{os.urandom(4).hex()}")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
@@ -176,8 +189,11 @@ class SpillManager:
                 continue
             payload = cloudpickle.dumps(value)
             url = self.storage.spill(oid, payload)
+            sanitize_hooks.crash_point("spill.write.after")
+            sanitize_hooks.sched_point("spill.mark")
             if self.store.mark_spilled(oid, url):
                 spilled += size
+                _SPILL_BYTES.inc(len(payload))
                 with self._lock:
                     self.in_memory_bytes -= size
                     self.spilled_bytes += len(payload)
@@ -186,8 +202,30 @@ class SpillManager:
                 self.storage.delete([url])
         return spilled
 
+    def spill_payload(self, object_id: ObjectID, payload: bytes) -> str:
+        """Write an already-serialized payload (a shm arena object's
+        RTS1 bytes — see ``shm_plane.payload_bytes``) to the storage
+        backend. The caller flips its own entry; accounting here."""
+        url = self.storage.spill(object_id, payload)
+        sanitize_hooks.crash_point("spill.write.after")
+        _SPILL_BYTES.inc(len(payload))
+        with self._lock:
+            self.spilled_bytes += len(payload)
+            self.num_spilled += 1
+        return url
+
     def restore(self, url: str):
-        value = cloudpickle.loads(self.storage.restore(url))
+        raw = self.storage.restore(url)
+        _RESTORE_BYTES.inc(len(raw))
+        sanitize_hooks.sched_point("spill.restore")
+        if raw[:4] == b"RTS1":
+            # A spilled shm-arena payload keeps its sealed layout; the
+            # decoder reconstructs with buffers viewing the loaded copy.
+            from ray_tpu._private.shm_plane import decode_payload
+
+            value = decode_payload(raw)
+        else:
+            value = cloudpickle.loads(raw)
         with self._lock:
             self.num_restored += 1
         return value

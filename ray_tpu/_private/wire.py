@@ -345,6 +345,22 @@ class TaskTemplate:
     payload: Any = None    # Opaque(SpecTemplate)
 
 
+@message("object.Descriptor", version=1)
+class ObjectDescriptor:
+    """Object-plane handoff: instead of pickling a large payload into
+    an RPC reply, the owner describes WHERE the sealed bytes live —
+    the shared segment holding them and the native transfer endpoint
+    serving them — and the requester reads zero-copy (same segment) or
+    pulls the chunked native stream (cross segment/host). The framed-
+    pickle value path remains for small objects and plane-less peers."""
+
+    oid: bytes = b""
+    shm: str = ""      # segment name holding the sealed payload
+    host: str = ""     # transfer server endpoint ("" = not served)
+    port: int = 0
+    size: int = 0      # sealed payload bytes (pull sizing / stats)
+
+
 @message("task.Call", version=1)
 class TaskCall:
     """One task submission against an interned template: only the

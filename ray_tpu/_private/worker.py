@@ -172,9 +172,13 @@ class Worker:
         for oid, value in zip(spec.return_ids, values):
             self.memory_store.put(oid, value, job_id=job)
             if self.shm_plane is not None:
-                from ray_tpu._private.shm_plane import share_value
+                # Default large-object path: serialize once into the
+                # node segment and swap the heap entry to the zero-copy
+                # view — the output lives in the (spillable) arena, not
+                # heap+arena.
+                from ray_tpu._private.shm_plane import publish_task_output
 
-                share_value(self, oid, value)
+                publish_task_output(self, oid, value)
 
     def submit(self, spec: TaskSpec) -> list[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.assign_return_ids()]

@@ -24,20 +24,36 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import perf_stats as _perf_stats
+from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import state as state_mod
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import ray_config
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import TaskKind
 from ray_tpu.exceptions import ActorDiedError, OwnerDiedError
+
+# Object-plane observability (ray_tpu_object_* in /api/metrics via the
+# runtime-metrics fold; node-tagged through the snapshot-shipping
+# plane): shm probe outcome, native pull volume/latency, and time spent
+# waiting for a bounded pull slot.
+_SHM_HITS = _perf_stats.counter("object_shm_hit")
+_SHM_MISSES = _perf_stats.counter("object_shm_miss")
+_PULL_BYTES = _perf_stats.counter("object_pull_bytes")
+_PULL_SECONDS = _perf_stats.latency("object_pull_seconds")
+_PULL_SLOT_WAIT = _perf_stats.latency("object_pull_slot_wait_seconds")
 
 
 def fetch_backoff(attempt: int) -> None:
     """Escalating poll interval for object-arrival waits: sub-ms first
     probes (most objects land within a few ms of submission — a flat
     10 ms sleep put a hard floor under every cross-process get), backing
-    off to 10 ms for slow producers."""
-    time.sleep(min(0.0005 * (1.6 ** min(attempt, 10)), 0.01))
+    off for slow producers. Curve knobs:
+    ``object_fetch_backoff_base_s`` / ``object_fetch_backoff_cap_s``."""
+    time.sleep(min(
+        ray_config.object_fetch_backoff_base_s * (1.6 ** min(attempt, 10)),
+        ray_config.object_fetch_backoff_cap_s))
 
 
 def try_shm_fetch(worker, oid) -> bool:
@@ -51,16 +67,63 @@ def try_shm_fetch(worker, oid) -> bool:
     except Exception:
         return False
     if not found:
+        _SHM_MISSES.inc()
         return False
-    worker.memory_store.put(oid, value)
+    _SHM_HITS.inc()
+    worker.memory_store.put(oid, value, shm=True)
     return True
 
 
 # Bandwidth-aware pull bounding (reference: pull_manager.h:52 — cap
-# in-flight pull bytes): at most N wire pulls at once; excess callers
-# wait their turn instead of thrashing the link with parallel streams
-# that each crawl.
-_WIRE_PULL_SLOTS = threading.BoundedSemaphore(2)
+# in-flight pull bytes): at most `object_pull_max_concurrent` wire
+# pulls at once; excess callers wait their turn instead of thrashing
+# the link with parallel streams that each crawl. Rebuilt when the
+# config knob changes (tests, tuning).
+_pull_slots_lock = threading.Lock()
+_pull_slots: Optional[threading.BoundedSemaphore] = None
+_pull_slots_cap = 0
+
+
+def _wire_pull_slots() -> threading.BoundedSemaphore:
+    global _pull_slots, _pull_slots_cap
+    cap = max(1, int(ray_config.object_pull_max_concurrent))
+    with _pull_slots_lock:
+        if _pull_slots is None or _pull_slots_cap != cap:
+            _pull_slots = threading.BoundedSemaphore(cap)
+            _pull_slots_cap = cap
+        return _pull_slots
+
+
+def pull_via_transfer(worker, plane, oid, host: str, port: int) -> bool:
+    """One bounded, range-striped native pull into the local segment,
+    then the zero-copy shm read (reference: ObjectManager Pull with
+    chunked parallel transfers)."""
+    sanitize_hooks.sched_point("objplane.pull")
+    try:
+        # Bounded wait for a pull slot: a hung peer must degrade the
+        # bound, never deadlock the whole object plane (the C layer's
+        # per-syscall socket timeout reclaims the slot eventually).
+        slots = _wire_pull_slots()
+        t0 = time.monotonic()
+        acquired = slots.acquire(timeout=30.0)
+        _PULL_SLOT_WAIT.record(time.monotonic() - t0)
+        t1 = time.monotonic()
+        try:
+            rc = plane.store.pull_from_striped(
+                oid.binary(), host, port,
+                streams=max(1, int(ray_config.object_pull_streams)),
+                allow_local=getattr(plane, "allow_local_pull", True))
+        finally:
+            if acquired:
+                slots.release()
+        if rc not in (0, -5):
+            return False
+        if rc == 0:
+            _PULL_SECONDS.record(time.monotonic() - t1)
+            _PULL_BYTES.inc(plane.store.object_size(oid.binary()) or 0)
+        return try_shm_fetch(worker, oid)
+    except Exception:
+        return False
 
 
 def try_transfer_fetch(worker, oid, loc_info) -> bool:
@@ -75,34 +138,39 @@ def try_transfer_fetch(worker, oid, loc_info) -> bool:
     transfer = loc_info.get("transfer")
     if transfer is None or loc_info.get("shm") == plane.name:
         return False
-    try:
-        # Bounded wait for a pull slot: a hung peer must degrade the
-        # bound, never deadlock the whole object plane (the C layer's
-        # per-syscall socket timeout reclaims the slot eventually).
-        acquired = _WIRE_PULL_SLOTS.acquire(timeout=30.0)
-        try:
-            rc = plane.store.pull_from(
-                oid.binary(), transfer[0], transfer[1],
-                allow_local=getattr(plane, "allow_local_pull", True))
-        finally:
-            if acquired:
-                _WIRE_PULL_SLOTS.release()
-        if rc not in (0, -5):
-            return False
-        return try_shm_fetch(worker, oid)
-    except Exception:
+    return pull_via_transfer(worker, plane, oid, transfer[0], transfer[1])
+
+
+def resolve_descriptor(worker, oid, desc) -> bool:
+    """Materialize an object the owner answered with a descriptor for:
+    same segment → plain zero-copy read; served cross-segment → striped
+    native pull; no plane here → cannot (caller retries the value
+    path)."""
+    plane = getattr(worker, "shm_plane", None)
+    if plane is None:
         return False
+    if desc.shm == plane.name:
+        return try_shm_fetch(worker, oid)
+    if desc.host:
+        return pull_via_transfer(worker, plane, oid, desc.host, desc.port)
+    return False
 
 
 def batch_fetch_objects(worker, oids, locate, self_address):
     """Shared batched-pull core (driver fetch dispatcher + node dep
     fetch): local/shm probes per object, ONE ``locate(need)`` call for
     the rest, transfer-plane pull where possible, then one
-    ``get_objects_batch`` RPC per owner. Returns ``(resolved set,
-    failed {oid: exc}, unresolved list)`` — unresolved objects simply
-    aren't anywhere yet (slow producer) and are the caller's to retry.
+    ``get_objects_batch`` RPC per owner — whose replies carry
+    ``wire.ObjectDescriptor``s for plane-reachable payloads (resolved
+    by shm read / native pull) and framed-pickle values only for small
+    or plane-less objects. Returns ``(resolved set, failed {oid: exc},
+    unresolved list)`` — unresolved objects simply aren't anywhere yet
+    (slow producer) and are the caller's to retry.
     """
+    from ray_tpu._private import wire
+
     store = worker.memory_store
+    plane = getattr(worker, "shm_plane", None)
     resolved: set = set()
     failed: Dict[Any, Exception] = {}
     unresolved: list = []
@@ -118,10 +186,17 @@ def batch_fetch_objects(worker, oids, locate, self_address):
     by_addr: Dict[tuple, list] = {}
     for oid, info in zip(need, infos):
         if info is not None and tuple(info["address"]) != tuple(self_address):
-            if try_transfer_fetch(worker, oid, info):
+            if plane is not None and info.get("shm") == plane.name:
+                # Owner shares our segment: the pre-locate probe may
+                # simply have raced the seal — re-probe before falling
+                # back to a payload-copying RPC.
+                if try_shm_fetch(worker, oid):
+                    resolved.add(oid)
+                    continue
+            elif try_transfer_fetch(worker, oid, info):
                 resolved.add(oid)
-            else:
-                by_addr.setdefault(tuple(info["address"]), []).append(oid)
+                continue
+            by_addr.setdefault(tuple(info["address"]), []).append(oid)
         elif store.contains(oid):
             resolved.add(oid)
         else:
@@ -130,19 +205,69 @@ def batch_fetch_objects(worker, oids, locate, self_address):
         try:
             replies = RpcClient.to(addr).call(
                 "get_objects_batch",
-                oids=[o.binary() for o in group], timeout=10.0)
+                oids=[o.binary() for o in group], timeout=10.0,
+                shm=plane.name if plane is not None else None,
+                can_pull=plane is not None)
         except Exception as e:
             for oid in group:
                 failed[oid] = e
             continue
         for oid, reply in zip(group, replies):
             ok, value, err = reply
-            if ok:
+            if not ok:
+                unresolved.append(oid)
+            elif isinstance(value, wire.ObjectDescriptor):
+                if resolve_descriptor(worker, oid, value):
+                    resolved.add(oid)
+                else:
+                    unresolved.append(oid)
+            else:
                 store.put(oid, value, error=err)
                 resolved.add(oid)
-            else:
-                unresolved.append(oid)
     return resolved, failed, unresolved
+
+
+def descriptor_object_read(worker, transfer_addr, get_object, oids,
+                           timeout: float = 30.0, shm=None,
+                           can_pull: bool = False):
+    """Owner-side ``get_objects_batch`` core: resolve every requested
+    object under a shared deadline, then answer with an
+    ``ObjectDescriptor`` wherever the requester can reach the sealed
+    bytes — same segment (zero-copy read) or our transfer server
+    (native pull) — and with the framed-pickle value otherwise. An
+    object that left the arena (spilled, evicted) but is large enough
+    is republished on demand so the descriptor path stays the default.
+    """
+    from ray_tpu._private import wire
+    from ray_tpu._private.rpc import batched_object_read
+    from ray_tpu._private.shm_plane import share_value
+
+    out = batched_object_read(get_object, oids, timeout)
+    plane = getattr(worker, "shm_plane", None)
+    if plane is None:
+        return out
+    same_seg = shm is not None and shm == plane.name
+    served = can_pull and transfer_addr is not None
+    if not (same_seg or served):
+        return out
+    for i, (oid, reply) in enumerate(zip(oids, out)):
+        ok, value, err = reply
+        if not ok or err is not None:
+            continue
+        if not plane.store.contains(oid):
+            # Left the arena (spilled/evicted) or never crossed the
+            # threshold: republish large restored values on demand.
+            if value is None or not share_value(worker, ObjectID(oid),
+                                                value):
+                continue
+        size = plane.store.object_size(oid)
+        if size is None:
+            continue
+        host, port = ("", 0) if same_seg else tuple(transfer_addr)
+        out[i] = [True, wire.ObjectDescriptor(
+            oid=oid, shm=plane.name, host=host, port=int(port),
+            size=int(size)), None]
+    return out
 
 
 class _NodeRecord:
@@ -203,6 +328,10 @@ class ClusterHead:
         self._lock = threading.Lock()
         self.nodes: Dict[str, _NodeRecord] = {}
         self.object_locations: Dict[bytes, Tuple[str, int]] = {}
+        # Reported payload sizes alongside locations (same lifecycle):
+        # what locality-aware lease placement scores by — the directory
+        # knows where the bytes are AND how many they are.
+        self.object_sizes: Dict[bytes, int] = {}
         self.actor_nodes: Dict[bytes, str] = {}
         # Failure/recovery state. lineage maps each task-return object to
         # its creating spec; inflight maps task_id -> (node_id, spec)
@@ -336,11 +465,13 @@ class ClusterHead:
         `pubsub/publisher.h:188-216`)."""
         return self.publisher.poll(channel, subscriber_id, cursor, timeout)
 
-    def _report_objects(self, oids: List[bytes], address):
+    def _report_objects(self, oids: List[bytes], address, sizes=None):
         frees = []
         with self._lock:
-            for oid in oids:
+            for i, oid in enumerate(oids):
                 self.object_locations[oid] = tuple(address)
+                if sizes is not None and i < len(sizes) and sizes[i]:
+                    self.object_sizes[oid] = int(sizes[i])
                 self._recon_attempts.pop(oid, None)
                 # Outputs landed: the producing task is no longer in
                 # flight anywhere; its arg pins drop with it.
@@ -416,6 +547,7 @@ class ClusterHead:
         self.driver_released.discard(oid)
         self.lineage.pop(oid, None)
         self._recon_attempts.pop(oid, None)
+        self.object_sizes.pop(oid, None)
         loc = self.object_locations.pop(oid, None)
         if loc is not None and loc != self.server.address:
             return [(loc, oid)]
@@ -517,6 +649,7 @@ class ClusterHead:
                     if loc == addr]
             for oid in lost:
                 del self.object_locations[oid]
+                self.object_sizes.pop(oid, None)
             resubmit = [spec for (nid, spec) in self.inflight.values()
                         if nid == node_id]
             for spec in resubmit:
@@ -693,11 +826,12 @@ class ClusterHead:
         tax)."""
         return [self._locate2(oid) for oid in oids]
 
-    def _get_objects_batch(self, oids, timeout: float = 30.0):
-        from ray_tpu._private.rpc import batched_object_read
-
-        return batched_object_read(
-            lambda oid, t: self._get_object(oid, timeout=t), oids, timeout)
+    def _get_objects_batch(self, oids, timeout: float = 30.0,
+                           shm=None, can_pull: bool = False):
+        return descriptor_object_read(
+            self.worker, getattr(self, "transfer_addr", None),
+            lambda oid, t: self._get_object(oid, timeout=t), oids,
+            timeout, shm=shm, can_pull=can_pull)
 
     def _route_task(self, spec) -> bool:
         """Submit a node-originated spec through the head's cluster
@@ -857,6 +991,12 @@ class ClusterBackendMixin:
                     local._available.get(k, 0) - pending.get(k, 0) >= v
                     for k, v in request.items())
             if fits_local:
+                # Locality override: a task whose large args live on a
+                # remote node should follow the bytes, not pull them
+                # here to follow a small spec.
+                if self._locality_prefers_remote(spec) and \
+                        self._lease_submit(spec, request):
+                    return
                 self._ensure_local_deps(spec)
                 self.local_backend.submit(spec)
                 return
@@ -946,7 +1086,19 @@ class ClusterBackendMixin:
                 if lease is None:
                     return False
             else:
-                lease = min(leases,
+                # Leases are keyed by resource SHAPE; a held lease may
+                # sit on the wrong node for THIS task's bytes. Prefer a
+                # lease already on the locality target, granting one
+                # there if none exists yet.
+                loc = self._locality_target(spec)
+                preferred = [l for l in leases
+                             if loc is not None
+                             and l["node_id"] == loc.node_id]
+                if loc is not None and not preferred:
+                    extra = self._grant_lease(key, spec, target=loc)
+                    if extra is not None:
+                        preferred = [extra]
+                lease = min(preferred or leases,
                             key=lambda l: l["pipe"].in_flight)
                 # Saturated: ask for one more lease on another node.
                 if lease["pipe"].in_flight >= max(
@@ -959,13 +1111,16 @@ class ClusterBackendMixin:
             lease["last_used"] = now
         return self._lease_send(lease, spec)
 
-    def _grant_lease(self, key, spec, exclude=()) -> Optional[dict]:
+    def _grant_lease(self, key, spec, exclude=(),
+                     target=None) -> Optional[dict]:
         """One head scheduling decision for a task SHAPE (not a task):
         locality-aware node choice + slot count from the pushed view.
-        Caller holds _lease_lock."""
+        Caller holds _lease_lock; a caller that already computed the
+        locality target passes it to skip the re-scan."""
         from ray_tpu._private.resources import to_milli
 
-        target = self._locality_target(spec, exclude)
+        if target is None:
+            target = self._locality_target(spec, exclude)
         if target is None:
             target = self._choose_node(spec, exclude=exclude)
         if target is None:
@@ -989,37 +1144,112 @@ class ClusterBackendMixin:
         self._leases.setdefault(key, []).append(lease)
         return lease
 
-    def _locality_target(self, spec, exclude=()):
-        """Lease policy (reference `lease_policy.h:56`): prefer the node
-        already holding the task's largest object argument, if it has
-        capacity for the shape."""
+    def _arg_bytes_by_addr(self, spec) -> Dict[tuple, int]:
+        """Resident argument bytes per owner address, from the head's
+        object directory (locations + reported sizes). Cheap when the
+        spec has no ObjectRef args — the common fan-out case."""
         from ray_tpu.object_ref import ObjectRef
+
+        head = self.head
+        out: Dict[tuple, int] = {}
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if not isinstance(arg, ObjectRef):
+                continue
+            ob = arg.id.binary()
+            loc = head.object_locations.get(ob)
+            if loc is None:
+                continue
+            addr = tuple(loc)
+            out[addr] = out.get(addr, 0) + head.object_sizes.get(ob, 0)
+        return out
+
+    def _locality_target(self, spec, exclude=()):
+        """Lease policy (reference `lease_policy.h:56`): score candidate
+        nodes by RESIDENT ARGUMENT BYTES — a task with a 64MB argument
+        runs where the bytes already live instead of pulling them to
+        follow a 200-byte spec. Ties (equal bytes) fall back to the
+        least-loaded ordering the default policy uses; nodes below
+        ``locality_min_arg_bytes`` never win on locality alone."""
+        if not ray_config.locality_aware_scheduling:
+            return None
+        bytes_by_addr = self._arg_bytes_by_addr(spec)
+        if not bytes_by_addr:
+            return None
         from ray_tpu._private.resources import to_milli
 
-        best_addr = None
-        for arg in list(spec.args) + list(spec.kwargs.values()):
-            if isinstance(arg, ObjectRef):
-                loc = self.head.object_locations.get(arg.id.binary())
-                if loc is not None:
-                    best_addr = tuple(loc)
-                    break  # first object arg wins (sizes not tracked)
-        if best_addr is None:
-            return None
         request = to_milli(spec.resources)
+        best, best_bytes, best_load = None, 0, -1.0
         for node in self.head.nodes.values():
             if node.node_id in exclude or not node.alive:
                 continue
-            if tuple(node.address) != best_addr:
+            nbytes = bytes_by_addr.get(tuple(node.address), 0)
+            if nbytes < ray_config.locality_min_arg_bytes:
                 continue
-            if all(node.available.get(k, 0) * 1000 >= v
-                   for k, v in request.items()):
-                return node
-        return None
+            if not all(node.available.get(k, 0) * 1000 >= v
+                       for k, v in request.items()):
+                continue
+            load_score = sum(node.available.values()) \
+                - 0.1 * node.backlog
+            if nbytes > best_bytes or (nbytes == best_bytes
+                                       and load_score > best_load):
+                best, best_bytes, best_load = node, nbytes, load_score
+        return best
+
+    def _locality_prefers_remote(self, spec) -> bool:
+        """True when the spec's resident argument bytes make a REMOTE
+        node the cheaper home even though the task fits locally (the
+        local-first fast path would otherwise pull the bytes here)."""
+        if not ray_config.locality_aware_scheduling:
+            return False
+        bytes_by_addr = self._arg_bytes_by_addr(spec)
+        if not bytes_by_addr:
+            return False
+        local = bytes_by_addr.get(tuple(self.head.server.address), 0)
+        remote = max((b for addr, b in bytes_by_addr.items()
+                      if addr != tuple(self.head.server.address)),
+                     default=0)
+        return remote >= ray_config.locality_min_arg_bytes \
+            and remote > local
+
+    def _promote_large_args(self, spec):
+        """Large plain-value args are published to the object plane and
+        replaced by ObjectRefs at the wire boundary, so the TaskCall /
+        shipped spec carries a descriptor-resolvable reference instead
+        of megabytes of pickle (the reference puts big args in plasma
+        at submission). Only obviously-sized values promote (arrays,
+        buffers, strings — `nbytes`/`len` is authoritative); containers
+        ship as before."""
+        plane = getattr(self.worker, "shm_plane", None)
+        if plane is None:
+            return spec
+        from ray_tpu.object_ref import ObjectRef
+
+        threshold = max(int(ray_config.shm_share_threshold_bytes), 1)
+
+        def big(v) -> bool:
+            if v is None or isinstance(v, (ObjectRef, bool, int, float)):
+                return False
+            nbytes = getattr(v, "nbytes", None)
+            if isinstance(nbytes, int):
+                return nbytes >= threshold
+            if isinstance(v, (bytes, bytearray, str)):
+                return len(v) >= threshold
+            return False
+
+        if not any(big(a) for a in spec.args) and \
+                not any(big(v) for v in spec.kwargs.values()):
+            return spec
+        put = self.worker.put_object
+        spec.args = tuple(put(a) if big(a) else a for a in spec.args)
+        spec.kwargs = {k: (put(v) if big(v) else v)
+                       for k, v in spec.kwargs.items()}
+        return spec
 
     def _lease_send(self, lease, spec) -> bool:
         record = self.head.nodes.get(lease["node_id"])
         if record is None or not record.alive:
             return False
+        spec = self._promote_large_args(spec)
         self._publish_local_args(record, spec)
         # Same bookkeeping as _send: lineage + inflight BEFORE the wire.
         self.head.record_lineage(spec)
@@ -1566,14 +1796,17 @@ class ClusterBackendMixin:
         on-demand dep fetch remains the fallback for every miss)."""
         from ray_tpu.object_ref import ObjectRef
 
-        local_oids = [arg.id.binary()
-                      for arg in list(spec.args)
+        store = self.worker.memory_store
+        local_refs = [arg for arg in list(spec.args)
                       + list(spec.kwargs.values())
                       if isinstance(arg, ObjectRef)
-                      and self.worker.memory_store.contains(arg.id)]
-        if not local_oids:
+                      and store.contains(arg.id)]
+        if not local_refs:
             return
-        self.head._report_objects(local_oids, self.head.server.address)
+        local_oids = [arg.id.binary() for arg in local_refs]
+        self.head._report_objects(
+            local_oids, self.head.server.address,
+            sizes=[store.entry_size(arg.id) for arg in local_refs])
         self._maybe_push_args(node, local_oids)
 
     def _maybe_push_args(self, node: _NodeRecord, local_oids) -> None:
@@ -1612,6 +1845,7 @@ class ClusterBackendMixin:
                          name="arg-push").start()
 
     def _send(self, node: _NodeRecord, spec):
+        spec = self._promote_large_args(spec)
         # Ordering fence: this synchronous submission must not overtake
         # coalesced frames already enqueued for the same node on the
         # pipelined channel (e.g. tasks submitted just before an actor

@@ -181,6 +181,45 @@ def generate_jobs_dashboard() -> dict:
     ], uid="ray-tpu-jobs")
 
 
+def generate_object_plane_dashboard() -> dict:
+    """Object-plane bandwidth panels (the PR 10 overhaul): shm probe
+    hit rate, native pull volume/latency, spill/restore traffic, arena
+    occupancy + eviction/backpressure pressure signals — all node-
+    tagged through the head's merged exposition."""
+    return generate_dashboard("ray_tpu object plane", [
+        {"title": "Shm probe hit rate",
+         "exprs": [("rate(ray_tpu_object_shm_hit_total[1m]) / "
+                    "(rate(ray_tpu_object_shm_hit_total[1m]) + "
+                    "rate(ray_tpu_object_shm_miss_total[1m]))",
+                    "hit rate {{node}}")]},
+        {"title": "Native pull throughput", "unit": "Bps",
+         "exprs": [("rate(ray_tpu_object_pull_bytes_total[1m])",
+                    "pull B/s {{node}}")]},
+        {"title": "Pull latency", "unit": "s",
+         "exprs": [("ray_tpu_object_pull_seconds_p50", "p50 {{node}}"),
+                   ("ray_tpu_object_pull_seconds_p95",
+                    "p95 {{node}}")]},
+        {"title": "Pull slot wait", "unit": "s",
+         "exprs": [("ray_tpu_object_pull_slot_wait_seconds_p95",
+                    "p95 {{node}}")]},
+        {"title": "Spill / restore", "unit": "Bps",
+         "exprs": [("rate(ray_tpu_object_spill_bytes_total[1m])",
+                    "spill B/s {{node}}"),
+                   ("rate(ray_tpu_object_restore_bytes_total[1m])",
+                    "restore B/s {{node}}")]},
+        {"title": "Arena pressure",
+         "exprs": [("rate(ray_tpu_shm_evictions[1m])",
+                    "evictions/s {{node}}"),
+                   ("rate(ray_tpu_object_create_backpressure_waits_"
+                    "total[1m])", "backpressure waits/s {{node}}"),
+                   ("rate(ray_tpu_object_shm_spills_total[1m])",
+                    "arena spills/s {{node}}")]},
+        {"title": "Arena occupancy", "unit": "bytes",
+         "exprs": [("ray_tpu_shm_allocated", "allocated {{node}}"),
+                   ("ray_tpu_shm_capacity", "capacity {{node}}")]},
+    ], uid="ray-tpu-object-plane")
+
+
 def write_dashboards(directory: str) -> List[str]:
     """Write all generated dashboards into a Grafana provisioning dir;
     returns the file paths."""
@@ -189,7 +228,8 @@ def write_dashboards(directory: str) -> List[str]:
     for dash in (generate_default_dashboard(),
                  generate_serve_dashboard(),
                  generate_observability_dashboard(),
-                 generate_jobs_dashboard()):
+                 generate_jobs_dashboard(),
+                 generate_object_plane_dashboard()):
         path = os.path.join(directory, f"{dash['uid']}.json")
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)

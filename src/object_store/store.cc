@@ -360,6 +360,13 @@ bool ShmStore::Delete(const uint8_t* id) {
   return true;
 }
 
+int32_t ShmStore::Refcount(const uint8_t* id) {
+  MutexGuard g(&header_->mutex);
+  ObjectEntry* e = FindEntry(id);
+  if (!e || e->state != (int32_t)ObjectState::kSealed) return -1;
+  return e->refcount;
+}
+
 uint64_t ShmStore::uuid() const { return header_->uuid; }
 
 StoreStats ShmStore::Stats() {
@@ -427,6 +434,10 @@ int shm_obj_release(void* store, const uint8_t* id) {
 
 int shm_obj_delete(void* store, const uint8_t* id) {
   return static_cast<ShmStore*>(store)->Delete(id) ? 1 : 0;
+}
+
+int32_t shm_obj_refcount(void* store, const uint8_t* id) {
+  return static_cast<ShmStore*>(store)->Refcount(id);
 }
 
 void shm_store_stats(void* store, ray_tpu::StoreStats* out) {

@@ -98,6 +98,11 @@ class ShmStore {
   bool Contains(const uint8_t* id);
   bool Release(const uint8_t* id);
   bool Delete(const uint8_t* id);  // refcount must be 0
+  // Current pin count of a sealed object, or -1 when absent/unsealed.
+  // The spill victim selector uses this: an object whose only pin is
+  // the owner's own can leave the arena without invalidating any
+  // other process's zero-copy view.
+  int32_t Refcount(const uint8_t* id);
   StoreStats Stats();
 
   const char* name() const { return name_; }
@@ -154,6 +159,7 @@ uint64_t shm_obj_get(void* store, const uint8_t* id, uint64_t* size_out);
 int shm_obj_contains(void* store, const uint8_t* id);
 int shm_obj_release(void* store, const uint8_t* id);
 int shm_obj_delete(void* store, const uint8_t* id);
+int32_t shm_obj_refcount(void* store, const uint8_t* id);
 void shm_store_stats(void* store, ray_tpu::StoreStats* out);
 uint64_t shm_store_mmap_size(void* store);
 }

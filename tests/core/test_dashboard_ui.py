@@ -90,7 +90,15 @@ def test_grafana_dashboard_factory(tmp_path):
     assert len(pos) == 6
 
     paths = write_dashboards(str(tmp_path))
-    assert len(paths) == 4  # core, serve, observability, jobs
+    # core, serve, observability, jobs, object-plane
+    assert len(paths) == 5
+    obj = next(p for p in paths if "object-plane" in p)
+    with open(obj) as f:
+        obj_exprs = " ".join(t["expr"]
+                             for p in json.load(f)["panels"]
+                             for t in p["targets"])
+    assert "ray_tpu_object_pull_bytes_total" in obj_exprs
+    assert "ray_tpu_object_spill_bytes_total" in obj_exprs
     for p in paths:
         with open(p) as f:
             loaded = json.load(f)

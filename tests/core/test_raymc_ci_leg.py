@@ -58,7 +58,7 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     assert report["pass"] is True
     by_name = {s["scenario"]: s for s in report["scenarios"]}
     assert set(by_name) == {"router_cap", "gcs_durability",
-                            "pipelined_close"}
+                            "pipelined_close", "spill_race"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"

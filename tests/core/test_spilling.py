@@ -79,6 +79,8 @@ def test_release_deletes_spill_files(ray_local):
 
 
 def test_spilled_task_output_roundtrip(ray_local):
+    import time
+
     @ray_tpu.remote
     def big(i):
         return np.full((256, 1024), i, dtype=np.float32)
@@ -87,7 +89,14 @@ def test_spilled_task_output_roundtrip(ray_local):
     outs = ray_tpu.get(refs)
     for i, out in enumerate(outs):
         assert float(out[0, 0]) == float(i)
-    assert ray_local.memory_store.spill_manager.stats()["num_spilled"] > 0
+    # get() returns when values resolve; the last put's spill sweep may
+    # still be running on its executor thread — bounded wait, not race.
+    manager = ray_local.memory_store.spill_manager
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and \
+            manager.stats()["num_spilled"] == 0:
+        time.sleep(0.01)
+    assert manager.stats()["num_spilled"] > 0
 
 
 def test_small_objects_never_spill(ray_local):

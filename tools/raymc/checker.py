@@ -72,6 +72,10 @@ def check(scenario_factory: Callable[[], Scenario],
     cfg = cfg or ExplorerConfig()
     probe = scenario_factory()
     result = CheckResult(scenario=probe.name)
+    # A scenario may declare the budget its exhaustive sweep needs
+    # (scenario.max_schedules); the wall-clock budget still binds.
+    schedule_cap = max(cfg.max_schedules,
+                       getattr(probe, "max_schedules", 0) or 0)
     t0 = time.monotonic()
     deadline = t0 + cfg.time_budget_s
 
@@ -80,7 +84,7 @@ def check(scenario_factory: Callable[[], Scenario],
     budget_hit = False
 
     while stack:
-        if result.executions >= cfg.max_schedules \
+        if result.executions >= schedule_cap \
                 or time.monotonic() > deadline:
             budget_hit = True
             break

@@ -70,6 +70,11 @@ class Scenario:
     # Scheduling decisions per execution before the explorer stops
     # branching and free-runs the tail (marks the check non-exhaustive).
     max_steps = 48
+    # Minimum schedule budget this scenario needs to DRAIN its bounded
+    # space (0 = the checker config's default). A scenario whose
+    # exhaustive sweep is cheap but wider than the CLI default raises
+    # this so the tier-1 leg's `exhausted` claim stays honest.
+    max_schedules = 0
     # Whether the scenario touches the ray_tpu runtime (ObjectRefs,
     # ray_tpu.wait/put) and needs ray_tpu.init() before checking.
     needs_ray = False

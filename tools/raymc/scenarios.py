@@ -573,13 +573,150 @@ class LongPollRecoveryScenario(Scenario):
         self.client._thread.join(2.0)
 
 
+# -- spill pipeline vs ref release vs restore --------------------------------
+
+
+class SpillRaceScenario(Scenario):
+    name = "spill_race"
+    description = ("disk spill racing ref release and transparent "
+                   "restore: an acked object is never lost, a freed "
+                   "object never resurrects")
+    points = ("spill.mark", "spill.restore")
+    crash_points = ("spill.write.after",)
+    crash_budget = 1
+    max_steps = 24
+    # Exhaustive sweep of this space is ~1.5k schedules (≈2s): above
+    # the CLI default cap, well inside the tier-1 wall budget.
+    max_schedules = 2500
+    block_grace_s = 0.04
+
+    def setup(self) -> None:
+        from ray_tpu._private.config import ray_config
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.memory_store import MemoryStore
+        from ray_tpu._private.spilling import SpillManager
+
+        # Small objects must be spill-eligible for the race to be
+        # reachable at model-checking scale; restored in teardown.
+        self._saved_min = ray_config.min_spilling_size_bytes
+        ray_config.min_spilling_size_bytes = 1
+        self.store = MemoryStore()
+        # Seed while the budget is huge (no spill during setup) …
+        self.manager = self.store.spill_manager = SpillManager(
+            self.store, budget_bytes=10 ** 12)
+        self.a_oid = ObjectID.from_random()
+        self.b_oid = ObjectID.from_random()
+        self.a_value = b"A" * 4096
+        self.store.put(self.a_oid, self.a_value)
+        self.store.put(self.b_oid, b"B" * 4096)
+        # … then shrink it so the spiller action must sweep both.
+        self.manager.budget = 1
+        self.b_freed = False
+        self.crashed = None
+        self.spill_done = False
+        self.a_reads: List = []
+
+    def actions(self):
+        def spiller():
+            self.manager.maybe_spill()
+            self.spill_done = True
+
+        def releaser():
+            self.store.free([self.b_oid])
+            self.b_freed = True
+
+        def reader():
+            ready, value, error = self.store.peek(self.a_oid)
+            self.a_reads.append((ready, bytes(value) if value else None,
+                                 error))
+
+        return [("spiller", spiller), ("releaser", releaser),
+                ("reader", reader)]
+
+    def _spill_path(self, url) -> str:
+        return url[len("file://"):] if url else ""
+
+    def invariants(self):
+        def a_never_lost(s):
+            entry = s.store._entries.get(s.a_oid)
+            if entry is None or not entry.ready or entry.error is not None:
+                return "acked object A lost its store entry"
+            if entry.value is not None:
+                return True
+            path = s._spill_path(entry.spilled_url)
+            return (path and os.path.exists(path)) or \
+                "A is value-less with no durable spilled copy"
+
+        def b_never_resurrects(s):
+            if not s.b_freed:
+                return True
+            entry = s.store._entries.get(s.b_oid)
+            if entry is None or entry.error is None or \
+                    entry.value is not None:
+                return "freed object B resurrected with a live value"
+            if entry.spilled_url is not None:
+                return ("freed object B still carries a restorable "
+                        f"spill URL: {entry.spilled_url}")
+            if s.crashed or not s.spill_done:
+                # A crashed spiller may orphan its in-flight file —
+                # disk garbage a dead process's storage dir reclaims,
+                # unreachable by any entry; and a mid-sweep file (write
+                # done, mark/delete pending) is legal in-flight state.
+                return True
+            # Once the sweep completed crash-free, the mark-fails→
+            # delete path must have left no ghost copy behind (spill
+            # files are <oid.hex()>-<token>, unique per write).
+            try:
+                ghosts = [n for n in os.listdir(
+                    s.manager.storage.directory)
+                    if n.startswith(s.b_oid.hex())]
+            except OSError:
+                ghosts = []
+            return (not ghosts) or \
+                f"freed object B left readable spill ghost(s): {ghosts}"
+
+        return [
+            Invariant("spill-no-loss", a_never_lost,
+                      description="an acked object survives spill/"
+                                  "restore/crash interleavings"),
+            Invariant("spill-no-resurrection", b_never_resurrects,
+                      description="a freed object never comes back"),
+        ]
+
+    def liveness(self):
+        def a_reads_correct(s):
+            # The reader ran to completion in every non-crashed
+            # execution; whatever it observed must be A's real bytes.
+            return all(ready and err is None and value == s.a_value
+                       for ready, value, err in s.a_reads)
+
+        return [Liveness("reader-sees-acked-value", a_reads_correct,
+                         timeout_s=1.0,
+                         description="peek(A) returns the acked bytes "
+                                     "through any spill state")]
+
+    def on_crash(self, point: str) -> None:
+        self.crashed = point  # the spiller thread dies; nothing to kill
+
+    def teardown(self) -> None:
+        from ray_tpu._private.config import ray_config
+
+        ray_config.min_spilling_size_bytes = self._saved_min
+        try:
+            self.manager.storage.destroy()
+        except Exception:
+            pass
+
+
 SCENARIOS = {
     cls.name: cls
     for cls in (RouterCapScenario, PipelinedCloseScenario,
                 GroupCommitDurabilityScenario,
-                ExactlyOnceResubmitScenario, LongPollRecoveryScenario)
+                ExactlyOnceResubmitScenario, LongPollRecoveryScenario,
+                SpillRaceScenario)
 }
 
 # The bounded tier-1 leg: real code, small configs, exhaustive where
 # the scenario supports it (see test_raymc_ci_leg.py).
-DEFAULT_SCENARIOS = ("router_cap", "gcs_durability", "pipelined_close")
+DEFAULT_SCENARIOS = ("router_cap", "gcs_durability", "pipelined_close",
+                     "spill_race")
