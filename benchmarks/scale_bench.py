@@ -356,6 +356,128 @@ def cluster_scale_chaos(nodes=4, n_actors=200, n_tasks=8000):
         cluster.shutdown()
 
 
+def chaos(broadcast_mb=256, n_consumers=200):
+    """Fault-tolerance headline (ROADMAP item 3): kill a node holding
+    ~256MB of broadcast objects MID-JOB. The job completes through
+    lineage reconstruction + actor restart, and the recovery is
+    visible in the fault counters, not just in "it didn't hang"."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import perf_stats
+    from ray_tpu._private.config import ray_config
+    from ray_tpu._private.task_spec import NodeAffinitySchedulingStrategy
+    from ray_tpu.cluster_utils import Cluster
+
+    def counter(name, outcome=None):
+        # counter() is create-or-get on the process-global registry:
+        # reading .value is the public lookup.
+        return perf_stats.counter(
+            name, {"outcome": outcome} if outcome else None).value
+
+    FAULT_COUNTERS = {
+        "node_deaths": ("node_deaths", None),
+        "node_death_lost_bytes": ("node_death_lost_bytes", None),
+        "reconstructions_reexecute": ("reconstructions", "reexecute"),
+        "reconstructions_from_spill": ("reconstructions", "from_spill"),
+        "actor_restarts_restarted": ("actor_restarts", "restarted"),
+        "actor_calls_replayed": ("actor_restarts", "call_replayed"),
+        "actor_calls_rejected": ("actor_restarts", "call_rejected"),
+    }
+    # Deltas, not absolutes: earlier sections in a full sweep (e.g.
+    # cluster_scale_chaos) leave their own recovery activity in the
+    # process-global counters.
+    base = {k: counter(*v) for k, v in FAULT_COUNTERS.items()}
+
+    old_period = ray_config.health_check_period_s
+    ray_config.health_check_period_s = 0.3
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        # simulate_remote_host: each node owns its own segment, so the
+        # kill genuinely loses the victim's bytes.
+        victim = cluster.add_node(num_cpus=4,
+                                  simulate_remote_host=True)
+        survivor = cluster.add_node(num_cpus=4,
+                                    simulate_remote_host=True)
+        assert survivor
+        chunk_mb = 64
+        n_chunks = max(1, broadcast_mb // chunk_mb)
+
+        # soft NodeAffinity: the broadcast chunks are PRODUCED on the
+        # victim (they die with it), but the reconstruction resubmit of
+        # the same spec may fall back to any live node.
+        @ray_tpu.remote(num_cpus=1,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=victim, soft=True))
+        def produce(i):
+            return np.full(chunk_mb * 1024 * 1024 // 8, float(i))
+
+        chunks = [produce.remote(i) for i in range(n_chunks)]
+        head = cluster.head
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(c.id.binary() in head.object_locations
+                   for c in chunks):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("broadcast chunks never landed")
+
+        # A couple of actors on the victim with restart + retry budget:
+        # their calls must ride the restart, not die with the node.
+        @ray_tpu.remote(num_cpus=0.05, max_restarts=1,
+                        max_task_retries=2,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=victim, soft=True))
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        actors = [Counter.remote() for _ in range(2)]
+        assert all(ray_tpu.get([a.bump.remote() for a in actors],
+                               timeout=120))
+
+        @ray_tpu.remote(num_cpus=1, max_retries=5)
+        def consume(part, j):
+            return float(part[j % 1000])
+
+        t0 = time.perf_counter()
+        refs = [consume.remote(chunks[j % n_chunks], j)
+                for j in range(n_consumers)]
+        actor_refs = [a.bump.remote() for a in actors for _ in range(4)]
+        time.sleep(1.0)  # mid-drain, with the victim's bytes in play
+        cluster.kill_node(victim)
+        got = ray_tpu.get(refs, timeout=900)
+        assert all(got[j] == float(j % n_chunks)
+                   for j in range(n_consumers)), "wrong values after kill"
+        actor_got = ray_tpu.get(actor_refs, timeout=300)
+        assert all(v >= 1 for v in actor_got)
+        t_drain = time.perf_counter() - t0
+
+        counters = {k: counter(*v) - base[k]
+                    for k, v in FAULT_COUNTERS.items()}
+        assert counters["node_deaths"] >= 1
+        assert counters["reconstructions_reexecute"] >= 1, \
+            "job completed without any visible reconstruction"
+        return {
+            "broadcast_mb": chunk_mb * n_chunks,
+            "chunks": n_chunks,
+            "consumers": n_consumers,
+            "chaos": "node holding the broadcast killed 1.0s into "
+                     "the drain",
+            "drain_s": round(t_drain, 2),
+            "consume_per_s": round(n_consumers / t_drain, 1),
+            "counters": counters,
+        }
+    finally:
+        ray_config.health_check_period_s = old_period
+        cluster.shutdown()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
@@ -375,7 +497,10 @@ def main():
     def want(name):
         return not wanted or name in wanted
 
+    from benchmarks.perf_bench import host_calibration
+
     out = {"host_cpus": os.cpu_count(),
+           "host_calibration": host_calibration(),
            "note": "single-core host; reference envelope runs on a 64+"
                    "-node AWS fleet (release/benchmarks/README.md)"}
 
@@ -403,6 +528,9 @@ def main():
         section("cluster_remote_tasks", cluster_remote_tasks, out)
     if want("cluster_scale_chaos"):
         section("cluster_scale_chaos", cluster_scale_chaos, out)
+    if want("chaos"):
+        section("chaos",
+                lambda: chaos(broadcast_mb=args.broadcast_mb), out)
 
     print(json.dumps(out, indent=2))
     if args.out:

@@ -1,0 +1,222 @@
+"""Actor restart gate: caller-visible replay-or-reject semantics.
+
+Role-equivalent to the reference's actor fault-tolerance contract
+(`gcs_actor_manager.h` restart FSM + `direct_actor_task_submitter.h`
+client-side queueing): when an actor's node dies, the actor transitions
+ALIVE → RESTARTING (budget permitting) → ALIVE, or → DEAD when
+``max_restarts`` is exhausted, and every call observes a *defined*
+outcome keyed to its own ``max_task_retries``:
+
+- a call **in flight** on the dying node replays against the restarted
+  actor when it has retry budget (decrementing it), else rejects with
+  an error naming the restart state and the remaining budget;
+- a call **submitted during the restart window** parks (bounded by
+  ``actor_restart_timeout_s``) and dispatches to the replacement when
+  it has retry budget, else rejects immediately;
+- a call against a DEAD (budget-exhausted) actor fails fast with an
+  ``ActorDiedError`` naming the exhausted budget — it must never fall
+  through to a backend that silently drops it.
+
+This class is pure decision state — no RPC, no worker, no threads — so
+the bounded model checker (`tools/raymc` ``actor_restart`` scenario)
+can prove the contract over every interleaving of callers, node death,
+and restart completion at small scope; ``ClusterHead`` wires the
+decisions to real dispatch/park/fail effects.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ray_tpu._private import sanitize_hooks
+
+
+class ActorRestartState:
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class ActorRestartGate:
+    """Per-head actor restart FSM + per-call replay-or-reject policy."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Parked callers wait on state transitions (ready / mark_dead /
+        # rollback) instead of busy-polling.
+        self._changed = threading.Condition(self._lock)
+        self._state: Dict[bytes, str] = {}
+        self._budget: Dict[bytes, int] = {}   # restarts left; -1 = inf
+        self._max_restarts: Dict[bytes, int] = {}
+        self._cause: Dict[bytes, str] = {}    # DEAD tombstone cause
+
+    # -- registration / introspection -----------------------------------
+
+    def register(self, actor_id: bytes, max_restarts: int) -> None:
+        """First sighting of an actor creation: seed budget + state.
+        Idempotent — a resubmitted creation spec must not reset a
+        partially-consumed budget."""
+        with self._lock:
+            if actor_id in self._state:
+                return
+            self._state[actor_id] = ActorRestartState.ALIVE
+            self._budget[actor_id] = max_restarts
+            self._max_restarts[actor_id] = max_restarts
+
+    def state(self, actor_id: bytes) -> Optional[str]:
+        with self._lock:
+            return self._state.get(actor_id)
+
+    def restarts_left(self, actor_id: bytes) -> int:
+        with self._lock:
+            return self._budget.get(actor_id, 0)
+
+    def death_cause(self, actor_id: bytes) -> str:
+        with self._lock:
+            return self._cause.get(actor_id, "")
+
+    def _budget_desc_locked(self, actor_id: bytes) -> str:
+        left = self._budget.get(actor_id, 0)
+        mx = self._max_restarts.get(actor_id, 0)
+        if left == -1:
+            return "max_restarts=-1 (infinite)"
+        return f"{left} of max_restarts={mx} left"
+
+    # -- restart FSM -----------------------------------------------------
+
+    def begin_restart(self, actor_id: bytes, reason: str) -> bool:
+        """The actor's host died. Returns True when a restart was
+        started (budget consumed, state → RESTARTING); False when the
+        budget is exhausted (state → DEAD, tombstoned with a cause
+        naming the budget)."""
+        sanitize_hooks.sched_point("actor.restart.begin")
+        with self._lock:
+            try:
+                if self._state.get(actor_id) == ActorRestartState.DEAD:
+                    return False
+                left = self._budget.get(actor_id, 0)
+                if left == 0:
+                    mx = self._max_restarts.get(actor_id, 0)
+                    self._state[actor_id] = ActorRestartState.DEAD
+                    self._cause[actor_id] = (
+                        f"{reason}; restart budget exhausted "
+                        f"(max_restarts={mx}, 0 restarts left)")
+                    return False
+                if left > 0:
+                    self._budget[actor_id] = left - 1
+                self._state[actor_id] = ActorRestartState.RESTARTING
+                return True
+            finally:
+                self._changed.notify_all()
+
+    def ready(self, actor_id: bytes) -> None:
+        """The replacement registered a live location: parked callers
+        may dispatch now."""
+        sanitize_hooks.sched_point("actor.restart.ready")
+        with self._lock:
+            if self._state.get(actor_id) == ActorRestartState.RESTARTING:
+                self._state[actor_id] = ActorRestartState.ALIVE
+            self._changed.notify_all()
+
+    def rollback_ready(self, actor_id: bytes) -> None:
+        """A location gain was unwound (the send to the chosen node
+        failed and the directory entry was popped): an ALIVE flip must
+        not stand with no live location, or parked/new calls fall
+        through to a backend that has never heard of the actor. The
+        re-dispatch (or queue/fail path) will flip it again."""
+        with self._lock:
+            if self._state.get(actor_id) == ActorRestartState.ALIVE:
+                self._state[actor_id] = ActorRestartState.RESTARTING
+            self._changed.notify_all()
+
+    def mark_dead(self, actor_id: bytes, cause: str) -> None:
+        with self._lock:
+            self._state[actor_id] = ActorRestartState.DEAD
+            self._cause.setdefault(actor_id, cause)
+            self._changed.notify_all()
+
+    def wait_change(self, timeout_s: float) -> None:
+        """Park until some actor's gate state changes (bounded): the
+        wake signal for parked-call waiters — no busy polling."""
+        with self._changed:
+            self._changed.wait(timeout_s)
+
+    # -- per-call decisions ----------------------------------------------
+    #
+    # Both take effect callbacks rather than returning verdicts: the
+    # decision and its effect wiring are ONE product seam — ClusterHead
+    # passes real dispatch/park/fail closures, the model checker passes
+    # counters, and both exercise the same branch structure.
+
+    def route_call(self, spec, dispatch: Callable, park: Callable,
+                   fail: Callable) -> None:
+        """Submission-time decision for an actor call with no live
+        location. ``dispatch()`` is never called here (there is no
+        node); ``park(spec)`` queues the call for the restart window;
+        ``fail(spec, msg, dead)`` rejects it (``dead``: tombstone vs
+        mid-restart rejection)."""
+        del dispatch  # routing without a location never dispatches
+        sanitize_hooks.sched_point("actor.route")
+        with self._lock:
+            state = self._state.get(spec.actor_id.binary())
+            msg = self._reject_msg_locked(spec, state)
+        if msg is None:
+            park(spec)
+        else:
+            fail(spec, msg, state == ActorRestartState.DEAD)
+
+    def recover_call(self, spec, resubmit: Callable,
+                     fail: Callable) -> None:
+        """Replay-or-reject for a call that was IN FLIGHT on a node
+        that died. A replay consumes one unit of the call's own
+        ``max_task_retries`` budget (``spec.max_retries``); a call with
+        none left — or whose actor is DEAD — rejects with an error
+        naming the state and the remaining budgets."""
+        sanitize_hooks.sched_point("actor.replay")
+        aid = spec.actor_id.binary()
+        with self._lock:
+            state = self._state.get(aid)
+            if state == ActorRestartState.DEAD:
+                msg = (f"call {spec.describe()} was in flight when the "
+                       f"actor died: {self._cause.get(aid, 'dead')}")
+            elif spec.max_retries == 0:
+                msg = (f"call {spec.describe()} was in flight when its "
+                       f"node died and has no retries left "
+                       f"(max_task_retries budget exhausted: 0 left); "
+                       f"actor is {state or 'UNKNOWN'} "
+                       f"({self._budget_desc_locked(aid)})")
+            else:
+                # The replay consumes one retry NOW; attempt marks the
+                # spec as replay-authorized so the routing decision it
+                # is about to re-enter parks it instead of re-judging
+                # the (already-charged) budget.
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1
+                spec.attempt = getattr(spec, "attempt", 0) + 1
+                msg = None
+        if msg is None:
+            resubmit(spec)
+        else:
+            fail(spec, msg, state == ActorRestartState.DEAD)
+
+    def _reject_msg_locked(self, spec, state) -> Optional[str]:
+        """None = park; else the rejection message. A call that races a
+        completed restart (state already ALIVE again) parks — the park
+        waiter dispatches it immediately — rather than spuriously
+        rejecting a call against a healthy actor."""
+        aid = spec.actor_id.binary()
+        if state == ActorRestartState.DEAD:
+            return (f"call {spec.describe()} rejected: "
+                    f"{self._cause.get(aid, 'actor is dead')}")
+        if state == ActorRestartState.RESTARTING and \
+                spec.max_retries == 0 and \
+                getattr(spec, "attempt", 0) == 0:
+            # attempt > 0 = a replay recover_call already authorized
+            # (and charged) — it must park for the replacement, not be
+            # re-judged against its now-consumed budget.
+            return (f"call {spec.describe()} rejected: actor is "
+                    f"RESTARTING and the call has no retry budget to "
+                    f"ride the restart window (max_task_retries=0; "
+                    f"actor restarts: {self._budget_desc_locked(aid)})")
+        return None

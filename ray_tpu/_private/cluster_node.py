@@ -101,6 +101,7 @@ class NodeRuntime:
         self._spec_templates = LruTable(8192)
         self._shutdown_event = threading.Event()
         self._install_report_hook()
+        self._install_spill_report()
         self._install_borrow_hooks()
         self._install_cluster_actor_routing()
         self._install_cluster_kv()
@@ -232,6 +233,42 @@ class NodeRuntime:
                                   worker.memory_store.entry_size(roid)))
 
         worker.store_task_outputs = store_and_report
+
+    def _install_spill_report(self):
+        """Spilled objects report their durable URL to the head: if
+        this node later dies, the head restores the lost object from
+        the surviving disk copy instead of re-executing its creating
+        task (reconstruction-composes-with-spill). Reports COALESCE on
+        a drainer thread (same shape as the output reporter): one
+        pressure sweep spilling dozens of objects makes one RPC, not
+        one per object, and the spill path never blocks on the head."""
+        import queue as _q
+
+        node = self
+        report_q: "_q.SimpleQueue" = _q.SimpleQueue()
+
+        def report_loop():
+            while True:
+                items = [report_q.get()]
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 0.05:
+                    try:
+                        items.append(report_q.get_nowait())
+                    except _q.Empty:
+                        time.sleep(0.005)
+                try:
+                    node.head.call("report_spilled",
+                                   oids=[ob for ob, _ in items],
+                                   urls=[u for _, u in items],
+                                   node_id=node.node_id)
+                except Exception:
+                    pass  # best effort: re-execution remains the net
+
+        threading.Thread(target=report_loop, daemon=True,
+                         name="spill-reporter").start()
+        self.worker.memory_store.on_spilled = \
+            lambda object_id, url: report_q.put((object_id.binary(),
+                                                 url))
 
     def _install_borrow_hooks(self):
         """Register this node as a borrower of every object it holds a
@@ -448,6 +485,7 @@ class NodeRuntime:
             job_id=getattr(call, "job_id", "") or "",
         )
         spec.max_retries = call.max_retries
+        spec.attempt = getattr(call, "attempt", 0) or 0
         spec.assign_return_ids()
         return spec
 

@@ -75,6 +75,18 @@ class RayTpuConfig:
     #    task_manager.h lineage pinning) ---------------------------------
     enable_object_reconstruction: bool = True
     max_reconstruction_attempts: int = 3
+    # Recursive reconstruction of a lost chain stops at this depth (a
+    # lineage cycle or pathological dependency chain must terminate;
+    # each OBJECT is still charged its own max_reconstruction_attempts).
+    max_reconstruction_depth: int = 16
+
+    # -- actor fault tolerance (reference: gcs_actor_manager.h restart
+    #    FSM + direct_actor_task_submitter.h client-side queueing) ------
+    # Calls submitted (or caught in flight) while an actor restarts park
+    # this long waiting for the replacement before failing with an
+    # ActorUnavailableError naming the restart state. Only calls with
+    # max_task_retries > 0 park; others reject immediately.
+    actor_restart_timeout_s: float = 30.0
 
     # -- rpc -------------------------------------------------------------
     rpc_connect_retries: int = 10

@@ -48,6 +48,13 @@ class StoreClient:
         # durable. Backends overriding close() must keep that promise.
         self.flush()
 
+    def crash(self) -> None:
+        """Simulated hard process death: release resources WITHOUT the
+        durability promise of close() — writes still riding the
+        group-commit window are deliberately lost (the crash-mode head
+        failover's documented loss bound)."""
+        self.close()
+
 
 class InMemoryStoreClient(StoreClient):
     """Reference: `in_memory_store_client.h:31`."""
@@ -228,6 +235,26 @@ class SqliteStoreClient(StoreClient):
                 self._conn.commit()
             finally:
                 self._conn.close()
+
+    def crash(self) -> None:
+        """Hard-death teardown: drop the connection with the pending
+        transaction UNCOMMITTED (sqlite rolls it back) — exactly what a
+        SIGKILL'd process leaves behind. Acked (flushed) writes are on
+        disk; the open group-commit window is lost. Under the store
+        lock so a mid-statement writer is sequenced before the close
+        (closing under a running conn.execute is a C-level
+        use-after-free)."""
+        self._closed.set()
+        self._dirty.set()
+        with self._lock:
+            try:
+                self._conn.rollback()
+            except Exception:
+                pass
+            try:
+                self._conn.close()
+            except Exception:
+                pass
 
 
 def make_store_client() -> StoreClient:

@@ -566,13 +566,14 @@ class LocalBackend:
             self.worker.store_task_outputs(spec, self._split_returns(spec, result))
             events.task_finished(spec)
         except exc.WorkerCrashedError as e:
-            # The actor's worker process died mid-call: fail this call,
-            # then restart the actor (within max_restarts) — reference:
-            # gcs_actor_manager.h restart FSM on worker failure.
+            # The actor's worker process died mid-call: restart the
+            # actor (within max_restarts) — reference:
+            # gcs_actor_manager.h restart FSM on worker failure. The
+            # call itself replays on the replacement when its own
+            # max_task_retries budget covers it (the restart-window
+            # mailbox contract), else rejects naming the budget.
             events.task_finished(spec, error=f"WorkerCrashedError: {e}")
-            self.worker.store_task_outputs(
-                spec, None, error=exc.TaskError(e, spec.describe()))
-            self._handle_actor_crash(actor, str(e))
+            self._handle_actor_crash(actor, str(e), inflight_spec=spec)
         except BaseException as e:  # noqa: BLE001
             events.task_finished(spec, error=f"{type(e).__name__}: {e}")
             err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
@@ -657,9 +658,14 @@ class LocalBackend:
         err = e if isinstance(e, exc.TaskError) else exc.TaskError(e, spec.describe())
         self.worker.store_task_outputs(spec, None, error=err)
 
-    def _handle_actor_crash(self, actor: _Actor, cause: str):
-        """Worker-process death: restart in place if budget remains
-        (queued calls survive onto the replacement), else die."""
+    def _handle_actor_crash(self, actor: _Actor, cause: str,
+                            inflight_spec: Optional[TaskSpec] = None):
+        """Worker-process death: restart in place if budget remains —
+        queued calls survive onto the replacement, and the call that
+        was EXECUTING replays ahead of them iff its own
+        max_task_retries budget covers it (caller-visible
+        replay-or-reject; the reject names the remaining budgets) —
+        else die."""
         spec = actor.spec
         can_restart = spec.max_restarts == -1 or \
             actor.num_restarts < spec.max_restarts
@@ -675,11 +681,40 @@ class LocalBackend:
             replacement = _Actor(self, spec)
             replacement.num_restarts = actor.num_restarts + 1
             self._actors[actor.actor_id] = replacement
+            if inflight_spec is not None:
+                if inflight_spec.max_retries != 0:
+                    # Replay FIRST — it was dispatched before everything
+                    # still queued — charging its per-call budget.
+                    if inflight_spec.max_retries > 0:
+                        inflight_spec.max_retries -= 1
+                    inflight_spec.attempt = getattr(
+                        inflight_spec, "attempt", 0) + 1
+                    replacement.mailbox.put(inflight_spec)
+                else:
+                    restarts_left = "-1 (infinite)" \
+                        if spec.max_restarts == -1 else str(
+                            spec.max_restarts - actor.num_restarts - 1)
+                    self.worker.store_task_outputs(
+                        inflight_spec, None,
+                        error=exc.ActorUnavailableError(
+                            f"call {inflight_spec.describe()} was "
+                            f"executing when the actor's worker "
+                            f"crashed and has no retries left "
+                            f"(max_task_retries budget exhausted); "
+                            f"actor is RESTARTING "
+                            f"({restarts_left} restarts left)"))
             for item in drained:
                 replacement.mailbox.put(item)
             self._pending_add(spec)
             self._ready.put(spec)
             return
+        if inflight_spec is not None:
+            self.worker.store_task_outputs(
+                inflight_spec, None,
+                error=exc.ActorDiedError(
+                    actor.actor_id.hex()[:8],
+                    f"{actor.death_cause}; restart budget exhausted "
+                    f"(max_restarts={spec.max_restarts})"))
         for item in drained:
             self.worker.store_task_outputs(
                 item, None,

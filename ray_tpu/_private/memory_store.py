@@ -97,6 +97,11 @@ class MemoryStore:
         # Optional SpillManager (ray_tpu._private.spilling): set by the
         # worker when an object-store budget is configured.
         self.spill_manager = spill_manager
+        # Optional spill observer fn(object_id, url): cluster mode wires
+        # this to the head's spill-URL directory so a lost object with a
+        # surviving disk copy restores instead of re-executing. Called
+        # OUTSIDE the store lock, best-effort.
+        self.on_spilled = None
 
     def _entry(self, object_id: ObjectID) -> _Entry:
         entry = self._entries.get(object_id)
@@ -396,6 +401,7 @@ class MemoryStore:
         if stale:
             manager.delete([url])
             return None
+        self._notify_spilled(object_id, url)
         return len(payload)
 
     # -- spilling hooks (called by SpillManager) --------------------------
@@ -431,7 +437,16 @@ class MemoryStore:
                 return False
             entry.value = None
             entry.spilled_url = url
-            return True
+        self._notify_spilled(object_id, url)
+        return True
+
+    def _notify_spilled(self, object_id: ObjectID, url: str) -> None:
+        hook = self.on_spilled
+        if hook is not None:
+            try:
+                hook(object_id, url)
+            except Exception:
+                pass
 
     def _drop_entry_locked(self, entry: _Entry) -> Optional[str]:
         """Common release path: account the dropped bytes, hand back any

@@ -93,6 +93,22 @@ SCHED_POINTS = frozenset({
     "spill.mark",
     "spill.restore",
     "objplane.pull",
+    # actor fault tolerance: the restart gate's routing decision (park /
+    # dispatch / reject), the replay-or-reject decision for a call whose
+    # node died mid-flight, and the restart FSM edges
+    "actor.route",
+    "actor.replay",
+    "actor.restart.begin",
+    "actor.restart.ready",
+    # lineage reconstruction: a locate miss deciding to reconstruct,
+    # the re-execution resubmit, and a restore from a spilled copy
+    "recon.request",
+    "recon.resubmit",
+    "recon.restore",
+    # head registration surface (GCS-restart convergence: the report-
+    # returns-False → re-register path)
+    "head.node_report",
+    "head.register",
 })
 
 CRASH_POINTS = frozenset({

@@ -37,6 +37,30 @@ _SPILL_BYTES = _perf_stats.counter("object_spill_bytes")
 _RESTORE_BYTES = _perf_stats.counter("object_restore_bytes")
 
 
+def decode_spilled_payload(raw: bytes):
+    """Decode one spilled payload: RTS1-framed arena bytes (sealed
+    layout, buffers viewing the loaded copy) or plain cloudpickle —
+    the ONE sniff both transparent restore and lineage
+    restore-from-spill share."""
+    if raw[:4] == b"RTS1":
+        from ray_tpu._private.shm_plane import decode_payload
+
+        return decode_payload(raw)
+    return cloudpickle.loads(raw)
+
+
+def restore_spilled_payload(url: str):
+    """Restore a spilled object from its URL without a SpillManager —
+    the lineage-reconstruction path: a dead node's spill file outlives
+    the process, and the head restores the value from disk instead of
+    re-executing the creating task."""
+    assert url.startswith("file://"), url
+    with open(url[len("file://"):], "rb") as f:
+        raw = f.read()
+    _RESTORE_BYTES.inc(len(raw))
+    return decode_spilled_payload(raw)
+
+
 def estimate_size(value) -> int:
     """Cheap recursive size estimate — exact for buffers/arrays (where
     the bytes are), rough for object graphs (which spilling doesn't
@@ -218,14 +242,7 @@ class SpillManager:
         raw = self.storage.restore(url)
         _RESTORE_BYTES.inc(len(raw))
         sanitize_hooks.sched_point("spill.restore")
-        if raw[:4] == b"RTS1":
-            # A spilled shm-arena payload keeps its sealed layout; the
-            # decoder reconstructs with buffers viewing the loaded copy.
-            from ray_tpu._private.shm_plane import decode_payload
-
-            value = decode_payload(raw)
-        else:
-            value = cloudpickle.loads(raw)
+        value = decode_spilled_payload(raw)
         with self._lock:
             self.num_restored += 1
         return value

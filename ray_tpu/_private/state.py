@@ -167,6 +167,16 @@ class GlobalState:
         except Exception:
             pass
 
+    def crash_storage(self) -> None:
+        """Hard-crash teardown (crash-mode head failover): the store
+        connection drops WITHOUT flushing — at most the open
+        group-commit window (``gcs_commit_interval_s``) of accepted-
+        but-unflushed writes is lost, and none of them can resurrect."""
+        try:
+            self._store.crash()
+        except Exception:
+            pass
+
     # -- cluster introspection -------------------------------------------
 
     def nodes(self) -> list:

@@ -167,6 +167,12 @@ class TaskSpec:
     num_returns: "int | str" = 1  # int, or "dynamic" (generator task)
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 3
+    # Which attempt this dispatch is (0 = first). Node-death resubmits
+    # and actor-call replays increment it and decrement max_retries —
+    # the pair is the per-spec retry ledger, and both ride the wire
+    # (TaskCall.attempt / full-spec shipping) so a replayed dispatch is
+    # observably a replay on the receiving node too.
+    attempt: int = 0
     retry_exceptions: Any = False  # False | True | list of exception types
     scheduling_strategy: SchedulingStrategy = field(
         default_factory=DefaultSchedulingStrategy

@@ -380,3 +380,8 @@ class TaskCall:
     # header exactly like trace_parent so attribution survives the
     # interned fast path.
     job_id: str = ""
+    # Retry ledger (added field): which dispatch attempt this call is
+    # (0 = first; a node-death resubmit ships attempt+1 with the
+    # already-decremented max_retries, so retry accounting survives the
+    # interned fast path the same way job_id does).
+    attempt: int = 0

@@ -44,6 +44,25 @@ _PULL_BYTES = _perf_stats.counter("object_pull_bytes")
 _PULL_SECONDS = _perf_stats.latency("object_pull_seconds")
 _PULL_SLOT_WAIT = _perf_stats.latency("object_pull_slot_wait_seconds")
 
+# Fault-path observability (ray_tpu_node_deaths_total,
+# ray_tpu_node_death_lost_bytes_total, ray_tpu_reconstructions_total
+# {outcome}, ray_tpu_actor_restarts_total{outcome} after the runtime-
+# metrics fold): every recovery decision leaves a countable trace, so a
+# chaos run's "the job completed" comes with "and here is what it cost".
+_NODE_DEATHS = _perf_stats.counter("node_deaths")
+_NODE_DEATH_LOST_BYTES = _perf_stats.counter("node_death_lost_bytes")
+
+
+def _recon_counter(outcome: str):
+    """reconstructions{outcome}: reexecute | from_spill | exhausted."""
+    return _perf_stats.counter("reconstructions", {"outcome": outcome})
+
+
+def _restart_counter(outcome: str):
+    """actor_restarts{outcome}: restarted | exhausted | call_replayed |
+    call_rejected."""
+    return _perf_stats.counter("actor_restarts", {"outcome": outcome})
+
 
 def fetch_backoff(attempt: int) -> None:
     """Escalating poll interval for object-arrival waits: sub-ms first
@@ -312,6 +331,18 @@ class _NodeRecord:
         self.known_templates = LruTable(4096)
 
 
+class _NullServer:
+    """Transport stub for a head constructed with ``start_server=False``
+    (model-checking / unit harnesses): carries the address identity and
+    a no-op shutdown, nothing listens."""
+
+    def __init__(self, address: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.address = tuple(address)
+
+    def shutdown(self) -> None:
+        pass
+
+
 class ClusterHead:
     """GCS-equivalent services hosted in the driver process.
 
@@ -323,7 +354,7 @@ class ClusterHead:
     re-execution of lost work.
     """
 
-    def __init__(self, worker, port: int = 0):
+    def __init__(self, worker, port: int = 0, start_server: bool = True):
         self.worker = worker
         self._lock = threading.Lock()
         self.nodes: Dict[str, _NodeRecord] = {}
@@ -336,12 +367,28 @@ class ClusterHead:
         # Failure/recovery state. lineage maps each task-return object to
         # its creating spec; inflight maps task_id -> (node_id, spec)
         # until outputs are reported; actor_specs keeps creation specs for
-        # restart-on-node-death.
+        # restart-on-node-death; the gate owns restart budgets, the
+        # ALIVE/RESTARTING/DEAD FSM, and per-call replay-or-reject.
         self.lineage: Dict[bytes, Any] = {}
         self.inflight: Dict[bytes, Tuple[str, Any]] = {}
         self.actor_specs: Dict[bytes, Any] = {}
-        self.actor_restarts_left: Dict[bytes, int] = {}
+        from ray_tpu._private.actor_gate import ActorRestartGate
+
+        self.actor_gate = ActorRestartGate()
+        # Gate-registered actors whose (restarted) home is the HEAD's
+        # local backend: distinguishes "ALIVE with no directory entry
+        # because it lives here" from the transient no-location window
+        # mid-death-sweep (where calls must park, not fall through to a
+        # backend that has never heard of the actor).
+        self.actor_local: set = set()
         self._recon_attempts: Dict[bytes, int] = {}
+        # Durable spilled copies by object (node-reported): when a node
+        # dies, its spilled RTS1 files outlive the process (they sit on
+        # the node-local disk this single-host simulation shares — a
+        # real deployment needs shared/remote spill storage for this to
+        # hold across hosts), so reconstruction restores from spill
+        # instead of re-executing the creating task.
+        self.object_spill_urls: Dict[bytes, str] = {}
         # Distributed refcount (reference: reference_count.h borrower
         # protocol, adapted to head-owned objects). A driver release is
         # deferred while any node holds a handle (borrowers) or any
@@ -362,9 +409,10 @@ class ClusterHead:
         # Placement-group bundle locations: (pg_id_binary, index) ->
         # node_id, or None for the head itself.
         self.pg_bundle_nodes: Dict[Tuple[bytes, int], Optional[str]] = {}
-        self.server = RpcServer({
+        handlers = {
             "register_node": self._register_node,
             "report_objects": self._report_objects,
+            "report_spilled": self._report_spilled,
             "report_resources": self._report_resources,
             "add_borrowers": self._add_borrowers,
             "remove_borrowers": self._remove_borrowers,
@@ -401,9 +449,17 @@ class ClusterHead:
             # snapshots land in the head-side aggregator
             # (_private/obs_plane.py — the GcsTaskManager role).
             "obs_report": self._obs_report,
-        }, port=port,
-           dedupe_methods=frozenset({"gcs_kv_put", "route_task",
-                                     "gcs_named_actor_register"}))
+        }
+        if start_server:
+            self.server = RpcServer(
+                handlers, port=port,
+                dedupe_methods=frozenset({"gcs_kv_put", "route_task",
+                                          "gcs_named_actor_register"}))
+        else:
+            # Transport-less head (the model checker drives handlers
+            # directly): every directory/recovery code path stays real,
+            # only the socket server is stubbed.
+            self.server = _NullServer()
         # Long-poll pubsub channels (reference: pubsub/publisher.h:302);
         # node lifecycle events publish here.
         from ray_tpu._private.pubsub import Publisher
@@ -425,6 +481,7 @@ class ClusterHead:
 
     def _register_node(self, node_id, address, resources,
                        transfer=None, shm_name=None, labels=None):
+        sanitize_hooks.sched_point("head.register")
         with self._lock:
             self.nodes[node_id] = _NodeRecord(node_id, address, resources,
                                               transfer, shm_name, labels)
@@ -442,7 +499,9 @@ class ClusterHead:
                           labels=None, stats=None, backlog=None):
         """Pushed resource-view delta (reference: ray_syncer.h:86). Also
         treated as a liveness heartbeat by the health checker, and the
-        carrier for per-node agent stats (node_stats.py)."""
+        carrier for per-node agent stats (node_stats.py). Returning
+        False tells an unknown (restarted-head) node to re-register."""
+        sanitize_hooks.sched_point("head.node_report")
         with self._lock:
             record = self.nodes.get(node_id)
             if record is None:
@@ -485,20 +544,50 @@ class ClusterHead:
             notify(oids)
         return True
 
+    def _report_spilled(self, oids, urls, node_id=None):
+        """A node spilled objects to durable storage: record the URLs so
+        reconstruction can restore from disk instead of re-executing
+        when the node later dies. A None/empty url drops the record."""
+        with self._lock:
+            for oid, url in zip(oids, urls):
+                if url:
+                    self.object_spill_urls[oid] = url
+                else:
+                    self.object_spill_urls.pop(oid, None)
+        return True
+
+    def note_spilled(self, oid: bytes, url: Optional[str]) -> None:
+        """In-process form of report_spilled (the head process's own
+        store spills through the same directory)."""
+        self._report_spilled([oid], [url])
+
     # -- dispatch bookkeeping (called by ClusterBackendMixin) -----------
 
     def record_lineage(self, spec) -> None:
         from ray_tpu._private.task_spec import TaskKind
 
         with self._lock:
-            if spec.kind in (TaskKind.NORMAL_TASK, TaskKind.ACTOR_CREATION):
+            # Actor-task outputs are reconstructable iff the call has
+            # retry budget (reference semantics: objects created by
+            # actor tasks can be re-created when max_task_retries > 0;
+            # re-execution routes through the restart gate like any
+            # replay). Without budget the output is lost with its node
+            # and the caller gets a typed ObjectLostError, never a
+            # hang (see mark_node_dead's poison pass).
+            if spec.kind in (TaskKind.NORMAL_TASK,
+                             TaskKind.ACTOR_CREATION) or \
+                    (spec.kind == TaskKind.ACTOR_TASK
+                     and spec.max_retries != 0):
                 for oid in spec.return_ids:
                     self.lineage[oid.binary()] = spec
             if spec.kind == TaskKind.ACTOR_CREATION:
                 key = spec.actor_id.binary()
                 self.actor_specs[key] = spec
-                self.actor_restarts_left.setdefault(
-                    key, getattr(spec, "max_restarts", 0))
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            # Gate registration is idempotent: a restart's resubmitted
+            # creation spec never resets a partially-consumed budget.
+            self.actor_gate.register(spec.actor_id.binary(),
+                                     getattr(spec, "max_restarts", 0))
 
     def record_inflight(self, spec, node_id: str) -> None:
         # All kinds, actor calls included: a node death must *fail* an
@@ -547,6 +636,7 @@ class ClusterHead:
         self.driver_released.discard(oid)
         self.lineage.pop(oid, None)
         self._recon_attempts.pop(oid, None)
+        self.object_spill_urls.pop(oid, None)
         self.object_sizes.pop(oid, None)
         loc = self.object_locations.pop(oid, None)
         if loc is not None and loc != self.server.address:
@@ -638,15 +728,16 @@ class ClusterHead:
             record = self.nodes.get(node_id)
             if record is None or not record.alive:
                 return
-            from ray_tpu._private.events import record_event
-
-            record_event("node", f"node {node_id} marked dead: {reason}",
-                         severity="ERROR", node_id=node_id)
             record.alive = False
             addr = record.address
-            # Objects whose only copy was there are gone.
+            # Objects whose only copy was there are gone. (Their spill
+            # URLs — durable disk copies — survive in
+            # object_spill_urls: reconstruction restores from those
+            # first.)
             lost = [oid for oid, loc in self.object_locations.items()
                     if loc == addr]
+            lost_bytes = sum(self.object_sizes.get(oid, 0)
+                             for oid in lost)
             for oid in lost:
                 del self.object_locations[oid]
                 self.object_sizes.pop(oid, None)
@@ -654,6 +745,17 @@ class ClusterHead:
                         if nid == node_id]
             for spec in resubmit:
                 self.inflight.pop(spec.task_id.binary(), None)
+            from ray_tpu._private.events import record_event
+
+            # The death event carries the damage assessment: what the
+            # recovery machinery now has to make good on.
+            record_event("node", f"node {node_id} marked dead: {reason}",
+                         severity="ERROR", node_id=node_id,
+                         lost_objects=len(lost),
+                         lost_bytes=int(lost_bytes),
+                         inflight_tasks=len(resubmit))
+            _NODE_DEATHS.inc()
+            _NODE_DEATH_LOST_BYTES.inc(int(lost_bytes))
             # A dead node can no longer borrow anything; dropping it may
             # unblock deferred frees (fanned out after the lock).
             dead_frees = []
@@ -675,6 +777,26 @@ class ClusterHead:
             "node %s marked dead (%s): %d objects lost, %d tasks in "
             "flight, %d actors", node_id, reason, len(lost),
             len(resubmit), len(dead_actors))
+        # Unrecoverable losses fail FAST: a lost object with no lineage
+        # (e.g. a zero-retry actor call's output) and no durable spill
+        # copy can never be produced again — a waiting get must raise a
+        # typed ObjectLostError, not hang out its deadline. put() is a
+        # no-op on entries the driver already resolved.
+        from ray_tpu.exceptions import ObjectLostError
+
+        with self._lock:
+            unrecoverable = [
+                oid for oid in lost
+                if oid not in self.lineage
+                and oid not in self.object_spill_urls]
+        for oid in unrecoverable:
+            if not self.worker.memory_store.contains(ObjectID(oid)):
+                self.worker.memory_store.put(
+                    ObjectID(oid), None, error=ObjectLostError(
+                        oid.hex()[:12],
+                        f"object {oid.hex()[:12]} was lost when node "
+                        f"{node_id} died and has no lineage or spilled "
+                        f"copy to recover from"))
         self.publisher.publish("node_events", {
             "event": "NODE_DEAD", "node_id": node_id, "reason": reason})
         # A dead node stops scraping-by-proxy: drop its metric snapshot
@@ -682,44 +804,113 @@ class ClusterHead:
         # forever (its task events stay — history outlives the node).
         self.obs.forget_node(node_id)
         self._fan_out_frees(dead_frees)
+        # An actor whose CREATION was still in flight on the dead node
+        # is not restarting — it never finished constructing. The
+        # resubmit loop re-drives the creation under the spec's own
+        # max_retries; routing it through _restart_actor too would
+        # double-submit the creation AND burn restart budget on a
+        # first attempt.
+        inflight_creations = {
+            spec.actor_id.binary() for spec in resubmit
+            if spec.kind == TaskKind.ACTOR_CREATION}
         # Restart actors first so resubmitted / queued actor tasks find a
         # live location.
         for aid in dead_actors:
+            if aid in inflight_creations:
+                with self._lock:
+                    self.actor_nodes.pop(aid, None)
+                continue
             self._restart_actor(aid, node_id)
         for spec in resubmit:
             if spec.kind == TaskKind.ACTOR_TASK:
-                # Reference semantics: calls in flight on a dying actor
-                # fail (retries are the caller's max_task_retries layer).
-                from ray_tpu.exceptions import ActorDiedError
-
-                for oid in spec.return_ids:
-                    self.worker.memory_store.put(
-                        oid, None, error=ActorDiedError(
-                            spec.actor_id.hex()[:8],
-                            f"its node {node_id} died mid-call"))
-                with self._lock:
-                    failed_frees = self._unpin_task_locked(
-                        spec.task_id.binary())
-                self._fan_out_frees(failed_frees)
+                # Replay-or-reject (reference: max_task_retries covers
+                # system failures): a call with retry budget replays
+                # against the restarted actor; one without rejects with
+                # an error naming the restart state and budgets.
+                self.recover_actor_call(spec)
                 continue
-            self._resubmit(spec)
+            self._resubmit_lost_task(spec, node_id)
 
     def _restart_actor(self, actor_id: bytes, dead_node: str) -> None:
-        from ray_tpu.exceptions import ActorDiedError
-
         with self._lock:
             spec = self.actor_specs.get(actor_id)
-            left = self.actor_restarts_left.get(actor_id, 0)
-            # max_restarts=-1 means infinite (reference semantics).
-            if spec is None or left == 0:
-                # No restart budget: future calls fail fast.
-                self.actor_nodes.pop(actor_id, None)
-                return
-            if left > 0:
-                self.actor_restarts_left[actor_id] = left - 1
             self.actor_nodes.pop(actor_id, None)
+        reason = f"its node {dead_node} died"
+        if spec is None:
+            self.actor_gate.mark_dead(
+                actor_id, reason + " and no creation spec is recorded")
+            return
+        if not self.actor_gate.begin_restart(actor_id, reason):
+            # Budget exhausted: tombstoned by the gate — later calls
+            # fail FAST with the cause, instead of falling through to a
+            # backend that has never heard of the actor.
+            _restart_counter("exhausted").inc()
+            return
+        _restart_counter("restarted").inc()
         # Re-run the creation spec through the normal scheduler; it
-        # re-registers the actor's node on dispatch.
+        # re-registers the actor's node on dispatch (set_actor_node →
+        # gate.ready releases parked callers).
+        self._resubmit(spec)
+
+    def set_actor_node(self, actor_id: bytes, node_id: str) -> None:
+        """The ONE place an actor gains a live location: directory entry
+        plus the gate's RESTARTING→ALIVE edge (parked calls dispatch)."""
+        with self._lock:
+            self.actor_nodes[actor_id] = node_id
+            self.actor_local.discard(actor_id)
+        self.actor_gate.ready(actor_id)
+
+    def recover_actor_call(self, spec) -> None:
+        """An actor call that was in flight on (or failed to reach) a
+        dead node: gate-decided replay-or-reject."""
+
+        def resubmit(s):
+            _restart_counter("call_replayed").inc()
+            self._resubmit(s)
+
+        def fail(s, msg, dead):
+            _restart_counter("call_rejected").inc()
+            self._fail_actor_call(s, msg, dead)
+
+        self.actor_gate.recover_call(spec, resubmit, fail)
+
+    def _fail_actor_call(self, spec, msg: str, dead: bool) -> None:
+        from ray_tpu.exceptions import ActorDiedError, \
+            ActorUnavailableError
+
+        err = ActorDiedError(spec.actor_id.hex()[:8], msg) if dead \
+            else ActorUnavailableError(msg)
+        for oid in spec.return_ids:
+            self.worker.memory_store.put(oid, None, error=err)
+        with self._lock:
+            frees = self._unpin_task_locked(spec.task_id.binary())
+        self._fan_out_frees(frees)
+
+    def _resubmit_lost_task(self, spec, node_id: str) -> None:
+        """Node-death resubmit with per-spec retry accounting
+        (reference: max_retries covers worker/node failures): each
+        death consumes one unit of the spec's own budget — and rides
+        the wire on the resubmitted TaskCall — instead of resubmitting
+        unconditionally forever."""
+        from ray_tpu import exceptions as exc
+
+        if spec.max_retries == 0:
+            attempts = getattr(spec, "attempt", 0)
+            for oid in spec.return_ids:
+                self.worker.memory_store.put(
+                    oid, None, error=exc.TaskError(
+                        exc.WorkerCrashedError(
+                            f"node {node_id} died with the task in "
+                            f"flight and its retry budget is exhausted "
+                            f"(attempt {attempts + 1}, 0 retries left)"),
+                        spec.describe()))
+            with self._lock:
+                frees = self._unpin_task_locked(spec.task_id.binary())
+            self._fan_out_frees(frees)
+            return
+        if spec.max_retries > 0:
+            spec.max_retries -= 1
+        spec.attempt = getattr(spec, "attempt", 0) + 1
         self._resubmit(spec)
 
     def _resubmit(self, spec) -> None:
@@ -757,28 +948,105 @@ class ClusterHead:
             for oid in oids:
                 self.driver_released.discard(oid)
 
-    def _maybe_reconstruct(self, oid: bytes) -> None:
-        """On-demand lineage reconstruction: if a requested object has no
-        live copy but we know its creating task, re-execute it (bounded
-        by max_reconstruction_attempts)."""
+    def _maybe_reconstruct(self, oid: bytes, _chain=None) -> None:
+        """On-demand lineage reconstruction: a requested object with no
+        live copy restores from its durable spilled copy when one is
+        known, else re-executes its creating task — and does so
+        TRANSITIVELY: a re-executed task whose own arguments were also
+        lost reconstructs them first (depth/cycle-guarded; each object
+        charged its own max_reconstruction_attempts)."""
         from ray_tpu._private.config import ray_config
 
         if not ray_config.enable_object_reconstruction:
             return
         with self._lock:
             spec = self.lineage.get(oid)
-            if spec is None:
+            spill_url = self.object_spill_urls.get(oid)
+            # A durable spilled copy is recoverable WITHOUT lineage
+            # (e.g. a zero-retry actor call's spilled output), so the
+            # spill check must not sit behind the lineage requirement.
+            if spec is None and spill_url is None:
                 return
-            if spec.task_id.binary() in self.inflight:
+            if spec is not None and \
+                    spec.task_id.binary() in self.inflight:
                 return  # already being re-executed
             attempts = self._recon_attempts.get(oid, 0)
             if attempts >= ray_config.max_reconstruction_attempts:
+                _recon_counter("exhausted").inc()
                 return
             self._recon_attempts[oid] = attempts + 1
+        sanitize_hooks.sched_point("recon.request")
+        if spill_url is not None and \
+                self._restore_from_spill(oid, spill_url):
+            _recon_counter("from_spill").inc()
+            return
+        if spec is None:
+            # The spill copy was the ONLY recovery path and it is gone
+            # (stale URL): poison waiting gets now — never a hang.
+            from ray_tpu.exceptions import ObjectLostError
+
+            object_id = ObjectID(oid)
+            if not self.worker.memory_store.contains(object_id):
+                self.worker.memory_store.put(
+                    object_id, None, error=ObjectLostError(
+                        oid.hex()[:12],
+                        f"object {oid.hex()[:12]} has no lineage and "
+                        f"its spilled copy could not be restored"))
+            return
+        # Cycle/depth guard for the recursive walk: a lineage loop (or a
+        # pathological chain) terminates; the per-object attempt charge
+        # above remains the authoritative bound.
+        chain = _chain if _chain is not None else set()
+        tid = spec.task_id.binary()
+        if tid in chain or \
+                len(chain) >= ray_config.max_reconstruction_depth:
+            return
+        chain = chain | {tid}
+        # Transitive: re-executing this spec needs its args resident
+        # somewhere — eagerly reconstruct the ones that are lost too,
+        # so the re-execution's dep fetch finds (or soon finds) them
+        # instead of burning its whole deadline polling.
+        for dep in spec.nested_dependencies():
+            db = dep.binary()
+            with self._lock:
+                have = db in self.object_locations
+            if not have and not self.worker.memory_store.contains(dep):
+                self._maybe_reconstruct(db, chain)
         logging.getLogger(__name__).info(
             "reconstructing object %s via lineage (attempt %d)",
             oid.hex()[:12], attempts + 1)
+        _recon_counter("reexecute").inc()
+        sanitize_hooks.sched_point("recon.resubmit")
         self._resubmit(spec)
+
+    def _restore_from_spill(self, oid: bytes, url: str) -> bool:
+        """Restore a lost object from its durable spilled payload: the
+        surviving copy IS the object — no re-execution. The restored
+        value republishes through the object plane (share_value) so
+        outstanding descriptors and cross-node reads stay valid."""
+        sanitize_hooks.sched_point("recon.restore")
+        from ray_tpu._private.spilling import restore_spilled_payload
+
+        try:
+            value = restore_spilled_payload(url)
+        except Exception:
+            # Stale URL (file reclaimed, dead node's dir destroyed):
+            # drop the record and fall back to re-execution.
+            with self._lock:
+                self.object_spill_urls.pop(oid, None)
+            return False
+        object_id = ObjectID(oid)
+        self.worker.memory_store.put(object_id, value)
+        from ray_tpu._private.shm_plane import share_value
+
+        share_value(self.worker, object_id, value)
+        logging.getLogger(__name__).info(
+            "restored lost object %s from spilled copy %s",
+            oid.hex()[:12], url)
+        # The head itself now owns a live copy: advertise it (also
+        # wakes the driver's fetch dispatcher for waiting gets).
+        self._report_objects([oid], self.server.address)
+        return True
 
     def _locate(self, oid: bytes):
         """Owner's RPC address, or None. (Legacy callers; see _locate2.)"""
@@ -845,8 +1113,7 @@ class ClusterHead:
         the head's directory, so handles to it route from anywhere and
         it gets the same restart bookkeeping as head-dispatched actors."""
         self.record_lineage(spec)
-        with self._lock:
-            self.actor_nodes[spec.actor_id.binary()] = node_id
+        self.set_actor_node(spec.actor_id.binary(), node_id)
         return True
 
     def _named_actor_register(self, name, namespace, handle) -> bool:
@@ -939,34 +1206,74 @@ class ClusterBackendMixin:
     def submit(self, spec) -> None:
         head = self.head
         if spec.kind == TaskKind.ACTOR_TASK:
-            node_id = head.actor_nodes.get(spec.actor_id.binary())
+            aid = spec.actor_id.binary()
+            node_id = head.actor_nodes.get(aid)
             if node_id is not None:
-                actor_desc = spec.actor_id.hex()[:8]
                 record = head.nodes.get(node_id)
                 if record is None or not record.alive:
-                    self._fail_spec(spec, ActorDiedError(
-                        actor_desc, f"its node {node_id} is dead"))
+                    # The directory still points at a dead node (the
+                    # death sweep hasn't run or finished): run it, then
+                    # let the gate decide replay-or-reject for THIS
+                    # call like any other call caught by the death.
+                    # The stale mapping is dropped FIRST — a replay
+                    # resubmit must route through the gate, not recurse
+                    # back into this branch (mark_node_dead is a no-op
+                    # for an already-removed record and would pop
+                    # nothing).
+                    head.mark_node_dead(node_id,
+                                        reason="found dead at dispatch")
+                    with head._lock:
+                        if head.actor_nodes.get(aid) == node_id:
+                            head.actor_nodes.pop(aid, None)
+                    head.recover_actor_call(spec)
                     return
                 try:
                     self._send(record, spec)
                 except (ConnectionError, OSError) as e:
-                    # Transport failure: the node itself is unreachable.
-                    # mark_node_dead restarts the actor elsewhere if it
-                    # has restart budget; this call still fails (the
-                    # reference fails in-flight calls on a dying actor
-                    # unless max_task_retries covers them — retries are
-                    # the submitter's RemoteFunction layer here).
+                    # Transport failure: the node itself is
+                    # unreachable. mark_node_dead restarts the actor
+                    # elsewhere (budget permitting); this call then
+                    # replays against the replacement when its own
+                    # max_task_retries covers it, else rejects with an
+                    # error naming the restart state and budget.
                     head.mark_node_dead(node_id,
                                         reason=f"unreachable: {e}")
-                    self._fail_spec(spec, ActorDiedError(
-                        actor_desc, f"node {node_id} unreachable: {e}"))
+                    head.recover_actor_call(spec)
                 except Exception as e:
                     # Handler-level error: the node is healthy, this
                     # submission failed — fail the task, keep the node.
                     self._fail_spec(spec, e)
                 return
-            self._ensure_local_deps(spec)
-            self.local_backend.submit(spec)
+            from ray_tpu._private.actor_gate import ActorRestartState
+
+            state = head.actor_gate.state(aid)
+            if state == ActorRestartState.DEAD:
+                # Tombstoned (restart budget exhausted): fail FAST with
+                # the recorded cause — never fall through to the local
+                # backend, which has no such actor and would bury the
+                # call behind a generic "unknown actor".
+                self._fail_spec(spec, ActorDiedError(
+                    spec.actor_id.hex()[:8],
+                    head.actor_gate.death_cause(aid)
+                    or "restart budget exhausted"))
+                return
+            if state == ActorRestartState.RESTARTING:
+                head.actor_gate.route_call(
+                    spec, dispatch=None,
+                    park=self._park_actor_call,
+                    fail=head._fail_actor_call)
+                return
+            if state is not None and aid not in head.actor_local:
+                # Gate-registered (cluster-dispatched) actor, no
+                # location, and not known to live on the head: we
+                # raced the death sweep's window between
+                # record.alive=False and the gate's RESTARTING flip.
+                # Park — falling through to the local backend would
+                # fail a retryable call with a generic "unknown
+                # actor".
+                self._park_actor_call(spec)
+                return
+            self._submit_local(spec)
             return
         # Strategy-directed routing (reference: the scheduling-policy set
         # of `scheduling/policy/` — PG-affinity, node-affinity, spread).
@@ -997,8 +1304,7 @@ class ClusterBackendMixin:
                 if self._locality_prefers_remote(spec) and \
                         self._lease_submit(spec, request):
                     return
-                self._ensure_local_deps(spec)
-                self.local_backend.submit(spec)
+                self._submit_local(spec)
                 return
             if self._lease_submit(spec, request):
                 return
@@ -1015,8 +1321,7 @@ class ClusterBackendMixin:
                 if all(local_total.get(k, 0) >= v
                        for k, v in request.items()):
                     # A head-local task may still depend on remote objects.
-                    self._ensure_local_deps(spec)
-                    self.local_backend.submit(spec)
+                    self._submit_local(spec)
                     return
                 # Too big for the head and no remote capacity *right now*:
                 # queue cluster-wide (the reference raylet queues leases),
@@ -1024,7 +1329,7 @@ class ClusterBackendMixin:
                 self._queue_for_cluster(spec, request)
                 return
             if spec.kind == TaskKind.ACTOR_CREATION:
-                head.actor_nodes[spec.actor_id.binary()] = target.node_id
+                head.set_actor_node(spec.actor_id.binary(), target.node_id)
             try:
                 self._send(target, spec)
                 return
@@ -1033,15 +1338,115 @@ class ClusterBackendMixin:
                 # a successful send), so mark_node_dead won't resubmit
                 # this spec — the loop retries it on another node.
                 attempted.add(target.node_id)
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    # Unwind the never-landed placement BEFORE the
+                    # death sweep: the sweep must not see this aid in
+                    # its dead-actor set — begin_restart would burn
+                    # restart budget (tombstoning a max_restarts=0
+                    # actor forever) for a creation the loop is about
+                    # to retry cleanly elsewhere. The gate's ALIVE flip
+                    # rolls back too, so concurrent calls park instead
+                    # of dispatching into a backend that has never
+                    # heard of the actor.
+                    head.actor_nodes.pop(spec.actor_id.binary(), None)
+                    head.actor_gate.rollback_ready(
+                        spec.actor_id.binary())
                 head.mark_node_dead(target.node_id,
                                     reason=f"unreachable: {e}")
-                if spec.kind == TaskKind.ACTOR_CREATION:
-                    head.actor_nodes.pop(spec.actor_id.binary(), None)
 
     def _fail_spec(self, spec, error: Exception) -> None:
         store = self.worker.memory_store
         for oid in spec.return_ids:
             store.put(oid, None, error=error)
+
+    def kill_actor(self, actor_id, no_restart: bool = True) -> None:
+        """Deliberate kill in cluster mode: reach the HOSTING node (the
+        local backend only knows head-local actors — delegating there
+        was a silent no-op for remote ones) and, for no_restart kills,
+        tombstone the gate so later calls fail fast with the real
+        cause instead of parking or probing a dead mailbox."""
+        head = self.head
+        aid = actor_id.binary()
+        node_id = head.actor_nodes.get(aid)
+        if no_restart and head.actor_gate.state(aid) is not None:
+            with head._lock:
+                head.actor_nodes.pop(aid, None)
+            head.actor_gate.mark_dead(
+                aid, "killed via ray_tpu.kill(no_restart=True)")
+        if node_id is None:
+            self.local_backend.kill_actor(actor_id, no_restart)
+            return
+        record = head.nodes.get(node_id)
+        if record is None or not record.alive:
+            return  # the death sweep owns cleanup
+        try:
+            RpcClient.to(record.address).call(
+                "kill_actor", actor_id=actor_id, no_restart=no_restart)
+        except Exception:
+            pass  # node unreachable: the health checker owns it
+
+    def _submit_local(self, spec) -> None:
+        """The ONE local-dispatch path in cluster mode: dep fetch +
+        local backend, plus the restart gate's ready edge for actor
+        creations — a RESTARTED actor that lands on the head (remote
+        nodes saturated) has no directory entry (None = head-local),
+        but its parked callers must still observe it alive again."""
+        self._ensure_local_deps(spec)
+        self.local_backend.submit(spec)
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            aid = spec.actor_id.binary()
+            if self.head.actor_gate.state(aid) is not None:
+                with self.head._lock:
+                    self.head.actor_local.add(aid)
+            self.head.actor_gate.ready(aid)
+
+    def _park_actor_call(self, spec) -> None:
+        """A call with retry budget submitted during an actor's restart
+        window: park off-thread (the submitter keeps its ObjectRef and
+        waits through get()), dispatch when the replacement registers,
+        reject when the window expires or the actor dies."""
+        head = self.head
+        aid = spec.actor_id.binary()
+        timeout = ray_config.actor_restart_timeout_s
+        deadline = time.monotonic() + timeout
+
+        def wait_loop():
+            from ray_tpu._private.actor_gate import ActorRestartState
+
+            while time.monotonic() < deadline:
+                state = head.actor_gate.state(aid)
+                if state == ActorRestartState.DEAD:
+                    head._fail_actor_call(
+                        spec,
+                        head.actor_gate.death_cause(aid)
+                        or "actor died during the restart window",
+                        True)
+                    return
+                # Dispatch only once the actor has a real home again:
+                # a node entry, the head itself, or no gate record at
+                # all. ALIVE-without-location is the mid-sweep
+                # transient — re-submitting there would just re-park.
+                if head.actor_nodes.get(aid) is not None or \
+                        state is None or aid in head.actor_local:
+                    try:
+                        self.submit(spec)
+                    except Exception as e:
+                        self._fail_spec(spec, e)
+                    return
+                # Condition-signalled wait (gate notifies on every
+                # transition): no busy polling, prompt release.
+                head.actor_gate.wait_change(
+                    min(0.5, max(0.01, deadline - time.monotonic())))
+            head._fail_actor_call(
+                spec,
+                f"actor restart did not complete within "
+                f"actor_restart_timeout_s={timeout:g}s (call parked "
+                f"with retry budget; actor restarts: "
+                f"{head.actor_gate.restarts_left(aid)} left)",
+                False)
+
+        threading.Thread(target=wait_loop, daemon=True,
+                         name="ray_tpu-actor-park").start()
 
     # -- lease-based dispatch (direct_task_transport role) ---------------
 
@@ -1333,7 +1738,8 @@ class ClusterBackendMixin:
                     depth=spec.depth,
                     trace_parent=spec.trace_parent,
                     max_retries=spec.max_retries,
-                    job_id=spec.job_id or "")
+                    job_id=spec.job_id or "",
+                    attempt=getattr(spec, "attempt", 0))
                 return call, templates
         return self._strip_exported_func(spec, record), []
 
@@ -1562,8 +1968,7 @@ class ClusterBackendMixin:
                 node_id = None if None in entries.values() else \
                     next(iter(entries.values()))
             if node_id is None:
-                self._ensure_local_deps(spec)
-                self.local_backend.submit(spec)
+                self._submit_local(spec)
                 return True
             record = head.nodes.get(node_id)
             if record is None or not record.alive:
@@ -1571,10 +1976,16 @@ class ClusterBackendMixin:
                     f"placement group bundle's node {node_id} is dead"))
                 return True
             if spec.kind == TaskKind.ACTOR_CREATION:
-                head.actor_nodes[spec.actor_id.binary()] = record.node_id
+                head.set_actor_node(spec.actor_id.binary(), record.node_id)
             try:
                 self._send(record, spec)
             except (ConnectionError, OSError) as e:
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    # Unwind the never-landed placement BEFORE the
+                    # sweep (see submit's creation handler).
+                    head.actor_nodes.pop(spec.actor_id.binary(), None)
+                    head.actor_gate.rollback_ready(
+                        spec.actor_id.binary())
                 head.mark_node_dead(record.node_id,
                                     reason=f"unreachable: {e}")
                 self._fail_spec(spec, exc.PlacementGroupSchedulingError(
@@ -1595,10 +2006,14 @@ class ClusterBackendMixin:
                     f"node affinity target {wanted!r} is not available"))
                 return True
             if spec.kind == TaskKind.ACTOR_CREATION:
-                head.actor_nodes[spec.actor_id.binary()] = record.node_id
+                head.set_actor_node(spec.actor_id.binary(), record.node_id)
             try:
                 self._send(record, spec)
             except (ConnectionError, OSError) as e:
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    head.actor_nodes.pop(spec.actor_id.binary(), None)
+                    head.actor_gate.rollback_ready(
+                        spec.actor_id.binary())
                 head.mark_node_dead(record.node_id,
                                     reason=f"unreachable: {e}")
                 if strat.soft:
@@ -1625,19 +2040,23 @@ class ClusterBackendMixin:
                     if not fits:
                         continue
                     self._rr += attempt + 1
-                    self._ensure_local_deps(spec)
-                    self.local_backend.submit(spec)
+                    self._submit_local(spec)
                     return True
                 if all(target.available.get(k, 0) * 1000 >= v
                        for k, v in request.items()):
                     self._rr += attempt + 1
                     if spec.kind == TaskKind.ACTOR_CREATION:
-                        head.actor_nodes[spec.actor_id.binary()] = \
-                            target.node_id
+                        head.set_actor_node(spec.actor_id.binary(),
+                                            target.node_id)
                     try:
                         self._send(target, spec)
                         return True
                     except (ConnectionError, OSError) as e:
+                        if spec.kind == TaskKind.ACTOR_CREATION:
+                            head.actor_nodes.pop(
+                                spec.actor_id.binary(), None)
+                            head.actor_gate.rollback_ready(
+                                spec.actor_id.binary())
                         head.mark_node_dead(target.node_id,
                                             reason=f"unreachable: {e}")
                         continue
@@ -1733,18 +2152,21 @@ class ClusterBackendMixin:
                               if feasible else None)
                     if target is not None:
                         if spec.kind == TaskKind.ACTOR_CREATION:
-                            self.head.actor_nodes[
-                                spec.actor_id.binary()] = target.node_id
+                            self.head.set_actor_node(
+                                spec.actor_id.binary(), target.node_id)
                         try:
                             self._send(target, spec)
                             return
                         except (ConnectionError, OSError) as e:
+                            if spec.kind == TaskKind.ACTOR_CREATION:
+                                # Unwind BEFORE the sweep (see submit).
+                                self.head.actor_nodes.pop(
+                                    spec.actor_id.binary(), None)
+                                self.head.actor_gate.rollback_ready(
+                                    spec.actor_id.binary())
                             self.head.mark_node_dead(
                                 target.node_id,
                                 reason=f"unreachable: {e}")
-                            if spec.kind == TaskKind.ACTOR_CREATION:
-                                self.head.actor_nodes.pop(
-                                    spec.actor_id.binary(), None)
                     time.sleep(0.1)
             finally:
                 self.head.pending_demands.pop(tid, None)
@@ -2192,6 +2614,7 @@ class Cluster:
         backend = ClusterBackendMixin(self.driver_worker, self.head)
         self.driver_worker.backend = backend
         ClusterDriverMixin.install(self.driver_worker, self.head)
+        self._wire_driver_spill_reports()
         # Node-wide shared object segment (plasma role): the head creates
         # it; node subprocesses attach by name. Large objects then cross
         # process boundaries zero-copy instead of via pickle RPC.
@@ -2340,11 +2763,28 @@ class Cluster:
             proc.kill()
             proc.wait(timeout=10)
 
-    def restart_head(self):
+    def restart_head(self, mode: str = "graceful"):
         """Head (GCS) failover: tear the head's services down and bring
         a FRESH head up on the same address, recovering durable tables
         from gcs_storage (reference: GCS restart +
         `node_manager.proto:356` RayletNotifyGCSRestart).
+
+        Two modes:
+
+        - ``"graceful"`` (default): planned handoff — the old store's
+          deferred group-commit batch is flushed before the swap, so
+          the successor recovers EVERYTHING the old head accepted.
+        - ``"crash"``: hard process death — NO flush; the sqlite
+          connection drops with the open group-commit window
+          uncommitted (WAL rolls it back). The documented loss bound is
+          exactly that window (``gcs_commit_interval_s``): writes whose
+          flush() returned (acked durable) survive, writes still
+          riding the window may be lost, and nothing un-acked ever
+          resurrects — the same contract raymc's ``gcs_durability`` /
+          ``head_crash_recovery`` scenarios prove at small scope. Live
+          nodes re-register through the report-returns-False path with
+          no driver intervention; in-flight callers ride the fetch
+          retry window to completion.
 
         What this simulates/recovers, and what it loses:
         - KV, named-actor, and placement-group tables reload from the
@@ -2363,18 +2803,32 @@ class Cluster:
         - The driver process itself survives (the head is in-process
           here); in a real deployment driver death is a separate event.
         """
+        if mode not in ("graceful", "crash"):
+            raise ValueError(f"restart_head mode must be 'graceful' or "
+                             f"'crash', got {mode!r}")
         old = self.head
         addr = old.server.address
         old.stop()
         old.server.shutdown()
-        # Graceful handoff boundary: drain the old store's deferred
-        # group-commit batch so the fresh GlobalState's new connection
-        # recovers everything the old head accepted. (A hard crash
-        # instead loses at most the commit-interval window — the same
-        # contract as the reference's async Redis writes.)
-        flush = getattr(self.driver_worker.gcs, "flush_storage", None)
-        if flush is not None:
-            flush()
+        old_gcs = self.driver_worker.gcs
+        if mode == "graceful":
+            # Graceful handoff boundary: drain the old store's deferred
+            # group-commit batch so the fresh GlobalState's new
+            # connection recovers everything the old head accepted,
+            # then close it (stops the flusher thread).
+            flush = getattr(old_gcs, "flush_storage", None)
+            if flush is not None:
+                flush()
+            close = getattr(old_gcs, "close_storage", None)
+            if close is not None:
+                close()
+        else:
+            # Hard crash: the connection dies with the group-commit
+            # window open — sqlite rolls the pending transaction back,
+            # exactly what a SIGKILL'd head process leaves behind.
+            crash = getattr(old_gcs, "crash_storage", None)
+            if crash is not None:
+                crash()
         # Fresh GlobalState: prove recovery comes from durable storage,
         # not this process's memory.
         self.driver_worker.gcs = state_mod.GlobalState(self.driver_worker)
@@ -2390,8 +2844,23 @@ class Cluster:
         self.head = new
         self.driver_worker.backend.head = new
         self.driver_worker.cluster_head = new
+        self._wire_driver_spill_reports()
         new._ensure_health_checker()
         return new
+
+    def _wire_driver_spill_reports(self):
+        """Driver-local spills feed the (current) head's spill-URL
+        directory the same way node spills do over RPC."""
+        store = self.driver_worker.memory_store
+        cluster = self
+
+        def on_spilled(oid, url):
+            try:
+                cluster.head.note_spilled(oid.binary(), url)
+            except Exception:
+                pass
+
+        store.on_spilled = on_spilled
 
     def nodes(self) -> List[dict]:
         return self.head._get_nodes()

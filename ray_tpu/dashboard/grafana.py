@@ -217,6 +217,22 @@ def generate_object_plane_dashboard() -> dict:
         {"title": "Arena occupancy", "unit": "bytes",
          "exprs": [("ray_tpu_shm_allocated", "allocated {{node}}"),
                    ("ray_tpu_shm_capacity", "capacity {{node}}")]},
+        # Fault-tolerance row: what the recovery machinery is doing —
+        # node deaths + the bytes they took, lineage reconstructions by
+        # outcome (reexecute / from_spill / exhausted), and actor
+        # restarts by outcome (restarted / exhausted / call_replayed /
+        # call_rejected).
+        {"title": "Node deaths / lost bytes",
+         "exprs": [("increase(ray_tpu_node_deaths_total[5m])",
+                    "deaths (5m)"),
+                   ("increase(ray_tpu_node_death_lost_bytes_total[5m])",
+                    "lost bytes (5m)")]},
+        {"title": "Reconstructions by outcome",
+         "exprs": [("increase(ray_tpu_reconstructions_total[5m])",
+                    "{{outcome}} (5m)")]},
+        {"title": "Actor restarts / call replay-or-reject",
+         "exprs": [("increase(ray_tpu_actor_restarts_total[5m])",
+                    "{{outcome}} (5m)")]},
     ], uid="ray-tpu-object-plane")
 
 

@@ -99,6 +99,10 @@ def test_grafana_dashboard_factory(tmp_path):
                              for t in p["targets"])
     assert "ray_tpu_object_pull_bytes_total" in obj_exprs
     assert "ray_tpu_object_spill_bytes_total" in obj_exprs
+    # Fault-tolerance row (PR 11): recovery work is graphable.
+    assert "ray_tpu_node_deaths_total" in obj_exprs
+    assert "ray_tpu_reconstructions_total" in obj_exprs
+    assert "ray_tpu_actor_restarts_total" in obj_exprs
     for p in paths:
         with open(p) as f:
             loaded = json.load(f)

@@ -1,0 +1,558 @@
+"""Deterministic fault-tolerance semantics: replay-or-reject, restart
+tombstones, per-spec death-retry accounting, transitive lineage
+reconstruction, spill-restore composition, and crash-mode storage — the
+single-process half of the proof tree (raymc exhausts the
+interleavings, the slow chaos suite drives real processes; these pin
+the DECISIONS deterministically).
+
+Reference semantics under test: `gcs_actor_manager.h` restart FSM +
+max_task_retries (actor calls), `task_manager.h` resubmit accounting
+(max_retries covers node death), `object_recovery_manager.h` recursive
+reconstruction, and GCS store crash durability.
+"""
+
+import os
+import time
+from types import SimpleNamespace
+
+import cloudpickle
+import pytest
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.actor_gate import ActorRestartGate, ActorRestartState
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.task_spec import TaskKind, TaskSpec
+from ray_tpu.cluster_utils import ClusterHead, _NodeRecord
+
+
+def _make_head():
+    """Transport-less head over a stub worker + recording backend."""
+    worker = SimpleNamespace(memory_store=MemoryStore(), shm_plane=None,
+                             gcs=None, backend=None)
+    head = ClusterHead(worker, start_server=False)
+    submitted = []
+    worker.backend = SimpleNamespace(submit=submitted.append)
+    head.nodes["n1"] = _NodeRecord("n1", ("127.0.0.1", 7191), {"CPU": 2})
+    return head, worker, submitted
+
+
+def _creation_spec(max_restarts=0):
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_CREATION, func=object,
+                    args=(), kwargs={}, name="A.__init__",
+                    actor_id=ActorID.from_random(),
+                    max_restarts=max_restarts)
+    spec.assign_return_ids()
+    return spec
+
+
+def _call_spec(creation, max_task_retries=0, name="A.f"):
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_TASK, func="f", args=(),
+                    kwargs={}, name=name, actor_id=creation.actor_id,
+                    max_retries=max_task_retries)
+    spec.assign_return_ids()
+    return spec
+
+
+def _stored_error(worker, spec):
+    ready, _value, error = worker.memory_store.peek(spec.return_ids[0])
+    assert ready, "no outcome stored for the call"
+    return error
+
+
+# -- gate decision units -----------------------------------------------------
+
+
+def test_gate_fsm_budget_and_tombstone_cause():
+    gate = ActorRestartGate()
+    gate.register(b"a", 2)
+    assert gate.state(b"a") == ActorRestartState.ALIVE
+    assert gate.begin_restart(b"a", "its node n1 died")
+    assert gate.state(b"a") == ActorRestartState.RESTARTING
+    assert gate.restarts_left(b"a") == 1
+    gate.ready(b"a")
+    assert gate.state(b"a") == ActorRestartState.ALIVE
+    assert gate.begin_restart(b"a", "its node n2 died")
+    gate.ready(b"a")
+    # Budget drained: the third death tombstones with a cause naming it.
+    assert not gate.begin_restart(b"a", "its node n3 died")
+    assert gate.state(b"a") == ActorRestartState.DEAD
+    assert "max_restarts=2" in gate.death_cause(b"a")
+    # register() is idempotent: a resubmitted creation spec must not
+    # resurrect or refill the actor.
+    gate.register(b"a", 2)
+    assert gate.state(b"a") == ActorRestartState.DEAD
+
+
+def test_gate_rollback_ready_returns_to_restarting():
+    """A failed creation send unwinds its location gain: the ALIVE flip
+    rolls back to RESTARTING so parked calls keep parking instead of
+    falling through to a backend that never heard of the actor."""
+    gate = ActorRestartGate()
+    gate.register(b"a", 1)
+    gate.begin_restart(b"a", "its node n1 died")
+    gate.ready(b"a")
+    assert gate.state(b"a") == ActorRestartState.ALIVE
+    gate.rollback_ready(b"a")
+    assert gate.state(b"a") == ActorRestartState.RESTARTING
+    # Rollback never resurrects the dead.
+    gate.mark_dead(b"a", "gone")
+    gate.rollback_ready(b"a")
+    assert gate.state(b"a") == ActorRestartState.DEAD
+
+
+def test_gate_infinite_restarts():
+    gate = ActorRestartGate()
+    gate.register(b"a", -1)
+    for i in range(5):
+        assert gate.begin_restart(b"a", f"death {i}")
+        gate.ready(b"a")
+    assert gate.restarts_left(b"a") == -1
+
+
+def test_gate_replay_authorized_call_parks_not_rejected():
+    """Regression (found by the raymc actor_restart scenario while it
+    was being built): recover_call consumes the call's last retry to
+    authorize the replay — the resubmitted call re-enters route_call
+    with max_retries==0 and must PARK for the replacement, not be
+    re-judged against the budget it just spent."""
+    gate = ActorRestartGate()
+    creation = _creation_spec(max_restarts=1)
+    aid = creation.actor_id.binary()
+    gate.register(aid, 1)
+    gate.begin_restart(aid, "its node n1 died")
+    call = _call_spec(creation, max_task_retries=1)
+    routed = []
+    gate.recover_call(
+        call,
+        resubmit=lambda s: gate.route_call(
+            s, dispatch=None, park=lambda x: routed.append("park"),
+            fail=lambda x, m, d: routed.append(("reject", m))),
+        fail=lambda s, m, d: routed.append(("fail", m)))
+    assert routed == ["park"]
+    assert call.max_retries == 0 and call.attempt == 1
+
+
+def test_gate_route_rejects_zero_budget_mid_restart_naming_budget():
+    gate = ActorRestartGate()
+    creation = _creation_spec(max_restarts=1)
+    aid = creation.actor_id.binary()
+    gate.register(aid, 1)
+    gate.begin_restart(aid, "its node n1 died")
+    call = _call_spec(creation, max_task_retries=0)
+    out = []
+    gate.route_call(call, dispatch=None,
+                    park=lambda s: out.append("park"),
+                    fail=lambda s, m, d: out.append((m, d)))
+    (msg, dead), = out
+    assert not dead
+    assert "max_task_retries=0" in msg and "RESTARTING" in msg
+
+
+# -- head-level replay-or-reject --------------------------------------------
+
+
+def test_inflight_call_with_retry_budget_replays_on_node_death():
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_inflight(call, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    # The creation spec was resubmitted (restart) and the call REPLAYED
+    # (not failed): both reached the backend.
+    kinds = [s.kind for s in submitted]
+    assert kinds.count(TaskKind.ACTOR_CREATION) == 1
+    assert kinds.count(TaskKind.ACTOR_TASK) == 1
+    replayed = next(s for s in submitted
+                    if s.kind == TaskKind.ACTOR_TASK)
+    assert replayed is call
+    assert call.max_retries == 0 and call.attempt == 1
+    # No error was stored for the call — its outcome is the replay's.
+    ready, _v, _e = worker.memory_store.peek(call.return_ids[0])
+    assert not ready
+
+
+def test_inflight_call_without_budget_rejects_naming_state():
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=0)
+    head.record_inflight(call, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    error = _stored_error(worker, call)
+    assert isinstance(error, exc.ActorUnavailableError)
+    msg = str(error)
+    assert "max_task_retries" in msg and "RESTARTING" in msg
+
+
+def test_inflight_call_on_budgetless_actor_gets_actor_died():
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=0)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=5)
+    head.record_inflight(call, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    # Retries cannot help a dead actor: typed death naming the budget.
+    error = _stored_error(worker, call)
+    assert isinstance(error, exc.ActorDiedError)
+    assert "max_restarts=0" in str(error)
+    assert not any(s.kind == TaskKind.ACTOR_TASK for s in submitted)
+
+
+def test_tombstoned_actor_fails_fast_not_local_dispatch():
+    """Satellite regression: _restart_actor with no budget used to pop
+    the actor_nodes entry, so the next submit took the node_id-is-None
+    branch into the LOCAL backend (which has never heard of the actor).
+    Tombstones must fail the call fast with the recorded cause."""
+    from ray_tpu.cluster_utils import ClusterBackendMixin
+
+    head, worker, _submitted = _make_head()
+    creation = _creation_spec(max_restarts=0)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    head.mark_node_dead("n1", reason="test kill")
+
+    local_calls = []
+    worker.backend = SimpleNamespace(
+        submit=local_calls.append,
+        resources=None)
+    backend = ClusterBackendMixin(worker, head)
+    call = _call_spec(creation, max_task_retries=3)
+    backend.submit(call)
+
+    assert local_calls == [], \
+        "tombstoned actor call leaked to the local backend"
+    error = _stored_error(worker, call)
+    assert isinstance(error, exc.ActorDiedError)
+    assert "max_restarts=0" in str(error)
+
+
+def test_parked_call_dispatches_when_restart_completes(monkeypatch):
+    from ray_tpu.cluster_utils import ClusterBackendMixin
+
+    monkeypatch.setattr(ray_config, "actor_restart_timeout_s", 5.0)
+    head, worker, _submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    head.mark_node_dead("n1", reason="test kill")  # -> RESTARTING
+    head.nodes["n2"] = _NodeRecord("n2", ("127.0.0.1", 7192),
+                                   {"CPU": 2})
+
+    worker.backend = SimpleNamespace(submit=lambda s: None,
+                                     resources=None)
+    backend = ClusterBackendMixin(worker, head)
+    sent = []
+    backend._send = lambda record, spec: sent.append(
+        (record.node_id, spec))
+
+    call = _call_spec(creation, max_task_retries=1)
+    backend.submit(call)  # parks (no live location, RESTARTING)
+    assert sent == []
+
+    # Replacement registers: the parked waiter must dispatch to it.
+    head.set_actor_node(creation.actor_id.binary(), "n2")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not sent:
+        time.sleep(0.02)
+    assert sent and sent[0][0] == "n2" and sent[0][1] is call
+
+
+def test_parked_call_times_out_with_unavailable_error(monkeypatch):
+    from ray_tpu.cluster_utils import ClusterBackendMixin
+
+    monkeypatch.setattr(ray_config, "actor_restart_timeout_s", 0.2)
+    head, worker, _submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    head.mark_node_dead("n1", reason="test kill")  # restart never done
+
+    worker.backend = SimpleNamespace(submit=lambda s: None,
+                                     resources=None)
+    backend = ClusterBackendMixin(worker, head)
+    call = _call_spec(creation, max_task_retries=1)
+    backend.submit(call)
+
+    deadline = time.monotonic() + 5.0
+    error = None
+    while time.monotonic() < deadline:
+        ready, _v, error = worker.memory_store.peek(call.return_ids[0])
+        if ready:
+            break
+        time.sleep(0.02)
+    assert isinstance(error, exc.ActorUnavailableError)
+    assert "actor_restart_timeout_s" in str(error)
+
+
+# -- plain-task death-retry accounting ---------------------------------------
+
+
+def test_lost_task_resubmits_with_decremented_budget():
+    head, worker, submitted = _make_head()
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.NORMAL_TASK, func=lambda: 1,
+                    args=(), kwargs={}, name="t", max_retries=2)
+    spec.assign_return_ids()
+    head.record_lineage(spec)
+    head.record_inflight(spec, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    assert submitted == [spec]
+    assert spec.max_retries == 1 and spec.attempt == 1
+
+
+def test_lost_task_with_exhausted_budget_fails_naming_it():
+    head, worker, submitted = _make_head()
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.NORMAL_TASK, func=lambda: 1,
+                    args=(), kwargs={}, name="t", max_retries=0)
+    spec.assign_return_ids()
+    head.record_lineage(spec)
+    head.record_inflight(spec, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    assert submitted == []
+    error = _stored_error(worker, spec)
+    assert isinstance(error, exc.TaskError)
+    assert "retry budget is exhausted" in str(error)
+
+
+# -- transitive reconstruction + spill compose -------------------------------
+
+
+def _exec_backend(head, worker, log):
+    """A backend that 'executes' specs: runs func, stores + reports the
+    output (the node-side effect, condensed)."""
+
+    def execute(spec):
+        log.append(spec.name)
+        value = spec.func()
+        worker.memory_store.put(spec.return_ids[0], value)
+        head._report_objects([spec.return_ids[0].binary()],
+                             head.server.address)
+
+    return SimpleNamespace(submit=execute)
+
+
+def test_transitive_reconstruction_charges_per_object():
+    from ray_tpu.object_ref import ObjectRef
+
+    head, worker, _ = _make_head()
+    log = []
+    worker.backend = _exec_backend(head, worker, log)
+    node_addr = ("127.0.0.1", 7191)
+
+    def chain_spec(name, func, args=()):
+        spec = TaskSpec(task_id=TaskID.from_random(),
+                        kind=TaskKind.NORMAL_TASK, func=func,
+                        args=args, kwargs={}, name=name)
+        spec.assign_return_ids()
+        head.record_lineage(spec)
+        head._report_objects([spec.return_ids[0].binary()], node_addr)
+        return spec
+
+    spec_a = chain_spec("a", lambda: 1)
+    ref_a = ObjectRef(spec_a.return_ids[0], _register=False)
+    spec_b = chain_spec("b", lambda: 2, args=(ref_a,))
+    ref_b = ObjectRef(spec_b.return_ids[0], _register=False)
+    spec_c = chain_spec("c", lambda: 3, args=(ref_b,))
+
+    head.mark_node_dead("n1", reason="test kill")  # all three lost
+    head._maybe_reconstruct(spec_c.return_ids[0].binary())
+
+    # Recursive re-execution in dependency order, each object charged
+    # its OWN attempt (not one per chain).
+    assert log == ["a", "b", "c"]
+    for spec in (spec_a, spec_b, spec_c):
+        ready, value, error = worker.memory_store.peek(
+            spec.return_ids[0])
+        assert ready and error is None
+    # _report_objects resets the attempt charge as each lands; the
+    # recursion never exceeded one attempt per object.
+    assert all(v <= 1 for v in head._recon_attempts.values())
+
+
+def test_reconstruction_cycle_guard_terminates():
+    from ray_tpu.object_ref import ObjectRef
+
+    head, worker, _ = _make_head()
+    log = []
+    # A backend that does NOT produce outputs: lineage stays lost, so a
+    # cycle would recurse forever without the guard.
+    worker.backend = SimpleNamespace(
+        submit=lambda spec: log.append(spec.name))
+    node_addr = ("127.0.0.1", 7191)
+
+    spec_a = TaskSpec(task_id=TaskID.from_random(),
+                      kind=TaskKind.NORMAL_TASK, func=lambda: 1,
+                      args=(), kwargs={}, name="a")
+    spec_a.assign_return_ids()
+    spec_b = TaskSpec(task_id=TaskID.from_random(),
+                      kind=TaskKind.NORMAL_TASK, func=lambda: 2,
+                      args=(ObjectRef(spec_a.return_ids[0],
+                                      _register=False),),
+                      kwargs={}, name="b")
+    spec_b.assign_return_ids()
+    # Forge the cycle: a depends on b, b depends on a.
+    spec_a.args = (ObjectRef(spec_b.return_ids[0], _register=False),)
+    for spec in (spec_a, spec_b):
+        head.record_lineage(spec)
+        head._report_objects([spec.return_ids[0].binary()], node_addr)
+    head.mark_node_dead("n1", reason="test kill")
+
+    head._maybe_reconstruct(spec_b.return_ids[0].binary())  # returns
+
+
+def test_lost_object_restores_from_spill_not_reexecution(tmp_path):
+    from ray_tpu._private.spilling import FileSystemStorage
+
+    head, worker, _ = _make_head()
+    log = []
+    worker.backend = _exec_backend(head, worker, log)
+    node_addr = ("127.0.0.1", 7191)
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.NORMAL_TASK,
+                    func=lambda: "recomputed", args=(), kwargs={},
+                    name="y")
+    spec.assign_return_ids()
+    oid = spec.return_ids[0]
+    head.record_lineage(spec)
+    head._report_objects([oid.binary()], node_addr)
+
+    storage = FileSystemStorage(str(tmp_path))
+    url = storage.spill(oid, cloudpickle.dumps("from-disk"))
+    head._report_spilled([oid.binary()], [url], node_id="n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+    head._maybe_reconstruct(oid.binary())
+
+    assert log == [], "spill-backed object re-executed its task"
+    ready, value, error = worker.memory_store.peek(oid)
+    assert ready and error is None and value == "from-disk"
+    # The restored copy is advertised at the head.
+    assert head.object_locations[oid.binary()] == head.server.address
+
+
+def test_stale_spill_url_falls_back_to_reexecution(tmp_path):
+    head, worker, _ = _make_head()
+    log = []
+    worker.backend = _exec_backend(head, worker, log)
+    node_addr = ("127.0.0.1", 7191)
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.NORMAL_TASK, func=lambda: "redone",
+                    args=(), kwargs={}, name="z")
+    spec.assign_return_ids()
+    oid = spec.return_ids[0]
+    head.record_lineage(spec)
+    head._report_objects([oid.binary()], node_addr)
+    head._report_spilled([oid.binary()],
+                         [f"file://{tmp_path}/gone"], node_id="n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+    head._maybe_reconstruct(oid.binary())
+
+    assert log == ["z"]
+    assert oid.binary() not in head.object_spill_urls  # dropped stale
+    ready, value, _err = worker.memory_store.peek(oid)
+    assert ready and value == "redone"
+
+
+def test_lost_actor_output_with_retries_is_lineage_recoverable():
+    """Reference semantics: objects created by actor tasks reconstruct
+    when the call carries max_task_retries budget — a completed call
+    whose output died with its node re-executes through the gate."""
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_lineage(call)
+    oid = call.return_ids[0]
+    head._report_objects([oid.binary()], ("127.0.0.1", 7191))
+
+    head.mark_node_dead("n1", reason="test kill")  # output lost
+
+    # Not poisoned (it IS recoverable)...
+    ready, _v, _e = worker.memory_store.peek(oid)
+    assert not ready
+    # ...and an on-demand locate re-executes the call.
+    head._maybe_reconstruct(oid.binary())
+    assert any(s is call for s in submitted)
+
+
+def test_lost_actor_output_without_retries_poisons_fast():
+    """A lost object with NO lineage (zero-retry actor call output) and
+    no spilled copy can never come back: waiting gets must get a typed
+    ObjectLostError now, not hang out the fetch deadline."""
+    head, worker, _submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=0)
+    head.record_lineage(call)  # no-op: zero budget, no lineage entry
+    oid = call.return_ids[0]
+    head._report_objects([oid.binary()], ("127.0.0.1", 7191))
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    error = _stored_error(worker, call)
+    assert isinstance(error, exc.ObjectLostError)
+    assert "no lineage or spilled copy" in str(error)
+
+
+# -- node spill reporting ----------------------------------------------------
+
+
+def test_memory_store_notifies_spills(monkeypatch):
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.spilling import SpillManager
+
+    monkeypatch.setattr(ray_config, "min_spilling_size_bytes", 1)
+    store = MemoryStore()
+    store.spill_manager = SpillManager(store, budget_bytes=1)
+    seen = []
+    store.on_spilled = lambda oid, url: seen.append((oid, url))
+    oid = ObjectID.from_random()
+    store.put(oid, b"x" * 4096)
+    store.spill_manager.maybe_spill()
+    assert seen and seen[0][0] == oid \
+        and seen[0][1].startswith("file://")
+    store.spill_manager.storage.destroy()
+
+
+# -- crash-mode storage ------------------------------------------------------
+
+
+def test_sqlite_crash_loses_window_keeps_acked(tmp_path):
+    from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+    path = str(tmp_path / "gcs.sqlite")
+    store = SqliteStoreClient(path, commit_interval_s=0)
+    store._interval = 3600.0  # committer-driven window
+    store.put("t", b"acked", b"1")
+    store.flush()
+    store.put("t", b"riding-the-window", b"2")
+    store.crash()
+
+    survivor = SqliteStoreClient(path, commit_interval_s=0)
+    try:
+        present = {k for k, _ in survivor.get_all("t")}
+    finally:
+        survivor.close()
+    assert present == {b"acked"}

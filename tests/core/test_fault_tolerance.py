@@ -161,6 +161,135 @@ def test_actor_without_restart_budget_dies(cluster):
         ray_tpu.get(a.f.remote(), timeout=30)
 
 
+def _counter_value(name, outcome=None):
+    from ray_tpu._private import perf_stats
+
+    # counter() is create-or-get on the process-global registry.
+    return perf_stats.counter(
+        name, {"outcome": outcome} if outcome else None).value
+
+
+def test_transitive_reconstruction_chain(cluster):
+    """Chain a → b → c across nodes; kill the node holding all the
+    intermediates. get(c) completes via RECURSIVE re-execution, and
+    the attempt charge lands per object, not per chain."""
+    import numpy as np
+
+    node = cluster.add_node(num_cpus=2, simulate_remote_host=True)
+
+    @ray_tpu.remote(num_cpus=2)
+    def a():
+        return np.arange(1000, dtype=np.float64)
+
+    @ray_tpu.remote(num_cpus=2)
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote(num_cpus=2)
+    def c(x):
+        return float(x.sum())
+
+    ra = a.remote()
+    rb = b.remote(ra)
+    rc = c.remote(rb)
+    want = float(np.arange(1000, dtype=np.float64).sum() * 2)
+    assert ray_tpu.get(rc, timeout=90) == want
+
+    # Lose every copy: evict the driver's caches, kill the producer.
+    cluster.driver_worker.memory_store.evict([ra.id, rb.id, rc.id])
+    before = _counter_value("reconstructions", "reexecute")
+    cluster.kill_node(node)
+    node2 = cluster.add_node(num_cpus=2, simulate_remote_host=True)
+    assert node2
+
+    assert ray_tpu.get(rc, timeout=120) == want
+    # The whole lost chain re-executed — one charge per OBJECT (c alone
+    # re-executing could never produce the value; a per-chain charge
+    # would burn c's budget on a/b's attempts).
+    delta = _counter_value("reconstructions", "reexecute") - before
+    assert delta >= 2, f"expected recursive re-execution, saw {delta}"
+    from ray_tpu._private.config import ray_config
+
+    assert all(v <= ray_config.max_reconstruction_attempts
+               for v in cluster.head._recon_attempts.values())
+
+
+def test_actor_call_with_retry_budget_survives_node_death(cluster):
+    """Acceptance: a call with max_task_retries > 0 whose node dies
+    MID-CALL returns the retried result — not ActorDiedError."""
+    node = cluster.add_node(num_cpus=2)
+    node2 = cluster.add_node(num_cpus=2)
+    assert node2
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2, num_cpus=2)
+    class Slow:
+        def work(self, delay):
+            time.sleep(delay)
+            return "made-it"
+
+    actor = Slow.remote()
+    assert ray_tpu.get(actor.work.remote(0.0), timeout=60) == "made-it"
+    host = next(iter(cluster.head.actor_nodes.values()))
+
+    ref = actor.work.remote(3.0)
+    time.sleep(0.8)  # dispatched and running on `host`
+    cluster.kill_node(host)
+    # The call REPLAYS against the restarted actor on the survivor.
+    assert ray_tpu.get(ref, timeout=120) == "made-it"
+
+
+def test_actor_call_without_retry_budget_rejects_naming_it(cluster):
+    """Acceptance: with retries exhausted the call rejects with an
+    error naming the restart state and budget."""
+    from ray_tpu.exceptions import ActorUnavailableError
+
+    node = cluster.add_node(num_cpus=2)
+    node2 = cluster.add_node(num_cpus=2)
+    assert node2
+
+    @ray_tpu.remote(max_restarts=1, num_cpus=2)  # max_task_retries=0
+    class Slow:
+        def work(self, delay):
+            time.sleep(delay)
+            return "made-it"
+
+    actor = Slow.remote()
+    assert ray_tpu.get(actor.work.remote(0.0), timeout=60) == "made-it"
+    host = next(iter(cluster.head.actor_nodes.values()))
+
+    ref = actor.work.remote(5.0)
+    time.sleep(0.8)
+    cluster.kill_node(host)
+    with pytest.raises(ActorUnavailableError) as ei:
+        ray_tpu.get(ref, timeout=120)
+    assert "max_task_retries" in str(ei.value)
+
+
+def test_tombstoned_actor_names_exhausted_budget(cluster):
+    """Satellite regression: after the restart budget is exhausted,
+    calls fail FAST with an ActorDiedError naming the budget — they
+    must not dispatch into a backend that has never heard of the
+    actor."""
+    node = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=2)  # max_restarts=0
+    class A:
+        def f(self):
+            return 1
+
+    from ray_tpu.exceptions import ActorDiedError
+
+    a = A.remote()
+    assert ray_tpu.get(a.f.remote(), timeout=60) == 1
+    cluster.kill_node(node)
+    _wait_for(lambda: not cluster.head.nodes[node].alive,
+              msg="node death detection")
+
+    with pytest.raises(ActorDiedError) as ei:
+        ray_tpu.get(a.f.remote(), timeout=30)
+    assert "max_restarts=0" in str(ei.value)
+
+
 def test_release_propagates_to_owner_node(cluster):
     from ray_tpu._private.rpc import RpcClient
 

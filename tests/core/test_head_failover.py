@@ -166,6 +166,84 @@ def test_head_failover_inflight_task(durable_gcs):
         cluster.shutdown()
 
 
+def test_head_hard_crash_failover(tmp_path, monkeypatch):
+    """Acceptance: crash-mode failover (NO flush_storage) recovers all
+    group-committed state, loses AT MOST the open commit window, and
+    live nodes re-register without driver intervention."""
+    from ray_tpu._private.config import ray_config
+
+    monkeypatch.setattr(ray_config, "gcs_storage_path",
+                        str(tmp_path / "gcs.sqlite"))
+    monkeypatch.setattr(ray_config, "health_check_period_s", 0.3)
+    # A wide, test-controlled commit window: what rides it when the
+    # head dies is exactly what the contract allows to be lost.
+    monkeypatch.setattr(ray_config, "gcs_commit_interval_s", 30.0)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2)
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        gcs = global_worker().gcs
+        gcs.kv_put(b"acked-key", b"durable")
+        gcs.flush_storage()  # acked durable: must survive the crash
+        gcs.kv_put(b"window-key", b"riding")  # un-acked: may be lost
+
+        cluster.restart_head(mode="crash")
+
+        # Acked-durable state survived; the window write did NOT
+        # resurrect (it was never made durable, and a crash recovers
+        # only from disk).
+        assert global_worker().gcs.kv_get(b"acked-key") == b"durable"
+        assert global_worker().gcs.kv_get(b"window-key") is None
+
+        # Live nodes re-register through report-returns-False, with no
+        # driver involvement.
+        _wait(lambda: sum(n["Alive"] for n in cluster.nodes()) >= 1,
+              msg="node re-registered after hard crash")
+
+        # And the cluster schedules new work end to end.
+        @ray_tpu.remote(num_cpus=2)
+        def on_node():
+            import os
+
+            return os.getpid()
+
+        import os
+
+        assert ray_tpu.get(on_node.remote(), timeout=60) != os.getpid()
+    finally:
+        cluster.shutdown()
+
+
+def test_head_hard_crash_inflight_task_rides_fetch_retry(tmp_path,
+                                                         monkeypatch):
+    """A task RUNNING on a node while the head hard-crashes completes;
+    its caller rides the fetch-retry window to the result."""
+    from ray_tpu._private.config import ray_config
+
+    monkeypatch.setattr(ray_config, "gcs_storage_path",
+                        str(tmp_path / "gcs.sqlite"))
+    monkeypatch.setattr(ray_config, "health_check_period_s", 0.3)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def slow():
+            import time as _t
+
+            _t.sleep(3.0)
+            return "made-it"
+
+        ref = slow.remote()
+        time.sleep(0.5)  # dispatched and running
+        cluster.restart_head(mode="crash")
+        assert ray_tpu.get(ref, timeout=45) == "made-it"
+    finally:
+        cluster.shutdown()
+
+
 def test_head_failover_without_durable_storage(tmp_path, monkeypatch):
     """Without gcs_storage_path the tables start empty after restart —
     nodes still re-register and NEW work proceeds (the non-FT

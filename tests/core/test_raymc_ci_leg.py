@@ -58,7 +58,9 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     assert report["pass"] is True
     by_name = {s["scenario"]: s for s in report["scenarios"]}
     assert set(by_name) == {"router_cap", "gcs_durability",
-                            "pipelined_close", "spill_race"}
+                            "pipelined_close", "spill_race",
+                            "lineage_reconstruction", "actor_restart",
+                            "head_crash_recovery"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"
@@ -73,6 +75,10 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     # durability scenario's schedule count must exceed the fault-free
     # interleavings alone (26 at this scope without crash branching).
     assert by_name["gcs_durability"]["executions"] >= 50, by_name
+    assert by_name["head_crash_recovery"]["executions"] >= 50, by_name
+    # The actor replay-or-reject space is the largest in the leg: a
+    # shrunk count means the scenario lost its death placements.
+    assert by_name["actor_restart"]["executions"] >= 5000, by_name
 
 
 def test_raymc_harness_clean_under_raysan_sanitizers(tmp_path):

@@ -136,6 +136,47 @@ def test_isolated_actor_without_budget_dies(ray_local):
         ray_tpu.get(a.f.remote(), timeout=30)
 
 
+def test_isolated_actor_call_replays_with_retry_budget(ray_local,
+                                                       tmp_path):
+    """Restart-window mailbox contract: the call EXECUTING when the
+    worker crashes replays on the replacement iff it carries
+    max_task_retries budget — and returns the retried result."""
+    marker = str(tmp_path / "crashed-once")
+
+    @ray_tpu.remote(isolate_process=True, max_restarts=1,
+                    max_task_retries=1)
+    class FlakyOnce:
+        def work(self, marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # first attempt: worker dies mid-call
+            return "retried-ok"
+
+    actor = FlakyOnce.remote()
+    assert ray_tpu.get(actor.work.remote(marker),
+                       timeout=120) == "retried-ok"
+
+
+def test_isolated_actor_call_without_budget_rejects_naming_it(
+        ray_local):
+    from ray_tpu.exceptions import ActorUnavailableError
+
+    @ray_tpu.remote(isolate_process=True, max_restarts=1)
+    class Crasher:  # max_task_retries=0
+        def crash(self):
+            os._exit(1)
+
+        def f(self):
+            return "alive"
+
+    actor = Crasher.remote()
+    with pytest.raises(ActorUnavailableError) as ei:
+        ray_tpu.get(actor.crash.remote(), timeout=120)
+    assert "max_task_retries" in str(ei.value)
+    # The actor itself restarted (budget 1) and keeps serving.
+    assert ray_tpu.get(actor.f.remote(), timeout=120) == "alive"
+
+
 def test_isolation_in_cluster_node_survives(tmp_path):
     """A crashing isolated task on a cluster node leaves the node alive."""
     from ray_tpu.cluster_utils import Cluster

@@ -708,15 +708,605 @@ class SpillRaceScenario(Scenario):
             pass
 
 
+# -- lineage reconstruction vs node death ------------------------------------
+
+
+class LineageReconstructionScenario(Scenario):
+    name = "lineage_reconstruction"
+    description = ("node crash between publish and consume: a get on a "
+                   "lost object returns the re-executed (or spill-"
+                   "restored) value or a bounded error — never a hang, "
+                   "never a stale/partial value")
+    points = ("recon.request", "recon.resubmit", "recon.restore",
+              "store.put", "mc.sync.get_loop")
+    max_steps = 40
+    # The getter's bounded poll loop widens the space past the CLI
+    # default; the exhaustive sweep is still small (two threads).
+    max_schedules = 6000
+    block_grace_s = 0.04
+
+    def setup(self) -> None:
+        from types import SimpleNamespace
+
+        from ray_tpu._private.config import ray_config
+        from ray_tpu._private.ids import TaskID
+        from ray_tpu._private.memory_store import MemoryStore
+        from ray_tpu._private.spilling import FileSystemStorage
+        from ray_tpu._private.task_spec import TaskKind, TaskSpec
+        from ray_tpu.cluster_utils import ClusterHead, _NodeRecord
+
+        # No health-checker thread: node liveness is scenario-driven.
+        self._saved_period = ray_config.health_check_period_s
+        ray_config.health_check_period_s = 0
+        self.reexec = {"x": 0, "y": 0}
+        worker = SimpleNamespace(memory_store=MemoryStore(),
+                                 shm_plane=None, gcs=None, backend=None)
+        self.head = head = ClusterHead(worker, start_server=False)
+        self.store = worker.memory_store
+
+        def execute(spec):
+            # The re-execution environment: runs the creating task on
+            # the head and reports the output — the real node-side
+            # store_task_outputs/report path condensed to its effect.
+            key = spec.name
+            self.reexec[key] += 1
+            value = spec.func()
+            self.store.put(spec.return_ids[0], value)
+            head._report_objects([spec.return_ids[0].binary()],
+                                 head.server.address)
+
+        worker.backend = SimpleNamespace(submit=execute)
+        self.node_addr = ("127.0.0.1", 7091)
+        head.nodes["n1"] = _NodeRecord("n1", self.node_addr, {"CPU": 1})
+        # X: lost copy must be re-created by re-executing its task.
+        spec_x = TaskSpec(task_id=TaskID.from_random(),
+                          kind=TaskKind.NORMAL_TASK,
+                          func=lambda: 42, args=(), kwargs={}, name="x")
+        spec_x.assign_return_ids()
+        self.x = spec_x.return_ids[0]
+        head.record_lineage(spec_x)
+        head._report_objects([self.x.binary()], self.node_addr,
+                             sizes=[8])
+        # Y: lost copy has a surviving spilled payload — restore must
+        # win over re-execution (reexec["y"] stays 0).
+        spec_y = TaskSpec(task_id=TaskID.from_random(),
+                          kind=TaskKind.NORMAL_TASK,
+                          func=lambda: "never", args=(), kwargs={},
+                          name="y")
+        spec_y.assign_return_ids()
+        self.y = spec_y.return_ids[0]
+        head.record_lineage(spec_y)
+        head._report_objects([self.y.binary()], self.node_addr,
+                             sizes=[16])
+        self.spill_store = FileSystemStorage()
+        import cloudpickle as _cp
+
+        url = self.spill_store.spill(self.y, _cp.dumps("from-disk"))
+        head._report_spilled([self.y.binary()], [url], node_id="n1")
+        self.results = {}
+
+    def _bounded_get(self, key, oid):
+        head, store = self.head, self.store
+        for _ in range(8):
+            sanitize_hooks.sched_point("mc.sync.get_loop")
+            ready, value, error = store.peek(oid)
+            if ready:
+                self.results[key] = ("err", error) if error else value
+                return
+            info = head._locate2(oid.binary())
+            if info is not None:
+                record = head.nodes.get("n1")
+                if tuple(info["address"]) == self.node_addr:
+                    # Remote fetch from the owner: succeeds only while
+                    # the owner process is alive (env-controlled).
+                    if record is not None and record.alive:
+                        self.results[key] = \
+                            42 if key == "x" else "from-disk"
+                        return
+                    # process gone mid-fetch: retry (next locate sees
+                    # the dropped location and reconstructs)
+        self.results[key] = ("err", "fetch deadline")
+
+    def actions(self):
+        def getter():
+            self._bounded_get("x", self.x)
+            self._bounded_get("y", self.y)
+
+        def env():
+            self.head.mark_node_dead("n1", reason="chaos kill")
+
+        return [("getter", getter), ("env", env)]
+
+    def invariants(self):
+        def values_sane(s):
+            for key, want in (("x", 42), ("y", "from-disk")):
+                got = s.results.get(key, "<pending>")
+                if got not in (want, "<pending>") and \
+                        not (isinstance(got, tuple) and got[0] == "err"):
+                    return (f"get({key}) returned stale/partial "
+                            f"{got!r} (want {want!r} or bounded error)")
+            return True
+
+        def attempts_bounded(s):
+            from ray_tpu._private.config import ray_config
+
+            cap = ray_config.max_reconstruction_attempts
+            over = {k.hex()[:8]: v
+                    for k, v in s.head._recon_attempts.items()
+                    if v > cap}
+            return (not over) or f"reconstruction attempts over " \
+                                 f"max_reconstruction_attempts: {over}"
+
+        def spill_wins(s):
+            return s.reexec["y"] == 0 or (
+                f"spill-backed object re-executed its task "
+                f"{s.reexec['y']} times instead of restoring")
+
+        return [
+            Invariant("recon-no-stale-value", values_sane,
+                      description="a get never observes a wrong value"),
+            Invariant("recon-attempts-bounded", attempts_bounded,
+                      description="per-object attempt charge holds"),
+            Invariant("recon-spill-short-circuit", spill_wins,
+                      description="a durable spilled copy restores "
+                                  "instead of re-executing"),
+        ]
+
+    def liveness(self):
+        def completes_correctly(s):
+            # The getter is a bounded loop (never hangs, by
+            # construction); with reconstruction enabled it must also
+            # CONVERGE: both gets return the real values.
+            return s.results.get("x") == 42 and \
+                s.results.get("y") == "from-disk"
+
+        return [Liveness(
+            "recon-converges", completes_correctly, timeout_s=3.0,
+            description="gets on lost objects return the re-executed/"
+                        "restored values, not errors")]
+
+    def teardown(self) -> None:
+        from ray_tpu._private.config import ray_config
+
+        ray_config.health_check_period_s = self._saved_period
+        self.head.stop()
+        try:
+            self.spill_store.destroy()
+        except Exception:
+            pass
+
+
+# -- actor restart: replay-or-reject over every death placement --------------
+
+
+class ActorRestartScenario(Scenario):
+    name = "actor_restart"
+    description = ("node death across mailbox-submit/dispatch/restart: "
+                   "<=1 execution per call always, exactly-1 for calls "
+                   "with retry budget, rejects name the budget")
+    points = ("actor.route", "actor.replay", "actor.restart.begin",
+              "actor.restart.ready", "mc.sync.exec1")
+    max_steps = 36
+    # Measured exhaustive sweep: ~17.3k schedules (~17s on a 1-core
+    # box); the floor leaves headroom so the tier-1 `exhausted` claim
+    # stays honest.
+    max_schedules = 25000
+    block_grace_s = 0.04
+
+    # The model around the REAL ActorRestartGate mirrors the head's
+    # choreography (ClusterBackendMixin.submit / ClusterHead.
+    # mark_node_dead) the way exactly_once mirrors _batch_pipe_error:
+    # dispatch appends to the hosting node's mailbox (+ the inflight
+    # table), node death sweeps the inflight snapshot through
+    # gate.recover_call, and the restarted actor's location release
+    # drains parked calls. Execution and its inflight-clear are one
+    # atomic segment — the model's analog of the output report; the
+    # report-in-flight window (at-least-once, as in the reference) is
+    # out of scope here.
+
+    def setup(self) -> None:
+        from types import SimpleNamespace
+
+        from ray_tpu._private.actor_gate import (ActorRestartGate,
+                                                 ActorRestartState)
+        self._alive = ActorRestartState.ALIVE
+        self._restarting = ActorRestartState.RESTARTING
+        self._dead = ActorRestartState.DEAD
+        self.aid = b"actor-1"
+
+        aid = self.aid
+
+        class _Call:  # hashable (rides set-typed inflight tables)
+            def __init__(self, name, retries):
+                self.name = name
+                self.max_retries = retries
+                self.actor_id = SimpleNamespace(
+                    binary=lambda: aid, hex=lambda: "61637430")
+
+            def describe(self):
+                return self.name
+
+        def call(name, retries):
+            return _Call(name, retries)
+
+        self.gate = ActorRestartGate()
+        self.gate.register(self.aid, 1)
+        self.c_r = call("r", 1)   # rides max_task_retries=1
+        self.c_n = call("n", 0)   # no retry budget
+        self.node1 = {"alive": True, "mailbox": []}
+        self.actor_node = "n1"
+        # Insertion-ordered (a set of id-hashed objects iterates in a
+        # different order per process run — divergence under replay).
+        self.inflight = []
+        self.parked = []
+        self.executions = {"r": 0, "n": 0}
+        self.rejected = {}
+        self._lock = threading.Lock()
+        # c_r is already dispatched and in flight when the fault hits.
+        self.inflight.append(self.c_r)
+        self.node1["mailbox"].append(self.c_r)
+
+    # -- model effects (the head's wiring, condensed) --------------------
+
+    def _reject(self, spec, msg, dead):
+        self.rejected[spec.name] = (msg, dead)
+
+    def _exec_on_n2(self, spec):
+        # The replacement node is warm and healthy: dispatch-to-exec is
+        # synchronous in the model (the races under proof are around
+        # the death, not the healthy node's queueing).
+        with self._lock:
+            self.executions[spec.name] += 1
+        if spec in self.inflight:
+            self.inflight.remove(spec)
+
+    def _drain_parked(self):
+        while self.parked:
+            self._submit(self.parked.pop(0))
+
+    def _park(self, spec):
+        self.parked.append(spec)
+        # Model of the park-waiter thread: an actor already ALIVE again
+        # releases immediately.
+        if self.gate.state(self.aid) == self._alive and \
+                self.actor_node is not None:
+            self._drain_parked()
+
+    def _submit(self, spec):
+        node = self.actor_node
+        if node == "n1" and self.node1["alive"]:
+            self.inflight.append(spec)
+            self.node1["mailbox"].append(spec)
+            return
+        if node == "n2":
+            self.inflight.append(spec)
+            self._exec_on_n2(spec)
+            return
+        state = self.gate.state(self.aid)
+        if state == self._dead:
+            self._reject(spec, self.gate.death_cause(self.aid), True)
+            return
+        self.gate.route_call(spec, dispatch=None, park=self._park,
+                             fail=self._reject)
+
+    # -- actions ---------------------------------------------------------
+
+    def actions(self):
+        def caller():
+            # Submitted at an arbitrary point relative to the death:
+            # may execute on n1, reject mid-restart (naming the
+            # budget), or run on the replacement.
+            self._submit(self.c_n)
+
+        def node1():
+            # Two service beats: c_r is pre-queued, c_n may land during
+            # the loop — both can execute pre-death; a third beat only
+            # re-observes an empty mailbox (space, no coverage).
+            for _ in range(2):
+                sanitize_hooks.sched_point("mc.sync.exec1")
+                if not self.node1["alive"]:
+                    return
+                if self.node1["mailbox"]:
+                    spec = self.node1["mailbox"].pop(0)
+                    sanitize_hooks.sched_point("mc.sync.exec1")
+                    if not self.node1["alive"]:
+                        return  # died mid-call: spec stays in flight
+                    with self._lock:
+                        self.executions[spec.name] += 1
+                    if spec in self.inflight:
+                        self.inflight.remove(spec)
+
+        def env_kill():
+            # mark_node_dead condensed: kill, restart decision,
+            # replay-or-reject every in-flight call via the REAL gate,
+            # then the creation resubmit completing (set_actor_node →
+            # ready → parked calls drain). The sweep-vs-ready thread
+            # race is pinned separately by a deterministic unit test
+            # (test_fault_semantics) — a fourth event-blocked thread
+            # here costs exhaustiveness.
+            self.node1["alive"] = False
+            self.actor_node = None
+            restarted = self.gate.begin_restart(self.aid,
+                                                "its node n1 died")
+            for spec in list(self.inflight):
+                self.inflight.remove(spec)
+                self.gate.recover_call(spec, resubmit=self._submit,
+                                       fail=self._reject)
+            if not restarted:
+                # tombstoned: parked calls fail fast
+                for spec in list(self.parked):
+                    self.parked.remove(spec)
+                    self._reject(spec,
+                                 self.gate.death_cause(self.aid), True)
+                return
+            self.actor_node = "n2"
+            self.gate.ready(self.aid)
+            self._drain_parked()
+
+        return [("caller", caller), ("node1", node1),
+                ("env_kill", env_kill)]
+
+    # -- properties ------------------------------------------------------
+
+    def invariants(self):
+        def at_most_once(s):
+            over = {k: v for k, v in s.executions.items() if v > 1}
+            return (not over) or f"calls executed more than once: {over}"
+
+        def no_double_outcome(s):
+            both = [k for k in s.executions
+                    if s.executions[k] >= 1 and k in s.rejected]
+            return (not both) or \
+                f"calls both executed AND rejected: {both}"
+
+        def rejects_name_budget(s):
+            bad = [
+                (k, msg) for k, (msg, _dead) in s.rejected.items()
+                if "max_task_retries" not in msg
+                and "max_restarts" not in msg
+            ]
+            return (not bad) or \
+                f"rejection errors do not name the budget: {bad}"
+
+        return [
+            Invariant("actor-at-most-once", at_most_once,
+                      description="<=1 execution per call, always"),
+            Invariant("actor-single-outcome", no_double_outcome,
+                      description="a call resolves exactly one way"),
+            Invariant("actor-reject-names-budget", rejects_name_budget,
+                      description="rejects name restart/retry budgets"),
+        ]
+
+    def liveness(self):
+        def budget_call_exactly_once(s):
+            return s.executions["r"] == 1
+
+        def no_budget_call_resolves(s):
+            return (s.executions["n"] + (1 if "n" in s.rejected
+                                         else 0)) == 1
+
+        return [
+            Liveness("actor-retry-exactly-once",
+                     budget_call_exactly_once, timeout_s=3.0,
+                     description="a call with retry budget executes "
+                                 "exactly once despite the death"),
+            Liveness("actor-zero-budget-resolves",
+                     no_budget_call_resolves, timeout_s=3.0,
+                     description="a call without budget either ran "
+                                 "pre-death or was rejected — exactly "
+                                 "one of the two"),
+        ]
+
+    def teardown(self) -> None:
+        pass
+
+
+# -- head hard-crash: durability + node re-registration convergence ----------
+
+
+class HeadCrashRecoveryScenario(Scenario):
+    name = "head_crash_recovery"
+    description = ("head killed at the commit boundary with a parked "
+                   "submitter and a live node: acked-durable rows "
+                   "survive, un-acked writes never resurrect, the node "
+                   "re-registers through the report-returns-False path")
+    # head.node_report / head.register are crossed by the node beats
+    # but left UNGATED: registration orderings touch none of the
+    # checked properties (the store and the node table are disjoint),
+    # and gating them multiplies the space ~30x past the tier-1
+    # budget. The convergence property is still driven through the
+    # real handlers at EVERY crash placement (see on_crash).
+    points = ("gcs.put",)
+    crash_points = ("gcs.commit.before", "gcs.commit.after")
+    crash_budget = 1
+    max_steps = 26
+    max_schedules = 4000
+    block_grace_s = 0.02
+
+    def setup(self) -> None:
+        from types import SimpleNamespace
+
+        from ray_tpu._private.config import ray_config
+        from ray_tpu._private.gcs_storage import SqliteStoreClient
+        from ray_tpu._private.memory_store import MemoryStore
+        from ray_tpu.cluster_utils import ClusterHead
+
+        self._saved_period = ray_config.health_check_period_s
+        ray_config.health_check_period_s = 0
+        fd, self.path = tempfile.mkstemp(prefix="raymc-headcrash-",
+                                         suffix=".db")
+        os.close(fd)
+        os.unlink(self.path)
+        # Group-commit mode, committer-driven (see gcs_durability).
+        self.store = SqliteStoreClient(self.path, commit_interval_s=0)
+        self.store._interval = 3600.0
+
+        def make_head():
+            worker = SimpleNamespace(memory_store=MemoryStore(),
+                                     shm_plane=None, gcs=None,
+                                     backend=None)
+            return ClusterHead(worker, start_server=False)
+
+        self._make_head = make_head
+        self.head = make_head()
+        self.node_addr = ("127.0.0.1", 7093)
+        self.head._register_node("n1", self.node_addr, {"CPU": 1})
+        self.accepted: List[bytes] = []
+        self.acked: set = set()
+        self.durable: set = set()
+        self.present: set = set()
+        self.crashed: str = ""
+        self._post_crash = False
+        self.converged_after_crash = False
+
+    def actions(self):
+        def writer():
+            try:
+                self.store.put("t", b"k1", b"v")
+            except Exception:
+                return  # store died under us: the write never took
+            self.accepted.append(b"k1")
+
+        def committer():
+            for window in range(2):
+                snap = list(self.accepted)
+                self.store.flush()
+                self.acked.update(snap)
+                if window == 0:
+                    sanitize_hooks.sched_point("mc.sync.commit_gap")
+
+        # No node ACTION thread: even a gate-only third thread
+        # multiplies the interleaving space ~70x past the tier-1
+        # budget, and the node's pre-crash report beats touch nothing
+        # the properties read. Its post-crash convergence handshake is
+        # driven through the REAL head handlers inside on_crash, at
+        # every explored crash placement.
+        return [("writer", writer), ("committer", committer)]
+
+    def _node_converge_step(self) -> bool:
+        """One report-loop beat against the CURRENT head; True once
+        convergence post-crash is established. Keys off the internal
+        _post_crash flag — the public ``crashed`` field is set LAST in
+        on_crash so mid-crash invariant evaluations stay vacuous."""
+        head = self.head
+        ok = head._report_resources("n1", {"CPU": 1})
+        if ok:
+            if self._post_crash:
+                self.converged_after_crash = True
+                return True
+            return False
+        head._register_node("n1", self.node_addr, {"CPU": 1})
+        return False
+
+    def on_point(self, point: str, role: str) -> None:
+        if point == "gcs.commit.after":
+            self.durable.update(self.accepted)
+
+    def on_crash(self, point: str) -> None:
+        from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+        # The head process dies: connection drops (open window rolls
+        # back) and every in-memory table is gone. crash() takes the
+        # store lock, sequencing the close after any in-flight
+        # statement (a lock-blocked writer then sees a clean
+        # ProgrammingError).
+        self.store.crash()
+        survivor = SqliteStoreClient(self.path, commit_interval_s=0)
+        try:
+            self.present = {k for k, _ in survivor.get_all("t")}
+        finally:
+            survivor.close()
+        old = self.head
+        self.head = self._make_head()  # fresh head, EMPTY node table
+        old.stop()
+        self._post_crash = True
+        # The node's report loop keeps beating after the failover (in
+        # product it is an infinite timer loop; the bounded action
+        # thread may already have drained its iterations). Driving the
+        # remaining beats here keeps every crash placement's
+        # convergence CHECKED without an unbounded action: report →
+        # False → re-register → report → True, all real head handlers.
+        for _ in range(3):
+            if self._node_converge_step():
+                break
+        self.crashed = point  # LAST: invariants key off it
+
+    def invariants(self):
+        def durability(s):
+            if not s.crashed:
+                return True
+            lost = s.acked - s.present
+            return (not lost
+                    or f"acked-durable rows lost across head crash at "
+                       f"{s.crashed}: {sorted(lost)}")
+
+        def no_resurrection(s):
+            if not s.crashed:
+                return True
+            ghosts = s.present - s.durable
+            return (not ghosts
+                    or f"un-acked writes resurrected after head crash "
+                       f"at {s.crashed}: {sorted(ghosts)}")
+
+        def reregistered(s):
+            # Evaluated at end-state: by then on_crash has driven the
+            # node's remaining report beats, so a crash execution that
+            # did NOT converge is a real protocol failure, not a
+            # bounded-thread artifact. (An invariant, not a Liveness:
+            # the state is final when the actions drain — polling
+            # would only burn the budget.)
+            if not s.crashed:
+                return True
+            record = s.head.nodes.get("n1")
+            if record is None or not record.alive:
+                return ("node n1 never re-registered with the "
+                        "post-crash head")
+            return s.converged_after_crash or \
+                "node n1 re-registered but never reconverged (no " \
+                "True report after the crash)"
+
+        return [
+            Invariant("head-crash-durability", durability,
+                      description="acked table rows survive the crash"),
+            Invariant("head-crash-no-resurrection", no_resurrection,
+                      description="window-riding writes stay dead"),
+            Invariant("head-crash-node-converges", reregistered,
+                      description="the live node re-registers through "
+                                  "report-returns-False, no driver "
+                                  "intervention"),
+        ]
+
+    def teardown(self) -> None:
+        from ray_tpu._private.config import ray_config
+
+        ray_config.health_check_period_s = self._saved_period
+        try:
+            self.head.stop()
+        except Exception:
+            pass
+        try:
+            if not self.crashed:
+                self.store.close()
+        except Exception:
+            pass
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                os.unlink(self.path + suffix)
+            except OSError:
+                pass
+
+
 SCENARIOS = {
     cls.name: cls
     for cls in (RouterCapScenario, PipelinedCloseScenario,
                 GroupCommitDurabilityScenario,
                 ExactlyOnceResubmitScenario, LongPollRecoveryScenario,
-                SpillRaceScenario)
+                SpillRaceScenario, LineageReconstructionScenario,
+                ActorRestartScenario, HeadCrashRecoveryScenario)
 }
 
 # The bounded tier-1 leg: real code, small configs, exhaustive where
 # the scenario supports it (see test_raymc_ci_leg.py).
 DEFAULT_SCENARIOS = ("router_cap", "gcs_durability", "pipelined_close",
-                     "spill_race")
+                     "spill_race", "lineage_reconstruction",
+                     "actor_restart", "head_crash_recovery")
