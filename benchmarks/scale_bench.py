@@ -478,6 +478,147 @@ def chaos(broadcast_mb=256, n_consumers=200):
         cluster.shutdown()
 
 
+def tenancy(n_flood=40, n_serve=60, hog_chunks=4):
+    """Tenancy enforcement A/B (ROADMAP item 4): a submit flood, an
+    object hog, and a latency-sensitive serve job run concurrently,
+    once with enforcement OFF (the control: the flood takes every CPU
+    it can, nothing is shed or charged) and once ON (flood capped at
+    cpus:1, overflow rejected typed, hog's arena spills charged to the
+    hog, serve p99 protected). Same-run A/B — absolutes across hosts
+    are not comparable, the off/on contrast is the result."""
+    import threading as _threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import perf_stats
+    from ray_tpu._private.config import ray_config
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.shm_plane import (SharedPlane,
+                                            publish_task_output)
+    from ray_tpu.exceptions import JobQuotaExceededError
+
+    track_lock = _threading.Lock()
+    track = {"running": 0, "peak": 0}
+
+    def flood_body():
+        with track_lock:
+            track["running"] += 1
+            track["peak"] = max(track["peak"], track["running"])
+        time.sleep(0.1)
+        with track_lock:
+            track["running"] -= 1
+        return 1
+
+    def one_side(enforce: bool) -> dict:
+        ray_config.tenancy_enforcement = enforce
+        # Ceiling at half the flood: the overflow must fail TYPED on
+        # the enforced side, not queue without bound.
+        ray_config.job_quotas = \
+            "job-flood=cpus:1,queued:%d" % (n_flood // 2)
+        ray_config.job_weights = "job-serve=8,job-flood=1"
+        ray_config.job_arena_budgets = "job-hog=4m"
+        with track_lock:
+            track["running"] = track["peak"] = 0
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=4)
+        from ray_tpu._private.task_spec import set_ambient_job_id
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        plane = SharedPlane(f"/rt_scale_ten_{os.getpid()}_{enforce}",
+                            create=True, capacity=24 * 2**20)
+        plane.install(w)
+        spill_base = perf_stats.counter(
+            "job_arena_spill_bytes", {"job": "job-hog"}).value
+        rej_base = perf_stats.counter(
+            "job_quota_rejections", {"job": "job-flood"}).value
+        try:
+            @serve.deployment
+            class Api:
+                def __call__(self, request):
+                    return {"out": 1}
+
+            handle = serve.run(Api.bind(), route_prefix="/api")
+            ray_tpu.get(handle.remote({}), timeout=60)  # warm
+
+            flood = ray_tpu.remote(num_cpus=1)(flood_body)
+            prev = set_ambient_job_id("job-flood")
+            try:
+                flood_refs = [flood.remote() for _ in range(n_flood)]
+            finally:
+                set_ambient_job_id(prev)
+
+            # The hog, mid-flood.
+            for i in range(hog_chunks):
+                oid = ObjectID.from_random()
+                value = np.full(1_000_000, float(i))  # 8 MB
+                w.memory_store.put(oid, value, job_id="job-hog")
+                publish_task_output(w, oid, value)
+
+            # The SLO job, mid-flood: sequential keep-pressure
+            # requests, each timed.
+            lat = []
+            for _ in range(n_serve):
+                t0 = time.perf_counter()
+                ray_tpu.get(handle.remote({}, _job="job-serve"),
+                            timeout=60)
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+
+            ok = rejected = 0
+            for ref in flood_refs:
+                try:
+                    ray_tpu.get(ref, timeout=300)
+                    ok += 1
+                except JobQuotaExceededError:
+                    rejected += 1
+            with track_lock:
+                peak = track["peak"]
+            return {
+                "enforcement": enforce,
+                "flood_submitted": n_flood,
+                "flood_completed": ok,
+                "flood_rejected_typed": rejected,
+                "flood_peak_concurrency": peak,
+                "serve_requests": n_serve,
+                "serve_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                # ceil-based rank: int(n*0.99)-1 picks the p98 sample
+                # at n=60.
+                "serve_p99_ms": round(
+                    lat[min(len(lat) - 1,
+                            -(-len(lat) * 99 // 100) - 1)] * 1e3, 2),
+                "hog_published_mb": hog_chunks * 8,
+                "hog_arena_spill_bytes": perf_stats.counter(
+                    "job_arena_spill_bytes",
+                    {"job": "job-hog"}).value - spill_base,
+                "quota_rejections_metered": perf_stats.counter(
+                    "job_quota_rejections",
+                    {"job": "job-flood"}).value - rej_base,
+            }
+        finally:
+            try:
+                serve.shutdown()
+            except Exception:
+                pass
+            plane.destroy()
+            ray_tpu.shutdown()
+            ray_config.reset()
+
+    off = one_side(False)
+    on = one_side(True)
+    assert on["flood_peak_concurrency"] <= 1, on
+    assert off["flood_peak_concurrency"] > 1, off
+    return {
+        "off": off,
+        "on": on,
+        "serve_p99_protection_x": round(
+            max(off["serve_p99_ms"], 0.01)
+            / max(on["serve_p99_ms"], 0.01), 2),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
@@ -531,6 +672,8 @@ def main():
     if want("chaos"):
         section("chaos",
                 lambda: chaos(broadcast_mb=args.broadcast_mb), out)
+    if want("tenancy"):
+        section("tenancy", tenancy, out)
 
     print(json.dumps(out, indent=2))
     if args.out:

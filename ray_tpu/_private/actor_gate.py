@@ -53,15 +53,24 @@ class ActorRestartGate:
 
     # -- registration / introspection -----------------------------------
 
-    def register(self, actor_id: bytes, max_restarts: int) -> None:
+    def register(self, actor_id: bytes, max_restarts: int,
+                 used: int = 0) -> None:
         """First sighting of an actor creation: seed budget + state.
         Idempotent — a resubmitted creation spec must not reset a
-        partially-consumed budget."""
+        partially-consumed budget. ``used`` is the consumed-restart
+        count carried on a node's re-register report: a FRESH gate
+        (head failover) seeds ``max_restarts - used``, so budgets
+        survive the failover instead of resetting (ROADMAP FT gap c).
+        An actor re-reported with its whole budget spent registers at 0
+        left — alive now, tombstoned on its next death."""
         with self._lock:
             if actor_id in self._state:
                 return
             self._state[actor_id] = ActorRestartState.ALIVE
-            self._budget[actor_id] = max_restarts
+            budget = max_restarts
+            if max_restarts >= 0 and used > 0:
+                budget = max(0, max_restarts - used)
+            self._budget[actor_id] = budget
             self._max_restarts[actor_id] = max_restarts
 
     def state(self, actor_id: bytes) -> Optional[str]:

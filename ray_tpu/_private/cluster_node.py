@@ -43,6 +43,10 @@ class NodeRuntime:
         worker_mod.shutdown()
         self.worker = worker_mod.init(**_res_kwargs(resources))
         self.worker.is_cluster_node = True
+        # Tenancy quotas are CLUSTER-wide, enforced once at the head's
+        # grant/admission path; a node re-enforcing them against its
+        # local slice of capacity would double-charge every job.
+        self.worker.backend.quota_ledger.disable()
         # Endpoints are advertised at the interface the head routes us
         # on (loopback in single-host simulation, the NIC IP on a real
         # multi-host deployment) — the reference's node manager likewise
@@ -883,8 +887,15 @@ class NodeRuntime:
                                   {}).values()):
             try:
                 if actor.state != "DEAD":
+                    # Consumed-restart count = head-driven restarts
+                    # recorded on the spec + this node's own in-place
+                    # worker restarts: the fresh head's gate seeds the
+                    # REMAINING budget, not a reset one.
+                    used = getattr(actor.spec, "restarts_used", 0) + \
+                        actor.num_restarts
                     self.head.call("report_actor", spec=actor.spec,
-                                   node_id=self.node_id)
+                                   node_id=self.node_id,
+                                   restarts_used=used)
             except Exception:
                 pass
         oids = [oid for oid in self._reported_oids

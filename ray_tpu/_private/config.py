@@ -158,6 +158,39 @@ class RayTpuConfig:
     health_loop_lag_threshold_s: float = 0.25
     health_slo_burn_threshold: float = 4.0
 
+    # -- tenancy enforcement (_private/tenancy.py; the enforcement half
+    #    of the PR 6 attribution plane — reference: scheduling policies
+    #    at lease grant + Serve ingress limits) --------------------------
+    # Master switch: quotas, WFQ, ingress rate limits, and arena-budget
+    # victim ordering all gate on this (attribution/metering is always
+    # on). Off = PR 6 behavior exactly.
+    tenancy_enforcement: bool = False
+    # Per-job quotas: "jobA=cpus:2,queued:100,leases:2;jobB=cpus:1".
+    # cpus bounds concurrently RUNNING CPU slots (over-quota tasks park
+    # behind the job's own limit), queued bounds admitted-not-started
+    # tasks (beyond it submits fail with JobQuotaExceededError), leases
+    # bounds concurrently held pipelined dispatch leases.
+    job_quotas: str = ""
+    # WFQ weights for the scheduler's runnable queue and the serve
+    # router: "jobA=4,jobB=1". Unlisted (and untagged) traffic uses
+    # job_default_weight.
+    job_weights: str = ""
+    job_default_weight: float = 1.0
+    # Ingress token buckets: "jobA=rate[:burst];..." per second, shed
+    # with 429 + Retry-After BEFORE the router. 0 default rate = only
+    # explicitly listed jobs are limited.
+    ingress_rate_limits: str = ""
+    ingress_default_rate_per_s: float = 0.0
+    ingress_default_burst: float = 0.0
+    # Optional shared-secret ingress auth: when set, requests must
+    # carry "Authorization: Bearer <token>" or "X-Auth-Token: <token>"
+    # or are refused with 401 before any routing work happens.
+    ingress_auth_token: str = ""
+    # Per-job shared-arena budgets: "jobA=64m;jobB=1g". A job over its
+    # budget has ITS cold objects spilled first under arena pressure,
+    # so its oversized working set cannot evict another tenant's.
+    job_arena_budgets: str = ""
+
     # -- GCS storage (reference: store_client/; "" = in-memory, a file
     #    path selects the durable SQLite backend in Redis's role) -------
     gcs_storage_path: str = ""

@@ -24,6 +24,7 @@ from time import monotonic as _monotonic
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import perf_stats as _perf_stats
+from ray_tpu._private import tenancy
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu._private.resources import ResourceSet, to_milli
 from ray_tpu._private.task_spec import (
@@ -209,7 +210,18 @@ class LocalBackend:
         self.resources = ResourceSet(resources)
         self._pending_deps: dict[ObjectID, list[TaskSpec]] = {}
         self._dep_counts: dict[bytes, int] = {}  # task_id binary -> remaining deps
-        self._ready: "queue.Queue[TaskSpec]" = queue.Queue()
+        # Runnable queue: per-job virtual-time WFQ when tenancy
+        # enforcement + weights are configured, byte-identical FIFO
+        # otherwise (one class). Same put/get/get_nowait surface as the
+        # queue.Queue it replaces.
+        self._ready = tenancy.FairTaskQueue()
+        # Per-job quota ledger (tenancy enforcement): queued-task
+        # ceiling at admission, CPU-slot gate at dispatch. One ledger
+        # per head process — the cluster mixin shares it through
+        # __getattr__ delegation so a job's usage is one number whether
+        # its tasks run here or ride a lease. Node processes disable
+        # theirs (the head already enforced at grant).
+        self.quota_ledger = tenancy.QuotaLedger()
         self._waiting_for_resources: list[TaskSpec] = []
         # Incremental queued-demand accounting (reference: raylet
         # backlog). Scanning the ready queue per submission made the
@@ -268,6 +280,16 @@ class LocalBackend:
         if spec.kind == TaskKind.ACTOR_TASK:
             self._submit_actor_task(spec)
             return
+        # Tenancy admission: a job at its queued-task ceiling is
+        # rejected HERE, with a typed error, before the spec costs the
+        # scheduler anything (idempotent per spec — cluster-mixin
+        # admission and dep-park resubmits never double-charge).
+        reason = self.quota_ledger.note_queued(spec)
+        if reason is not None:
+            self.worker.store_task_outputs(
+                spec, None, error=exc.JobQuotaExceededError(
+                    spec.job_id or "", reason))
+            return
         if spec.kind == TaskKind.ACTOR_CREATION:
             existing = self._actors.get(spec.actor_id)
             if existing is not None and \
@@ -275,7 +297,10 @@ class LocalBackend:
                 # Duplicate creation (e.g. a node-death sweep re-driving
                 # a spec that also took the normal path): creating a
                 # second instance would strand queued calls in a mailbox
-                # whose creation can never get resources.
+                # whose creation can never get resources. Release the
+                # admission charge taken above — a swallowed duplicate
+                # must not hold a phantom queued slot forever.
+                self.quota_ledger.note_dequeued(spec)
                 return
             # Register the mailbox immediately so method calls submitted
             # before the creation task is dispatched are queued, mirroring
@@ -332,6 +357,11 @@ class LocalBackend:
         except Exception:
             return False  # malformed request: let the dispatcher report it
         if not self.resources.try_acquire(request):
+            return False
+        if not self.quota_ledger.try_acquire_cpu(spec):
+            # Job at its CPU quota: the dispatcher path parks it behind
+            # the job's own limit instead of the fast path running it.
+            self.resources.release(request)
             return False
         self._launch(spec, self.resources, request)
         return True
@@ -422,6 +452,7 @@ class LocalBackend:
             for s in candidates:
                 if s.task_id.binary() in self._cancelled:
                     self._pending_remove(s)
+                    self.quota_ledger.release_cpu(s)
                     self.worker.store_task_outputs(
                         s, None, error=exc.TaskCancelledError(s.describe())
                     )
@@ -439,6 +470,7 @@ class LocalBackend:
                     continue
                 if not pool.can_fit_total(request):
                     self._pending_remove(s)
+                    self.quota_ledger.release_cpu(s)
                     self.worker.store_task_outputs(
                         s, None, error=exc.RayTpuError(
                             f"task {s.describe()} requests {s.resources} which can "
@@ -447,6 +479,22 @@ class LocalBackend:
                     )
                     continue
                 if pool.try_acquire(request):
+                    # Quota gate AFTER the pool acquire (same order as
+                    # _try_fast_dispatch, pool rolled back on denial):
+                    # the quota bounds concurrently RUNNING slots, so
+                    # a spec that cannot run yet must not hold a
+                    # charge that starves its job's smaller tasks.
+                    # Actor CREATIONS are charged too (an actor holds
+                    # its CPU slots for life — exempting them would
+                    # let a tenant run its whole flood as actors);
+                    # their charge releases on actor death, not task
+                    # completion.
+                    if s.kind in (TaskKind.NORMAL_TASK,
+                                  TaskKind.ACTOR_CREATION) and \
+                            not self.quota_ledger.try_acquire_cpu(s):
+                        pool.release(request)
+                        still_waiting.append(s)
+                        continue
                     self._pending_remove(s)
                     self._launch(s, pool, request)
                 else:
@@ -459,10 +507,12 @@ class LocalBackend:
                     self.resources.wait_for_change(timeout=0.05)
 
     def _launch(self, spec: TaskSpec, pool: ResourceSet, request: Dict[str, int]):
+        self.quota_ledger.note_dequeued(spec)  # left the queue: dispatching
         if spec.kind == TaskKind.ACTOR_CREATION:
             actor = self._actors[spec.actor_id]
             if actor.state == ActorState.DEAD:  # killed while pending
                 pool.release(request)
+                self.quota_ledger.release_cpu(spec)
                 return
             actor._held_pool = pool
             actor._held_request = request
@@ -539,6 +589,9 @@ class LocalBackend:
         finally:
             ctx.pop()
             pool.release(request)
+            # Tenancy CPU-slot release (token-guarded no-op for
+            # unquota'd jobs): the job's parked work may dispatch now.
+            self.quota_ledger.release_cpu(spec)
 
     def _execute_actor_task(self, actor: _Actor, spec: TaskSpec):
         ctx = self.worker.task_context
@@ -667,8 +720,12 @@ class LocalBackend:
         replay-or-reject; the reject names the remaining budgets) —
         else die."""
         spec = actor.spec
+        # Budget = in-place worker restarts here PLUS head-driven
+        # node-death restarts recorded on the spec (restarts_used): the
+        # two consume ONE max_restarts allowance, not one each.
+        used = actor.num_restarts + getattr(spec, "restarts_used", 0)
         can_restart = spec.max_restarts == -1 or \
-            actor.num_restarts < spec.max_restarts
+            used < spec.max_restarts
         drained = actor.stop(f"worker process crashed: {cause}")
         if actor._proc is not None:
             self.worker_pool.release_dedicated(actor._proc)
@@ -727,7 +784,10 @@ class LocalBackend:
         if actor._proc is not None:
             self.worker_pool.release_dedicated(actor._proc)
             actor._proc = None
-        # Idempotent: release lifetime resources exactly once.
+        # Idempotent: release lifetime resources exactly once — the
+        # tenancy CPU charge is lifetime-held like the pool slots
+        # (restarts keep it; only true death frees it).
+        self.quota_ledger.release_cpu(actor.spec)
         pool = getattr(actor, "_held_pool", None)
         if pool is not None:
             actor._held_pool = None
@@ -804,6 +864,7 @@ class LocalBackend:
                 self._pending_milli[k] = self._pending_milli.get(k, 0) + v
 
     def _pending_remove(self, spec) -> None:
+        self.quota_ledger.note_dequeued(spec)
         milli = self._spec_milli(spec)
         with self._lock:
             self._pending_count = max(0, self._pending_count - 1)

@@ -349,6 +349,13 @@ class MemoryStore:
             manager.note_drop(heap_size)
         return True
 
+    def entry_job(self, object_id: ObjectID) -> str:
+        """Producing job of a ready entry ("" = untagged/unknown) — how
+        the shared arena charges object bytes to tenants."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return "" if entry is None else entry.job_id
+
     def entry_size(self, object_id: ObjectID) -> int:
         """Estimated payload size of a ready entry (0 when unknown) —
         what object-location reports carry for locality scoring."""

@@ -207,6 +207,39 @@ def _collect_job_metrics(w) -> None:
         _set_multi_series("ray_tpu_job_object_store_bytes",
                           "Estimated object-store bytes owned by job",
                           ("job",), obj_bytes)
+    # Tenancy enforcement state: live quota usage per job (the
+    # rejection/park/rate-limit counters ride the fast-path fold as
+    # ray_tpu_job_quota_*_total / ray_tpu_job_rate_limited_total).
+    ledger = getattr(getattr(w, "backend", None), "quota_ledger", None)
+    if ledger is not None:
+        cpu_used: Dict[Tuple[str, ...], float] = {}
+        queued: Dict[Tuple[str, ...], float] = {}
+        parked: Dict[Tuple[str, ...], float] = {}
+        for job in ledger.jobs():
+            if not job:
+                continue
+            u = ledger.usage(job)
+            cpu_used[(job,)] = float(u["cpu_milli"])
+            queued[(job,)] = float(u["queued"])
+            parked[(job,)] = float(u["parked"])
+        _set_multi_series("ray_tpu_job_quota_cpu_milli",
+                          "Running milli-CPU charged against the "
+                          "job's quota", ("job",), cpu_used)
+        _set_multi_series("ray_tpu_job_quota_queued",
+                          "Tasks admitted against the job's "
+                          "queued-task ceiling", ("job",), queued)
+        _set_multi_series("ray_tpu_job_quota_parked",
+                          "Tasks parked behind the job's CPU quota",
+                          ("job",), parked)
+    plane = getattr(w, "shm_plane", None)
+    if plane is not None and hasattr(plane, "job_arena_bytes"):
+        arena: Dict[Tuple[str, ...], float] = {}
+        for job, nbytes in plane.job_arena_bytes().items():
+            if job:
+                arena[(job,)] = float(nbytes)
+        _set_multi_series("ray_tpu_job_arena_bytes",
+                          "Shared-arena bytes charged to the "
+                          "producing job", ("job",), arena)
 
 
 def collect_runtime_metrics() -> None:

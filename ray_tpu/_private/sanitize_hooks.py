@@ -109,6 +109,17 @@ SCHED_POINTS = frozenset({
     # returns-False → re-register path)
     "head.node_report",
     "head.register",
+    # tenancy enforcement: quota check-and-charge / release and the
+    # over-quota park (the quota_admission raymc scenario's
+    # interleaving surface). Each fires ONLY for jobs with a
+    # configured quota, so unquota'd hot paths cross nothing. The WFQ
+    # queue's enqueue/serve edges are gated scenario-side
+    # (mc.sync.wfq.*) — a product crossing there would fire on every
+    # idle dispatch-loop poll and get the runtime's own dispatcher
+    # adopted into the exploration.
+    "tenancy.acquire",
+    "tenancy.release",
+    "tenancy.park",
 })
 
 CRASH_POINTS = frozenset({

@@ -38,6 +38,7 @@ from typing import Any, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private import perf_stats as _perf_stats
+from ray_tpu._private import tenancy
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.shm_store import ShmObjectStore
 
@@ -107,6 +108,11 @@ class SharedPlane:
         # what it owns — its pin is the one it may drop).
         self._written: "collections.OrderedDict[bytes, int]" = \
             collections.OrderedDict()
+        # Producing job per written object (tenancy arena budgets):
+        # bytes are CHARGED to the job whose task produced them, so
+        # pressure spill can victimize the over-budget tenant's cold
+        # objects first instead of whoever happens to be oldest.
+        self._written_jobs: dict = {}
         self._owner = create
         # Set by install(): the worker whose memory store carries the
         # spill URLs for objects swapped out of this arena.
@@ -188,10 +194,38 @@ class SharedPlane:
                 view[off + boff:off + boff + blen] = r.cast("B")
         ok = bool(self.store._lib.shm_obj_seal(self.store._handle, oid))
         if ok:
+            job = self._job_of_entry(object_id)
             with self._lock:
                 self._written[oid] = total
                 self._written.move_to_end(oid)
+                if job:
+                    self._written_jobs[oid] = job
         return ok
+
+    def _job_of_entry(self, object_id: ObjectID) -> str:
+        """Producing job of the object being published, read from the
+        worker's store entry (the tags PR 6 put there)."""
+        worker = self._worker
+        if worker is None:
+            return ""
+        store = getattr(worker, "memory_store", None)
+        if store is None or not hasattr(store, "entry_job"):
+            return ""
+        try:
+            return store.entry_job(object_id)
+        except Exception:
+            return ""
+
+    def job_arena_bytes(self) -> dict:
+        """Arena bytes charged per producing job over this process's
+        written objects ("" = untagged) — job_summary's ``arena_bytes``
+        and the budget check's usage side."""
+        out: dict = {}
+        with self._lock:
+            for oid, size in self._written.items():
+                job = self._written_jobs.get(oid, "")
+                out[job] = out.get(job, 0) + size
+        return out
 
     # -- read side -------------------------------------------------------
 
@@ -273,6 +307,7 @@ class SharedPlane:
             pass
         with self._lock:
             self._written.pop(oid, None)
+            self._written_jobs.pop(oid, None)
 
     # -- spill-to-disk under arena pressure ------------------------------
 
@@ -296,6 +331,15 @@ class SharedPlane:
         freed = 0
         with self._lock:
             candidates = [ob for ob in self._written if ob != exclude]
+            job_of = dict(self._written_jobs)
+        # Tenancy arena budgets: victimize the OVER-BUDGET jobs' cold
+        # objects first (cold-first within each tier — `_written` is
+        # oldest-first), so one tenant's oversized working set spills
+        # ITSELF before it can evict another tenant's bytes.
+        over = tenancy.over_budget_jobs(self.job_arena_bytes())
+        if over:
+            candidates = tenancy.order_spill_victims(
+                candidates, lambda ob: job_of.get(ob, ""), over)
         for ob in candidates:
             if freed >= needed:
                 break
@@ -318,8 +362,13 @@ class SharedPlane:
             if self.store.delete(ob):
                 freed += size
                 _SHM_SPILLS.inc()
+                # Spilled bytes are charged to the producing job: the
+                # hog sees its own pressure in job_summary/metrics.
+                tenancy.arena_spill_counter(
+                    job_of.get(ob, "")).inc(size)
             with self._lock:
                 self._written.pop(ob, None)
+                self._written_jobs.pop(ob, None)
         return freed
 
     def stats(self) -> dict:

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import state as state_mod
+from ray_tpu._private import tenancy
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import ray_config
 from ray_tpu._private.ids import ObjectID
@@ -526,6 +527,7 @@ class ClusterHead:
 
     def _report_objects(self, oids: List[bytes], address, sizes=None):
         frees = []
+        finished = []
         with self._lock:
             for i, oid in enumerate(oids):
                 self.object_locations[oid] = tuple(address)
@@ -535,8 +537,11 @@ class ClusterHead:
                 # Outputs landed: the producing task is no longer in
                 # flight anywhere; its arg pins drop with it.
                 tid = ObjectID(oid).task_id().binary()
-                self.inflight.pop(tid, None)
+                entry = self.inflight.pop(tid, None)
+                if entry is not None:
+                    finished.append(entry[1])
                 frees.extend(self._unpin_task_locked(tid))
+        self._quota_release(finished)
         self._fan_out_frees(frees)
         # Wake the driver's fetch dispatcher for anything it awaits.
         notify = getattr(self.worker, "_fetch_notify", None)
@@ -586,8 +591,15 @@ class ClusterHead:
         if spec.kind == TaskKind.ACTOR_CREATION:
             # Gate registration is idempotent: a restart's resubmitted
             # creation spec never resets a partially-consumed budget.
+            # `restarts_used` rides the spec (incremented per restart,
+            # shipped with it), so a FRESH gate — a failed-over head
+            # whose nodes re-report their actors — seeds the budget
+            # with the consumed count instead of resetting it
+            # (ROADMAP FT gap c).
             self.actor_gate.register(spec.actor_id.binary(),
-                                     getattr(spec, "max_restarts", 0))
+                                     getattr(spec, "max_restarts", 0),
+                                     used=getattr(spec, "restarts_used",
+                                                  0))
 
     def record_inflight(self, spec, node_id: str) -> None:
         # All kinds, actor calls included: a node death must *fail* an
@@ -612,7 +624,36 @@ class ClusterHead:
             tid = spec.task_id.binary()
             self.inflight.pop(tid, None)
             frees = self._unpin_task_locked(tid)
+        self._quota_release([spec])
         self._fan_out_frees(frees)
+
+    def _quota_release(self, specs) -> None:
+        """Release tenancy CPU charges for specs leaving the in-flight
+        table (token-guarded: no-ops for unquota'd jobs and for specs
+        whose charge a local execution already released). Actor
+        CREATIONS are lifetime charges — they release at actor death
+        (`release_actor_quota`), never at inflight-clear."""
+        if not specs:
+            return
+        backend = getattr(self.worker, "backend", None)
+        ledger = getattr(backend, "quota_ledger", None)
+        if ledger is None:
+            return
+        for spec in specs:
+            if spec.kind != TaskKind.ACTOR_CREATION:
+                ledger.release_cpu(spec)
+
+    def release_actor_quota(self, actor_id: bytes) -> None:
+        """An actor died for real (tombstoned/killed): free its
+        creation's lifetime CPU charge."""
+        backend = getattr(self.worker, "backend", None)
+        ledger = getattr(backend, "quota_ledger", None)
+        if ledger is None:
+            return
+        with self._lock:
+            spec = self.actor_specs.get(actor_id)
+        if spec is not None:
+            ledger.release_cpu(spec)
 
     def _unpin_task_locked(self, tid: bytes) -> list:
         frees = []
@@ -821,6 +862,13 @@ class ClusterHead:
                     self.actor_nodes.pop(aid, None)
                 continue
             self._restart_actor(aid, node_id)
+        # Dead-node tasks left the in-flight table: release their
+        # tenancy CPU charges BEFORE the resubmit re-enters admission
+        # (a replay must re-acquire like any dispatch, not double-hold).
+        # _quota_release itself keeps creations' lifetime charges held
+        # through the restart, and actor-task releases are token-
+        # guarded no-ops.
+        self._quota_release(resubmit)
         for spec in resubmit:
             if spec.kind == TaskKind.ACTOR_TASK:
                 # Replay-or-reject (reference: max_task_retries covers
@@ -843,10 +891,16 @@ class ClusterHead:
         if not self.actor_gate.begin_restart(actor_id, reason):
             # Budget exhausted: tombstoned by the gate — later calls
             # fail FAST with the cause, instead of falling through to a
-            # backend that has never heard of the actor.
+            # backend that has never heard of the actor. The dead
+            # actor's lifetime CPU charge frees with it.
             _restart_counter("exhausted").inc()
+            self.release_actor_quota(actor_id)
             return
         _restart_counter("restarted").inc()
+        # The consumed-restart count travels ON the spec: the node
+        # hosting the replacement re-reports it on head failover, so a
+        # fresh gate never resets a partially-spent budget.
+        spec.restarts_used = getattr(spec, "restarts_used", 0) + 1
         # Re-run the creation spec through the normal scheduler; it
         # re-registers the actor's node on dispatch (set_actor_node →
         # gate.ready releases parked callers).
@@ -1108,10 +1162,18 @@ class ClusterHead:
         self.worker.backend.submit(spec)
         return True
 
-    def _report_actor(self, spec, node_id: str) -> bool:
+    def _report_actor(self, spec, node_id: str,
+                      restarts_used: Optional[int] = None) -> bool:
         """An actor created LOCALLY inside a node process registers with
         the head's directory, so handles to it route from anywhere and
-        it gets the same restart bookkeeping as head-dispatched actors."""
+        it gets the same restart bookkeeping as head-dispatched actors.
+        ``restarts_used`` rides a node's RE-report after head failover:
+        the fresh gate must seed the budget with what the actor already
+        consumed (head-driven restarts on the spec + node-local worker
+        restarts), not reset it (ROADMAP FT gap c)."""
+        if restarts_used is not None:
+            spec.restarts_used = max(
+                getattr(spec, "restarts_used", 0), int(restarts_used))
         self.record_lineage(spec)
         self.set_actor_node(spec.actor_id.binary(), node_id)
         return True
@@ -1202,6 +1264,29 @@ class ClusterBackendMixin:
         self._submit_locks: Dict[str, Any] = {}
         # (node_id, oid) pairs already pushed (push_manager dedupe).
         self._pushed: set = set()
+        # Tenancy: over-CPU-quota specs park in the shared ledger; ONE
+        # drainer thread resubmits them as their jobs free capacity
+        # (lazily spawned, retires when the park list drains). Actor
+        # calls parked for a restart window share the same design: one
+        # dispatcher draining the parked list on gate.wait_change —
+        # NOT a waiter thread per call.
+        self._quota_stop = threading.Event()
+        self._quota_drainer: Optional[threading.Thread] = None
+        self._parked_calls: list = []
+        self._park_lock = threading.Lock()
+        self._park_thread: Optional[threading.Thread] = None
+        self._fallback_ledger = None
+
+    @property
+    def quota_ledger(self):
+        # Shared with the local backend (one ledger per head process);
+        # harness-built mixins over a stub backend get their own.
+        ledger = getattr(self.local_backend, "quota_ledger", None)
+        if ledger is None:
+            if self._fallback_ledger is None:
+                self._fallback_ledger = tenancy.QuotaLedger()
+            ledger = self._fallback_ledger
+        return ledger
 
     def submit(self, spec) -> None:
         head = self.head
@@ -1275,6 +1360,34 @@ class ClusterBackendMixin:
                 return
             self._submit_local(spec)
             return
+        # Tenancy quotas, BEFORE any placement work (reference: lease
+        # admission policies): a job at its queued-task ceiling is
+        # rejected with a typed error; a job at its CPU quota parks the
+        # spec in the ledger — behind its OWN limit, consuming no
+        # cluster capacity — until one of its running tasks releases.
+        # Both checks are idempotent per spec, so quota-drained
+        # resubmits and the local backend's own admission never
+        # double-charge.
+        if spec.kind in (TaskKind.NORMAL_TASK, TaskKind.ACTOR_CREATION):
+            ledger = self.quota_ledger
+            reason = ledger.note_queued(spec)
+            if reason is not None:
+                from ray_tpu.exceptions import JobQuotaExceededError
+
+                self._fail_spec(spec, JobQuotaExceededError(
+                    spec.job_id or "", reason))
+                return
+            if not ledger.try_acquire_cpu(spec):
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    # Register the gate BEFORE parking the creation:
+                    # method calls submitted meanwhile then park at
+                    # the restart gate (ALIVE, no location yet) and
+                    # dispatch when the creation finally lands,
+                    # instead of failing against an unknown actor.
+                    head.record_lineage(spec)
+                ledger.park(spec)
+                self._ensure_quota_drainer()
+                return
         # Strategy-directed routing (reference: the scheduling-policy set
         # of `scheduling/policy/` — PG-affinity, node-affinity, spread).
         routed = self._route_by_strategy(spec)
@@ -1355,9 +1468,45 @@ class ClusterBackendMixin:
                                     reason=f"unreachable: {e}")
 
     def _fail_spec(self, spec, error: Exception) -> None:
+        # Terminal: release any tenancy charges the spec still holds
+        # (token-guarded no-ops otherwise).
+        ledger = self.quota_ledger
+        ledger.note_dequeued(spec)
+        ledger.release_cpu(spec)
         store = self.worker.memory_store
         for oid in spec.return_ids:
             store.put(oid, None, error=error)
+
+    def _ensure_quota_drainer(self) -> None:
+        with self._lease_lock:
+            t = self._quota_drainer
+            if t is not None and t.is_alive():
+                return
+            self._quota_drainer = threading.Thread(
+                target=self._quota_drain_loop, daemon=True,
+                name="ray_tpu-quota-drain")
+            self._quota_drainer.start()
+
+    def _quota_drain_loop(self) -> None:
+        """ONE thread drains the quota park list (never a thread per
+        parked spec): as a job's running tasks release their CPU
+        charges, its parked specs are popped — charged atomically under
+        the ledger lock — and re-enter the normal scheduling path."""
+        ledger = self.quota_ledger
+        while not self._quota_stop.is_set():
+            for spec in ledger.take_dispatchable():
+                try:
+                    self.submit(spec)  # charge held: skips the gate
+                except Exception as e:
+                    self._fail_spec(spec, e)
+            with self._lease_lock:
+                if ledger.parked_count() == 0 or \
+                        self._quota_stop.is_set():
+                    # Retire under the spawn lock: a park landing after
+                    # this check sees the dead thread and respawns.
+                    self._quota_drainer = None
+                    return
+            ledger.wait_change(0.5)
 
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         """Deliberate kill in cluster mode: reach the HOSTING node (the
@@ -1373,6 +1522,7 @@ class ClusterBackendMixin:
                 head.actor_nodes.pop(aid, None)
             head.actor_gate.mark_dead(
                 aid, "killed via ray_tpu.kill(no_restart=True)")
+            head.release_actor_quota(aid)
         if node_id is None:
             self.local_backend.kill_actor(actor_id, no_restart)
             return
@@ -1402,51 +1552,89 @@ class ClusterBackendMixin:
 
     def _park_actor_call(self, spec) -> None:
         """A call with retry budget submitted during an actor's restart
-        window: park off-thread (the submitter keeps its ObjectRef and
-        waits through get()), dispatch when the replacement registers,
-        reject when the window expires or the actor dies."""
+        window: park in the shared list (the submitter keeps its
+        ObjectRef and waits through get()), dispatch when the
+        replacement registers, reject when the window expires or the
+        actor dies. ONE dispatcher thread drains the whole list on the
+        gate's wait_change signal — N parked calls used to cost N
+        sleeping waiter threads (the PR 11 accepted trade-off, retired:
+        WFQ can park a whole job class's calls at once)."""
+        deadline = time.monotonic() + ray_config.actor_restart_timeout_s
+        with self._park_lock:
+            self._parked_calls.append((spec, deadline))
+            t = self._park_thread
+            if t is not None and t.is_alive():
+                return
+            self._park_thread = threading.Thread(
+                target=self._park_dispatch_loop, daemon=True,
+                name="ray_tpu-actor-park")
+            self._park_thread.start()
+
+    def _park_eval(self, spec, deadline: float):
+        """Disposition of one parked call: ``None`` = keep parked,
+        else a zero-arg effect to run OUTSIDE the park lock."""
+        from ray_tpu._private.actor_gate import ActorRestartState
+
         head = self.head
         aid = spec.actor_id.binary()
-        timeout = ray_config.actor_restart_timeout_s
-        deadline = time.monotonic() + timeout
-
-        def wait_loop():
-            from ray_tpu._private.actor_gate import ActorRestartState
-
-            while time.monotonic() < deadline:
-                state = head.actor_gate.state(aid)
-                if state == ActorRestartState.DEAD:
-                    head._fail_actor_call(
-                        spec,
-                        head.actor_gate.death_cause(aid)
-                        or "actor died during the restart window",
-                        True)
-                    return
-                # Dispatch only once the actor has a real home again:
-                # a node entry, the head itself, or no gate record at
-                # all. ALIVE-without-location is the mid-sweep
-                # transient — re-submitting there would just re-park.
-                if head.actor_nodes.get(aid) is not None or \
-                        state is None or aid in head.actor_local:
-                    try:
-                        self.submit(spec)
-                    except Exception as e:
-                        self._fail_spec(spec, e)
-                    return
-                # Condition-signalled wait (gate notifies on every
-                # transition): no busy polling, prompt release.
-                head.actor_gate.wait_change(
-                    min(0.5, max(0.01, deadline - time.monotonic())))
-            head._fail_actor_call(
+        state = head.actor_gate.state(aid)
+        if state == ActorRestartState.DEAD:
+            cause = head.actor_gate.death_cause(aid) \
+                or "actor died during the restart window"
+            return lambda: head._fail_actor_call(spec, cause, True)
+        # Dispatch only once the actor has a real home again: a node
+        # entry, the head itself, or no gate record at all.
+        # ALIVE-without-location is the mid-sweep transient —
+        # re-submitting there would just re-park.
+        if head.actor_nodes.get(aid) is not None or state is None \
+                or aid in head.actor_local:
+            def dispatch():
+                try:
+                    self.submit(spec)
+                except Exception as e:
+                    self._fail_spec(spec, e)
+            return dispatch
+        if time.monotonic() >= deadline:
+            timeout = ray_config.actor_restart_timeout_s
+            left = head.actor_gate.restarts_left(aid)
+            return lambda: head._fail_actor_call(
                 spec,
-                f"actor restart did not complete within "
+                f"actor did not become available within "
                 f"actor_restart_timeout_s={timeout:g}s (call parked "
-                f"with retry budget; actor restarts: "
-                f"{head.actor_gate.restarts_left(aid)} left)",
+                f"with retry budget while the actor was restarting "
+                f"or its creation was quota-parked; actor restarts: "
+                f"{left} left)",
                 False)
+        return None
 
-        threading.Thread(target=wait_loop, daemon=True,
-                         name="ray_tpu-actor-park").start()
+    def _park_dispatch_loop(self) -> None:
+        """The one parked-call dispatcher: wakes on every gate
+        transition (condition-signalled, no busy polling), sweeps the
+        parked list, runs the matured effects outside the lock, and
+        retires when the list drains."""
+        while not self._quota_stop.is_set():
+            effects = []
+            with self._park_lock:
+                still = []
+                for spec, deadline in self._parked_calls:
+                    effect = self._park_eval(spec, deadline)
+                    if effect is None:
+                        still.append((spec, deadline))
+                    else:
+                        effects.append(effect)
+                self._parked_calls = still
+            for effect in effects:
+                effect()
+            with self._park_lock:
+                if not self._parked_calls or self._quota_stop.is_set():
+                    # Retire under the spawn lock: a park landing after
+                    # this check sees the dead thread and respawns.
+                    self._park_thread = None
+                    return
+                nearest = min(d for _s, d in self._parked_calls)
+            # Read self.head per iteration: restart_head swaps it.
+            self.head.actor_gate.wait_change(
+                min(0.5, max(0.01, nearest - time.monotonic())))
 
     # -- lease-based dispatch (direct_task_transport role) ---------------
 
@@ -1457,8 +1645,14 @@ class ClusterBackendMixin:
     _LEASE_BACKLOG_FACTOR = 4
 
     def _shape_key(self, spec) -> tuple:
-        return tuple(sorted((k, float(v))
-                            for k, v in (spec.resources or {}).items()))
+        # Keyed by (job, resource shape): leases are per-TENANT, so
+        # the `leases:` quota genuinely bounds a job's pipelined
+        # channels — a shape-only key let other jobs ride (and keep
+        # alive) a lease charged to whoever asked first, making the
+        # cap bound nothing. Untagged traffic shares the "" tenant.
+        return (getattr(spec, "job_id", "") or "",) + tuple(
+            sorted((k, float(v))
+                   for k, v in (spec.resources or {}).items()))
 
     def _lease_submit(self, spec, request) -> bool:
         """Dispatch through a held (or newly granted) lease; False when
@@ -1472,19 +1666,22 @@ class ClusterBackendMixin:
                 # Prune leases on dead nodes and idle-expired ones
                 # (lease return: the node's capacity is only "ours"
                 # while we keep it busy).
-                live = []
+                live, dropped = [], []
                 for lease in leases:
                     record = self.head.nodes.get(lease["node_id"])
                     if record is None or not record.alive:
+                        dropped.append(lease)
                         continue
                     if lease["pipe"].in_flight == 0 and \
                             now - lease["last_used"] > self._LEASE_IDLE_S:
+                        dropped.append(lease)
                         continue
                     live.append(lease)
                 if live:
                     self._leases[key] = live
                 else:
                     del self._leases[key]
+                self._retire_leases(dropped)
                 leases = live or None
             if not leases:
                 lease = self._grant_lease(key, spec)
@@ -1530,6 +1727,12 @@ class ClusterBackendMixin:
             target = self._choose_node(spec, exclude=exclude)
         if target is None:
             return None
+        # Concurrent-lease quota: a job at its cap keeps riding the
+        # leases it already holds (queueing behind its own limit)
+        # instead of opening another pipelined channel.
+        job = getattr(spec, "job_id", "") or ""
+        if not self.quota_ledger.try_acquire_lease(job):
+            return None
         request = to_milli(spec.resources)
         slots = 1
         if request:
@@ -1545,9 +1748,18 @@ class ClusterBackendMixin:
             self._pipes[target.node_id] = pipe
         lease = {"node_id": target.node_id, "pipe": pipe,
                  "slots": slots, "last_used": time.monotonic(),
-                 "address": target.address}
+                 "address": target.address, "job": job}
         self._leases.setdefault(key, []).append(lease)
         return lease
+
+    def _retire_leases(self, leases) -> None:
+        """Release the lease-quota charge of every retired lease (any
+        removal path: idle prune, dead node, broken pipe, drain)."""
+        ledger = self.quota_ledger
+        for lease in leases:
+            job = lease.get("job")
+            if job is not None:
+                ledger.release_lease(job)
 
     def _arg_bytes_by_addr(self, spec) -> Dict[tuple, int]:
         """Resident argument bytes per owner address, from the head's
@@ -1659,6 +1871,9 @@ class ClusterBackendMixin:
         # Same bookkeeping as _send: lineage + inflight BEFORE the wire.
         self.head.record_lineage(spec)
         self.head.record_inflight(spec, lease["node_id"])
+        # Dispatching: the spec leaves the head's queued-ceiling count
+        # (its CPU charge stays held until the in-flight entry clears).
+        self.quota_ledger.note_dequeued(spec)
         # Coalesced, non-blocking enqueue: the node's batcher drains
         # whatever accumulates while the previous frame is on the wire
         # into ONE submit_batch request. Transport failures surface
@@ -1793,12 +2008,22 @@ class ClusterBackendMixin:
     def drain_channels(self, timeout: float = 2.0) -> None:
         """Shutdown-boundary drain: flush-and-close every submit
         batcher and pipelined channel so accepted submissions reach the
-        wire (and are acked) before the cluster tears down."""
+        wire (and are acked) before the cluster tears down. Also stops
+        the tenancy drainer + parked-call dispatcher threads (their
+        parked work is abandoned with the cluster)."""
+        self._quota_stop.set()
+        # Bounded joins: both loops wake within their 0.5s wait slice,
+        # observe the stop flag, and retire.
+        for t in (self._quota_drainer, self._park_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=1.0)
         with self._lease_lock:
             batchers = list(self._batchers.values())
             pipes = list(self._pipes.values())
             self._batchers.clear()
             self._pipes.clear()
+            self._retire_leases(
+                [l for ls in self._leases.values() for l in ls])
             self._leases.clear()
         for batcher in batchers:
             batcher.close(drain_timeout=timeout)
@@ -1809,11 +2034,16 @@ class ClusterBackendMixin:
         with self._lease_lock:
             pipe = self._pipes.pop(node_id, None)
             batcher = self._batchers.pop(node_id, None)
+            retired = []
             for ls in self._leases.values():
                 if lease is None:
+                    retired += [l for l in ls
+                                if l["node_id"] == node_id]
                     ls[:] = [l for l in ls if l["node_id"] != node_id]
-                else:
+                elif lease in ls:
+                    retired.append(lease)
                     ls[:] = [l for l in ls if l is not lease]
+            self._retire_leases(retired)
         if batcher is not None:
             batcher.close()  # flusher drains then retires (no thread leak)
         if pipe is not None:
@@ -1837,8 +2067,12 @@ class ClusterBackendMixin:
             if retries < 3:
                 spec._lease_reroutes = retries + 1
                 with self._lease_lock:
+                    retired = []
                     for ls in self._leases.values():
-                        ls[:] = [l for l in ls if l is not lease]
+                        if lease in ls:
+                            retired.append(lease)
+                            ls[:] = [l for l in ls if l is not lease]
+                    self._retire_leases(retired)
                 try:
                     self.submit(spec)
                     return
@@ -1855,8 +2089,12 @@ class ClusterBackendMixin:
         record = self.head.nodes.get(lease["node_id"])
         with self._lease_lock:
             self._pipes.pop(lease["node_id"], None)
+            retired = []
             for ls in self._leases.values():
-                ls[:] = [l for l in ls if l is not lease]
+                if lease in ls:
+                    retired.append(lease)
+                    ls[:] = [l for l in ls if l is not lease]
+            self._retire_leases(retired)
         if record is None or not record.alive:
             return  # node-death sweep owns recovery
         try:
@@ -2292,6 +2530,7 @@ class ClusterBackendMixin:
         # only the caller retries.
         self.head.record_lineage(spec)
         self.head.record_inflight(spec, node.node_id)
+        self.quota_ledger.note_dequeued(spec)
         wire_spec = self._strip_exported_func(spec, node)
         try:
             RpcClient.to(node.address).call("submit_task",
@@ -2352,9 +2591,22 @@ class ClusterBackendMixin:
         node.known_fns.add(fid)  # first shipment carries the body
         return spec
 
+    def shutdown(self):
+        """Stop the mixin's own threads (quota drainer, parked-call
+        dispatcher), then the local backend's engine."""
+        self._quota_stop.set()
+        for t in (self._quota_drainer, self._park_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=1.0)
+        self.local_backend.shutdown()
+
     # Delegate everything else to the local backend.
 
     def __getattr__(self, name):
+        if name == "local_backend":
+            # A half-constructed mixin (harness __new__) must raise,
+            # not recurse through this delegation forever.
+            raise AttributeError(name)
         return getattr(self.local_backend, name)
 
 

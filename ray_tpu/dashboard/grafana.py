@@ -236,6 +236,43 @@ def generate_object_plane_dashboard() -> dict:
     ], uid="ray-tpu-object-plane")
 
 
+def generate_tenancy_dashboard() -> dict:
+    """Tenancy ENFORCEMENT panels (the other half of the jobs
+    dashboard's attribution view): what the quota/WFQ/rate-limit/
+    arena-budget machinery is actively doing to each tenant —
+    `_private/tenancy.py` counters + live ledger gauges."""
+    return generate_dashboard("ray_tpu tenancy", [
+        {"title": "Quota rejections / parks",
+         "exprs": [("increase(ray_tpu_job_quota_rejections_total[5m])",
+                    "rejected {{job}} (5m)"),
+                   ("increase(ray_tpu_job_quota_parks_total[5m])",
+                    "parked {{job}} (5m)"),
+                   ("increase(ray_tpu_job_quota_lease_denials_total"
+                    "[5m])", "lease denials {{job}} (5m)")]},
+        {"title": "CPU-slot usage vs quota",
+         "exprs": [('sum(ray_tpu_job_quota_cpu_milli) by (job)',
+                    "running milli-CPU {{job}}")]},
+        {"title": "Queued / parked behind own limit",
+         "exprs": [('sum(ray_tpu_job_quota_queued) by (job)',
+                    "queued {{job}}"),
+                   ('sum(ray_tpu_job_quota_parked) by (job)',
+                    "parked {{job}}")]},
+        {"title": "Ingress rate limiting",
+         "exprs": [("increase(ray_tpu_job_rate_limited_total[5m])",
+                    "429s {{job}} (5m)"),
+                   ("increase(ray_tpu_serve_http_limited_429[5m])",
+                    "429s total (5m)"),
+                   ("increase(ray_tpu_serve_http_denied_401[5m])",
+                    "401s total (5m)")]},
+        {"title": "Arena bytes by job vs budget", "unit": "bytes",
+         "exprs": [('sum(ray_tpu_job_arena_bytes) by (job)',
+                    "{{job}}")]},
+        {"title": "Arena budget spills", "unit": "Bps",
+         "exprs": [("rate(ray_tpu_job_arena_spill_bytes_total[1m])",
+                    "spill B/s {{job}}")]},
+    ], uid="ray-tpu-tenancy")
+
+
 def write_dashboards(directory: str) -> List[str]:
     """Write all generated dashboards into a Grafana provisioning dir;
     returns the file paths."""
@@ -245,7 +282,8 @@ def write_dashboards(directory: str) -> List[str]:
                  generate_serve_dashboard(),
                  generate_observability_dashboard(),
                  generate_jobs_dashboard(),
-                 generate_object_plane_dashboard()):
+                 generate_object_plane_dashboard(),
+                 generate_tenancy_dashboard()):
         path = os.path.join(directory, f"{dash['uid']}.json")
         with open(path, "w") as f:
             json.dump(dash, f, indent=2)

@@ -131,3 +131,14 @@ class PlacementGroupSchedulingError(RayTpuError):
 
 class PendingCallsLimitExceeded(RayTpuError):
     pass
+
+
+class JobQuotaExceededError(RayTpuError):
+    """The submitting job is over a configured tenancy quota (the
+    queued-task ceiling): the submission was rejected at admission,
+    before consuming any cluster capacity. The message names the job,
+    the exhausted quota, and the config knob (``job_quotas``)."""
+
+    def __init__(self, job_id: str = "", msg: str = ""):
+        super().__init__(msg or f"job {job_id!r} exceeded its quota")
+        self.job_id = job_id

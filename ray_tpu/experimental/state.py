@@ -137,6 +137,28 @@ def job_summary() -> Dict[str, Any]:
             e = entry(job)
             e["objects"] = n
             e["object_store_bytes"] = nbytes
+    # Shared-arena bytes charged per producing job (tenancy budgets).
+    plane = getattr(w, "shm_plane", None)
+    if plane is not None and hasattr(plane, "job_arena_bytes"):
+        for job, nbytes in plane.job_arena_bytes().items():
+            entry(job)["arena_bytes"] = nbytes
+    # Enforcement-side accounting: quota usage (running CPU milli +
+    # high-water mark, queued, parked) and the per-job rejection/park/
+    # rate-limit/arena-spill counters — the "what enforcement did to
+    # me" half of a tenant's summary row.
+    ledger = getattr(getattr(w, "backend", None), "quota_ledger", None)
+    if ledger is not None:
+        for job in ledger.jobs():
+            entry(job)["quota"] = ledger.usage(job)
+    for name, tags, stat in perf_stats.stats_items():
+        if name not in ("job_quota_rejections", "job_quota_parks",
+                        "job_quota_lease_denials", "job_rate_limited",
+                        "job_arena_spill_bytes") or \
+                not isinstance(stat, perf_stats.Counter) or \
+                not stat.value:
+            continue
+        e = entry(dict(tags).get("job", ""))
+        e.setdefault("enforcement", {})[name] = stat.value
     # Serve requests by (job, route) — recorded by the ingress in this
     # process (the proxy normally runs in the head/driver).
     for name, tags, stat in perf_stats.stats_items():

@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import re
 import socket
 import threading
@@ -45,6 +46,7 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import perf_stats
+from ray_tpu._private import tenancy
 from ray_tpu.serve._private.router import QueueSaturatedError
 from ray_tpu.serve.streaming import aiter_stream, is_stream
 
@@ -98,11 +100,18 @@ _runtime_metrics.register_stats_provider(
                    "Serve ingress: requests served (terminal non-shed)"),
         "shed_503": ("ray_tpu_serve_http_shed_503",
                      "Serve ingress: requests shed with 503"),
+        "limited_429": ("ray_tpu_serve_http_limited_429",
+                        "Serve ingress: requests shed by per-tenant "
+                        "rate limits (429)"),
+        "denied_401": ("ray_tpu_serve_http_denied_401",
+                       "Serve ingress: requests refused by ingress "
+                       "auth (401)"),
     })
 
 _REASONS = {
-    200: "OK", 400: "Bad Request", 404: "Not Found",
-    413: "Payload Too Large",
+    200: "OK", 400: "Bad Request", 401: "Unauthorized",
+    404: "Not Found", 413: "Payload Too Large",
+    429: "Too Many Requests",
     431: "Request Header Fields Too Large", 500: "Internal Server Error",
     501: "Not Implemented", 503: "Service Unavailable",
 }
@@ -331,8 +340,12 @@ class _Conn(asyncio.Protocol):
     # -- outgoing --------------------------------------------------------
 
     def send_response(self, status: int, body: bytes, *,
-                      keep: bool = True, retry_after: bool = False,
+                      keep: bool = True, retry_after=False,
                       content_type: str = "application/json"):
+        # ``retry_after``: falsy = no header; True = 1s; a number =
+        # that many seconds (rounded up — the rate limiter's computed
+        # token-accrual time must reach the wire, or compliant clients
+        # retry far too fast).
         self.last_status = status
         if self.closing:
             return
@@ -361,7 +374,9 @@ class _Conn(asyncio.Protocol):
         if self.job_id:
             parts.append(f"X-Job-Id: {self.job_id}")
         if retry_after:
-            parts.append("Retry-After: 1")
+            seconds = 1 if retry_after is True else \
+                max(1, math.ceil(float(retry_after)))
+            parts.append(f"Retry-After: {seconds}")
         if not keep:
             parts.append("Connection: close")
         elif self.http10:
@@ -414,6 +429,12 @@ class HTTPProxy:
         self._in_flight = 0
         self._served = 0
         self._shed = 0
+        self._limited = 0
+        self._denied = 0
+        # Per-tenant ingress token buckets (tenancy enforcement): work
+        # a job pushes past its rate is shed with 429 + Retry-After
+        # HERE, before any router/replica resource is touched.
+        self._limiter = tenancy.IngressLimiter()
         self._conns: set = set()
         # Distinct job tags this proxy has accounted. X-Job-Id is
         # client-controlled: without a cap, a client cycling random
@@ -566,12 +587,51 @@ class HTTPProxy:
                 501, b'{"error": "chunked bodies not supported"}',
                 keep=False)
             return ""
+        # Ingress auth (optional shared secret), BEFORE route matching:
+        # refused requests never touch the route table (no 404-based
+        # route enumeration), the router, a replica slot, or the rate
+        # limiter's token accounting.
+        from ray_tpu._private.config import ray_config
+
+        token = ray_config.ingress_auth_token
+        if token:
+            import hmac
+
+            # Constant-time comparisons over BYTES: a shared-secret
+            # check must not leak matching-prefix length through
+            # response timing, and compare_digest refuses non-ASCII
+            # str (latin-1-decoded headers can carry any byte).
+            expect = f"Bearer {token}".encode("latin-1", "replace")
+            supplied = req.headers.get(
+                "authorization", "").encode("latin-1", "replace")
+            alt = req.headers.get(
+                "x-auth-token", "").encode("latin-1", "replace")
+            token_b = token.encode("latin-1", "replace")
+            if not hmac.compare_digest(supplied, expect) \
+                    and not hmac.compare_digest(alt, token_b):
+                self._denied += 1
+                conn.send_response(
+                    401, b'{"error": "missing or invalid ingress '
+                    b'credentials"}', keep=req.keep_alive)
+                return ""
         handle, _rest, route = self.routes.match(
             req.path.split("?", 1)[0])
         if handle is None:
             conn.send_response(404, b'{"error": "no route"}',
                                keep=req.keep_alive)
             return ""
+        # Per-tenant token bucket: shed a job over its ingress rate
+        # with 429 + Retry-After BEFORE work enters the router (rides
+        # the same early-exit path as the 503 backpressure shed).
+        retry_in = self._limiter.try_admit(job_id)
+        if retry_in is not None:
+            self._limited += 1
+            conn.send_response(
+                429, json.dumps({
+                    "error": f"job {job_id or '(untagged)'} is over "
+                             f"its ingress rate limit"}).encode(),
+                keep=req.keep_alive, retry_after=retry_in)
+            return route
         if self._in_flight >= self.max_in_flight:
             # Load shed: a bounded in-flight cap with an explicit 503
             # instead of the threaded server's unbounded thread growth.
@@ -698,7 +758,8 @@ class HTTPProxy:
         ``shed_503`` counts load-shed requests (in-flight cap or router
         queue timeout) — the two are disjoint."""
         return {"in_flight": self._in_flight, "served": self._served,
-                "shed_503": self._shed,
+                "shed_503": self._shed, "limited_429": self._limited,
+                "denied_401": self._denied,
                 "open_connections": len(self._conns)}
 
     def shutdown(self):

@@ -37,6 +37,7 @@ def shutdown_all_routers() -> None:
 
 import ray_tpu
 from ray_tpu._private import sanitize_hooks
+from ray_tpu._private import tenancy
 from ray_tpu._private.task_spec import (set_ambient_job_id,
                                         set_ambient_trace_parent)
 from ray_tpu.serve._private.long_poll import LongPollClient
@@ -64,6 +65,12 @@ class Router:
         # a replica while a send is in flight.
         self._reserved: Dict[Any, int] = {}
         self._lock = threading.Condition()
+        # Per-job weighted fair arbitration over contended replica
+        # slots (tenancy enforcement): when requests of several jobs
+        # wait for a slot, the job with the smallest virtual time
+        # dispatches next — a flood job saturates only its weight
+        # share. No-op (one lock read) when enforcement is off.
+        self._fair = tenancy.FairShare()
         self._client = LongPollClient(
             controller, f"replicas::{deployment_name}",
             self._update_replicas, reresolve=self._reresolve_controller)
@@ -130,6 +137,13 @@ class Router:
         same way: the replica call's spec carries it, so one tenant's
         serve traffic stays attributable through the tasks it fans
         into."""
+        # WFQ turn gate: under contention only the minimum-virtual-time
+        # job may claim the next slot (the fast path with no waiters
+        # always passes). Sits BEFORE any slot probing so a flood job's
+        # requests cannot race a freed slot away from a higher-weight
+        # tenant parked for it.
+        if not self._fair.may_dispatch(job or ""):
+            return None
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
@@ -177,6 +191,10 @@ class Router:
                             replica, []).append(ref)
                         self._waiting -= 1
                         total = self._pending_report_locked()
+            if dispatched:
+                # Advance the job's virtual time: its next contended
+                # turn moves back by 1/weight.
+                self._fair.charge(job or "")
             self._send_report(total)
             return ref
         return None
@@ -187,6 +205,9 @@ class Router:
         dispatched = False
         with self._lock:
             self._waiting += 1
+        # Fair-share wait registration: while parked, this job's
+        # virtual time competes for the next freed slot.
+        self._fair.enter_wait(job or "")
         try:
             while True:
                 ref = self._try_assign(method, args, kwargs, trace, job)
@@ -206,6 +227,7 @@ class Router:
                 self._send_report(total)
                 time.sleep(0.005)
         finally:
+            self._fair.exit_wait(job or "")
             if not dispatched:
                 with self._lock:
                     self._waiting -= 1
@@ -237,6 +259,7 @@ class Router:
         dispatched = False
         with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
             self._waiting += 1
+        self._fair.enter_wait(job or "")
         try:
             while True:
                 ref = self._try_assign(method, args, kwargs, trace, job)
@@ -252,6 +275,7 @@ class Router:
                 self._send_report(total)
                 await asyncio.sleep(0.002)
         finally:
+            self._fair.exit_wait(job or "")
             if not dispatched:
                 with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
                     self._waiting -= 1

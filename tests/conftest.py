@@ -49,9 +49,15 @@ def _global_state_baseline():
     from ray_tpu._private import health, perf_stats
 
     serve_snap = perf_stats.snapshot_records("serve_request_seconds")
+    # The per-(job, route) request counter feeds job_summary()'s
+    # serve_requests rows: same process-global class, same rollback —
+    # a test's tagged traffic must not inflate a later test's exact
+    # per-tenant counts.
+    req_snap = perf_stats.snapshot_records("serve_requests")
     health_snap = health.snapshot_state()
     yield
     perf_stats.restore_records("serve_request_seconds", serve_snap)
+    perf_stats.restore_records("serve_requests", req_snap)
     health.restore_state(health_snap)
 
 

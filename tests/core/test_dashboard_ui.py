@@ -90,8 +90,15 @@ def test_grafana_dashboard_factory(tmp_path):
     assert len(pos) == 6
 
     paths = write_dashboards(str(tmp_path))
-    # core, serve, observability, jobs, object-plane
-    assert len(paths) == 5
+    # core, serve, observability, jobs, object-plane, tenancy
+    assert len(paths) == 6
+    tenancy = next(p for p in paths if "tenancy" in p)
+    with open(tenancy) as f:
+        tenancy_exprs = " ".join(t["expr"]
+                                 for p in json.load(f)["panels"]
+                                 for t in p["targets"])
+    assert "ray_tpu_job_quota_rejections_total" in tenancy_exprs
+    assert "ray_tpu_job_arena_spill_bytes_total" in tenancy_exprs
     obj = next(p for p in paths if "object-plane" in p)
     with open(obj) as f:
         obj_exprs = " ".join(t["expr"]

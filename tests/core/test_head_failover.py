@@ -244,6 +244,74 @@ def test_head_hard_crash_inflight_task_rides_fetch_retry(tmp_path,
         cluster.shutdown()
 
 
+def test_restart_budget_survives_head_failover(durable_gcs):
+    """ROADMAP FT gap (c): consumed actor-restart budgets must survive
+    head failover. A max_restarts=1 actor that already spent its one
+    restart re-reports into the FRESH head's gate with the consumed
+    count (riding the node's re-register report), so its next node
+    death TOMBSTONES it — a reset budget would let it restart forever,
+    one head failover at a time."""
+    from ray_tpu._private.actor_gate import ActorRestartState
+    from ray_tpu._private.task_spec import NodeAffinitySchedulingStrategy
+    from ray_tpu.exceptions import ActorDiedError
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    n1 = cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    assert n2
+    try:
+        # 2 CPUs: can never land on the 1-CPU head, so both the first
+        # placement and the restart live on NODES — the re-register
+        # report is the only channel the consumed count can ride.
+        @ray_tpu.remote(num_cpus=2, max_restarts=1, max_task_retries=2,
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=n1, soft=True))
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        counter = Counter.remote()
+        aid = counter._actor_id.binary()
+        assert ray_tpu.get(counter.bump.remote(), timeout=30) == 1
+
+        # First death: the ONE restart is consumed; the replacement
+        # constructs on the surviving node.
+        cluster.remove_node(n1, graceful=False)
+        _wait(lambda: ray_tpu.get(counter.bump.remote(),
+                                  timeout=10) >= 1,
+              msg="actor restarted after first node death")
+        assert cluster.head.actor_gate.restarts_left(aid) == 0
+
+        # ---- hard-crash head failover ----
+        cluster.restart_head(mode="crash")
+        _wait(lambda: cluster.head.actor_gate.state(aid)
+              == ActorRestartState.ALIVE,
+              msg="node re-reported the actor into the fresh gate")
+        # THE regression: the fresh gate carries the CONSUMED budget
+        # (a reset gate would read 1 restart left again).
+        assert cluster.head.actor_gate.restarts_left(aid) == 0, \
+            "consumed restart budget reset across head failover"
+        assert ray_tpu.get(counter.bump.remote(), timeout=30) >= 1
+
+        # Second death: budget exhausted — tombstone, never another
+        # restart. Calls fail FAST with a cause naming the budget.
+        home = cluster.head.actor_nodes.get(aid)
+        assert home == n2, home
+        cluster.remove_node(n2, graceful=False)
+        _wait(lambda: cluster.head.actor_gate.state(aid)
+              == ActorRestartState.DEAD,
+              msg="budget-exhausted actor tombstoned after failover")
+        with pytest.raises(ActorDiedError, match="exhausted"):
+            ray_tpu.get(counter.bump.remote(), timeout=30)
+    finally:
+        cluster.shutdown()
+
+
 def test_head_failover_without_durable_storage(tmp_path, monkeypatch):
     """Without gcs_storage_path the tables start empty after restart —
     nodes still re-register and NEW work proceeds (the non-FT

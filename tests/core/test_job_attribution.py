@@ -463,14 +463,107 @@ def test_job_summary_endpoint_and_cli(ray_local):
         server.shutdown()
 
 
+def test_two_job_enforcement_caps_flood_protects_serve(monkeypatch):
+    """The adversarial two-job scenario in ENFORCE mode (the PR 6
+    variant below remains the enforcement-off, observe-only control):
+    with `tenancy_enforcement` on and a quota on the flood job, the
+    flood runs at most its CPU-slot share (its overflow parks behind
+    its own limit / rejects typed), and the serve job's X-Job-Id
+    traffic is never shed by the flood's pressure — every request
+    lands 200 while the flood is at full push."""
+    import http.client
+
+    from ray_tpu import serve
+    from ray_tpu._private import perf_stats
+    from ray_tpu.exceptions import JobQuotaExceededError
+    from ray_tpu.util.metrics import render_prometheus, \
+        snapshot_registry
+
+    monkeypatch.setattr(ray_config, "tenancy_enforcement", True)
+    monkeypatch.setattr(ray_config, "job_quotas",
+                        "job-flood=cpus:1,queued:15")
+    monkeypatch.setattr(ray_config, "job_weights",
+                        "job-serve=8,job-flood=1")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @serve.deployment
+        class Api:
+            def __call__(self, request):
+                return {"out": 42}
+
+        serve.run(Api.bind(), route_prefix="/api")
+        proxy = serve.start_http_proxy()
+
+        @ray_tpu.remote(num_cpus=1)
+        def flood():
+            time.sleep(0.15)
+            return 1
+
+        prev = set_ambient_job_id("job-flood")
+        try:
+            flood_refs = [flood.remote() for _ in range(30)]
+        finally:
+            set_ambient_job_id(prev)
+
+        # While the flood is at full push, the serve tenant's requests
+        # ALL land — none shed by the flood's queue pressure.
+        conn = http.client.HTTPConnection(proxy.host, proxy.port,
+                                          timeout=30)
+        for _ in range(8):
+            conn.request("POST", "/api", body=json.dumps({}),
+                         headers={"Content-Type": "application/json",
+                                  "X-Job-Id": "job-serve"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.status
+            assert json.loads(resp.read()) == {"out": 42}
+        conn.close()
+        assert proxy.stats()["shed_503"] == 0
+
+        # The flood never held more than its cpus:1 quota of the 4
+        # CPUs, the admitted work completed, and the overflow failed
+        # TYPED (not silently queued forever).
+        w = ray_tpu._private.worker.global_worker()
+        assert w.backend.quota_ledger.usage(
+            "job-flood")["peak_cpu_milli"] <= 1000
+        ok = rejected = 0
+        for ref in flood_refs:
+            try:
+                ray_tpu.get(ref, timeout=60)
+                ok += 1
+            except JobQuotaExceededError as e:
+                assert "job-flood" in str(e)
+                rejected += 1
+        assert ok >= 15 and rejected >= 1, (ok, rejected)
+        # Rejections are metered under the flood's own tag and reach
+        # the exposition as ray_tpu_job_quota_* series.
+        assert perf_stats.counter("job_quota_rejections",
+                                  {"job": "job-flood"}).value >= 1
+        from ray_tpu._private.runtime_metrics import \
+            collect_runtime_metrics
+
+        collect_runtime_metrics()
+        text = render_prometheus([(snapshot_registry(), None)])
+        assert 'ray_tpu_job_quota_rejections_total{job="job-flood"}' \
+            in text
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
 def test_two_job_cluster_attribution_and_health():
-    """The adversarial two-job scenario on a two-node cluster: a
+    """The adversarial two-job scenario on a two-node cluster — the
+    ENFORCEMENT-OFF control for the enforce-mode test above: a
     flooding job (parked submits pinned to node 1) and a
     latency-sensitive serve job, concurrently. Every task event /
     metric series carries the right job tag, job_summary() separates
     the tenants, the cluster healthz verdict degrades with a reason
-    naming the overloaded signal while the flood is queued, and
-    recovers after it drains."""
+    naming the overloaded signal while the flood is queued (the flood
+    genuinely floods — nothing caps it), and recovers after it
+    drains."""
     from ray_tpu import serve
     from ray_tpu.cluster_utils import Cluster
     from ray_tpu._private.health import evaluate_health

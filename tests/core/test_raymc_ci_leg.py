@@ -60,7 +60,7 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     assert set(by_name) == {"router_cap", "gcs_durability",
                             "pipelined_close", "spill_race",
                             "lineage_reconstruction", "actor_restart",
-                            "head_crash_recovery"}
+                            "head_crash_recovery", "quota_admission"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"
@@ -79,6 +79,10 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     # The actor replay-or-reject space is the largest in the leg: a
     # shrunk count means the scenario lost its death placements.
     assert by_name["actor_restart"]["executions"] >= 5000, by_name
+    # Tenancy admission: the grant/release race + WFQ put/pop space
+    # drained — a shrunk count means the racing submitters (or the
+    # queue race) fell out of the scenario.
+    assert by_name["quota_admission"]["executions"] >= 5000, by_name
 
 
 def test_raymc_harness_clean_under_raysan_sanitizers(tmp_path):

@@ -275,8 +275,16 @@ def test_cluster_drain_channels_flush_closes_once():
         def close(self, drain_timeout=None, flush_timeout=None):
             self.closed_with.append((drain_timeout, flush_timeout))
 
+    from types import SimpleNamespace
+
     backend = ClusterBackendMixin.__new__(ClusterBackendMixin)
     backend._lease_lock = threading.Lock()
+    # Tenancy-drain state the real __init__ would set up.
+    backend._quota_stop = threading.Event()
+    backend._quota_drainer = None
+    backend._park_thread = None
+    backend._fallback_ledger = None
+    backend.local_backend = SimpleNamespace()
     batcher, pipe = FakeChannel(), FakeChannel()
     backend._batchers = {"n1": batcher}
     backend._pipes = {"n1": pipe}
