@@ -227,6 +227,102 @@ def ab_observability(repeats: int = 5, attempts: int = 3) -> dict:
     return result
 
 
+# -- compact-queue tax guard (--ab-sched) ------------------------------------
+#
+# The compact queued representation (QueuedTaskHeader, materialized at
+# dispatch) exists for million-task backlogs; it must not tax the
+# 1-task case. This mode measures the submit hot path (dep-parked
+# submissions: header mint + park, zero dispatch racing the timer) and
+# the single-task submit→get roundtrip (where the dispatch-time
+# materialization cost lives) with sched_compact_queue on vs off.
+
+SCHED_OVERHEAD_BUDGET = 0.05  # <5% on submit and 1-task roundtrip
+
+
+def _measure_sched_paths(n_tasks: int = 4000,
+                         n_roundtrips: int = 600) -> dict:
+    """One sample of the compact-queue-sensitive paths in the CURRENT
+    process state: parked submits (pure submit-side cost) and
+    sequential 1-task roundtrips (submit + fast dispatch +
+    materialization + result)."""
+    import gc
+
+    sample = _measure_submit_wait(n_tasks=n_tasks, n_refs=50,
+                                  wait_rounds=10)
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def one(x):
+        return x
+
+    ray_tpu.get(one.remote(0), timeout=30)  # warm template + executor
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n_roundtrips):
+            ray_tpu.get(one.remote(i), timeout=30)
+        rt_s = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    return {"submit_per_s": sample["submit_per_s"],
+            "roundtrips_per_s": n_roundtrips / rt_s}
+
+
+def ab_sched(repeats: int = 5, attempts: int = 3) -> dict:
+    """Compact-queue on-vs-off A/B over the 1-task fast path. Same
+    noise discipline as ab_observability: best-of-R per side,
+    interleaved, bounded retry."""
+    import ray_tpu
+    from ray_tpu._private.config import ray_config
+
+    def side(compact: bool) -> dict:
+        ray_config.sched_compact_queue = compact
+        try:
+            return _measure_sched_paths()
+        finally:
+            ray_config.sched_compact_queue = True
+
+    result = None
+    for attempt in range(attempts):
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=2)
+        try:
+            on = {"submit_per_s": 0.0, "roundtrips_per_s": 0.0}
+            off = {"submit_per_s": 0.0, "roundtrips_per_s": 0.0}
+            side(True)  # warm-up
+            for i in range(repeats):
+                pair = ((True, on), (False, off)) if i % 2 == 0 \
+                    else ((False, off), (True, on))
+                for flag, best in pair:
+                    sample = side(flag)
+                    for k in best:
+                        best[k] = max(best[k], sample[k])
+        finally:
+            ray_config.sched_compact_queue = True
+            ray_tpu.shutdown()
+        overhead = {
+            "submit_overhead": 1.0 - on["submit_per_s"]
+            / off["submit_per_s"],
+            "roundtrip_overhead": 1.0 - on["roundtrips_per_s"]
+            / off["roundtrips_per_s"],
+        }
+        ok = all(v < SCHED_OVERHEAD_BUDGET for v in overhead.values())
+        result = {
+            "budget": SCHED_OVERHEAD_BUDGET,
+            "repeats": repeats,
+            "attempt": attempt + 1,
+            "compact": on,
+            "full_spec": off,
+            **{k: round(v, 4) for k, v in overhead.items()},
+            "pass": ok,
+        }
+        if ok:
+            return result
+    return result
+
+
 # -- yield-point hook tax guard (--ab-hooks) ---------------------------------
 #
 # raysan/raymc grow the sanitize_hooks yield-point map over time; each
@@ -789,6 +885,10 @@ def main() -> dict:
                         help="run ONLY the sanitize_hooks yield-point "
                              "tax guard (uninstalled crossing cost x "
                              "per-op crossing census, <1% budget)")
+    parser.add_argument("--ab-sched", action="store_true",
+                        help="run ONLY the compact-queue tax guard "
+                             "(submit + 1-task roundtrip, header vs "
+                             "full-spec queueing, <5% budget)")
     parser.add_argument("--ab-objects", action="store_true",
                         help="run ONLY the object-plane A/B: xproc "
                              "get/put-arg at 4/64/256MB vs the same-"
@@ -816,6 +916,23 @@ def main() -> dict:
             sys.exit(f"object-plane memcpy-envelope guard FAILED: "
                      f"get64={obj['xproc_get_64MB_vs_memcpy']}x off "
                      f"the envelope (budget {OBJ_MEMCPY_FACTOR}x)")
+        return envelope
+
+    if args.ab_sched:
+        sched = ab_sched()
+        envelope = {
+            "schema_version": SCHEMA_VERSION,
+            "suite": "sched_ab",
+            "harness": "benchmarks/perf_bench.py --ab-sched",
+            "host_calibration": cal,
+            "metrics": {"sched": sched},
+        }
+        print(json.dumps(envelope, indent=2))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(envelope, f, indent=2)
+        if not sched["pass"]:
+            sys.exit(f"compact-queue tax guard FAILED: {sched}")
         return envelope
 
     if args.ab_hooks:

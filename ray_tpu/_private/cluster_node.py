@@ -476,18 +476,37 @@ class NodeRuntime:
             raise RuntimeError(
                 f"UnknownTemplateError: {call.template_id.hex()[:12]} "
                 "not registered on this node")
+        from ray_tpu._private.config import ray_config
         from ray_tpu._private.ids import TaskID
 
-        spec = tpl.make_spec(
-            TaskID(call.task_id),
-            tuple(call.args or ()),
-            dict(call.kwargs or {}),
-            depth=call.depth,
-            trace_parent=tuple(call.trace_parent)
-            if call.trace_parent else None,
-            num_returns=call.num_returns,
-            job_id=getattr(call, "job_id", "") or "",
-        )
+        if ray_config.sched_compact_queue:
+            # Node-side compact queueing: the wire call stays a header
+            # until this node's scheduler dispatches it, so a deep
+            # remote backlog is header-sized here too.
+            from ray_tpu._private.task_spec import QueuedTaskHeader
+
+            spec = QueuedTaskHeader(
+                tpl, TaskID(call.task_id),
+                tuple(call.args or ()),
+                dict(call.kwargs or {}),
+                depth=call.depth,
+                trace_parent=tuple(call.trace_parent)
+                if call.trace_parent else None,
+                job_id=getattr(call, "job_id", "") or "",
+            )
+            if call.num_returns is not None:
+                spec.num_returns = call.num_returns
+        else:
+            spec = tpl.make_spec(
+                TaskID(call.task_id),
+                tuple(call.args or ()),
+                dict(call.kwargs or {}),
+                depth=call.depth,
+                trace_parent=tuple(call.trace_parent)
+                if call.trace_parent else None,
+                num_returns=call.num_returns,
+                job_id=getattr(call, "job_id", "") or "",
+            )
         spec.max_retries = call.max_retries
         spec.attempt = getattr(call, "attempt", 0) or 0
         spec.assign_return_ids()
@@ -883,21 +902,34 @@ class NodeRuntime:
             transfer=self.transfer_addr,
             shm_name=plane.name if plane else None,
             labels=self.labels)
-        for actor in list(getattr(self.worker.backend, "_actors",
-                                  {}).values()):
-            try:
-                if actor.state != "DEAD":
-                    # Consumed-restart count = head-driven restarts
-                    # recorded on the spec + this node's own in-place
-                    # worker restarts: the fresh head's gate seeds the
-                    # REMAINING budget, not a reset one.
-                    used = getattr(actor.spec, "restarts_used", 0) + \
-                        actor.num_restarts
-                    self.head.call("report_actor", spec=actor.spec,
+        # Consumed-restart count = head-driven restarts recorded on
+        # the spec + this node's own in-place worker restarts: the
+        # fresh head's gate seeds the REMAINING budget, not a reset
+        # one. Re-reports BATCH into one report_actors RPC (group-
+        # committed registration: a node hosting 10k actors reconverges
+        # in O(1) round trips, not O(actors)); old heads without the
+        # batch handler get the per-actor fallback.
+        live = [(actor.spec,
+                 getattr(actor.spec, "restarts_used", 0)
+                 + actor.num_restarts)
+                for actor in list(getattr(self.worker.backend,
+                                          "_actors", {}).values())
+                if actor.state != "DEAD"]
+        try:
+            if live:
+                self.head.call(
+                    "report_actors",
+                    specs=[spec for spec, _ in live],
+                    node_id=self.node_id,
+                    restarts_used=[used for _, used in live])
+        except Exception:
+            for spec, used in live:
+                try:
+                    self.head.call("report_actor", spec=spec,
                                    node_id=self.node_id,
                                    restarts_used=used)
-            except Exception:
-                pass
+                except Exception:
+                    pass
         oids = [oid for oid in self._reported_oids
                 if self.worker.memory_store.contains(ObjectID(oid))]
         if oids:

@@ -112,6 +112,38 @@ class RayTpuConfig:
     # Pack below this node-utilization fraction, then prefer spreading
     # (reference: scheduler_spread_threshold, hybrid_scheduling_policy.h).
     scheduler_spread_threshold: float = 0.5
+    # Compact queued representation: queued-but-undispatched normal
+    # tasks are held as interned-template headers (QueuedTaskHeader)
+    # and materialized to a full TaskSpec only at dispatch, so a
+    # million-task backlog costs header bytes, not spec bytes
+    # (reference: the serialize-once TaskSpec + raylet queued-lease
+    # shape). Off = every submission builds the full spec up front.
+    sched_compact_queue: bool = True
+    # Shared-executor actors: sync max_concurrency=1 in-process actors
+    # are served by the grow-on-demand executor pool (one activation at
+    # a time per actor preserves mailbox order) instead of a dedicated
+    # thread per actor, so 10k actors cost 10k mailboxes, not 10k
+    # threads. Async / multi-concurrency / process-isolated actors
+    # keep dedicated threads. Off = legacy thread-per-actor.
+    sched_actor_executor_pool: bool = True
+    # Group-committed actor creation: cluster-dispatched creations ride
+    # the per-node CoalescingBatcher (submit_batch frames) and head
+    # re-registrations batch into one report_actors RPC, so N actors
+    # register in O(batches) head round trips. Restart-gate semantics
+    # are unchanged (same record_lineage/ActorRestartGate.register
+    # calls, batched transport). Off = one synchronous RPC per actor.
+    sched_group_actor_creation: bool = True
+    # Lock partitioning for the head's hot scheduling tables (inflight,
+    # object directory, lineage, lease grants): shard count (rounded up
+    # to a power of two). 1 = effectively a single lock per table.
+    sched_head_shards: int = 16
+    # Lease cache: a granted (job, shape) lease is returned after this
+    # long idle (reference: lease return on idle worker).
+    sched_lease_idle_s: float = 2.0
+    # Spillback: a leased node whose reported backlog exceeds this many
+    # queued-undispatched tasks triggers a spill lease on a better
+    # target (reference: raylet backlog-driven spillback).
+    sched_spillback_backlog: int = 128
 
     # -- memory monitor / worker killing (reference: memory_monitor.h) ---
     memory_usage_threshold: float = 0.95

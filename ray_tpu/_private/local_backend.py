@@ -24,12 +24,13 @@ from time import monotonic as _monotonic
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import perf_stats as _perf_stats
-from ray_tpu._private import tenancy
+from ray_tpu._private import sched_state, tenancy
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
-from ray_tpu._private.resources import ResourceSet, to_milli
+from ray_tpu._private.resources import ResourceSet, spec_milli, to_milli
 from ray_tpu._private.task_spec import (
     DefaultSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
+    QueuedTaskHeader,
     TaskKind,
     TaskSpec,
 )
@@ -40,6 +41,12 @@ logger = logging.getLogger(__name__)
 # dispatch; actor tasks: mailbox wait) — module-level so both execute
 # paths share one distribution.
 _SCHED_LATENCY = _perf_stats.latency("sched_submit_to_start_seconds")
+# Compact-queue observability (ray_tpu_sched_* after the runtime-
+# metrics fold): header-queued submissions + their approximate queued
+# footprint, and the header→spec materialization cost at dispatch.
+_HEADERS_QUEUED = _perf_stats.counter("sched_headers_queued")
+_HEADER_BYTES = _perf_stats.counter("sched_queued_header_bytes")
+_MATERIALIZE = _perf_stats.latency("sched_materialize_seconds")
 
 
 class _BlockedState(threading.local):
@@ -68,19 +75,34 @@ class _Actor:
         self.mailbox: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
         self.death_cause = ""
         self.num_restarts = 0
-        # Guards state transitions vs. mailbox puts (kill/submit race).
+        # Guards state transitions vs. mailbox puts (kill/submit race),
+        # and — in pool mode — the activation flag.
         self.mb_lock = threading.Lock()
-        self.is_async = any(
-            inspect.iscoroutinefunction(m)
-            for _, m in inspect.getmembers(type(spec.func) if not inspect.isclass(spec.func) else spec.func,
-                                           predicate=inspect.isfunction)
-        ) if inspect.isclass(spec.func) else False
+        self.is_async = bool(sched_state.class_is_async(spec.func))
+        # Shared-executor serving (sched_actor_executor_pool): the
+        # default actor shape (sync, max_concurrency=1, in-process) is
+        # drained by the backend's grow-on-demand executor pool — one
+        # activation at a time preserves mailbox order — instead of a
+        # dedicated thread per actor, so 10k actors cost 10k mailboxes
+        # and ZERO standing threads. Async / multi-concurrency /
+        # process-isolated actors keep the dedicated-thread path.
+        from ray_tpu._private.config import ray_config
+
+        self.pool_mode = bool(
+            ray_config.sched_actor_executor_pool and not self.is_async
+            and spec.max_concurrency <= 1 and not spec.isolate_process)
+        self._active = False  # pool mode: a drain pass is scheduled
         self._threads: list[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # Dedicated forked worker when spec.isolate_process is set.
         self._proc = None
 
     def start(self):
+        if self.pool_mode:
+            # Constructor + queued calls run as one drain pass on the
+            # shared executor pool (no per-actor thread).
+            self.backend._activate_actor(self)
+            return
         n = max(1, self.spec.max_concurrency) if not self.is_async else 1
         for i in range(n):
             t = threading.Thread(
@@ -180,7 +202,10 @@ class _Actor:
                         drained.append(item)
             except queue.Empty:
                 pass
-            if not already_dead:
+            if not already_dead and not self.pool_mode:
+                # Wake every dedicated executor thread (pool-mode
+                # actors have none to wake: an active drain pass
+                # observes DEAD at its next item and retires).
                 for _ in (self._threads or [None]):
                     self.mailbox.put(None)
         # Abrupt-stop hook, OUTSIDE mb_lock (it may take the instance's
@@ -208,8 +233,18 @@ class LocalBackend:
         self.worker = worker
         self.node_id = node_id or NodeID.from_random()
         self.resources = ResourceSet(resources)
-        self._pending_deps: dict[ObjectID, list[TaskSpec]] = {}
-        self._dep_counts: dict[bytes, int] = {}  # task_id binary -> remaining deps
+        # Dependency-parked work: a pure decision core with exactly-
+        # once handoff between the ready path and the death sweep
+        # (raymc dep_sweep scenario proves the claim protocol; ROADMAP
+        # FT gap d). Items are queued forms — headers or full specs.
+        self._deps = sched_state.DepTable()
+        # Demand of dep-parked work, charged at park and released at
+        # claim (ready or sweep). NOT part of the backlog signal (the
+        # work is not runnable yet) but head-local placement of
+        # lifetime-pinned creations must see it — a dep-blocked
+        # creation burst otherwise over-lands on the head and the
+        # overflow parks forever once the deps resolve.
+        self._dep_demand = sched_state.PendingCounter()
         # Runnable queue: per-job virtual-time WFQ when tenancy
         # enforcement + weights are configured, byte-identical FIFO
         # otherwise (one class). Same put/get/get_nowait surface as the
@@ -224,14 +259,18 @@ class LocalBackend:
         self.quota_ledger = tenancy.QuotaLedger()
         self._waiting_for_resources: list[TaskSpec] = []
         # Incremental queued-demand accounting (reference: raylet
-        # backlog). Scanning the ready queue per submission made the
+        # backlog) under its own small lock — the submit hot path's
+        # add/remove never contends with the dep table or the parked
+        # list. Scanning the ready queue per submission made the
         # local-fit check O(queue) -> O(n^2) over a fan-out burst.
-        self._pending_milli: dict = {}
-        self._pending_count = 0
+        self._pending = sched_state.PendingCounter()
         # Grow-on-demand executor pool for normal tasks (see _launch).
         self._exec_q: "queue.Queue" = queue.Queue()
         self._exec_idle = 0
         self._exec_lock = threading.Lock()
+        # Materialization-latency sampling tick (1/32; benign race —
+        # a lost increment only shifts which dispatch gets timed).
+        self._mat_tick = 0
         # Every executor thread ever spawned (pruned of dead ones at
         # spawn): shutdown() wakes each blocked get() with a None
         # sentinel — without it an idle executor sits out its full 10s
@@ -307,18 +346,20 @@ class LocalBackend:
             # the reference's client-side queueing while an actor is
             # PENDING_CREATION (direct_actor_task_submitter.h).
             self._actors[spec.actor_id] = _Actor(self, spec)
+        elif type(spec) is QueuedTaskHeader and _perf_stats.ENABLED:
+            _HEADERS_QUEUED.inc()
+            _HEADER_BYTES.inc(spec.approx_nbytes())
         deps = spec.dependencies()
         unresolved = [d for d in deps if not self.worker.memory_store.contains(d)]
-        with self._lock:
-            # Only dep-parked tasks get an entry (a zero entry would
-            # never be removed — _on_dep_ready deletes at zero — so
-            # the dict and the waiting_for_deps gauge would grow with
-            # every dep-free task ever submitted).
-            if unresolved:
-                self._dep_counts[spec.task_id.binary()] = len(unresolved)
-                for d in unresolved:
-                    self._pending_deps.setdefault(d, []).append(spec)
         if unresolved:
+            # Charge the dep-parked demand BEFORE parking: the claim
+            # (which releases it) can only happen after park, so the
+            # counter never goes negative.
+            self._dep_demand.add(self._spec_milli(spec))
+            # Park before registering callbacks: a dep landing between
+            # the contains() probe and on_ready registration fires the
+            # callback inline, and dep_ready must find the entry.
+            self._deps.park(spec.task_id.binary(), spec, unresolved)
             for d in unresolved:
                 self.worker.memory_store.on_ready(d, self._on_dep_ready)
         else:
@@ -348,7 +389,7 @@ class LocalBackend:
         # concurrently-submitted task (unordered anyway) jump the
         # queue; a task queued EARLIER by this thread always bumped
         # the pending count synchronously.
-        if self._pending_count != 0 or self._exec_idle == 0:
+        if self._pending.count_approx != 0 or self._exec_idle == 0:
             return False
         if self._cancelled and spec.task_id.binary() in self._cancelled:
             return False
@@ -367,15 +408,8 @@ class LocalBackend:
         return True
 
     def _on_dep_ready(self, object_id: ObjectID) -> None:
-        now_ready = []
-        with self._lock:
-            for spec in self._pending_deps.pop(object_id, []):
-                key = spec.task_id.binary()
-                self._dep_counts[key] -= 1
-                if self._dep_counts[key] == 0:
-                    del self._dep_counts[key]
-                    now_ready.append(spec)
-        for spec in now_ready:
+        for spec in self._deps.dep_ready(object_id):
+            self._dep_demand.remove(self._spec_milli(spec))
             self._pending_add(spec)
             self._ready.put(spec)
 
@@ -392,13 +426,24 @@ class LocalBackend:
         # State check and enqueue are atomic w.r.t. stop(): otherwise a kill
         # between the check and the put leaves this caller hanging forever.
         with actor.mb_lock:
-            if actor.state != ActorState.DEAD:
+            enqueued = actor.state != ActorState.DEAD
+            if enqueued:
                 # Dependencies still gate execution; ordering is preserved by
-                # the mailbox (the actor thread blocks on unresolved deps at
-                # dequeue time).
+                # the mailbox (the actor executor blocks on unresolved deps
+                # at dequeue time).
                 actor.mailbox.put(spec)
-                return
+                needs_activation = actor.pool_mode and \
+                    actor.state == ActorState.ALIVE and \
+                    not actor._active
             cause = actor.death_cause
+        if enqueued:
+            if needs_activation:
+                # Idle pool-mode actor: schedule a drain pass. PENDING
+                # actors drain when their creation dispatches, and an
+                # active pass sees this item before deactivating —
+                # puts and the deactivation check share mb_lock.
+                self._activate_actor(actor)
+            return
         self.worker.store_task_outputs(
             spec, None, error=exc.ActorDiedError(spec.actor_id.hex()[:8], cause)
         )
@@ -448,6 +493,16 @@ class LocalBackend:
                 self._waiting_for_resources = []
             if spec is not None:
                 candidates.append(spec)
+                # Group-committed dispatch: drain whatever else is
+                # already runnable into THIS pass (bounded), so a
+                # burst of N queued creations/tasks costs O(N/batch)
+                # loop iterations — not one full pass each. Order is
+                # preserved (appended in queue order).
+                try:
+                    for _ in range(255):
+                        candidates.append(self._ready.get_nowait())
+                except queue.Empty:
+                    pass
             still_waiting = []
             for s in candidates:
                 if s.task_id.binary() in self._cancelled:
@@ -517,23 +572,53 @@ class LocalBackend:
             actor._held_pool = pool
             actor._held_request = request
             actor.start()
-        else:
-            # Reusable executor pool (reference: the worker pool keeps
-            # warm workers; here threads): a thread PER task made thread
-            # creation the single biggest per-task cost at fan-out
-            # rates. Grows on demand (a task blocking in get() holds its
-            # thread, idle==0 spawns another), shrinks on idle timeout.
-            with self._exec_lock:
-                self._exec_q.put((spec, pool, request))  # raylint: disable=R2 -- _exec_q is unbounded, so put() cannot block; enqueue + idle-count bookkeeping must be one atomic step or _exec_loop's retire check double-counts idle threads
-                if self._exec_idle == 0:
-                    t = threading.Thread(target=self._exec_loop,
-                                         name="task-exec", daemon=True)
-                    self._exec_threads = [
-                        th for th in self._exec_threads if th.is_alive()]
-                    self._exec_threads.append(t)
-                    t.start()
-                else:
-                    self._exec_idle -= 1
+            return
+        if type(spec) is QueuedTaskHeader:
+            # Compact-queue dispatch boundary: the full TaskSpec exists
+            # from here on (and only from here on). Latency is SAMPLED
+            # 1/32 — two clock reads per dispatch would tax the path
+            # the distribution exists to watch.
+            tick = self._mat_tick = self._mat_tick + 1
+            if tick & 31:
+                spec = spec.materialize()
+            else:
+                t0 = _monotonic()
+                spec = spec.materialize()
+                _MATERIALIZE.record(_monotonic() - t0)
+        # Reusable executor pool (reference: the worker pool keeps
+        # warm workers; here threads): a thread PER task made thread
+        # creation the single biggest per-task cost at fan-out
+        # rates. Grows on demand (a task blocking in get() holds its
+        # thread, idle==0 spawns another), shrinks on idle timeout.
+        self._exec_submit(("task", spec, pool, request))
+
+    def _exec_submit(self, item, spawn: bool = True) -> bool:
+        """Enqueue one executor work item — a ("task", spec, pool,
+        request) dispatch or an ("actor", actor) drain pass — growing
+        the pool when no idle executor is promised to serve it.
+        ``spawn=False`` is for re-activations from INSIDE an executor
+        (that thread returns to the loop and serves the item itself —
+        spawning would leak a thread per drain slice).
+
+        Returns True when the item is accounted (an idle promise was
+        consumed or a thread spawned). A ``spawn=False`` enqueue at
+        idle==0 returns False: the item rides the CALLER's return to
+        the loop, so the caller must skip its post-serve idle credit
+        or the item double-counts as a phantom idle thread."""
+        with self._exec_lock:
+            self._exec_q.put(item)  # raylint: disable=R2 -- _exec_q is unbounded, so put() cannot block; enqueue + idle-count bookkeeping must be one atomic step or _exec_loop's retire check double-counts idle threads
+            if self._exec_idle == 0:
+                if not spawn:
+                    return False
+                t = threading.Thread(target=self._exec_loop,
+                                     name="task-exec", daemon=True)
+                self._exec_threads = [
+                    th for th in self._exec_threads if th.is_alive()]
+                self._exec_threads.append(t)
+                t.start()
+            else:
+                self._exec_idle -= 1
+            return True
 
     def _exec_loop(self):
         while not self._shutdown.is_set():
@@ -549,9 +634,74 @@ class LocalBackend:
                 continue
             if item is None:
                 return  # shutdown sentinel: retire immediately
-            self._execute_normal_task(*item)
+            if item[0] == "actor":
+                rode_this_thread = self._drain_actor(item[1])
+            else:
+                self._execute_normal_task(item[1], item[2], item[3])
+                rode_this_thread = False
             with self._exec_lock:
-                self._exec_idle += 1
+                if not rode_this_thread:
+                    self._exec_idle += 1
+
+    # -- shared-executor actor serving (pool mode) ---------------------
+
+    def _activate_actor(self, actor: "_Actor") -> None:
+        """Schedule one drain pass for a pool-mode actor; at most one
+        active pass per actor preserves mailbox (per-caller) order."""
+        with actor.mb_lock:
+            if actor._active:
+                return
+            actor._active = True
+        self._exec_submit(("actor", actor))
+
+    # Mailbox items served per drain slice before the pass re-enqueues
+    # itself, so one chatty actor cannot monopolize an executor while
+    # other work queues.
+    _ACTOR_DRAIN_SLICE = 64
+
+    def _drain_actor(self, actor: "_Actor") -> bool:
+        """One activation: construct if pending, then serve the mailbox
+        until empty (deactivating under mb_lock, atomic with puts) or
+        the fairness slice expires (re-enqueue, still active).
+
+        Returns True when the slice re-enqueued itself UNACCOUNTED
+        (``_exec_submit(spawn=False)`` at idle==0): the continuation
+        rides this thread's return to the loop, so _exec_loop must not
+        also credit the thread as idle."""
+        if actor.state == ActorState.PENDING:
+            if not actor._construct():
+                # Constructor failed: _on_actor_death already drained
+                # and poisoned the queued calls; retire the activation.
+                with actor.mb_lock:
+                    actor._active = False
+                return False
+        served = 0
+        while True:
+            try:
+                item = actor.mailbox.get_nowait()
+            except queue.Empty:
+                with actor.mb_lock:
+                    if actor.mailbox.empty():
+                        actor._active = False
+                        return False
+                continue
+            if item is None:
+                continue  # stray dedicated-path sentinel: ignore
+            if actor.state == ActorState.DEAD:
+                self.worker.store_task_outputs(
+                    item, None,
+                    error=exc.ActorDiedError(actor.actor_id.hex()[:8],
+                                             actor.death_cause))
+                continue
+            self._execute_actor_task(actor, item)
+            served += 1
+            if served >= self._ACTOR_DRAIN_SLICE and \
+                    not self._shutdown.is_set():
+                accounted = self._exec_submit(("actor", actor),
+                                              spawn=False)
+                # Still active: the re-enqueued pass continues. When
+                # unaccounted, it continues ON THIS THREAD.
+                return not accounted
 
     # ------------------------------------------------------------------
     # Execution
@@ -796,6 +946,17 @@ class LocalBackend:
         self.worker.gcs.remove_named_actor_by_id(actor.actor_id)
         # Fail everything that was still queued at death.
         drained = actor.stop(actor.death_cause or "actor died")
+        # Death sweep over the dep-park table: a creation spec of THIS
+        # actor still parked on unresolved deps is claimed here — or by
+        # a racing _on_dep_ready, never both (DepTable's exactly-once
+        # handoff; the loser's path is a no-op). Un-swept it would hold
+        # its queued-ceiling admission forever if its dep never fires.
+        aid = actor.actor_id
+        for item in self._deps.sweep(
+                lambda s: getattr(s, "actor_id", None) == aid):
+            self._dep_demand.remove(self._spec_milli(item))
+            self.quota_ledger.note_dequeued(item)
+            drained.append(item)
         for item in drained:
             self.worker.store_task_outputs(
                 item, None,
@@ -841,63 +1002,48 @@ class LocalBackend:
             )
         self._on_actor_death(actor, exc.ActorDiedError(actor_id.hex()[:8], "killed"))
 
-    @staticmethod
-    def _spec_milli(spec) -> dict:
-        # Cached per spec: the demand conversion runs at least three
-        # times per task (pending add/remove + dispatch) otherwise.
-        m = getattr(spec, "_milli_cache", None)
-        if m is None:
-            from ray_tpu._private.resources import to_milli as _to_milli
-
-            m = _to_milli(spec.resources)
-            try:
-                spec._milli_cache = m
-            except Exception:
-                pass
-        return m
+    # Template-cached milli-demand (shared core with the head's
+    # placement/reservation accounting — resources.spec_milli).
+    _spec_milli = staticmethod(spec_milli)
 
     def _pending_add(self, spec) -> None:
-        milli = self._spec_milli(spec)
-        with self._lock:
-            self._pending_count += 1
-            for k, v in milli.items():
-                self._pending_milli[k] = self._pending_milli.get(k, 0) + v
+        self._pending.add(self._spec_milli(spec))
 
     def _pending_remove(self, spec) -> None:
         self.quota_ledger.note_dequeued(spec)
-        milli = self._spec_milli(spec)
-        with self._lock:
-            self._pending_count = max(0, self._pending_count - 1)
-            for k, v in milli.items():
-                left = self._pending_milli.get(k, 0) - v
-                if left > 0:
-                    self._pending_milli[k] = left
-                else:
-                    self._pending_milli.pop(k, None)
+        self._pending.remove(self._spec_milli(spec))
 
     def pending_demand_milli(self) -> Dict[str, int]:
         """Resource demand of tasks queued but not yet dispatched — the
         backlog signal the cluster scheduler and autoscaler consume
         (reference: raylet backlog reporting in lease requests).
-        Maintained incrementally: O(1) per read."""
-        with self._lock:
-            return dict(self._pending_milli)
+        Maintained incrementally: O(1) per read. Header-queued and
+        spec-queued work charge identically (both flow _pending_add
+        with the template-cached milli conversion)."""
+        return self._pending.demand_milli()
 
     def backlog_count(self) -> int:
-        with self._lock:
-            return self._pending_count
+        return self._pending.count()
+
+    def dep_parked_demand_milli(self) -> Dict[str, int]:
+        """Demand of dependency-parked work — not runnable yet, so not
+        in the backlog signal, but placement of lifetime-pinned work
+        (actor creations) must reserve for it."""
+        return self._dep_demand.demand_milli()
 
     def queue_depths(self) -> Dict[str, int]:
         """Scheduler-pressure snapshot for the health plane: tasks
         queued but not dispatched (``backlog``), the subset parked
         waiting for resources, and tasks parked on unresolved
-        dependencies. O(1) except the parked list length."""
+        dependencies (headers and full specs count identically).
+        O(1) except the parked list length."""
         with self._lock:
-            return {
-                "backlog": self._pending_count,
-                "parked_for_resources": len(self._waiting_for_resources),
-                "waiting_for_deps": len(self._dep_counts),
-            }
+            parked = len(self._waiting_for_resources)
+        return {
+            "backlog": self._pending.count(),
+            "parked_for_resources": parked,
+            "waiting_for_deps": self._deps.waiting_count(),
+        }
 
     def actor_state(self, actor_id: ActorID) -> str:
         actor = self._actors.get(actor_id)

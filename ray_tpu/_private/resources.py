@@ -36,6 +36,21 @@ def to_milli(resources: Dict[str, float]) -> Dict[str, int]:
     return out
 
 
+def spec_milli(spec) -> Dict[str, int]:
+    """Template-cached milli-demand of a spec or queued header. Cached
+    per spec: the conversion runs at least three times per task
+    (pending add/remove + dispatch) plus the head's reservation and
+    backlog accounting otherwise."""
+    m = getattr(spec, "_milli_cache", None)
+    if m is None:
+        m = to_milli(spec.resources)
+        try:
+            spec._milli_cache = m
+        except Exception:
+            pass
+    return m
+
+
 def from_milli(resources: Dict[str, int]) -> Dict[str, float]:
     return {k: v / MILLI for k, v in resources.items()}
 

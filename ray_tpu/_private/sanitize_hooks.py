@@ -120,6 +120,11 @@ SCHED_POINTS = frozenset({
     "tenancy.acquire",
     "tenancy.release",
     "tenancy.park",
+    # scheduler dep-park table: the ready-path claim and the death
+    # sweep's claim (the dep_sweep raymc scenario's interleaving
+    # surface — exactly-once handoff between the two).
+    "sched.dep_ready",
+    "sched.dep_sweep",
 })
 
 CRASH_POINTS = frozenset({

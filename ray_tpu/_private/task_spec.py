@@ -221,6 +221,21 @@ class TaskSpec:
     # args + a small header.
     template_id: Optional[bytes] = None
 
+    # Class-level defaults (NOT dataclass fields) for the scheduler's
+    # per-spec bookkeeping: quota charge tokens, the sticky admission
+    # flag, the submit timestamp, consumed actor restarts, and the
+    # milli-demand cache. Hot paths probe these with getattr on every
+    # submission/dispatch; an absent instance attribute makes getattr
+    # raise-and-catch internally (~µs each), while a class attribute
+    # is a plain MRO read. Writes shadow per-instance as before.
+    _quota_cpu = None
+    _quota_queued = None
+    _quota_admitted = False
+    _submit_monotonic = None
+    _milli_cache = None
+    _lease_reroutes = 0
+    restarts_used = 0
+
     def assign_return_ids(self) -> list[ObjectID]:
         """Populate ``return_ids`` from ``num_returns`` and return them.
 
@@ -241,13 +256,7 @@ class TaskSpec:
 
     def dependencies(self) -> list[ObjectID]:
         """ObjectIDs appearing at the top level of args/kwargs."""
-        from ray_tpu.object_ref import ObjectRef
-
-        deps = []
-        for a in list(self.args) + list(self.kwargs.values()):
-            if isinstance(a, ObjectRef):
-                deps.append(a.id)
-        return deps
+        return top_level_dependencies(self.args, self.kwargs)
 
     def nested_dependencies(self, max_depth: int = 4) -> list[ObjectID]:
         """ObjectIDs reachable through standard containers in
@@ -255,35 +264,55 @@ class TaskSpec:
         objects against a racing driver release; refs buried in custom
         user objects are covered by the executing node's borrower
         registration instead."""
-        from ray_tpu.object_ref import ObjectRef
-
-        deps: list[ObjectID] = []
-        seen: set = set()
-
-        def walk(v, depth):
-            if isinstance(v, ObjectRef):
-                if v.binary() not in seen:
-                    seen.add(v.binary())
-                    deps.append(v.id)
-                return
-            if depth <= 0:
-                return
-            if isinstance(v, (list, tuple, set, frozenset)):
-                for item in v:
-                    walk(item, depth - 1)
-            elif isinstance(v, dict):
-                for k, item in v.items():
-                    walk(k, depth - 1)
-                    walk(item, depth - 1)
-
-        for a in list(self.args) + list(self.kwargs.values()):
-            walk(a, max_depth)
-        return deps
+        return nested_dependencies_of(self.args, self.kwargs, max_depth)
 
     def describe(self) -> str:
         if self.kind == TaskKind.ACTOR_TASK:
             return f"{self.name} (actor={self.actor_id})"
         return f"{self.name} ({self.task_id.hex()[:8]})"
+
+
+def top_level_dependencies(args, kwargs) -> list[ObjectID]:
+    """ObjectIDs at the top level of an args/kwargs pair (shared by
+    TaskSpec and QueuedTaskHeader — the dep-gating contract must be
+    identical whichever queued form a submission takes)."""
+    from ray_tpu.object_ref import ObjectRef
+
+    deps = []
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, ObjectRef):
+            deps.append(a.id)
+    return deps
+
+
+def nested_dependencies_of(args, kwargs, max_depth: int = 4) \
+        -> list[ObjectID]:
+    """Container-walking dependency scan shared by TaskSpec and
+    QueuedTaskHeader (see TaskSpec.nested_dependencies)."""
+    from ray_tpu.object_ref import ObjectRef
+
+    deps: list[ObjectID] = []
+    seen: set = set()
+
+    def walk(v, depth):
+        if isinstance(v, ObjectRef):
+            if v.binary() not in seen:
+                seen.add(v.binary())
+                deps.append(v.id)
+            return
+        if depth <= 0:
+            return
+        if isinstance(v, (list, tuple, set, frozenset)):
+            for item in v:
+                walk(item, depth - 1)
+        elif isinstance(v, dict):
+            for k, item in v.items():
+                walk(k, depth - 1)
+                walk(item, depth - 1)
+
+    for a in list(args) + list(kwargs.values()):
+        walk(a, max_depth)
+    return deps
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +353,14 @@ class SpecTemplate:
     lifetime: Optional[str] = None
     max_pending_calls: int = -1
     template_id: bytes = b""
+    # Lazily-built invariant __dict__ slice for fast materialization
+    # (see spec_proto); NOT part of template identity — excluded from
+    # dataclass __eq__/__repr__ so a template that has built its proto
+    # still compares equal to a content-identical fresh one, and the
+    # placeholder-spec dict never rides a wire.TaskTemplate shipment
+    # as dead weight.
+    _spec_proto: Optional[dict] = field(
+        default=None, repr=False, compare=False)
 
     def make_spec(self, task_id: TaskID, args: tuple, kwargs: dict,
                   depth: int = 0, trace_parent: Optional[tuple] = None,
@@ -364,6 +401,178 @@ class SpecTemplate:
         )
         # The scheduler's demand conversion, computed once at intern time.
         spec._milli_cache = self.milli
+        return spec
+
+    def spec_proto(self) -> dict:
+        """The invariant slice of a materialized spec's ``__dict__``,
+        built once per template: QueuedTaskHeader.materialize copies it
+        with one C-level ``dict.update`` instead of re-running the
+        25-kwarg dataclass constructor per dispatch (the constructor
+        was ~40% of header+materialize cost; with the proto the compact
+        path's TOTAL work is below a single make_spec). Field sharing
+        (resources / scheduling_strategy / runtime_env aliased to the
+        template's) is exactly make_spec's existing semantics; every
+        per-call key is overwritten by the copier. Benign lazy-init
+        race: two builders produce equal dicts."""
+        proto = self._spec_proto
+        if proto is None:
+            proto = self.make_spec(TaskID(b"\0" * 16), (), {}).__dict__
+            self._spec_proto = proto
+        return proto
+
+    def __getstate__(self):
+        # The lazily-built proto is derived state: shipping it in a
+        # wire.TaskTemplate would carry a placeholder spec __dict__ as
+        # dead weight — the receiving side rebuilds on first dispatch.
+        state = dict(self.__dict__)
+        state["_spec_proto"] = None
+        return state
+
+
+class QueuedTaskHeader:
+    """Compact queued form of one submission (the control-plane slice
+    of the reference's lease-request header): the interned template
+    reference plus only the per-call fields, in a ``__slots__`` object
+    a fraction the size of a full ``TaskSpec``. Queued-but-undispatched
+    work is held in this form — a million-task backlog costs header
+    bytes — and :meth:`materialize` builds the full spec exactly once,
+    at dispatch. Only default-strategy NORMAL_TASK submissions take
+    this shape (see ``RemoteFunction.remote``); everything else still
+    queues full specs, and both forms flow the same scheduler paths
+    (quota admission, WFQ classing, dep parking, backlog accounting).
+
+    Retry state (``max_retries``/``attempt``) lives on the header, not
+    the template, so node-death resubmits of a leased header keep their
+    own ledger; quota charge tokens ride the header and TRANSFER to the
+    materialized spec (never both — a charge is released exactly once).
+    """
+
+    __slots__ = ("tpl", "task_id", "args", "kwargs", "depth",
+                 "trace_parent", "job_id", "attempt", "max_retries",
+                 "num_returns", "return_ids", "_milli_cache",
+                 "_quota_cpu", "_quota_queued", "_quota_admitted",
+                 "_submit_monotonic", "_lease_reroutes")
+
+    def __init__(self, tpl: SpecTemplate, task_id: TaskID, args: tuple,
+                 kwargs: dict, depth: int = 0,
+                 trace_parent: Optional[tuple] = None,
+                 job_id: str = ""):
+        self.tpl = tpl
+        self.task_id = task_id
+        self.args = args
+        self.kwargs = kwargs
+        self.depth = depth
+        self.trace_parent = trace_parent
+        self.job_id = job_id
+        self.attempt = 0
+        self.max_retries = tpl.max_retries
+        self.num_returns = tpl.num_returns
+        self.return_ids: list = []
+        self._milli_cache = tpl.milli
+        # Pre-set every optional slot: getattr(h, name, default) on an
+        # UNSET slot raises internally (~µs of exception machinery),
+        # and the quota/WFQ hot paths probe these on every submission —
+        # five stores at mint buy plain reads everywhere after.
+        self._quota_cpu = None
+        self._quota_queued = None
+        self._quota_admitted = False
+        self._submit_monotonic = None
+        self._lease_reroutes = 0
+
+    # -- template-delegated invariants (read-only views) -----------------
+
+    @property
+    def kind(self) -> TaskKind:
+        return self.tpl.kind
+
+    @property
+    def resources(self) -> Dict[str, float]:
+        return self.tpl.resources
+
+    @property
+    def scheduling_strategy(self):
+        return self.tpl.scheduling_strategy
+
+    @property
+    def name(self) -> str:
+        return self.tpl.name
+
+    @property
+    def func(self):
+        return self.tpl.func
+
+    @property
+    def func_id(self) -> Optional[bytes]:
+        return self.tpl.func_id
+
+    @property
+    def template_id(self) -> bytes:
+        return self.tpl.template_id
+
+    @property
+    def actor_id(self):
+        return None  # headers are normal tasks only
+
+    def assign_return_ids(self) -> list[ObjectID]:
+        n = 1 if self.num_returns == "dynamic" else self.num_returns
+        self.return_ids = [
+            ObjectID.for_task_return(self.task_id, i) for i in range(n)
+        ]
+        return self.return_ids
+
+    def dependencies(self) -> list[ObjectID]:
+        return top_level_dependencies(self.args, self.kwargs)
+
+    def nested_dependencies(self, max_depth: int = 4) -> list[ObjectID]:
+        return nested_dependencies_of(self.args, self.kwargs, max_depth)
+
+    def describe(self) -> str:
+        return f"{self.tpl.name} ({self.task_id.hex()[:8]})"
+
+    def approx_nbytes(self) -> int:
+        """Cheap queued-footprint estimate for the
+        ``sched_queued_header_bytes`` counter (slots + id + per-arg
+        slot; arg VALUES are shared with the caller, not charged)."""
+        return 240 + 16 * (len(self.args) + len(self.kwargs))
+
+    def materialize(self, transfer_tokens: bool = True) -> TaskSpec:
+        """Build the full TaskSpec. At local dispatch (the default)
+        quota charge tokens MOVE to the spec — release/retry paths run
+        against the materialized form, exactly once. With
+        ``transfer_tokens=False`` (wire copies: the head keeps the
+        header in its lineage/in-flight tables) tokens stay put so the
+        head-side release still finds the charge."""
+        tpl = self.tpl
+        proto = tpl._spec_proto
+        if proto is None:
+            proto = tpl.spec_proto()
+        spec = TaskSpec.__new__(TaskSpec)
+        d = spec.__dict__
+        d.update(proto)
+        d["task_id"] = self.task_id
+        d["args"] = self.args
+        d["kwargs"] = self.kwargs
+        d["depth"] = self.depth
+        d["trace_parent"] = self.trace_parent
+        d["job_id"] = self.job_id
+        d["num_returns"] = self.num_returns
+        d["return_ids"] = self.return_ids
+        d["max_retries"] = self.max_retries
+        d["attempt"] = self.attempt
+        if transfer_tokens:
+            cpu_token = self._quota_cpu
+            if cpu_token is not None:
+                spec._quota_cpu = cpu_token
+                self._quota_cpu = None
+            queued_token = self._quota_queued
+            if queued_token is not None:
+                spec._quota_queued = queued_token
+                self._quota_queued = None
+        if self._quota_admitted:
+            spec._quota_admitted = True
+        submitted = self._submit_monotonic
+        if submitted is not None:
+            spec._submit_monotonic = submitted
         return spec
 
 

@@ -51,6 +51,12 @@ class _TaskContext(threading.local):
 class Worker:
     """The runtime embedded in the driver (and, conceptually, each worker)."""
 
+    # Compact queued submissions (QueuedTaskHeader) are accepted by the
+    # in-process backends; the thin ray-client proxy is not marked, so
+    # remote() keeps building full specs there (the client wire contract
+    # ships TaskSpec).
+    supports_compact_submit = True
+
     def __init__(self, resources: Dict[str, float], namespace: Optional[str] = None):
         self.worker_id = WorkerID.from_random()
         self.job_id = JobID.from_random()

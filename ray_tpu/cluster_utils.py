@@ -26,11 +26,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private import sanitize_hooks
+from ray_tpu._private import sched_state
 from ray_tpu._private import state as state_mod
 from ray_tpu._private import tenancy
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import ray_config
 from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.resources import spec_milli
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import TaskKind
 from ray_tpu.exceptions import ActorDiedError, OwnerDiedError
@@ -52,6 +54,15 @@ _PULL_SLOT_WAIT = _perf_stats.latency("object_pull_slot_wait_seconds")
 # chaos run's "the job completed" comes with "and here is what it cost".
 _NODE_DEATHS = _perf_stats.counter("node_deaths")
 _NODE_DEATH_LOST_BYTES = _perf_stats.counter("node_death_lost_bytes")
+
+# Lease-cache observability (ray_tpu_sched_* after the runtime-metrics
+# fold): a hit is a submission riding an already-granted (job, shape)
+# lease with no head scheduling decision; a miss is a fresh grant; a
+# spillback is a grant redirected off an overloaded lease target by the
+# node's reported backlog signal.
+_LEASE_CACHE_HITS = _perf_stats.counter("sched_lease_cache_hit")
+_LEASE_CACHE_MISSES = _perf_stats.counter("sched_lease_cache_miss")
+_SPILLBACKS = _perf_stats.counter("sched_spillbacks")
 
 
 def _recon_counter(outcome: str):
@@ -290,6 +301,11 @@ def descriptor_object_read(worker, transfer_addr, get_object, oids,
     return out
 
 
+# Template-cached milli-demand of a spec (shared core with the local
+# backend's _spec_milli — resources.spec_milli).
+_spec_milli_of = spec_milli
+
+
 class _NodeRecord:
     def __init__(self, node_id: str, address: Tuple[str, int],
                  resources: Dict[str, float],
@@ -330,6 +346,23 @@ class _NodeRecord:
         from ray_tpu._private.rpc import LruTable
 
         self.known_templates = LruTable(4096)
+        # In-flight ACTOR-CREATION reservations (milli-resources),
+        # charged at record_inflight and released when the creation
+        # completes or unwinds. The pushed availability view is stale
+        # within a report period, which tasks tolerate (an over-placed
+        # task queues and runs when the node frees up) but creations do
+        # NOT: an actor pins its CPUs for life, so a burst of creations
+        # placed against one stale view overcommits a node with work
+        # that can never start while other nodes idle. _choose_node
+        # subtracts this. Mutations under the head lock (creations are
+        # rare next to tasks); racy reads see a momentarily-stale int.
+        self.reserved_milli: Dict[str, int] = {}
+
+    def reserve(self, milli: Dict[str, int]) -> None:
+        sched_state.milli_add(self.reserved_milli, milli)
+
+    def unreserve(self, milli: Dict[str, int]) -> None:
+        sched_state.milli_sub(self.reserved_milli, milli)
 
 
 class _NullServer:
@@ -357,21 +390,30 @@ class ClusterHead:
 
     def __init__(self, worker, port: int = 0, start_server: bool = True):
         self.worker = worker
+        # The head lock guards the cold/cross-keyed tables (node
+        # records, pins, borrowers, actor directory). The HOT tables —
+        # object directory, in-flight dispatches, lineage — are
+        # lock-partitioned ShardedTables keyed by object/task id, so
+        # concurrent submit batches and node object reports stop
+        # serializing on one lock. Ordering rule: shard locks are LEAF
+        # locks — code holding self._lock may call into a sharded
+        # table, never the reverse.
         self._lock = threading.Lock()
+        shards = ray_config.sched_head_shards
         self.nodes: Dict[str, _NodeRecord] = {}
-        self.object_locations: Dict[bytes, Tuple[str, int]] = {}
+        self.object_locations = sched_state.ShardedTable(shards)
         # Reported payload sizes alongside locations (same lifecycle):
         # what locality-aware lease placement scores by — the directory
         # knows where the bytes are AND how many they are.
-        self.object_sizes: Dict[bytes, int] = {}
+        self.object_sizes = sched_state.ShardedTable(shards)
         self.actor_nodes: Dict[bytes, str] = {}
         # Failure/recovery state. lineage maps each task-return object to
         # its creating spec; inflight maps task_id -> (node_id, spec)
         # until outputs are reported; actor_specs keeps creation specs for
         # restart-on-node-death; the gate owns restart budgets, the
         # ALIVE/RESTARTING/DEAD FSM, and per-call replay-or-reject.
-        self.lineage: Dict[bytes, Any] = {}
-        self.inflight: Dict[bytes, Tuple[str, Any]] = {}
+        self.lineage = sched_state.ShardedTable(shards)
+        self.inflight = sched_state.ShardedTable(shards)
         self.actor_specs: Dict[bytes, Any] = {}
         from ray_tpu._private.actor_gate import ActorRestartGate
 
@@ -443,6 +485,7 @@ class ClusterHead:
             # actors from the head's registry.
             "route_task": self._route_task,
             "report_actor": self._report_actor,
+            "report_actors": self._report_actors,
             "gcs_named_actor_register": self._named_actor_register,
             "gcs_named_actor_get": self._named_actor_get,
             "gcs_named_actor_remove": self._named_actor_remove,
@@ -528,19 +571,31 @@ class ClusterHead:
     def _report_objects(self, oids: List[bytes], address, sizes=None):
         frees = []
         finished = []
-        with self._lock:
-            for i, oid in enumerate(oids):
-                self.object_locations[oid] = tuple(address)
-                if sizes is not None and i < len(sizes) and sizes[i]:
-                    self.object_sizes[oid] = int(sizes[i])
-                self._recon_attempts.pop(oid, None)
-                # Outputs landed: the producing task is no longer in
-                # flight anywhere; its arg pins drop with it.
-                tid = ObjectID(oid).task_id().binary()
-                entry = self.inflight.pop(tid, None)
-                if entry is not None:
-                    finished.append(entry[1])
-                frees.extend(self._unpin_task_locked(tid))
+        addr = tuple(address)
+        for i, oid in enumerate(oids):
+            self.object_locations[oid] = addr
+            if sizes is not None and i < len(sizes) and sizes[i]:
+                self.object_sizes[oid] = int(sizes[i])
+            # Outputs landed: the producing task is no longer in
+            # flight anywhere; its arg pins drop with it.
+            tid = ObjectID(oid).task_id().binary()
+            entry = self.inflight.pop(tid, None)
+            if entry is not None:
+                finished.append(entry[1])
+                if entry[1].kind == TaskKind.ACTOR_CREATION:
+                    # Constructed: the node's own reports carry the
+                    # held CPUs from dispatch on — drop the reservation.
+                    self._unreserve_creation(entry[0], entry[1])
+            # Lock-free membership prechecks keep the common case (no
+            # pins, no reconstruction attempt) off the head lock
+            # entirely. Safe: dict membership is GIL-atomic, and both
+            # entries are written strictly BEFORE the dispatch whose
+            # report this is (pins at record_inflight, the attempt at
+            # reconstruct request), so by report time they are visible.
+            if oid in self._recon_attempts or tid in self._task_pinned:
+                with self._lock:
+                    self._recon_attempts.pop(oid, None)
+                    frees.extend(self._unpin_task_locked(tid))
         self._quota_release(finished)
         self._fan_out_frees(frees)
         # Wake the driver's fetch dispatcher for anything it awaits.
@@ -571,24 +626,25 @@ class ClusterHead:
     def record_lineage(self, spec) -> None:
         from ray_tpu._private.task_spec import TaskKind
 
-        with self._lock:
-            # Actor-task outputs are reconstructable iff the call has
-            # retry budget (reference semantics: objects created by
-            # actor tasks can be re-created when max_task_retries > 0;
-            # re-execution routes through the restart gate like any
-            # replay). Without budget the output is lost with its node
-            # and the caller gets a typed ObjectLostError, never a
-            # hang (see mark_node_dead's poison pass).
-            if spec.kind in (TaskKind.NORMAL_TASK,
-                             TaskKind.ACTOR_CREATION) or \
-                    (spec.kind == TaskKind.ACTOR_TASK
-                     and spec.max_retries != 0):
-                for oid in spec.return_ids:
-                    self.lineage[oid.binary()] = spec
-            if spec.kind == TaskKind.ACTOR_CREATION:
+        # Actor-task outputs are reconstructable iff the call has
+        # retry budget (reference semantics: objects created by
+        # actor tasks can be re-created when max_task_retries > 0;
+        # re-execution routes through the restart gate like any
+        # replay). Without budget the output is lost with its node
+        # and the caller gets a typed ObjectLostError, never a
+        # hang (see mark_node_dead's poison pass). Lineage writes are
+        # shard-locked only: the lease submit path stops serializing
+        # on the head lock here.
+        if spec.kind in (TaskKind.NORMAL_TASK,
+                         TaskKind.ACTOR_CREATION) or \
+                (spec.kind == TaskKind.ACTOR_TASK
+                 and spec.max_retries != 0):
+            for oid in spec.return_ids:
+                self.lineage[oid.binary()] = spec
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            with self._lock:
                 key = spec.actor_id.binary()
                 self.actor_specs[key] = spec
-        if spec.kind == TaskKind.ACTOR_CREATION:
             # Gate registration is idempotent: a restart's resubmitted
             # creation spec never resets a partially-consumed budget.
             # `restarts_used` rides the spec (incremented per restart,
@@ -601,29 +657,51 @@ class ClusterHead:
                                      used=getattr(spec, "restarts_used",
                                                   0))
 
+    def _unreserve_creation(self, node_id: str, spec) -> None:
+        record = self.nodes.get(node_id)
+        if record is not None:
+            with self._lock:
+                record.unreserve(_spec_milli_of(spec))
+
     def record_inflight(self, spec, node_id: str) -> None:
         # All kinds, actor calls included: a node death must *fail* an
         # in-flight actor call (typed ActorDiedError) rather than leave
         # its caller hanging on a never-located return object.
-        with self._lock:
-            tid = spec.task_id.binary()
-            self.inflight[tid] = (node_id, spec)
-            # Pin arg objects for the task's lifetime: a driver release
-            # racing the dispatch must not free an argument out from
-            # under the executing task.
-            pinned = []
-            for dep in spec.nested_dependencies():
-                ob = dep.binary()
-                self.task_pins.setdefault(ob, set()).add(tid)
-                pinned.append(ob)
-            if pinned:
+        tid = spec.task_id.binary()
+        self.inflight[tid] = (node_id, spec)
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            # Creation reservation: charge the placement against the
+            # head's availability view NOW — the node's next report is
+            # up to a report period away, and a creation burst placed
+            # against one stale view pins a node with actors that can
+            # never start (see _NodeRecord.reserved_milli).
+            record = self.nodes.get(node_id)
+            if record is not None:
+                with self._lock:
+                    record.reserve(_spec_milli_of(spec))
+        # Pin arg objects for the task's lifetime: a driver release
+        # racing the dispatch must not free an argument out from
+        # under the executing task. Dep-free submissions (the fan-out
+        # common case) skip the head lock entirely.
+        deps = spec.nested_dependencies()
+        if deps:
+            with self._lock:
+                pinned = []
+                for dep in deps:
+                    ob = dep.binary()
+                    self.task_pins.setdefault(ob, set()).add(tid)
+                    pinned.append(ob)
                 self._task_pinned[tid] = pinned
 
     def clear_inflight(self, spec) -> None:
-        with self._lock:
-            tid = spec.task_id.binary()
-            self.inflight.pop(tid, None)
-            frees = self._unpin_task_locked(tid)
+        tid = spec.task_id.binary()
+        entry = self.inflight.pop(tid, None)
+        if entry is not None and spec.kind == TaskKind.ACTOR_CREATION:
+            self._unreserve_creation(entry[0], spec)
+        frees = []
+        if tid in self._task_pinned:  # GIL-atomic precheck (see report)
+            with self._lock:
+                frees = self._unpin_task_locked(tid)
         self._quota_release([spec])
         self._fan_out_frees(frees)
 
@@ -775,12 +853,16 @@ class ClusterHead:
             # URLs — durable disk copies — survive in
             # object_spill_urls: reconstruction restores from those
             # first.)
+            # Sharded-table scans under the head lock are fine (shard
+            # locks are leaf locks); per-shard snapshots are consistent
+            # enough — a report racing the sweep could always land
+            # wholly before or after it.
             lost = [oid for oid, loc in self.object_locations.items()
                     if loc == addr]
             lost_bytes = sum(self.object_sizes.get(oid, 0)
                              for oid in lost)
             for oid in lost:
-                del self.object_locations[oid]
+                self.object_locations.pop(oid, None)
                 self.object_sizes.pop(oid, None)
             resubmit = [spec for (nid, spec) in self.inflight.values()
                         if nid == node_id]
@@ -1178,6 +1260,17 @@ class ClusterHead:
         self.set_actor_node(spec.actor_id.binary(), node_id)
         return True
 
+    def _report_actors(self, specs, node_id: str,
+                       restarts_used=None) -> bool:
+        """Group-committed actor registration: one RPC registers a
+        whole node's actors (same record_lineage/restart-gate calls as
+        the singular form — semantics unchanged, transport O(batches))."""
+        for i, spec in enumerate(specs):
+            used = restarts_used[i] if restarts_used is not None \
+                and i < len(restarts_used) else None
+            self._report_actor(spec, node_id, restarts_used=used)
+        return True
+
     def _named_actor_register(self, name, namespace, handle) -> bool:
         self.worker.gcs.register_named_actor(name, namespace, handle)
         return True
@@ -1252,8 +1345,17 @@ class ClusterBackendMixin:
         # node (locality-aware); subsequent same-shape tasks stream to
         # the leased node over a pipelined channel with no per-task
         # scheduling or round-trip. Leases are returned after
-        # `_LEASE_IDLE_S` idle; backlog flows back on resource reports.
+        # `_LEASE_IDLE_S` idle; backlog flows back on resource reports
+        # (and, past `sched_spillback_backlog`, spills the lease to a
+        # better target). Lease state is LOCK-PARTITIONED by (job,
+        # shape) key so concurrent submitters of different shapes never
+        # serialize; `_lease_lock` remains the channel/global lock
+        # (pipes, batchers, drainer spawn). Ordering rule: shard locks
+        # before `_lease_lock`, never the reverse; whole-table
+        # operations take every shard lock in index order first.
         self._leases: Dict[tuple, list] = {}
+        n_shards = sched_state.round_up_pow2(ray_config.sched_head_shards)
+        self._lease_locks = [threading.Lock() for _ in range(n_shards)]
         self._lease_lock = threading.Lock()
         self._pipes: Dict[str, Any] = {}  # node_id -> PipelinedClient
         # node_id -> CoalescingBatcher feeding that node's pipe with
@@ -1397,20 +1499,13 @@ class ClusterBackendMixin:
         # the local backend (the hot path; _choose_node would conclude
         # the same after redundant work); doesn't fit → ride a held
         # lease without per-task head scheduling.
-        from ray_tpu._private.resources import to_milli
         from ray_tpu._private.task_spec import DefaultSchedulingStrategy
 
         if spec.kind == TaskKind.NORMAL_TASK and \
                 isinstance(spec.scheduling_strategy,
                            (DefaultSchedulingStrategy, type(None))):
-            request = to_milli(spec.resources)
-            local = self.local_backend.resources
-            pending = self.local_backend.pending_demand_milli()
-            with local._cond:
-                fits_local = all(
-                    local._available.get(k, 0) - pending.get(k, 0) >= v
-                    for k, v in request.items())
-            if fits_local:
+            request = _spec_milli_of(spec)
+            if self._local_fits_now(request):
                 # Locality override: a task whose large args live on a
                 # remote node should follow the bytes, not pull them
                 # here to follow a small spec.
@@ -1428,21 +1523,42 @@ class ClusterBackendMixin:
             if target is None:
                 from ray_tpu._private.resources import to_milli
 
-                request = to_milli(spec.resources)
+                request = _spec_milli_of(spec)
                 local_total = to_milli(dict(
                     self.local_backend.resources.total))
                 if all(local_total.get(k, 0) >= v
                        for k, v in request.items()):
-                    # A head-local task may still depend on remote objects.
-                    self._submit_local(spec)
-                    return
+                    if spec.kind != TaskKind.ACTOR_CREATION:
+                        # A head-local task may still depend on remote
+                        # objects.
+                        self._submit_local(spec)
+                        return
+                    # Lifetime placement: a creation queued on the head
+                    # behind lifetime-pinned actor CPUs NEVER constructs
+                    # (actors don't release), while a remote node whose
+                    # stale report reads full may free on its next
+                    # report cycle. Land it locally only when it can
+                    # construct NOW; otherwise queue cluster-wide and
+                    # let fresh reports (or a local release) decide.
+                    if self._submit_local_if_fits(spec, request):
+                        return
                 # Too big for the head and no remote capacity *right now*:
                 # queue cluster-wide (the reference raylet queues leases),
                 # failing fast only if no live node could ever fit it.
+                if spec.kind == TaskKind.ACTOR_CREATION:
+                    # Register the gate BEFORE queueing (mirrors the
+                    # quota-park arm): method calls submitted while the
+                    # creation waits for capacity park at the gate
+                    # (ALIVE, no location yet) and dispatch when it
+                    # lands, instead of failing "unknown actor".
+                    head.record_lineage(spec)
                 self._queue_for_cluster(spec, request)
                 return
             if spec.kind == TaskKind.ACTOR_CREATION:
                 head.set_actor_node(spec.actor_id.binary(), target.node_id)
+                if ray_config.sched_group_actor_creation and \
+                        self._send_creation_batched(target, spec):
+                    return
             try:
                 self._send(target, spec)
                 return
@@ -1543,12 +1659,37 @@ class ClusterBackendMixin:
         but its parked callers must still observe it alive again."""
         self._ensure_local_deps(spec)
         self.local_backend.submit(spec)
+        self._local_ready_edge(spec)
+
+    def _local_ready_edge(self, spec) -> None:
         if spec.kind == TaskKind.ACTOR_CREATION:
             aid = spec.actor_id.binary()
             if self.head.actor_gate.state(aid) is not None:
                 with self.head._lock:
                     self.head.actor_local.add(aid)
             self.head.actor_gate.ready(aid)
+
+    # Serializes head-local CREATION placement decisions: the fits
+    # check and the backend submit (whose pending-demand add IS the
+    # claim) must be one atomic step, or concurrent creations all pass
+    # the same free CPU and over-pack the head with lifetime-pinned
+    # actors that never construct (there is no head-local analogue of
+    # _NodeRecord.reserved_milli otherwise).
+    _local_place_lock = threading.Lock()
+
+    def _submit_local_if_fits(self, spec, request) -> bool:
+        """Atomic check-and-claim for head-local placement of work that
+        must be able to START NOW (creations; also safe for tasks).
+        Returns False when the head cannot run it immediately."""
+        reserve = spec.kind == TaskKind.ACTOR_CREATION
+        self._ensure_local_deps(spec)  # may fetch: outside the lock
+        with ClusterBackendMixin._local_place_lock:
+            if not self._local_fits_now(request,
+                                        reserve_dep_parked=reserve):
+                return False
+            self.local_backend.submit(spec)
+        self._local_ready_edge(spec)
+        return True
 
     def _park_actor_call(self, spec) -> None:
         """A call with retry budget submitted during an actor's restart
@@ -1638,11 +1779,29 @@ class ClusterBackendMixin:
 
     # -- lease-based dispatch (direct_task_transport role) ---------------
 
-    _LEASE_IDLE_S = 2.0
+    @property
+    def _LEASE_IDLE_S(self) -> float:
+        return ray_config.sched_lease_idle_s
+
     # How far a lease may over-subscribe its granted slots before the
     # manager asks the head for another lease on a different node (the
     # reference's backlog-driven extra lease requests).
     _LEASE_BACKLOG_FACTOR = 4
+
+    def _lease_lock_for(self, key: tuple):
+        return self._lease_locks[hash(key)
+                                 & (len(self._lease_locks) - 1)]
+
+    def _all_lease_locks(self):
+        """Acquire every lease shard lock in index order (whole-table
+        ops: pipe drops, drains) — deadlock-free against per-key
+        holders by the fixed ordering."""
+        import contextlib
+
+        stack = contextlib.ExitStack()
+        for lock in self._lease_locks:
+            stack.enter_context(lock)
+        return stack
 
     def _shape_key(self, spec) -> tuple:
         # Keyed by (job, resource shape): leases are per-TENANT, so
@@ -1660,7 +1819,12 @@ class ClusterBackendMixin:
         node has capacity). Caller has already ruled out local-first."""
         key = self._shape_key(spec)
         now = time.monotonic()
-        with self._lease_lock:
+        # A "hit" is a submission with NO head scheduling decision: any
+        # _grant_lease attempt (fresh, locality extra, saturated extra,
+        # spill) flips it to a miss so hit+miss == submissions and the
+        # cache-hit ratio reads true.
+        decided = False
+        with self._lease_lock_for(key):
             leases = self._leases.get(key)
             if leases:
                 # Prune leases on dead nodes and idle-expired ones
@@ -1684,8 +1848,10 @@ class ClusterBackendMixin:
                 self._retire_leases(dropped)
                 leases = live or None
             if not leases:
+                decided = True
                 lease = self._grant_lease(key, spec)
                 if lease is None:
+                    _LEASE_CACHE_MISSES.inc()
                     return False
             else:
                 # Leases are keyed by resource SHAPE; a held lease may
@@ -1697,6 +1863,7 @@ class ClusterBackendMixin:
                              if loc is not None
                              and l["node_id"] == loc.node_id]
                 if loc is not None and not preferred:
+                    decided = True
                     extra = self._grant_lease(key, spec, target=loc)
                     if extra is not None:
                         preferred = [extra]
@@ -1705,20 +1872,68 @@ class ClusterBackendMixin:
                 # Saturated: ask for one more lease on another node.
                 if lease["pipe"].in_flight >= max(
                         1, lease["slots"]) * self._LEASE_BACKLOG_FACTOR:
+                    decided = True
                     extra = self._grant_lease(
                         key, spec,
                         exclude={l["node_id"] for l in leases})
                     if extra is not None:
                         lease = extra
+            # Backlog spillback (reference: raylet spillback on deep
+            # local queues): the node's own pushed backlog signal says
+            # its queue is past the spill threshold — redirect to a
+            # lease on a better target (locality-scored grant) instead
+            # of piling deeper. The overloaded lease stays held; it
+            # re-wins once its backlog drains below the threshold.
+            record = self.head.nodes.get(lease["node_id"])
+            if record is not None and \
+                    record.backlog > ray_config.sched_spillback_backlog:
+                spill = None
+                if lease.get("spill_denied_at") != record.last_report:
+                    decided = True
+                    spill = self._grant_lease(
+                        key, spec,
+                        exclude={l["node_id"]
+                                 for l in self._leases.get(key, ())})
+                    if spill is None:
+                        # Nowhere to GRANT a spill (every candidate
+                        # leased or full): stamp the node's report so
+                        # saturated submissions stop re-paying the
+                        # O(nodes) grant scan until a fresh resource
+                        # report changes the picture.
+                        lease["spill_denied_at"] = record.last_report
+                if spill is None:
+                    # Fall back to an already-held lease on a node
+                    # whose backlog is below the threshold:
+                    # min(in_flight) can keep picking the overloaded
+                    # lease (a deep node queue acks frames fast, so
+                    # its in_flight stays low), and without this the
+                    # flood keeps piling onto it while a healthy
+                    # lease idles. O(held leases), so it runs even in
+                    # the grant-scan backoff window.
+                    thresh = ray_config.sched_spillback_backlog
+                    for alt in self._leases.get(key, ()):
+                        if alt is lease:
+                            continue
+                        alt_rec = self.head.nodes.get(alt["node_id"])
+                        if alt_rec is None or not alt_rec.alive or \
+                                alt_rec.backlog > thresh:
+                            continue
+                        if spill is None or alt["pipe"].in_flight < \
+                                spill["pipe"].in_flight:
+                            spill = alt
+                if spill is not None:
+                    _SPILLBACKS.inc()
+                    lease = spill
             lease["last_used"] = now
+            (_LEASE_CACHE_MISSES if decided else _LEASE_CACHE_HITS).inc()
         return self._lease_send(lease, spec)
 
     def _grant_lease(self, key, spec, exclude=(),
                      target=None) -> Optional[dict]:
         """One head scheduling decision for a task SHAPE (not a task):
         locality-aware node choice + slot count from the pushed view.
-        Caller holds _lease_lock; a caller that already computed the
-        locality target passes it to skip the re-scan."""
+        Caller holds the key's lease shard lock; a caller that already
+        computed the locality target passes it to skip the re-scan."""
         from ray_tpu._private.resources import to_milli
 
         if target is None:
@@ -1739,18 +1954,26 @@ class ClusterBackendMixin:
             slots = max(1, min(
                 int(target.available.get(k, 0) * 1000 // v)
                 for k, v in request.items() if v > 0))
-        pipe = self._pipes.get(target.node_id)
-        if pipe is None:
-            from ray_tpu._private.rpc import PipelinedClient
-
-            pipe = PipelinedClient(target.address,
-                                   on_error=self._pipe_error)
-            self._pipes[target.node_id] = pipe
+        pipe = self._node_pipe(target)
         lease = {"node_id": target.node_id, "pipe": pipe,
                  "slots": slots, "last_used": time.monotonic(),
                  "address": target.address, "job": job}
         self._leases.setdefault(key, []).append(lease)
         return lease
+
+    def _node_pipe(self, node: "_NodeRecord"):
+        """The node's pipelined channel, created on first use. Channel
+        registry mutations are under the global channel lock (shard
+        lock -> _lease_lock is the one legal nesting order)."""
+        with self._lease_lock:
+            pipe = self._pipes.get(node.node_id)
+            if pipe is None:
+                from ray_tpu._private.rpc import PipelinedClient
+
+                pipe = PipelinedClient(node.address,
+                                       on_error=self._pipe_error)
+                self._pipes[node.node_id] = pipe
+            return pipe
 
     def _retire_leases(self, leases) -> None:
         """Release the lease-quota charge of every retired lease (any
@@ -1901,6 +2124,41 @@ class ClusterBackendMixin:
         self.head.clear_inflight(spec)
         return False
 
+    def _send_creation_batched(self, node: "_NodeRecord", spec) -> bool:
+        """Group-committed actor creation: the creation rides the
+        node's coalescing submit_batch channel — one frame commits a
+        GROUP of creations (plus any leased tasks already queued for
+        that node, order preserved) instead of one synchronous RPC per
+        actor. Bookkeeping is byte-identical to _send — lineage +
+        in-flight recorded BEFORE the wire — so a node death re-drives
+        the creation through the resubmit loop's inflight_creations
+        path (never _restart_actor: no restart budget burned for a
+        never-constructed actor) and ActorRestartGate semantics are
+        unchanged. Returns False to fall back to the synchronous path
+        (channel unavailable/closed)."""
+        try:
+            pipe = self._node_pipe(node)
+        except Exception:
+            return False
+        spec = self._promote_large_args(spec)
+        self._publish_local_args(node, spec)
+        self.head.record_lineage(spec)
+        self.head.record_inflight(spec, node.node_id)
+        self.quota_ledger.note_dequeued(spec)
+        # Pseudo-lease tag: the batch error paths only read node_id
+        # (and retire via identity against _leases, where this never
+        # appears — creations hold no lease-quota charge).
+        tag = {"node_id": node.node_id, "pipe": pipe, "job": None}
+        with self._submit_lock_for(node.node_id):
+            wire_spec = self._strip_exported_func(spec, node)
+            try:
+                self._batcher_for(node.node_id, pipe).add(
+                    (wire_spec, [], spec, tag))
+                return True
+            except ConnectionError:
+                self.head.clear_inflight(spec)
+                return False
+
     def _submit_lock_for(self, node_id: str):
         lock = self._submit_locks.get(node_id)
         if lock is None:
@@ -1934,7 +2192,10 @@ class ClusterBackendMixin:
 
         if spec.kind == TaskKind.NORMAL_TASK and spec.template_id \
                 and spec.func_id:
-            tpl = get_template(spec.template_id)
+            # A compact header carries its template strongly — immune
+            # to intern-cache eviction; full specs re-resolve by id.
+            tpl = getattr(spec, "tpl", None) or \
+                get_template(spec.template_id)
             if tpl is not None:
                 templates = []
                 if spec.template_id not in record.known_templates:
@@ -2017,23 +2278,32 @@ class ClusterBackendMixin:
         for t in (self._quota_drainer, self._park_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=1.0)
+        with self._all_lease_locks():
+            self._retire_leases(
+                [l for ls in self._leases.values() for l in ls])
+            self._leases.clear()
         with self._lease_lock:
             batchers = list(self._batchers.values())
             pipes = list(self._pipes.values())
             self._batchers.clear()
             self._pipes.clear()
-            self._retire_leases(
-                [l for ls in self._leases.values() for l in ls])
-            self._leases.clear()
         for batcher in batchers:
             batcher.close(drain_timeout=timeout)
         for pipe in pipes:
             pipe.close(flush_timeout=timeout)
 
     def _drop_lease_pipe(self, node_id: str, lease) -> None:
+        # Pop the channel FIRST: a concurrent _grant_lease racing this
+        # drop then mints a fresh pipe (and batcher) via _node_pipe
+        # instead of binding a new lease to the broken one about to be
+        # closed — those sends would fail and burn the spec's bounded
+        # lease reroutes on a node that may be healthy. A lease granted
+        # in the window is swept by the retirement pass below and
+        # simply re-grants on its next use.
         with self._lease_lock:
             pipe = self._pipes.pop(node_id, None)
             batcher = self._batchers.pop(node_id, None)
+        with self._all_lease_locks():
             retired = []
             for ls in self._leases.values():
                 if lease is None:
@@ -2066,7 +2336,7 @@ class ClusterBackendMixin:
             retries = getattr(spec, "_lease_reroutes", 0)
             if retries < 3:
                 spec._lease_reroutes = retries + 1
-                with self._lease_lock:
+                with self._all_lease_locks():
                     retired = []
                     for ls in self._leases.values():
                         if lease in ls:
@@ -2087,8 +2357,13 @@ class ClusterBackendMixin:
         # once whether or not the original arrived. If the node is
         # truly dead, the inflight table resubmits via mark_node_dead.
         record = self.head.nodes.get(lease["node_id"])
+        # Pop the broken pipe BEFORE retiring the lease (same order as
+        # _drop_lease_pipe): a _grant_lease racing this handler must
+        # mint a fresh pipe, not bind a new lease to the dead one and
+        # burn the spec's bounded reroutes on a healthy node.
         with self._lease_lock:
             self._pipes.pop(lease["node_id"], None)
+        with self._all_lease_locks():
             retired = []
             for ls in self._leases.values():
                 if lease in ls:
@@ -2366,9 +2641,15 @@ class ClusterBackendMixin:
 
         def loop():
             try:
+                local_total = to_milli(dict(
+                    self.local_backend.resources.total))
+                local_possible = all(local_total.get(k, 0) >= v
+                                     for k, v in request.items())
                 while True:
-                    feasible = False
+                    feasible = local_possible
                     for record in self.head.nodes.values():
+                        if feasible:
+                            break
                         if not record.alive:
                             continue
                         total = to_milli(dict(record.resources))
@@ -2405,6 +2686,16 @@ class ClusterBackendMixin:
                             self.head.mark_node_dead(
                                 target.node_id,
                                 reason=f"unreachable: {e}")
+                    elif local_possible and \
+                            self._submit_local_if_fits(spec, request):
+                        # _choose_node returns None both for "the head
+                        # fits it now" and "nothing remote fits" —
+                        # dispatch locally only in the first case (a
+                        # queued CREATION must construct immediately,
+                        # never park behind lifetime-pinned CPUs; the
+                        # atomic check-and-claim stops concurrent queue
+                        # threads from over-packing one freed CPU).
+                        return
                     time.sleep(0.1)
             finally:
                 self.head.pending_demands.pop(tid, None)
@@ -2412,19 +2703,33 @@ class ClusterBackendMixin:
         threading.Thread(target=loop, daemon=True,
                          name="ray_tpu-cluster-queue").start()
 
+    def _local_fits_now(self, request,
+                        reserve_dep_parked: bool = False) -> bool:
+        """Run/construct-NOW feasibility on the head's local backend:
+        available minus already-queued demand covers the milli request.
+        ``reserve_dep_parked`` additionally reserves for dep-parked
+        work — lifetime-pinned CREATIONS must see it (a dep-blocked
+        burst's demand is invisible to the backlog counter until the
+        deps resolve, by which time over-landed creations park behind
+        pinned CPUs forever); plain tasks queue and release, so they
+        keep the cheaper check."""
+        local = self.local_backend.resources
+        pending = self.local_backend.pending_demand_milli()
+        dep_parked = (self.local_backend.dep_parked_demand_milli()
+                      if reserve_dep_parked else {})
+        with local._cond:
+            return all(
+                local._available.get(k, 0) - pending.get(k, 0)
+                - dep_parked.get(k, 0) >= v
+                for k, v in request.items())
+
     def _choose_node(self, spec, exclude=()) -> Optional[_NodeRecord]:
         """Local-first pack; spill to remote capacity when local can't run
         it now (reference hybrid policy shape)."""
-        from ray_tpu._private.resources import to_milli
-
-        request = to_milli(spec.resources)
-        local = self.local_backend.resources
-        pending = self.local_backend.pending_demand_milli()
-        with local._cond:
-            local_fits_now = all(
-                local._available.get(k, 0) - pending.get(k, 0) >= v
-                for k, v in request.items())
-        if local_fits_now:
+        request = _spec_milli_of(spec)
+        if self._local_fits_now(
+                request,
+                reserve_dep_parked=spec.kind == TaskKind.ACTOR_CREATION):
             return None
         # Pushed resource view (ray_syncer role): no per-submit pings.
         # Staleness is fine — the receiving node queues anything that no
@@ -2434,7 +2739,8 @@ class ClusterBackendMixin:
         best, best_avail = None, -1.0
         for node in candidates:
             avail = node.available
-            if all(avail.get(k, 0) * 1000 >= v
+            reserved = node.reserved_milli
+            if all(avail.get(k, 0) * 1000 - reserved.get(k, 0) >= v
                    for k, v in request.items()):
                 # Reported backlog discounts a node that looks free but
                 # has a deep queue (lease pipelining fills queues ahead
@@ -2547,6 +2853,14 @@ class ClusterBackendMixin:
         specs travel WITHOUT the function body (often the bulk of a
         small task's wire bytes) and the node re-resolves from its local
         cache, falling back to the head KV."""
+        from ray_tpu._private.task_spec import QueuedTaskHeader
+
+        if type(spec) is QueuedTaskHeader:
+            # Full-spec shipping boundary: materialize the header for
+            # the wire WITHOUT moving its quota tokens — the head keeps
+            # the header in its lineage/in-flight tables, and releases
+            # must find the charge there, not on the wire copy.
+            spec = spec.materialize(transfer_tokens=False)
         fid = getattr(spec, "func_id", None)
         if fid is None or spec.kind == TaskKind.ACTOR_TASK:
             return spec

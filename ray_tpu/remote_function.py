@@ -10,6 +10,7 @@ from __future__ import annotations
 import functools
 
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import ray_config
 from ray_tpu._private.ids import TaskID
 from ray_tpu._private.resources import normalize_request
 from ray_tpu._private.task_spec import (
@@ -19,6 +20,7 @@ from ray_tpu._private.task_spec import (
     job_id_for_submit,
     trace_parent_from,
     DefaultSchedulingStrategy,
+    QueuedTaskHeader,
     SchedulingStrategy,
     TaskKind,
 )
@@ -131,13 +133,33 @@ class RemoteFunction:
             tpl = self._template = self._build_template()
         ctx = w.task_context.current()
         ctx_spec = ctx["task_spec"] if ctx else None
-        spec = tpl.make_spec(
-            TaskID.from_random(), args, kwargs,
-            depth=(ctx_spec.depth + 1) if ctx else 0,
-            trace_parent=(trace_parent_from(ctx_spec)
-                          if ctx else get_ambient_trace_parent()),
-            job_id=job_id_for_submit(ctx_spec),
-        )
+        use_header = ray_config.sched_compact_queue and \
+            type(tpl.scheduling_strategy) is \
+            DefaultSchedulingStrategy and \
+            getattr(w, "supports_compact_submit", False)
+        if use_header:
+            # Compact queued representation: submit a header (template
+            # reference + per-call fields) instead of a full TaskSpec —
+            # the scheduler materializes the spec only at dispatch, so
+            # a deep backlog holds header bytes, not spec bytes. Minting
+            # a header plus the proto-based materialization is CHEAPER
+            # than one make_spec (perf_bench --ab-sched), so immediate
+            # dispatches take this path too.
+            spec = QueuedTaskHeader(
+                tpl, TaskID.from_random(), args, kwargs,
+                depth=(ctx_spec.depth + 1) if ctx else 0,
+                trace_parent=(trace_parent_from(ctx_spec)
+                              if ctx else get_ambient_trace_parent()),
+                job_id=job_id_for_submit(ctx_spec),
+            )
+        else:
+            spec = tpl.make_spec(
+                TaskID.from_random(), args, kwargs,
+                depth=(ctx_spec.depth + 1) if ctx else 0,
+                trace_parent=(trace_parent_from(ctx_spec)
+                              if ctx else get_ambient_trace_parent()),
+                job_id=job_id_for_submit(ctx_spec),
+            )
         refs = w.submit(spec)
         num_returns = tpl.num_returns
         if num_returns == 0:

@@ -21,6 +21,39 @@ def cluster():
     c.shutdown()
 
 
+def test_actor_burst_after_flood_constructs_everywhere(cluster):
+    """Creation burst right after a saturating flood: the worker nodes'
+    pushed availability is stale (reads full) at burst time, so the
+    head must NOT park overflow creations in its own backlog behind
+    lifetime-pinned actor CPUs — they queue cluster-wide and land on a
+    node once its fresh report shows the freed capacity (regression:
+    2 of 12 creations hung forever on the head while a node idled)."""
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow():
+        time.sleep(0.15)
+        return os.getpid()
+
+    ray_tpu.get([slow.remote() for _ in range(60)], timeout=180)
+
+    @ray_tpu.remote(num_cpus=0.4)
+    class A:
+        def __init__(self):
+            self.pid = os.getpid()
+
+        def ping(self):
+            return self.pid
+
+    # 12 x 0.4 CPU = 4.8 over 6 total: every creation must construct
+    # and answer, wherever it lands.
+    actors = [A.remote() for _ in range(12)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=120)
+    assert len(pids) == 12
+    assert len(set(pids)) >= 2, "burst packed onto one process"
+
+
 def test_remote_node_executes_spillover(cluster):
     cluster.add_node(num_cpus=4)
 
@@ -115,3 +148,35 @@ def test_node_removal(cluster):
         return 42
 
     assert ray_tpu.get(f.remote(), timeout=30) == 42
+
+
+def test_creation_burst_respects_capacity_across_nodes(cluster):
+    """A burst of actor creations placed within ONE resource-report
+    period must spread by true capacity, not pile onto the first node
+    whose pushed view still looks free: creations pin CPUs for life,
+    so over-placement queues actors that can never start while other
+    nodes idle (head-side reservation, _NodeRecord.reserved_milli)."""
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Holder:
+        def pid(self):
+            return os.getpid()
+
+    # 2 (head) + 4 + 4 CPUs: eight 1-CPU actors fit exactly — but only
+    # if no node is over-committed by the burst.
+    actors = [Holder.remote() for _ in range(8)]
+    refs = [a.pid.remote() for a in actors]
+    ready, pending = ray_tpu.wait(refs, num_returns=len(refs),
+                                  timeout=90)
+    assert not pending, (
+        f"{len(pending)} creations never constructed — burst "
+        f"over-placement regressed")
+    pids = ray_tpu.get(refs, timeout=30)
+    assert len(set(pids)) >= 3  # all three processes actually used
+    # Reservations are transient: all released once constructed.
+    head = ray_tpu._private.worker.global_worker().backend.head
+    assert all(not rec.reserved_milli for rec in head.nodes.values())
+    for a in actors:
+        ray_tpu.kill(a)

@@ -60,7 +60,8 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     assert set(by_name) == {"router_cap", "gcs_durability",
                             "pipelined_close", "spill_race",
                             "lineage_reconstruction", "actor_restart",
-                            "head_crash_recovery", "quota_admission"}
+                            "head_crash_recovery", "quota_admission",
+                            "dep_sweep"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"
@@ -83,6 +84,10 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     # drained — a shrunk count means the racing submitters (or the
     # queue race) fell out of the scenario.
     assert by_name["quota_admission"]["executions"] >= 5000, by_name
+    # Dep-park exactly-once handoff (ROADMAP FT gap d): the two-ready-
+    # vs-sweep space drained — a shrunk count means the multi-dep item
+    # (or the sweeper) fell out of the scenario.
+    assert by_name["dep_sweep"]["executions"] >= 1000, by_name
 
 
 def test_raymc_harness_clean_under_raysan_sanitizers(tmp_path):

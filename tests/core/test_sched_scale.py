@@ -1,0 +1,753 @@
+"""Scheduler-scale machinery: compact queued headers, the dep-park
+table's exactly-once handoff, lock-partitioned head tables, pooled
+actor serving, and the WFQ x compact-queue contract.
+
+The ordering-sensitive pieces (FIFO byte-identity with enforcement
+off, charge tokens riding a quota-parked header exactly once) pin the
+ISSUE 13 acceptance criteria; the dep_sweep raymc scenario proves the
+DepTable claim protocol exhaustively — these tests cover the product
+wiring around it.
+"""
+
+import queue as _queue
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.sched_state import (DepTable, PendingCounter,
+                                          ShardedTable)
+from ray_tpu._private.task_spec import (QueuedTaskHeader,
+                                        DefaultSchedulingStrategy,
+                                        TaskKind, intern_template)
+from ray_tpu._private.ids import TaskID
+
+
+def _header(job_id="", args=(), n_cpus=0.5):
+    tpl = intern_template(
+        kind=TaskKind.NORMAL_TASK, func=lambda: None, name="t",
+        num_returns=1, resources={"CPU": n_cpus},
+        scheduling_strategy=DefaultSchedulingStrategy())
+    h = QueuedTaskHeader(tpl, TaskID.from_random(), tuple(args), {},
+                         job_id=job_id)
+    h.assign_return_ids()
+    return h
+
+
+# -- QueuedTaskHeader --------------------------------------------------------
+
+
+def test_header_materializes_to_equivalent_spec():
+    h = _header(job_id="jobX", args=(1, 2))
+    h.max_retries = 7
+    h.attempt = 2
+    spec = h.materialize()
+    assert spec.task_id == h.task_id
+    assert spec.args == (1, 2)
+    assert spec.job_id == "jobX"
+    assert spec.max_retries == 7 and spec.attempt == 2
+    assert spec.return_ids == h.return_ids
+    assert spec.template_id == h.template_id
+    assert spec.resources == h.resources
+
+
+def test_header_quota_tokens_transfer_exactly_once():
+    from ray_tpu._private.tenancy import QuotaLedger
+
+    old_enf, old_q = ray_config.tenancy_enforcement, ray_config.job_quotas
+    ray_config.tenancy_enforcement = True
+    ray_config.job_quotas = "jobQ=cpus:2,queued:10"
+    try:
+        ledger = QuotaLedger()
+        h = _header(job_id="jobQ", n_cpus=1.0)
+        assert ledger.note_queued(h) is None
+        assert ledger.try_acquire_cpu(h)
+        assert ledger.usage("jobQ")["cpu_milli"] == 1000
+        assert ledger.usage("jobQ")["queued"] == 1
+        spec = h.materialize()  # tokens MOVE to the spec
+        assert getattr(h, "_quota_cpu", None) is None
+        ledger.note_dequeued(spec)
+        ledger.release_cpu(spec)
+        assert ledger.usage("jobQ")["cpu_milli"] == 0
+        assert ledger.usage("jobQ")["queued"] == 0
+        # Idempotent: a second release via either form is a no-op.
+        ledger.release_cpu(spec)
+        ledger.release_cpu(h)
+        assert ledger.usage("jobQ")["cpu_milli"] == 0
+    finally:
+        ray_config.tenancy_enforcement = old_enf
+        ray_config.job_quotas = old_q
+
+
+def test_quota_parked_header_materializes_on_drain():
+    """A header parked at its job's CPU quota is drained by
+    take_dispatchable with the charge token riding it — and the token
+    survives materialization exactly once (the ISSUE's WFQ x compact
+    checklist item)."""
+    from ray_tpu._private.tenancy import QuotaLedger
+
+    old_enf, old_q = ray_config.tenancy_enforcement, ray_config.job_quotas
+    ray_config.tenancy_enforcement = True
+    ray_config.job_quotas = "jobP=cpus:1"
+    try:
+        ledger = QuotaLedger()
+        first = _header(job_id="jobP", n_cpus=1.0)
+        assert ledger.note_queued(first) is None
+        assert ledger.try_acquire_cpu(first)
+        parked = _header(job_id="jobP", n_cpus=1.0)
+        assert ledger.note_queued(parked) is None
+        assert not ledger.try_acquire_cpu(parked)
+        ledger.park(parked)
+        assert ledger.take_dispatchable() == []  # job still at cap
+        ledger.release_cpu(first.materialize())  # charge rode the spec
+        out = ledger.take_dispatchable()
+        assert out == [parked]
+        assert getattr(parked, "_quota_cpu", None) is not None
+        spec = parked.materialize()
+        assert getattr(parked, "_quota_cpu", None) is None
+        ledger.release_cpu(spec)
+        assert ledger.usage("jobP")["cpu_milli"] == 0
+    finally:
+        ray_config.tenancy_enforcement = old_enf
+        ray_config.job_quotas = old_q
+
+
+# -- WFQ x compact queue -----------------------------------------------------
+
+
+def test_fair_queue_fifo_byte_identical_with_enforcement_off():
+    """Enforcement off: FairTaskQueue over mixed headers/specs pops in
+    EXACTLY the put order — indistinguishable from the queue.Queue it
+    replaced (acceptance: enforcement-off scheduling order provably
+    unchanged)."""
+    from ray_tpu._private.tenancy import FairTaskQueue
+
+    assert not ray_config.tenancy_enforcement
+    fq = FairTaskQueue()
+    baseline = _queue.Queue()
+    items = []
+    for i in range(200):
+        item = _header(job_id=f"job{i % 7}") if i % 3 \
+            else SimpleNamespace(job_id=f"job{i % 5}", i=i)
+        items.append(item)
+        fq.put(item)
+        baseline.put(item)
+    popped = [fq.get_nowait() for _ in range(len(items))]
+    expected = [baseline.get_nowait() for _ in range(len(items))]
+    assert [id(x) for x in popped] == [id(x) for x in expected]
+    with pytest.raises(_queue.Empty):
+        fq.get_nowait()
+
+
+def test_fair_queue_wfq_bounded_with_headers():
+    """Enforcement on: header items class by job_id and the WFQ bypass
+    bound holds (a backlogged class is never starved past the
+    virtual-time law)."""
+    from ray_tpu._private.tenancy import FairTaskQueue
+
+    fq = FairTaskQueue(weights={"a": 1.0, "b": 1.0})
+    for _ in range(10):
+        fq.put(_header(job_id="a"))
+    for _ in range(10):
+        fq.put(_header(job_id="b"))
+    order = [fq.get_nowait().job_id for _ in range(20)]
+    assert sorted(order) == ["a"] * 10 + ["b"] * 10
+    # Equal weights: serves alternate once both are backlogged.
+    assert fq.max_bypass <= 2
+    assert order != ["a"] * 10 + ["b"] * 10  # not plain FIFO
+
+
+# -- DepTable ----------------------------------------------------------------
+
+
+def test_dep_table_ready_and_sweep_exactly_once():
+    t = DepTable()
+    a, b = SimpleNamespace(name="A"), SimpleNamespace(name="B")
+    t.park(b"A", a, ["d1"])
+    t.park(b"B", b, ["d1", "d2"])
+    assert t.waiting_count() == 2
+    ready = t.dep_ready("d1")
+    assert ready == [a]  # B still waits on d2
+    swept = t.sweep(lambda item: True)
+    assert swept == [b]
+    assert t.waiting_count() == 0
+    assert t.parked_entries() == 0  # d2's stale entry purged
+    assert t.dep_ready("d2") == []  # loser of the race gets nothing
+
+
+def test_dep_table_sweep_is_selective():
+    t = DepTable()
+    mine = SimpleNamespace(actor="x")
+    other = SimpleNamespace(actor="y")
+    t.park(b"m", mine, ["d"])
+    t.park(b"o", other, ["d"])
+    assert t.sweep(lambda item: item.actor == "x") == [mine]
+    assert t.dep_ready("d") == [other]
+
+
+def test_dep_table_concurrent_ready_vs_sweep_smoke():
+    """Thread-level smoke over the exactly-once claim (the raymc
+    dep_sweep scenario explores this space exhaustively)."""
+    for _ in range(50):
+        t = DepTable()
+        items = [SimpleNamespace(i=i) for i in range(6)]
+        for i, item in enumerate(items):
+            t.park(str(i).encode(), item, ["d1", "d2"])
+        got: list = []
+        lock = threading.Lock()
+
+        def claim(result):
+            with lock:
+                got.extend(result)
+
+        threads = [
+            threading.Thread(
+                target=lambda: claim(t.dep_ready("d1"))),
+            threading.Thread(
+                target=lambda: claim(t.dep_ready("d2"))),
+            threading.Thread(
+                target=lambda: claim(t.sweep(lambda item: True))),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(5)
+        assert len(got) == len(set(id(x) for x in got))
+        assert len(got) + t.waiting_count() == len(items)
+        if t.waiting_count() == 0:
+            assert t.parked_entries() == 0
+
+
+# -- ShardedTable / PendingCounter -------------------------------------------
+
+
+def test_sharded_table_basics():
+    t = ShardedTable(8)
+    t[b"k1"] = ("addr", 1)
+    assert b"k1" in t and t[b"k1"] == ("addr", 1)
+    assert t.get(b"nope") is None
+    assert t.pop(b"k1") == ("addr", 1)
+    assert t.pop(b"k1", "dflt") == "dflt"
+    for i in range(100):
+        t[f"k{i}".encode()] = i
+    assert len(t) == 100
+    assert sorted(v for _, v in t.items()) == list(range(100))
+    assert sorted(t.values()) == list(range(100))
+
+
+def test_sharded_table_concurrent_smoke():
+    t = ShardedTable(4)
+
+    def writer(base):
+        for i in range(500):
+            key = f"{base}-{i}".encode()
+            t[key] = i
+            assert t.pop(key) == i
+
+    threads = [threading.Thread(target=writer, args=(b,))
+               for b in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10)
+    assert len(t) == 0
+
+
+def test_pending_counter_parity():
+    c = PendingCounter()
+    c.add({"CPU": 500})
+    c.add({"CPU": 500, "TPU": 1000})
+    assert c.count() == 2 and c.count_approx == 2
+    assert c.demand_milli() == {"CPU": 1000, "TPU": 1000}
+    c.remove({"CPU": 500, "TPU": 1000})
+    c.remove({"CPU": 500})
+    assert c.count() == 0 and c.demand_milli() == {}
+
+
+# -- product wiring (runtime) ------------------------------------------------
+
+
+@pytest.fixture
+def fresh_runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu._private.worker.global_worker()
+    ray_tpu.shutdown()
+
+
+def test_backlogged_submissions_queue_as_headers(fresh_runtime):
+    """Dep-blocked submissions park header-only; queue_depths /
+    pending_demand_milli / quota queued counts see them exactly like
+    full specs (the under-count checklist item)."""
+    w = fresh_runtime
+    backend = w.backend
+    gate = threading.Event()
+
+    @ray_tpu.remote(num_cpus=1)
+    def blocker():
+        gate.wait(30)
+        return 0
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def after(x, i):
+        return i
+
+    dep = blocker.remote()
+    refs = [after.remote(dep, i) for i in range(40)]
+    deadline = time.monotonic() + 10
+    while backend._deps.waiting_count() < 40 and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    depths = backend.queue_depths()
+    assert depths["waiting_for_deps"] == 40
+    # The parked items really are compact headers, not full specs.
+    with backend._deps._lock:
+        parked_types = {type(item).__name__
+                        for entries in backend._deps._by_dep.values()
+                        for _k, item in entries}
+    assert parked_types == {"QueuedTaskHeader"}
+    gate.set()
+    assert ray_tpu.get(refs, timeout=60) == list(range(40))
+    assert backend._deps.waiting_count() == 0
+    # Once runnable-but-unfit work exists, demand accounting must see
+    # header work identically (0.5 CPU each, 4 CPUs total).
+    gate2 = threading.Event()
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        gate2.wait(30)
+        return 1
+
+    holders = [hold.remote() for _ in range(4)]
+    more = [after.remote(0, i) for i in range(10)]
+    deadline = time.monotonic() + 10
+    while backend.backlog_count() < 10 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert backend.pending_demand_milli().get("CPU", 0) == 5000
+    gate2.set()
+    assert ray_tpu.get(more, timeout=60) == list(range(10))
+    assert ray_tpu.get(holders, timeout=60) == [1] * 4
+
+
+def test_compact_off_matches_on_results(fresh_runtime):
+    @ray_tpu.remote(num_cpus=0.1)
+    def sq(x):
+        return x * x
+
+    old = ray_config.sched_compact_queue
+    try:
+        ray_config.sched_compact_queue = False
+        off = ray_tpu.get([sq.remote(i) for i in range(50)], timeout=60)
+        ray_config.sched_compact_queue = True
+        on = ray_tpu.get([sq.remote(i) for i in range(50)], timeout=60)
+        assert off == on == [i * i for i in range(50)]
+    finally:
+        ray_config.sched_compact_queue = old
+
+
+def test_cancel_and_retry_with_compact_queue(fresh_runtime):
+    w = fresh_runtime
+    gate = threading.Event()
+
+    @ray_tpu.remote(num_cpus=4)
+    def hold():
+        gate.wait(30)
+        return 1
+
+    attempts = []
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, retry_exceptions=True)
+    def flaky(dep):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    holder = hold.remote()
+    # Queue a task behind the resource hold, then cancel it while
+    # it is still header-queued.
+    queued = ray_tpu.remote(num_cpus=2)(lambda: 9).remote()
+    ray_tpu.cancel(queued)
+    gate.set()
+    assert ray_tpu.get(holder, timeout=30) == 1
+    from ray_tpu.exceptions import TaskCancelledError
+
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    assert ray_tpu.get(flaky.remote(holder), timeout=60) == "ok"
+    assert len(attempts) == 3
+    assert w.backend.backlog_count() == 0
+
+
+def test_pool_actors_have_no_dedicated_threads(fresh_runtime):
+    @ray_tpu.remote(num_cpus=0.01)
+    class P:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote(num_cpus=0.01, max_concurrency=2)
+    class Multi:
+        def ping(self):
+            return 1
+
+    actors = [P.remote() for _ in range(20)]
+    assert ray_tpu.get([a.bump.remote() for a in actors],
+                       timeout=60) == [1] * 20
+    multi = Multi.remote()
+    assert ray_tpu.get(multi.ping.remote(), timeout=30) == 1
+    backend = ray_tpu._private.worker.global_worker().backend
+    pool_actors = [a for a in backend._actors.values() if a.pool_mode]
+    dedicated = [a for a in backend._actors.values() if not a.pool_mode]
+    assert len(pool_actors) == 20 and not any(
+        a._threads for a in pool_actors)
+    # max_concurrency>1 keeps the dedicated-thread path. (Poll: start()
+    # appends to _threads after the first thread may already serve.)
+    assert len(dedicated) == 1
+    deadline = time.monotonic() + 5
+    while not dedicated[0]._threads and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert dedicated[0]._threads
+    # Kill fails pending work and frees the mailbox.
+    ray_tpu.kill(actors[0])
+    from ray_tpu.exceptions import ActorDiedError
+
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(actors[0].bump.remote(), timeout=30)
+
+
+def test_pool_actor_ordering_under_burst(fresh_runtime):
+    @ray_tpu.remote(num_cpus=0.01)
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return i
+
+        def read(self):
+            return list(self.log)
+
+    s = Seq.remote()
+    refs = [s.add.remote(i) for i in range(300)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(300))
+    assert ray_tpu.get(s.read.remote(), timeout=30) == list(range(300))
+
+
+def test_exec_submit_reenqueue_accounting(fresh_runtime):
+    """_exec_submit(spawn=False) must report whether the enqueue was
+    accounted: at idle==0 the drain continuation rides the CALLING
+    thread, so the caller skips its post-serve idle credit (regression:
+    the unaccounted item plus the unconditional +1 minted a phantom
+    idle credit per re-enqueued drain slice, inflating _exec_idle past
+    the real thread count and defeating the fast-dispatch gate)."""
+    w = fresh_runtime
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+    backend = w.backend
+    actor = next(x for x in backend._actors.values() if x.pool_mode)
+    # Quiesce: the executor that served ping parks with an idle credit.
+    deadline = time.monotonic() + 5
+    while backend._exec_idle == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with backend._exec_lock:
+        assert backend._exec_idle >= 1
+    # An idle promise is available: the enqueue consumes it (accounted).
+    assert backend._exec_submit(("actor", actor), spawn=False) is True
+    # The parked thread no-op-drains the stale activation and restores
+    # its credit; wait so the forced-idle==0 probe below is exact.
+    deadline = time.monotonic() + 5
+    while backend._exec_idle == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # idle==0 (forced): nothing is promised to the item — unaccounted,
+    # the caller must skip its own +1.
+    with backend._exec_lock:
+        saved = backend._exec_idle
+        backend._exec_idle = 0
+    try:
+        assert backend._exec_submit(("actor", actor),
+                                    spawn=False) is False
+    finally:
+        with backend._exec_lock:
+            backend._exec_idle += saved
+
+
+def test_pool_actor_restart_keeps_mailbox(fresh_runtime):
+    @ray_tpu.remote(num_cpus=0.01, max_restarts=1)
+    class R:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    r = R.remote()
+    assert ray_tpu.get(r.bump.remote(), timeout=30) == 1
+    ray_tpu.kill(r, no_restart=False)
+    # Replacement re-runs the constructor; counts restart from 1.
+    assert ray_tpu.get(r.bump.remote(), timeout=30) == 1
+
+
+def test_sched_metrics_registered(fresh_runtime):
+    """The new ray_tpu_sched_* series exist and count (folded into
+    runtime metrics via perf_stats like every fast-path stat)."""
+    from ray_tpu._private import perf_stats
+
+    gate = threading.Event()
+
+    @ray_tpu.remote(num_cpus=4)
+    def holdall():
+        gate.wait(30)
+        return 1
+
+    @ray_tpu.remote(num_cpus=1)
+    def queued(i):
+        return i
+
+    base = perf_stats.counter("sched_headers_queued").value
+    h = holdall.remote()
+    # >= 64 headers so the 1/32-sampled materialization distribution
+    # is guaranteed at least one recorded sample.
+    refs = [queued.remote(i) for i in range(80)]
+    gate.set()
+    ray_tpu.get(refs + [h], timeout=60)
+    assert perf_stats.counter("sched_headers_queued").value > base
+    assert perf_stats.counter("sched_queued_header_bytes").value > 0
+    assert perf_stats.latency("sched_materialize_seconds").total > 0
+    # Lease-cache counters exist (counted on the cluster path).
+    perf_stats.counter("sched_lease_cache_hit")
+    perf_stats.counter("sched_lease_cache_miss")
+    perf_stats.counter("sched_spillbacks")
+
+
+def test_spillback_falls_back_to_calm_held_lease():
+    """When the spill grant fails (every node already leased or full)
+    but a held lease sits on a below-threshold node, submissions must
+    redirect there instead of piling onto the over-backlog node
+    (min(in_flight) keeps picking the overloaded lease because a deep
+    node queue acks frames fast). Also pins the grant-scan backoff: a
+    denied spill is stamped against the node's report, and the stamped
+    window skips the O(nodes) grant scan but still takes the cheap
+    fallback."""
+    from ray_tpu._private import perf_stats
+    from ray_tpu._private.task_spec import TaskSpec  # noqa: F401
+    from ray_tpu.cluster_utils import (ClusterBackendMixin, ClusterHead,
+                                       _NodeRecord)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    w = ray_tpu._private.worker.global_worker()
+    head = ClusterHead(w, start_server=False)
+    for nid in ("hot", "calm"):
+        head.nodes[nid] = _NodeRecord(nid, ("127.0.0.1", 0),
+                                      {"CPU": 4.0})
+    head.nodes["hot"].backlog = ray_config.sched_spillback_backlog + 50
+
+    mixin = ClusterBackendMixin.__new__(ClusterBackendMixin)
+    mixin.head = head
+    mixin.local_backend = w.backend
+    mixin._lease_locks = [threading.Lock()]
+    sent = []
+    mixin._lease_send = lambda lease, spec: sent.append(lease) or True
+
+    spec = _header(n_cpus=1.0).materialize()
+    key = mixin._shape_key(spec)
+    now = time.monotonic()
+    hot = {"node_id": "hot", "pipe": SimpleNamespace(in_flight=0),
+           "slots": 4, "last_used": now, "address": ("127.0.0.1", 0),
+           "job": ""}
+    calm = {"node_id": "calm", "pipe": SimpleNamespace(in_flight=1),
+            "slots": 4, "last_used": now, "address": ("127.0.0.1", 0),
+            "job": ""}
+    mixin._leases = {key: [hot, calm]}
+
+    sb0 = perf_stats.counter("sched_spillbacks").value
+    hit0 = perf_stats.counter("sched_lease_cache_hit").value
+    # First submission: grant scan runs (both nodes excluded -> None),
+    # the hot lease is stamped, and the calm lease wins.
+    assert mixin._lease_submit(spec, None) is True
+    assert sent[-1] is calm
+    assert hot["spill_denied_at"] == head.nodes["hot"].last_report
+    assert perf_stats.counter("sched_spillbacks").value == sb0 + 1
+    # Second submission inside the backoff window: no grant scan (the
+    # submission counts as a cache HIT) but still redirected.
+    assert mixin._lease_submit(spec, None) is True
+    assert sent[-1] is calm
+    assert perf_stats.counter("sched_spillbacks").value == sb0 + 2
+    assert perf_stats.counter("sched_lease_cache_hit").value == hit0 + 1
+    ray_tpu.shutdown()
+
+
+def test_dep_parked_demand_charged_and_released(fresh_runtime):
+    """Dep-parked work charges an incremental demand counter at park
+    and releases it at claim — head placement of lifetime-pinned
+    creations reserves against it (a dep-blocked burst is invisible to
+    the backlog counter until the deps resolve, by which time
+    over-landed creations park forever)."""
+    w = fresh_runtime
+    backend = w.backend
+    gate = threading.Event()
+
+    @ray_tpu.remote(num_cpus=1)
+    def dep():
+        gate.wait(30)
+        return 1
+
+    @ray_tpu.remote(num_cpus=2)
+    def blocked(d):
+        return d
+
+    d = dep.remote()
+    b = blocked.remote(d)
+    deadline = time.monotonic() + 5
+    while backend.dep_parked_demand_milli().get("CPU", 0) != 2000 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert backend.dep_parked_demand_milli().get("CPU", 0) == 2000
+    gate.set()
+    assert ray_tpu.get(b, timeout=30) == 1
+    assert backend.dep_parked_demand_milli().get("CPU", 0) == 0
+
+
+def test_local_fits_reserves_dep_parked_only_for_creations():
+    from ray_tpu.cluster_utils import ClusterBackendMixin
+
+    mixin = ClusterBackendMixin.__new__(ClusterBackendMixin)
+    mixin.local_backend = SimpleNamespace(
+        resources=SimpleNamespace(_cond=threading.Condition(),
+                                  _available={"CPU": 1000}),
+        pending_demand_milli=lambda: {},
+        dep_parked_demand_milli=lambda: {"CPU": 1000})
+    # Plain-task check ignores dep-parked demand (tasks queue+release).
+    assert mixin._local_fits_now({"CPU": 1000}) is True
+    # Creation placement reserves for it.
+    assert mixin._local_fits_now({"CPU": 1000},
+                                 reserve_dep_parked=True) is False
+
+
+def test_creation_never_parks_on_full_head():
+    """A creation that cannot construct NOW on the head must queue
+    cluster-wide, not land in the head's local backlog (regression: the
+    head-local fallback admitted creations against local TOTAL — task
+    semantics — so a burst arriving while remote reports were stale
+    parked creations behind lifetime-pinned actor CPUs forever while a
+    remote node freed up; found by the flood-then-actors verify
+    drive). The gate must be registered before queueing so concurrent
+    method calls park instead of failing 'unknown actor'."""
+    from ray_tpu._private.resources import ResourceSet
+    from ray_tpu._private.task_spec import intern_template as it
+    from ray_tpu._private.ids import ActorID
+    from ray_tpu.cluster_utils import (ClusterBackendMixin, ClusterHead,
+                                       _NodeRecord)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    w = ray_tpu._private.worker.global_worker()
+    head = ClusterHead(w, start_server=False)
+    # One remote node: total CPU 2 but pushed availability reads 0
+    # (stale report — its tasks just finished).
+    rec = _NodeRecord("n1", ("127.0.0.1", 0), {"CPU": 2.0})
+    rec.available = {"CPU": 0.0}
+    head.nodes["n1"] = rec
+
+    mixin = ClusterBackendMixin.__new__(ClusterBackendMixin)
+    mixin.head = head
+    mixin.local_backend = w.backend
+    routed = []
+    mixin._queue_for_cluster = \
+        lambda spec, request: routed.append(("queue", spec))
+    # Patch the backend submit (the atomic check-and-claim in
+    # _submit_local_if_fits calls it directly, not _submit_local).
+    w.backend.submit = lambda spec: routed.append(("local", spec))
+
+    def creation():
+        tpl = it(kind=TaskKind.ACTOR_CREATION, func=object, name="A",
+                 num_returns=1, resources={"CPU": 1.0},
+                 scheduling_strategy=DefaultSchedulingStrategy())
+        spec = tpl.make_spec(TaskID.from_random(), (), {},
+                             actor_id=ActorID.from_random())
+        spec.assign_return_ids()
+        return spec
+
+    # Local CPU free: the creation lands locally (local-first pack).
+    mixin.submit(creation())
+    assert routed[-1][0] == "local"
+    # Local CPU lifetime-pinned: the creation must QUEUE, and the gate
+    # must exist so concurrent calls park rather than "unknown actor".
+    w.backend.resources = ResourceSet({"CPU": 0.0})
+    spec = creation()
+    mixin.submit(spec)
+    assert routed[-1][0] == "queue", routed[-1]
+    assert head.actor_gate.state(spec.actor_id.binary()) is not None
+    ray_tpu.shutdown()
+
+
+def test_creation_reservation_gates_choose_node():
+    """In-flight actor creations charge a head-side placement
+    reservation (stale pushed views + lifetime CPU pinning: an
+    unreserved burst packs one node with actors that can never start —
+    found by the PR 13 verify drive, multiprocess regression in
+    test_cluster). Unit-level: reserve at record_inflight, subtract in
+    _choose_node, release at clear_inflight."""
+    from ray_tpu._private.resources import ResourceSet
+    from ray_tpu._private.task_spec import (TaskSpec,
+                                            intern_template as it)
+    from ray_tpu.cluster_utils import (ClusterBackendMixin, ClusterHead,
+                                       _NodeRecord)
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    w = ray_tpu._private.worker.global_worker()
+    head = ClusterHead(w, start_server=False)
+    for nid in ("nA", "nB"):
+        head.nodes[nid] = _NodeRecord(nid, ("127.0.0.1", 0),
+                                      {"CPU": 4.0})
+
+    def creation(i):
+        tpl = it(kind=TaskKind.ACTOR_CREATION, func=object, name="A",
+                 num_returns=1, resources={"CPU": 1.0},
+                 scheduling_strategy=DefaultSchedulingStrategy())
+        from ray_tpu._private.ids import ActorID
+        spec = tpl.make_spec(TaskID.from_random(), (), {},
+                             actor_id=ActorID.from_random())
+        spec.assign_return_ids()
+        return spec
+
+    mixin = ClusterBackendMixin.__new__(ClusterBackendMixin)
+    mixin.head = head
+    mixin.local_backend = w.backend
+    # Fill the local backend so _choose_node must go remote.
+    w.backend.resources = ResourceSet({"CPU": 0.0})
+
+    placed = {"nA": 0, "nB": 0}
+    specs = []
+    for i in range(8):
+        target = mixin._choose_node(creation(0))
+        assert target is not None, (placed, "burst bounced at 8 <= 8")
+        spec = creation(i)
+        head.record_inflight(spec, target.node_id)
+        specs.append((spec, target.node_id))
+        placed[target.node_id] += 1
+    # 8 one-CPU creations over two 4-CPU nodes: exactly 4 + 4.
+    assert placed == {"nA": 4, "nB": 4}, placed
+    # The 9th has nowhere to go until something releases.
+    assert mixin._choose_node(creation(9)) is None
+    for spec, nid in specs:
+        head.clear_inflight(spec)
+    assert all(not r.reserved_milli for r in head.nodes.values())
+    assert mixin._choose_node(creation(10)) is not None
+    ray_tpu.shutdown()

@@ -279,6 +279,7 @@ def test_cluster_drain_channels_flush_closes_once():
 
     backend = ClusterBackendMixin.__new__(ClusterBackendMixin)
     backend._lease_lock = threading.Lock()
+    backend._lease_locks = [threading.Lock()]
     # Tenancy-drain state the real __init__ would set up.
     backend._quota_stop = threading.Event()
     backend._quota_drainer = None

@@ -1319,6 +1319,125 @@ class QuotaAdmissionScenario(Scenario):
         ray_config.job_quotas = self._old_quotas
 
 
+# -- scheduler dep-park table: death sweep vs dep-ready claims ---------------
+
+
+class DepSweepScenario(Scenario):
+    name = "dep_sweep"
+    description = ("the scheduler's dep-park table under a racing "
+                   "death sweep (ROADMAP FT gap d): two dep-ready "
+                   "claims race one sweep over items parked on one and "
+                   "two dependencies — every item is handed to exactly "
+                   "one owner (ready path XOR sweep), nothing leaks a "
+                   "per-dep entry, and every item resolves")
+    points = ("sched.dep_ready", "sched.dep_sweep")
+    max_steps = 24
+    # Measured exhaustive sweep is tiny (3 single-crossing actions);
+    # the floor leaves headroom so `exhausted` stays honest.
+    max_schedules = 2000
+    block_grace_s = 0.02
+
+    # The REAL DepTable (the core LocalBackend parks dep-blocked work
+    # in) under a condensed model of the product wiring: ready1/ready2
+    # are _on_dep_ready for two objects landing concurrently, the
+    # sweeper is _on_actor_death's claim over a dying actor's parked
+    # specs. Item A parks on {d1}, item B on {d1, d2} — the multi-dep
+    # item is what makes stale-entry purging and double-claim windows
+    # reachable.
+
+    def setup(self) -> None:
+        from ray_tpu._private.sched_state import DepTable
+
+        self.table = DepTable()
+        self.item_a = SimpleNamespace(name="A")
+        self.item_b = SimpleNamespace(name="B")
+        self.table.park(b"A", self.item_a, ["d1"])
+        self.table.park(b"B", self.item_b, ["d1", "d2"])
+        self._wlock = threading.Lock()
+        self.dispatched: List[str] = []
+        self.failed: List[str] = []
+
+    def actions(self):
+        def claim(out, items):
+            with self._wlock:
+                out.extend(item.name for item in items)
+
+        def ready1():
+            claim(self.dispatched, self.table.dep_ready("d1"))
+
+        def ready2():
+            claim(self.dispatched, self.table.dep_ready("d2"))
+
+        def sweeper():
+            claim(self.failed,
+                  self.table.sweep(lambda item: True))
+
+        return [("ready1", ready1), ("ready2", ready2),
+                ("sweeper", sweeper)]
+
+    def invariants(self):
+        def exactly_once(s):
+            with s._wlock:
+                dispatched = list(s.dispatched)
+                failed = list(s.failed)
+            both = set(dispatched) & set(failed)
+            if both:
+                return (f"items claimed by BOTH ready and sweep: "
+                        f"{sorted(both)}")
+            if len(dispatched) != len(set(dispatched)) or \
+                    len(failed) != len(set(failed)):
+                return (f"duplicate claim: dispatched={dispatched} "
+                        f"failed={failed}")
+            return True
+
+        def conservation(s):
+            with s._wlock:
+                claimed = set(s.dispatched) | set(s.failed)
+            waiting = s.table.waiting_count()
+            if len(claimed) + waiting != 2:
+                return (f"items lost or forged: claimed="
+                        f"{sorted(claimed)} waiting={waiting}")
+            return True
+
+        def no_entry_leak(s):
+            # A claimed item must not pin per-dep list entries: a dep
+            # that never fires would hold them (and their args)
+            # forever. Entries may only remain for UNCLAIMED items.
+            waiting = s.table.waiting_count()
+            entries = s.table.parked_entries()
+            if waiting == 0 and entries != 0:
+                return (f"{entries} stale per-dep entries with no "
+                        f"unclaimed items")
+            return True
+
+        return [
+            Invariant("dep-exactly-once-handoff", exactly_once,
+                      description="each parked item is claimed by the "
+                                  "ready path XOR the sweep, once"),
+            Invariant("dep-conservation", conservation,
+                      description="claimed + still-waiting == parked"),
+            Invariant("dep-no-entry-leak", no_entry_leak,
+                      description="claimed items leave no per-dep "
+                                  "entries behind"),
+        ]
+
+    def liveness(self):
+        def all_resolved(s):
+            # The sweep matches everything, so by quiescence every
+            # item has exactly one owner (sweep-first executions fail
+            # both; ready-first dispatch some and sweep the rest).
+            with s._wlock:
+                return len(set(s.dispatched) | set(s.failed)) == 2
+
+        return [Liveness("dep-items-resolve", all_resolved,
+                         timeout_s=2.0,
+                         description="every parked item ends owned by "
+                                     "the ready path or the sweep")]
+
+    def teardown(self) -> None:
+        pass
+
+
 # -- head hard-crash: durability + node re-registration convergence ----------
 
 
@@ -1521,17 +1640,18 @@ SCENARIOS = {
                 ExactlyOnceResubmitScenario, LongPollRecoveryScenario,
                 SpillRaceScenario, LineageReconstructionScenario,
                 ActorRestartScenario, HeadCrashRecoveryScenario,
-                QuotaAdmissionScenario)
+                QuotaAdmissionScenario, DepSweepScenario)
 }
 
 # The bounded tier-1 leg: real code, small configs, exhaustive where
 # the scenario supports it (see test_raymc_ci_leg.py).
-# quota_admission runs FIRST: it is the one scenario that never needs
-# the ray_tpu runtime, and explorer executions are an order of
-# magnitude cheaper before a needs_ray scenario brings the runtime
+# dep_sweep and quota_admission run FIRST: they are the scenarios that
+# never need the ray_tpu runtime, and explorer executions are an order
+# of magnitude cheaper before a needs_ray scenario brings the runtime
 # (and its background threads, which every quiescence settle must
-# scan) up for the rest of the leg.
-DEFAULT_SCENARIOS = ("quota_admission", "router_cap", "gcs_durability",
-                     "pipelined_close", "spill_race",
+# scan) up for the rest of the leg (run order matters — cheap
+# scenarios first).
+DEFAULT_SCENARIOS = ("dep_sweep", "quota_admission", "router_cap",
+                     "gcs_durability", "pipelined_close", "spill_race",
                      "lineage_reconstruction", "actor_restart",
                      "head_crash_recovery")
