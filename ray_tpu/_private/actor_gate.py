@@ -63,15 +63,18 @@ class ActorRestartGate:
         survive the failover instead of resetting (ROADMAP FT gap c).
         An actor re-reported with its whole budget spent registers at 0
         left — alive now, tombstoned on its next death."""
+        sanitize_hooks.spec_op("spec.actor.register", "call", self,
+                               (actor_id, max_restarts, used))
         with self._lock:
-            if actor_id in self._state:
-                return
-            self._state[actor_id] = ActorRestartState.ALIVE
-            budget = max_restarts
-            if max_restarts >= 0 and used > 0:
-                budget = max(0, max_restarts - used)
-            self._budget[actor_id] = budget
-            self._max_restarts[actor_id] = max_restarts
+            if actor_id not in self._state:
+                self._state[actor_id] = ActorRestartState.ALIVE
+                budget = max_restarts
+                if max_restarts >= 0 and used > 0:
+                    budget = max(0, max_restarts - used)
+                self._budget[actor_id] = budget
+                self._max_restarts[actor_id] = max_restarts
+        sanitize_hooks.spec_op("spec.actor.register", "ret", self,
+                               actor_id)
 
     def state(self, actor_id: bytes) -> Optional[str]:
         with self._lock:
@@ -99,10 +102,14 @@ class ActorRestartGate:
         started (budget consumed, state → RESTARTING); False when the
         budget is exhausted (state → DEAD, tombstoned with a cause
         naming the budget)."""
+        sanitize_hooks.spec_op("spec.actor.restart", "call", self,
+                               actor_id)
         sanitize_hooks.sched_point("actor.restart.begin")
+        started = False
         with self._lock:
             try:
                 if self._state.get(actor_id) == ActorRestartState.DEAD:
+                    started = False
                     return False
                 left = self._budget.get(actor_id, 0)
                 if left == 0:
@@ -111,22 +118,28 @@ class ActorRestartGate:
                     self._cause[actor_id] = (
                         f"{reason}; restart budget exhausted "
                         f"(max_restarts={mx}, 0 restarts left)")
+                    started = False
                     return False
                 if left > 0:
                     self._budget[actor_id] = left - 1
                 self._state[actor_id] = ActorRestartState.RESTARTING
+                started = True
                 return True
             finally:
                 self._changed.notify_all()
+                sanitize_hooks.spec_op("spec.actor.restart", "ret", self,
+                                       (actor_id, started))
 
     def ready(self, actor_id: bytes) -> None:
         """The replacement registered a live location: parked callers
         may dispatch now."""
+        sanitize_hooks.spec_op("spec.actor.ready", "call", self, actor_id)
         sanitize_hooks.sched_point("actor.restart.ready")
         with self._lock:
             if self._state.get(actor_id) == ActorRestartState.RESTARTING:
                 self._state[actor_id] = ActorRestartState.ALIVE
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.actor.ready", "ret", self, actor_id)
 
     def rollback_ready(self, actor_id: bytes) -> None:
         """A location gain was unwound (the send to the chosen node
@@ -134,16 +147,22 @@ class ActorRestartGate:
         not stand with no live location, or parked/new calls fall
         through to a backend that has never heard of the actor. The
         re-dispatch (or queue/fail path) will flip it again."""
+        sanitize_hooks.spec_op("spec.actor.rollback", "call", self,
+                               actor_id)
         with self._lock:
             if self._state.get(actor_id) == ActorRestartState.ALIVE:
                 self._state[actor_id] = ActorRestartState.RESTARTING
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.actor.rollback", "ret", self,
+                               actor_id)
 
     def mark_dead(self, actor_id: bytes, cause: str) -> None:
+        sanitize_hooks.spec_op("spec.actor.dead", "call", self, actor_id)
         with self._lock:
             self._state[actor_id] = ActorRestartState.DEAD
             self._cause.setdefault(actor_id, cause)
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.actor.dead", "ret", self, actor_id)
 
     def wait_change(self, timeout_s: float) -> None:
         """Park until some actor's gate state changes (bounded): the
@@ -166,10 +185,18 @@ class ActorRestartGate:
         ``fail(spec, msg, dead)`` rejects it (``dead``: tombstone vs
         mid-restart rejection)."""
         del dispatch  # routing without a location never dispatches
+        aid = spec.actor_id.binary()
+        sanitize_hooks.spec_op(
+            "spec.actor.route", "call", self,
+            (aid, spec.max_retries, getattr(spec, "attempt", 0)))
         sanitize_hooks.sched_point("actor.route")
         with self._lock:
-            state = self._state.get(spec.actor_id.binary())
+            state = self._state.get(aid)
             msg = self._reject_msg_locked(spec, state)
+        verdict = "park" if msg is None else (
+            "dead" if state == ActorRestartState.DEAD else "reject")
+        sanitize_hooks.spec_op("spec.actor.route", "ret", self,
+                               (aid, verdict))
         if msg is None:
             park(spec)
         else:
@@ -182,8 +209,10 @@ class ActorRestartGate:
         ``max_task_retries`` budget (``spec.max_retries``); a call with
         none left — or whose actor is DEAD — rejects with an error
         naming the state and the remaining budgets."""
-        sanitize_hooks.sched_point("actor.replay")
         aid = spec.actor_id.binary()
+        sanitize_hooks.spec_op("spec.actor.replay", "call", self,
+                               (aid, spec.max_retries))
+        sanitize_hooks.sched_point("actor.replay")
         with self._lock:
             state = self._state.get(aid)
             if state == ActorRestartState.DEAD:
@@ -204,6 +233,10 @@ class ActorRestartGate:
                     spec.max_retries -= 1
                 spec.attempt = getattr(spec, "attempt", 0) + 1
                 msg = None
+        verdict = "resubmit" if msg is None else (
+            "dead" if state == ActorRestartState.DEAD else "reject")
+        sanitize_hooks.spec_op("spec.actor.replay", "ret", self,
+                               (aid, verdict))
         if msg is None:
             resubmit(spec)
         else:

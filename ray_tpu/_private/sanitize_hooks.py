@@ -14,7 +14,7 @@ per site. The sites are control-plane boundaries (a dispatch, a frame
 flush, a teardown) — not per-object hot loops — so this stays far
 below measurement noise; the A/B observability bench budget covers it.
 
-Three hooks:
+Four hooks:
 
 - ``sched_point(name)``: a named yield point. A deterministic schedule
   (``tools.raysan.sched.Schedule``) installs a callable that can park
@@ -35,6 +35,23 @@ Three hooks:
   (C ``_thread._local`` storage is invisible from other threads).
   The calling thread's ident is derived here and handed to the
   installed observer as ``(kind, ident, value)``.
+- ``spec_op(name, phase, obj, payload)``: an operation-boundary tap on
+  the pure decision cores (``QuotaLedger``, ``FairTaskQueue``,
+  ``DepTable``, ``ActorRestartGate``, ``ShardedTable``) and the
+  actor-call exactly-once protocol. ``tools/rayspec`` installs a
+  history recorder here and checks the captured concurrent
+  invocation/response histories against each core's executable
+  sequential specification (linearizability / refinement). ``phase``
+  is ``"call"`` (operation entered; ``payload`` = argument view) or
+  ``"ret"`` (operation returning; ``payload`` = result view); ``obj``
+  is the core instance, used only for identity so one process-wide
+  recorder can partition events per core instance. Point names are
+  ``spec.<core>.<op>``, registered in :data:`SPEC_POINTS` (folded into
+  ``SCHED_POINTS`` so the R8 literal-name contract and the raymc point
+  catalog cover them); while a recorder is installed, the ``call``
+  phase also crosses the sched-point seam, so a raysan ``Schedule``
+  can gate spec operations — that is how rayspec's emitted violation
+  scripts replay.
 
 Every product call site must use a literal name registered below in
 ``SCHED_POINTS``/``CRASH_POINTS`` (raylint R8 enforces it): a typo'd
@@ -60,10 +77,56 @@ class SimulatedCrash(BaseException):
         self.point = point
 
 
+# Decision-core operation boundaries tapped by the rayspec history
+# recorder (``spec.<core>.<op>``; crossed via :func:`spec_op`, not
+# :func:`sched_point`). Registered separately so tooling can tell the
+# two seam kinds apart, but folded into ``SCHED_POINTS`` below: R8's
+# literal-name contract and raymc's point catalog cover both, and a
+# raysan ``Schedule`` may gate a spec op's call phase while a recorder
+# is installed (rayspec's violation scripts rely on it). raylint R9
+# additionally pins the registry ↔ call-site ↔ SPEC_CATALOG agreement.
+SPEC_POINTS = frozenset({
+    # tenancy.QuotaLedger: queued-ceiling admission, queue exit, CPU
+    # charge/release, the drainer's batched charge, lease slots
+    "spec.quota.admit",
+    "spec.quota.dequeue",
+    "spec.quota.charge",
+    "spec.quota.release",
+    "spec.quota.drain",
+    "spec.quota.lease_acquire",
+    "spec.quota.lease_release",
+    # sched_state.DepTable: park / ready-claim / sweep-claim
+    "spec.dep.park",
+    "spec.dep.ready",
+    "spec.dep.sweep",
+    # sched_state.ShardedTable: refinement of one flat dict
+    "spec.table.get",
+    "spec.table.set",
+    "spec.table.pop",
+    "spec.table.contains",
+    "spec.table.setdefault",
+    # actor_gate.ActorRestartGate: FSM edges + per-call decisions
+    "spec.actor.register",
+    "spec.actor.restart",
+    "spec.actor.ready",
+    "spec.actor.rollback",
+    "spec.actor.dead",
+    "spec.actor.route",
+    "spec.actor.replay",
+    # cluster head actor-call exactly-once protocol: a call entering
+    # the in-flight table / its output REPORT being applied (the FT
+    # gap (a) double-execution witness rides these)
+    "spec.call.invoke",
+    "spec.call.apply",
+    # scheduler WFQ runnable queue: enqueue / fair pick
+    "spec.wfq.put",
+    "spec.wfq.pop",
+})
+
 # The registered yield-point catalog. Grouped by component; the first
 # dotted segment is the point's conflict domain (raymc's partial-order
 # reduction treats crossings in different domains as independent).
-SCHED_POINTS = frozenset({
+SCHED_POINTS = SPEC_POINTS | frozenset({
     # serve router: the reserved→in-flight slot handoff
     "router.handoff",
     # memory store: object publication and wait-path snapshot
@@ -144,6 +207,12 @@ POINTS = SCHED_POINTS | CRASH_POINTS
 _sched_point: Optional[Callable[[str], None]] = None
 _crash_point: Optional[Callable[[str], None]] = None
 _ambient_set: Optional[Callable[[str, int, object], None]] = None
+_spec_op: Optional[Callable[[str, str, object, object], None]] = None
+# Public mirror of "_spec_op is installed": the inline guard hot tap
+# sites read (one module-attr load + truth test, ~30ns uninstalled —
+# cheaper than calling spec_op just to no-op, and public so call sites
+# outside _private stay R3-clean). Kept in sync by install_spec_op.
+spec_taps_active = False
 
 
 def sched_point(name: str) -> None:
@@ -171,6 +240,40 @@ def crash_point(name: str) -> None:
 def install_crash_point(fn: Optional[Callable[[str], None]]) -> None:
     global _crash_point
     _crash_point = fn
+
+
+def spec_op(name: str, phase: str, obj: object,
+            payload: object = None) -> None:
+    """Report a decision-core operation boundary to the installed
+    rayspec recorder (no-op unless one is installed; cost then is one
+    global load and a ``None`` check — same contract as
+    :func:`sched_point`). ``phase`` is ``"call"`` or ``"ret"``; the
+    payload is a cheap view of args/result the recorder's per-point
+    adapters interpret. While a recorder is installed, the call phase
+    also crosses the sched-point seam so a raysan ``Schedule`` can
+    order spec operations (rayspec violation-script replay)."""
+    hook = _spec_op
+    if hook is None:
+        return
+    if phase == "call":
+        gate = _sched_point
+        if gate is not None:
+            gate(name)
+    hook(name, phase, obj, payload)
+
+
+def install_spec_op(
+        fn: Optional[Callable[[str, str, object, object], None]]) -> None:
+    global _spec_op, spec_taps_active
+    _spec_op = fn
+    spec_taps_active = fn is not None
+
+
+def spec_recording() -> bool:
+    """True while a rayspec recorder is installed — the gate for call
+    sites whose probe PAYLOAD is itself costly to build (they must pay
+    nothing when nothing records)."""
+    return _spec_op is not None
 
 
 def ambient_set(kind: str, value: object) -> None:

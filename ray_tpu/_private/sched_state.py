@@ -79,14 +79,21 @@ class DepTable:
     def park(self, key: bytes, item: Any, deps: List[Any]) -> None:
         """Park ``item`` until every dep in ``deps`` has fired (caller
         guarantees ``deps`` is non-empty and de-duplicated)."""
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.park", "call", self,
+                                   (key, item, deps))
         with self._lock:
             self._counts[key] = len(deps)
             for dep in deps:
                 self._by_dep.setdefault(dep, []).append((key, item))
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.park", "ret", self, None)
 
     def dep_ready(self, dep: Any) -> List[Any]:
         """One dependency resolved: returns the items this completes
         (claimed — the caller now owns dispatching them)."""
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.ready", "call", self, dep)
         sanitize_hooks.sched_point("sched.dep_ready")
         out: List[Any] = []
         with self._lock:
@@ -99,6 +106,8 @@ class DepTable:
                 else:
                     del self._counts[key]
                     out.append(item)
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.ready", "ret", self, out)
         return out
 
     def sweep(self, match: Callable[[Any], bool]) -> List[Any]:
@@ -106,6 +115,8 @@ class DepTable:
         (death sweep / shutdown). Purges the claimed items' entries
         from every per-dep list — a dep that never fires must not pin
         swept items forever."""
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.sweep", "call", self, None)
         sanitize_hooks.sched_point("sched.dep_sweep")
         out: List[Any] = []
         with self._lock:
@@ -127,6 +138,8 @@ class DepTable:
                     self._by_dep[dep] = kept
                 else:
                     del self._by_dep[dep]
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.dep.sweep", "ret", self, out)
         return out
 
     def waiting_count(self) -> int:
@@ -165,35 +178,74 @@ class ShardedTable:
     def _ix(self, key) -> int:
         return hash(key) & self._mask
 
+    # Per-key ops carry rayspec taps (spec.table.*): the recorded
+    # concurrent history must refine ONE flat dict — the spec the
+    # lock-partitioned form exists to preserve. Iteration stays
+    # untapped: its contract is explicitly weaker (per-shard, not
+    # cross-shard, consistency) and outside the refinement map.
+
     def get(self, key, default=None):
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.get", "call", self, key)
         i = self._ix(key)
         with self._locks[i]:
-            return self._shards[i].get(key, default)
+            out = self._shards[i].get(key, default)
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.get", "ret", self, (key, out))
+        return out
 
     def __contains__(self, key) -> bool:
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.contains", "call", self, key)
         i = self._ix(key)
         with self._locks[i]:
-            return key in self._shards[i]
+            out = key in self._shards[i]
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.contains", "ret", self,
+                                   (key, out))
+        return out
 
     def __setitem__(self, key, value) -> None:
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.set", "call", self,
+                                   (key, value))
         i = self._ix(key)
         with self._locks[i]:
             self._shards[i][key] = value
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.set", "ret", self, (key, None))
 
     def __getitem__(self, key):
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.get", "call", self, key)
         i = self._ix(key)
         with self._locks[i]:
-            return self._shards[i][key]
+            out = self._shards[i][key]
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.get", "ret", self, (key, out))
+        return out
 
     def pop(self, key, default=None):
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.pop", "call", self, key)
         i = self._ix(key)
         with self._locks[i]:
-            return self._shards[i].pop(key, default)
+            out = self._shards[i].pop(key, default)
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.pop", "ret", self, (key, out))
+        return out
 
     def setdefault(self, key, default):
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.setdefault", "call", self,
+                                   (key, default))
         i = self._ix(key)
         with self._locks[i]:
-            return self._shards[i].setdefault(key, default)
+            out = self._shards[i].setdefault(key, default)
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.table.setdefault", "ret", self,
+                                   (key, out))
+        return out
 
     def __len__(self) -> int:
         return sum(len(s) for s in self._shards)

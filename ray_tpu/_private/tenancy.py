@@ -280,15 +280,23 @@ class QuotaLedger:
         quota = self._active_quota(job)
         if quota is None or quota.queued < 0:
             return None
+        sanitize_hooks.spec_op("spec.quota.admit", "call", self,
+                               (job, quota.queued))
+        reason = None
         with self._lock:
             have = self._queued.get(job, 0)
             if have >= quota.queued:
                 quota_counter("rejections", job).inc()
-                return (f"job {job!r} is at its queued-task ceiling "
-                        f"({have} queued, quota queued:{quota.queued}) "
-                        f"— submit rejected; release or await existing "
-                        f"work, or raise job_quotas for this job")
-            self._queued[job] = have + 1
+                reason = (f"job {job!r} is at its queued-task ceiling "
+                          f"({have} queued, quota queued:{quota.queued}) "
+                          f"— submit rejected; release or await existing "
+                          f"work, or raise job_quotas for this job")
+            else:
+                self._queued[job] = have + 1
+        sanitize_hooks.spec_op("spec.quota.admit", "ret", self,
+                               reason is None)
+        if reason is not None:
+            return reason
         spec._quota_queued = job
         spec._quota_admitted = True
         return None
@@ -300,6 +308,7 @@ class QuotaLedger:
         if job is None:
             return
         spec._quota_queued = None
+        sanitize_hooks.spec_op("spec.quota.dequeue", "call", self, job)
         with self._lock:
             left = self._queued.get(job, 0) - 1
             if left > 0:
@@ -307,6 +316,7 @@ class QuotaLedger:
             else:
                 self._queued.pop(job, None)
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.quota.dequeue", "ret", self, None)
 
     # -- CPU slots -------------------------------------------------------
 
@@ -325,16 +335,22 @@ class QuotaLedger:
             milli = int((spec.resources or {}).get("CPU", 0) * 1000)
         if milli <= 0:
             return True  # zero-CPU work never counts against CPU slots
+        sanitize_hooks.spec_op("spec.quota.charge", "call", self,
+                               (job, milli, quota.cpu_milli))
         sanitize_hooks.sched_point("tenancy.acquire")
+        ok = True
         with self._lock:
             used = self._cpu.get(job, 0)
             if used + milli > quota.cpu_milli:
-                return False
-            self._cpu[job] = used + milli
-            if used + milli > self._peak_cpu.get(job, 0):
-                self._peak_cpu[job] = used + milli
-        spec._quota_cpu = (job, milli)
-        return True
+                ok = False
+            else:
+                self._cpu[job] = used + milli
+                if used + milli > self._peak_cpu.get(job, 0):
+                    self._peak_cpu[job] = used + milli
+        sanitize_hooks.spec_op("spec.quota.charge", "ret", self, ok)
+        if ok:
+            spec._quota_cpu = (job, milli)
+        return ok
 
     def release_cpu(self, spec) -> None:
         """Release the spec's CPU charge (terminal state or node-death
@@ -345,6 +361,8 @@ class QuotaLedger:
             return
         spec._quota_cpu = None
         job, milli = token
+        sanitize_hooks.spec_op("spec.quota.release", "call", self,
+                               (job, milli))
         sanitize_hooks.sched_point("tenancy.release")
         with self._lock:
             left = self._cpu.get(job, 0) - milli
@@ -353,6 +371,7 @@ class QuotaLedger:
             else:
                 self._cpu.pop(job, None)
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.quota.release", "ret", self, None)
 
     # -- concurrent leases -----------------------------------------------
 
@@ -360,15 +379,22 @@ class QuotaLedger:
         quota = self._active_quota(job or "")
         if quota is None or quota.leases < 0:
             return True
+        sanitize_hooks.spec_op("spec.quota.lease_acquire", "call", self,
+                               (job, quota.leases))
+        ok = True
         with self._lock:
             have = self._leases.get(job, 0)
             if have >= quota.leases:
                 quota_counter("lease_denials", job).inc()
-                return False
-            self._leases[job] = have + 1
-        return True
+                ok = False
+            else:
+                self._leases[job] = have + 1
+        sanitize_hooks.spec_op("spec.quota.lease_acquire", "ret", self, ok)
+        return ok
 
     def release_lease(self, job: str) -> None:
+        sanitize_hooks.spec_op("spec.quota.lease_release", "call", self,
+                               job)
         with self._lock:
             left = self._leases.get(job, 0) - 1
             if left > 0:
@@ -376,6 +402,8 @@ class QuotaLedger:
             else:
                 self._leases.pop(job, None)
             self._changed.notify_all()
+        sanitize_hooks.spec_op("spec.quota.lease_release", "ret", self,
+                               None)
 
     # -- quota parking (over-CPU-quota specs wait HERE, not in the
     #    scheduler, so they consume no cluster capacity) -----------------
@@ -397,7 +425,9 @@ class QuotaLedger:
         charging each under the lock (check + charge are atomic — two
         drain passes must not both dispatch into the last slot).
         Called by the owner's single drainer thread."""
+        sanitize_hooks.spec_op("spec.quota.drain", "call", self, None)
         out: List = []
+        charged: List[Tuple[str, int, int]] = []
         with self._lock:
             for job in list(self._parked):
                 quota = self._quotas.get(job)
@@ -415,9 +445,11 @@ class QuotaLedger:
                         if used + milli > self._peak_cpu.get(job, 0):
                             self._peak_cpu[job] = used + milli
                         spec._quota_cpu = (job, milli)
+                        charged.append((job, milli, quota.cpu_milli))
                     out.append(specs.pop(0))
                 if not specs:
                     del self._parked[job]
+        sanitize_hooks.spec_op("spec.quota.drain", "ret", self, charged)
         return out
 
     def wait_change(self, timeout_s: float) -> None:
@@ -494,6 +526,8 @@ class FairTaskQueue:
 
     def put(self, item) -> None:
         job = self._class_of(item)
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.put", "call", self, (job, item))
         with self._cond:
             q = self._classes.get(job)
             if q is None:
@@ -506,6 +540,8 @@ class FairTaskQueue:
             q.append(item)
             self._count += 1
             self._cond.notify()
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.put", "ret", self, None)
 
     def _pop_locked(self):
         best, best_vt = None, 0.0
@@ -551,6 +587,10 @@ class FairTaskQueue:
     def get(self, timeout: Optional[float] = None):
         import queue as _queue
 
+        # The pop tap's result payload is the served item, None for an
+        # empty (timed-out) beat — items are specs/headers, never None.
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.pop", "call", self, None)
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         with self._cond:
@@ -558,17 +598,30 @@ class FairTaskQueue:
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
+                    if sanitize_hooks.spec_taps_active:
+                        sanitize_hooks.spec_op("spec.wfq.pop", "ret", self,
+                                               None)
                     raise _queue.Empty
                 self._cond.wait(remaining)
-            return self._pop_locked()
+            item = self._pop_locked()
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.pop", "ret", self, item)
+        return item
 
     def get_nowait(self):
         import queue as _queue
 
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.pop", "call", self, None)
         with self._cond:
             if self._count == 0:
+                if sanitize_hooks.spec_taps_active:
+                    sanitize_hooks.spec_op("spec.wfq.pop", "ret", self, None)
                 raise _queue.Empty
-            return self._pop_locked()
+            item = self._pop_locked()
+        if sanitize_hooks.spec_taps_active:
+            sanitize_hooks.spec_op("spec.wfq.pop", "ret", self, item)
+        return item
 
     def qsize(self) -> int:
         with self._lock:

@@ -72,7 +72,7 @@ def _recon_counter(outcome: str):
 
 def _restart_counter(outcome: str):
     """actor_restarts{outcome}: restarted | exhausted | call_replayed |
-    call_rejected."""
+    call_rejected | call_deduped."""
     return _perf_stats.counter("actor_restarts", {"outcome": outcome})
 
 
@@ -572,6 +572,14 @@ class ClusterHead:
         frees = []
         finished = []
         addr = tuple(address)
+        # FT gap (a) guard: a dying node's last-gasp report must not
+        # apply after the death sweep ran — it would re-point the
+        # directory at an unreachable address and pop a REPLAYED call's
+        # fresh in-flight record (the head would then believe the
+        # replay finished while it is still running). The copies it
+        # announces died with the node; recovery owns them now.
+        if self._addr_dead(addr) and not self._addr_alive(addr):
+            return True
         for i, oid in enumerate(oids):
             self.object_locations[oid] = addr
             if sizes is not None and i < len(sizes) and sizes[i]:
@@ -582,10 +590,43 @@ class ClusterHead:
             entry = self.inflight.pop(tid, None)
             if entry is not None:
                 finished.append(entry[1])
+                if entry[1].kind == TaskKind.ACTOR_TASK:
+                    # Exactly-once protocol tap (rayspec): the call's
+                    # output REPORT is applied — its effect is now
+                    # observable. A second apply for the same task id
+                    # is the FT-gap-(a) double execution the
+                    # exactly-once register spec flags.
+                    sanitize_hooks.spec_op("spec.call.apply", "call",
+                                           self, tid)
+                    sanitize_hooks.spec_op("spec.call.apply", "ret",
+                                           self, (tid, "applied"))
                 if entry[1].kind == TaskKind.ACTOR_CREATION:
                     # Constructed: the node's own reports carry the
                     # held CPUs from dispatch on — drop the reservation.
                     self._unreserve_creation(entry[0], entry[1])
+            elif sanitize_hooks.spec_taps_active \
+                    and addr != tuple(self.server.address):
+                # Recorder installed only: a NODE's report for an
+                # actor-task output whose in-flight entry is ALREADY
+                # gone (popped by a death sweep that replayed the
+                # call, or by the other execution's report) is a
+                # further application of the same call — exactly the
+                # history the exactly-once spec exists to flag.
+                # Head-address self-reports are excluded: those are
+                # re-advertisements (local-arg publication, spill
+                # restore), not executions; failover re-registration
+                # lands on a FRESH head whose history starts empty.
+                # The lineage row identifies the oid as an actor-call
+                # output; the whole probe is gated so the uninstalled
+                # hot path pays nothing for it.
+                lspec = self.lineage.get(oid)
+                if lspec is not None and \
+                        getattr(lspec, "kind", None) == \
+                        TaskKind.ACTOR_TASK:
+                    sanitize_hooks.spec_op("spec.call.apply", "call",
+                                           self, tid)
+                    sanitize_hooks.spec_op("spec.call.apply", "ret",
+                                           self, (tid, "applied"))
             # Lock-free membership prechecks keep the common case (no
             # pins, no reconstruction attempt) off the head lock
             # entirely. Safe: dict membership is GIL-atomic, and both
@@ -668,6 +709,17 @@ class ClusterHead:
         # in-flight actor call (typed ActorDiedError) rather than leave
         # its caller hanging on a never-located return object.
         tid = spec.task_id.binary()
+        if sanitize_hooks.spec_taps_active and \
+                spec.kind == TaskKind.ACTOR_TASK:
+            # Exactly-once protocol tap (rayspec): one dispatch attempt
+            # of this call is now in flight. `attempt` distinguishes a
+            # replay's re-invocation from the original. Guarded like
+            # every per-dispatch tap: uninstalled cost is one flag
+            # read, no payload construction.
+            sanitize_hooks.spec_op(
+                "spec.call.invoke", "call", self,
+                (tid, getattr(spec, "attempt", 0)))
+            sanitize_hooks.spec_op("spec.call.invoke", "ret", self, tid)
         self.inflight[tid] = (node_id, spec)
         if spec.kind == TaskKind.ACTOR_CREATION:
             # Creation reservation: charge the placement against the
@@ -998,7 +1050,24 @@ class ClusterHead:
 
     def recover_actor_call(self, spec) -> None:
         """An actor call that was in flight on (or failed to reach) a
-        dead node: gate-decided replay-or-reject."""
+        dead node: gate-decided replay-or-reject.
+
+        Caller-side dedupe on return-object identity first (ROADMAP FT
+        gap a): the death sweep's in-flight snapshot races the call's
+        output REPORT — a call whose output was already applied by the
+        time we decide here EXECUTED; replaying it would run its
+        effects twice and burn its retry budget on a success. "Applied"
+        is judged by the call's own return objects: already resolved in
+        the caller's store, located on a surviving node, or durably
+        spilled. An output genuinely lost with the node (none of the
+        above) still replays — that residual window is the documented
+        at-least-once slice reference semantics share."""
+        if self._call_output_applied(spec):
+            _restart_counter("call_deduped").inc()
+            with self._lock:
+                frees = self._unpin_task_locked(spec.task_id.binary())
+            self._fan_out_frees(frees)
+            return
 
         def resubmit(s):
             _restart_counter("call_replayed").inc()
@@ -1009,6 +1078,39 @@ class ClusterHead:
             self._fail_actor_call(s, msg, dead)
 
         self.actor_gate.recover_call(spec, resubmit, fail)
+
+    def _call_output_applied(self, spec) -> bool:
+        """Every return object of the call is already obtainable — the
+        dedupe predicate for replay decisions (see
+        recover_actor_call)."""
+        if not spec.return_ids:
+            return False
+        for oid in spec.return_ids:
+            ob = oid.binary()
+            if self.worker.memory_store.contains(oid):
+                continue
+            if ob in self.object_spill_urls:
+                continue
+            loc = self.object_locations.get(ob)
+            if loc is not None and self._addr_alive(loc):
+                continue
+            return False
+        return True
+
+    def _addr_alive(self, addr) -> bool:
+        addr = tuple(addr)
+        with self._lock:
+            return any(record.alive and record.address == addr
+                       for record in self.nodes.values())
+
+    def _addr_dead(self, addr) -> bool:
+        """The address belongs to a node marked dead (an UNKNOWN
+        address answers False: in-process self-reports have no node
+        record and must keep flowing)."""
+        addr = tuple(addr)
+        with self._lock:
+            return any(not record.alive and record.address == addr
+                       for record in self.nodes.values())
 
     def _fail_actor_call(self, spec, msg: str, dead: bool) -> None:
         from ray_tpu.exceptions import ActorDiedError, \

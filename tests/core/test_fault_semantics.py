@@ -195,6 +195,86 @@ def test_inflight_call_without_budget_rejects_naming_state():
     assert "max_task_retries" in msg and "RESTARTING" in msg
 
 
+def test_replayed_call_with_applied_output_dedupes():
+    """ROADMAP FT gap (a) regression: the call's output REPORT won the
+    race — its return object is already resolved in the caller's store
+    when the death sweep decides. The replay must DEDUPE on
+    return-object identity (no re-execution, no retry-budget burn, the
+    resolved value untouched) instead of double-executing."""
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_lineage(call)
+    head.record_inflight(call, "n1")
+    worker.memory_store.put(call.return_ids[0], 41)
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    kinds = [s.kind for s in submitted]
+    assert kinds.count(TaskKind.ACTOR_CREATION) == 1  # restart ran
+    assert kinds.count(TaskKind.ACTOR_TASK) == 0      # call did NOT
+    assert call.max_retries == 1
+    assert getattr(call, "attempt", 0) == 0
+    ready, value, error = worker.memory_store.peek(call.return_ids[0])
+    assert ready and value == 41 and error is None
+
+
+def test_replayed_call_with_spilled_output_dedupes():
+    """Dedupe evidence #2: a durable spilled copy of the output exists
+    — the call executed; restore-from-disk (not re-execution) owns
+    serving it."""
+    head, worker, submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_lineage(call)
+    head.record_inflight(call, "n1")
+    head._report_spilled([call.return_ids[0].binary()],
+                         ["file:///tmp/rayspec-dedupe-test"])
+
+    head.mark_node_dead("n1", reason="test kill")
+
+    assert [s.kind for s in submitted].count(TaskKind.ACTOR_TASK) == 0
+    assert call.max_retries == 1
+
+
+def test_late_report_from_dead_node_is_ignored():
+    """FT gap (a) companion guard: the dying node's last-gasp output
+    REPORT lands after the death sweep replayed the call. Applying it
+    would re-point the directory at an unreachable address and pop the
+    REPLAY's fresh in-flight record; it must be dropped wholesale. A
+    surviving node's report still applies."""
+    head, worker, submitted = _make_head()
+    dead_addr = head.nodes["n1"].address
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_lineage(call)
+    head.record_inflight(call, "n1")
+
+    head.mark_node_dead("n1", reason="test kill")
+    assert [s.kind for s in submitted].count(TaskKind.ACTOR_TASK) == 1
+
+    # The replay dispatched to a replacement node.
+    head.nodes["n2"] = _NodeRecord("n2", ("127.0.0.1", 7192),
+                                   {"CPU": 2})
+    head.record_inflight(call, "n2")
+    oid = call.return_ids[0].binary()
+
+    head._report_objects([oid], dead_addr)
+    assert call.task_id.binary() in head.inflight
+    assert head.object_locations.get(oid) is None
+
+    head._report_objects([oid], head.nodes["n2"].address)
+    assert call.task_id.binary() not in head.inflight
+    assert head.object_locations.get(oid) == tuple(
+        head.nodes["n2"].address)
+
+
 def test_inflight_call_on_budgetless_actor_gets_actor_died():
     head, worker, submitted = _make_head()
     creation = _creation_spec(max_restarts=0)

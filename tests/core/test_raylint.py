@@ -24,15 +24,17 @@ _REPORT = None
 
 def _run_full():
     # One full analysis shared by every assertion in this module: the
-    # 10-second budget below is per-run, not per-test. tools/raysan and
-    # tools/raymc are linted alongside the runtime — the sanitizer and
-    # model-checker layers enforce concurrency invariants, so they hold
-    # themselves to the same rules.
+    # 10-second budget below is per-run, not per-test. tools/raysan,
+    # tools/raymc and tools/rayspec are linted alongside the runtime —
+    # the sanitizer, model-checker and spec-checker layers enforce
+    # concurrency invariants, so they hold themselves to the same
+    # rules.
     global _REPORT
     if _REPORT is None:
         _REPORT = analyze([os.path.join(REPO_ROOT, "ray_tpu"),
                            os.path.join(REPO_ROOT, "tools", "raysan"),
-                           os.path.join(REPO_ROOT, "tools", "raymc")],
+                           os.path.join(REPO_ROOT, "tools", "raymc"),
+                           os.path.join(REPO_ROOT, "tools", "rayspec")],
                           root=REPO_ROOT)
     return _REPORT
 

@@ -848,3 +848,137 @@ def test_r8_aliased_imports_still_checked():
     """, ["R8"]))
     assert len(vs) == 2, vs
     assert all("not in the registered" in v.message for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# R9 spec-coverage
+# ---------------------------------------------------------------------------
+
+
+def test_r9_registered_literal_taps_clean():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        class Core:
+            def charge(self, job):
+                sanitize_hooks.spec_op("spec.quota.charge", "call",
+                                       self, (job, 1, 2))
+                sanitize_hooks.spec_op("spec.quota.charge", "ret",
+                                       self, True)
+    """, ["R9"]))
+    assert vs == []
+
+
+def test_r9_unregistered_point_flagged():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        class Core:
+            def charge(self):
+                sanitize_hooks.spec_op("spec.quota.chargee", "call",
+                                       self)
+    """, ["R9"]))
+    assert len(vs) == 1 and vs[0].rule == "R9"
+    assert "not in sanitize_hooks.SPEC_POINTS" in vs[0].message
+
+
+def test_r9_computed_point_and_phase_flagged():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        class Core:
+            def op(self, which, phase):
+                sanitize_hooks.spec_op(f"spec.quota.{which}", "call",
+                                       self)
+                sanitize_hooks.spec_op("spec.quota.charge", phase,
+                                       self)
+    """, ["R9"]))
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2, vs
+    assert "must be a literal string" in msgs
+    assert "invocation/response pairing" in msgs
+
+
+def test_r9_tap_without_catalog_entry_flagged():
+    from tools.raylint.core import analyze_source
+    from tools.raylint.rules.r9_spec_coverage import SpecCoverageRule
+    import textwrap
+
+    rule = SpecCoverageRule(
+        registry=frozenset({"spec.orphan.op"}), prefixes={})
+    vs = [v for v in analyze_source(textwrap.dedent("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        class Core:
+            def op(self):
+                sanitize_hooks.spec_op("spec.orphan.op", "call", self)
+    """), [rule], module="ray_tpu.fixture_mod") if not v.suppressed]
+    assert len(vs) == 1
+    assert "no rayspec SPEC_CATALOG entry" in vs[0].message
+
+
+def test_r9_tools_and_tests_exempt():
+    vs = active(lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        def drive(core):
+            sanitize_hooks.spec_op("totally.bogus", "call", core)
+    """, ["R9"], module="tools.rayspec.fixture",
+        relpath="tools/rayspec/fixture.py"))
+    assert vs == []
+
+
+def test_r9_suppression_with_justification_honored():
+    vs = lint("""
+        from ray_tpu._private import sanitize_hooks
+
+
+        class Core:
+            def op(self):
+                sanitize_hooks.spec_op("spec.future.op", "call", self)  # raylint: disable=R9 -- staged rollout: registered next PR with its spec
+    """, ["R9"])
+    assert all(v.suppressed for v in vs if v.rule == "R9")
+
+
+def test_r9_cross_file_coverage_halves():
+    """Finalize half: a catalog entry with no product tap and a
+    registry point never crossed both anchor findings on the registry
+    module — and only when that module is in the linted set."""
+    from tools.raylint.core import FileInfo, run_rules
+    from tools.raylint.rules.r9_spec_coverage import SpecCoverageRule
+
+    registry_src = "SPEC_POINTS = frozenset()\n"
+    product_src = (
+        "from ray_tpu._private import sanitize_hooks\n\n\n"
+        "class Core:\n"
+        "    def op(self):\n"
+        "        sanitize_hooks.spec_op('spec.quota.charge', 'call',"
+        " self)\n")
+    registry_fi = FileInfo(
+        path="ray_tpu/_private/sanitize_hooks.py",
+        relpath="ray_tpu/_private/sanitize_hooks.py",
+        module="ray_tpu._private.sanitize_hooks", source=registry_src)
+    product_fi = FileInfo(
+        path="ray_tpu/_private/core.py",
+        relpath="ray_tpu/_private/core.py",
+        module="ray_tpu._private.core", source=product_src)
+    rule = SpecCoverageRule(
+        registry=frozenset({"spec.quota.charge", "spec.dead.point"}),
+        prefixes={"spec.quota.": "quota_ledger",
+                  "spec.ghost.": "ghost_core"})
+    vs = [v for v in run_rules([registry_fi, product_fi], [rule])
+          if not v.suppressed]
+    msgs = "\n".join(v.message for v in vs)
+    assert "ghost_core" in msgs and "no product spec_op tap" in msgs
+    assert "'spec.dead.point' is never crossed" in msgs
+    assert all(v.path.endswith("sanitize_hooks.py") for v in vs)
+    # Without the registry module in the set, the cross-file half
+    # stays quiet (partial lints must not produce spurious findings).
+    vs = [v for v in run_rules([product_fi], [rule])
+          if not v.suppressed]
+    assert vs == []

@@ -1,0 +1,610 @@
+"""rayspec's own regression suite: the checker demonstrably catches
+seeded non-linearizable histories (and 1-minimizes them), the recorder
+pairs concurrent invocation/response events correctly, and every
+catalog-registered decision core passes its conformance suite — driven
+concurrently against the REAL core, coverage by construction via
+parametrization over ``SPEC_CATALOG`` itself (the other half of the R9
+contract).
+
+The two ISSUE-pinned seeded violations live here: a monkeypatched
+QuotaLedger double-release and the pre-fix FT-gap-(a) double-execution
+history, each flagged with a VERIFIED 1-minimal counterexample and an
+emitted raysan Schedule script.
+"""
+
+import os
+import sys
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:  # `tools` must resolve from the repo root
+    sys.path.insert(0, REPO_ROOT)
+
+from ray_tpu._private import sanitize_hooks  # noqa: E402
+from ray_tpu._private.actor_gate import ActorRestartGate  # noqa: E402
+from ray_tpu._private.config import ray_config  # noqa: E402
+from ray_tpu._private.ids import ActorID, TaskID  # noqa: E402
+from ray_tpu._private.memory_store import MemoryStore  # noqa: E402
+from ray_tpu._private.sched_state import (DepTable,  # noqa: E402
+                                          ShardedTable)
+from ray_tpu._private.task_spec import TaskKind, TaskSpec  # noqa: E402
+from ray_tpu._private.tenancy import (FairTaskQueue,  # noqa: E402
+                                      QuotaLedger)
+from ray_tpu.cluster_utils import ClusterHead, _NodeRecord  # noqa: E402
+
+from tools.rayspec.check import (check_events, linearize,  # noqa: E402
+                                 schedule_script)
+from tools.rayspec.conformance import check_conformance  # noqa: E402
+from tools.rayspec.history import OpEvent, Recorder  # noqa: E402
+from tools.rayspec.specs import (ANY, SPEC_CATALOG,  # noqa: E402
+                                 AtomicRegisterSpec, FifoQueueSpec,
+                                 ShardedTableSpec)
+
+
+def ev(op, args, result, inv, ret, thread="t", point=None):
+    return OpEvent(point=point or f"spec.x.{op}", op=op, args=args,
+                   result=result, invoked=inv, returned=ret,
+                   thread=thread)
+
+
+# ---------------------------------------------------------------------------
+# checker fixtures: classic histories
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_register_concurrent_history_linearizable():
+    # w(1) done; r->1 overlaps w(2); r->2 strictly after: linearizable.
+    h = [ev("write", (1,), None, 0, 1),
+         ev("read", (), 1, 2, 5, "a"),
+         ev("write", (2,), None, 3, 4, "b"),
+         ev("read", (), 2, 6, 7)]
+    (out,) = check_events(h, AtomicRegisterSpec())
+    assert out.status == "ok"
+
+
+def test_atomic_register_stale_read_flagged_and_minimized():
+    # r->2 strictly after w(1) with no w(2) anywhere: impossible.
+    h = [ev("write", (1,), None, 0, 1), ev("read", (), 2, 2, 3)]
+    (out,) = check_events(h, AtomicRegisterSpec())
+    assert out.status == "violation"
+    # 1-minimal: the read of a never-written value alone already fails.
+    assert [e.op for e in out.minimal] == ["read"]
+    assert out.minimal_verified
+
+
+def test_fifo_queue_reorder_flagged_overlap_ok():
+    seq = [ev("enq", (1,), None, 0, 1), ev("enq", (2,), None, 2, 3),
+           ev("deq", (), 2, 4, 5), ev("deq", (), 1, 6, 7)]
+    (out,) = check_events(seq, FifoQueueSpec())
+    assert out.status == "violation"
+    # The same delivery order is FINE when the enqueues overlapped —
+    # either enq may linearize first.
+    lap = [ev("enq", (1,), None, 0, 3), ev("enq", (2,), None, 1, 2, "b"),
+           ev("deq", (), 2, 4, 5), ev("deq", (), 1, 6, 7)]
+    (out,) = check_events(lap, FifoQueueSpec())
+    assert out.status == "ok"
+
+
+def test_pending_invocation_may_or_may_not_take_effect():
+    # A pending enq's item may be observed by a completed deq...
+    h = [ev("enq", ("x",), None, 0, None), ev("deq", (), "x", 1, 2, "b")]
+    (out,) = check_events(h, FifoQueueSpec())
+    assert out.status == "ok"
+    # ...and a pending enq that was never observed is fine too.
+    h = [ev("enq", ("x",), None, 0, None), ev("deq", (), None, 1, 2, "b")]
+    (out,) = check_events(h, FifoQueueSpec())
+    assert out.status == "ok"
+
+
+def test_partition_by_key_still_catches_per_key_violation():
+    """The compositionality rule: checking per key must still catch a
+    violation CONFINED to one key while other keys' (interleaved)
+    subhistories are clean."""
+    spec = ShardedTableSpec()
+    pt = "spec.table."
+    h = [
+        ev("set", ("k1", "v1"), None, 0, 1, point=pt + "set"),
+        ev("set", ("k2", "v2"), None, 2, 3, point=pt + "set"),
+        # k2 reads its own value back: fine.
+        ev("get", ("k2",), "v2", 4, 5, point=pt + "get"),
+        # k1 reads a value NEVER written to k1, strictly after the set:
+        # no linearization explains it.
+        ev("get", ("k1",), "v2", 6, 7, point=pt + "get"),
+    ]
+    outs = {o.key: o for o in check_events(h, spec)}
+    assert outs["k2"].status == "ok"
+    assert outs["k1"].status == "violation"
+    # 1-minimal needs BOTH ops: an absent-key get matches anything (the
+    # tap does not capture the caller's default), so the set is what
+    # pins the cell to "v1" and makes the stray read impossible.
+    assert [e.op for e in outs["k1"].minimal] == ["set", "get"]
+    assert outs["k1"].minimal_verified
+
+
+def test_ddmin_minimal_subhistory_is_one_minimal():
+    """Dropping ANY single event from the emitted minimal sub-history
+    loses the violation — 1-minimality, checked directly."""
+    spec = FifoQueueSpec()
+    noise = [ev("enq", (i,), None, i * 2 + 10, i * 2 + 11)
+             for i in range(4)]
+    bad = [ev("enq", ("a",), None, 0, 1), ev("enq", ("b",), None, 2, 3),
+           ev("deq", (), "b", 4, 5), ev("deq", (), "a", 6, 7)]
+    (out,) = check_events(bad + noise, FifoQueueSpec())
+    assert out.status == "violation" and out.minimal_verified
+    for i in range(len(out.minimal)):
+        candidate = out.minimal[:i] + out.minimal[i + 1:]
+        status, _ = linearize(candidate, spec)
+        assert status == "ok", (
+            f"minimal sub-history is not 1-minimal: dropping event {i} "
+            f"({out.minimal[i].op}) still fails")
+
+
+def test_bounded_search_falls_back_to_undecided():
+    # A wide all-overlapping write burst under a tiny budget: the
+    # checker must give up with 'undecided', never a false verdict.
+    n = 12
+    h = [ev("write", (i,), None, i, 100 + i, f"t{i}") for i in range(n)]
+    h.append(ev("read", (), 0, 200, 201))
+    status, explored = linearize(h, AtomicRegisterSpec(), max_configs=5)
+    assert status == "undecided" and explored >= 5
+
+
+def test_schedule_script_emission_keys():
+    h = [ev("enq", (1,), None, 0, 1, point="spec.wfq.put"),
+         ev("enq", (2,), None, 2, 3, point="spec.wfq.put"),
+         ev("deq", (), 1, 4, 5, point="spec.wfq.pop")]
+    assert schedule_script(h) == ["spec.wfq.put", "spec.wfq.put#2",
+                                  "spec.wfq.pop"]
+
+
+def test_emitted_script_gates_spec_points_under_recorder():
+    """The triage recipe end-to-end: with a Recorder installed, spec
+    taps forward their call phase into the raysan Schedule seam, so an
+    emitted script really gates the op-entry order."""
+    from tools.raysan.sched import Schedule
+
+    order = ["spec.wfq.put", "spec.wfq.put#2"]
+    q = FairTaskQueue(weights={"": 1.0})
+    done = []
+    with Recorder():
+        sched = Schedule(order=order, timeout_s=5.0)
+        with sched:
+            def put(tag):
+                q.put(SimpleNamespace(job_id="", tag=tag))
+                done.append(tag)
+            t1 = threading.Thread(target=put, args=("a",))
+            t2 = threading.Thread(target=put, args=("b",))
+            t1.start(); t1.join(5)
+            t2.start(); t2.join(5)
+        assert sched.completed
+    assert sorted(done) == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# recorder units
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_pairs_calls_and_rets_per_thread():
+    with Recorder() as rec:
+        core = object()
+        sanitize_hooks.spec_op("spec.wfq.put", "call", core, "a")
+        sanitize_hooks.spec_op("spec.wfq.put", "ret", core, None)
+        sanitize_hooks.spec_op("spec.wfq.pop", "call", core, None)
+        # pop never returns: stays pending
+    events = rec.events_for(core)
+    assert [(e.op, e.returned is None) for e in events] == \
+        [("put", False), ("pop", True)]
+    assert events[0].invoked < events[0].returned < events[1].invoked
+
+
+def test_recorder_partitions_by_instance_and_overflows_flagged():
+    a, b = object(), object()
+    with Recorder(max_events=3) as rec:
+        for core in (a, b, a):
+            sanitize_hooks.spec_op("spec.wfq.put", "call", core, None)
+        # 4th event (any) overflows: recording stops, flag set.
+        sanitize_hooks.spec_op("spec.wfq.put", "call", b, None)
+    assert len(rec.events_for(a)) == 2
+    assert len(rec.events_for(b)) == 1
+    assert rec.overflowed
+
+
+def test_recorder_chains_with_previous_hook():
+    seen = []
+    sanitize_hooks.install_spec_op(
+        lambda name, phase, obj, payload: seen.append((name, phase)))
+    try:
+        with Recorder() as rec:
+            sanitize_hooks.spec_op("spec.wfq.put", "call", rec, None)
+            sanitize_hooks.spec_op("spec.wfq.put", "ret", rec, None)
+        assert len(rec.events_for(rec)) == 1
+        assert seen == [("spec.wfq.put", "call"), ("spec.wfq.put", "ret")]
+        assert sanitize_hooks._spec_op is not None  # outer restored
+    finally:
+        sanitize_hooks.install_spec_op(None)
+
+
+def test_uninstalled_taps_are_noops():
+    assert sanitize_hooks._spec_op is None
+    sanitize_hooks.spec_op("spec.wfq.put", "call", object(), None)
+    assert not sanitize_hooks.spec_recording()
+
+
+# ---------------------------------------------------------------------------
+# per-core conformance suites (coverage by construction: every catalog
+# entry must have a drive registered here)
+# ---------------------------------------------------------------------------
+
+
+def _drive_quota_ledger(rec):
+    """Concurrent admit/charge/release churn plus the LEASE slots the
+    PR 13 lease-cache/spillback path acquires and retires per
+    (job, shape) channel — the ledger side of that path is the
+    lease_acquire/lease_release law under concurrency."""
+    old_enf, old_q = ray_config.tenancy_enforcement, ray_config.job_quotas
+    ray_config.tenancy_enforcement = True
+    ray_config.job_quotas = "a=cpus:1,queued:2,leases:2;b=cpus:2"
+    try:
+        led = QuotaLedger()
+
+        def spec_of(job):
+            return SimpleNamespace(job_id=job, resources={"CPU": 0.5},
+                                   attempt=0)
+
+        def churn(job):
+            for _ in range(6):
+                s = spec_of(job)
+                led.note_queued(s)
+                if led.try_acquire_cpu(s):
+                    led.release_cpu(s)
+                led.note_dequeued(s)
+                if led.try_acquire_lease(job):
+                    led.release_lease(job)
+
+        ts = [threading.Thread(target=churn, args=(j,))
+              for j in ("a", "a", "b")]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        led.take_dispatchable()
+        return led
+    finally:
+        ray_config.tenancy_enforcement = old_enf
+        ray_config.job_quotas = old_q
+
+
+def _drive_dep_table(rec):
+    dt = DepTable()
+    items = {k: SimpleNamespace(name=k) for k in ("A", "B", "C")}
+    dt.park(b"A", items["A"], [b"d1"])
+    dt.park(b"B", items["B"], [b"d1", b"d2"])
+    dt.park(b"C", items["C"], [b"d2"])
+    ts = [threading.Thread(target=dt.dep_ready, args=(d,))
+          for d in (b"d1", b"d2")]
+    ts.append(threading.Thread(
+        target=lambda: dt.sweep(lambda it: it is items["C"])))
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return dt
+
+
+def _drive_actor_gate(rec):
+    gate = ActorRestartGate()
+    gate.register(b"a1", 2)
+    gate.register(b"a2", 0)
+    call = SimpleNamespace(
+        actor_id=SimpleNamespace(binary=lambda: b"a1"),
+        max_retries=1, attempt=0, describe=lambda: "A.f")
+
+    def deaths():
+        gate.begin_restart(b"a1", "n1 died")
+        gate.ready(b"a1")
+        gate.begin_restart(b"a2", "n1 died")  # budget 0 -> tombstone
+
+    def calls():
+        gate.route_call(call, dispatch=None, park=lambda s: None,
+                        fail=lambda s, m, d: None)
+        gate.recover_call(call, resubmit=lambda s: None,
+                          fail=lambda s, m, d: None)
+
+    ts = [threading.Thread(target=deaths),
+          threading.Thread(target=calls)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return gate
+
+
+def _drive_sharded_table(rec):
+    """Mixed per-key churn shaped like the head's hot tables under the
+    PR 13 scheduler (inflight record/clear via set/pop, directory
+    setdefault/contains probes, spillback-style re-reads)."""
+    st = ShardedTable(8)
+
+    def worker(i):
+        key = f"task-{i}"
+        st[key] = ("n1", i)
+        assert st.get(key) == ("n1", i)
+        st.setdefault(key, ("n9", -1))
+        assert key in st
+        if i % 2:
+            st.pop(key)
+        else:
+            st[key] = ("n2", i)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(10)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return st
+
+
+def _drive_fair_task_queue(rec):
+    # Equal weights: the catalog's spec factory models the default
+    # weight; a weighted queue needs a matching
+    # FairTaskQueueSpec(weights=...) — covered separately below.
+    q = FairTaskQueue(weights={"a": 1.0, "b": 1.0})
+    items = [SimpleNamespace(job_id=j, tag=f"{j}{i}")
+             for j in ("a", "b") for i in range(4)]
+    got = []
+
+    def producer():
+        for item in items:
+            q.put(item)
+
+    def consumer():
+        import queue as _q
+
+        for _ in range(len(items)):
+            try:
+                got.append(q.get(timeout=2))
+            except _q.Empty:
+                return
+
+    ts = [threading.Thread(target=producer),
+          threading.Thread(target=consumer)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return q
+
+
+def _drive_exactly_once_call(rec):
+    head, worker, _submitted = _make_head()
+    creation = _creation_spec(max_restarts=1)
+    head.record_lineage(creation)
+    head.set_actor_node(creation.actor_id.binary(), "n1")
+    call = _call_spec(creation, max_task_retries=1)
+    head.record_lineage(call)
+    head.record_inflight(call, "n1")
+    head._report_objects([call.return_ids[0].binary()],
+                         head.nodes["n1"].address)
+    return head
+
+
+CORE_DRIVES = {
+    "quota_ledger": _drive_quota_ledger,
+    "dep_table": _drive_dep_table,
+    "actor_gate": _drive_actor_gate,
+    "sharded_table": _drive_sharded_table,
+    "fair_task_queue": _drive_fair_task_queue,
+    "exactly_once_call": _drive_exactly_once_call,
+}
+
+
+def test_every_catalog_entry_has_a_conformance_drive():
+    assert set(CORE_DRIVES) == set(SPEC_CATALOG), (
+        "every SPEC_CATALOG entry needs a conformance drive here "
+        "(and vice versa) — this is the R9 contract's testing half")
+
+
+def test_weighted_wfq_history_checks_under_matching_spec():
+    """Non-default weights: the spec instance must carry the queue's
+    weights (the catalog factory models the default); with them, a
+    weighted real queue's history linearizes — and the same history
+    FAILS under a deliberately wrong weight map, proving the
+    virtual-time law (not just FIFO-per-class) is what's checked."""
+    from tools.rayspec.specs import FairTaskQueueSpec
+
+    weights = {"a": 4.0, "b": 1.0}
+    with Recorder() as rec:
+        q = FairTaskQueue(weights=weights)
+        for j, i in [("a", 0), ("b", 0), ("a", 1), ("a", 2),
+                     ("b", 1), ("a", 3)]:
+            q.put(SimpleNamespace(job_id=j, tag=f"{j}{i}"))
+        for _ in range(6):
+            q.get_nowait()
+    raw = rec.events_for(q)
+    spec = FairTaskQueueSpec(weights=weights)
+    events, _ = spec.adapt(raw)
+    assert all(o.status == "ok" for o in check_events(events, spec))
+    wrong = FairTaskQueueSpec(weights={"a": 1.0, "b": 4.0})
+    events, _ = wrong.adapt(raw)
+    assert any(o.status == "violation"
+               for o in check_events(events, wrong))
+
+
+def test_conformance_binds_live_queue_weights():
+    """Review regression: the catalog factory cannot know a queue's
+    weight map — conformance must BIND it from the live core, or a
+    weighted queue's correct picks read as WFQ violations."""
+    weights = {"a": 4.0, "b": 1.0}
+    with Recorder() as rec:
+        q = FairTaskQueue(weights=weights)
+        for j, i in [("a", 0), ("b", 0), ("a", 1), ("a", 2),
+                     ("b", 1), ("a", 3)]:
+            q.put(SimpleNamespace(job_id=j, tag=f"{j}{i}"))
+        for _ in range(4):
+            q.get_nowait()
+    assert check_conformance(rec.events_for(q),
+                             SPEC_CATALOG["fair_task_queue"], q) is None
+
+
+@pytest.mark.parametrize("name", sorted(SPEC_CATALOG))
+def test_core_conformance(name):
+    """Drive the REAL core concurrently under the recorder; the
+    history must linearize against the spec, and (where a live
+    abstraction exists) the end state must be spec-reachable."""
+    entry = SPEC_CATALOG[name]
+    with Recorder() as rec:
+        core = CORE_DRIVES[name](rec)
+    raw = rec.events_for(core)
+    assert raw, f"drive for {name} recorded nothing"
+    spec = entry.factory()
+    events, _tokens = spec.adapt(raw)
+    outcomes = check_events(events, spec)
+    assert outcomes and all(o.status == "ok" for o in outcomes), [
+        (o.key, o.status, o.message) for o in outcomes
+        if o.status != "ok"]
+    if entry.supports_conformance:
+        assert check_conformance(raw, entry, core) is None
+
+
+# ---------------------------------------------------------------------------
+# seeded violations (the ISSUE's acceptance pair)
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_quota_ledger_double_release_flagged():
+    """Monkeypatched bug: release_cpu forgets to clear the charge
+    token, so a spec releases twice. The ledger spec calls the second
+    release ILLEGAL (usage would go negative) — no linearization
+    survives — with a verified 1-minimal counterexample and a replay
+    script."""
+    old_enf, old_q = ray_config.tenancy_enforcement, ray_config.job_quotas
+    ray_config.tenancy_enforcement = True
+    ray_config.job_quotas = "a=cpus:1"
+    try:
+        with Recorder() as rec:
+            led = QuotaLedger()
+            s = SimpleNamespace(job_id="a", resources={"CPU": 1.0},
+                                attempt=0)
+            assert led.try_acquire_cpu(s)
+            token = s._quota_cpu
+            led.release_cpu(s)
+            s._quota_cpu = token  # the seeded bug: token not cleared
+            led.release_cpu(s)
+        entry = SPEC_CATALOG["quota_ledger"]
+        spec = entry.factory()
+        events, _ = spec.adapt(rec.events_for(led))
+        (out,) = check_events(events, spec)
+        assert out.status == "violation"
+        assert [e.op for e in out.minimal] == ["release"]
+        assert out.minimal_verified
+        assert out.schedule_order == ["spec.quota.release"]
+    finally:
+        ray_config.tenancy_enforcement = old_enf
+        ray_config.job_quotas = old_q
+
+
+def _make_head():
+    worker = SimpleNamespace(memory_store=MemoryStore(), shm_plane=None,
+                             gcs=None, backend=None)
+    head = ClusterHead(worker, start_server=False)
+    submitted = []
+    worker.backend = SimpleNamespace(submit=submitted.append)
+    head.nodes["n1"] = _NodeRecord("n1", ("127.0.0.1", 7191), {"CPU": 2})
+    return head, worker, submitted
+
+
+def _creation_spec(max_restarts=0):
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_CREATION, func=object,
+                    args=(), kwargs={}, name="A.__init__",
+                    actor_id=ActorID.from_random(),
+                    max_restarts=max_restarts)
+    spec.assign_return_ids()
+    return spec
+
+
+def _call_spec(creation, max_task_retries=0):
+    spec = TaskSpec(task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_TASK, func="f", args=(),
+                    kwargs={}, name="A.f", actor_id=creation.actor_id,
+                    max_retries=max_task_retries)
+    spec.assign_return_ids()
+    return spec
+
+
+def _gap_a_history(monkeypatch, prefix_behavior: bool):
+    """Drive the FT-gap-(a) interleaving against a real head; with
+    ``prefix_behavior`` the dedupe + dead-node-report guard are
+    disabled (the PRE-fix code paths)."""
+    if prefix_behavior:
+        monkeypatch.setattr(ClusterHead, "_call_output_applied",
+                            lambda self, spec: False)
+        monkeypatch.setattr(ClusterHead, "_addr_dead",
+                            lambda self, addr: False)
+    with Recorder() as rec:
+        head, worker, _submitted = _make_head()
+        creation = _creation_spec(max_restarts=1)
+        head.record_lineage(creation)
+        head.set_actor_node(creation.actor_id.binary(), "n1")
+        call = _call_spec(creation, max_task_retries=1)
+        head.record_lineage(call)
+        head.record_inflight(call, "n1")
+        dead_addr = head.nodes["n1"].address
+        head.mark_node_dead("n1", reason="chaos kill")
+        if call.attempt:  # the replay dispatched to a replacement
+            head.nodes["n2"] = _NodeRecord("n2", ("127.0.0.1", 7192),
+                                           {"CPU": 2})
+            head.record_inflight(call, "n2")
+        oid = call.return_ids[0].binary()
+        # Execution #1's output REPORT, in flight at node death, lands.
+        head._report_objects([oid], dead_addr)
+        # The replay's execution reports from the replacement.
+        if call.attempt:
+            head._report_objects([oid], ("127.0.0.1", 7192))
+    entry = SPEC_CATALOG["exactly_once_call"]
+    spec = entry.factory()
+    events, _ = spec.adapt(rec.events_for(head))
+    return check_events(events, spec)
+
+
+def test_prefix_gap_a_double_execution_history_flagged(monkeypatch):
+    outcomes = _gap_a_history(monkeypatch, prefix_behavior=True)
+    bad = [o for o in outcomes if o.status == "violation"]
+    assert bad, "pre-fix double execution was NOT flagged"
+    (out,) = bad
+    assert [e.op for e in out.minimal] == ["apply", "apply"]
+    assert out.minimal_verified
+    assert out.schedule_order == ["spec.call.apply", "spec.call.apply#2"]
+
+
+def test_fixed_gap_a_history_clean(monkeypatch):
+    outcomes = _gap_a_history(monkeypatch, prefix_behavior=False)
+    assert all(o.status == "ok" for o in outcomes), [
+        (o.key, o.status, o.message) for o in outcomes]
+
+
+# ---------------------------------------------------------------------------
+# deterministic report artifacts (tools/reporting.py)
+# ---------------------------------------------------------------------------
+
+
+def test_report_artifact_is_deterministic_modulo_volatile(tmp_path):
+    from tools.reporting import (render_deterministic, split_volatile,
+                                 write_report_artifact)
+
+    a = {"pass": True, "elapsed_s": 1.23,
+         "scenarios": [{"name": "x", "elapsed_s": 4.5, "count": 7}]}
+    b = {"pass": True, "elapsed_s": 9.87,
+         "scenarios": [{"name": "x", "elapsed_s": 0.1, "count": 7}]}
+    assert render_deterministic(a, ("elapsed_s",)) == \
+        render_deterministic(b, ("elapsed_s",))
+    # But a REAL difference still shows.
+    c = {**a, "pass": False}
+    assert render_deterministic(a, ("elapsed_s",)) != \
+        render_deterministic(c, ("elapsed_s",))
+    # The sidecar keeps the real values, path-addressed.
+    _norm, timings = split_volatile(a, ("elapsed_s",))
+    assert timings == {"elapsed_s": 1.23,
+                       "scenarios[0].elapsed_s": 4.5}
+    # write_report_artifact: artifact + sidecar land; artifact bytes
+    # identical across the two volatile-differing runs.
+    p1, p2 = tmp_path / "r1.json", tmp_path / "r2.json"
+    assert write_report_artifact(str(p1), a)
+    assert write_report_artifact(str(p2), b)
+    assert p1.read_bytes() == p2.read_bytes()
+    assert (tmp_path / "r1.json.timing.json").exists()

@@ -13,6 +13,7 @@ from tools.raylint.rules.r5_wire_hygiene import WireHygieneRule
 from tools.raylint.rules.r6_hygiene import HygieneRule
 from tools.raylint.rules.r7_ambient import AmbientStateRule
 from tools.raylint.rules.r8_yield_points import YieldPointHygieneRule
+from tools.raylint.rules.r9_spec_coverage import SpecCoverageRule
 
 _RULE_CLASSES = (
     AsyncBlockingRule,
@@ -23,6 +24,7 @@ _RULE_CLASSES = (
     HygieneRule,
     AmbientStateRule,
     YieldPointHygieneRule,
+    SpecCoverageRule,
 )
 
 

@@ -1,0 +1,169 @@
+"""R9 spec-coverage: decision-core taps, registry, and catalog agree.
+
+rayspec's correctness story has three legs that can silently drift
+apart: the ``sanitize_hooks.SPEC_POINTS`` registry (what exists), the
+``spec_op`` call sites in the decision cores (what is actually
+recorded), and ``tools.rayspec.specs.SPEC_CATALOG`` (what has an
+executable sequential specification). A tap with a typo'd name records
+nothing; a tapped core with no spec records history nobody checks; a
+catalog entry whose core lost its taps "passes" every check vacuously.
+
+So, for every ``spec_op`` call site inside ``ray_tpu/``:
+
+- the point name must be a LITERAL string registered in
+  ``SPEC_POINTS`` (same contract as R8 for sched/crash points);
+- the phase must be the literal ``"call"`` or ``"ret"`` (a computed
+  phase breaks the recorder's invocation/response pairing silently);
+- the point's ``spec.<core>.`` prefix must belong to a catalog entry
+  (recorded history nobody can check is a lie of omission).
+
+And cross-file, when the registry module itself is in the linted set
+(the tier-1 sweep over all of ``ray_tpu/``):
+
+- every catalog entry's prefix must be crossed by at least one product
+  call site (a spec with no taps proves nothing);
+- every registered SPEC_POINTS name must be crossed somewhere in
+  product code (a dead registry entry is a point the tools believe in
+  that can never fire).
+
+The other half of the contract — every catalog entry has a
+conformance test — is enforced by construction in
+``tests/core/test_rayspec.py``: its per-core suites parametrize over
+``SPEC_CATALOG`` itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.raylint.core import FileInfo, Project, Rule
+
+_PHASES = ("call", "ret")
+
+
+def _default_registry():
+    from ray_tpu._private.sanitize_hooks import SPEC_POINTS
+
+    return frozenset(SPEC_POINTS)
+
+
+def _default_prefixes():
+    from tools.rayspec.specs import SPEC_CATALOG
+
+    return {entry.prefix: name
+            for name, entry in SPEC_CATALOG.items()}
+
+
+class SpecCoverageRule(Rule):
+    id = "R9"
+    name = "spec-coverage"
+    description = ("spec_op taps literal+registered; taps, SPEC_POINTS "
+                   "and the rayspec catalog cover each other")
+
+    def __init__(self, registry: Optional[frozenset] = None,
+                 prefixes: Optional[dict] = None):
+        # Injectable for fixture tests; defaults to the live registry
+        # and catalog so the rule can never drift from the code.
+        self._registry = registry
+        self._prefixes = prefixes
+
+    def _points(self) -> frozenset:
+        if self._registry is None:
+            self._registry = _default_registry()
+        return self._registry
+
+    def _catalog_prefixes(self) -> dict:
+        if self._prefixes is None:
+            self._prefixes = _default_prefixes()
+        return self._prefixes
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        if fi.package is None:
+            return  # tooling/tests are the recorder, not the recorded
+        if fi.relpath.endswith("_private/sanitize_hooks.py"):
+            return  # the registry itself
+        for node in fi.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_spec_op(node.func):
+                continue
+            if len(node.args) < 2:
+                yield (node.lineno,
+                       "`spec_op()` needs (point, phase, obj[, "
+                       "payload])")
+                continue
+            point_arg, phase_arg = node.args[0], node.args[1]
+            if not (isinstance(point_arg, ast.Constant)
+                    and isinstance(point_arg.value, str)):
+                yield (node.lineno,
+                       "`spec_op` point name must be a literal string "
+                       "(a computed name cannot be registered or "
+                       "gated)")
+                continue
+            point = point_arg.value
+            if point not in self._points():
+                yield (node.lineno,
+                       f"`spec_op({point!r})` is not in "
+                       f"sanitize_hooks.SPEC_POINTS — an unregistered "
+                       f"tap silently records nothing the tools know "
+                       f"about")
+            else:
+                prefix = ".".join(point.split(".")[:2]) + "."
+                if prefix not in self._catalog_prefixes():
+                    yield (node.lineno,
+                           f"`spec_op({point!r})`: no rayspec "
+                           f"SPEC_CATALOG entry owns prefix "
+                           f"{prefix!r} — recorded history nobody "
+                           f"checks")
+            if not (isinstance(phase_arg, ast.Constant)
+                    and phase_arg.value in _PHASES):
+                yield (node.lineno,
+                       "`spec_op` phase must be the literal \"call\" "
+                       "or \"ret\" (a computed phase breaks "
+                       "invocation/response pairing silently)")
+
+    def finalize(self, project: Project) \
+            -> Iterable[Tuple[FileInfo, int, str]]:
+        registry_fi = None
+        crossed = set()
+        for fi in project.files:
+            if fi.package is None:
+                continue
+            if fi.relpath.endswith("_private/sanitize_hooks.py"):
+                registry_fi = fi
+                continue
+            for node in fi.nodes():
+                if isinstance(node, ast.Call) \
+                        and self._is_spec_op(node.func) and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        crossed.add(arg.value)
+        if registry_fi is None:
+            # Partial lint (fixtures, single files): the cross-file
+            # coverage half only makes sense over the whole package.
+            return
+        for prefix, name in sorted(self._catalog_prefixes().items()):
+            if not any(p.startswith(prefix) for p in crossed):
+                yield (registry_fi, 1,
+                       f"rayspec catalog entry {name!r} (prefix "
+                       f"{prefix!r}) has no product spec_op tap — its "
+                       f"spec can never check a recorded history")
+        for point in sorted(self._points() - crossed):
+            yield (registry_fi, 1,
+                   f"SPEC_POINTS entry {point!r} is never crossed by "
+                   f"product code — dead registry entry")
+
+    @staticmethod
+    def _is_spec_op(func) -> bool:
+        if isinstance(func, ast.Attribute) and func.attr == "spec_op":
+            root = func.value
+            if isinstance(root, ast.Name) \
+                    and root.id == "sanitize_hooks":
+                return True
+            if isinstance(root, ast.Attribute) \
+                    and root.attr == "sanitize_hooks":
+                return True
+            return False
+        return isinstance(func, ast.Name) and func.id == "spec_op"

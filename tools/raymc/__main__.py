@@ -104,12 +104,13 @@ def main(argv=None) -> int:
             for f in r.findings:
                 print("  " + f.render().replace("\n", "\n  "))
     if args.report_file:
-        try:
-            with open(args.report_file, "w", encoding="utf-8") as f:
-                json.dump(report, f, indent=2)
-        except OSError as e:
-            print(f"raymc: could not write report file "
-                  f"{args.report_file}: {e}", file=sys.stderr)
+        # Deterministic artifact: wall-clock noise goes to the
+        # .timing.json sidecar so back-to-back identical runs produce
+        # byte-identical committed reports.
+        from tools.reporting import write_report_artifact
+
+        write_report_artifact(args.report_file, report,
+                              volatile=("elapsed_s",))
 
     return 0 if report["pass"] else 1
 

@@ -30,6 +30,7 @@ class CheckResult:
     divergences: int = 0
     exhausted: bool = False
     elapsed_s: float = 0.0
+    conformance_checks: int = 0
     findings: List[Finding] = dataclasses.field(default_factory=list)
 
     @property
@@ -46,6 +47,7 @@ class CheckResult:
             "divergences": self.divergences,
             "exhausted": self.exhausted,
             "elapsed_s": round(self.elapsed_s, 3),
+            "conformance_checks": self.conformance_checks,
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -94,6 +96,7 @@ def check(scenario_factory: Callable[[], Scenario],
         result.executions += 1
         result.steps_total += len(res.steps)
         result.pruned += res.sleep_leaves
+        result.conformance_checks += res.conformance_checks
         if res.truncated:
             result.truncated += 1
         if res.status == "divergence":

@@ -129,6 +129,7 @@ class ExecutionResult:
     truncated: bool = False
     errors: List[str] = dataclasses.field(default_factory=list)
     sleep_leaves: int = 0
+    conformance_checks: int = 0      # rayspec refinement checks run
 
 
 class ExplorerConfig:
@@ -187,6 +188,12 @@ class Execution:
         self._last_grant: Dict[str, int] = {}
         self._truncated = False
         self._controller_ident: Optional[int] = None
+        # rayspec conformance mode: bindings declared by the scenario,
+        # a per-execution history recorder, and the check counter.
+        self._conf_bindings = scenario.conformance()
+        self._recorder = None
+        self._conf_sessions: Optional[dict] = None
+        self._conf_checks = 0
 
     # -- the installed yield/crash hook ------------------------------------
 
@@ -310,6 +317,16 @@ class Execution:
         self._controller_ident = threading.get_ident()
         prev_sched = sanitize_hooks._sched_point
         prev_crash = sanitize_hooks._crash_point
+        if self._conf_bindings:
+            # Conformance mode: record the cores' spec-op history for
+            # this whole execution — INCLUDING setup (the history must
+            # account for every op that shaped the core's state, and
+            # setup's seeding ops are part of that account even though
+            # they are "before time zero" for interleaving purposes).
+            from tools.rayspec.history import Recorder
+
+            self._recorder = Recorder(max_events=100_000)
+            self._recorder.__enter__()
         # Setup runs BEFORE the hooks go in: it is "before time zero",
         # and its crossings (initial broadcasts, warmup writes) are not
         # part of the explored interleaving. Runtime-internal threads
@@ -333,15 +350,19 @@ class Execution:
                 self._errors.append("action threads outlived release")
             if status == "ok":
                 # End-state pass: invariants again (the last transition
-                # may have broken one) plus bounded liveness.
+                # may have broken one) plus bounded liveness, plus the
+                # rayspec refinement check against the final state.
                 violations = self.scn.violations(include_liveness=True)
+                if not violations:
+                    violations = self._conformance_violations()
                 if violations:
                     status = "violation"
             return ExecutionResult(
                 status=status, steps=self._steps,
                 crossings=self._crossings, pending=pending,
                 violations=violations, truncated=self._truncated,
-                errors=self._errors, sleep_leaves=self.sleep_leaves)
+                errors=self._errors, sleep_leaves=self.sleep_leaves,
+                conformance_checks=self._conf_checks)
         finally:
             sanitize_hooks.install_sched_point(prev_sched)
             sanitize_hooks.install_crash_point(prev_crash)
@@ -349,6 +370,9 @@ class Execution:
                 self.scn.teardown()
             except Exception as e:
                 self._errors.append(f"teardown raised: {e!r}")
+            if self._recorder is not None:
+                self._recorder.__exit__()
+                self._recorder = None
 
     def _control_loop(self) -> Tuple[str, List[str]]:
         deadline = time.monotonic() + self.cfg.exec_timeout_s
@@ -357,6 +381,12 @@ class Execution:
             if not self._wait_quiescent(deadline):
                 return "timeout", []
             violations = self.scn.violations(include_liveness=False)
+            if not violations:
+                # Conformance mode: every quiescent state is also a
+                # refinement check — the live cores' states must be
+                # reachable by some linearization of the recorded
+                # history so far.
+                violations = self._conformance_violations()
             if violations:
                 return "violation", violations
             with self._lock:
@@ -391,6 +421,56 @@ class Execution:
                                if self._indep(t, decision)}
             self._grant(decision)
             step += 1
+
+    def _conformance_violations(self) -> List[str]:
+        """Run the scenario's rayspec conformance bindings against the
+        recorded history (cached across the DFS's replayed prefixes —
+        see tools.rayspec.conformance). Called only at quiescent
+        states: parked threads sit BEFORE the cores' locks (every spec
+        tap gates outside them), so the live snapshot is consistent."""
+        if not self._conf_bindings or self._recorder is None:
+            return []
+        if self._recorder.overflowed:
+            # A truncated history cannot judge the live state — the
+            # comparison would manufacture divergences (and the
+            # unchanged-count skip would then freeze a stale verdict).
+            # Surfacing it as an error fails the scenario loudly: the
+            # fix is a bigger recorder or a smaller scenario, never a
+            # silent half-check.
+            msg = ("conformance recording overflowed "
+                   f"({self._recorder.max_events} events) — refusing "
+                   "to check against a truncated history")
+            if msg not in self._errors:
+                self._errors.append(msg)
+            return []
+        from tools.rayspec.conformance import ConformanceSession
+        from tools.rayspec.specs import SPEC_CATALOG
+
+        if self._conf_sessions is None:
+            self._conf_sessions = {
+                name: ConformanceSession(SPEC_CATALOG[name])
+                for name, _getter in self._conf_bindings}
+        out: List[str] = []
+        for name, getter in self._conf_bindings:
+            try:
+                core = getter()
+            except Exception as e:
+                self._errors.append(
+                    f"conformance getter {name!r} raised: {e!r}")
+                continue
+            if core is None:
+                continue
+            self._conf_checks += 1
+            try:
+                msg = self._conf_sessions[name].check(
+                    self._recorder, core)
+            except Exception as e:
+                self._errors.append(
+                    f"conformance check {name!r} raised: {e!r}")
+                continue
+            if msg is not None:
+                out.append(f"conformance-{name}: {msg}")
+        return out
 
     def _indep(self, a, b) -> bool:
         """Same doubt-answers-dependent guard as checker._independent:

@@ -24,7 +24,7 @@ happened to make — most of them irrelevant noise. This module:
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Tuple
 
 from tools.raymc.explorer import Decision, ExecutionResult, _Cross
 from tools.raymc.props import Counterexample
@@ -32,6 +32,47 @@ from tools.raymc.props import Counterexample
 
 def _prop_names(violations: List[str]) -> set:
     return {v.split(":", 1)[0] for v in violations}
+
+
+def ddmin(fails: Callable[[list], bool], items: list,
+          max_probes: int = 48) -> list:
+    """Generic delta-debugging minimization (classic ddmin over
+    chunks, bounded probe budget): the smallest order-preserving
+    sublist of ``items`` for which ``fails`` still returns truthy —
+    1-minimal when the budget allows (dropping any single remaining
+    item loses the failure). ``fails(items)`` is assumed truthy for
+    the input. Shared engine: raymc shrinks scheduling-decision lists
+    through it, rayspec shrinks non-linearizable sub-histories."""
+    probes = [0]
+
+    def check(candidate: list) -> bool:
+        if probes[0] >= max_probes:
+            return False
+        probes[0] += 1
+        return bool(fails(candidate))
+
+    current = list(items)
+    # Fast path: does the empty list already fail?
+    if check([]):
+        return []
+    n = 2
+    while len(current) >= 2 and probes[0] < max_probes:
+        chunk = max(1, len(current) // n)
+        reduced = False
+        i = 0
+        while i < len(current):
+            candidate = current[:i] + current[i + chunk:]
+            if check(candidate):
+                current = candidate
+                n = max(n - 1, 2)
+                reduced = True
+            else:
+                i += chunk
+        if not reduced:
+            if n >= len(current):
+                break
+            n = min(n * 2, len(current))
+    return current
 
 
 def minimize_decisions(
@@ -42,48 +83,23 @@ def minimize_decisions(
     """ddmin over the decision list; returns (minimal decisions, the
     minimal run's result). ``run`` executes a fresh scenario instance
     under the candidate prefix."""
-    probes = [0]
+    results: dict = {}
 
-    def fails(candidate: List[Decision]) -> Optional[ExecutionResult]:
-        if probes[0] >= max_probes:
-            return None
-        probes[0] += 1
+    def fails(candidate: List[Decision]) -> bool:
         res = run(candidate)
-        if res.status in ("violation", "deadlock") \
-                and (_prop_names(res.violations) & target_props
-                     or (res.status == "deadlock"
-                         and "deadlock" in target_props)):
-            return res
-        return None
+        hit = res.status in ("violation", "deadlock") \
+            and (_prop_names(res.violations) & target_props
+                 or (res.status == "deadlock"
+                     and "deadlock" in target_props))
+        if hit:
+            results[id_key(candidate)] = res
+        return hit
 
-    current = list(decisions)
-    best_res = None
+    def id_key(candidate: List[Decision]) -> tuple:
+        return tuple(map(tuple, candidate))
 
-    # Fast path: does the empty prefix (pure default policy) fail?
-    res = fails([])
-    if res is not None:
-        return [], res
-
-    n = 2
-    while len(current) >= 2 and probes[0] < max_probes:
-        chunk = max(1, len(current) // n)
-        reduced = False
-        i = 0
-        while i < len(current):
-            candidate = current[:i] + current[i + chunk:]
-            res = fails(candidate)
-            if res is not None:
-                current = candidate
-                best_res = res
-                n = max(n - 1, 2)
-                reduced = True
-            else:
-                i += chunk
-        if not reduced:
-            if n >= len(current):
-                break
-            n = min(n * 2, len(current))
-
+    current = ddmin(fails, list(decisions), max_probes=max_probes)
+    best_res = results.get(id_key(current))
     if best_res is None:
         best_res = run(current)
     return current, best_res

@@ -111,6 +111,18 @@ class Scenario:
     def liveness(self) -> List[Liveness]:
         return []
 
+    def conformance(self) -> List[Tuple[str, Callable[[], object]]]:
+        """rayspec conformance bindings: ``(catalog name, live-core
+        getter)`` pairs. When non-empty, the explorer records the
+        cores' spec-op history for each execution and, at every
+        quiescent state, cross-checks the live core against the
+        executable sequential spec's reachable states — each explored
+        schedule becomes a refinement check, not just a property list.
+        The getter runs at check time (a scenario may build the core
+        in ``setup``); returning ``None`` skips the binding for this
+        state."""
+        return []
+
     # -- fault + observation seams ----------------------------------------
 
     def on_crash(self, point: str) -> None:

@@ -865,6 +865,14 @@ class LineageReconstructionScenario(Scenario):
             description="gets on lost objects return the re-executed/"
                         "restored values, not errors")]
 
+    def conformance(self):
+        # rayspec refinement over the head's lock-partitioned object
+        # directory (ShardedTable): under the publish/death/
+        # reconstruct churn, the directory must stay a refinement of
+        # ONE flat dict per key — the catalog's sharded_table spec
+        # checked against a REAL head under exploration.
+        return [("sharded_table", lambda: self.head.object_locations)]
+
     def teardown(self) -> None:
         from ray_tpu._private.config import ray_config
 
@@ -901,8 +909,10 @@ class ActorRestartScenario(Scenario):
     # gate.recover_call, and the restarted actor's location release
     # drains parked calls. Execution and its inflight-clear are one
     # atomic segment — the model's analog of the output report; the
-    # report-in-flight window (at-least-once, as in the reference) is
-    # out of scope here.
+    # report-in-flight window is out of scope here (closed by the
+    # caller-side dedupe in ClusterHead.recover_actor_call — ROADMAP
+    # FT gap (a) — with the rayspec exactly_once_call spec as the
+    # mechanical witness, see test_rayspec.py's pre-fix history test).
 
     def setup(self) -> None:
         from types import SimpleNamespace
@@ -1096,6 +1106,13 @@ class ActorRestartScenario(Scenario):
                                  "pre-death or was rejected — exactly "
                                  "one of the two"),
         ]
+
+    def conformance(self):
+        # rayspec refinement: the REAL gate's FSM state and remaining
+        # budget must match a linearization of the recorded
+        # register/restart/ready/route/replay history at every
+        # quiescent state of every death placement.
+        return [("actor_gate", lambda: self.gate)]
 
     def teardown(self) -> None:
         pass
@@ -1299,6 +1316,15 @@ class QuotaAdmissionScenario(Scenario):
                          description="every racing submit resolves to "
                                      "a definite grant/deny outcome")]
 
+    def conformance(self):
+        # rayspec refinement: at every quiescent state, the REAL
+        # ledger and fair queue must sit in a state some linearization
+        # of the recorded charge/release/admit (resp. put/pop) history
+        # reaches — the scenario's invariants prove the properties,
+        # the conformance pass proves the state.
+        return [("quota_ledger", lambda: self.ledger),
+                ("fair_task_queue", lambda: self.wfq)]
+
     def conflict_key(self, point: str):
         # The ledger (quota counters + model grant/release lists) and
         # the fair queue (items + put/pop model lists) are DISJOINT
@@ -1433,6 +1459,13 @@ class DepSweepScenario(Scenario):
                          timeout_s=2.0,
                          description="every parked item ends owned by "
                                      "the ready path or the sweep")]
+
+    def conformance(self):
+        # rayspec refinement: the live DepTable's remaining-count rows
+        # must match a linearization of the park/ready/sweep history
+        # at every quiescent state (FT gap (d)'s exactly-once handoff,
+        # now also proven as a refinement of the sequential model).
+        return [("dep_table", lambda: self.table)]
 
     def teardown(self) -> None:
         pass

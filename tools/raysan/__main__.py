@@ -64,6 +64,7 @@ def main(argv=None) -> int:
 
     fd, report_path = tempfile.mkstemp(prefix="raysan-", suffix=".json")
     os.close(fd)
+    report = None
     try:
         rc = pytest.main(
             args.paths + args.pytest_args.split() + [
@@ -81,18 +82,17 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     finally:
-        if args.report_file:
-            try:
-                # shutil.move copies across filesystems (the tmp report
-                # honors TMPDIR/tmpfs; os.replace would EXDEV there and
-                # silently drop the CI artifact).
-                import shutil
+        if args.report_file and report is not None:
+            # Deterministic artifact: the run's wall clock goes to the
+            # .timing.json sidecar so back-to-back identical runs
+            # produce byte-identical committed reports. (Replaces the
+            # tmp-file move — the artifact is re-serialized, which
+            # also dodges the historical cross-fs EXDEV hazard.)
+            from tools.reporting import write_report_artifact
 
-                shutil.move(report_path, args.report_file)
-            except OSError as e:
-                print(f"raysan: could not write report file "
-                      f"{args.report_file}: {e}", file=sys.stderr)
-        elif os.path.exists(report_path):
+            write_report_artifact(args.report_file, report,
+                                  volatile=("elapsed_s",))
+        if os.path.exists(report_path):
             os.unlink(report_path)
 
     if args.report == "json":

@@ -1,0 +1,34 @@
+"""rayspec: executable sequential specifications + linearizability
+checking for the runtime's pure decision cores.
+
+The analysis ladder so far proves structure (raylint), replays one
+schedule (raysan), and exhausts bounded interleavings against
+hand-written per-scenario properties (raymc). rayspec adds the missing
+rung: each registered decision core (``QuotaLedger``, ``FairTaskQueue``,
+``DepTable``, ``ActorRestartGate``, ``ShardedTable``, plus the actor-call
+exactly-once protocol) gets a small *executable sequential
+specification* — a pure Python model with an explicit operation
+alphabet — and the tooling to hold the concurrent implementation to it:
+
+- a **history recorder** (:mod:`.history`) riding the
+  ``sanitize_hooks.spec_op`` seam captures concurrent
+  invocation/response histories from real runs at near-zero uninstalled
+  cost;
+- a **Wing & Gong-style linearizability checker** (:mod:`.check`) with
+  partition-by-key compositionality and a bounded-search fallback; on
+  violation it ddmin-shrinks to the minimal non-linearizable
+  sub-history and emits a raysan ``Schedule`` script for replay;
+- a **conformance mode** (:mod:`.conformance`) cross-checks a live core
+  against the spec's reachable state set — wired into raymc so every
+  quiescent state of an explored scenario becomes a refinement check.
+
+``SPEC_CATALOG`` in :mod:`.specs` is the registry; raylint R9 holds the
+product taps, the ``sanitize_hooks.SPEC_POINTS`` registry, and the
+catalog to each other.
+"""
+
+from tools.rayspec.check import CheckOutcome, check_events  # noqa: F401
+from tools.rayspec.conformance import check_conformance  # noqa: F401
+from tools.rayspec.history import OpEvent, RawEvent, Recorder  # noqa: F401
+from tools.rayspec.specs import (FIXTURE_SPECS, SPEC_CATALOG,  # noqa: F401
+                                 Spec)

@@ -1,0 +1,147 @@
+"""CLI: ``python -m tools.rayspec [paths] [--report json] ...``
+
+Runs the given test paths under a process-wide history recorder, then
+checks every recorded decision-core history against its executable
+sequential specification — the form CI archives as
+``RAYSPEC_REPORT.json`` (deterministic artifact; volatile counters go
+to the ``.timing.json`` sidecar).
+
+Exit-code contract (raylint's, extended over test outcomes):
+  0  tests passed, every checked history linearizable
+  1  test failures and/or linearizability violations
+  2  usage error (bad path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+DEFAULT_PATHS = ("tests/core/test_fault_semantics.py",
+                 "tests/core/test_sched_scale.py")
+
+# Run-to-run volatile report fields (timings, id-/timing-dependent
+# counters): normalized out of the committed artifact.
+VOLATILE_FIELDS = ("elapsed_s", "events", "instances", "explored",
+                   "checked_keys", "recorded_events")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.rayspec",
+        description="executable-spec linearizability checking for "
+                    "ray_tpu decision cores")
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help="test files/directories to record and check (default: "
+             f"the decision-core suites {', '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--report", choices=("json", "pretty"),
+                        default="pretty")
+    parser.add_argument("--report-file", default="", metavar="PATH",
+                        help="also write the JSON report artifact to "
+                             "PATH (plus PATH.timing.json sidecar)")
+    parser.add_argument("--pytest-args", default="-q", metavar="ARGS",
+                        help="extra arguments handed to pytest "
+                             "(default: -q)")
+    parser.add_argument("--include-slow", action="store_true",
+                        help="do not add '-m not slow' to the pytest "
+                             "run")
+    parser.add_argument("--max-events", type=int, default=200_000,
+                        help="recorder event cap (overflow stops "
+                             "recording, flagged in the report)")
+    parser.add_argument("--max-configs", type=int, default=200_000,
+                        help="per-history linearization search budget "
+                             "(exhausted -> 'undecided', never a "
+                             "false verdict)")
+    args = parser.parse_args(argv)
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"rayspec: no such path: {path}", file=sys.stderr)
+            return 2
+
+    import pytest
+
+    from tools.rayspec.check import check_events
+    from tools.rayspec.history import Recorder
+    from tools.rayspec.specs import entry_for_core
+
+    t0 = time.monotonic()
+    pytest_argv = list(args.paths) + args.pytest_args.split()
+    if not args.include_slow:
+        pytest_argv += ["-m", "not slow"]
+    pytest_argv += ["-p", "no:cacheprovider"]
+    recorder = Recorder(max_events=args.max_events)
+    with recorder:
+        rc = pytest.main(pytest_argv)
+
+    cores: dict = {}
+    violations_total = 0
+    undecided_total = 0
+    for (core, _instance), raw in sorted(recorder.histories().items(),
+                                         key=lambda kv: kv[0]):
+        entry = entry_for_core(core)
+        if entry is None:
+            continue  # a tap with no registered spec: R9's business
+        spec = entry.factory()
+        events, _tokens = spec.adapt(raw)
+        row = cores.setdefault(entry.name, {
+            "instances": 0, "recorded_events": 0, "checked_keys": 0,
+            "undecided": 0, "violations": []})
+        row["instances"] += 1
+        row["recorded_events"] += len(events)
+        for outcome in check_events(events, spec,
+                                    max_configs=args.max_configs):
+            row["checked_keys"] += 1
+            if outcome.status == "violation":
+                violations_total += 1
+                row["violations"].append(outcome.to_dict())
+            elif outcome.status == "undecided":
+                undecided_total += 1
+                row["undecided"] += 1
+
+    report = {
+        "schema_version": 1,
+        "harness": "python -m tools.rayspec",
+        "pytest_exit": int(rc),
+        "recorder_overflowed": recorder.overflowed,
+        "cores": cores,
+        "undecided": undecided_total,
+        "pass": violations_total == 0 and int(rc) == 0,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+    if args.report == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for name, row in sorted(cores.items()):
+            print(f"rayspec[{name}]: {row['instances']} instance(s), "
+                  f"{row['recorded_events']} op(s), "
+                  f"{row['checked_keys']} checked key(s), "
+                  f"{len(row['violations'])} violation(s), "
+                  f"{row['undecided']} undecided")
+            for v in row["violations"]:
+                print(f"  VIOLATION {v['message']}")
+                print(f"    replay: Schedule(order="
+                      f"{v['schedule_order']})")
+        print(f"rayspec: {'PASS' if report['pass'] else 'FAIL'} "
+              f"(pytest exit {rc}, {violations_total} violation(s), "
+              f"{undecided_total} undecided, "
+              f"{report['elapsed_s']:.2f}s)")
+
+    if args.report_file:
+        from tools.reporting import write_report_artifact
+
+        write_report_artifact(args.report_file, report,
+                              volatile=VOLATILE_FIELDS)
+
+    if int(rc) == 4:  # pytest usage error
+        return 2
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""Wing & Gong-style linearizability checking over recorded histories.
+
+The algorithm is the classic one: search for a total order of the
+history's operations that (a) respects real-time order — an operation
+whose response precedes another's invocation must linearize first —
+and (b) replays legally through the sequential spec, each completed
+operation's recorded result matching the spec's. Pending invocations
+(ops that never returned: a thread parked mid-op, an op that raised)
+may linearize at any legal point or not at all.
+
+Engineering notes:
+
+- **Partition-by-key compositionality**: linearizability is
+  compositional over independent objects, so specs that declare
+  ``partition = True`` (per-actor gate state, per-key table cells,
+  per-call exactly-once registers) are checked one key-subhistory at a
+  time — turning one big search into many trivial ones. A violation
+  is still a violation of the whole history (the failing key's
+  sub-history is reported).
+- **Mostly-sequential fast path**: recorded histories from real runs
+  are long but thinly overlapped. Candidates at each step are found by
+  scanning the invocation-ordered suffix up to the earliest
+  outstanding response — O(window), not O(n) — and the memo key
+  compresses the linearized set as (sequential prefix, small overflow
+  set).
+- **Bounded-search fallback**: the search is budgeted
+  (``max_configs`` visited configurations). A blown budget returns
+  ``undecided`` — never a false verdict — and is reported as such.
+- On violation the failing sub-history is **ddmin-shrunk** (the raymc
+  delta-debugging machinery) to a 1-minimal non-linearizable
+  sub-history, re-verified, and emitted as a raysan ``Schedule``
+  script over the ``spec.*`` points for deterministic replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from tools.rayspec.history import OpEvent
+from tools.rayspec.specs import Spec
+
+
+class _Budget(Exception):
+    """Internal: the configuration budget tripped (→ undecided)."""
+
+
+@dataclasses.dataclass
+class CheckOutcome:
+    """Verdict for one (sub-)history.
+
+    ``status``: ``ok`` (linearizable; and live state reachable when a
+    conformance target was given), ``violation`` (not linearizable),
+    ``divergence`` (linearizable, but no linearization reaches the
+    live core's observable state — a conformance failure),
+    ``undecided`` (search budget exhausted).
+    """
+
+    status: str
+    spec: str
+    key: Optional[object] = None
+    explored: int = 0
+    events: int = 0
+    message: str = ""
+    minimal: List[OpEvent] = dataclasses.field(default_factory=list)
+    schedule_order: List[str] = dataclasses.field(default_factory=list)
+    minimal_verified: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "spec": self.spec,
+            "key": repr(self.key) if self.key is not None else None,
+            "explored": self.explored,
+            "events": self.events,
+            "message": self.message,
+            "minimal": [
+                {"point": e.point, "op": e.op, "args": repr(e.args),
+                 "result": repr(e.result), "thread": e.thread,
+                 "pending": e.pending}
+                for e in self.minimal],
+            "schedule_order": self.schedule_order,
+            "minimal_verified": self.minimal_verified,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "CheckOutcome":
+        out = cls(status=data["status"], spec=data["spec"],
+                  key=data.get("key"), explored=data.get("explored", 0),
+                  events=data.get("events", 0),
+                  message=data.get("message", ""),
+                  schedule_order=list(data.get("schedule_order", ())),
+                  minimal_verified=bool(
+                      data.get("minimal_verified", False)))
+        return out
+
+
+def linearize(events: List[OpEvent], spec: Spec,
+              max_configs: int = 100_000,
+              target=None, init_state=None) -> Tuple[str, int]:
+    """Core search. Returns (status, configurations explored); status
+    as in :class:`CheckOutcome`. ``target`` (optional) is an
+    observable the final spec state must reach for ``ok`` —
+    conformance mode's refinement question."""
+    events = sorted(events, key=lambda e: e.invoked)
+    n = len(events)
+    state0 = spec.init_state() if init_state is None else init_state
+    if n == 0:
+        if target is not None and spec.observable(state0) != target:
+            return "divergence", 0
+        return "ok", 0
+
+    # Ascending (response, index) over completed ops: the real-time
+    # constraint source. resp_order[resp_lo:] skips linearized ones.
+    resp_order = sorted(
+        (e.returned, i) for i, e in enumerate(events)
+        if e.returned is not None)
+    lin = [False] * n
+    explored = [0]
+    found_full = [False]
+    memo = set()
+    completed_left = [len(resp_order)]
+
+    def first_unlin_resp(skip: int, start: int) -> Tuple[Optional[int],
+                                                         int]:
+        """(response, holder) of the earliest unlinearized completed op
+        (excluding ``skip``), scanning from resp_order[start:]."""
+        for j in range(start, len(resp_order)):
+            resp, idx = resp_order[j]
+            if not lin[idx] and idx != skip:
+                return resp, idx
+        return None, -1
+
+    def search(state, lo: int, resp_lo: int) -> bool:
+        explored[0] += 1
+        if explored[0] > max_configs:
+            raise _Budget
+        # Advance the sequential-prefix pointers past linearized ops.
+        while lo < n and lin[lo]:
+            lo += 1
+        while resp_lo < len(resp_order) and lin[resp_order[resp_lo][1]]:
+            resp_lo += 1
+        if completed_left[0] == 0:
+            found_full[0] = True
+            if target is None or spec.observable(state) == target:
+                return True
+            # Keep searching: a pending op's effect may be what the
+            # live state reflects.
+        key = (lo, frozenset(i for i in range(lo, n) if lin[i]),
+               spec.state_key(state))
+        if key in memo:
+            return False
+        memo.add(key)
+        bound, holder = first_unlin_resp(-1, resp_lo)
+        i = lo
+        while i < n:
+            e = events[i]
+            if lin[i]:
+                i += 1
+                continue
+            # Real-time rule: e may go next only if no OTHER
+            # unlinearized completed op responded before e invoked.
+            limit = bound
+            if i == holder:
+                limit, _ = first_unlin_resp(i, resp_lo)
+            if limit is not None and e.invoked >= limit:
+                break  # invocation-ordered: later events only later
+            for new_state, res in spec.apply(state, e.op, e.args):
+                if e.returned is not None and \
+                        not spec.match(e.op, e.args, res, e.result):
+                    continue
+                lin[i] = True
+                if e.returned is not None:
+                    completed_left[0] -= 1
+                try:
+                    if search(new_state, lo, resp_lo):
+                        return True
+                finally:
+                    lin[i] = False
+                    if e.returned is not None:
+                        completed_left[0] += 1
+            i += 1
+        return False
+
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old_limit, n * 2 + 200))
+    try:
+        ok = search(state0, 0, 0)
+    except _Budget:
+        return "undecided", explored[0]
+    finally:
+        sys.setrecursionlimit(old_limit)
+    if ok:
+        return "ok", explored[0]
+    if target is not None and found_full[0]:
+        return "divergence", explored[0]
+    return "violation", explored[0]
+
+
+def _partitions(events: List[OpEvent],
+                spec: Spec) -> Dict[object, List[OpEvent]]:
+    if not spec.partition:
+        return {None: events}
+    out: Dict[object, List[OpEvent]] = {}
+    for e in events:
+        out.setdefault(spec.key_of(e.op, e.args), []).append(e)
+    return out
+
+
+def schedule_script(events: List[OpEvent]) -> List[str]:
+    """A raysan ``Schedule(order=[...])`` script over the sub-history's
+    spec points, invocation order, global occurrence keys. Replaying:
+    install a rayspec Recorder (spec taps gate only while one is
+    installed), then run the component drive under the Schedule — the
+    script pins the op-entry order that produced the violation."""
+    counts: Dict[str, int] = {}
+    out = []
+    for e in sorted(events, key=lambda ev: ev.invoked):
+        occ = counts.get(e.point, 0) + 1
+        counts[e.point] = occ
+        out.append(e.point if occ == 1 else f"{e.point}#{occ}")
+    return out
+
+
+def minimize_violation(events: List[OpEvent], spec: Spec,
+                       max_configs: int,
+                       max_probes: int = 64) -> Tuple[List[OpEvent],
+                                                      bool]:
+    """ddmin the non-linearizable sub-history to 1-minimality (every
+    probe is a full re-check; the raymc delta-debugging engine drives
+    the chunking), then re-verify the result still fails."""
+    from tools.raymc.minimize import ddmin
+
+    def fails(candidate: List[OpEvent]) -> bool:
+        status, _ = linearize(candidate, spec, max_configs)
+        return status == "violation"
+
+    minimal = ddmin(fails, list(events), max_probes=max_probes)
+    verified = fails(minimal)
+    return minimal, verified
+
+
+def check_events(events: List[OpEvent], spec: Spec,
+                 max_configs: int = 100_000,
+                 minimize: bool = True) -> List[CheckOutcome]:
+    """Linearizability verdicts for a history (one outcome per
+    partition key for partitioned specs)."""
+    out = []
+    for key, group in sorted(_partitions(events, spec).items(),
+                             key=lambda kv: repr(kv[0])):
+        status, explored = linearize(group, spec, max_configs)
+        outcome = CheckOutcome(status=status, spec=spec.name, key=key,
+                               explored=explored, events=len(group))
+        if status == "violation":
+            minimal = group
+            verified = True
+            if minimize:
+                minimal, verified = minimize_violation(
+                    group, spec, max_configs)
+            outcome.minimal = minimal
+            outcome.minimal_verified = verified
+            outcome.schedule_order = schedule_script(minimal)
+            outcome.message = (
+                f"history of {len(group)} op(s) is not linearizable "
+                f"w.r.t. {spec.name}"
+                + (f" (key {key!r})" if key is not None else "")
+                + f"; minimal sub-history: "
+                + ", ".join(f"{e.op}{e.args}->{e.result!r}"
+                            for e in minimal))
+        elif status == "undecided":
+            outcome.message = (
+                f"search budget ({max_configs} configurations) "
+                f"exhausted on {len(group)} op(s) — no verdict")
+        out.append(outcome)
+    return out

@@ -1,0 +1,182 @@
+"""Conformance (refinement) checking: live core vs. spec-reachable states.
+
+Linearizability answers "could this history have happened against the
+sequential spec?". Conformance asks one question more: "and is the
+core's CURRENT state one the spec can reach via some linearization of
+that history?" — i.e. the concurrent implementation *refines* the
+sequential model, not just its answers but its state. raymc calls into
+this at every quiescent state of an explored scenario, turning each
+existing scenario into a refinement proof.
+
+The search is the checker's (:func:`tools.rayspec.check.linearize`
+with a ``target`` observable). Two layers keep the cost compatible
+with raymc's thousands of replayed executions:
+
+- a :class:`ConformanceSession` adapts the recorder's raw events
+  **incrementally** (the adapters' token tables live on the session's
+  ``Tokens``), maintaining one canonical tuple per event instead of
+  re-canonicalizing the whole history at every quiescent state;
+- verdicts are cached process-wide keyed on (spec, canonical history,
+  target): a DFS re-execution of the same logical prefix hits the
+  cache instead of re-searching. Canonical forms use the recorder's
+  per-execution sequence numbers — identical replayed prefixes produce
+  identical sequences — plus adapter-tokenized identifiers.
+
+Verdict mapping: ``violation`` (history itself non-linearizable) and
+``divergence`` (linearizable, but the live state is not reachable)
+both return a message — a finding. ``undecided`` (budget) returns
+None: a bounded-search miss must not fabricate a finding; the caller
+counts checks so a silent wash-out is visible in the stats.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tools.rayspec.check import linearize
+from tools.rayspec.history import RawEvent, Tokens
+from tools.rayspec.specs import CatalogEntry, Spec, _freeze
+
+# (spec name, canonical history, target) -> status. Bounded: cleared
+# wholesale at the cap (simplicity over LRU; one raymc scenario's
+# distinct prefixes sit far below it).
+_CACHE: Dict[tuple, str] = {}
+_CACHE_CAP = 500_000
+
+
+def _canonical_item(e) -> tuple:
+    return (e.point, _freeze(e.args), _freeze(e.result), e.invoked,
+            e.returned, e.thread)
+
+
+def _cached_linearize(events, items, spec: Spec, target,
+                      max_configs: int) -> str:
+    # `target` is already canonical/hashable (every observe()/
+    # observable() returns frozen forms) — re-freezing it dominated
+    # the profile at raymc's check rates. params_key covers bound
+    # model parameters (WFQ weights): differently-bound sessions must
+    # never share verdicts.
+    key = (spec.name, spec.params_key(), items, target)
+    status = _CACHE.get(key)
+    if status is None:
+        status, _explored = linearize(events, spec, max_configs,
+                                      target=target)
+        if len(_CACHE) >= _CACHE_CAP:
+            _CACHE.clear()
+        _CACHE[key] = status
+    return status
+
+
+class ConformanceSession:
+    """Incremental adapter + checker for ONE (core, spec) binding over
+    a growing recorded history (one raymc execution)."""
+
+    def __init__(self, entry: CatalogEntry,
+                 max_configs: int = 50_000):
+        self.entry = entry
+        self.spec = entry.factory()
+        self.tokens = Tokens()
+        self.max_configs = max_configs
+        self._adapted: List = []
+        self._items: List[tuple] = []
+        self._consumed = 0
+        # (index, raw event) adapted while still pending: re-adapted
+        # once the recorder completes them in place.
+        self._open: List[Tuple[int, RawEvent]] = []
+        self._last: Optional[str] = None
+        self._checked = False
+        self._bound = False
+
+    def _refresh(self, raw: List[RawEvent]) -> None:
+        still_open = []
+        for ix, ev in self._open:
+            if ev.returned is not None:
+                adapted = self.spec.adapt_event(ev, self.tokens)
+                self._adapted[ix] = adapted
+                self._items[ix] = _canonical_item(adapted)
+            else:
+                still_open.append((ix, ev))
+        self._open = still_open
+        for ix in range(self._consumed, len(raw)):
+            ev = raw[ix]
+            adapted = self.spec.adapt_event(ev, self.tokens)
+            self._adapted.append(adapted)
+            self._items.append(_canonical_item(adapted))
+            if ev.returned is None:
+                self._open.append((ix, ev))
+        self._consumed = len(raw)
+
+    def check(self, recorder, core) -> Optional[str]:
+        """Recorder-driven form with the unchanged-state skip: every
+        mutator of a catalog core is tapped, so a quiescent state with
+        no new events (and no pending op completed) cannot have
+        changed the core — the previous verdict stands."""
+        if self._checked \
+                and recorder.count_for(core) == self._consumed and \
+                not any(ev.returned is not None
+                        for _ix, ev in self._open):
+            return self._last
+        self._checked = True
+        self._last = self.check_raw(recorder.events_for(core), core)
+        return self._last
+
+    def check_raw(self, raw: List[RawEvent], core) -> Optional[str]:
+        """None when ``core`` conforms (or the budget washed out);
+        else a violation message naming the failing key and kind."""
+        if not self._bound:
+            self.spec.bind(core)
+            self._bound = True
+        self._refresh(raw)
+        spec = self.spec
+        events = self._adapted
+        if not spec.partition:
+            target = spec.observe(core, self.tokens)
+            status = _cached_linearize(events, tuple(self._items),
+                                       spec, target, self.max_configs)
+            return _verdict(status, spec.name, None, len(events))
+        groups: Dict[object, list] = {}
+        group_items: Dict[object, list] = {}
+        for e, item in zip(events, self._items):
+            key = spec.key_of(e.op, e.args)
+            groups.setdefault(key, []).append(e)
+            group_items.setdefault(key, []).append(item)
+        live = spec.observe(core, self.tokens)
+        init_obs = spec.observable(spec.init_state())
+        for key in sorted(set(groups) | set(live), key=repr):
+            target = live.get(key, init_obs)
+            status = _cached_linearize(
+                groups.get(key, []),
+                tuple(group_items.get(key, ())), spec, target,
+                self.max_configs)
+            msg = _verdict(status, spec.name, key,
+                           len(groups.get(key, ())))
+            if msg is not None:
+                return msg
+        return None
+
+
+def check_conformance(raw_events: List[RawEvent], entry: CatalogEntry,
+                      core,
+                      max_configs: int = 100_000) -> Optional[str]:
+    """One-shot form (tests, ad-hoc triage): adapt the whole history
+    and check ``core`` against it."""
+    return ConformanceSession(entry, max_configs).check_raw(raw_events,
+                                                            core)
+
+
+def _verdict(status: str, spec_name: str, key,
+             events: int) -> Optional[str]:
+    where = f" (key {key!r})" if key is not None else ""
+    if status == "violation":
+        return (f"{spec_name}{where}: recorded history of {events} "
+                f"op(s) is not linearizable w.r.t. the sequential "
+                f"spec")
+    if status == "divergence":
+        return (f"{spec_name}{where}: live core state is not "
+                f"reachable by any linearization of the recorded "
+                f"{events}-op history (refinement violation)")
+    return None  # ok, or undecided (bounded search washed out)
+
+
+def conformance_cache_info() -> Tuple[int, int]:
+    return len(_CACHE), _CACHE_CAP

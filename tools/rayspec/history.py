@@ -1,0 +1,228 @@
+"""rayspec history recorder: concurrent invocation/response capture.
+
+The product's decision cores report operation boundaries through the
+``sanitize_hooks.spec_op`` seam (``spec.<core>.<op>`` points, two
+phases: ``call`` on entry, ``ret`` on return). A :class:`Recorder`
+installed into that seam turns them into a **history** — the standard
+linearizability object: a sequence of invocation and response events,
+each op carrying the argument/result views its call site passed.
+
+Recording discipline:
+
+- one global, lock-protected sequence counter orders invocations and
+  responses across threads (a single process-wide total order is
+  exactly what the checker's happens-before relation needs);
+- call/ret pairing is per (thread, point, instance): an op that raised
+  instead of returning leaves its invocation **pending** — the checker
+  treats pending invocations as may-or-may-not-have-taken-effect,
+  which is also the honest reading of an op that died mid-flight;
+- events are bounded (``max_events``); overflow stops recording and is
+  flagged rather than silently wedging the process being observed;
+- instances are tracked by ``id(obj)``, and the recorder PINS a strong
+  reference to every instance it has seen: CPython reuses freed
+  addresses routinely, and two unrelated cores merged under one
+  recycled id would concatenate into a single bogus history (phantom
+  violations). Pinning bounds the extension to the recorder's own
+  lifetime — one CLI run or one raymc execution.
+
+The raw payloads are whatever cheap views the product taps passed;
+per-spec adapters (:mod:`.specs`) normalize them into the op alphabet
+and tokenize run-specific identifiers so logically-identical histories
+from different runs canonicalize identically (the conformance cache
+keys on that).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import sanitize_hooks
+
+
+@dataclasses.dataclass
+class RawEvent:
+    """One completed-or-pending operation as recorded (unadapted)."""
+
+    point: str                 # "spec.<core>.<op>"
+    instance: int              # id() of the core instance
+    call_payload: object
+    ret_payload: object
+    invoked: int               # global sequence number of the call
+    returned: Optional[int]    # ... of the return; None = pending
+    thread: str
+
+    @property
+    def core(self) -> str:
+        return self.point.split(".")[1]
+
+    @property
+    def op(self) -> str:
+        return self.point.split(".")[2]
+
+
+@dataclasses.dataclass
+class OpEvent:
+    """One adapted operation: the spec-alphabet form the checker eats."""
+
+    point: str
+    op: str
+    args: tuple
+    result: object
+    invoked: int
+    returned: Optional[int]
+    thread: str
+
+    @property
+    def pending(self) -> bool:
+        return self.returned is None
+
+
+class Tokens:
+    """Run-specific identifier canonicalization: maps object identities
+    (``for_obj``) and hashable values (``for_val``) to dense ``"t<n>"``
+    tokens in first-appearance order, so two runs producing the same
+    logical history adapt to byte-identical canonical forms."""
+
+    def __init__(self):
+        self._by_id: Dict[int, str] = {}
+        self._by_val: Dict[object, str] = {}
+        self._n = 0
+        # Adapter scratch space (e.g. the dep-table's item->key map):
+        # lives with the token table so incremental adaptation keeps
+        # its cross-event context.
+        self.aux: Dict[str, dict] = {}
+
+    def _mint(self) -> str:
+        tok = f"t{self._n}"
+        self._n += 1
+        return tok
+
+    def for_obj(self, obj) -> str:
+        tok = self._by_id.get(id(obj))
+        if tok is None:
+            tok = self._by_id[id(obj)] = self._mint()
+        return tok
+
+    def for_val(self, value) -> str:
+        tok = self._by_val.get(value)
+        if tok is None:
+            tok = self._by_val[value] = self._mint()
+        return tok
+
+    def peek_obj(self, obj) -> Optional[str]:
+        """Token for an already-seen object; None for a stranger (a
+        live-state row the history never touched — a conformance
+        mismatch by construction, surfaced instead of minted over)."""
+        return self._by_id.get(id(obj))
+
+    def peek_val(self, value) -> Optional[str]:
+        return self._by_val.get(value)
+
+
+class Recorder:
+    """Process-wide spec-op history recorder (context manager).
+
+    ::
+
+        with Recorder() as rec:
+            ...drive the cores...
+        for (core, instance), events in rec.histories().items():
+            ...check...
+
+    Chains with a previously-installed hook (raymc's conformance mode
+    nests a per-execution recorder under whatever the session has
+    installed).
+    """
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._events: List[RawEvent] = []
+        self._by_instance: Dict[int, List[RawEvent]] = {}
+        # id -> the instance itself: pinned so the id cannot be
+        # recycled under us (see module docstring).
+        self._pinned: Dict[int, object] = {}
+        # (thread ident, point, instance) -> stack of open RawEvents.
+        self._open: Dict[Tuple[int, str, int], List[RawEvent]] = {}
+        self.max_events = max_events
+        self.overflowed = False
+        self._prev = None
+        self._installed = False
+
+    # -- installation ------------------------------------------------------
+
+    def __enter__(self) -> "Recorder":
+        self._prev = sanitize_hooks._spec_op
+        sanitize_hooks.install_spec_op(self._record)
+        self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        sanitize_hooks.install_spec_op(self._prev)
+        self._installed = False
+
+    # -- the installed hook ------------------------------------------------
+
+    def _record(self, point: str, phase: str, obj: object,
+                payload: object) -> None:
+        prev = self._prev
+        if prev is not None:
+            prev(point, phase, obj, payload)
+        ident = threading.get_ident()
+        with self._lock:
+            if self.overflowed:
+                return
+            if len(self._events) >= self.max_events:
+                self.overflowed = True
+                return
+            self._seq += 1
+            key = (ident, point, id(obj))
+            if phase == "call":
+                ev = RawEvent(point=point, instance=id(obj),
+                              call_payload=payload, ret_payload=None,
+                              invoked=self._seq, returned=None,
+                              thread=threading.current_thread().name)
+                self._open.setdefault(key, []).append(ev)
+                self._events.append(ev)
+                iid = id(obj)
+                self._pinned.setdefault(iid, obj)
+                self._by_instance.setdefault(iid, []).append(ev)
+            else:
+                stack = self._open.get(key)
+                if not stack:
+                    return  # ret with no recorded call (install raced)
+                ev = stack.pop()
+                if not stack:
+                    del self._open[key]
+                ev.ret_payload = payload
+                ev.returned = self._seq
+
+    # -- results -----------------------------------------------------------
+
+    def events(self) -> List[RawEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def histories(self) -> Dict[Tuple[str, int], List[RawEvent]]:
+        """Raw events grouped per (core, instance), invocation order.
+        One core instance = one linearizability object (two ledgers
+        never form one history)."""
+        out: Dict[Tuple[str, int], List[RawEvent]] = {}
+        for ev in self.events():
+            out.setdefault((ev.core, ev.instance), []).append(ev)
+        return out
+
+    def events_for(self, obj) -> List[RawEvent]:
+        """This instance's raw events (conformance filters by the live
+        core it is about to compare against)."""
+        with self._lock:
+            return list(self._by_instance.get(id(obj), ()))
+
+    def count_for(self, obj) -> int:
+        """Cheap per-instance event count: lets a conformance session
+        skip quiescent states where no op touched its core (state
+        provably unchanged — every mutator is tapped)."""
+        with self._lock:
+            return len(self._by_instance.get(id(obj), ()))

@@ -1,0 +1,748 @@
+"""Executable sequential specifications for the decision cores.
+
+Each spec is a small pure model of one core's *sequential* contract:
+an explicit operation alphabet, an initial state, and an ``apply``
+step function. The concurrent implementation is correct when every
+recorded concurrent history is **linearizable** with respect to this
+model (check.py), and — in raymc conformance mode — when the live
+core's observable state is reachable by *some* linearization of the
+recorded history (conformance.py): refinement, not a property list.
+
+Spec design rules:
+
+- ``apply(state, op, args)`` returns a list of ``(new_state, result)``
+  candidates — usually one; more when the sequential contract itself is
+  nondeterministic (the WFQ pick among tied virtual times). An empty
+  list means the op is *illegal* in that state (a double release, a
+  dequeue with nothing queued): no linearization may pass through it.
+- States are never mutated — every step builds a new value — so the
+  checker can memoize on ``state_key``.
+- ``adapt`` turns the raw recorded payloads into the op alphabet and
+  tokenizes run-specific identifiers (object ids, random task/actor
+  ids) in first-appearance order, so logically identical histories
+  from different runs canonicalize identically.
+- ``ANY`` as a spec result matches every recorded result (used where
+  the implementation's answer depends on an argument the cheap tap
+  deliberately does not capture, e.g. ``dict.get``'s default).
+
+``SPEC_CATALOG`` maps each registered product core to its spec;
+raylint R9 holds catalog, ``sanitize_hooks.SPEC_POINTS`` registry, and
+product tap sites to each other. ``FIXTURE_SPECS`` are checker
+self-test models (atomic register, FIFO queue) — not product cores.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tools.rayspec.history import OpEvent, RawEvent, Tokens
+
+# Matches any recorded result (see module docstring).
+ANY = "<any>"
+
+_UNSEEN = "?unseen"
+
+
+def _freeze(value):
+    """Canonical hashable form of a state component."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        return frozenset(_freeze(v) for v in value)
+    return value
+
+
+def _tok(tokens: Tokens, value) -> str:
+    """Token for an identifier that is *usually* a hashable value
+    (bytes ids) but may be an arbitrary object."""
+    try:
+        return tokens.for_val(value)
+    except TypeError:
+        return tokens.for_obj(value)
+
+
+def _peek(tokens: Tokens, value) -> str:
+    try:
+        out = tokens.peek_val(value)
+    except TypeError:
+        out = tokens.peek_obj(value)
+    return _UNSEEN if out is None else out
+
+
+class Spec:
+    """Base sequential specification. Subclasses define the alphabet."""
+
+    name = "unnamed"
+    description = ""
+    product = ""          # dotted product path ("module.Class"), docs/R9
+    prefix = ""           # "spec.<core>." point prefix
+    partition = False     # check per key (compositional linearizability)
+    ops: Tuple[str, ...] = ()
+    supports_conformance = True
+
+    # -- model -------------------------------------------------------------
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def apply(self, state, op: str, args: tuple) -> List[tuple]:
+        """[(new_state, result), ...]; [] = illegal here."""
+        raise NotImplementedError
+
+    def match(self, op: str, args: tuple, spec_result, actual) -> bool:
+        if spec_result is ANY:
+            return True
+        if spec_result is actual:
+            return True
+        try:
+            return bool(spec_result == actual)
+        except Exception:
+            return False
+
+    def state_key(self, state):
+        return _freeze(state)
+
+    def observable(self, state):
+        """The refinement-visible projection conformance compares; by
+        default the whole state."""
+        return self.state_key(state)
+
+    def key_of(self, op: str, args: tuple):
+        """Partition key (first arg by convention)."""
+        return args[0]
+
+    # -- bridges to the implementation -------------------------------------
+
+    def adapt(self, raw: List[RawEvent],
+              tokens: Optional[Tokens] = None) \
+            -> Tuple[List[OpEvent], Tokens]:
+        """Raw recorded events -> (alphabet events, token table)."""
+        tokens = tokens or Tokens()
+        out = []
+        for ev in raw:
+            adapted = self.adapt_event(ev, tokens)
+            if adapted is not None:
+                out.append(adapted)
+        return out, tokens
+
+    def adapt_event(self, ev: RawEvent,
+                    tokens: Tokens) -> Optional[OpEvent]:
+        args, result = self.adapt_payloads(
+            ev.op, ev.call_payload,
+            ev.ret_payload if ev.returned is not None else None, tokens)
+        return OpEvent(point=ev.point, op=ev.op, args=args,
+                       result=result, invoked=ev.invoked,
+                       returned=ev.returned, thread=ev.thread)
+
+    def adapt_payloads(self, op: str, call, ret, tokens: Tokens):
+        """(args, result) from the raw payloads; default passthrough."""
+        if isinstance(call, tuple):
+            return call, ret
+        return ((() if call is None else (call,)), ret)
+
+    def observe(self, core, tokens: Tokens):
+        """Live-core observable (same token space as the adapted
+        history). Partitioned specs return {key: observable}."""
+        raise NotImplementedError
+
+    def bind(self, core) -> None:
+        """Adopt per-instance model parameters from the live core
+        before conformance checking (e.g. the WFQ's weight map — the
+        catalog factory cannot know them). Default: nothing."""
+
+    def params_key(self):
+        """Hashable fingerprint of bound model parameters — part of
+        the conformance verdict cache key (two differently-bound
+        sessions must never share verdicts). Default: None."""
+        return None
+
+
+# -- fixture specs (checker self-tests) --------------------------------------
+
+
+class AtomicRegisterSpec(Spec):
+    name = "atomic_register"
+    description = "single atomic register: read/write"
+    ops = ("read", "write")
+    supports_conformance = False
+
+    def init_state(self):
+        return None
+
+    def apply(self, state, op, args):
+        if op == "write":
+            return [(args[0], None)]
+        if op == "read":
+            return [(state, state)]
+        return []
+
+
+class FifoQueueSpec(Spec):
+    name = "fifo_queue"
+    description = "FIFO queue: enq/deq (deq on empty returns None)"
+    ops = ("enq", "deq")
+    supports_conformance = False
+
+    def init_state(self):
+        return ()
+
+    def apply(self, state, op, args):
+        if op == "enq":
+            return [(state + (args[0],), None)]
+        if op == "deq":
+            if not state:
+                return [(state, None)]
+            return [(state[1:], state[0])]
+        return []
+
+
+# -- QuotaLedger -------------------------------------------------------------
+
+
+class QuotaLedgerSpec(Spec):
+    """Charge/release/ceiling-check law of the tenancy ledger: usage
+    counters never exceed the ceiling passed to the op, never go
+    negative (a release without a matching charge is ILLEGAL — the
+    double-release class of bug), and the drainer's batched charges
+    obey the same ceiling one at a time."""
+
+    name = "quota_ledger"
+    description = "per-job CPU/queued/lease quota accounting"
+    product = "ray_tpu._private.tenancy.QuotaLedger"
+    prefix = "spec.quota."
+    ops = ("admit", "dequeue", "charge", "release", "drain",
+           "lease_acquire", "lease_release")
+
+    def init_state(self):
+        return {"cpu": {}, "queued": {}, "leases": {}}
+
+    @staticmethod
+    def _bump(table: dict, key, delta: int) -> dict:
+        out = dict(table)
+        left = out.get(key, 0) + delta
+        if left > 0:
+            out[key] = left
+        else:
+            out.pop(key, None)
+        return out
+
+    def apply(self, state, op, args):
+        cpu, queued, leases = (state["cpu"], state["queued"],
+                               state["leases"])
+        if op == "charge":
+            job, milli, cap = args
+            ok = cpu.get(job, 0) + milli <= cap
+            if not ok:
+                return [(state, False)]
+            return [({**state, "cpu": self._bump(cpu, job, milli)},
+                     True)]
+        if op == "release":
+            job, milli = args
+            if cpu.get(job, 0) < milli:
+                return []  # released more than was ever charged
+            return [({**state, "cpu": self._bump(cpu, job, -milli)},
+                     None)]
+        if op == "admit":
+            job, ceiling = args
+            ok = queued.get(job, 0) < ceiling
+            if not ok:
+                return [(state, False)]
+            return [({**state, "queued": self._bump(queued, job, 1)},
+                     True)]
+        if op == "dequeue":
+            job, = args
+            if queued.get(job, 0) < 1:
+                return []  # dequeue without an admission
+            return [({**state, "queued": self._bump(queued, job, -1)},
+                     None)]
+        if op == "drain":
+            charges, = args
+            new_cpu = cpu
+            for job, milli, cap in charges:
+                if new_cpu.get(job, 0) + milli > cap:
+                    return []  # the drainer charged past the ceiling
+                new_cpu = self._bump(new_cpu, job, milli)
+            return [({**state, "cpu": new_cpu}, None)]
+        if op == "lease_acquire":
+            job, cap = args
+            ok = leases.get(job, 0) < cap
+            if not ok:
+                return [(state, False)]
+            return [({**state, "leases": self._bump(leases, job, 1)},
+                     True)]
+        if op == "lease_release":
+            job, = args
+            # Lenient by design: lease release sites are not
+            # token-guarded and the implementation clamps at zero.
+            return [({**state, "leases": self._bump(leases, job, -1)},
+                     None)]
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        if op == "drain":
+            return ((tuple(ret or ()),), None)
+        if op in ("dequeue", "lease_release"):
+            return ((call,), None)
+        return call, ret
+
+    def observe(self, core, tokens):
+        with core._lock:
+            return self.observable({"cpu": dict(core._cpu),
+                                    "queued": dict(core._queued),
+                                    "leases": dict(core._leases)})
+
+
+# -- DepTable ----------------------------------------------------------------
+
+
+class DepTableSpec(Spec):
+    """Exactly-once handoff law of the dep-park table: every parked
+    item is claimed by the ready path XOR a sweep — a sweep claiming an
+    already-handed-out item is illegal, and a ready claim must return
+    exactly the items whose last dependency fired."""
+
+    name = "dep_table"
+    description = "dependency-parked work, exactly-once claims"
+    product = "ray_tpu._private.sched_state.DepTable"
+    prefix = "spec.dep."
+    ops = ("park", "ready", "sweep")
+
+    def init_state(self):
+        return {"counts": {}, "by_dep": {}}
+
+    def apply(self, state, op, args):
+        counts, by_dep = state["counts"], state["by_dep"]
+        if op == "park":
+            key, deps = args
+            new_by = dict(by_dep)
+            for dep in deps:
+                new_by[dep] = new_by.get(dep, ()) + (key,)
+            return [({"counts": {**counts, key: len(deps)},
+                      "by_dep": new_by}, None)]
+        if op == "ready":
+            dep, = args
+            claimed = []
+            new_counts = dict(counts)
+            new_by = dict(by_dep)
+            for key in new_by.pop(dep, ()):
+                left = new_counts.get(key)
+                if left is None:
+                    continue  # already claimed elsewhere: stale entry
+                if left > 1:
+                    new_counts[key] = left - 1
+                else:
+                    del new_counts[key]
+                    claimed.append(key)
+            return [({"counts": new_counts, "by_dep": new_by},
+                     frozenset(claimed))]
+        if op == "sweep":
+            claimed, = args
+            new_counts = dict(counts)
+            for key in claimed:
+                if key not in new_counts:
+                    return []  # claimed an item it never owned
+                del new_counts[key]
+            return [({"counts": new_counts, "by_dep": by_dep}, None)]
+        return []
+
+    def adapt_event(self, ev: RawEvent,
+                    tokens: Tokens) -> Optional[OpEvent]:
+        # The item->key map rides the token table: incremental
+        # adaptation (conformance sessions) must resolve a ready/sweep
+        # result against parks adapted in earlier batches.
+        item_keys = tokens.aux.setdefault("dep_item_keys", {})
+        if ev.op == "park":
+            key, item, deps = ev.call_payload
+            ktok = _tok(tokens, key)
+            item_keys[id(item)] = ktok
+            args = (ktok, tuple(_tok(tokens, d) for d in deps))
+            result = None
+        elif ev.op == "ready":
+            args = (_tok(tokens, ev.call_payload),)
+            result = None if ev.returned is None else frozenset(
+                item_keys.get(id(item), _UNSEEN)
+                for item in ev.ret_payload)
+        else:  # sweep: the claim set rides the result payload
+            claimed = () if ev.returned is None else tuple(
+                item_keys.get(id(item), _UNSEEN)
+                for item in ev.ret_payload)
+            args = (frozenset(claimed),)
+            result = None
+        return OpEvent(point=ev.point, op=ev.op, args=args,
+                       result=result, invoked=ev.invoked,
+                       returned=ev.returned, thread=ev.thread)
+
+    def observable(self, state):
+        return _freeze(state["counts"])  # by_dep staleness is internal
+
+    def observe(self, core, tokens):
+        with core._lock:
+            counts = {_peek(tokens, k): v
+                      for k, v in core._counts.items()}
+        return _freeze(counts)
+
+
+# -- ActorRestartGate --------------------------------------------------------
+
+
+class ActorGateSpec(Spec):
+    """The restart FSM + per-call decision law, per actor (partition
+    by actor id): budgets only ever decrease, DEAD is terminal, and
+    route/replay verdicts follow the documented replay-or-reject
+    contract."""
+
+    name = "actor_gate"
+    description = "actor restart FSM and replay-or-reject decisions"
+    product = "ray_tpu._private.actor_gate.ActorRestartGate"
+    prefix = "spec.actor."
+    partition = True
+    ops = ("register", "restart", "ready", "rollback", "dead",
+           "route", "replay")
+
+    ALIVE, RESTARTING, DEAD = "ALIVE", "RESTARTING", "DEAD"
+
+    def init_state(self):
+        return None  # unregistered
+
+    def apply(self, state, op, args):
+        if op == "register":
+            _aid, mx, used = args
+            if state is not None:
+                return [(state, None)]  # idempotent
+            budget = mx
+            if mx >= 0 and used > 0:
+                budget = max(0, mx - used)
+            return [((self.ALIVE, budget, mx), None)]
+        if op == "restart":
+            if state is None:
+                return [((self.DEAD, 0, 0), False)]
+            st, budget, mx = state
+            if st == self.DEAD:
+                return [(state, False)]
+            if budget == 0:
+                return [((self.DEAD, 0, mx), False)]
+            left = budget - 1 if budget > 0 else budget
+            return [((self.RESTARTING, left, mx), True)]
+        if op == "ready":
+            if state is not None and state[0] == self.RESTARTING:
+                return [((self.ALIVE,) + state[1:], None)]
+            return [(state, None)]
+        if op == "rollback":
+            if state is not None and state[0] == self.ALIVE:
+                return [((self.RESTARTING,) + state[1:], None)]
+            return [(state, None)]
+        if op == "dead":
+            if state is None:
+                return [((self.DEAD, 0, 0), None)]
+            return [((self.DEAD,) + state[1:], None)]
+        if op == "route":
+            _aid, max_retries, attempt = args
+            st = state[0] if state is not None else None
+            if st == self.DEAD:
+                return [(state, "dead")]
+            if st == self.RESTARTING and max_retries == 0 \
+                    and attempt == 0:
+                return [(state, "reject")]
+            return [(state, "park")]
+        if op == "replay":
+            _aid, max_retries = args
+            st = state[0] if state is not None else None
+            if st == self.DEAD:
+                return [(state, "dead")]
+            if max_retries == 0:
+                return [(state, "reject")]
+            return [(state, "resubmit")]
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        if op == "register":
+            aid, mx, used = call
+            return (_tok(tokens, aid), mx, used), None
+        if op in ("ready", "rollback", "dead"):
+            return (_tok(tokens, call),), None
+        if op == "restart":
+            result = None if ret is None else ret[1]
+            return (_tok(tokens, call),), result
+        if op in ("route", "replay"):
+            args = (_tok(tokens, call[0]),) + tuple(call[1:])
+            result = None if ret is None else ret[1]
+            return args, result
+        return call, ret
+
+    def observable(self, state):
+        if state is None:
+            return None
+        return (state[0], state[1])  # FSM state + remaining budget
+
+    def observe(self, core, tokens):
+        with core._lock:
+            return {_peek(tokens, aid): (st, core._budget.get(aid, 0))
+                    for aid, st in core._state.items()}
+
+
+# -- ShardedTable ------------------------------------------------------------
+
+
+class ShardedTableSpec(Spec):
+    """Refinement of ONE flat dict, per key (the showcase of
+    partition-by-key compositionality: each key's subhistory must
+    independently linearize against a single-cell map). Results whose
+    value depends on an uncaptured caller default (a ``get``/``pop``
+    miss) match anything — the refinement bite is on present keys."""
+
+    name = "sharded_table"
+    description = "lock-partitioned map refines one flat dict"
+    product = "ray_tpu._private.sched_state.ShardedTable"
+    prefix = "spec.table."
+    partition = True
+    ops = ("get", "set", "pop", "contains", "setdefault")
+
+    ABSENT = ("absent",)
+
+    def init_state(self):
+        return self.ABSENT
+
+    def apply(self, state, op, args):
+        present = state is not self.ABSENT and state[0] == "present"
+        if op == "set":
+            return [(("present", args[1]), None)]
+        if op == "get":
+            return [(state, state[1] if present else ANY)]
+        if op == "contains":
+            return [(state, present)]
+        if op == "pop":
+            if present:
+                return [(self.ABSENT, state[1])]
+            return [(state, ANY)]
+        if op == "setdefault":
+            if present:
+                return [(state, state[1])]
+            return [(("present", args[1]), args[1])]
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        if op in ("set", "setdefault"):
+            key, value = call
+            args = (_tok(tokens, key), self._val_tok(tokens, value))
+        else:
+            args = (_tok(tokens, call),)
+        if ret is None:
+            return args, None
+        _key, out = ret
+        if op in ("get", "pop", "setdefault"):
+            return args, self._val_tok(tokens, out)
+        if op == "contains":
+            return args, out
+        return args, None
+
+    @staticmethod
+    def _val_tok(tokens, value):
+        return None if value is None else _tok(tokens, value)
+
+    def observable(self, state):
+        return state
+
+    def observe(self, core, tokens):
+        out = {}
+        for i, shard in enumerate(core._shards):
+            with core._locks[i]:
+                snap = dict(shard)
+            for key, value in snap.items():
+                out[_peek(tokens, key)] = (
+                    "present",
+                    None if value is None else _peek(tokens, value))
+        return out
+
+
+# -- FairTaskQueue -----------------------------------------------------------
+
+
+class FairTaskQueueSpec(Spec):
+    """The virtual-time WFQ law: a pick serves the head of a class
+    whose virtual time is minimal among backlogged classes (ties may
+    break either way — the spec is deliberately nondeterministic
+    there), each serve advances the class's clock by 1/weight, and a
+    rejoining class starts at the global virtual time. With one class
+    (enforcement off) this degenerates to exactly a FIFO queue."""
+
+    name = "fair_task_queue"
+    description = "virtual-time weighted fair queuing law"
+    product = "ray_tpu._private.tenancy.FairTaskQueue"
+    prefix = "spec.wfq."
+    ops = ("put", "pop")
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0):
+        self.weights = weights or {}
+        self.default_weight = default_weight
+
+    def _weight(self, job: str) -> float:
+        return self.weights.get(job) or self.default_weight
+
+    def init_state(self):
+        return {"classes": {}, "vt": {}, "gvt": 0.0}
+
+    def apply(self, state, op, args):
+        classes, vt, gvt = state["classes"], state["vt"], state["gvt"]
+        if op == "put":
+            job, item = args
+            q = classes.get(job, ())
+            new_vt = vt
+            if not q:
+                new_vt = {**vt, job: max(vt.get(job, 0.0), gvt)}
+            return [({"classes": {**classes, job: q + (item,)},
+                      "vt": new_vt, "gvt": gvt}, None)]
+        if op == "pop":
+            backlogged = [j for j, q in classes.items() if q]
+            if not backlogged:
+                return [(state, None)]
+            best_vt = min(vt.get(j, 0.0) for j in backlogged)
+            out = []
+            for job in backlogged:
+                if vt.get(job, 0.0) != best_vt:
+                    continue
+                q = classes[job]
+                new_classes = dict(classes)
+                if len(q) > 1:
+                    new_classes[job] = q[1:]
+                else:
+                    del new_classes[job]
+                out.append((
+                    {"classes": new_classes,
+                     "vt": {**vt,
+                            job: best_vt + 1.0 / self._weight(job)},
+                     "gvt": best_vt}, q[0]))
+            return out
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        if op == "put":
+            job, item = call
+            return (job, tokens.for_obj(item)), None
+        # pop: result is the served item (None = empty beat)
+        result = None if ret is None else tokens.for_obj(ret)
+        return (), result
+
+    def observable(self, state):
+        return _freeze(state["classes"])  # clocks are internal pacing
+
+    def observe(self, core, tokens):
+        with core._lock:
+            classes = {job: tuple(tokens.peek_obj(item) or _UNSEEN
+                                  for item in q)
+                       for job, q in core._classes.items() if q}
+        return _freeze(classes)
+
+    def bind(self, core) -> None:
+        """Adopt the live queue's weight map (the virtual-time law is
+        weight-parameterized; a mismatched model would flag correct
+        picks). A config-driven queue (weights=None) binds the current
+        cached parse + default weight, mirroring FairTaskQueue._weight."""
+        weights = getattr(core, "_weights", None)
+        if weights is not None:
+            self.weights = dict(weights)
+            return
+        from ray_tpu._private.config import ray_config
+        from ray_tpu._private.tenancy import cached_job_weights
+
+        self.weights = dict(cached_job_weights())
+        self.default_weight = max(
+            float(ray_config.job_default_weight), 1e-6)
+
+    def params_key(self):
+        return (_freeze(self.weights), self.default_weight)
+
+
+# -- actor-call exactly-once protocol ----------------------------------------
+
+
+class ExactlyOnceCallSpec(Spec):
+    """Exactly-once register over actor calls, per task id: a call's
+    output REPORT may be *applied* at most once. The recorded apply tap
+    always observes "applied" (the implementation cannot see its own
+    duplicate), so a history in which one call's effect lands twice
+    has NO linearization — the FT-gap-(a) double execution, flagged
+    mechanically (ROADMAP FT gap a)."""
+
+    name = "exactly_once_call"
+    description = "actor-call output applied at most once per task"
+    product = "ray_tpu.cluster_utils.ClusterHead"
+    prefix = "spec.call."
+    partition = True
+    ops = ("invoke", "apply")
+    supports_conformance = False  # protocol spec: no single live core
+
+    def init_state(self):
+        return ("idle", 0)  # (phase, invocations)
+
+    def apply(self, state, op, args):
+        phase, n = state
+        if op == "invoke":
+            return [((phase, n + 1), None)]
+        if op == "apply":
+            if phase == "applied":
+                return [(state, "duplicate")]
+            return [(("applied", n), "applied")]
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        if op == "invoke":
+            tid, attempt = call
+            return (_tok(tokens, tid), attempt), None
+        # apply
+        result = None if ret is None else ret[1]
+        return (_tok(tokens, call),), result
+
+    def observe(self, core, tokens):
+        raise NotImplementedError
+
+
+# -- the registry ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogEntry:
+    name: str
+    factory: Callable[[], Spec]
+    product: str
+    prefix: str
+    description: str
+    supports_conformance: bool = True
+
+
+def _entry(factory: Callable[[], Spec]) -> CatalogEntry:
+    probe = factory()
+    return CatalogEntry(name=probe.name, factory=factory,
+                        product=probe.product, prefix=probe.prefix,
+                        description=probe.description,
+                        supports_conformance=probe.supports_conformance)
+
+
+SPEC_CATALOG: Dict[str, CatalogEntry] = {
+    entry.name: entry for entry in (
+        _entry(QuotaLedgerSpec),
+        _entry(DepTableSpec),
+        _entry(ActorGateSpec),
+        _entry(ShardedTableSpec),
+        _entry(FairTaskQueueSpec),
+        _entry(ExactlyOnceCallSpec),
+    )
+}
+
+FIXTURE_SPECS: Dict[str, Callable[[], Spec]] = {
+    "atomic_register": AtomicRegisterSpec,
+    "fifo_queue": FifoQueueSpec,
+}
+
+
+def entry_for_core(core: str) -> Optional[CatalogEntry]:
+    """Catalog entry for a recorded point's core segment ("quota" from
+    "spec.quota.charge")."""
+    want = f"spec.{core}."
+    for entry in SPEC_CATALOG.values():
+        if entry.prefix == want:
+            return entry
+    return None

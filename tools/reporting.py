@@ -1,0 +1,82 @@
+"""Deterministic CI report artifacts (shared by raymc/raysan/rayspec).
+
+The analysis CLIs archive JSON reports at the repo root
+(``RAYMC_REPORT.json`` & friends). Those files are committed, so two
+back-to-back identical runs must produce byte-identical artifacts —
+otherwise every CI run double-touches them with timing noise and the
+diffs bury real changes. The fix: **volatile** fields (wall-clock
+timings, host-dependent counters) are split out of the artifact into a
+``<artifact>.timing.json`` sidecar (gitignored) and normalized to a
+fixed placeholder in the artifact itself; everything else is written
+with sorted keys and a trailing newline so serialization is canonical.
+
+``volatile`` names are matched by dict key at any nesting depth. The
+sidecar mirrors the nesting (`"scenarios[3].elapsed_s"`-style flat
+paths) so the real numbers stay inspectable per run.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Tuple
+
+VOLATILE_PLACEHOLDER = 0
+
+TIMING_SIDECAR_SUFFIX = ".timing.json"
+
+
+def split_volatile(report, volatile: Tuple[str, ...],
+                   _path: str = "") -> Tuple[object, Dict[str, object]]:
+    """(normalized report, {flat path: real value}) — pure."""
+    timings: Dict[str, object] = {}
+    if isinstance(report, dict):
+        out = {}
+        for key, value in report.items():
+            child_path = f"{_path}.{key}" if _path else str(key)
+            if key in volatile:
+                timings[child_path] = value
+                out[key] = VOLATILE_PLACEHOLDER
+            else:
+                norm, sub = split_volatile(value, volatile, child_path)
+                out[key] = norm
+                timings.update(sub)
+        return out, timings
+    if isinstance(report, list):
+        out_list = []
+        for i, value in enumerate(report):
+            norm, sub = split_volatile(value, volatile,
+                                       f"{_path}[{i}]")
+            out_list.append(norm)
+            timings.update(sub)
+        return out_list, timings
+    return report, timings
+
+
+def render_deterministic(report: dict,
+                         volatile: Tuple[str, ...]) -> str:
+    normalized, _ = split_volatile(report, volatile)
+    return json.dumps(normalized, indent=2, sort_keys=True) + "\n"
+
+
+def write_report_artifact(path: str, report: dict,
+                          volatile: Tuple[str, ...] = ("elapsed_s",)) \
+        -> bool:
+    """Write the canonical artifact at ``path`` and the real volatile
+    values at ``path + ".timing.json"`` (gitignored). Returns False
+    (with a stderr note) instead of raising on I/O errors — report
+    writing must never fail the analysis run itself."""
+    normalized, timings = split_volatile(report, volatile)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(normalized, indent=2, sort_keys=True)
+                    + "\n")
+        with open(path + TIMING_SIDECAR_SUFFIX, "w",
+                  encoding="utf-8") as f:
+            f.write(json.dumps(timings, indent=2, sort_keys=True)
+                    + "\n")
+        return True
+    except OSError as e:
+        print(f"reporting: could not write report artifact {path}: {e}",
+              file=sys.stderr)
+        return False
