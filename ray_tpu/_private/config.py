@@ -133,6 +133,12 @@ class RayTpuConfig:
     # are unchanged (same record_lineage/ActorRestartGate.register
     # calls, batched transport). Off = one synchronous RPC per actor.
     sched_group_actor_creation: bool = True
+    # Multi-slot pooled actors: sync in-process actors with
+    # max_concurrency>1 (serve replicas declare it) are ALSO served by
+    # the executor pool — up to max_concurrency concurrent drain
+    # passes per actor instead of max_concurrency standing threads.
+    # Off = only max_concurrency=1 actors pool (PR 13 behavior).
+    sched_actor_pool_multislot: bool = True
     # Lock partitioning for the head's hot scheduling tables (inflight,
     # object directory, lineage, lease grants): shard count (rounded up
     # to a power of two). 1 = effectively a single lock per table.
@@ -165,6 +171,44 @@ class RayTpuConfig:
     # trace_id, job_id). Off by default — the ingress hot path stays
     # log-free.
     serve_access_log: bool = False
+
+    # -- serve data plane (proxy fleet + replica-direct dispatch) --------
+    # Replica-direct dispatch: the HTTP proxy's steady-state fast path
+    # dispatches proxy→replica over the long-poll-fed membership table
+    # (no router lock, no per-request pruning, no head involvement),
+    # falling back to the routed path on cache miss / saturation /
+    # replica death. Read per request, so an A/B can flip it live.
+    serve_replica_direct: bool = True
+    # Priority-class load shedding (X-Priority: high|normal|low or
+    # 0|1|2): class c is admitted while proxy in-flight < max_in_flight
+    # * fraction[c], so the lowest class sheds first as load rises.
+    # Defaults keep high/normal at the full cap (pre-priority behavior
+    # for untagged traffic) and shed low-priority work at half load.
+    serve_priority_shed_fractions: str = "1.0,1.0,0.5"
+    # Optional per-class ingress token buckets ("low=50:100;normal=200",
+    # rate[:burst] per second): a class over its rate sheds 503 +
+    # Retry-After at the proxy even when in-flight headroom exists.
+    serve_priority_rates: str = ""
+    # Replica-health supervision: the controller pings each replica
+    # every period; this many consecutive failures (timeout
+    # serve_replica_health_timeout_s each) marks the replica dead — it
+    # is removed from membership (broadcast FIRST, so routers and
+    # direct tables stop dispatching), reported in /api/healthz, and
+    # replaced by the reconcile loop.
+    serve_replica_health_period_s: float = 1.0
+    serve_replica_health_timeout_s: float = 2.0
+    serve_replica_health_failures: int = 2
+    # Proxy-fleet supervision period (ProxyFleet): dead proxies are
+    # reported degraded and restarted on their original port.
+    serve_proxy_supervise_period_s: float = 1.0
+    # SLO-burn-driven autoscaling: a deployment whose route burns its
+    # error budget past this multiple (short window; status-aware, so
+    # load-shed 503s count) scales up one replica per cooldown even
+    # when the queue signal alone would not — and never scales down
+    # while burning. 0 disables the burn input (queue-only, PR 6
+    # behavior).
+    serve_autoscale_burn_threshold: float = 2.0
+    serve_autoscale_cooldown_s: float = 3.0
 
     # -- SLO / health plane (_private/health.py) -------------------------
     # Per-route latency SLO targets: "route=latency_s[:objective],..."

@@ -229,6 +229,46 @@ class SloTracker:
 tracker = SloTracker()
 
 
+# -- degraded-component providers --------------------------------------------
+
+# Process-wide registry of component health providers: subsystems that
+# KNOW about dead/degraded components (the serve controller's replica
+# supervision, the proxy-fleet supervisor) register a callable
+# returning current reason strings, and /api/healthz folds them in —
+# the dependency points downward (serve registers into health, health
+# never imports serve; same contract as register_stats_provider).
+_PROVIDER_LOCK = threading.Lock()
+_DEGRADED_PROVIDERS: Dict[str, Any] = {}
+
+
+def register_degraded_provider(key: str, fn) -> None:
+    """Register (or replace) a component-health provider. ``fn()``
+    returns a list of degraded-reason strings (empty = healthy); it is
+    called on every healthz evaluation and must be cheap and
+    non-blocking (read a dict under a lock, never RPC)."""
+    with _PROVIDER_LOCK:
+        _DEGRADED_PROVIDERS[key] = fn
+
+
+def unregister_degraded_provider(key: str) -> None:
+    with _PROVIDER_LOCK:
+        _DEGRADED_PROVIDERS.pop(key, None)
+
+
+def provider_reasons() -> list:
+    """Current reasons from every registered provider; a broken
+    provider degrades to absent rather than failing the endpoint."""
+    with _PROVIDER_LOCK:
+        providers = list(_DEGRADED_PROVIDERS.values())
+    reasons = []
+    for fn in providers:
+        try:
+            reasons.extend(str(r) for r in fn() or ())
+        except Exception:
+            continue
+    return reasons
+
+
 def snapshot_state() -> dict:
     """Plain-data snapshot of this module's process-global state: the
     global tracker's burn-rate history plus the loop-lag sample/token
@@ -241,8 +281,11 @@ def snapshot_state() -> dict:
     with _LAG_LOCK:
         lag = dict(_LAST_LAG)
         tokens = dict(_SAMPLER_TOKENS)
+    with _PROVIDER_LOCK:
+        providers = dict(_DEGRADED_PROVIDERS)
     return {"tracker_samples": samples, "loop_lag": lag,
-            "sampler_components": tokens}
+            "sampler_components": tokens,
+            "degraded_providers": providers}
 
 
 def restore_state(snapshot: dict) -> None:
@@ -258,6 +301,10 @@ def restore_state(snapshot: dict) -> None:
         _LAST_LAG.update(snapshot["loop_lag"])
         _SAMPLER_TOKENS.clear()
         _SAMPLER_TOKENS.update(snapshot["sampler_components"])
+    with _PROVIDER_LOCK:
+        _DEGRADED_PROVIDERS.clear()
+        _DEGRADED_PROVIDERS.update(
+            snapshot.get("degraded_providers") or {})
 
 
 # -- scrape-time collection --------------------------------------------------
@@ -409,6 +456,13 @@ def evaluate_health(worker=None) -> Dict[str, Any]:
 
     w = worker or global_worker()
     local = evaluate_signals(_local_signals(w))
+    # Component-health providers (serve replica/proxy supervision):
+    # dead components degrade this process's verdict with reasons
+    # naming them, and recover the moment the provider's list drains.
+    extra = provider_reasons()
+    if extra:
+        local["reasons"] = list(local["reasons"]) + extra
+        local["status"] = "degraded"
     nodes: Dict[str, Any] = {}
     head = getattr(w, "cluster_head", None)
     agg = getattr(head, "obs", None) if head is not None else None

@@ -56,6 +56,32 @@ class _BlockedState(threading.local):
         self.stack = []
 
 
+# Actor-death observers: modules holding per-actor registries keyed by
+# actor id (util.collective's group tables) register a cleanup callable
+# here so a dying actor's rows don't outlive it. Process-wide, called
+# with the ActorID from every local death path; unregister provided
+# (reset-capable).
+_ACTOR_DEATH_HOOKS: list = []
+
+
+def register_actor_death_hook(fn) -> None:
+    if fn not in _ACTOR_DEATH_HOOKS:
+        _ACTOR_DEATH_HOOKS.append(fn)
+
+
+def unregister_actor_death_hook(fn) -> None:
+    if fn in _ACTOR_DEATH_HOOKS:
+        _ACTOR_DEATH_HOOKS.remove(fn)
+
+
+def _fire_actor_death_hooks(actor_id: "ActorID") -> None:
+    for fn in list(_ACTOR_DEATH_HOOKS):
+        try:
+            fn(actor_id)
+        except Exception:
+            pass
+
+
 class ActorState:
     ALIVE = "ALIVE"
     DEAD = "DEAD"
@@ -76,22 +102,34 @@ class _Actor:
         self.death_cause = ""
         self.num_restarts = 0
         # Guards state transitions vs. mailbox puts (kill/submit race),
-        # and — in pool mode — the activation flag.
+        # and — in pool mode — the activation slot count.
         self.mb_lock = threading.Lock()
+        # Pool mode: serializes construction against a (theoretical)
+        # concurrent second activation; never held during serving.
+        self.ctor_lock = threading.Lock()
         self.is_async = bool(sched_state.class_is_async(spec.func))
-        # Shared-executor serving (sched_actor_executor_pool): the
-        # default actor shape (sync, max_concurrency=1, in-process) is
-        # drained by the backend's grow-on-demand executor pool — one
-        # activation at a time preserves mailbox order — instead of a
-        # dedicated thread per actor, so 10k actors cost 10k mailboxes
-        # and ZERO standing threads. Async / multi-concurrency /
+        # Shared-executor serving (sched_actor_executor_pool): sync
+        # in-process actors are drained by the backend's grow-on-demand
+        # executor pool instead of dedicated threads, so 10k actors
+        # cost 10k mailboxes and ZERO standing threads. max_concurrency
+        # bounds CONCURRENT drain passes per actor (multi-slot —
+        # sched_actor_pool_multislot; serve replicas declare
+        # max_concurrency>1 and used to pin that many standing threads
+        # each); at max_concurrency=1 a single activation at a time
+        # preserves strict mailbox order exactly as before. Async /
         # process-isolated actors keep the dedicated-thread path.
         from ray_tpu._private.config import ray_config
 
         self.pool_mode = bool(
             ray_config.sched_actor_executor_pool and not self.is_async
-            and spec.max_concurrency <= 1 and not spec.isolate_process)
-        self._active = False  # pool mode: a drain pass is scheduled
+            and not spec.isolate_process
+            and (spec.max_concurrency <= 1
+                 or ray_config.sched_actor_pool_multislot))
+        # Pool mode: drain passes (slots) currently scheduled/running,
+        # bounded by max_slots. Guarded by mb_lock.
+        self.max_slots = max(1, spec.max_concurrency) \
+            if self.pool_mode else 1
+        self._active_count = 0
         self._threads: list[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # Dedicated forked worker when spec.isolate_process is set.
@@ -432,9 +470,12 @@ class LocalBackend:
                 # the mailbox (the actor executor blocks on unresolved deps
                 # at dequeue time).
                 actor.mailbox.put(spec)
+                # Multi-slot actors admit up to max_slots concurrent
+                # drain passes; a surplus activation that finds the
+                # mailbox already drained simply retires.
                 needs_activation = actor.pool_mode and \
                     actor.state == ActorState.ALIVE and \
-                    not actor._active
+                    actor._active_count < actor.max_slots
             cause = actor.death_cause
         if enqueued:
             if needs_activation:
@@ -646,12 +687,16 @@ class LocalBackend:
     # -- shared-executor actor serving (pool mode) ---------------------
 
     def _activate_actor(self, actor: "_Actor") -> None:
-        """Schedule one drain pass for a pool-mode actor; at most one
-        active pass per actor preserves mailbox (per-caller) order."""
+        """Schedule one drain pass for a pool-mode actor, bounded by
+        its slot count (``max_slots`` = ``max_concurrency``): at
+        max_concurrency=1 a single active pass preserves strict
+        mailbox order; multi-slot actors serve up to max_slots items
+        concurrently — the slot accounting, not thread count, is the
+        concurrency bound."""
         with actor.mb_lock:
-            if actor._active:
+            if actor._active_count >= actor.max_slots:
                 return
-            actor._active = True
+            actor._active_count += 1
         self._exec_submit(("actor", actor))
 
     # Mailbox items served per drain slice before the pass re-enqueues
@@ -669,12 +714,35 @@ class LocalBackend:
         rides this thread's return to the loop, so _exec_loop must not
         also credit the thread as idle."""
         if actor.state == ActorState.PENDING:
-            if not actor._construct():
+            # Only the creation-dispatch activation ever sees PENDING
+            # (submits gate activation on ALIVE), but multi-slot makes
+            # the invariant worth enforcing rather than assuming: a
+            # PER-ACTOR ctor guard + re-check — the dedicated path's
+            # global ctor lock would serialize a 10k-actor creation
+            # storm across the whole pool.
+            constructed = True
+            with actor.ctor_lock:
+                if actor.state == ActorState.PENDING:
+                    constructed = actor._construct()
+            if not constructed:
                 # Constructor failed: _on_actor_death already drained
                 # and poisoned the queued calls; retire the activation.
                 with actor.mb_lock:
-                    actor._active = False
+                    actor._active_count -= 1
                 return False
+        if actor.max_slots > 1:
+            # Multi-slot fan-out: items that queued while this actor
+            # was PENDING (or while every slot was busy) never
+            # triggered an activation — bring concurrent passes up to
+            # min(backlog, max_slots) so a burst actually uses the
+            # slots. _activate_actor enforces the bound.
+            backlog = actor.mailbox.qsize() - 1  # this pass serves one
+            while backlog > 0:
+                with actor.mb_lock:
+                    if actor._active_count >= actor.max_slots:
+                        break
+                self._activate_actor(actor)
+                backlog -= 1
         served = 0
         while True:
             try:
@@ -682,7 +750,7 @@ class LocalBackend:
             except queue.Empty:
                 with actor.mb_lock:
                     if actor.mailbox.empty():
-                        actor._active = False
+                        actor._active_count -= 1
                         return False
                 continue
             if item is None:
@@ -931,6 +999,7 @@ class LocalBackend:
             actor.actor_id.hex()[:8], actor.death_cause))
 
     def _on_actor_death(self, actor: _Actor, error: BaseException):
+        _fire_actor_death_hooks(actor.actor_id)
         if actor._proc is not None:
             self.worker_pool.release_dedicated(actor._proc)
             actor._proc = None

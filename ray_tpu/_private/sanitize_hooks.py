@@ -149,6 +149,14 @@ SCHED_POINTS = SPEC_POINTS | frozenset({
     "longpoll.listen",
     "longpoll.notify",
     "longpoll.client.loop",
+    # serve replica-direct dispatch: the proxy-side slot claim, the
+    # long-poll-fed membership commit, and the completion release —
+    # the handoff seams of the proxy→replica fast path (raymc
+    # replica_direct proves no acquire returns a replica whose removal
+    # already committed, and that slot accounting stays exact).
+    "serve.direct.acquire",
+    "serve.direct.update",
+    "serve.direct.release",
     # cluster node: one coalesced submit_batch frame dispatch
     "cluster.submit_batch",
     # object plane: spill pipeline (disk write done → entry flip) and

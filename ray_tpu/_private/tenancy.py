@@ -813,6 +813,124 @@ class IngressLimiter:
             return max(bucket.retry_after_s(), 0.001)
 
 
+# -- serve priority classes --------------------------------------------------
+
+
+# Ordinal priority classes for the serve ingress (X-Priority header):
+# index IS the shed order — higher index sheds first.
+PRIORITY_CLASSES = ("high", "normal", "low")
+_PRIORITY_BY_NAME = {name: i for i, name in enumerate(PRIORITY_CLASSES)}
+_PRIORITY_DEFAULT = 1  # normal
+
+
+def parse_priority(raw: str) -> int:
+    """``X-Priority`` header value → class index. Accepts the class
+    names or their ordinals; anything else — including absence — is
+    ``normal`` (a malformed client header must neither crash nor grant
+    elevated priority)."""
+    raw = (raw or "").strip().lower()
+    if not raw:
+        return _PRIORITY_DEFAULT
+    idx = _PRIORITY_BY_NAME.get(raw)
+    if idx is not None:
+        return idx
+    if raw.isdigit():
+        n = int(raw)
+        if n < len(PRIORITY_CLASSES):
+            return n
+    return _PRIORITY_DEFAULT
+
+
+def parse_shed_fractions(raw: Optional[str] = None) -> Tuple[float, ...]:
+    """``serve_priority_shed_fractions`` (``"1.0,1.0,0.5"``) → one
+    admission fraction per priority class. Malformed / missing entries
+    fall back to 1.0 (never shed below the hard cap) — a config typo
+    must not start shedding traffic."""
+    if raw is None:
+        raw = ray_config.serve_priority_shed_fractions
+    out = [1.0] * len(PRIORITY_CLASSES)
+    for i, part in enumerate((raw or "").split(",")):
+        if i >= len(out):
+            break
+        try:
+            val = float(part.strip())
+        except ValueError:
+            continue
+        if 0.0 <= val <= 1.0:
+            out[i] = val
+    return tuple(out)
+
+
+class PriorityGate:
+    """Priority-class load shedding for the HTTP ingress: the decision
+    half of "shed lowest class first".
+
+    Two independent admission checks, both cheap enough for the
+    per-request fast path:
+
+    - **layered thresholds**: class ``c`` is admitted while the proxy's
+      in-flight count is below ``capacity * fraction[c]`` — as load
+      rises, ``low`` sheds first, then ``normal``, and ``high`` rides
+      to the hard cap (fraction defaults keep high/normal at 1.0, so
+      untagged traffic behaves exactly as before priorities existed);
+    - **per-class token buckets** (``serve_priority_rates``,
+      ``"low=50:100"``): a class over its configured rate sheds even
+      with in-flight headroom — the knob that keeps a background-class
+      flood from consuming the headroom bursts need.
+
+    Returns the Retry-After seconds on shed (the 503 honors it), None
+    on admit. Unlike the tenancy quota plane this is always on — it is
+    data-plane overload protection, not multi-tenant policy — but the
+    default config is behavior-neutral for high/normal traffic.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
+        self._clock = clock or time.monotonic
+        self._fractions_src: Optional[str] = None
+        self._fractions: Tuple[float, ...] = (1.0,) * len(PRIORITY_CLASSES)
+        self._rates_src: Optional[str] = None
+        self._buckets: Dict[int, TokenBucket] = {}
+
+    def _refresh_locked(self) -> None:
+        raw = ray_config.serve_priority_shed_fractions
+        if raw != self._fractions_src:
+            self._fractions = parse_shed_fractions(raw)
+            self._fractions_src = raw
+        raw = ray_config.serve_priority_rates
+        if raw != self._rates_src:
+            limits = parse_rate_limits(raw)
+            self._buckets = {
+                _PRIORITY_BY_NAME[name]: TokenBucket(rate, burst,
+                                                     now=self._clock())
+                for name, (rate, burst) in limits.items()
+                if name in _PRIORITY_BY_NAME
+            }
+            self._rates_src = raw
+
+    def try_admit(self, cls: int, in_flight: int,
+                  capacity: int) -> Optional[float]:
+        """None = admitted; else seconds to wait before retrying (the
+        503's Retry-After). ``cls`` is the :func:`parse_priority`
+        index; out-of-range values are clamped to the lowest class."""
+        cls = min(max(cls, 0), len(PRIORITY_CLASSES) - 1)
+        with self._lock:
+            self._refresh_locked()
+            frac = self._fractions[cls]
+            if frac < 1.0 and in_flight >= capacity * frac:
+                _perf_stats.counter(
+                    "serve_priority_shed",
+                    {"class": PRIORITY_CLASSES[cls]}).inc()
+                return 1.0
+            bucket = self._buckets.get(cls)
+            if bucket is not None and not bucket.try_take(self._clock()):
+                _perf_stats.counter(
+                    "serve_priority_shed",
+                    {"class": PRIORITY_CLASSES[cls]}).inc()
+                return max(bucket.retry_after_s(), 0.001)
+        return None
+
+
 # -- arena budgets -----------------------------------------------------------
 
 

@@ -24,6 +24,7 @@ from ray_tpu.serve._private.controller import (
 from ray_tpu.serve._private.http_proxy import HTTPProxy
 from ray_tpu.serve._private.proxy_actor import (  # noqa: F401
     HTTPProxyActor,
+    ProxyFleet,
     start_proxy_fleet,
 )
 from ray_tpu.serve._private.router import ServeHandle
@@ -257,13 +258,22 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
 
 def shutdown():
     global _proxy
+    from ray_tpu.serve._private.membership import (
+        shutdown_all_dispatchers,
+        shutdown_all_watches,
+    )
     from ray_tpu.serve._private.router import shutdown_all_routers
     from ray_tpu.serve.batching import retire_all_batchers
 
     # Routers first: their stop flags must be set before the
     # controller dies so the long-poll threads exit on the resulting
-    # error instead of re-resolving a replacement controller.
+    # error instead of re-resolving a replacement controller. Direct
+    # dispatchers and any orphaned membership watches go down with
+    # them (watches stop on last unsubscribe; the sweep below catches
+    # subscribers that never unsubscribed).
     shutdown_all_routers()
+    shutdown_all_dispatchers()
+    shutdown_all_watches()
     retire_all_batchers()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)

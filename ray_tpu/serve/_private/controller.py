@@ -27,6 +27,9 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import health as _health
+from ray_tpu._private.config import ray_config
+from ray_tpu.exceptions import ActorDiedError
 from ray_tpu.serve._private.long_poll import LongPollHost
 from ray_tpu.serve._private.replica import ServeReplica
 
@@ -55,6 +58,33 @@ class _DeploymentState:
         self.replica_names: Dict[Any, str] = {}  # handle -> actor name
         self.status = "UPDATING"
         self.message = ""
+        # Replica supervision state: per-replica consecutive health-
+        # check strikes, the in-flight (ping ref, sent_at) checked on
+        # later passes, and the set of replicas whose LAST ping
+        # answered ok (a degraded reason only clears once the
+        # replacement fleet confirms).
+        self.health_strikes: Dict[Any, int] = {}
+        self.health_pings: Dict[Any, Any] = {}
+        self.health_ok: set = set()
+        self.last_health = 0.0
+        # Burn-driven autoscaling hysteresis.
+        self.last_burn_scale = 0.0
+
+    def forget_replica(self, r) -> None:
+        """Drop ALL supervision state for a replica leaving membership
+        (rolling update, scale-down, health-detected death) — stale
+        entries would otherwise accumulate one row (and a pending ping
+        ref) per stopped replica for the controller's lifetime. The
+        progress-heartbeat row keyed by the actor name goes with it."""
+        rname = self.replica_names.pop(r, None)
+        if rname:
+            from ray_tpu.serve._private.replica import clear_progress
+
+            clear_progress(rname)
+        self.replica_versions.pop(r, None)
+        self.health_strikes.pop(r, None)
+        self.health_pings.pop(r, None)
+        self.health_ok.discard(r)
 
 
 @ray_tpu.remote
@@ -73,6 +103,17 @@ class ServeController:
         # control->data-plane LongPollHost route updates).
         self._routes: Dict[str, str] = {}
         self._shutdown = threading.Event()
+        # Dead/degraded serve components, keyed by component id: the
+        # /api/healthz provider reads the values, so a chaos kill is
+        # NAMED while the fleet is degraded and the reason drops the
+        # moment the deployment reconciles back to target.
+        self._degraded: Dict[str, str] = {}
+        # Burn-rate sampling for autoscaling is rate-limited (the
+        # reconcile loop runs at 10Hz; sampling the SLO tracker that
+        # often would grow its window history 10x for no signal).
+        self._last_burn_sample = 0.0
+        self._burn_cache: Dict[str, float] = {}
+        _health.register_degraded_provider("serve", self._health_reasons)
         self._recover()
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             daemon=True)
@@ -224,9 +265,11 @@ class ServeController:
         with self._lock:
             state = self._deployments.pop(name, None)
         if state:
+            # Membership commits empty BEFORE the replicas die, so
+            # routers and direct tables stop dispatching first.
+            self._broadcast(name, [])
             for r in state.replicas:
                 self._stop_replica(r)
-            self._broadcast(name, [])
             from ray_tpu._private.events import record_event
 
             record_event("serve", f"deployment {name} deleted",
@@ -258,8 +301,15 @@ class ServeController:
             self._metrics[deployment]["ts"] = time.monotonic()
         return True
 
+    def _health_reasons(self) -> List[str]:
+        """The /api/healthz degraded-provider payload: every dead
+        serve component this controller currently knows about."""
+        with self._lock:
+            return list(self._degraded.values())
+
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
+        _health.unregister_degraded_provider("serve")
         # Release long-poll waiters FIRST: an in-flight listen would
         # otherwise hold an executor thread (and its client's get) in a
         # 30s condvar wait long after this actor is gone.
@@ -291,6 +341,7 @@ class ServeController:
         controller leaks both (threads outlive their thread-simulated
         'process')."""
         self._shutdown.set()
+        _health.unregister_degraded_provider("serve")
         self._long_poll.shutdown()
 
     # -- reconcile -------------------------------------------------------
@@ -307,19 +358,26 @@ class ServeController:
         with self._lock:
             states = list(self._deployments.values())
         for st in states:
+            self._check_replica_health(st)
             self._autoscale(st)
             target = int(st.info.get("num_replicas", 1))
             version = st.version
             changed = False
+            # Victims are collected and stopped only AFTER their
+            # removal broadcasts: the replica-direct tables (and
+            # routers) must see the membership commit before the
+            # replica dies, so steady-state dispatch never races a
+            # planned stop (the raymc replica_direct property's
+            # product-side discipline).
+            stops: List[Any] = []
             # Rolling update: stop outdated replicas one at a time.
             outdated = [r for r in st.replicas
                         if st.replica_versions.get(r) != version]
             if outdated and len(st.replicas) >= target:
                 victim = outdated[0]
                 st.replicas.remove(victim)
-                st.replica_versions.pop(victim, None)
-                st.replica_names.pop(victim, None)
-                self._stop_replica(victim)
+                st.forget_replica(victim)
+                stops.append(victim)
                 changed = True
             while len(st.replicas) < target:
                 r = self._start_replica(st)
@@ -330,9 +388,8 @@ class ServeController:
                 changed = True
             while len(st.replicas) > target:
                 victim = st.replicas.pop()
-                st.replica_versions.pop(victim, None)
-                st.replica_names.pop(victim, None)
-                self._stop_replica(victim)
+                st.forget_replica(victim)
+                stops.append(victim)
                 changed = True
             if changed or st.status == "UPDATING":
                 up_to_date = all(st.replica_versions.get(r) == version
@@ -340,8 +397,191 @@ class ServeController:
                 if len(st.replicas) == target and up_to_date:
                     st.status = "HEALTHY"
                 self._broadcast(st.name, st.replicas)
+            for victim in stops:
+                self._stop_replica(victim)
             if changed:
                 self._checkpoint()
+
+    def _check_replica_health(self, st: _DeploymentState):
+        """Replica supervision: detect dead replicas and remove them
+        from membership (broadcast FIRST), so the reconcile pass below
+        replaces them — before this, a replica dying under a live
+        controller stayed dead forever (only controller *recovery*
+        re-checked liveness).
+
+        Liveness is two-tier: (a) the named-actor registry — a DEAD
+        replica's name is gone, definitive, instant; (b) a
+        ``check_health`` ping collected on later passes — an
+        ActorDiedError answer is death, a user-raised error is a
+        strike, and a ping still pending past
+        ``serve_replica_health_timeout_s`` is a strike too (the hung/
+        deadlocked-replica detector — a merely BUSY replica serves the
+        FIFO'd ping within one item's time, while a wedged one never
+        does). ``serve_replica_health_failures`` consecutive strikes =
+        dead; any successful ping resets the count.
+        """
+        now = time.monotonic()
+        if now - st.last_health < ray_config.serve_replica_health_period_s:
+            return
+        st.last_health = now
+        # Degraded-reason retirement: only once the fleet is back at
+        # target AND every replica's last ping answered ok — clearing
+        # on "replacement started" would close healthz's degraded
+        # window before the replacement can actually serve.
+        with self._lock:
+            has_degraded = any(k.startswith(f"replica:{st.name}:")
+                               for k in self._degraded)
+        if has_degraded and st.status == "HEALTHY" and \
+                len(st.replicas) >= int(st.info.get("num_replicas", 1)) \
+                and all(r in st.health_ok for r in st.replicas):
+            with self._lock:
+                for key in [k for k in self._degraded
+                            if k.startswith(f"replica:{st.name}:")]:
+                    del self._degraded[key]
+            from ray_tpu._private.events import record_event
+
+            record_event("serve", f"deployment {st.name} recovered: "
+                         f"all replicas confirm healthy",
+                         deployment=st.name)
+        dead: List[Any] = []
+        for r in list(st.replicas):
+            rname = st.replica_names.get(r)
+            cause = ""
+            if rname:
+                try:
+                    ray_tpu.get_actor(rname)
+                except ValueError:
+                    cause = "actor gone from the registry"
+                except Exception:
+                    pass
+            if not cause:
+                # Collect an earlier ping (never blocks: timeout 0).
+                prev = st.health_pings.pop(r, None)
+                resend = True
+                if prev is not None:
+                    ref, sent_at = prev
+                    try:
+                        ready, _ = ray_tpu.wait([ref], timeout=0)
+                    except Exception:
+                        ready = []
+                    if ready:
+                        try:
+                            ray_tpu.get(ref, timeout=0.1)
+                            st.health_strikes.pop(r, None)
+                            st.health_ok.add(r)
+                        except ActorDiedError as e:
+                            cause = f"health ping failed: {e}"
+                        except Exception as e:  # noqa: BLE001
+                            strikes = st.health_strikes.get(r, 0) + 1
+                            st.health_strikes[r] = strikes
+                            if strikes >= \
+                                    ray_config.serve_replica_health_failures:
+                                cause = (f"{strikes} consecutive failed "
+                                         f"health checks ({e})")
+                    elif now - sent_at > \
+                            ray_config.serve_replica_health_timeout_s:
+                        # Unanswered past the timeout: hung-replica
+                        # strike — but ONLY when the replica made no
+                        # progress since the ping was sent. A
+                        # SATURATED replica's ping queues behind a
+                        # deep mailbox (admission caps exceed its
+                        # execution slots by design) while requests
+                        # keep completing; striking it would kill a
+                        # healthy replica under exactly the load that
+                        # needs it, and the replacement would saturate
+                        # and be killed again — a kill loop. Progress
+                        # stamps are process-local (replica.py); a
+                        # remote replica with no visible stamp still
+                        # strikes (conservative, same as pre-fix).
+                        from ray_tpu.serve._private.replica import (
+                            last_progress,
+                        )
+
+                        progressed = rname and \
+                            (last_progress(rname) or 0.0) >= sent_at
+                        st.health_pings[r] = prev
+                        resend = False
+                        if progressed:
+                            st.health_strikes.pop(r, None)
+                        else:
+                            strikes = st.health_strikes.get(r, 0) + 1
+                            st.health_strikes[r] = strikes
+                            if strikes >= \
+                                    ray_config.serve_replica_health_failures:
+                                cause = (f"unresponsive: health ping "
+                                         f"unanswered for "
+                                         f"{now - sent_at:.1f}s with "
+                                         f"no completed request since "
+                                         f"({strikes} strikes)")
+                    else:
+                        # In flight, within the timeout: keep waiting.
+                        st.health_pings[r] = prev
+                        resend = False
+                if resend and not cause:
+                    try:
+                        st.health_pings[r] = (r.check_health.remote(),
+                                              now)
+                    except Exception as e:  # noqa: BLE001
+                        cause = f"health ping could not be sent: {e}"
+            if cause:
+                dead.append((r, rname, cause))
+        if not dead:
+            return
+        for r, rname, cause in dead:
+            if r in st.replicas:
+                st.replicas.remove(r)
+            st.forget_replica(r)
+            # A strike-dead (wedged, not crashed) replica is still
+            # alive: kill it so it cannot linger half-serving after
+            # its removal broadcast (no-op for already-dead actors).
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+            with self._lock:
+                self._degraded[f"replica:{st.name}:{rname}"] = (
+                    f"serve_replica_dead: deployment {st.name} replica "
+                    f"{rname or '(unnamed)'} removed ({cause}); "
+                    f"{len(st.replicas)}/"
+                    f"{int(st.info.get('num_replicas', 1))} live, "
+                    f"replacing")
+            from ray_tpu._private.events import record_event
+
+            record_event("serve",
+                         f"replica {rname} of {st.name} found dead "
+                         f"({cause}); replacing", deployment=st.name)
+        st.status = "UPDATING"
+        # Removal commits to long-poll BEFORE any replacement work (or
+        # the next dispatch): routers and replica-direct tables drop
+        # the dead replica now.
+        self._broadcast(st.name, st.replicas)
+        self._checkpoint()
+
+    def _route_burn(self, deployment: str) -> float:
+        """Max short-window SLO burn over the deployment's routes —
+        status-aware (PR 6), so proxy load-shed 503s push it up. The
+        tracker sample is rate-limited to ~1/s across ALL deployments
+        (the reconcile loop ticks at 10Hz)."""
+        now = time.monotonic()
+        if now - self._last_burn_sample >= 1.0:
+            self._last_burn_sample = now
+            try:
+                _health.tracker.sample()
+                rates = _health.tracker.burn_rates()
+            except Exception:
+                rates = {}
+            with self._lock:
+                routes = dict(self._routes)
+            burns: Dict[str, float] = {}
+            for route, windows in rates.items():
+                dep = routes.get(route)
+                if dep is None:
+                    continue
+                burn = float(windows.get("short", 0.0))
+                if burn > burns.get(dep, 0.0):
+                    burns[dep] = burn
+            self._burn_cache = burns
+        return self._burn_cache.get(deployment, 0.0)
 
     def _autoscale(self, st: _DeploymentState):
         cfg = st.info.get("autoscaling_config")
@@ -361,16 +601,35 @@ class ServeController:
         target_in_flight = cfg.get("target_num_ongoing_requests_per_replica",
                                    1.0)
         current = max(1, len(st.replicas))
+        max_replicas = cfg.get("max_replicas", current)
         desired = queued / max(target_in_flight, 1e-6)
         desired = int(min(max(desired, cfg.get("min_replicas", 1)),
-                          cfg.get("max_replicas", current)))
+                          max_replicas))
+        # SLO-burn input (closes the ROADMAP loop): a route burning its
+        # error budget — status-aware, so the proxy's own load-shed
+        # 503s count — scales UP one replica per cooldown even when
+        # the queue signal reads low (e.g. requests being shed never
+        # reach the router's queue metric), and a burning deployment
+        # never scales DOWN under its callers.
+        burn = 0.0
+        burn_thr = float(ray_config.serve_autoscale_burn_threshold)
+        if burn_thr > 0:
+            burn = self._route_burn(st.name)
+            if burn > burn_thr:
+                desired = max(desired, len(st.replicas))
+                now = time.monotonic()
+                if desired < max_replicas and now - st.last_burn_scale \
+                        >= ray_config.serve_autoscale_cooldown_s:
+                    st.last_burn_scale = now
+                    desired += 1
         if desired != st.info.get("num_replicas"):
             from ray_tpu._private.events import record_event
 
             record_event(
                 "serve", f"autoscaling {st.name}: "
                 f"{st.info.get('num_replicas')} -> {desired} replicas "
-                f"(queued={queued:.0f})", deployment=st.name)
+                f"(queued={queued:.0f}, burn={burn:.1f}x)",
+                deployment=st.name)
             st.info["num_replicas"] = desired
             st.status = "UPDATING"
 
@@ -401,7 +660,7 @@ class ServeController:
             r = ServeReplica.options(**opts).remote(
                 st.name, info["cls"], info.get("init_args"),
                 info.get("init_kwargs"), info.get("user_config"),
-                st.version)
+                st.version, actor_name=rname)
             st.replica_names[r] = rname
             return r
         except Exception:

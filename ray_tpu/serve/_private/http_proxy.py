@@ -47,6 +47,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import perf_stats
 from ray_tpu._private import tenancy
+from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.serve._private import membership
 from ray_tpu.serve._private.router import QueueSaturatedError
 from ray_tpu.serve.streaming import aiter_stream, is_stream
 
@@ -106,6 +108,13 @@ _runtime_metrics.register_stats_provider(
         "denied_401": ("ray_tpu_serve_http_denied_401",
                        "Serve ingress: requests refused by ingress "
                        "auth (401)"),
+        "direct_served": ("ray_tpu_serve_http_direct_served",
+                          "Serve ingress: requests served via the "
+                          "replica-direct fast path"),
+        "direct_fallbacks": ("ray_tpu_serve_http_direct_fallbacks",
+                             "Serve ingress: direct dispatches that "
+                             "fell back to the routed path after a "
+                             "replica death"),
     })
 
 _REASONS = {
@@ -184,6 +193,7 @@ class _Conn(asyncio.Protocol):
         self.last_status = 0  # status of the most recent response
         self.trace_id = ""    # trace id of the request being handled
         self.job_id = ""      # job/tenant tag of the request in flight
+        self.serve_path = ""  # dispatch path taken (direct/routed/...)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -358,6 +368,12 @@ class _Conn(asyncio.Protocol):
             if self.job_id:
                 trace_hdr += (b"X-Job-Id: " + self.job_id.encode()
                               + b"\r\n")
+            if self.serve_path:
+                # Per-request dispatch-path proof (direct|routed|
+                # fallback): the replica-direct benches and the chaos
+                # test read it instead of trusting aggregate counters.
+                trace_hdr += (b"X-Serve-Path: "
+                              + self.serve_path.encode() + b"\r\n")
             self.transport.write(
                 b"HTTP/1.1 200 OK\r\nContent-Type: application/json"
                 b"\r\n" + trace_hdr
@@ -373,6 +389,8 @@ class _Conn(asyncio.Protocol):
             parts.append(f"X-Trace-Id: {self.trace_id}")
         if self.job_id:
             parts.append(f"X-Job-Id: {self.job_id}")
+        if self.serve_path:
+            parts.append(f"X-Serve-Path: {self.serve_path}")
         if retry_after:
             seconds = 1 if retry_after is True else \
                 max(1, math.ceil(float(retry_after)))
@@ -431,10 +449,16 @@ class HTTPProxy:
         self._shed = 0
         self._limited = 0
         self._denied = 0
+        self._direct_served = 0
+        self._fallbacks = 0
         # Per-tenant ingress token buckets (tenancy enforcement): work
         # a job pushes past its rate is shed with 429 + Retry-After
         # HERE, before any router/replica resource is touched.
         self._limiter = tenancy.IngressLimiter()
+        # Priority-class shedding (X-Priority): lowest class sheds
+        # first as in-flight load rises, plus optional per-class rate
+        # buckets — all decided by the pure gate in tenancy.py.
+        self._priority = tenancy.PriorityGate()
         self._conns: set = set()
         # Distinct job tags this proxy has accounted. X-Job-Id is
         # client-controlled: without a cap, a client cycling random
@@ -539,6 +563,7 @@ class HTTPProxy:
         conn.trace_id = trace_id
         conn.job_id = job_id
         conn.last_status = 0
+        conn.serve_path = ""
         route = ""
         try:
             route = await self._respond(conn, req, trace_id, job_id)
@@ -546,6 +571,7 @@ class HTTPProxy:
             latency = time.monotonic() - t0
             conn.trace_id = ""
             conn.job_id = ""
+            conn.serve_path = ""
             status = str(conn.last_status or 0)
             perf_stats.dist(
                 "serve_request_seconds",
@@ -632,12 +658,21 @@ class HTTPProxy:
                              f"its ingress rate limit"}).encode(),
                 keep=req.keep_alive, retry_after=retry_in)
             return route
+        # Priority-class admission (X-Priority: high|normal|low):
+        # below the hard cap, the lowest class sheds first as load
+        # rises (layered fractions) and per-class rate buckets apply.
+        cls = tenancy.parse_priority(req.headers.get("x-priority", ""))
+        retry_in = self._priority.try_admit(cls, self._in_flight,
+                                            self.max_in_flight)
+        if retry_in is not None:
+            self._record_shed(conn, req, route, job_id, cls,
+                              retry_after=retry_in)
+            return route
         if self._in_flight >= self.max_in_flight:
             # Load shed: a bounded in-flight cap with an explicit 503
             # instead of the threaded server's unbounded thread growth.
-            self._shed += 1
-            conn.send_response(503, b'{"error": "server overloaded"}',
-                               keep=req.keep_alive, retry_after=True)
+            self._record_shed(conn, req, route, job_id, cls,
+                              retry_after=True)
             return route
         payload: Any = None
         if req.body:
@@ -646,6 +681,7 @@ class HTTPProxy:
             except ValueError:
                 payload = req.body.decode("utf-8", "replace")
         self._in_flight += 1
+        token = None
         try:
             args = () if payload is None else (payload,)
             # The request is the trace ROOT: the replica call's parent
@@ -655,36 +691,86 @@ class HTTPProxy:
             # proxy's ambient/default tag instead).
             trace = (trace_id, trace_id)
             job = job_id or None
-            # Fast path: a free replica slot dispatches synchronously
-            # (no coroutine machinery); only saturation parks on the
-            # async queue-wait.
-            ref = handle.try_remote(*args, _trace=trace, _job=job)
-            if ref is None:
-                ref = await handle.remote_async(
-                    *args, _queue_timeout_s=self.queue_timeout_s,
-                    _trace=trace, _job=job)
-            fut = ref.as_future(self._loop)
-            try:
-                # Bounded replica execution (the threaded proxy's
-                # get(timeout=60) contract): a hung deployment becomes
-                # a 500, not a request pinning its in-flight slot — and
-                # the proxy — forever.
-                result = await asyncio.wait_for(
-                    fut, self.result_timeout_s)
-            except asyncio.TimeoutError:
-                if not fut.cancelled():
-                    # The DEPLOYMENT raised a TimeoutError (3.11+:
-                    # asyncio.TimeoutError is builtin TimeoutError);
-                    # wait_for only cancels the future when IT timed
-                    # out. Application failure -> generic 500 below.
+            result = None
+            direct_failed = False
+            for attempt in (0, 1, 2):
+                # Replica-direct fast path: claim a slot in the
+                # long-poll-fed table and dispatch proxy→replica —
+                # no router lock, no per-request ref pruning, no
+                # report RPC. Falls back to the routed path on cold
+                # table / saturation / the knob being off.
+                ref = None
+                if attempt == 0:
+                    ref, token = handle.try_direct(
+                        *args, _trace=trace, _job=job)
+                if ref is not None:
+                    conn.serve_path = "direct"
+                else:
+                    # "fallback" means a DIRECT dispatch died and the
+                    # request rerouted — a routed retry after a routed
+                    # death stays "routed" (mislabeling it would skew
+                    # the exact A/B ratio the hop counters prove).
+                    conn.serve_path = "fallback" if direct_failed \
+                        else "routed"
+                    # Routed: a free replica slot dispatches
+                    # synchronously (no coroutine machinery); only
+                    # saturation parks on the async queue-wait.
+                    ref = handle.try_remote(*args, _trace=trace,
+                                            _job=job)
+                    if ref is None:
+                        ref = await handle.remote_async(
+                            *args,
+                            _queue_timeout_s=self.queue_timeout_s,
+                            _trace=trace, _job=job)
+                fut = ref.as_future(self._loop)
+                try:
+                    # Bounded replica execution (the threaded proxy's
+                    # get(timeout=60) contract): a hung deployment
+                    # becomes a 500, not a request pinning its
+                    # in-flight slot — and the proxy — forever.
+                    result = await asyncio.wait_for(
+                        fut, self.result_timeout_s)
+                except asyncio.TimeoutError:
+                    if not fut.cancelled():
+                        # The DEPLOYMENT raised a TimeoutError (3.11+:
+                        # asyncio.TimeoutError is builtin
+                        # TimeoutError); wait_for only cancels the
+                        # future when IT timed out. Application
+                        # failure -> generic 500 below.
+                        raise
+                    conn.send_response(
+                        500, json.dumps({
+                            "error": f"no result within "
+                                     f"{self.result_timeout_s}s"
+                        }).encode(), keep=req.keep_alive)
+                    self._served += 1
+                    return route
+                except ActorDiedError:
+                    if attempt < 2:
+                        # The dispatched replica died with the call
+                        # never executed (an ActorDiedError is only
+                        # ever stored for calls drained UNEXECUTED
+                        # from the mailbox — an executing call runs to
+                        # completion — so a re-dispatch cannot
+                        # double-execute): drop the replica from the
+                        # direct table AND the router's list ahead of
+                        # long-poll, then retry through the routed
+                        # path. One extra bounded retry covers the
+                        # window where the router's own snapshot still
+                        # carried a second dying replica.
+                        if token is not None:
+                            handle.direct_invalidate(token)
+                            token = None
+                            direct_failed = True
+                            # The fallback event IS the direct
+                            # dispatch dying — counted here, once.
+                            membership.hop_counter("fallback").inc()
+                            self._fallbacks += 1
+                        continue
                     raise
-                conn.send_response(
-                    500, json.dumps({
-                        "error": f"no result within "
-                                 f"{self.result_timeout_s}s"}).encode(),
-                    keep=req.keep_alive)
-                self._served += 1
-                return route
+                break
+            if token is not None:
+                self._direct_served += 1
             if is_stream(result):
                 await self._stream_response(conn, req, result)
             else:
@@ -707,7 +793,29 @@ class HTTPProxy:
             self._served += 1
         finally:
             self._in_flight -= 1
+            if token is not None:
+                # Slot release is the completion edge of the direct
+                # path (streams included: the stream handle resolved).
+                handle.direct_release(token)
         return route
+
+    def _record_shed(self, conn: _Conn, req: _Request, route: str,
+                     job_id: str, cls: int, retry_after) -> None:
+        """One load-shed 503: send the response AND account the shed at
+        the shed point — ``serve_requests_shed{route,job,class}`` plus
+        the ``serve_request_seconds{route,status="503"}`` /
+        job-tagged request records the enclosing ``_handle`` writes —
+        so per-job accounting and the (status-aware) SLO burn see
+        shedding the moment it happens, not only when saturation
+        reaches the router."""
+        self._shed += 1
+        perf_stats.counter(
+            "serve_requests_shed",
+            tags={"route": route or "(unmatched)", "job": job_id,
+                  "class": tenancy.PRIORITY_CLASSES[
+                      min(cls, len(tenancy.PRIORITY_CLASSES) - 1)]}).inc()
+        conn.send_response(503, b'{"error": "server overloaded"}',
+                           keep=req.keep_alive, retry_after=retry_after)
 
     async def _stream_response(self, conn: _Conn, req: _Request, result):
         """Server-sent events with chunked transfer-encoding: the client
@@ -760,6 +868,8 @@ class HTTPProxy:
         return {"in_flight": self._in_flight, "served": self._served,
                 "shed_503": self._shed, "limited_429": self._limited,
                 "denied_401": self._denied,
+                "direct_served": self._direct_served,
+                "direct_fallbacks": self._fallbacks,
                 "open_connections": len(self._conns)}
 
     def shutdown(self):

@@ -6,14 +6,23 @@ HTTP on its own process/port, learns the route table from the
 controller's "routes" long-poll channel, and builds deployment handles
 locally, so request traffic never passes through the driver. Place with
 node-affinity / SPREAD options to front every node of a cluster.
+
+:class:`ProxyFleet` is the supervised form (reference ``http_state``'s
+proxy-state manager): proxies get STABLE explicit ports (a restarted
+proxy rebinds the same address, so clients/LBs reconnect where they
+were), a supervisor thread detects dead proxies, reports them into
+``/api/healthz`` (named, while degraded), and restarts them in place.
 """
 
 from __future__ import annotations
 
+import socket
 import threading
-from typing import Dict
+from typing import Dict, List, Tuple
 
 import ray_tpu
+from ray_tpu._private import health as _health
+from ray_tpu._private.config import ray_config
 from ray_tpu.serve._private.http_proxy import HTTPProxy
 from ray_tpu.serve._private.router import ServeHandle
 
@@ -78,14 +87,196 @@ class HTTPProxyActor:
         return (self._proxy.host, self._proxy.port)
 
     def stats(self):
-        """Ingress counters (in_flight, served, shed_503, open
-        connections) — the fleet-level load/shedding signal."""
+        """Ingress counters (in_flight, served, shed_503, direct_served,
+        open connections) — the fleet-level load/shedding signal."""
         return self._proxy.stats()
+
+    def _teardown(self):
+        self._stop.set()
+        self._proxy.shutdown()
+        # The deployment handles own routers + direct dispatchers with
+        # membership subscriptions: release them so a restarted proxy
+        # doesn't leave orphaned long-poll threads behind.
+        for handle in self._handles.values():
+            holder = getattr(handle, "_router_holder", {})
+            router = holder.get("r")
+            if router is not None:
+                try:
+                    router.shutdown()
+                except Exception:
+                    pass
+            direct = holder.get("d")
+            if direct is not None:
+                try:
+                    direct.shutdown()
+                except Exception:
+                    pass
+        self._handles.clear()
+
+    def _on_actor_stop(self):
+        """Runtime abrupt-stop hook: a KILLED proxy (chaos, restart-in-
+        place via max_restarts) must release its server socket and loop
+        thread — otherwise the replacement's bind of the SAME port
+        fails and the 'restart' dies in __init__."""
+        self._teardown()
+
+    def shutdown(self):
+        self._teardown()
+        return True
+
+
+def _free_port(host: str) -> int:
+    """Pick a currently-free TCP port. The tiny bind→close→rebind race
+    is acceptable for fleet startup (a collision fails the proxy
+    constructor loudly and the supervisor retries)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class ProxyFleet:
+    """A supervised proxy fleet: N :class:`HTTPProxyActor`s on STABLE
+    ports, restarted in place when they die, with deaths reported into
+    ``/api/healthz`` while degraded.
+
+    Each proxy builds its deployment handles locally and shares replica
+    membership through the per-process long-poll watch
+    (``membership.watch_replicas``) — membership changes fan out once
+    per proxy process, and steady-state requests dispatch
+    proxy→replica directly (``serve_replica_direct``).
+    """
+
+    def __init__(self, num_proxies: int = 2, *,
+                 host: str = "127.0.0.1",
+                 base_port: int = 0, spread: bool = True,
+                 max_in_flight: int = 256,
+                 queue_timeout_s: float = 15.0):
+        self._host = host
+        self._spread = spread
+        self._max_in_flight = max_in_flight
+        self._queue_timeout_s = queue_timeout_s
+        self._lock = threading.Lock()
+        self._degraded: Dict[int, str] = {}  # port -> reason
+        self._restarts = 0
+        # Stable explicit ports: a supervisor-restarted (or runtime-
+        # restarted) proxy rebinds the address clients already hold.
+        self._ports: List[int] = [
+            base_port + i if base_port else _free_port(host)
+            for i in range(num_proxies)]
+        self._actors: Dict[int, object] = {}
+        for port in self._ports:
+            self._actors[port] = self._start_proxy(port)
+        # Wait for every proxy to be serving before returning.
+        for port, actor in self._actors.items():
+            ray_tpu.get(actor.address.remote(), timeout=30)
+        _health.register_degraded_provider(
+            "serve_proxy_fleet", self._health_reasons)
+        self._stop = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, daemon=True,
+            name="proxy-fleet-supervisor")
+        self._supervisor.start()
+
+    def _start_proxy(self, port: int):
+        from ray_tpu.util.scheduling_strategies import (
+            SpreadSchedulingStrategy,
+        )
+
+        opts: Dict[str, object] = {"max_restarts": -1}
+        if self._spread:
+            opts["scheduling_strategy"] = SpreadSchedulingStrategy()
+        return HTTPProxyActor.options(**opts).remote(
+            self._host, port, self._max_in_flight,
+            self._queue_timeout_s)
+
+    # -- supervision -----------------------------------------------------
+
+    def _supervise_loop(self):
+        period = ray_config.serve_proxy_supervise_period_s
+        while not self._stop.wait(period):
+            for port in list(self._ports):
+                if self._stop.is_set():
+                    return
+                actor = self._actors.get(port)
+                alive = False
+                if actor is not None:
+                    try:
+                        ray_tpu.get(actor.address.remote(), timeout=2.0)
+                        alive = True
+                    except Exception:
+                        alive = False
+                if alive:
+                    with self._lock:
+                        self._degraded.pop(port, None)
+                    continue
+                # Name the dead proxy BEFORE attempting the restart:
+                # healthz must tell the true story while degraded.
+                with self._lock:
+                    self._degraded[port] = (
+                        f"serve_proxy_dead: proxy {self._host}:{port} "
+                        f"unresponsive; restarting")
+                try:
+                    replacement = self._start_proxy(port)
+                    ray_tpu.get(replacement.address.remote(),
+                                timeout=10.0)
+                except Exception:
+                    continue  # port may still be draining: retry next tick
+                with self._lock:
+                    self._actors[port] = replacement
+                    self._restarts += 1
+                    # The degraded reason is NOT cleared here: the
+                    # next supervision tick's successful ping of the
+                    # replacement clears it — healthz stays degraded
+                    # until the restarted proxy CONFIRMS serving on
+                    # its port, never just "a restart was attempted".
+                from ray_tpu._private.events import record_event
+
+                record_event("serve", f"proxy fleet restarted proxy on "
+                             f"{self._host}:{port}")
+
+    def _health_reasons(self) -> List[str]:
+        with self._lock:
+            return list(self._degraded.values())
+
+    # -- surface ---------------------------------------------------------
+
+    def addresses(self) -> List[Tuple[str, int]]:
+        return [(self._host, port) for port in self._ports]
+
+    def actors(self) -> List[object]:
+        with self._lock:
+            return [self._actors[p] for p in self._ports
+                    if p in self._actors]
+
+    def stats(self) -> Dict[str, int]:
+        """Summed ingress counters across the live fleet (dead proxies
+        contribute nothing), plus fleet supervision counters."""
+        out: Dict[str, int] = {"proxies": len(self._ports),
+                               "restarts": self._restarts}
+        for actor in self.actors():
+            try:
+                for k, v in ray_tpu.get(actor.stats.remote(),
+                                        timeout=5.0).items():
+                    out[k] = out.get(k, 0) + v
+            except Exception:
+                continue
+        return out
 
     def shutdown(self):
         self._stop.set()
-        self._proxy.shutdown()
-        return True
+        _health.unregister_degraded_provider("serve_proxy_fleet")
+        self._supervisor.join(timeout=5.0)
+        for actor in self.actors():
+            try:
+                ray_tpu.get(actor.shutdown.remote(), timeout=10.0)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        with self._lock:
+            self._actors.clear()
 
 
 def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
@@ -93,16 +284,16 @@ def start_proxy_fleet(num_proxies: int = 1, *, host: str = "127.0.0.1",
                       max_in_flight: int = 256,
                       queue_timeout_s: float = 15.0):
     """Start N proxy actors (SPREAD-scheduled across nodes when
-    possible); returns [(actor_handle, (host, port)), ...]."""
-    from ray_tpu.util.scheduling_strategies import (
-        SpreadSchedulingStrategy,
-    )
-
+    possible); returns [(actor_handle, (host, port)), ...]. The
+    list-of-pairs contract predates :class:`ProxyFleet` — new callers
+    that want supervision/restart should hold a ``ProxyFleet``."""
     actors = []
     for i in range(num_proxies):
-        # Proxies restart indefinitely (the reference's http_state keeps
-        # the fleet alive across node failures).
-        opts = {"max_restarts": -1}
+        from ray_tpu.util.scheduling_strategies import (
+            SpreadSchedulingStrategy,
+        )
+
+        opts: Dict[str, object] = {"max_restarts": -1}
         if spread:
             opts["scheduling_strategy"] = SpreadSchedulingStrategy()
         port = base_port + i if base_port else 0

@@ -20,15 +20,44 @@ import ray_tpu
 # first replica's sampler — leaving exactly one loop unmonitored.
 _loop_seq = itertools.count(1)
 
+# Per-replica progress heartbeats (actor name -> monotonic stamp of the
+# last COMPLETED request): the controller's hung-replica detector
+# distinguishes a SATURATED replica (ping FIFO'd behind a deep mailbox
+# but requests completing continuously — must never be struck) from a
+# WEDGED one (no completions since the ping was sent). Process-local:
+# in cluster mode a remote replica's stamps are invisible and the
+# detector conservatively treats "no stamp" as "can't prove progress".
+_PROGRESS_LOCK = threading.Lock()
+_PROGRESS: Dict[str, float] = {}
+
+
+def note_progress(name: str) -> None:
+    if name:
+        with _PROGRESS_LOCK:
+            _PROGRESS[name] = time.monotonic()
+
+
+def last_progress(name: str):
+    with _PROGRESS_LOCK:
+        return _PROGRESS.get(name)
+
+
+def clear_progress(name: str) -> None:
+    """Reset-capable (a replica leaving membership drops its row)."""
+    with _PROGRESS_LOCK:
+        _PROGRESS.pop(name, None)
+
 
 @ray_tpu.remote
 class ServeReplica:
     def __init__(self, deployment_name: str, serialized_cls, init_args,
-                 init_kwargs, user_config=None, version: str = ""):
+                 init_kwargs, user_config=None, version: str = "",
+                 actor_name: str = ""):
         from ray_tpu._private import perf_stats
 
         self.deployment_name = deployment_name
         self.version = version
+        self.actor_name = actor_name  # progress-heartbeat key
         self._lock = threading.Lock()
         self._in_flight = 0
         self._total = 0
@@ -95,6 +124,7 @@ class ServeReplica:
         finally:
             elapsed = time.perf_counter() - t0
             self._stat_latency.record(elapsed)
+            note_progress(self.actor_name)
             with self._lock:
                 self._in_flight -= 1
                 self._t_busy += elapsed

@@ -38,9 +38,10 @@ def shutdown_all_routers() -> None:
 import ray_tpu
 from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import tenancy
+from ray_tpu._private.config import ray_config
 from ray_tpu._private.task_spec import (set_ambient_job_id,
                                         set_ambient_trace_parent)
-from ray_tpu.serve._private.long_poll import LongPollClient
+from ray_tpu.serve._private import membership
 
 
 class QueueSaturatedError(TimeoutError):
@@ -52,7 +53,7 @@ class QueueSaturatedError(TimeoutError):
 
 class Router:
     def __init__(self, controller, deployment_name: str,
-                 max_concurrent_queries: int = 100):
+                 max_concurrent_queries: int = 100, external_load=None):
         self._controller = controller
         self._deployment = deployment_name
         self._max_concurrent = max_concurrent_queries
@@ -64,6 +65,14 @@ class Router:
         # per-replica cap so concurrent dispatchers can't oversubscribe
         # a replica while a send is in flight.
         self._reserved: Dict[Any, int] = {}
+        # Per-replica in-flight the router did NOT dispatch (the
+        # replica-direct fast path's slot table): counted against the
+        # cap so the routed fallback cannot oversubscribe a replica the
+        # direct path already saturated. ``_external_total`` is the
+        # table's whole in-flight count, folded into the autoscaling
+        # report (direct traffic must pressure the queue signal).
+        self._external_load = external_load
+        self._external_total = None
         self._lock = threading.Condition()
         # Per-job weighted fair arbitration over contended replica
         # slots (tenancy enforcement): when requests of several jobs
@@ -71,9 +80,13 @@ class Router:
         # dispatches next — a flood job saturates only its weight
         # share. No-op (one lock read) when enforcement is off.
         self._fair = tenancy.FairShare()
-        self._client = LongPollClient(
-            controller, f"replicas::{deployment_name}",
-            self._update_replicas, reresolve=self._reresolve_controller)
+        # Shared per-process membership stream: one long-poll client
+        # per (controller, deployment) feeds every router AND the
+        # replica-direct table — membership changes fan out once.
+        self._watch_sub = membership.watch_replicas(
+            controller, deployment_name,
+            lambda _seq, snapshot: self._update_replicas(snapshot),
+            on_controller=self._set_controller)
         self._last_report = 0.0
         self._waiting = 0  # callers blocked on a free replica slot
         # Periodic reporter: long-running requests dispatch once and then
@@ -88,18 +101,16 @@ class Router:
         self._reporter.start()
         _ROUTERS.add(self)
 
-    def _reresolve_controller(self):
-        """Find a live (replacement or restarted) controller after a
-        crash; also swaps the metrics-report target so autoscaling
-        signals resume."""
-        from ray_tpu.serve._private.controller import (
-            resolve_live_controller,
-        )
+    def _set_controller(self, handle):
+        """Controller replacement found by the shared watch's reresolve:
+        swap the metrics-report target so autoscaling signals resume."""
+        self._controller = handle
 
-        handle = resolve_live_controller()
-        if handle is not None:
-            self._controller = handle
-        return handle
+    def set_external_load(self, fn, total=None) -> None:
+        """Late cross-wiring (direct dispatcher created after this
+        router — e.g. serve_replica_direct flipped on live)."""
+        self._external_load = fn
+        self._external_total = total
 
     def _update_replicas(self, replicas):
         with self._lock:
@@ -108,6 +119,16 @@ class Router:
                 self._in_flight.setdefault(r, [])
             self._lock.notify_all()
 
+    def discard_replica(self, replica) -> None:
+        """A caller observed this replica die (ActorDiedError) before
+        the membership broadcast caught up: stop round-robining onto
+        it now. The next long-poll snapshot replaces the list
+        wholesale either way."""
+        with self._lock:
+            if replica in self._replicas:
+                self._replicas = [r for r in self._replicas
+                                  if r is not replica]
+
     def _prune(self, replica) -> int:
         refs = self._in_flight.get(replica, [])
         if refs:
@@ -115,6 +136,16 @@ class Router:
                                         timeout=0)
             self._in_flight[replica] = list(not_ready)
         return len(self._in_flight.get(replica, []))
+
+    def replica_load(self, replica) -> int:
+        """Routed-path in-flight for one replica, UNPRUNED (no
+        ray_tpu.wait on the direct fast path): an overestimate only
+        makes the direct table decline and the request take the routed
+        path, which prunes and decides exactly. Stale refs decay within
+        a reporter tick (~1s) or the next routed dispatch attempt."""
+        with self._lock:
+            return len(self._in_flight.get(replica, ())) \
+                + self._reserved.get(replica, 0)
 
     def _try_assign(self, method: str, args: tuple, kwargs: dict,
                     trace=None, job=None):
@@ -152,9 +183,15 @@ class Router:
         start = next(self._rr)
         for i in range(n):
             replica = replicas[(start + i) % n]
+            # Direct-path load read OUTSIDE the router lock (the table
+            # has its own leaf lock; nesting the two would add a lock
+            # order for no benefit — a slightly stale count only shifts
+            # which replica this dispatch probes).
+            ext = self._external_load(replica) \
+                if self._external_load is not None else 0
             with self._lock:
                 load = self._prune(replica) \
-                    + self._reserved.get(replica, 0)
+                    + self._reserved.get(replica, 0) + ext
                 if load >= self._max_concurrent:
                     continue
                 self._reserved[replica] = \
@@ -195,6 +232,9 @@ class Router:
                 # Advance the job's virtual time: its next contended
                 # turn moves back by 1/weight.
                 self._fair.charge(job or "")
+                # Trace-plane hop accounting: this request paid a
+                # router hop (the replica-direct A/B reads the ratio).
+                membership.hop_counter("router").inc()
             self._send_report(total)
             return ref
         return None
@@ -289,8 +329,14 @@ class Router:
         if now - self._last_report < 0.5:
             return None
         self._last_report = now
+        ext = 0
+        if self._external_total is not None:
+            try:
+                ext = int(self._external_total())
+            except Exception:
+                ext = 0
         return float(sum(len(v) for v in self._in_flight.values())
-                     + self._waiting)
+                     + self._waiting + ext)
 
     def _send_report(self, total):
         if total is None:
@@ -305,8 +351,14 @@ class Router:
         was_busy = False
         while not self._reporter_stop.wait(1.0):
             total = None
+            ext_busy = False
+            if self._external_total is not None:
+                try:
+                    ext_busy = self._external_total() > 0
+                except Exception:
+                    ext_busy = False
             with self._lock:
-                busy = self._waiting > 0 or any(
+                busy = ext_busy or self._waiting > 0 or any(
                     self._prune(r) for r in list(self._in_flight))
                 if busy or was_busy:  # final 0 on the drain edge
                     self._last_report = 0.0  # bypass the rate limit
@@ -316,7 +368,7 @@ class Router:
 
     def shutdown(self):
         self._reporter_stop.set()
-        self._client.stop()
+        self._watch_sub.unsubscribe()
         _ROUTERS.discard(self)
 
 
@@ -332,13 +384,78 @@ class ServeHandle:
         self._router_holder: Dict[str, Router] = {}
         self._max_concurrent = max_concurrent_queries
 
+    def _direct(self):
+        """The deployment's replica-direct dispatcher (shared across
+        method handles, like the router) — or None while
+        ``serve_replica_direct`` is off. Config is read per call so an
+        A/B (or an operator) can flip the fast path live; an existing
+        dispatcher keeps its membership subscription either way."""
+        if not ray_config.serve_replica_direct:
+            return None
+        d = self._router_holder.get("d")
+        if d is None:
+            d = membership.DirectDispatcher(
+                self._controller, self._deployment, self._max_concurrent)
+            self._router_holder["d"] = d
+            # A router may already exist (the knob was flipped on
+            # LIVE, after routed traffic created one): cross-wire the
+            # two NOW — each path must count the other's per-replica
+            # load or the shared cap splits into two.
+            r = self._router_holder.get("r")
+            if r is not None:
+                d.set_router_load(r.replica_load)
+                r.set_external_load(d.table.slots_of,
+                                    total=d.table.total_in_flight)
+        return d
+
     def _router(self) -> Router:
         r = self._router_holder.get("r")
         if r is None:
+            # The router counts the direct table's slots against the
+            # per-replica cap, so the two dispatch paths share one
+            # concurrency budget per replica. Created through the
+            # holder so the dispatcher (and its membership
+            # subscription) exists whenever the router does.
+            d = self._direct()
             r = Router(self._controller, self._deployment,
-                       self._max_concurrent)
+                       self._max_concurrent,
+                       external_load=d.table.slots_of
+                       if d is not None else None)
+            if d is not None:
+                d.set_router_load(r.replica_load)
+                r.set_external_load(d.table.slots_of,
+                                    total=d.table.total_in_flight)
             self._router_holder["r"] = r
         return r
+
+    def try_direct(self, *args, _trace=None, _job=None, **kwargs):
+        """Replica-direct fast path: ``(ref, token)`` dispatched
+        straight to a replica with a free slot (no router, no head), or
+        ``(None, None)`` — cold table, saturation, or the fast path
+        disabled — in which case the caller takes the routed path. The
+        caller MUST release (or, on replica death, invalidate) the
+        token when the request completes."""
+        d = self._direct()
+        if d is None:
+            return None, None
+        return d.dispatch(self._method or "__call__", args, kwargs,
+                          trace=_trace, job=_job)
+
+    def direct_release(self, token) -> None:
+        d = self._router_holder.get("d")
+        if d is not None:
+            d.release(token)
+
+    def direct_invalidate(self, token) -> None:
+        d = self._router_holder.get("d")
+        if d is not None:
+            d.invalidate(token)
+        # The routed FALLBACK must not round-robin onto the replica
+        # this caller just watched die: drop it from the router's
+        # list too, ahead of the membership broadcast.
+        r = self._router_holder.get("r")
+        if r is not None and token is not None:
+            r.discard_replica(token.replica)
 
     def remote(self, *args, _trace=None, _job=None, **kwargs):
         return self._router().assign_request(self._method or "__call__",

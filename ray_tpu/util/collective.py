@@ -176,9 +176,60 @@ class _GroupState:
 
 
 _local = threading.local()
+# Actor-keyed group registries: a pooled actor's method calls run on
+# whatever executor thread serves the activation (multi-slot since the
+# serve scale-out PR), so per-THREAD state would vanish between an
+# actor's __init__ and its next call. The registry is therefore keyed
+# by the executing ACTOR when there is one (read from the ambient task
+# context) and falls back to the thread for driver/plain-task code —
+# which preserves the original semantics exactly where threads ARE the
+# identity. ``destroy_collective_group`` shrinks it (reset-capable).
+_ACTOR_GROUPS: Dict[bytes, Dict[str, "_GroupState"]] = {}
+_ACTOR_GROUPS_LOCK = threading.Lock()
+_death_hook_installed = False
 
 
-def _groups() -> Dict[str, _GroupState]:
+def _on_actor_dead(actor_id) -> None:
+    """Backend death hook: a dying actor's group registry dies with it
+    — without this, actor churn leaks one row (holding _GroupState +
+    rendezvous handles) per collective-using actor for the process
+    lifetime."""
+    with _ACTOR_GROUPS_LOCK:
+        _ACTOR_GROUPS.pop(actor_id.binary(), None)
+
+
+def _ensure_death_hook() -> None:
+    global _death_hook_installed
+    if _death_hook_installed:
+        return
+    with _ACTOR_GROUPS_LOCK:
+        if _death_hook_installed:
+            return
+        _death_hook_installed = True
+    from ray_tpu._private.local_backend import register_actor_death_hook
+
+    register_actor_death_hook(_on_actor_dead)
+
+
+def _groups() -> Dict[str, "_GroupState"]:
+    try:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        if w is not None:
+            ctx = w.task_context.current()
+            if ctx is not None:
+                spec = ctx.get("task_spec")
+                aid = getattr(spec, "actor_id", None)
+                if aid is not None:
+                    key = aid.binary()
+                    with _ACTOR_GROUPS_LOCK:
+                        groups = _ACTOR_GROUPS.get(key)
+                        if groups is None:
+                            groups = _ACTOR_GROUPS[key] = {}
+                    return groups
+    except Exception:
+        pass
     if not hasattr(_local, "groups"):
         _local.groups = {}
     return _local.groups
@@ -201,6 +252,7 @@ def init_collective_group(world_size: int, rank: int,
             except ValueError:
                 return ray_tpu.get_actor(name)
 
+    _ensure_death_hook()
     actor = get_or_create(f"__collective::{group_name}")
     shards = [get_or_create(f"__collective::{group_name}::shard{j}")
               for j in range(_SHARD_ACTORS)]
@@ -221,7 +273,16 @@ def clear_default_group() -> None:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    st = _groups().pop(group_name, None)
+    groups = _groups()
+    st = groups.pop(group_name, None)
+    if not groups:
+        # Last group of this actor's registry: drop the actor-keyed
+        # row too, so dead actors don't accumulate empty dicts.
+        with _ACTOR_GROUPS_LOCK:
+            for key, val in list(_ACTOR_GROUPS.items()):
+                if val is groups:
+                    del _ACTOR_GROUPS[key]
+                    break
     if st is not None:
         for a in [st.actor] + list(st.shard_actors):
             try:

@@ -68,7 +68,7 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
                             "pipelined_close", "spill_race",
                             "lineage_reconstruction", "actor_restart",
                             "head_crash_recovery", "quota_admission",
-                            "dep_sweep"}
+                            "dep_sweep", "replica_direct"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"
@@ -95,6 +95,11 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     # vs-sweep space drained — a shrunk count means the multi-dep item
     # (or the sweeper) fell out of the scenario.
     assert by_name["dep_sweep"]["executions"] >= 1000, by_name
+    # Serve replica-direct: the two-dispatcher-vs-removal space
+    # drained — a shrunk count means a dispatcher (or the updater)
+    # fell out of the scenario and the no-stale-dispatch property is
+    # being proven over less than it claims.
+    assert by_name["replica_direct"]["executions"] >= 1000, by_name
     # Conformance mode really ran: each decision-core scenario
     # cross-checked its live core against the rayspec sequential spec
     # at quiescent states (a zero here means the refinement pass

@@ -397,19 +397,31 @@ def test_pool_actors_have_no_dedicated_threads(fresh_runtime):
         def ping(self):
             return 1
 
+    @ray_tpu.remote(num_cpus=0.01)
+    class Async:
+        async def ping(self):
+            return 1
+
     actors = [P.remote() for _ in range(20)]
     assert ray_tpu.get([a.bump.remote() for a in actors],
                        timeout=60) == [1] * 20
     multi = Multi.remote()
     assert ray_tpu.get(multi.ping.remote(), timeout=30) == 1
+    an = Async.remote()
+    assert ray_tpu.get(an.ping.remote(), timeout=30) == 1
     backend = ray_tpu._private.worker.global_worker().backend
     pool_actors = [a for a in backend._actors.values() if a.pool_mode]
     dedicated = [a for a in backend._actors.values() if not a.pool_mode]
-    assert len(pool_actors) == 20 and not any(
+    assert len(pool_actors) == 21 and not any(
         a._threads for a in pool_actors)
-    # max_concurrency>1 keeps the dedicated-thread path. (Poll: start()
-    # appends to _threads after the first thread may already serve.)
-    assert len(dedicated) == 1
+    # Sync max_concurrency>1 actors pool too (multi-slot: up to
+    # max_concurrency concurrent drain passes, zero standing threads);
+    # async actors keep the dedicated-thread path (they own an event
+    # loop). (Poll: start() appends to _threads after the first thread
+    # may already serve.)
+    multi_actor = next(a for a in pool_actors if a.max_slots == 2)
+    assert multi_actor.max_slots == 2
+    assert len(dedicated) == 1 and dedicated[0].is_async
     deadline = time.monotonic() + 5
     while not dedicated[0]._threads and time.monotonic() < deadline:
         time.sleep(0.01)
@@ -439,6 +451,70 @@ def test_pool_actor_ordering_under_burst(fresh_runtime):
     refs = [s.add.remote(i) for i in range(300)]
     assert ray_tpu.get(refs, timeout=60) == list(range(300))
     assert ray_tpu.get(s.read.remote(), timeout=30) == list(range(300))
+
+
+def test_pool_multislot_actor_slot_accounting(fresh_runtime):
+    """Multi-slot pooled actors (serve-replica shape): a sync
+    max_concurrency=4 actor runs on the executor pool with SLOT
+    accounting — true concurrency reaches the slot count under a
+    concurrent-call burst (not 1, not unbounded), ``_active_count``
+    never exceeds ``max_slots`` at any observed instant, everything
+    drains back to zero, and the actor owns no standing threads."""
+    import threading as _threading
+
+    @ray_tpu.remote(num_cpus=0.01, max_concurrency=4)
+    class Gate:
+        def __init__(self):
+            self._lock = _threading.Lock()
+            self.now = 0
+            self.peak = 0
+
+        def call(self, hold_s):
+            with self._lock:
+                self.now += 1
+                self.peak = max(self.peak, self.now)
+            time.sleep(hold_s)
+            with self._lock:
+                self.now -= 1
+            return 1
+
+        def peak_now(self):
+            return (self.peak, self.now)
+
+    g = Gate.remote()
+    refs = [g.call.remote(0.15) for _ in range(12)]
+    backend = fresh_runtime.backend
+    deadline = time.monotonic() + 10
+    actor = None
+    while actor is None and time.monotonic() < deadline:
+        actor = next((a for a in backend._actors.values()
+                      if a.max_slots == 4), None)
+        time.sleep(0.005)
+    assert actor is not None and actor.pool_mode
+    peak_active = 0
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with actor.mb_lock:
+            peak_active = max(peak_active, actor._active_count)
+            assert actor._active_count <= actor.max_slots
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        if len(done) == len(refs):
+            break
+        time.sleep(0.005)
+    assert sum(ray_tpu.get(refs, timeout=60)) == 12
+    peak, now = ray_tpu.get(g.peak_now.remote(), timeout=30)
+    assert now == 0
+    assert 2 <= peak <= 4, peak  # true parallelism, bounded by slots
+    assert peak_active >= 2, peak_active
+    assert not actor._threads  # zero standing threads: pool-served
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with actor.mb_lock:
+            if actor._active_count == 0:
+                break
+        time.sleep(0.01)
+    with actor.mb_lock:
+        assert actor._active_count == 0  # every activation retired
 
 
 def test_exec_submit_reenqueue_accounting(fresh_runtime):

@@ -415,12 +415,31 @@ def test_chunked_request_body_rejected(serve_up):
 
     serve.run(Echo.bind(), route_prefix="/c")
     proxy = serve.start_http_proxy()
-    conn = http.client.HTTPConnection(proxy.host, proxy.port, timeout=30)
-    conn.request("POST", "/c", body=iter([b"ab", b"cd"]),
-                 headers={"Content-Type": "application/json"})
-    resp = conn.getresponse()
-    assert resp.status == 501
-    conn.close()
+    # Raw socket, whole request in one write: the proxy answers 501 and
+    # CLOSES the moment it sees the chunked header, which legitimately
+    # races a client still streaming its chunks — http.client's
+    # iterator body turned that race into a BrokenPipeError flake
+    # (server behavior correct, client mid-send).
+    sock = socket.create_connection((proxy.host, proxy.port),
+                                    timeout=30)
+    try:
+        try:
+            sock.sendall(
+                b"POST /c HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"2\r\nab\r\n2\r\ncd\r\n0\r\n\r\n")
+        except OSError:
+            pass  # server already refused + closed: fine, read below
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert b" 501 " in buf.split(b"\r\n", 1)[0], buf[:200]
+    finally:
+        sock.close()
 
 
 def test_access_log_and_trace_header_on_keepalive(serve_up, caplog):

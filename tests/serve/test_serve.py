@@ -52,9 +52,13 @@ def test_multiple_replicas_round_robin():
     @serve.deployment(num_replicas=3)
     class Who:
         def __init__(self):
-            import threading
+            # Replica identity = the instance, not the serving thread:
+            # pooled multi-slot actors construct and serve on shared
+            # executor threads, so thread names no longer distinguish
+            # replicas.
+            import uuid
 
-            self.me = threading.current_thread().name
+            self.me = uuid.uuid4().hex
 
         def __call__(self):
             return self.me

@@ -1,0 +1,349 @@
+"""Replica-direct dispatch + priority shedding + shared membership:
+
+- steady-state HTTP requests skip the router entirely (hop counters +
+  per-response ``X-Serve-Path`` prove it), falling back to the routed
+  path on cold tables and replica death;
+- the two dispatch paths share one per-replica concurrency budget;
+- load-shed 503s are accounted at the shed point — route/status
+  latency records (what SLO burn reads), the job-tagged request
+  counter, and the class-tagged shed counter;
+- priority classes shed lowest-first with Retry-After honored;
+- replica membership fans out ONCE per process (one long-poll client
+  per deployment, shared by every router and the direct table).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import perf_stats
+from ray_tpu._private import tenancy
+from ray_tpu._private.config import ray_config
+
+
+@pytest.fixture
+def serve_up():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _post(port, path, payload=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", path, body=json.dumps(payload or {}),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, dict(resp.headers), body
+    finally:
+        conn.close()
+
+
+def _hops():
+    return {hop: perf_stats.counter("serve_hops", {"hop": hop}).value
+            for hop in ("router", "direct", "fallback")}
+
+
+def test_direct_path_skips_router_steady_state(serve_up):
+    """After warmup, keep-alive traffic dispatches proxy→replica with
+    ZERO router hops — the tentpole's headline property, read from the
+    hop counters and every response's X-Serve-Path header."""
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=8)
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), route_prefix="/echo")
+    proxy = serve.start_http_proxy()
+
+    conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                      timeout=30)
+    try:
+        # Warmup: the first requests may route while the membership
+        # watch delivers its first snapshot.
+        deadline = time.monotonic() + 15
+        warmed = False
+        while not warmed and time.monotonic() < deadline:
+            conn.request("POST", "/echo", body=json.dumps(1),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            warmed = resp.headers.get("X-Serve-Path") == "direct"
+            if not warmed:
+                time.sleep(0.05)
+        assert warmed, "direct path never warmed up"
+
+        before = _hops()
+        for i in range(30):
+            conn.request("POST", "/echo", body=json.dumps(i),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200 and body == {"echo": i}
+            assert resp.headers.get("X-Serve-Path") == "direct"
+        after = _hops()
+    finally:
+        conn.close()
+    assert after["direct"] - before["direct"] == 30
+    assert after["router"] == before["router"], (before, after)
+    assert proxy.stats()["direct_served"] >= 30
+
+
+def test_direct_disabled_routes_everything(serve_up, monkeypatch):
+    monkeypatch.setattr(ray_config, "serve_replica_direct", False)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), route_prefix="/echo")
+    proxy = serve.start_http_proxy()
+    before = _hops()
+    status, headers, _body = _post(proxy.port, "/echo", 1)
+    after = _hops()
+    assert status == 200
+    assert headers.get("X-Serve-Path") == "routed"
+    assert after["router"] == before["router"] + 1
+    assert after["direct"] == before["direct"]
+
+
+def test_direct_replica_death_falls_back_exactly_once(serve_up):
+    """Kill one replica under a warmed direct table: requests keep
+    succeeding (fallback through the routed path, which re-checks
+    membership), the dead replica is invalidated, and nothing executes
+    twice (execution counts per request id stay <= 1)."""
+    counts = {}
+    lock = threading.Lock()
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=8)
+    class Count:
+        def __call__(self, payload):
+            with lock:
+                counts[payload] = counts.get(payload, 0) + 1
+            return {"id": payload}
+
+    serve.run(Count.bind(), route_prefix="/count")
+    proxy = serve.start_http_proxy()
+    # Warm the direct table (unique ids: every request executes once).
+    deadline = time.monotonic() + 15
+    warm = 0
+    while time.monotonic() < deadline:
+        warm += 1
+        _status, headers, _ = _post(proxy.port, "/count", f"warm{warm}")
+        if headers.get("X-Serve-Path") == "direct":
+            break
+        time.sleep(0.05)
+    from ray_tpu._private.worker import global_worker
+
+    names = [n for n in global_worker().gcs.list_named_actors()
+             if str(n).startswith("SERVE_REPLICA::Count::")]
+    assert len(names) == 2
+    victim = ray_tpu.get_actor(names[0])
+    ray_tpu.kill(victim)
+    ok = 0
+    for i in range(20):
+        status, _headers, _body = _post(proxy.port, "/count", f"r{i}")
+        if status == 200:
+            ok += 1
+    assert ok == 20, f"only {ok}/20 succeeded after replica death"
+    with lock:
+        over = {k: v for k, v in counts.items() if v > 1}
+    assert not over, f"double-dispatched requests: {over}"
+
+
+def test_shed_503_accounted_at_shed_point(serve_up):
+    """A proxy-fast-path 503 (in-flight cap) is visible to per-job
+    accounting and SLO burn the moment it happens: the route/status
+    latency dist gains a status=503 record, the job-tagged request
+    counter ticks, and the class-tagged shed counter ticks."""
+    release = threading.Event()
+
+    @serve.deployment(max_concurrent_queries=8)
+    class Block:
+        def __call__(self, payload):
+            release.wait(30)
+            return {"ok": True}
+
+    serve.run(Block.bind(), route_prefix="/block")
+    proxy = serve.start_http_proxy(max_in_flight=1, queue_timeout_s=1.0)
+
+    def blocker():
+        _post(proxy.port, "/block")
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while proxy.stats()["in_flight"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        status, headers, _body = _post(
+            proxy.port, "/block", headers={"X-Job-Id": "job-shed"})
+        assert status == 503
+        assert headers.get("Retry-After") is not None
+        # Accounted at the shed point, all three surfaces. (The
+        # request-envelope records land one loop tick after the
+        # response bytes, so poll briefly.)
+        shed = perf_stats.counter(
+            "serve_requests_shed",
+            {"route": "/block", "job": "job-shed",
+             "class": "normal"}).value
+        assert shed >= 1
+        reqs = perf_stats.counter(
+            "serve_requests", {"route": "/block", "job": "job-shed"})
+        dist = perf_stats.dist(
+            "serve_request_seconds",
+            tags={"route": "/block", "status": "503"},
+            bounds=perf_stats.SERVE_LATENCY_BOUNDS)
+        deadline = time.monotonic() + 5
+        while (reqs.value < 1 or dist.total < 1) and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reqs.value >= 1
+        assert dist.total >= 1
+    finally:
+        release.set()
+        t.join(timeout=30)
+
+
+def test_priority_classes_shed_lowest_first(serve_up, monkeypatch):
+    """Layered priority admission: with in-flight at half the cap, a
+    low-priority request sheds (503 + Retry-After) while normal and
+    high still serve; a malformed X-Priority value is just normal."""
+    monkeypatch.setattr(ray_config, "serve_priority_shed_fractions",
+                        "1.0,1.0,0.5")
+    release = threading.Event()
+    started = threading.Semaphore(0)
+
+    @serve.deployment(max_concurrent_queries=8)
+    class Block:
+        def __call__(self, payload):
+            if payload == "hold":
+                started.release()
+                release.wait(30)
+            return {"ok": True}
+
+    serve.run(Block.bind(), route_prefix="/p")
+    proxy = serve.start_http_proxy(max_in_flight=4)
+    holders = [threading.Thread(
+        target=lambda: _post(proxy.port, "/p", "hold"))
+        for _ in range(2)]
+    for t in holders:
+        t.start()
+    try:
+        assert started.acquire(timeout=10)
+        assert started.acquire(timeout=10)
+        deadline = time.monotonic() + 10
+        while proxy.stats()["in_flight"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # in_flight == 2 == 0.5 * max_in_flight: low sheds...
+        status, headers, _ = _post(proxy.port, "/p", "x",
+                                   headers={"X-Priority": "low"})
+        assert status == 503
+        assert headers.get("Retry-After") is not None
+        # ...normal and high still serve; junk degrades to normal.
+        for prio in ("normal", "high", "2junk"):
+            status, _h, _b = _post(proxy.port, "/p", "x",
+                                   headers={"X-Priority": prio})
+            assert status == 200, prio
+        shed = perf_stats.counter("serve_priority_shed",
+                                  {"class": "low"}).value
+        assert shed >= 1
+    finally:
+        release.set()
+        for t in holders:
+            t.join(timeout=30)
+
+
+def test_priority_rate_bucket_sheds_with_headroom(serve_up,
+                                                  monkeypatch):
+    """A per-class token bucket sheds a class over its rate even when
+    the proxy has in-flight headroom, with the bucket's computed
+    accrual time on Retry-After."""
+    monkeypatch.setattr(ray_config, "serve_priority_rates", "low=1:1")
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"ok": True}
+
+    serve.run(Echo.bind(), route_prefix="/rl")
+    proxy = serve.start_http_proxy()
+    status, _h, _b = _post(proxy.port, "/rl", 1,
+                           headers={"X-Priority": "low"})
+    assert status == 200  # burst of 1
+    status, headers, _b = _post(proxy.port, "/rl", 2,
+                                headers={"X-Priority": "low"})
+    assert status == 503
+    assert int(headers.get("Retry-After", 0)) >= 1
+    # Other classes unaffected.
+    status, _h, _b = _post(proxy.port, "/rl", 3)
+    assert status == 200
+
+
+def test_parse_priority_grammar():
+    assert tenancy.parse_priority("high") == 0
+    assert tenancy.parse_priority("NORMAL") == 1
+    assert tenancy.parse_priority("low") == 2
+    assert tenancy.parse_priority("0") == 0
+    assert tenancy.parse_priority("2") == 2
+    assert tenancy.parse_priority("") == 1
+    assert tenancy.parse_priority("7") == 1
+    assert tenancy.parse_priority("urgent!!") == 1
+    assert tenancy.parse_shed_fractions("1.0,0.9,0.5") == (1.0, 0.9, 0.5)
+    assert tenancy.parse_shed_fractions("junk") == (1.0, 1.0, 1.0)
+    assert tenancy.parse_shed_fractions("0.5") == (0.5, 1.0, 1.0)
+
+
+def test_membership_fans_out_once_per_process(serve_up):
+    """Two handles (four routers/dispatchers worth of subscribers)
+    share ONE long-poll client per deployment: membership changes fan
+    out once per process, not once per router."""
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    handle_a = serve.run(Echo.bind(), route_prefix="/echo")
+    handle_b = serve.get_deployment_handle("Echo")
+    assert ray_tpu.get(handle_a.remote(1), timeout=30) == 1
+    assert ray_tpu.get(handle_b.remote(2), timeout=30) == 2
+
+    poll_threads = [t for t in threading.enumerate()
+                    if t.name == "longpoll-replicas::Echo"]
+    assert len(poll_threads) == 1, [t.name for t in poll_threads]
+
+    # Both handles see a membership change through the shared watch:
+    # scale to 2 and keep serving.
+    controller = serve.get_or_create_controller()
+    info = ray_tpu.get(
+        controller.get_deployment_info.remote("Echo"))
+    deploy_info = {"cls": Echo.func_or_class, "init_args": (),
+                   "init_kwargs": {}, "num_replicas": 2,
+                   "user_config": None, "max_concurrent_queries": 100,
+                   "ray_actor_options": None,
+                   "autoscaling_config": None,
+                   "version": info["version"]}
+    ray_tpu.get(controller.deploy.remote("Echo", deploy_info))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        info = ray_tpu.get(
+            controller.get_deployment_info.remote("Echo"))
+        if info["num_replicas"] == 2:
+            break
+        time.sleep(0.05)
+    assert info["num_replicas"] == 2
+    assert ray_tpu.get(handle_b.remote(3), timeout=30) == 3

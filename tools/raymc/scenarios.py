@@ -1348,6 +1348,125 @@ class QuotaAdmissionScenario(Scenario):
 # -- scheduler dep-park table: death sweep vs dep-ready claims ---------------
 
 
+class ReplicaDirectScenario(Scenario):
+    name = "replica_direct"
+    description = ("serve replica-direct dispatch racing a long-poll "
+                   "membership removal: no slot claim ever lands on a "
+                   "replica whose removal committed before the claim "
+                   "started, per-replica slots never exceed the cap "
+                   "or go negative, and every claim releases")
+    points = ("serve.direct.acquire", "serve.direct.update")
+    max_steps = 24
+    # Three single-crossing actions (dep_sweep's shape): the
+    # exhaustive sweep is small; the floor leaves headroom so
+    # `exhausted` stays honest. Release is deliberately NOT a gated
+    # point here — the acquire crossing sits INSIDE the product's
+    # snapshot→claim race window (the interleaving that matters), and
+    # release-after-removal is reached via the pre-held rB token that
+    # disp-a releases after the updater may have committed.
+    max_schedules = 6000
+    block_grace_s = 0.02
+
+    # The REAL ReplicaDirectTable (the proxy fleet's steady-state fast
+    # path) under a condensed model of the wiring: two dispatchers are
+    # concurrent proxy requests claiming slots, the updater is the
+    # shared membership watch committing a snapshot that REMOVES
+    # replica rB (a scale-down / death broadcast). The property is the
+    # data plane's cache-invalidation contract: once the removal
+    # commits, no acquire returns rB — a request is never dispatched
+    # to a replica after its removal committed to long-poll state.
+
+    def setup(self) -> None:
+        from ray_tpu.serve._private.membership import ReplicaDirectTable
+
+        self.table = ReplicaDirectTable(cap=1)
+        self.table.update(1, ["rA", "rB"])
+        # Pre-hold rB's only slot (round-robin: first acquire claims
+        # rA — returned immediately — second claims rB): disp-a
+        # releases it mid-run, so schedules where the updater's
+        # removal commits FIRST exercise release-after-removal.
+        first = self.table.acquire()
+        self.held_rb = self.table.acquire()
+        self.table.release(first)
+        assert self.held_rb is not None and self.held_rb.replica == "rB"
+        # version -> committed membership (the updater bumps
+        # `committed` AFTER its update returns — commit is a return
+        # edge).
+        self.members = {1: {"rA", "rB"}, 2: {"rA"}}
+        self.committed = 1
+        self._wlock = threading.Lock()
+        self.claims: List[Tuple[str, int, int]] = []
+
+    def actions(self):
+        def dispatcher(release_held):
+            def body():
+                pre = self.committed  # committed BEFORE this acquire
+                token = self.table.acquire()
+                if token is not None:
+                    with self._wlock:
+                        self.claims.append(
+                            (token.replica, token.version, pre))
+                    self.table.release(token)
+                if release_held:
+                    # Possibly AFTER rB's removal committed: the slot
+                    # row is gone and the release must drop into the
+                    # void, never corrupt the replacement accounting.
+                    self.table.release(self.held_rb)
+            return body
+
+        return [("disp-a", dispatcher(True)),
+                ("disp-b", dispatcher(False)),
+                ("updater", self._update)]
+
+    def _update(self):
+        self.table.update(2, ["rA"])
+        self.committed = 2
+
+    def invariants(self):
+        def no_stale_claim(s):
+            with s._wlock:
+                claims = list(s.claims)
+            for replica, version, pre in claims:
+                legal = s.members.get(version)
+                if legal is None or replica not in legal:
+                    return (f"claim on {replica!r} under version "
+                            f"{version}, whose membership is {legal}")
+                if pre >= 2 and replica == "rB":
+                    return ("acquire started after rB's removal "
+                            "committed yet returned rB")
+            return True
+
+        def slots_exact(s):
+            with s.table._lock:
+                slots = dict(s.table._slots)
+            for replica, held in slots.items():
+                if held < 0:
+                    return f"slot count for {replica!r} is {held} (<0)"
+                if held > s.table.cap:
+                    return (f"slot count for {replica!r} is {held}, "
+                            f"over cap {s.table.cap}")
+            return True
+
+        return [
+            Invariant("no-stale-claim", no_stale_claim,
+                      description="a request is never dispatched to a "
+                                  "replica after its removal committed "
+                                  "to long-poll state"),
+            Invariant("slots-exact", slots_exact,
+                      description="per-replica in-flight slots stay "
+                                  "within [0, cap] at every quiescent "
+                                  "state"),
+        ]
+
+    def liveness(self):
+        return [Liveness(
+            "slots-drain",
+            lambda s: sum(s.table._slots.values()) == 0,
+            timeout_s=2.0,
+            description="every claimed slot is released (tokens for "
+                        "since-removed replicas included)")]
+
+
 class DepSweepScenario(Scenario):
     name = "dep_sweep"
     description = ("the scheduler's dep-park table under a racing "
@@ -1673,7 +1792,8 @@ SCENARIOS = {
                 ExactlyOnceResubmitScenario, LongPollRecoveryScenario,
                 SpillRaceScenario, LineageReconstructionScenario,
                 ActorRestartScenario, HeadCrashRecoveryScenario,
-                QuotaAdmissionScenario, DepSweepScenario)
+                QuotaAdmissionScenario, DepSweepScenario,
+                ReplicaDirectScenario)
 }
 
 # The bounded tier-1 leg: real code, small configs, exhaustive where
@@ -1684,7 +1804,8 @@ SCENARIOS = {
 # (and its background threads, which every quiescence settle must
 # scan) up for the rest of the leg (run order matters — cheap
 # scenarios first).
-DEFAULT_SCENARIOS = ("dep_sweep", "quota_admission", "router_cap",
+DEFAULT_SCENARIOS = ("dep_sweep", "quota_admission", "replica_direct",
+                     "router_cap",
                      "gcs_durability", "pipelined_close", "spill_race",
                      "lineage_reconstruction", "actor_restart",
                      "head_crash_recovery")
