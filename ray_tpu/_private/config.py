@@ -267,6 +267,38 @@ class RayTpuConfig:
     # so its oversized working set cannot evict another tenant's.
     job_arena_budgets: str = ""
 
+    # -- LLM serving (serve/llm.py + _private/kv_cache.py) ---------------
+    # Prefix/KV cache: prefill skips the shared prompt head by copying
+    # matched KV blocks from the host-side prefix cache into the slot
+    # and prefilling only the tail. Off = every request prefills its
+    # full prompt (pre-cache behavior; the bench A/B flips this).
+    llm_prefix_cache: bool = True
+    # Tokens per KV block (the prefix-match granularity; only full
+    # blocks are cached, the partial tail chunk never is).
+    llm_kv_block_tokens: int = 16
+    # Host-side prefix cache capacity per engine; LRU unpinned blocks
+    # evict past it (warm evictees fall to the shm tier below).
+    llm_prefix_cache_bytes: int = 256 * 1024 * 1024
+    # Shm-plane warm tier: evicted blocks persist as spill-backed
+    # shared objects (charged to the owning tenant's arena budget) so
+    # a cache hit on another replica restores via the object plane
+    # instead of recomputing the prefill.
+    llm_prefix_shm_tier: bool = True
+    # Cache-affinity routing: replicas export hot prefix-head digests
+    # through the membership long-poll; the replica-direct path scores
+    # candidates by matched-prefix bytes (tie → least-loaded).
+    llm_affinity_routing: bool = True
+    # How many MRU block keys a replica exports in its digest.
+    llm_digest_blocks: int = 32
+    # How often the controller polls replicas for fresh digests (and
+    # rebroadcasts the digests:: channel on change).
+    llm_digest_refresh_s: float = 2.0
+    # Multi-model cold-start SLA: a weight swap (load + device put)
+    # exceeding this deadline fails the request with
+    # ModelSwapDeadlineError (the loaded weights stay cached, so a
+    # retry is warm). 0 disables the deadline.
+    llm_model_swap_deadline_s: float = 30.0
+
     # -- GCS storage (reference: store_client/; "" = in-memory, a file
     #    path selects the durable SQLite backend in Redis's role) -------
     gcs_storage_path: str = ""

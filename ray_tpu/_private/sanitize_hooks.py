@@ -121,6 +121,13 @@ SPEC_POINTS = frozenset({
     # scheduler WFQ runnable queue: enqueue / fair pick
     "spec.wfq.put",
     "spec.wfq.pop",
+    # kv_cache.PrefixCache: prefix-tree read (longest-match pin), extra
+    # pin, unpin, block admission (may evict LRU), pressure eviction
+    "spec.kv.lookup",
+    "spec.kv.pin",
+    "spec.kv.release",
+    "spec.kv.admit",
+    "spec.kv.evict",
 })
 
 # The registered yield-point catalog. Grouped by component; the first
@@ -196,6 +203,14 @@ SCHED_POINTS = SPEC_POINTS | frozenset({
     # surface — exactly-once handoff between the two).
     "sched.dep_ready",
     "sched.dep_sweep",
+    # LLM prefix/KV cache: the lookup-pin, payload release, block
+    # admission, and pressure eviction edges (the kv_cache_reuse raymc
+    # scenario's interleaving surface — a hit racing admit/evict must
+    # never read freed KV bytes).
+    "llm.kv.lookup",
+    "llm.kv.release",
+    "llm.kv.admit",
+    "llm.kv.evict",
 })
 
 CRASH_POINTS = frozenset({

@@ -107,6 +107,29 @@ def generate_serve_dashboard() -> dict:
         {"title": "Replica latency", "unit": "s",
          "exprs": [('ray_tpu_serve_replica_request_seconds_p95',
                     "p95 {{deployment}} {{node}}")]},
+        # -- LLM serving row (PR 16): TTFT + the prefix/KV cache -------
+        {"title": "LLM TTFT", "unit": "s",
+         "exprs": [('ray_tpu_serve_ttft_seconds_p50',
+                    "p50 {{route}} {{model}}"),
+                   ('ray_tpu_serve_ttft_seconds_p99',
+                    "p99 {{route}} {{model}}")]},
+        {"title": "LLM KV cache",
+         "exprs": [("rate(ray_tpu_llm_kv_cache_hits[1m])", "hits/s"),
+                   ("rate(ray_tpu_llm_kv_cache_misses[1m])",
+                    "misses/s"),
+                   ("rate(ray_tpu_llm_kv_cache_evictions[1m])",
+                    "evictions/s")]},
+        {"title": "LLM KV cache bytes", "unit": "bytes",
+         "exprs": [("ray_tpu_llm_kv_cache_bytes", "resident"),
+                   ("rate(ray_tpu_llm_kv_shm_offloads[5m])",
+                    "shm offloads/s"),
+                   ("rate(ray_tpu_llm_kv_shm_restores[5m])",
+                    "shm restores/s")]},
+        {"title": "LLM model multiplexing",
+         "exprs": [("increase(ray_tpu_llm_model_swaps[5m])",
+                    "swaps (5m)"),
+                   ("increase(ray_tpu_serve_affinity_routed[5m])",
+                    "affinity-routed {{placed}} (5m)")]},
     ], uid="ray-tpu-serve")
 
 

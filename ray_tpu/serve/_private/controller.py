@@ -69,6 +69,13 @@ class _DeploymentState:
         self.last_health = 0.0
         # Burn-driven autoscaling hysteresis.
         self.last_burn_scale = 0.0
+        # Cache-affinity digest channel state: the in-flight
+        # prefix_digests() ref per replica (collected on later passes,
+        # like health pings), the last committed doc per replica NAME
+        # (what digests:: broadcasts), and the poll rate limiter.
+        self.digest_pings: Dict[Any, Any] = {}
+        self.digests: Dict[str, Any] = {}
+        self.last_digest = 0.0
 
     def forget_replica(self, r) -> None:
         """Drop ALL supervision state for a replica leaving membership
@@ -85,6 +92,9 @@ class _DeploymentState:
         self.health_strikes.pop(r, None)
         self.health_pings.pop(r, None)
         self.health_ok.discard(r)
+        self.digest_pings.pop(r, None)
+        if rname:
+            self.digests.pop(rname, None)
 
 
 @ray_tpu.remote
@@ -359,6 +369,7 @@ class ServeController:
             states = list(self._deployments.values())
         for st in states:
             self._check_replica_health(st)
+            self._poll_digests(st)
             self._autoscale(st)
             target = int(st.info.get("num_replicas", 1))
             version = st.version
@@ -401,6 +412,58 @@ class ServeController:
                 self._stop_replica(victim)
             if changed:
                 self._checkpoint()
+
+    def _poll_digests(self, st: _DeploymentState):
+        """Cache-affinity digest channel: collect each replica's hot
+        prefix-head digests (``prefix_digests()``, answered by LLM
+        deployments; None for everything else) and broadcast the
+        per-replica-name snapshot on ``digests::<deployment>`` for the
+        proxy fleet's replica-direct tables. Fire-and-collect like the
+        health pings — the reconcile loop never blocks on a replica.
+        Purely advisory: any failure leaves the last snapshot standing
+        (the router degrades to least-loaded/round-robin)."""
+        if not ray_config.llm_affinity_routing:
+            return
+        now = time.monotonic()
+        if now - st.last_digest < ray_config.llm_digest_refresh_s:
+            return
+        st.last_digest = now
+        changed = False
+        for r in list(st.replicas):
+            rname = st.replica_names.get(r)
+            if not rname:
+                continue
+            prev = st.digest_pings.pop(r, None)
+            if prev is not None:
+                try:
+                    ready, _ = ray_tpu.wait([prev], timeout=0)
+                except Exception:
+                    ready = []
+                if not ready:
+                    st.digest_pings[r] = prev  # still in flight
+                    continue
+                doc = None
+                try:
+                    doc = ray_tpu.get(prev, timeout=0.1)
+                except Exception:
+                    doc = None
+                if doc != st.digests.get(rname):
+                    if doc is None:
+                        st.digests.pop(rname, None)
+                    else:
+                        st.digests[rname] = doc
+                    changed = True
+            try:
+                st.digest_pings[r] = r.prefix_digests.remote()
+            except Exception:
+                pass
+        live = {st.replica_names.get(r) for r in st.replicas}
+        for rname in [n for n in st.digests if n not in live]:
+            st.digests.pop(rname, None)
+            changed = True
+        if changed:
+            self._long_poll.notify_changed(f"digests::{st.name}",
+                                           dict(st.digests))
 
     def _check_replica_health(self, st: _DeploymentState):
         """Replica supervision: detect dead replicas and remove them

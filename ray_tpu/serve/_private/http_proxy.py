@@ -194,6 +194,9 @@ class _Conn(asyncio.Protocol):
         self.trace_id = ""    # trace id of the request being handled
         self.job_id = ""      # job/tenant tag of the request in flight
         self.serve_path = ""  # dispatch path taken (direct/routed/...)
+        self.model = ""       # X-Model tag of the request in flight
+        self.ttft_s = None    # first-token latency, once observed
+        self.t_start = 0.0    # arrival stamp of the request in flight
 
     # -- lifecycle -------------------------------------------------------
 
@@ -560,18 +563,33 @@ class HTTPProxy:
                 job_id = ""  # cardinality guard: overflow -> untagged
             else:
                 self._job_tags_seen.add(job_id)
+        # Model tag (X-Model): selects the weight variant on a
+        # multi-model LLM deployment. Same sanitizer as the trace id
+        # (echoed into logs and used as a metric tag); malformed values
+        # drop to the deployment's default model.
+        raw_model = (req.headers.get("x-model", "")
+                     if getattr(req, "headers", None) else "")
+        model = raw_model if raw_model and len(raw_model) <= 64 \
+            and _TRACE_ID_OK(raw_model) else ""
         conn.trace_id = trace_id
         conn.job_id = job_id
         conn.last_status = 0
         conn.serve_path = ""
+        conn.model = model
+        conn.ttft_s = None
+        conn.t_start = t0
         route = ""
         try:
-            route = await self._respond(conn, req, trace_id, job_id)
+            route = await self._respond(conn, req, trace_id, job_id,
+                                        model=model)
         finally:
             latency = time.monotonic() - t0
+            ttft_s = conn.ttft_s
             conn.trace_id = ""
             conn.job_id = ""
             conn.serve_path = ""
+            conn.model = ""
+            conn.ttft_s = None
             status = str(conn.last_status or 0)
             perf_stats.dist(
                 "serve_request_seconds",
@@ -588,7 +606,7 @@ class HTTPProxy:
                       "job": job_id}).inc()
             if ray_config.serve_access_log:
                 try:
-                    _access_log.info(json.dumps({
+                    line = {
                         "method": getattr(req, "method", ""),
                         "route": route or "(unmatched)",
                         "path": getattr(req, "path", ""),
@@ -596,12 +614,18 @@ class HTTPProxy:
                         "latency_ms": round(latency * 1e3, 3),
                         "trace_id": trace_id,
                         "job_id": job_id,
-                    }))
+                    }
+                    if model:
+                        line["model"] = model
+                    if ttft_s is not None:
+                        line["ttft_ms"] = round(ttft_s * 1e3, 3)
+                    _access_log.info(json.dumps(line))
                 except Exception:
                     pass  # the access log must never break serving
 
     async def _respond(self, conn: _Conn, req: _Request,
-                       trace_id: str, job_id: str = "") -> str:
+                       trace_id: str, job_id: str = "",
+                       model: str = "") -> str:
         """Handle one parsed request; returns the matched route prefix
         (for metrics/logging)."""
         if req.error is not None:
@@ -680,6 +704,17 @@ class HTTPProxy:
                 payload = json.loads(req.body)
             except ValueError:
                 payload = req.body.decode("utf-8", "replace")
+        if isinstance(payload, dict):
+            # Header tags ride INSIDE the payload for deployments that
+            # understand them (multi-model routing, tenant charging,
+            # priority at the engine's slot shed point). Body values
+            # win — headers only fill gaps.
+            if model and not payload.get("model"):
+                payload["model"] = model
+            if job_id and not payload.get("job"):
+                payload["job"] = job_id
+            if req.headers.get("x-priority") and "priority" not in payload:
+                payload["priority"] = cls
         self._in_flight += 1
         token = None
         try:
@@ -772,8 +807,15 @@ class HTTPProxy:
             if token is not None:
                 self._direct_served += 1
             if is_stream(result):
-                await self._stream_response(conn, req, result)
+                await self._stream_response(conn, req, result,
+                                            route=route, model=model)
             else:
+                # Non-stream LLM responses carry their engine-measured
+                # TTFT; fold it into the same series the SSE path feeds.
+                if isinstance(result, dict) and \
+                        isinstance(result.get("ttft_s"), float):
+                    conn.ttft_s = result["ttft_s"]
+                    self._record_ttft(conn.ttft_s, route, model)
                 conn.send_response(200, json.dumps(result).encode(),
                                    keep=req.keep_alive)
             self._served += 1
@@ -817,7 +859,19 @@ class HTTPProxy:
         conn.send_response(503, b'{"error": "server overloaded"}',
                            keep=req.keep_alive, retry_after=retry_after)
 
-    async def _stream_response(self, conn: _Conn, req: _Request, result):
+    @staticmethod
+    def _record_ttft(ttft_s: float, route: str, model: str) -> None:
+        """ray_tpu_serve_ttft_seconds{route,model} — the LLM serving
+        SLO number: request arrival at the proxy to the first token on
+        the wire (SSE) or the engine's first-token stamp (unary)."""
+        perf_stats.dist(
+            "serve_ttft_seconds",
+            tags={"route": route or "(unmatched)",
+                  "model": model or "(default)"},
+            bounds=perf_stats.SERVE_LATENCY_BOUNDS).record(ttft_s)
+
+    async def _stream_response(self, conn: _Conn, req: _Request, result,
+                               route: str = "", model: str = ""):
         """Server-sent events with chunked transfer-encoding: the client
         sees each chunk as produced AND the connection stays usable for
         the next request (the threaded proxy had to Connection: close
@@ -835,6 +889,10 @@ class HTTPProxy:
         conn.send_header_block(200, headers)
         try:
             async for chunk in aiter_stream(result):
+                if conn.ttft_s is None:
+                    # First token on the wire: the streaming TTFT stamp.
+                    conn.ttft_s = time.monotonic() - conn.t_start
+                    self._record_ttft(conn.ttft_s, route, model)
                 conn.write_body(
                     b"data: " + json.dumps(chunk).encode() + b"\n\n",
                     chunked)

@@ -82,6 +82,13 @@ class ReplicaDirectTable:
         self._members: List[Any] = []
         self._slots: Dict[Any, int] = {}
         self._rr = 0
+        # Cache-affinity hints: replica actor name -> prefix-digest doc
+        # ({"seed", "block_tokens", "block_bytes", "keys", "model"}),
+        # fed by the controller's digests:: long-poll channel. Purely
+        # advisory — acquire() without an affinity hint (or with no
+        # digests) keeps the round-robin contract the raymc
+        # replica_direct scenario proves.
+        self._digests: Dict[str, dict] = {}
         # Replicas a CALLER observed dead before long-poll caught up:
         # filtered out of every snapshot until a committed membership
         # no longer contains them (then the tombstone drops — the name
@@ -107,7 +114,56 @@ class ReplicaDirectTable:
             self._slots = {r: self._slots.get(r, 0) for r in members}
             return True
 
-    def acquire(self, extra_load=None) -> Optional[DirectToken]:
+    def set_digests(self, digests: Optional[Dict[str, dict]]) -> None:
+        """Replace the affinity-hint table (controller broadcast). A
+        malformed snapshot degrades to no hints, never to an error on
+        the dispatch path."""
+        if not isinstance(digests, dict):
+            digests = {}
+        with self._lock:
+            self._digests = {str(k): v for k, v in digests.items()
+                             if isinstance(v, dict)}
+
+    @staticmethod
+    def _affinity_order(members, slots, digests, affinity_tokens):
+        """Reorder `members` by matched-prefix bytes against each
+        replica's exported digest keys (desc), tie-broken by fewest
+        held slots. Members without a positive score keep their
+        round-robin relative order at the tail. Pure: called on
+        SNAPSHOTS, outside the table lock."""
+        from ray_tpu._private.kv_cache import chain_keys
+
+        chains: Dict[tuple, list] = {}
+        scored = []
+        for pos, r in enumerate(members):
+            doc = digests.get(str(getattr(r, "_actor_name", "")) or "")
+            score = 0
+            if doc:
+                try:
+                    bt = int(doc.get("block_tokens", 0))
+                    seed = doc.get("seed", "")
+                    keys = doc.get("keys") or ()
+                    if bt > 0 and keys:
+                        ck = (seed, bt)
+                        chain = chains.get(ck)
+                        if chain is None:
+                            chain = chains[ck] = chain_keys(
+                                affinity_tokens, bt, seed)
+                        keyset = set(keys)
+                        matched = 0
+                        for key in chain:
+                            if key not in keyset:
+                                break
+                            matched += 1
+                        score = matched * int(doc.get("block_bytes", 1))
+                except Exception:
+                    score = 0
+            scored.append((-score, slots.get(r, 0), pos, r))
+        scored.sort(key=lambda t: t[:3])
+        return [t[3] for t in scored], bool(scored and -scored[0][0] > 0)
+
+    def acquire(self, extra_load=None,
+                affinity_tokens=None) -> Optional[DirectToken]:
         """Claim one slot on a member with headroom (round-robin), or
         None when every member is at cap / membership is empty.
 
@@ -118,19 +174,31 @@ class ReplicaDirectTable:
         sides. It is called OUTSIDE the table lock; the claim re-checks
         membership under the lock, so a replica removed between the
         snapshot and the claim is skipped — the no-stale-dispatch
-        property the raymc scenario proves."""
+        property the raymc scenario proves.
+
+        ``affinity_tokens`` (an LLM request's prompt head) reorders the
+        candidates by matched-prefix bytes against each replica's
+        exported digests — a prefix-cache hit skips the shared-head
+        prefill, which dwarfs any load-skew cost. Capacity still wins:
+        a scored replica at cap falls through to the next candidate."""
         with self._lock:
             members = list(self._members)
             start = self._rr
             self._rr += 1
+            digests = dict(self._digests) if affinity_tokens else None
+            slots_snap = dict(self._slots) if affinity_tokens else None
         # The yield point sits IN the race window: membership snapshot
         # taken, claim not yet committed — the interleaving raymc
         # orders an update's removal into (the under-lock containment
         # re-check below is what keeps the property true).
         sanitize_hooks.sched_point("serve.direct.acquire")
         n = len(members)
-        for i in range(n):
-            replica = members[(start + i) % n]
+        order = [members[(start + i) % n] for i in range(n)]
+        affine = False
+        if affinity_tokens and digests:
+            order, affine = self._affinity_order(
+                order, slots_snap, digests, affinity_tokens)
+        for idx, replica in enumerate(order):
             ext = extra_load(replica) if extra_load is not None else 0
             with self._lock:
                 held = self._slots.get(replica)
@@ -138,6 +206,11 @@ class ReplicaDirectTable:
                     continue  # removed since the snapshot: never claim
                 if held + ext < self.cap:
                     self._slots[replica] = held + 1
+                    if affine:
+                        _perf_stats.counter(
+                            "serve_affinity_routed",
+                            {"placed": "best" if idx == 0
+                             else "spill"}).inc()
                     return DirectToken(replica, self.version)
         return None
 
@@ -217,15 +290,15 @@ class _SubEntry:
 
 
 class _DeploymentWatch:
-    """One long-poll subscription per (controller, deployment) in this
+    """One long-poll subscription per (controller, channel) in this
     process; subscribers (routers, direct tables) get every snapshot —
     and the latest one immediately on subscribe."""
 
-    def __init__(self, key, controller, deployment: str):
+    def __init__(self, key, controller, channel: str):
         from ray_tpu.serve._private.long_poll import LongPollClient
 
         self._key = key
-        self._deployment = deployment
+        self._channel = channel
         self._controller = controller
         self._lock = threading.Lock()
         self._subs: List[_SubEntry] = []
@@ -234,7 +307,7 @@ class _DeploymentWatch:
         self._seq = 0  # local commit counter: the table's version feed
         self._stopped = False  # set by retire; subscribe refuses after
         self._client = LongPollClient(
-            controller, f"replicas::{deployment}", self._on_change,
+            controller, channel, self._on_change,
             reresolve=self._reresolve)
 
     def _reresolve(self):
@@ -324,22 +397,21 @@ def _controller_key(controller) -> Any:
     return aid.binary() if aid is not None else id(controller)
 
 
-def watch_replicas(controller, deployment: str, cb: Callable,
-                   on_controller: Optional[Callable] = None
-                   ) -> _Subscription:
-    """Subscribe ``cb(seq, replicas)`` to the deployment's membership
-    channel, sharing one long-poll stream per (controller, deployment)
-    in this process. The last unsubscribe stops the stream; a
-    subscriber racing that retirement retries against a fresh watch
-    (subscribe on a stopped watch returns None, never a dead
-    subscription)."""
-    key = (_controller_key(controller), deployment)
+def watch_channel(controller, channel: str, cb: Callable,
+                  on_controller: Optional[Callable] = None
+                  ) -> _Subscription:
+    """Subscribe ``cb(seq, snapshot)`` to any controller long-poll
+    channel, sharing one stream per (controller, channel) in this
+    process. The last unsubscribe stops the stream; a subscriber
+    racing that retirement retries against a fresh watch (subscribe on
+    a stopped watch returns None, never a dead subscription)."""
+    key = (_controller_key(controller), channel)
     while True:
         with _WATCH_LOCK:
             watch = _WATCHES.get(key)
             if watch is None:
                 watch = _WATCHES[key] = _DeploymentWatch(
-                    key, controller, deployment)
+                    key, controller, channel)
         sub = watch.subscribe(cb, on_controller)
         if sub is not None:
             return sub
@@ -350,6 +422,15 @@ def watch_replicas(controller, deployment: str, cb: Callable,
         with _WATCH_LOCK:
             if _WATCHES.get(key) is watch:
                 del _WATCHES[key]
+
+
+def watch_replicas(controller, deployment: str, cb: Callable,
+                   on_controller: Optional[Callable] = None
+                   ) -> _Subscription:
+    """Subscribe ``cb(seq, replicas)`` to the deployment's membership
+    channel (see :func:`watch_channel`)."""
+    return watch_channel(controller, f"replicas::{deployment}", cb,
+                         on_controller)
 
 
 def _retire_watch(watch: _DeploymentWatch) -> None:
@@ -411,7 +492,32 @@ class DirectDispatcher:
         self._router_load = None
         self._sub = watch_replicas(controller, deployment,
                                    self.table.update)
+        # Cache-affinity hints ride their own channel (hot prefix
+        # digests change far more often than membership — versioning
+        # them through update() would churn the slot table).
+        self._dig_sub = watch_channel(
+            controller, f"digests::{deployment}",
+            lambda _seq, snap: self.table.set_digests(snap))
         _DISPATCHERS.add(self)
+
+    @staticmethod
+    def _affinity_hint(args: tuple, kwargs: dict):
+        """An LLM request's prompt head (the affinity key), or None for
+        non-LLM payloads. Sniffed, not schema'd: the dispatcher serves
+        arbitrary deployments and must never fail on shape."""
+        from ray_tpu._private.config import ray_config
+
+        if not ray_config.llm_affinity_routing:
+            return None
+        payload = args[0] if args else kwargs.get("request")
+        if not isinstance(payload, dict):
+            return None
+        toks = payload.get("prompt_ids")
+        if not isinstance(toks, (list, tuple)) or not toks:
+            return None
+        # The digest match only needs the head; hashing a megaprompt
+        # per candidate scoring pass would tax the dispatch path.
+        return list(toks[:512])
 
     def set_router_load(self, fn) -> None:
         self._router_load = fn
@@ -423,7 +529,9 @@ class DirectDispatcher:
         from ray_tpu._private.task_spec import (set_ambient_job_id,
                                                 set_ambient_trace_parent)
 
-        token = self.table.acquire(extra_load=self._router_load)
+        token = self.table.acquire(
+            extra_load=self._router_load,
+            affinity_tokens=self._affinity_hint(args, kwargs))
         if token is None:
             return None, None
         try:
@@ -457,3 +565,4 @@ class DirectDispatcher:
 
     def shutdown(self) -> None:
         self._sub.unsubscribe()
+        self._dig_sub.unsubscribe()

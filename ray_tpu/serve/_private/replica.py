@@ -93,6 +93,19 @@ class ServeReplica:
             fn()
         return True
 
+    def prefix_digests(self):
+        """Cache-affinity hints for the controller's digests:: channel:
+        LLM deployments answer with their hot prefix-head digests; every
+        other deployment answers None (no hints, router stays
+        load-based)."""
+        fn = getattr(self.callable, "prefix_digests", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
+
     def handle_request(self, method: str, args: tuple, kwargs: dict):
         with self._lock:
             self._in_flight += 1

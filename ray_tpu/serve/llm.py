@@ -19,11 +19,31 @@ The engine is thread-safe: callers enqueue requests and block on their
 completion; a background loop interleaves admission and decode — the
 continuous-batching scheduler (admission between decode steps, no
 generation stall).
+
+Prefix/KV cache (PR 16): full ``llm_kv_block_tokens``-sized chunks of
+every admitted prompt are hash-chained into the
+:class:`~ray_tpu._private.kv_cache.PrefixCache` decision core, with the
+block KV payloads read back off-device into a host store. A later
+request sharing the prompt head copies the matched blocks straight into
+its slot's KV region and prefills ONLY the tail at the tail's bucket —
+the shared-head prefill compute (the dominant pre-first-token cost on a
+chatbot workload) is skipped entirely. Evicted-but-warm blocks persist
+as shm-plane objects (spill-backed, tenant-charged), so a hit on
+another replica restores KV bytes via the object plane instead of
+recomputing. Chain keys are seeded with the model identity, so
+multi-model replicas can never cross-hit.
+
+Multi-model multiplexing: a replica holds N weight variants
+(``LLMDeployment(models={...})``); the compiled programs take params as
+ARGUMENTS, so a swap is one ``device_put`` — no recompile. Requests
+carry a model tag and a priority class; interactive outranks batch at
+the slot shed point.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import logging
 import queue
@@ -36,11 +56,54 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ray_tpu._private import perf_stats
+from ray_tpu._private.config import ray_config
+from ray_tpu._private.kv_cache import PrefixCache, chain_keys
 from ray_tpu.models.llama import (
     LlamaConfig,
     forward_with_cache,
     init_kv_cache,
 )
+
+
+class PromptTooLongError(ValueError):
+    """Prompt exceeds the engine's slot KV region (``max_seq_len - 1``
+    tokens: one position must remain for generation). Raised at
+    ``generate()`` — the old behavior silently truncated the head,
+    which corrupts answers instead of failing loudly."""
+
+    def __init__(self, n_tokens: int, cap: int):
+        super().__init__(
+            f"prompt of {n_tokens} tokens exceeds the engine's "
+            f"{cap}-token cap (max_seq_len {cap + 1}); truncate or "
+            f"shard client-side")
+        self.n_tokens = n_tokens
+        self.cap = cap
+
+
+class UnknownModelError(ValueError):
+    """X-Model names a variant this deployment does not hold."""
+
+    def __init__(self, model: str, known):
+        super().__init__(
+            f"unknown model {model!r}; this replica serves {known}")
+        self.model = model
+        self.known = list(known)
+
+
+class ModelSwapDeadlineError(RuntimeError):
+    """A cold-start weight swap blew the ``llm_model_swap_deadline_s``
+    SLA. The loaded weights STAY cached (and published to the shm
+    plane), so an immediate retry is warm — the deadline is a latency
+    contract, not a capability failure."""
+
+    def __init__(self, model: str, took_s: float, deadline_s: float):
+        super().__init__(
+            f"swap to model {model!r} took {took_s:.2f}s, over the "
+            f"{deadline_s:.2f}s cold-start deadline (retry is warm)")
+        self.model = model
+        self.took_s = took_s
+        self.deadline_s = deadline_s
 
 
 # lax.top_k needs a static k: per-slot top_k values are clamped to this.
@@ -65,14 +128,19 @@ class _Request:
     slot: int = -1
     t_arrival: float = 0.0
     t_first_token: Optional[float] = None
+    model: Optional[str] = None
+    priority: int = 1     # 0 interactive > 1 normal > 2 batch
+    job: str = "default"
 
 
 class LLMEngine:
     def __init__(self, cfg: LlamaConfig, params, *,
                  max_batch_size: int = 8, max_seq_len: Optional[int] = None,
-                 decode_steps: int = 1, seed: int = 0):
+                 decode_steps: int = 1, seed: int = 0,
+                 model: str = "default"):
         self.cfg = cfg
         self.params = params
+        self.model = model
         self.n_slots = max_batch_size
         # Tokens generated per decode dispatch (in-program scan).
         # >1 trades admission granularity (a new request waits for the
@@ -134,8 +202,8 @@ class LLMEngine:
             out_shardings=(s1, s1, s1, s1, s1))
         self._prefill = jax.jit(
             self._prefill_impl, donate_argnums=(1,),
-            static_argnums=(5,),  # t — positional: pjit rejects kwargs
-            in_shardings=(None, s1, s1, s1, s1),  # with in_shardings
+            static_argnums=(6,),  # t — positional: pjit rejects kwargs
+            in_shardings=(None, s1, s1, s1, s1, s1),  # with in_shardings
             out_shardings=(s1, s1))
         # First-token sampling for an admission wave — FIXED shape
         # [n_slots, vocab] (padded) so it is ONE program compiled at
@@ -154,6 +222,42 @@ class LLMEngine:
         self._prefill_exec: Dict[int, Any] = {}
         self._decode_exec = None
         self._sample_exec = None
+        self._s1 = s1
+
+        # Prefix/KV cache: the PrefixCache decision core decides which
+        # blocks exist / are pinned / get evicted; _kv_store holds the
+        # actual host-side KV payloads keyed by block generation id
+        # (evicted payloads fall to the shm-plane warm tier).
+        self.block_tokens = max(1, int(ray_config.llm_kv_block_tokens))
+        self.prefix_cache: Optional[PrefixCache] = None
+        if ray_config.llm_prefix_cache and self.block_tokens < self.max_seq:
+            self.prefix_cache = PrefixCache(
+                ray_config.llm_prefix_cache_bytes, self.block_tokens)
+        self._kv_store: Dict[int, tuple] = {}
+        k = self.cache["k"]
+        per_token = 2 * k.size * k.dtype.itemsize // (k.shape[1] * k.shape[2])
+        self._block_nbytes = per_token * self.block_tokens
+        self._chain_seed = self._seed_for(model)
+        self._c_shm_offloads = perf_stats.counter("llm_kv_shm_offloads")
+        self._c_shm_restores = perf_stats.counter("llm_kv_shm_restores")
+        # Per-block KV copy-in/read-back programs (fixed [L, B, Hkv, D]
+        # block shape, traced slot/offset → exactly one compiled
+        # program each, touched at warmup).
+        self._read_block_j = jax.jit(
+            self._read_block_impl,
+            in_shardings=(s1, s1, s1), out_shardings=(s1, s1))
+        self._write_block_j = jax.jit(
+            self._write_block_impl, donate_argnums=(0,),
+            in_shardings=(s1, s1, s1, s1, s1), out_shardings=s1)
+
+    def _seed_for(self, model: str) -> str:
+        """Chain-key seed: model identity + the KV-shape fingerprint.
+        Two chains share keys only when the cached bytes are
+        interchangeable — same model, same layout — which is what makes
+        the shm tier safe to share across replicas."""
+        c = self.cfg
+        return (f"{model}|{c.n_layers}x{c.dim}x{c.n_kv_heads}x"
+                f"{c.max_seq_len}|{self.block_tokens}")
 
     def warmup(self, max_prompt_len: Optional[int] = None,
                concurrent: bool = True) -> float:
@@ -194,7 +298,15 @@ class LLMEngine:
         for bucket in buckets:
             tokens = jnp.zeros((1, bucket), jnp.int32)
             self.cache, last = self._run_prefill(
-                tokens, jnp.int32(0), jnp.int32(1), bucket)
+                tokens, jnp.int32(0), jnp.int32(1), jnp.int32(0), bucket)
+        if self.prefix_cache is not None \
+                and self.block_tokens <= self.max_seq:
+            # Touch the per-block KV copy programs so the first cache
+            # hit/readback doesn't pay a mid-serving compile.
+            kb, vb = self._read_block_j(
+                self.cache, jnp.int32(0), jnp.int32(0))
+            self.cache = self._write_block_j(
+                self.cache, kb, vb, jnp.int32(0), jnp.int32(0))
         # Admission-wave sampling program (and its eager stack feeder).
         stacked = jnp.stack([last] * self.n_slots)
         _firsts, self._rng = self._run_sample(
@@ -229,7 +341,7 @@ class LLMEngine:
         def compile_prefill(bucket):
             lowered = self._prefill.lower(
                 params_avals, cache_avals, aval((1, bucket)),
-                aval(()), aval(()), bucket)
+                aval(()), aval(()), aval(()), bucket)
             return bucket, lowered.compile()
 
         def compile_decode():
@@ -271,12 +383,12 @@ class LLMEngine:
     def _exec_fallback_ok(e: Exception) -> bool:
         return isinstance(e, (TypeError, ValueError))  # pre-dispatch checks
 
-    def _run_prefill(self, tokens, slot, length, bucket):
+    def _run_prefill(self, tokens, slot, length, start, bucket):
         compiled = self._prefill_exec.get(bucket)
         if compiled is not None:
             try:
                 return compiled(self.params, self.cache, tokens, slot,
-                                length)
+                                length, start)
             except Exception as e:
                 logging.getLogger(__name__).warning(
                     "AOT prefill[%d] failed (%s); %s", bucket, e,
@@ -286,7 +398,7 @@ class LLMEngine:
                 if not self._exec_fallback_ok(e):
                     raise
         return self._prefill(self.params, self.cache, tokens, slot,
-                             length, bucket)
+                             length, start, bucket)
 
     def _run_decode(self, last, lengths, temps, topks):
         if self._decode_exec is not None:
@@ -330,20 +442,46 @@ class LLMEngine:
         firsts = jnp.where(temps > 0, sampled, logits.argmax(-1))
         return firsts.astype(jnp.int32), rng
 
-    def _prefill_impl(self, params, cache, tokens, slot, length, t):
-        """tokens: [1, t] padded prompt; writes KV for one slot, returns
-        logits at the last real position [vocab]."""
+    def _prefill_impl(self, params, cache, tokens, slot, length, start, t):
+        """tokens: [1, t] padded prompt tail; writes KV for one slot
+        beginning at absolute position `start` (0 for a full prefill;
+        the matched-prefix length when cached KV blocks were copied in
+        ahead of this call), returns logits at the last real position
+        [vocab]."""
         slot_cache = {"k": lax_slice_slot(cache["k"], slot),
                       "v": lax_slice_slot(cache["v"], slot)}
         logits, new_slot_cache = forward_with_cache(
             params, tokens, self.cfg, slot_cache,
-            jnp.zeros((1,), jnp.int32))
+            jnp.full((1,), start, jnp.int32))
         cache = {
             "k": lax_write_slot(cache["k"], new_slot_cache["k"], slot),
             "v": lax_write_slot(cache["v"], new_slot_cache["v"], slot),
         }
         last = logits[0, length - 1]
         return cache, last
+
+    def _read_block_impl(self, cache, slot, start):
+        """Read one `block_tokens`-sized KV block out of a slot's region
+        at token offset `start` → (k, v) each [L, B, Hkv, D]."""
+        bt = self.block_tokens
+        out = []
+        for name in ("k", "v"):
+            x = cache[name]  # [L, slots, S, Hkv, D]
+            blk = jax.lax.dynamic_slice(
+                x, (0, slot, start, 0, 0),
+                (x.shape[0], 1, bt, x.shape[3], x.shape[4]))
+            out.append(blk[:, 0])
+        return tuple(out)
+
+    def _write_block_impl(self, cache, kb, vb, slot, start):
+        """Write one KV block (shapes from `_read_block_impl`) into a
+        slot's region at token offset `start`."""
+        new = {}
+        for name, blk in (("k", kb), ("v", vb)):
+            x = cache[name]
+            new[name] = jax.lax.dynamic_update_slice(
+                x, blk[:, None], (0, slot, start, 0, 0))
+        return new
 
     def _decode_impl(self, params, cache, last_tokens, lengths, temps,
                      topks, rng):
@@ -412,12 +550,20 @@ class LLMEngine:
 
     def generate(self, prompt_ids: List[int],
                  params: Optional[SamplingParams] = None,
-                 stream: bool = False):
+                 stream: bool = False, *,
+                 model: Optional[str] = None,
+                 priority: int = 1,
+                 job: str = "default"):
         """Blocking generate (or an iterator of tokens with stream=True)."""
+        prompt = list(prompt_ids)
+        cap = self.max_seq - 1
+        if len(prompt) > cap:
+            raise PromptTooLongError(len(prompt), cap)
         req = _Request(
-            request_id=next(self._req_counter), prompt=list(prompt_ids),
+            request_id=next(self._req_counter), prompt=prompt,
             params=params or SamplingParams(), out_queue=queue.Queue(),
-            t_arrival=time.perf_counter())
+            t_arrival=time.perf_counter(),
+            model=model, priority=max(0, min(2, int(priority))), job=job)
         self._queue.put(req)
         self.start()
 
@@ -434,11 +580,15 @@ class LLMEngine:
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "active_slots": int(self._active.sum()),
                 "free_slots": len(self._free_slots),
                 "queued": self._queue.qsize(),
+                "model": self.model,
             }
+        if self.prefix_cache is not None:
+            out["kv_cache"] = self.prefix_cache.stats()
+        return out
 
     # -- engine loop -----------------------------------------------------
 
@@ -459,31 +609,62 @@ class LLMEngine:
                 continue
             self._decode_once()
 
+    def _serve_bucket(self, t_real: int) -> int:
+        """Smallest compiled bucket that fits `t_real` tokens. The old
+        code keyed `_run_prefill` on the exact power-of-two, so a
+        request just over `warmup_max_prompt_len` missed the AOT ladder
+        and paid a mid-serving compile even though a LARGER compiled
+        bucket could serve it; now any bucket ≤ the compiled max
+        serves from the ladder."""
+        b = 1
+        while b < t_real:
+            b *= 2
+        b = min(b, self.max_seq)
+        if b in self._prefill_exec or not self._prefill_exec:
+            return b
+        bigger = [x for x in self._prefill_exec if x >= b]
+        return min(bigger) if bigger else b
+
     def _admit(self) -> bool:
         if self._queue.empty() or not self._free_slots:
             return False
         # Admission invalidates the device carries and needs free slots:
         # drain the in-flight decode block first.
         self._flush_pending()
-        staged = []  # (req, slot, t_real, last_logits_ref)
-        while self._free_slots:
+        drained: List[_Request] = []
+        while True:
             try:
-                req = self._queue.get_nowait()
+                drained.append(self._queue.get_nowait())
             except queue.Empty:
                 break
-            prompt = req.prompt[-(self.max_seq - 1):]
+        # Priority classes decide who gets the scarce slots at the shed
+        # point: interactive (0) outranks normal (1) outranks batch (2);
+        # FIFO within a class via the monotonic request id.
+        drained.sort(key=lambda r: (r.priority, r.request_id))
+        staged = []  # (req, slot, t_real, last_logits_ref, chain)
+        leftover: List[_Request] = []
+        for req in drained:
+            if not self._free_slots:
+                leftover.append(req)
+                continue
+            prompt = req.prompt
             t_real = len(prompt)
-            bucket = 1
-            while bucket < t_real:
-                bucket *= 2
-            bucket = min(bucket, self.max_seq)
             slot = self._free_slots.pop()
+            # Prefix-cache fast path: copy matched KV blocks straight
+            # into the slot, then prefill ONLY the tail at the tail's
+            # bucket, starting at the matched offset.
+            m_tok, chain = self._prefix_copy_in(req, slot, prompt)
+            tail = prompt[m_tok:]
+            t_tail = len(tail)
+            bucket = self._serve_bucket(t_tail)
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :t_real] = prompt
+            tokens[0, :t_tail] = tail
             self.cache, last_logits = self._run_prefill(
-                jnp.asarray(tokens), jnp.int32(slot), jnp.int32(t_real),
-                bucket)
-            staged.append((req, slot, t_real, last_logits))
+                jnp.asarray(tokens), jnp.int32(slot), jnp.int32(t_tail),
+                jnp.int32(m_tok), bucket)
+            staged.append((req, slot, t_real, last_logits, chain))
+        for req in leftover:
+            self._queue.put(req)
         if not staged:
             return False
         # ONE device-side sampling + ONE host sync for the whole wave:
@@ -501,7 +682,7 @@ class LLMEngine:
             logits, jnp.asarray(temps_np))
         firsts = np.asarray(firsts_dev)[:len(staged)]
         now = time.perf_counter()
-        for (req, slot, t_real, _), first in zip(staged, firsts):
+        for (req, slot, t_real, _, _chain), first in zip(staged, firsts):
             first = int(first)
             req.t_first_token = now
             req.tokens.append(first)
@@ -517,6 +698,12 @@ class LLMEngine:
                                                    _TOP_K_MAX))
             if self._finished(req, first):
                 self._retire(slot)
+        # Prefix-cache read-back AFTER the first-token wave (TTFT is not
+        # taxed by the host copies). Safe ordering: a slot retired above
+        # cannot be re-admitted until a LATER _admit call, so the KV
+        # bytes being read are still this request's prefill output.
+        for req, slot, t_real, _logits, chain in staged:
+            self._prefix_admit(req, slot, chain)
         # Host state changed: rebuild device carries on the next decode.
         self._dev_last = self._dev_lengths = None
         return True
@@ -575,6 +762,155 @@ class LLMEngine:
         self._lengths[slot] = 0
         self._free_slots.append(slot)
 
+    # -- prefix/KV cache ------------------------------------------------
+    #
+    # The PrefixCache core (pure, spec-checked) decides which blocks
+    # exist; the engine owns the PAYLOADS: `_kv_store` maps block
+    # generation id → (k, v) host arrays, and evicted payloads fall to
+    # the shm plane under a deterministic ObjectID derived from the
+    # chain key. A chain key commits to the model seed + every token of
+    # the prefix, so a key hit on ANY tier is byte-identical KV by
+    # construction (same weights + same tokens + causal attention).
+
+    def _prefix_copy_in(self, req: _Request, slot: int, prompt):
+        """Copy the longest cached prefix of `prompt` into `slot`'s KV
+        region. Returns (matched_tokens, chain_keys)."""
+        pc = self.prefix_cache
+        if pc is None:
+            return 0, []
+        chain = chain_keys(prompt, self.block_tokens, self._chain_seed)
+        if not chain:
+            return 0, []
+        hit = pc.lookup(chain, req.job)
+        # Cap the match: (a) ≥1 real token must go through prefill (the
+        # last-position logits feed the first sampled token), and (b)
+        # matched_offset + tail_bucket must FIT the slot's KV region —
+        # an overhanging padded bucket would clamp its KV write and
+        # corrupt the copied prefix.
+        m = min(len(hit), (len(prompt) - 1) // self.block_tokens)
+        while m > 0:
+            t_tail = len(prompt) - m * self.block_tokens
+            if m * self.block_tokens + self._serve_bucket(t_tail) \
+                    <= self.max_seq:
+                break
+            m -= 1
+        while len(hit) > m:
+            pc.release([hit.pop()])
+        # Resolve payloads hot→warm; the first miss truncates the match
+        # (a child block without its parent is useless).
+        payloads = []
+        for i, h in enumerate(hit):
+            p = self._kv_store.get(h.block_id)
+            if p is None:
+                p = self._shm_restore(h)
+            if p is None:
+                pc.release(hit[i:])
+                hit = hit[:i]
+                break
+            payloads.append(p)
+        for h, (k_np, v_np) in zip(hit, payloads):
+            self.cache = self._write_block_j(
+                self.cache, jnp.asarray(k_np), jnp.asarray(v_np),
+                jnp.int32(slot), jnp.int32(h.index * self.block_tokens))
+        pc.release(hit)
+        return len(hit) * self.block_tokens, chain
+
+    def _prefix_admit(self, req: _Request, slot: int, chain):
+        """After prefill, admit the prompt's full-block chain and read
+        the KV bytes for newly-created blocks back to the host store.
+        Runs post-first-token so TTFT never pays for the readback."""
+        pc = self.prefix_cache
+        if pc is None or not chain:
+            return
+        created, evicted = pc.admit(chain, req.job, self._block_nbytes)
+        for h in created:
+            kb, vb = self._read_block_j(
+                self.cache, jnp.int32(slot),
+                jnp.int32(h.index * self.block_tokens))
+            self._kv_store[h.block_id] = (np.asarray(kb), np.asarray(vb))
+        pc.release(created)
+        self._offload_evicted(evicted)
+
+    @staticmethod
+    def _shm_object_id(key: str):
+        from ray_tpu._private.ids import ObjectID
+        return ObjectID(hashlib.blake2b(
+            ("llmkv|" + key).encode(), digest_size=ObjectID.SIZE).digest())
+
+    def _shm_plane(self):
+        if not ray_config.llm_prefix_shm_tier:
+            return None
+        try:
+            from ray_tpu._private.worker import global_worker_or_none
+            w = global_worker_or_none()
+            return getattr(w, "shm_plane", None)
+        except Exception:
+            return None
+
+    def _shm_restore(self, handle):
+        """Warm-tier fetch: a block evicted here (or admitted by ANOTHER
+        replica — keys are content-addressed) comes back through the
+        object plane instead of being recomputed."""
+        plane = self._shm_plane()
+        if plane is None:
+            return None
+        try:
+            ok, payload = plane.get(self._shm_object_id(handle.key))
+        except Exception:
+            return None
+        if not ok or payload is None:
+            return None
+        self._kv_store[handle.block_id] = payload
+        self._c_shm_restores.inc()
+        return payload
+
+    def _offload_evicted(self, evicted):
+        """Evicted blocks leave the host store but persist as shm-plane
+        objects (spill-backed, charged to the admitting tenant's plane
+        quota) — a later hit restores bytes instead of recomputing."""
+        plane = self._shm_plane() if evicted else None
+        for e in evicted:
+            payload = self._kv_store.pop(e.block_id, None)
+            if plane is None or payload is None:
+                continue
+            try:
+                if plane.maybe_put(self._shm_object_id(e.key), payload,
+                                   timeout=0.1):
+                    self._c_shm_offloads.inc()
+            except Exception:
+                pass  # warm tier is best-effort; the cold path recomputes
+
+    # -- multi-model ----------------------------------------------------
+
+    def swap_params(self, params, model: str):
+        """Swap the served weight set (multi-model multiplexing). The
+        compiled programs take params as ARGUMENTS with unchanged avals,
+        so no recompile happens — the swap is one device_put. Caller
+        must have drained the engine (no active slots / queued work):
+        in-flight KV belongs to the OLD model."""
+        with self._lock:
+            if self._active.any() or not self._queue.empty():
+                raise RuntimeError(
+                    "swap_params on a non-idle engine: drain first")
+            self.params = jax.device_put(params, self._s1)
+            self.model = model
+            self._chain_seed = self._seed_for(model)
+
+    def prefix_digests(self) -> Optional[Dict[str, Any]]:
+        """Hot prefix-head digests for cache-affinity routing (exported
+        through the serve membership channel). None ⇒ no hints (router
+        falls back to least-loaded/round-robin)."""
+        if self.prefix_cache is None or not ray_config.llm_affinity_routing:
+            return None
+        return {
+            "model": self.model,
+            "block_tokens": self.block_tokens,
+            "seed": self._chain_seed,
+            "block_bytes": self._block_nbytes,
+            "keys": self.prefix_cache.hot_digests(
+                int(ray_config.llm_digest_blocks)),
+        }
+
 
 def lax_slice_slot(cache, slot):
     """cache: [L, slots, S, H, D] → [L, 1, S, H, D] at `slot`."""
@@ -589,23 +925,59 @@ def lax_write_slot(cache, slot_cache, slot):
 # -- Serve integration ------------------------------------------------------
 
 
+# Priority classes understood on the wire (ints 0-2 also accepted).
+_PRIORITY_CLASSES = {
+    "high": 0, "interactive": 0, "normal": 1, "low": 2, "batch": 2,
+}
+
+
+def _parse_priority(raw) -> int:
+    if isinstance(raw, str):
+        return _PRIORITY_CLASSES.get(raw.lower().strip(), 1)
+    try:
+        return max(0, min(2, int(raw)))
+    except (TypeError, ValueError):
+        return 1
+
+
 class LLMDeployment:
     """Deployment-ready wrapper: `serve.deployment(LLMDeployment).bind(...)`.
 
-    Each replica owns one engine (one model copy + cache in its chip's
-    HBM); serve's router spreads requests over replicas.
+    Each replica owns one engine (one KV cache in its chip's HBM) and
+    may multiplex N weight variants (``models={name: params_fn}``): the
+    compiled programs take params as arguments, so switching models is
+    a drain + ``device_put``, never a recompile. A swap is charged to
+    the requesting tenant and bounded by the
+    ``llm_model_swap_deadline_s`` cold-start SLA (post-hoc: the weights
+    stay cached, so a deadline miss leaves the NEXT attempt warm).
+    Serve's router spreads requests over replicas, preferring replicas
+    whose prefix cache already holds the request's prompt head.
     """
 
-    def __init__(self, cfg: LlamaConfig, params_fn: Callable[[], Any],
+    def __init__(self, cfg: LlamaConfig, params_fn: Callable[[], Any] = None,
                  max_batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  decode_steps: int = 1,
                  warmup: bool = True,
-                 warmup_max_prompt_len: Optional[int] = None):
-        params = params_fn() if callable(params_fn) else params_fn
+                 warmup_max_prompt_len: Optional[int] = None,
+                 models: Optional[Dict[str, Any]] = None,
+                 default_model: Optional[str] = None):
+        self.models: Dict[str, Any] = dict(models or {})
+        if params_fn is not None and not self.models:
+            self.models[default_model or "default"] = params_fn
+        if not self.models:
+            raise ValueError("LLMDeployment needs params_fn or models={...}")
+        self.default_model = default_model or next(iter(self.models))
+        if self.default_model not in self.models:
+            raise UnknownModelError(self.default_model, self.models)
+        self._loaded: Dict[str, Any] = {}
+        self._swap_lock = threading.RLock()
+        self._c_swaps = perf_stats.counter("llm_model_swaps")
+        params = self._load_model(self.default_model, job="deploy")
         self.engine = LLMEngine(cfg, params, max_batch_size=max_batch_size,
                                 max_seq_len=max_seq_len,
-                                decode_steps=decode_steps)
+                                decode_steps=decode_steps,
+                                model=self.default_model)
         # Deploy-time AOT: compile prefill buckets + decode BEFORE the
         # replica takes traffic, so the first request's TTFT is serving
         # latency, not XLA compile (round 3 measured 14 s cold TTFT).
@@ -615,22 +987,97 @@ class LLMDeployment:
             if warmup else 0.0
         self.engine.start()
 
+    # -- model loading / swapping ---------------------------------------
+
+    def _load_model(self, model: str, job: str):
+        """Resolve a model's weights: host cache → shm-plane warm tier →
+        loader callable. The load is charged to the requesting tenant
+        via the swap-bytes counter (and the plane publish is
+        quota-charged by the plane itself)."""
+        cached = self._loaded.get(model)
+        if cached is not None:
+            return cached
+        src = self.models[model]
+        params = src() if callable(src) else src
+        self._loaded[model] = params
+        try:
+            nbytes = sum(
+                int(x.size) * int(x.dtype.itemsize)
+                for x in jax.tree_util.tree_leaves(params)
+                if hasattr(x, "size") and hasattr(x, "dtype"))
+            perf_stats.counter(
+                "llm_model_swap_bytes", {"job": job}).inc(nbytes)
+        except Exception:
+            pass
+        return params
+
+    def _ensure_model(self, model: str, job: str):
+        """Make `model` the engine's live weight set. Caller holds
+        `_swap_lock`, which also covers the subsequent enqueue — no
+        other request can slip a different model in between. Returns
+        the loaded params (unused by the engine path, handy for
+        tests)."""
+        if model not in self.models:
+            raise UnknownModelError(model, self.models)
+        if self.engine.model == model:
+            return self._loaded.get(model)
+        t0 = time.perf_counter()
+        # Drain: every request enqueues under _swap_lock (held by us),
+        # so active/queued can only fall.
+        while True:
+            m = self.engine.metrics()
+            if m["active_slots"] == 0 and m["queued"] == 0:
+                break
+            time.sleep(0.002)
+        params = self._load_model(model, job)
+        self.engine.swap_params(params, model)
+        self._c_swaps.inc()
+        took = time.perf_counter() - t0
+        deadline = float(ray_config.llm_model_swap_deadline_s or 0)
+        if deadline and took > deadline:
+            # Post-hoc SLA: the swap COMPLETED and the weights stay
+            # cached, so the caller's retry is warm.
+            raise ModelSwapDeadlineError(model, took, deadline)
+        return params
+
+    def prefix_digests(self):
+        return self.engine.prefix_digests()
+
     def __call__(self, request: Dict[str, Any]):
         t0 = time.perf_counter()
         params = SamplingParams(
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             stop_token_ids=tuple(request.get("stop_token_ids", ())))
+        model = str(request.get("model") or self.default_model)
+        priority = _parse_priority(request.get("priority", 1))
+        job = str(request.get("job") or request.get("job_id") or "default")
+        # Hold the swap lock across ensure + enqueue: a concurrent
+        # request for a DIFFERENT model must not swap weights between
+        # our check and our admission. Token consumption happens
+        # outside the lock — a queued request pins its model because
+        # any later swap drains the queue first.
+        with self._swap_lock:
+            self._ensure_model(model, job)  # raylint: disable=R2 -- the blocking drain IS the design: the swap lock must span drain+swap+enqueue or a concurrent request could swap weights between our model check and our admission; the engine drains independently of this lock, so the wait always terminates
+            it = self.engine.generate(
+                request["prompt_ids"], params, stream=True,
+                model=model, priority=priority, job=job)
         if request.get("stream"):
             # Generator return → the replica streams it chunk-by-chunk
             # (tokens reach the client during decode, not after).
             def token_stream():
-                for i, token in enumerate(self.engine.generate(
-                        request["prompt_ids"], params, stream=True)):
+                for i, token in enumerate(it):
                     yield {"token": int(token), "index": i}
             return token_stream()
-        tokens = self.engine.generate(request["prompt_ids"], params)
+        tokens = []
+        ttft_s = None
+        for token in it:
+            if ttft_s is None:
+                ttft_s = time.perf_counter() - t0
+            tokens.append(int(token))
         return {"tokens": tokens,
+                "model": model,
+                "ttft_s": ttft_s,
                 "latency_s": time.perf_counter() - t0}
 
     def check_health(self):

@@ -99,6 +99,17 @@ def test_grafana_dashboard_factory(tmp_path):
                                  for t in p["targets"])
     assert "ray_tpu_job_quota_rejections_total" in tenancy_exprs
     assert "ray_tpu_job_arena_spill_bytes_total" in tenancy_exprs
+    serve = next(p for p in paths if "serve" in p)
+    with open(serve) as f:
+        serve_exprs = " ".join(t["expr"]
+                               for p in json.load(f)["panels"]
+                               for t in p["targets"])
+    # LLM serving row (PR 16): TTFT + prefix/KV-cache series.
+    assert "ray_tpu_serve_ttft_seconds_p50" in serve_exprs
+    assert "ray_tpu_serve_ttft_seconds_p99" in serve_exprs
+    assert "ray_tpu_llm_kv_cache_hits" in serve_exprs
+    assert "ray_tpu_llm_kv_cache_bytes" in serve_exprs
+    assert "ray_tpu_llm_model_swaps" in serve_exprs
     obj = next(p for p in paths if "object-plane" in p)
     with open(obj) as f:
         obj_exprs = " ".join(t["expr"]

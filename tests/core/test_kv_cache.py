@@ -1,0 +1,155 @@
+"""PrefixCache decision-core unit suite (PR 16).
+
+Pure-Python and fast: this file is also one of rayspec's
+``DEFAULT_PATHS``, so every op here runs under the history recorder in
+the tier-1 rayspec leg and the recorded interleavings are checked
+against ``KvCacheSpec`` for linearizability — keep it driving every
+public op (lookup/pin/release/admit/evict), including concurrently.
+"""
+
+import threading
+
+import pytest
+
+from ray_tpu._private.kv_cache import (
+    BlockHandle,
+    PrefixCache,
+    chain_keys,
+)
+
+_BT = 4
+_NB = 64  # block payload bytes used throughout
+
+
+def _chain(tokens):
+    return chain_keys(tokens, _BT, seed="test")
+
+
+def test_chain_keys_commit_to_prefix_and_seed():
+    toks = list(range(12))
+    keys = _chain(toks)
+    assert len(keys) == 3  # full chunks only
+    assert _chain(toks[:11]) == keys[:2]  # partial tail never keyed
+    # A different earlier token changes EVERY later key (hash chain).
+    other = _chain([99] + toks[1:])
+    assert all(a != b for a, b in zip(keys, other))
+    # A different seed (= model identity) is fully disjoint.
+    assert not set(keys) & set(chain_keys(toks, _BT, seed="other"))
+
+
+def test_lookup_longest_resident_prefix_and_counters():
+    pc = PrefixCache(capacity_bytes=_NB * 8, block_tokens=_BT)
+    chain = _chain(list(range(16)))  # 4 blocks
+    created, evicted = pc.admit(chain[:3], "job-a", _NB)
+    assert [h.key for h in created] == list(chain[:3]) and not evicted
+    pc.release(created)
+
+    hit = pc.lookup(chain)
+    assert [h.key for h in hit] == list(chain[:3])
+    assert pc.stats()["hits"] == 3 and pc.stats()["misses"] == 1
+    pc.release(hit)
+
+    # Handles carry the chunk position, so a sub-chain lookup pins
+    # exactly the blocks it names.
+    hit1 = pc.lookup(chain[:1])
+    assert [h.index for h in hit1] == [0]
+    pc.release(hit1)
+
+
+def test_pinned_blocks_never_evicted_lru_order_and_charges():
+    pc = PrefixCache(capacity_bytes=_NB * 2, block_tokens=_BT)
+    c1 = _chain([1, 2, 3, 4])
+    c2 = _chain([5, 6, 7, 8])
+    c3 = _chain([9, 10, 11, 12])
+    h1, _ = pc.admit(c1, "job-a", _NB)
+    h2, _ = pc.admit(c2, "job-b", _NB)
+    assert pc.charges() == {"job-a": _NB, "job-b": _NB}
+
+    # Both resident blocks are pinned: admitting a third cannot evict
+    # them — it degrades to a no-op admit instead of freeing held KV.
+    h3, evicted = pc.admit(c3, "job-c", _NB)
+    assert h3 == [] and evicted == []
+    assert pc.contains(c1[0]) and pc.contains(c2[0])
+
+    # Unpin c1 only: now c1 (LRU, unpinned) is the victim; c2 (still
+    # pinned) survives. The evicted block's charge moves off job-a.
+    pc.release(h1)
+    h3, evicted = pc.admit(c3, "job-c", _NB)
+    assert [h.key for h in h3] == list(c3)
+    assert [e.key for e in evicted] == list(c1)
+    assert not pc.contains(c1[0]) and pc.contains(c2[0])
+    assert pc.charges() == {"job-b": _NB, "job-c": _NB}
+    assert pc.resident_bytes == 2 * _NB
+    pc.release(h2)
+    pc.release(h3)
+
+
+def test_refcount_misuse_raises_typed():
+    pc = PrefixCache(capacity_bytes=_NB * 4, block_tokens=_BT)
+    created, _ = pc.admit(_chain([1, 2, 3, 4]), "j", _NB)
+    pc.release(created)
+    with pytest.raises(ValueError):
+        pc.release(created)  # double release = freed-bytes-in-flight
+    with pytest.raises(ValueError):
+        pc.pin([BlockHandle("no-such-key", 1, 0)])
+    stale = BlockHandle(created[0].key, created[0].block_id + 999, 0)
+    with pytest.raises(ValueError):
+        pc.pin([stale])  # wrong generation: a re-admitted key
+
+
+def test_evict_frees_only_unpinned_and_digests_are_mru():
+    pc = PrefixCache(capacity_bytes=_NB * 8, block_tokens=_BT)
+    ca = _chain(list(range(8)))       # 2 blocks, will stay pinned
+    cb = _chain(list(range(50, 58)))  # 2 blocks, released
+    ha, _ = pc.admit(ca, "j", _NB)
+    hb, _ = pc.admit(cb, "j", _NB)
+    pc.release(hb)
+    out = pc.evict(_NB * 8)
+    assert {e.key for e in out} == set(cb)
+    assert pc.resident_bytes == 2 * _NB
+    assert set(pc.hot_digests(8)) == set(ca)
+    pc.release(ha)
+
+
+def test_concurrent_admit_lookup_evict_is_safe():
+    """Race the full op surface from many threads; the invariants the
+    spec checks (no negative refs, pinned never evicted, charge
+    conservation) must hold under every interleaving."""
+    pc = PrefixCache(capacity_bytes=_NB * 6, block_tokens=_BT)
+    chains = [_chain(list(range(base, base + 12)))
+              for base in (0, 100, 200, 300)]
+    errors = []
+
+    def worker(chain, job):
+        try:
+            for _ in range(25):
+                hit = pc.lookup(chain, job)
+                pc.pin(hit)
+                pc.release(hit)
+                created, _evicted = pc.admit(chain, job, _NB)
+                pc.release(created)
+                pc.release(hit)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    def evictor():
+        try:
+            for _ in range(40):
+                pc.evict(_NB)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(c, f"job-{i}"))
+               for i, c in enumerate(chains)]
+    threads.append(threading.Thread(target=evictor))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    # Quiesced: nothing is pinned, so resident bytes equal the sum of
+    # per-job charges (conservation) and everything is evictable.
+    assert pc.resident_bytes == sum(pc.charges().values())
+    pc.evict(pc.resident_bytes)
+    assert pc.resident_bytes == 0
+    assert pc.charges() == {}

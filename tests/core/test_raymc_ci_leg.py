@@ -68,7 +68,8 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
                             "pipelined_close", "spill_race",
                             "lineage_reconstruction", "actor_restart",
                             "head_crash_recovery", "quota_admission",
-                            "dep_sweep", "replica_direct"}
+                            "dep_sweep", "replica_direct",
+                            "kv_cache_reuse"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"
@@ -100,13 +101,18 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
     # fell out of the scenario and the no-stale-dispatch property is
     # being proven over less than it claims.
     assert by_name["replica_direct"]["executions"] >= 1000, by_name
+    # LLM prefix/KV cache: the lookup-vs-admit-vs-evict space drained
+    # — a shrunk count means the pin-to-read window (or an action)
+    # fell out and the no-stale-hit property is proven over less than
+    # it claims.
+    assert by_name["kv_cache_reuse"]["executions"] >= 500, by_name
     # Conformance mode really ran: each decision-core scenario
     # cross-checked its live core against the rayspec sequential spec
     # at quiescent states (a zero here means the refinement pass
     # silently fell out — the scenario would still 'pass' but prove
     # strictly less).
     for name in ("quota_admission", "dep_sweep", "actor_restart",
-                 "lineage_reconstruction"):
+                 "lineage_reconstruction", "kv_cache_reuse"):
         assert by_name[name]["conformance_checks"] >= \
             by_name[name]["executions"], (
                 name, by_name[name]["conformance_checks"])

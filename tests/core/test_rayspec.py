@@ -28,6 +28,8 @@ from ray_tpu._private import sanitize_hooks  # noqa: E402
 from ray_tpu._private.actor_gate import ActorRestartGate  # noqa: E402
 from ray_tpu._private.config import ray_config  # noqa: E402
 from ray_tpu._private.ids import ActorID, TaskID  # noqa: E402
+from ray_tpu._private.kv_cache import (PrefixCache,  # noqa: E402
+                                       chain_keys)
 from ray_tpu._private.memory_store import MemoryStore  # noqa: E402
 from ray_tpu._private.sched_state import (DepTable,  # noqa: E402
                                           ShardedTable)
@@ -383,6 +385,33 @@ def _drive_exactly_once_call(rec):
     return head
 
 
+def _drive_kv_cache(rec):
+    """Concurrent lookup/pin/release racing admit and pressure evict
+    on a capacity so tight every admission must evict — the
+    pinned-never-evicted and charge-conservation laws under exactly
+    the contention the LLM engine's prefill path produces."""
+    cache = PrefixCache(capacity_bytes=300, block_tokens=4)
+    chains = {j: chain_keys([b + 100 * i for b in range(8)], 4, "m")
+              for i, j in enumerate(("a", "b", "c"))}
+
+    def churn(job):
+        for _ in range(4):
+            created, _ev = cache.admit(chains[job], job, 100)
+            hit = cache.lookup(chains[job], job)
+            if hit:
+                cache.pin(hit)
+                cache.release(hit)
+            cache.release(hit)
+            cache.release(created)
+            cache.evict(100)
+
+    ts = [threading.Thread(target=churn, args=(j,))
+          for j in ("a", "b", "c")]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    return cache
+
+
 CORE_DRIVES = {
     "quota_ledger": _drive_quota_ledger,
     "dep_table": _drive_dep_table,
@@ -390,6 +419,7 @@ CORE_DRIVES = {
     "sharded_table": _drive_sharded_table,
     "fair_task_queue": _drive_fair_task_queue,
     "exactly_once_call": _drive_exactly_once_call,
+    "kv_cache": _drive_kv_cache,
 }
 
 

@@ -1,4 +1,8 @@
-"""LLM engine tests: KV-cache correctness + continuous batching."""
+"""LLM engine tests: KV-cache correctness + continuous batching +
+prefix-cache reuse + admission behavior under slot pressure."""
+
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -6,6 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from ray_tpu._private.config import ray_config
 from ray_tpu.models.llama import (
     LlamaConfig,
     forward,
@@ -13,7 +18,11 @@ from ray_tpu.models.llama import (
     init_kv_cache,
     init_params,
 )
-from ray_tpu.serve.llm import LLMEngine, SamplingParams
+from ray_tpu.serve.llm import (
+    LLMEngine,
+    PromptTooLongError,
+    SamplingParams,
+)
 
 # Multi-process / soak tests: excluded from the quick
 # tier (pytest -m 'not slow').
@@ -114,3 +123,121 @@ def test_engine_streaming_and_metrics(model):
     m = engine.metrics()
     assert m["active_slots"] == 0 and m["free_slots"] == 2
     engine.stop()
+
+
+# -- PR 16: prefix/KV cache + admission behavior -------------------------
+
+
+def test_prompt_longer_than_cap_rejected_typed(model):
+    """The old behavior silently truncated the prompt HEAD (corrupting
+    answers); now an over-cap prompt fails loudly with a typed error
+    before any slot/queue resource is touched."""
+    cfg, params = model
+    engine = LLMEngine(cfg, params, max_batch_size=2, max_seq_len=16)
+    with pytest.raises(PromptTooLongError) as ei:
+        engine.generate(list(range(1, 30)), SamplingParams(max_tokens=2))
+    assert ei.value.n_tokens == 29 and ei.value.cap == 15
+    m = engine.metrics()
+    assert m["queued"] == 0 and m["active_slots"] == 0
+    engine.stop()
+
+
+def test_slot_exhaustion_parks_then_admits(model):
+    """More concurrent requests than slots: the overflow request parks
+    in the queue (never dropped, never doubly assigned) and admits as
+    soon as a retirement frees a slot — continuous batching's core
+    contract."""
+    cfg, params = model
+    engine = LLMEngine(cfg, params, max_batch_size=2, max_seq_len=64)
+    prompts = [[1, 2], [3, 4], [5, 6], [7, 8], [9, 10]]
+    expected = [naive_greedy(cfg, params, p, 4) for p in prompts]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = engine.generate(prompts[i],
+                                     SamplingParams(max_tokens=4))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    # With 2 slots and 5 requests, at least one must park mid-flight.
+    deadline = time.monotonic() + 30
+    saw_queued = False
+    while time.monotonic() < deadline and not saw_queued:
+        if engine.metrics()["queued"] > 0:
+            saw_queued = True
+        time.sleep(0.001)
+    for t in threads:
+        t.join(timeout=120)
+    engine.stop()
+    assert saw_queued, "5 requests over 2 slots never queued"
+    for got, exp in zip(results, expected):
+        assert got == exp
+
+
+def test_retired_slot_reuse_never_leaks_prior_tokens(model):
+    """A slot retired by request A and re-admitted for request B must
+    produce exactly B's tokens: stale KV from A beyond B's length can
+    never be attended (positions are overwritten before any query
+    reaches them). Run a LONG request then a SHORT one through a
+    1-slot engine — same slot, different lengths — and cross-check
+    the short one against ground truth."""
+    cfg, params = model
+    engine = LLMEngine(cfg, params, max_batch_size=1, max_seq_len=64)
+    long_prompt = list(range(1, 25))
+    short_prompt = [42, 7]
+    exp_long = naive_greedy(cfg, params, long_prompt, 6)
+    exp_short = naive_greedy(cfg, params, short_prompt, 6)
+    assert engine.generate(long_prompt,
+                           SamplingParams(max_tokens=6)) == exp_long
+    assert engine.generate(short_prompt,
+                           SamplingParams(max_tokens=6)) == exp_short
+    engine.stop()
+
+
+def test_prefix_cache_greedy_identical_and_hits(model, monkeypatch):
+    """The tentpole's correctness bar: greedy output is TOKEN-IDENTICAL
+    with the prefix cache on vs off (copied-in KV blocks are
+    byte-equivalent to recomputed prefill), and the shared-head
+    workload actually HITS the cache (the perf claim isn't vacuous)."""
+    cfg, params = model
+    monkeypatch.setattr(ray_config, "llm_kv_block_tokens", 4)
+    monkeypatch.setattr(ray_config, "llm_prefix_shm_tier", False)
+    shared = list(range(1, 18))  # 17 tokens = 4 full blocks + tail
+    prompts = [shared + [50 + i] for i in range(4)]
+
+    def run(cache_on):
+        monkeypatch.setattr(ray_config, "llm_prefix_cache", cache_on)
+        engine = LLMEngine(cfg, params, max_batch_size=2,
+                           max_seq_len=64, model="m")
+        outs = [engine.generate(p, SamplingParams(max_tokens=6))
+                for p in prompts]
+        stats = engine.prefix_cache.stats() if engine.prefix_cache \
+            else None
+        engine.stop()
+        return outs, stats
+
+    off, off_stats = run(False)
+    on, on_stats = run(True)
+    assert off_stats is None
+    assert on == off, "prefix cache changed greedy output"
+    assert on_stats["hits"] >= 3 * 4, on_stats  # 4 shared blocks x 3 reqs
+    assert on_stats["blocks"] > 0 and on_stats["bytes"] > 0
+
+
+def test_multi_model_chain_seeds_never_cross_hit(model):
+    """Two models on one replica must never share prefix-cache keys:
+    the chain seed commits to the model identity, so identical prompts
+    under different models produce disjoint chains."""
+    from ray_tpu._private.kv_cache import chain_keys
+
+    cfg, params = model
+    engine_a = LLMEngine(cfg, params, max_batch_size=1, model="a")
+    engine_b = LLMEngine(cfg, params, max_batch_size=1, model="b")
+    toks = list(range(32))
+    ka = chain_keys(toks, 16, engine_a._chain_seed)
+    kb = chain_keys(toks, 16, engine_b._chain_seed)
+    assert ka and kb and not (set(ka) & set(kb))
+    engine_a.stop()
+    engine_b.stop()

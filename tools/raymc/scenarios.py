@@ -1590,6 +1590,144 @@ class DepSweepScenario(Scenario):
         pass
 
 
+class KvCacheReuseScenario(Scenario):
+    name = "kv_cache_reuse"
+    description = ("LLM prefix/KV cache: a lookup hit racing block "
+                   "admission and pressure eviction — a hit never "
+                   "yields stale/freed KV bytes (pinned blocks are "
+                   "never evicted), per-tenant charge is conserved, "
+                   "and resident bytes stay under capacity")
+    # Release is deliberately NOT gated (replica_direct's shape): the
+    # race that matters is admit/evict landing between a lookup's pin
+    # and the payload read — pinned by mc.sync.kv.read below.
+    points = ("llm.kv.lookup", "llm.kv.admit", "llm.kv.evict",
+              "mc.sync.kv.read")
+    max_steps = 24
+    # Three actions, 1-2 gated crossings each: the exhaustive sweep is
+    # small; the floor leaves headroom so `exhausted` stays honest.
+    max_schedules = 6000
+    block_grace_s = 0.02
+
+    # The REAL PrefixCache (the LLM engine's prefix-reuse decision
+    # core) under a condensed model of the wiring: the reader is a
+    # prefill hitting the shared prompt head and copying matched KV
+    # payloads into its slot, the writer is another request admitting
+    # a different prompt's blocks (capacity forces LRU eviction), the
+    # evictor is arena-pressure reclaim. ``payloads`` stands in for
+    # the host-side KV byte store: an evicted block's payload is
+    # freed, so a hit observing a missing payload IS the
+    # read-after-free the pinning protocol must make impossible.
+
+    def setup(self) -> None:
+        from ray_tpu._private.kv_cache import PrefixCache, chain_keys
+
+        self.cache = PrefixCache(capacity_bytes=250, block_tokens=4)
+        self.chain_p = chain_keys(list(range(8)), 4, "m")
+        self.chain_q = chain_keys(list(range(100, 108)), 4, "m")
+        self._wlock = threading.Lock()
+        self.payloads: dict = {}
+        self.stale: List[str] = []
+        created, _ev = self.cache.admit(self.chain_p, "a", 100)
+        assert len(created) == 2
+        for h in created:
+            self.payloads[h.block_id] = b"P"
+        self.cache.release(created)
+
+    def actions(self):
+        def reader():
+            hit = self.cache.lookup(self.chain_p, "a")
+            # The pin-to-read window: admit/evict may be granted here.
+            sanitize_hooks.sched_point("mc.sync.kv.read")
+            with self._wlock:
+                for h in hit:
+                    if self.payloads.get(h.block_id) is None:
+                        self.stale.append(h.key)
+            self.cache.release(hit)
+
+        def writer():
+            created, evicted = self.cache.admit(self.chain_q, "b", 100)
+            with self._wlock:
+                for e in evicted:
+                    self.payloads.pop(e.block_id, None)  # the free
+                for h in created:
+                    self.payloads[h.block_id] = b"Q"
+            self.cache.release(created)
+
+        def evictor():
+            for e in self.cache.evict(100):
+                with self._wlock:
+                    self.payloads.pop(e.block_id, None)
+
+        return [("reader", reader), ("writer", writer),
+                ("evictor", evictor)]
+
+    def invariants(self):
+        def no_stale_hit(s):
+            with s._wlock:
+                stale = list(s.stale)
+            if stale:
+                return (f"lookup hit observed freed KV bytes for "
+                        f"blocks {stale} — evicted while pinned")
+            return True
+
+        def charge_conserved(s):
+            with s.cache._lock:
+                derived: dict = {}
+                total = 0
+                for b in s.cache._blocks.values():
+                    derived[b.job] = derived.get(b.job, 0) + b.nbytes
+                    total += b.nbytes
+                charge = dict(s.cache._charge)
+                resident = s.cache._bytes
+            if charge != derived:
+                return (f"per-tenant charge {charge} != resident "
+                        f"blocks' bytes {derived}")
+            if resident != total:
+                return f"byte counter {resident} != blocks {total}"
+            if resident > s.cache.capacity_bytes:
+                return (f"resident {resident} bytes over capacity "
+                        f"{s.cache.capacity_bytes}")
+            return True
+
+        def refs_sane(s):
+            with s.cache._lock:
+                bad = {b.key: b.refs for b in s.cache._blocks.values()
+                       if b.refs < 0}
+            if bad:
+                return f"negative refcounts: {bad}"
+            return True
+
+        return [
+            Invariant("kv-no-stale-hit", no_stale_hit,
+                      description="a prefix hit never reads bytes an "
+                                  "eviction already freed"),
+            Invariant("kv-charge-conserved", charge_conserved,
+                      description="tenant charge == resident bytes per "
+                                  "job; total within capacity"),
+            Invariant("kv-refs-nonnegative", refs_sane,
+                      description="block refcounts never go negative"),
+        ]
+
+    def liveness(self):
+        def pins_drain(s):
+            with s.cache._lock:
+                return all(b.refs == 0
+                           for b in s.cache._blocks.values())
+
+        return [Liveness("kv-pins-drain", pins_drain, timeout_s=2.0,
+                         description="every lookup/admit pin is "
+                                     "released by quiescence")]
+
+    def conformance(self):
+        # rayspec refinement: the live block table + charge map must
+        # match a linearization of the lookup/admit/release/evict
+        # history at every quiescent state.
+        return [("kv_cache", lambda: self.cache)]
+
+    def teardown(self) -> None:
+        pass
+
+
 # -- head hard-crash: durability + node re-registration convergence ----------
 
 
@@ -1793,7 +1931,7 @@ SCENARIOS = {
                 SpillRaceScenario, LineageReconstructionScenario,
                 ActorRestartScenario, HeadCrashRecoveryScenario,
                 QuotaAdmissionScenario, DepSweepScenario,
-                ReplicaDirectScenario)
+                ReplicaDirectScenario, KvCacheReuseScenario)
 }
 
 # The bounded tier-1 leg: real code, small configs, exhaustive where
@@ -1804,8 +1942,8 @@ SCENARIOS = {
 # (and its background threads, which every quiescence settle must
 # scan) up for the rest of the leg (run order matters — cheap
 # scenarios first).
-DEFAULT_SCENARIOS = ("dep_sweep", "quota_admission", "replica_direct",
-                     "router_cap",
+DEFAULT_SCENARIOS = ("dep_sweep", "kv_cache_reuse", "quota_admission",
+                     "replica_direct", "router_cap",
                      "gcs_durability", "pipelined_close", "spill_race",
                      "lineage_reconstruction", "actor_restart",
                      "head_crash_recovery")

@@ -21,7 +21,8 @@ import sys
 import time
 
 DEFAULT_PATHS = ("tests/core/test_fault_semantics.py",
-                 "tests/core/test_sched_scale.py")
+                 "tests/core/test_sched_scale.py",
+                 "tests/core/test_kv_cache.py")
 
 # Run-to-run volatile report fields (timings, id-/timing-dependent
 # counters): normalized out of the committed artifact.

@@ -700,6 +700,138 @@ class ExactlyOnceCallSpec(Spec):
         raise NotImplementedError
 
 
+# -- PrefixCache (LLM KV cache) ----------------------------------------------
+
+
+class KvCacheSpec(Spec):
+    """Safety law of the LLM prefix/KV cache: a pinned (refs>0) block
+    is never evicted, refcounts never go negative (a release or pin
+    without a matching hold is ILLEGAL), admission only creates blocks
+    that are absent and only evicts unpinned ones, resident bytes stay
+    under the bound capacity, and the per-tenant charge map equals the
+    bytes of each job's resident blocks (conservation — checked by
+    refinement: ``observe`` reads the live charge map separately from
+    the block table, so drift diverges)."""
+
+    name = "kv_cache"
+    description = "prefix/KV block pinning, LRU eviction, tenant charge"
+    product = "ray_tpu._private.kv_cache.PrefixCache"
+    prefix = "spec.kv."
+    ops = ("lookup", "pin", "release", "admit", "evict")
+
+    def __init__(self):
+        self._capacity = None  # bound from the live core
+
+    def init_state(self):
+        return {"blocks": {}}  # key -> (job, nbytes, refs)
+
+    def _bytes(self, blocks: dict) -> int:
+        return sum(nb for _job, nb, _refs in blocks.values())
+
+    def apply(self, state, op, args):
+        blocks = state["blocks"]
+        if op == "lookup":
+            chain, = args
+            new_blocks = dict(blocks)
+            matched = 0
+            for key in chain:
+                entry = new_blocks.get(key)
+                if entry is None:
+                    break
+                job, nb, refs = entry
+                new_blocks[key] = (job, nb, refs + 1)
+                matched += 1
+            return [({"blocks": new_blocks}, matched)]
+        if op == "pin":
+            keys, = args
+            new_blocks = dict(blocks)
+            for key in keys:
+                entry = new_blocks.get(key)
+                if entry is None or entry[2] < 1:
+                    return []  # pin of a block the caller cannot hold
+                new_blocks[key] = (entry[0], entry[1], entry[2] + 1)
+            return [({"blocks": new_blocks}, None)]
+        if op == "release":
+            keys, = args
+            new_blocks = dict(blocks)
+            for key in keys:
+                entry = new_blocks.get(key)
+                if entry is None or entry[2] < 1:
+                    return []  # release past zero: double-release bug
+                new_blocks[key] = (entry[0], entry[1], entry[2] - 1)
+            return [({"blocks": new_blocks}, None)]
+        if op == "admit":
+            chain, job, nbytes, created, evicted = args
+            new_blocks = dict(blocks)
+            for key in evicted:
+                entry = new_blocks.get(key)
+                if entry is None or entry[2] != 0:
+                    return []  # evicted a pinned (or absent) block
+                del new_blocks[key]
+            for key in created:
+                if key in new_blocks or key not in chain:
+                    return []  # created a duplicate / unasked block
+                new_blocks[key] = (job, nbytes, 1)
+            if self._capacity is not None \
+                    and self._bytes(new_blocks) > self._capacity:
+                return []  # admitted past the capacity bound
+            return [({"blocks": new_blocks}, None)]
+        if op == "evict":
+            _nbytes, evicted = args
+            new_blocks = dict(blocks)
+            for key in evicted:
+                entry = new_blocks.get(key)
+                if entry is None or entry[2] != 0:
+                    return []  # evicted a pinned (or absent) block
+                del new_blocks[key]
+            return [({"blocks": new_blocks}, None)]
+        return []
+
+    def adapt_payloads(self, op, call, ret, tokens):
+        # admit/evict: the created/evicted key sets ride the RESULT
+        # payload into args (the DepTable sweep pattern) so ``apply``
+        # validates their legality deterministically.
+        if op == "lookup":
+            chain, = call
+            return ((tuple(_tok(tokens, k) for k in chain),), ret)
+        if op in ("pin", "release"):
+            keys, = call
+            return ((tuple(_tok(tokens, k) for k in keys),), None)
+        if op == "admit":
+            chain, job, nbytes = call
+            created, evicted = ((), ()) if ret is None else ret
+            args = (tuple(_tok(tokens, k) for k in chain), job, nbytes,
+                    tuple(_tok(tokens, k) for k in created),
+                    tuple(_tok(tokens, k) for k in evicted))
+            return args, None
+        if op == "evict":
+            nbytes, = call
+            evicted = () if ret is None else ret[0]
+            return ((nbytes,
+                     tuple(_tok(tokens, k) for k in evicted)), None)
+        return call, ret
+
+    def bind(self, core) -> None:
+        self._capacity = core.capacity_bytes
+
+    def params_key(self):
+        return self._capacity
+
+    def observable(self, state):
+        blocks = state["blocks"]
+        charge: Dict[str, int] = {}
+        for job, nb, _refs in blocks.values():
+            charge[job] = charge.get(job, 0) + nb
+        return (_freeze(blocks), _freeze(charge))
+
+    def observe(self, core, tokens):
+        with core._lock:
+            blocks = {_peek(tokens, k): (b.job, b.nbytes, b.refs)
+                      for k, b in core._blocks.items()}
+            charge = dict(core._charge)
+        return (_freeze(blocks), _freeze(charge))
+
+
 # -- the registry ------------------------------------------------------------
 
 
@@ -729,6 +861,7 @@ SPEC_CATALOG: Dict[str, CatalogEntry] = {
         _entry(ShardedTableSpec),
         _entry(FairTaskQueueSpec),
         _entry(ExactlyOnceCallSpec),
+        _entry(KvCacheSpec),
     )
 }
 
