@@ -698,6 +698,86 @@ def ab_serve_keepalive(repeats: int = 4, attempts: int = 3,
     return result
 
 
+def ab_serve_stage_spans(repeats: int = 8, attempts: int = 4,
+                         n_requests: int = 800) -> dict:
+    """Critical-path recorder A/B (PR 18): the serve keep-alive path
+    with stage spans + flight rings recording at every hop vs. both
+    engines disabled. Unlike the lag-sampler leg — whose
+    instrumentation installs at proxy start, forcing a fresh setup per
+    side — the recorder flips live, so both sides share ONE setup and
+    the timed batches interleave on/off with order flipping. That
+    matters: a long-lived serve process speeds up over its first
+    minutes (allocator state, heap shape, branch history), and with
+    per-side setups that drift systematically taxes whichever side
+    ran earlier; interleaved on one setup, both sides sample the same
+    drift envelope and best-of-R converges to plateau throughput."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import critical_path, flight_recorder
+    from ray_tpu._private.config import ray_config
+
+    def set_on(recording: bool) -> None:
+        ray_config.stage_spans_enabled = recording
+        critical_path.set_enabled(recording)
+        flight_recorder.set_enabled(recording)
+
+    prev = ray_config.stage_spans_enabled
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @serve.deployment(max_concurrent_queries=8)
+        class Noop:
+            def __call__(self, payload):
+                return {"ok": True}
+
+        serve.run(Noop.bind(), route_prefix="/noop")
+        proxy = serve.start_http_proxy()
+        # Warm with the recorder ON: route resolution, replica loop,
+        # the folder thread, and the JIT-warm paths all exist before
+        # the first timed batch.
+        set_on(True)
+        _measure_keepalive_rps(proxy.port, 2000, job_header=False)
+
+        result = None
+        for attempt in range(attempts):
+            sides = {True: 0.0, False: 0.0}
+            for i in range(repeats):
+                order = (True, False) if (attempt + i) % 2 == 0 \
+                    else (False, True)
+                for recording in order:
+                    set_on(recording)
+                    sides[recording] = max(
+                        sides[recording],
+                        _measure_keepalive_rps(
+                            proxy.port, n_requests, job_header=False))
+            overhead = 1.0 - sides[True] / sides[False]
+            ok = overhead < OBS_OVERHEAD_BUDGET
+            result = {
+                "budget": OBS_OVERHEAD_BUDGET,
+                "repeats": repeats,
+                "attempt": attempt + 1,
+                "keepalive_rps_recording": round(sides[True], 1),
+                "keepalive_rps_baseline": round(sides[False], 1),
+                "stage_span_overhead": round(overhead, 4),
+                "pass": ok,
+            }
+            if ok:
+                return result
+        return result
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        ray_config.stage_spans_enabled = prev
+        critical_path.set_enabled(True)
+        flight_recorder.set_enabled(True)
+        critical_path.reset()
+        flight_recorder.reset()
+
+
 def ab_observability_cluster(repeats: int = 3) -> dict:
     """Cluster leg: driver submit rate into a lease-batched node WITH
     the shipping plane running vs. with it disabled — proves shipping
@@ -1047,6 +1127,7 @@ def main() -> dict:
         ab = ab_observability()
         job_ab = ab_job_tagging()
         serve_ab = ab_serve_keepalive()
+        stage_ab = ab_serve_stage_spans()
         cluster_ab = {} if args.skip_cluster \
             else ab_observability_cluster()
         envelope = {
@@ -1056,6 +1137,7 @@ def main() -> dict:
             "host_calibration": cal,
             "metrics": {"local": ab, "job_tagging": job_ab,
                         "serve_keepalive": serve_ab,
+                        "stage_spans": stage_ab,
                         "cluster": cluster_ab},
         }
         print(json.dumps(envelope, indent=2))
@@ -1063,7 +1145,8 @@ def main() -> dict:
             with open(args.out, "w") as f:
                 json.dump(envelope, f, indent=2)
         for leg_name, leg in (("local", ab), ("job_tagging", job_ab),
-                              ("serve_keepalive", serve_ab)):
+                              ("serve_keepalive", serve_ab),
+                              ("stage_spans", stage_ab)):
             if not leg["pass"]:
                 sys.exit("observability overhead guard FAILED "
                          f"({leg_name}): {leg}")

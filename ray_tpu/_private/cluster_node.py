@@ -124,6 +124,7 @@ class NodeRuntime:
             "commit_bundle": self._commit_bundle,
             "return_bundle": self._return_bundle,
             "ping": self._ping,
+            "flight_snapshot": self._flight_snapshot,
             "shutdown": self._shutdown,
         }, host="0.0.0.0",
            dedupe_methods=frozenset({"submit_task", "submit_batch",
@@ -840,6 +841,14 @@ class NodeRuntime:
             "total": self.worker.backend.resources.total,
             "labels": self.labels,
         }
+
+    def _flight_snapshot(self):
+        """Freeze this node's flight-recorder rings (recent stage
+        spans + health samples + slow in-flight waterfalls) for the
+        head's correlated FLIGHT_<ts>.json post-mortem dump."""
+        from ray_tpu._private import flight_recorder
+
+        return flight_recorder.local_snapshot()
 
     def _shutdown(self):
         self._shutdown_event.set()

@@ -172,6 +172,25 @@ class RayTpuConfig:
     # log-free.
     serve_access_log: bool = False
 
+    # -- critical path / flight recorder (_private/critical_path.py,
+    #    _private/flight_recorder.py) ------------------------------------
+    # Stage-span recording at every request hop (the per-route
+    # attribution vectors behind ray_tpu_request_stage_seconds and
+    # /api/slow_requests). The --ab-observability bench flips this to
+    # prove the tax on the serve keep-alive path stays under budget.
+    stage_spans_enabled: bool = True
+    # Where degradation-triggered FLIGHT_<ts>.json snapshots land.
+    # Empty (the default) disables the auto-dump entirely — only an
+    # explicit /api/debug/dump?write=1 or CLI request writes files.
+    flight_recorder_dir: str = ""
+    # Debounce: at most one auto-dump per this many seconds, so a
+    # flapping verdict costs one snapshot per window, not one per
+    # healthz poll.
+    flight_min_interval_s: float = 60.0
+    # Ring entries (spans / health samples) each process contributes
+    # to a frozen snapshot.
+    flight_ring_size: int = 512
+
     # -- serve data plane (proxy fleet + replica-direct dispatch) --------
     # Replica-direct dispatch: the HTTP proxy's steady-state fast path
     # dispatches proxy→replica over the long-poll-fed membership table

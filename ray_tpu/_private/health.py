@@ -342,6 +342,7 @@ def collect_health_metrics() -> None:
     # node's snapshot carries them to the head, which is where the
     # per-node healthz verdict reads them back out.
     w = worker_mod.global_worker_or_none()
+    depths = None
     if w is not None:
         try:
             depths = w.backend.queue_depths()
@@ -357,6 +358,18 @@ def collect_health_metrics() -> None:
             _gauge("ray_tpu_sched_waiting_for_deps",
                    "Tasks parked on unresolved dependencies").set(
                 float(depths.get("waiting_for_deps", 0)))
+    # Flight-recorder sample ring: the same signals, kept as bounded
+    # history per process so a degradation-triggered dump can show the
+    # minutes BEFORE the verdict flipped, not just the instant of it.
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.note_sample("health", {
+        "memory_pressure": current_pressure(),
+        "queue_depths": depths or {},
+        "loop_lag": recent_loop_lag(),
+        "slo_burn": {r: ws.get("short", 0.0)
+                     for r, ws in tracker.burn_rates().items()},
+    })
 
 
 # -- verdicts ----------------------------------------------------------------
@@ -477,7 +490,14 @@ def evaluate_health(worker=None) -> Dict[str, Any]:
     for node_id, verdict in nodes.items():
         reasons.extend(f"node {node_id[:8]}: {r}"
                        for r in verdict["reasons"])
-    return {"status": "degraded" if reasons else "ok",
-            "reasons": reasons,
-            "head": local,
-            "nodes": nodes}
+    out = {"status": "degraded" if reasons else "ok",
+           "reasons": reasons,
+           "head": local,
+           "nodes": nodes}
+    # Flight recorder: the ok→degraded edge freezes every live node's
+    # rings into one correlated FLIGHT_<ts>.json (no-op unless
+    # flight_recorder_dir is configured; debounced inside).
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.observe_verdict(out, worker=w)
+    return out

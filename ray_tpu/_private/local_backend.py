@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 from time import monotonic as _monotonic
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import critical_path as _critical_path
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private import sched_state, tenancy
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
@@ -34,6 +35,7 @@ from ray_tpu._private.task_spec import (
     TaskKind,
     TaskSpec,
 )
+from ray_tpu._private.task_spec import trace_id_of as _trace_id_of
 
 logger = logging.getLogger(__name__)
 
@@ -785,6 +787,10 @@ class LocalBackend:
         submitted = getattr(spec, "_submit_monotonic", None)
         if submitted is not None:
             _SCHED_LATENCY.record(_monotonic() - submitted)
+            if _critical_path.enabled():
+                _critical_path.record_stage(
+                    _trace_id_of(spec), "sched.queue",
+                    _monotonic() - submitted)
         try:
             from ray_tpu._private.runtime_env import applied_runtime_env
 
@@ -822,6 +828,10 @@ class LocalBackend:
             # For actor tasks this is mailbox queue delay — the actor-
             # path backpressure signal.
             _SCHED_LATENCY.record(_monotonic() - submitted)
+            if _critical_path.enabled():
+                _critical_path.record_stage(
+                    _trace_id_of(spec), "sched.queue",
+                    _monotonic() - submitted)
         try:
             args, kwargs = self.worker.resolve_args(spec)
             if actor._proc is not None:

@@ -66,7 +66,19 @@ class ObsAggregator:
     # -- RPC handler -----------------------------------------------------
 
     def report(self, node_id: str, events: Optional[list] = None,
-               metrics: Optional[dict] = None) -> bool:
+               metrics: Optional[dict] = None,
+               stages: Optional[list] = None) -> bool:
+        # Stage spans fold head-side: the critical-path engine on the
+        # head is where per-route attribution vectors live, and node-
+        # born stages (replica execute, LLM engine, object plane) must
+        # reach the same accumulator the proxy's finish_request closes.
+        if stages:
+            try:
+                from ray_tpu._private import critical_path
+
+                critical_path.ingest(stages)
+            except Exception:
+                pass  # malformed frame must not poison event merging
         evs = []
         for d in events or []:
             try:
@@ -146,6 +158,12 @@ class NodeObsShipper:
     def start(self) -> "NodeObsShipper":
         if self._period <= 0:
             return self  # shipping disabled by config
+        # This process's stage records now have a drain: tell the
+        # critical-path recorder to queue them (the head never sets
+        # this — it folds its own records in place).
+        from ray_tpu._private import critical_path
+
+        critical_path.set_shipping(True)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="obs-shipper")
         self._thread.start()
@@ -164,18 +182,26 @@ class NodeObsShipper:
             metrics_cycle = final or self._cycle % self._metrics_every == 0
             events = self.worker.task_events.drain_updates(
                 self._max_events)
-            if not events and not metrics_cycle:
+            # Critical-path stage records ride the same frame (bounded
+            # drain; an idle node with no stages pays nothing extra).
+            from ray_tpu._private import critical_path
+
+            stages = critical_path.drain_records(self._max_events)
+            if not events and not stages and not metrics_cycle:
                 return False  # idle between metric beats: no RPC
             metrics = self._snapshot_metrics() if metrics_cycle else None
             try:
                 self._client.call("obs_report", node_id=self.node_id,
-                                  events=events, metrics=metrics)
+                                  events=events, metrics=metrics,
+                                  stages=stages or None)
             except Exception:
                 # Head unreachable / mid-restart: put the drained ids
                 # back on the cursor so these events ship next cycle
                 # instead of silently vanishing from the cluster view.
                 self.worker.task_events.remark_dirty(
                     [d["task_id"] for d in events])
+                if stages:
+                    critical_path.requeue_records(stages)
                 return False
             self._stat_shipped.inc(len(events))
             self._stat_cycles.inc()

@@ -88,6 +88,12 @@ def _set_multi_series(name: str, desc: str, tag_keys: Tuple[str, ...],
     _prev_tags[name] = current
 
 
+# Dists whose p99 is a first-class dashboard series: the critical-path
+# attribution vectors are p50/p99 by contract, and the serve dashboard
+# already charts TTFT p99.
+_P99_DISTS = frozenset({"request_stage_seconds", "serve_ttft_seconds"})
+
+
 def _collect_fastpath_stats() -> None:
     """Fold the lock-free fast-path stats (`_private/perf_stats.py` —
     batcher queue delay/flush size, submit→start latency, intern hit
@@ -112,6 +118,13 @@ def _collect_fastpath_stats() -> None:
                tag_keys=tag_keys).set(stat.quantile(0.5), tags=tag_dict)
         _gauge(f"{base}_p95", f"fast-path {name} p95",
                tag_keys=tag_keys).set(stat.quantile(0.95), tags=tag_dict)
+        if name in _P99_DISTS:
+            # Tail-attribution series (the dashboards chart p99 for
+            # these); kept opt-in by name so every dist doesn't grow a
+            # third quantile gauge.
+            _gauge(f"{base}_p99", f"fast-path {name} p99",
+                   tag_keys=tag_keys).set(stat.quantile(0.99),
+                                          tags=tag_dict)
         _gauge(f"{base}_count", f"fast-path {name} observations",
                tag_keys=tag_keys).set(float(stat.total), tags=tag_dict)
         _gauge(f"{base}_sum", f"fast-path {name} sum",

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import critical_path
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import sched_state
@@ -150,8 +151,14 @@ def pull_via_transfer(worker, plane, oid, host: str, port: int) -> bool:
         if rc not in (0, -5):
             return False
         if rc == 0:
-            _PULL_SECONDS.record(time.monotonic() - t1)
+            pull_s = time.monotonic() - t1
+            _PULL_SECONDS.record(pull_s)
             _PULL_BYTES.inc(plane.store.object_size(oid.binary()) or 0)
+            # Critical-path stage: a pull inside a traced task charges
+            # the request; outside one it still reaches the flight ring.
+            if critical_path.enabled():
+                critical_path.record_stage(
+                    critical_path.ambient_trace_id(), "object.pull", pull_s)
         return try_shm_fetch(worker, oid)
     except Exception:
         return False
@@ -1386,8 +1393,10 @@ class ClusterHead:
         self.worker.gcs.remove_named_actor_by_id(ActorID(actor_id))
         return True
 
-    def _obs_report(self, node_id: str, events=None, metrics=None):
-        return self.obs.report(node_id, events=events, metrics=metrics)
+    def _obs_report(self, node_id: str, events=None, metrics=None,
+                    stages=None):
+        return self.obs.report(node_id, events=events, metrics=metrics,
+                               stages=stages)
 
     @staticmethod
     def _gcs_events(limit: int = 200, source=None):

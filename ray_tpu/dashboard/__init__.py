@@ -161,6 +161,31 @@ class DashboardServer:
 
             return (json.dumps(evaluate_health(), default=str).encode(),
                     "application/json")
+        if path == "/api/slow_requests":
+            # Critical-path attribution: top-N slowest finished request
+            # waterfalls (dominant stage named per request), per-route
+            # p50/p99 stage-attribution vectors, and the exemplar
+            # trace-ids pinned to the slowest histogram buckets.
+            from ray_tpu._private import critical_path
+
+            return (json.dumps({
+                "slow_requests": critical_path.slow_requests(),
+                "attribution": critical_path.attribution_vectors(),
+                "exemplars": critical_path.exemplars(),
+            }, default=str).encode(), "application/json")
+        if path == "/api/debug/dump":
+            # On-demand flight dump: every live node ships its bounded
+            # span/sample rings to the head; the correlated payload is
+            # returned inline and — when flight_recorder_dir is set —
+            # also written as FLIGHT_<ts>.json (the "path" key).
+            from ray_tpu._private import flight_recorder, health
+            from ray_tpu._private.worker import global_worker_or_none
+
+            w = global_worker_or_none()
+            payload = flight_recorder.dump(
+                "api", worker=w, verdict=health.evaluate_health(w))
+            return (json.dumps(payload, default=str).encode(),
+                    "application/json")
         if path == "/ui":
             return _UI_HTML.encode(), "text/html"
         if path == "/api/jobs" or path.startswith("/api/jobs/"):
@@ -183,6 +208,8 @@ class DashboardServer:
                                         "/api/traces", "/api/timeline",
                                         "/api/logs", "/api/events",
                                         "/api/healthz",
+                                        "/api/slow_requests",
+                                        "/api/debug/dump",
                                         "/api/job_summary"]},
             "/api/nodes": state.list_nodes,
             "/api/tasks": state.list_tasks,

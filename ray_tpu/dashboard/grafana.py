@@ -130,6 +130,24 @@ def generate_serve_dashboard() -> dict:
                     "swaps (5m)"),
                    ("increase(ray_tpu_serve_affinity_routed[5m])",
                     "affinity-routed {{placed}} (5m)")]},
+        # -- Request anatomy row (PR 18): the critical-path engine's
+        # per-(route, stage) attribution vectors. The p99 panel is the
+        # jump-off to /api/slow_requests, whose exemplar trace-ids name
+        # the trace behind each slow bucket.
+        {"title": "Request anatomy p50 (stacked by stage)", "unit": "s",
+         "exprs": [('sum(ray_tpu_request_stage_seconds_p50) '
+                    'by (route, stage)', "{{route}} {{stage}}")]},
+        {"title": "Request anatomy p99 (exemplars: /api/slow_requests)",
+         "unit": "s",
+         "exprs": [('sum(ray_tpu_request_stage_seconds_p99) '
+                    'by (route, stage)', "{{route}} {{stage}}")]},
+        {"title": "Affinity hit rate",
+         "exprs": [("rate(ray_tpu_serve_affinity_hits_total[1m]) / "
+                    "(rate(ray_tpu_serve_affinity_hits_total[1m]) + "
+                    "rate(ray_tpu_serve_affinity_misses_total[1m]))",
+                    "hit rate"),
+                   ("rate(ray_tpu_serve_affinity_misses_total[1m])",
+                    "misses/s")]},
     ], uid="ray-tpu-serve")
 
 

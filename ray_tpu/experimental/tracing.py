@@ -61,7 +61,45 @@ def export_spans(worker=None) -> List[Dict[str, Any]]:
                 "ray_tpu.state": ev.state,
             },
         })
+    spans.extend(_stage_spans({s["traceId"] for s in spans}))
     return spans
+
+
+def _stage_spans(trace_ids) -> List[Dict[str, Any]]:
+    """Synthetic stage spans from the critical-path engine, one per
+    finished-request waterfall entry, sharing the request's traceId so
+    an OTLP viewer shows the stage anatomy (proxy dispatch → replica
+    execute → llm.prefill → ...) inside the same trace as the task
+    spans. Durations are attributed (not wall-clock-positioned): each
+    span is laid end-to-end from the request's finish timestamp minus
+    its total, which preserves ordering and proportion."""
+    from ray_tpu._private import critical_path
+
+    out: List[Dict[str, Any]] = []
+    for entry in critical_path.finished_waterfalls():
+        trace_id = entry["trace_id"]
+        t0 = entry["ts"] - (entry.get("total_s") or 0.0)
+        cursor = t0
+        parent = trace_id if trace_id in trace_ids else None
+        for i, st in enumerate(entry.get("stages") or []):
+            start, cursor = cursor, cursor + st["dur_s"]
+            out.append({
+                "traceId": trace_id,
+                "spanId": f"stage:{st['stage']}:{i}:{trace_id[:8]}",
+                "parentSpanId": parent,
+                "name": f"stage.{st['stage']}",
+                "kind": "SPAN_KIND_INTERNAL",
+                "startTimeUnixNano": int(start * 1e9),
+                "endTimeUnixNano": int(cursor * 1e9),
+                "status": {"code": "STATUS_CODE_OK", "message": None},
+                "attributes": {
+                    "ray_tpu.stage": st["stage"],
+                    "ray_tpu.route": entry.get("route") or "",
+                    "ray_tpu.dominant_stage":
+                        entry.get("dominant_stage") or "",
+                },
+            })
+    return out
 
 
 def get_trace(trace_id: str, worker=None) -> List[Dict[str, Any]]:

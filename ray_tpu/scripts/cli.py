@@ -114,6 +114,34 @@ def cmd_health(args):
     sys.exit(0 if verdict["status"] == "ok" else 1)
 
 
+def cmd_slow(args):
+    """Top-N slowest request waterfalls (the /api/slow_requests
+    payload): per-request stage breakdown with the dominant stage
+    named, so "where did the time go" is one command."""
+    import ray_tpu
+    from ray_tpu._private import critical_path
+
+    ray_tpu.init(ignore_reinit_error=True)
+    rows = critical_path.slow_requests(n=args.n)
+    if args.json:
+        print(json.dumps({
+            "slow_requests": rows,
+            "attribution": critical_path.attribution_vectors(),
+        }, indent=2, default=str))
+        return
+    if not rows:
+        print("no finished requests recorded")
+        return
+    for row in rows:
+        print(f"{row['trace_id']}  route={row['route']} "
+              f"status={row['status']} total={row['total_s'] * 1e3:.1f}ms "
+              f"dominant={row['dominant_stage']}")
+        for st in row["stages"]:
+            bar = "#" * max(1, int(round(st.get("frac", 0.0) * 40)))
+            print(f"    {st['stage']:<18} "
+                  f"{st['dur_s'] * 1e3:9.2f}ms  {bar}")
+
+
 def cmd_serve(args):
     """`serve deploy/run/status/shutdown` (reference
     `serve/scripts.py` CLI over the REST schema)."""
@@ -192,6 +220,11 @@ def main(argv=None):
     p.set_defaults(fn=cmd_jobs)
 
     sub.add_parser("health").set_defaults(fn=cmd_health)
+
+    p = sub.add_parser("slow")
+    p.add_argument("-n", type=int, default=10)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_slow)
 
     p = sub.add_parser("job")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -45,6 +45,7 @@ import weakref
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import critical_path
 from ray_tpu._private import perf_stats
 from ray_tpu._private import tenancy
 from ray_tpu.exceptions import ActorDiedError
@@ -596,6 +597,12 @@ class HTTPProxy:
                 tags={"route": route or "(unmatched)",
                       "status": status},
                 bounds=perf_stats.SERVE_LATENCY_BOUNDS).record(latency)
+            # Close the critical-path accumulator: attribute this
+            # request's wall time to its recorded stage spans (the
+            # remainder folds as "unattributed") and retain the
+            # waterfall for /api/slow_requests.
+            critical_path.finish_request(
+                trace_id, route or "(unmatched)", status, latency)
             # Per-(job, route) request accounting — the serve half of
             # state.job_summary() and the ray_tpu_serve_requests_total
             # job-tagged series. Route prefixes bound the cardinality;
@@ -729,6 +736,9 @@ class HTTPProxy:
             result = None
             direct_failed = False
             for attempt in (0, 1, 2):
+                # Stage boundary: accept→dispatch covers slot claim /
+                # router queueing, dispatch→result the replica's work.
+                t_dispatch = time.monotonic()
                 # Replica-direct fast path: claim a slot in the
                 # long-poll-fed table and dispatch proxy→replica —
                 # no router lock, no per-request ref pruning, no
@@ -757,6 +767,10 @@ class HTTPProxy:
                             *args,
                             _queue_timeout_s=self.queue_timeout_s,
                             _trace=trace, _job=job)
+                t_wait = time.monotonic()
+                critical_path.record_stage(
+                    trace_id, "proxy.dispatch", t_wait - t_dispatch,
+                    route=route)
                 fut = ref.as_future(self._loop)
                 try:
                     # Bounded replica execution (the threaded proxy's
@@ -803,6 +817,12 @@ class HTTPProxy:
                             self._fallbacks += 1
                         continue
                     raise
+                # The dispatch→result window is deliberately NOT
+                # recorded as a stage: downstream spans (replica
+                # execute, LLM prefill/decode) explain it, and a
+                # wrapper stage would out-rank every stage nested
+                # inside it in the dominant-stage ranking. Whatever
+                # downstream doesn't explain folds as "unattributed".
                 break
             if token is not None:
                 self._direct_served += 1

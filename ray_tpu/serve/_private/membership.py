@@ -33,6 +33,7 @@ lives in :class:`DirectDispatcher` and the watch registry around it.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional
 
@@ -211,7 +212,19 @@ class ReplicaDirectTable:
                             "serve_affinity_routed",
                             {"placed": "best" if idx == 0
                              else "spill"}).inc()
+                    if affinity_tokens:
+                        # Hit = the request landed on its best-scored
+                        # cache-affine replica; anything else (no
+                        # digest overlap, or the best replica was at
+                        # cap and the claim spilled) is a miss the
+                        # hit-rate panel should see.
+                        hit = affine and idx == 0
+                        _perf_stats.counter(
+                            "serve_affinity_hits" if hit
+                            else "serve_affinity_misses").inc()
                     return DirectToken(replica, self.version)
+        if affinity_tokens:
+            _perf_stats.counter("serve_affinity_misses").inc()
         return None
 
     def release(self, token: Optional[DirectToken]) -> None:
@@ -526,14 +539,21 @@ class DirectDispatcher:
                  trace=None, job=None):
         """(ref, token) on success, (None, None) when the table has no
         free member (caller falls back to the routed path)."""
+        from ray_tpu._private import critical_path
         from ray_tpu._private.task_spec import (set_ambient_job_id,
                                                 set_ambient_trace_parent)
 
+        t_acquire = time.monotonic()
         token = self.table.acquire(
             extra_load=self._router_load,
             affinity_tokens=self._affinity_hint(args, kwargs))
         if token is None:
             return None, None
+        # Stage span: slot claim incl. the affinity-scoring pass (the
+        # dispatch RPC below is charged to the proxy's dispatch stage).
+        critical_path.record_stage(
+            trace[0] if trace else None, "direct.acquire",
+            time.monotonic() - t_acquire)
         try:
             prev = set_ambient_trace_parent(trace) \
                 if trace is not None else None

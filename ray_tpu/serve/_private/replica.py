@@ -107,9 +107,13 @@ class ServeReplica:
             return None
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
+        from ray_tpu._private import critical_path
+
         with self._lock:
             self._in_flight += 1
             self._total += 1
+        trace_id = critical_path.ambient_trace_id() \
+            if critical_path.enabled() else None
         t0 = time.perf_counter()
         try:
             target = self.callable
@@ -137,6 +141,8 @@ class ServeReplica:
         finally:
             elapsed = time.perf_counter() - t0
             self._stat_latency.record(elapsed)
+            critical_path.record_stage(trace_id, "replica.execute",
+                                       elapsed)
             note_progress(self.actor_name)
             with self._lock:
                 self._in_flight -= 1

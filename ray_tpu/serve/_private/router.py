@@ -36,6 +36,7 @@ def shutdown_all_routers() -> None:
             pass
 
 import ray_tpu
+from ray_tpu._private import critical_path
 from ray_tpu._private import sanitize_hooks
 from ray_tpu._private import tenancy
 from ray_tpu._private.config import ray_config
@@ -241,7 +242,8 @@ class Router:
 
     def assign_request(self, method: str, args: tuple, kwargs: dict,
                        timeout: float = 30.0, trace=None, job=None):
-        deadline = time.monotonic() + timeout
+        t_enter = time.monotonic()
+        deadline = t_enter + timeout
         dispatched = False
         with self._lock:
             self._waiting += 1
@@ -253,6 +255,9 @@ class Router:
                 ref = self._try_assign(method, args, kwargs, trace, job)
                 if ref is not None:
                     dispatched = True
+                    critical_path.record_stage(
+                        trace[0] if trace else None, "router.assign",
+                        time.monotonic() - t_enter)
                     return ref
                 if time.monotonic() > deadline:
                     raise QueueSaturatedError(
@@ -278,12 +283,17 @@ class Router:
         right now, else None. The event-loop proxy's fast path — no
         coroutine, no parking; saturation falls back to
         :meth:`assign_request_async`."""
+        t_enter = time.monotonic()
         with self._lock:
             self._waiting += 1
         ref = self._try_assign(method, args, kwargs, trace, job)
         if ref is None:
             with self._lock:
                 self._waiting -= 1
+        else:
+            critical_path.record_stage(
+                trace[0] if trace else None, "router.assign",
+                time.monotonic() - t_enter)
         return ref
 
     async def assign_request_async(self, method: str, args: tuple,
@@ -295,7 +305,8 @@ class Router:
         ``await asyncio.sleep`` instead of blocking the loop thread."""
         import asyncio
 
-        deadline = time.monotonic() + timeout
+        t_enter = time.monotonic()
+        deadline = t_enter + timeout
         dispatched = False
         with self._lock:  # raylint: disable=R1 -- microsecond critical section guarding state shared with sync dispatch threads; an asyncio.Lock cannot serialize against them
             self._waiting += 1
@@ -305,6 +316,9 @@ class Router:
                 ref = self._try_assign(method, args, kwargs, trace, job)
                 if ref is not None:
                     dispatched = True
+                    critical_path.record_stage(
+                        trace[0] if trace else None, "router.assign",
+                        time.monotonic() - t_enter)
                     return ref
                 if time.monotonic() > deadline:
                     raise QueueSaturatedError(

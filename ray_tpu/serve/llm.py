@@ -56,6 +56,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ray_tpu._private import critical_path
 from ray_tpu._private import perf_stats
 from ray_tpu._private.config import ray_config
 from ray_tpu._private.kv_cache import PrefixCache, chain_keys
@@ -131,6 +132,13 @@ class _Request:
     model: Optional[str] = None
     priority: int = 1     # 0 interactive > 1 normal > 2 batch
     job: str = "default"
+    # Critical-path attribution: the HTTP request's trace id (stamped
+    # at generate() from the calling task's ambient trace, "" outside
+    # any trace) plus the per-request stage marks the engine loop sets
+    # while the request crosses admit → kv-lookup → prefill → sample.
+    trace_id: str = ""
+    t_kv_done: float = 0.0
+    t_prefill_done: float = 0.0
 
 
 class LLMEngine:
@@ -563,7 +571,11 @@ class LLMEngine:
             request_id=next(self._req_counter), prompt=prompt,
             params=params or SamplingParams(), out_queue=queue.Queue(),
             t_arrival=time.perf_counter(),
-            model=model, priority=max(0, min(2, int(priority))), job=job)
+            model=model, priority=max(0, min(2, int(priority))), job=job,
+            # Stamped on the CALLING thread (the replica's task context
+            # is thread-local; the engine loop below has none).
+            trace_id=(critical_path.ambient_trace_id() or "")
+            if critical_path.enabled() else "")
         self._queue.put(req)
         self.start()
 
@@ -650,10 +662,17 @@ class LLMEngine:
             prompt = req.prompt
             t_real = len(prompt)
             slot = self._free_slots.pop()
+            # Stage: admit = time spent queued for a slot.
+            t_admit = time.perf_counter()
+            critical_path.record_stage(req.trace_id, "llm.admit",
+                                       t_admit - req.t_arrival)
             # Prefix-cache fast path: copy matched KV blocks straight
             # into the slot, then prefill ONLY the tail at the tail's
             # bucket, starting at the matched offset.
             m_tok, chain = self._prefix_copy_in(req, slot, prompt)
+            req.t_kv_done = time.perf_counter()
+            critical_path.record_stage(req.trace_id, "llm.kv_lookup",
+                                       req.t_kv_done - t_admit)
             tail = prompt[m_tok:]
             t_tail = len(tail)
             bucket = self._serve_bucket(t_tail)
@@ -662,6 +681,7 @@ class LLMEngine:
             self.cache, last_logits = self._run_prefill(
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(t_tail),
                 jnp.int32(m_tok), bucket)
+            req.t_prefill_done = time.perf_counter()
             staged.append((req, slot, t_real, last_logits, chain))
         for req in leftover:
             self._queue.put(req)
@@ -678,11 +698,27 @@ class LLMEngine:
         temps_np = np.zeros(self.n_slots, np.float32)
         for i, s in enumerate(staged):
             temps_np[i] = s[0].params.temperature
+        t_sample = time.perf_counter()
         firsts_dev, self._rng = self._run_sample(
             logits, jnp.asarray(temps_np))
+        # The host sync below is where the wave's ASYNC-dispatched
+        # prefill compute actually completes; the fused sample kernel
+        # is trivial next to a transformer prefill, so the sync wait is
+        # attributed to each staged request's prefill stage (split
+        # evenly across the wave). The residual — dispatch overhead of
+        # the batched sample path — is the first-token stage. The two
+        # splits tile the wave's wall time, so the per-request vector
+        # still sums to what the request actually spent here.
         firsts = np.asarray(firsts_dev)[:len(staged)]
         now = time.perf_counter()
+        sync_share = (now - t_sample) / len(staged)
         for (req, slot, t_real, _, _chain), first in zip(staged, firsts):
+            critical_path.record_stage(
+                req.trace_id, "llm.prefill",
+                (req.t_prefill_done - req.t_kv_done) + sync_share)
+            critical_path.record_stage(
+                req.trace_id, "llm.first_token",
+                max(0.0, t_sample - req.t_prefill_done))
             first = int(first)
             req.t_first_token = now
             req.tokens.append(first)
@@ -757,6 +793,11 @@ class LLMEngine:
     def _retire(self, slot: int):
         req = self._slot_req.pop(slot, None)
         if req is not None:
+            if req.t_first_token is not None:
+                # Per-slot decode stage: first token → end of stream.
+                critical_path.record_stage(
+                    req.trace_id, "llm.decode",
+                    time.perf_counter() - req.t_first_token)
             req.out_queue.put(None)
         self._active[slot] = False
         self._lengths[slot] = 0

@@ -46,7 +46,8 @@ def _global_state_baseline():
     via the runtime's own reset hooks, and the ambient sanitizer
     (``--sanitize=ambient``) independently verifies nothing escapes.
     Cost is two small dict snapshots per test."""
-    from ray_tpu._private import health, perf_stats
+    from ray_tpu._private import (critical_path, flight_recorder, health,
+                                  perf_stats)
 
     serve_snap = perf_stats.snapshot_records("serve_request_seconds")
     # The per-(job, route) request counter feeds job_summary()'s
@@ -54,10 +55,20 @@ def _global_state_baseline():
     # a test's tagged traffic must not inflate a later test's exact
     # per-tenant counts.
     req_snap = perf_stats.snapshot_records("serve_requests")
+    # The critical-path attribution vectors + waterfalls + flight rings
+    # (PR 18) are the same process-global class: one test's serve
+    # traffic must not leak stage records into another's
+    # /api/slow_requests or flight-dump assertions.
+    stage_snap = perf_stats.snapshot_records(critical_path.STAGE_METRIC)
+    cp_snap = critical_path.snapshot_state()
+    fr_snap = flight_recorder.snapshot_state()
     health_snap = health.snapshot_state()
     yield
     perf_stats.restore_records("serve_request_seconds", serve_snap)
     perf_stats.restore_records("serve_requests", req_snap)
+    perf_stats.restore_records(critical_path.STAGE_METRIC, stage_snap)
+    critical_path.restore_state(cp_snap)
+    flight_recorder.restore_state(fr_snap)
     health.restore_state(health_snap)
 
 

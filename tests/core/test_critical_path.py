@@ -1,0 +1,285 @@
+"""Critical-path attribution engine + flight recorder (PR 18).
+
+Pure-core coverage for `_private/critical_path.py` (stage folding,
+late-arrival ingest, waterfalls, exemplars) and
+`_private/flight_recorder.py` (rings, edge-triggered dump, debounce),
+plus the dashboard surfaces (`/api/slow_requests`, `/api/debug/dump`)
+and the chaos leg: an SLO flood on a 2-node cluster produces exactly
+one correlated FLIGHT dump with rings from every live node.
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import critical_path, flight_recorder, perf_stats
+from ray_tpu._private.config import ray_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_engines():
+    critical_path.reset()
+    flight_recorder.reset()
+    perf_stats.restore_records(critical_path.STAGE_METRIC, {})
+    yield
+
+
+def test_finish_folds_stages_and_unattributed():
+    critical_path.record_stage("t1", "proxy.dispatch", 0.01,
+                               route="/r")
+    critical_path.record_stage("t1", "replica.execute", 0.05,
+                               route="/r")
+    critical_path.finish_request("t1", "/r", "200", 0.10)
+
+    vecs = critical_path.attribution_vectors()
+    assert set(vecs["/r"]) == {"proxy.dispatch", "replica.execute",
+                               "unattributed"}
+    # The vector tiles the measured total: 0.01 + 0.05 + 0.04.
+    assert vecs["/r"]["unattributed"]["sum"] == pytest.approx(0.04)
+    assert vecs["/r"]["replica.execute"]["count"] == 1
+
+    (entry,) = critical_path.finished_waterfalls()
+    assert entry["dominant_stage"] == "replica.execute"
+    assert entry["unattributed_s"] == pytest.approx(0.04)
+
+    # Exemplars pin the trace id to its (route, stage) bucket.
+    exes = critical_path.exemplars()
+    assert any(e["trace_id"] == "t1" and e["stage"] == "replica.execute"
+               for e in exes)
+
+
+def test_late_arrival_folds_into_finished_route():
+    """Node-born stage records ship seconds after the proxy closed the
+    request; they must still land in the route's attribution vector."""
+    critical_path.record_stage("t2", "proxy.dispatch", 0.01, route="/r")
+    critical_path.finish_request("t2", "/r", "200", 0.02)
+    # Arrives via the obs shipper after the finish:
+    critical_path.ingest([{"trace_id": "t2", "stage": "llm.prefill",
+                           "dur_s": 0.5, "route": ""}])
+    vecs = critical_path.attribution_vectors()
+    assert vecs["/r"]["llm.prefill"]["sum"] == pytest.approx(0.5)
+
+
+def test_drain_requeue_roundtrip():
+    # Only shipping processes (a NodeObsShipper started) queue records.
+    critical_path.set_shipping(True)
+    try:
+        critical_path.record_stage("t3", "sched.queue", 0.001)
+        recs = critical_path.drain_records()
+        assert [r["stage"] for r in recs] == ["sched.queue"]
+        assert critical_path.drain_records() == []
+        critical_path.requeue_records(recs)
+        assert critical_path.drain_records() == recs
+    finally:
+        critical_path.set_shipping(False)
+
+
+def test_head_process_does_not_queue_for_shipping():
+    """The head folds its own records in place; with no shipper
+    started, nothing accumulates in the pending queue."""
+    critical_path.record_stage("t3b", "sched.queue", 0.001)
+    assert critical_path.drain_records() == []
+    # ...but the trace still accumulated locally.
+    critical_path.finish_request("t3b", "/r", "200", 0.002)
+    assert critical_path.attribution_vectors()["/r"]["sched.queue"][
+        "count"] == 1
+
+
+def test_disabled_records_nothing():
+    critical_path.set_enabled(False)
+    try:
+        critical_path.record_stage("t4", "proxy.dispatch", 0.01,
+                                   route="/r")
+        critical_path.finish_request("t4", "/r", "200", 0.1)
+        assert critical_path.finished_waterfalls() == []
+        assert critical_path.drain_records() == []
+        assert critical_path.attribution_vectors() == {}
+    finally:
+        critical_path.set_enabled(True)
+
+
+def test_slow_requests_ranked_with_fracs():
+    for i, total in enumerate((0.1, 0.5, 0.3)):
+        tid = f"t5-{i}"
+        critical_path.record_stage(tid, "replica.execute", total / 2,
+                                   route="/r")
+        critical_path.finish_request(tid, "/r", "200", total)
+    rows = critical_path.slow_requests(n=2)
+    assert [r["trace_id"] for r in rows] == ["t5-1", "t5-2"]
+    assert rows[0]["stages"][0]["frac"] == pytest.approx(0.5)
+
+
+def test_stage_metric_p99_exported():
+    """runtime_metrics exports the p99 gauge for the attribution
+    metric (the per-route p50/p99 vector contract)."""
+    from ray_tpu._private.runtime_metrics import _collect_fastpath_stats
+    from ray_tpu.util.metrics import snapshot_registry
+
+    critical_path.record_stage("t6", "replica.execute", 0.05,
+                               route="/r")
+    critical_path.finish_request("t6", "/r", "200", 0.06)
+    _collect_fastpath_stats()
+    snap = snapshot_registry()
+    assert "ray_tpu_request_stage_seconds_p50" in snap
+    assert "ray_tpu_request_stage_seconds_p99" in snap
+
+
+def test_flight_rings_bounded_and_snapshotted(monkeypatch):
+    monkeypatch.setattr(ray_config, "flight_ring_size", 8)
+    for i in range(32):
+        flight_recorder.note_span({"trace_id": f"x{i}",
+                                   "stage": "s", "dur_s": 0.0})
+        flight_recorder.note_sample("health", {"i": i})
+    snap = flight_recorder.local_snapshot()
+    assert len(snap["spans"]) == 8
+    assert snap["spans"][-1]["trace_id"] == "x31"
+    assert len(snap["samples"]) == 8
+    assert "slow_requests" in snap
+
+
+def test_observe_verdict_edge_and_debounce(tmp_path, monkeypatch):
+    monkeypatch.setattr(ray_config, "flight_recorder_dir",
+                        str(tmp_path))
+    monkeypatch.setattr(ray_config, "flight_min_interval_s", 3600.0)
+    ok = {"status": "ok", "reasons": []}
+    bad = {"status": "degraded", "reasons": ["slo_burn: route /r"]}
+
+    assert flight_recorder.observe_verdict(ok) is None
+    payload = flight_recorder.observe_verdict(bad)
+    assert payload is not None and "path" in payload
+    # Still degraded: no new edge, no new dump.
+    assert flight_recorder.observe_verdict(bad) is None
+    # Recovered then re-degraded inside the debounce window: edge
+    # detected but the dump is suppressed.
+    assert flight_recorder.observe_verdict(ok) is None
+    assert flight_recorder.observe_verdict(bad) is None
+    files = list(tmp_path.glob("FLIGHT_*.json"))
+    assert len(files) == 1
+    on_disk = json.loads(files[0].read_text())
+    assert on_disk["verdict"] == "degraded"
+    assert on_disk["reasons"] == bad["reasons"]
+    assert on_disk["trigger"] == "degraded"
+
+
+def test_observe_verdict_no_dir_never_writes(tmp_path, monkeypatch):
+    monkeypatch.setattr(ray_config, "flight_recorder_dir", "")
+    bad = {"status": "degraded", "reasons": ["r"]}
+    flight_recorder.observe_verdict({"status": "ok", "reasons": []})
+    assert flight_recorder.observe_verdict(bad) is None
+    assert list(tmp_path.glob("FLIGHT_*.json")) == []
+
+
+def test_api_slow_requests_and_debug_dump(ray_start_2_cpus):
+    import urllib.request
+
+    from ray_tpu.dashboard import shutdown_dashboard, start_dashboard
+
+    critical_path.record_stage("t7", "replica.execute", 0.2,
+                               route="/demo")
+    critical_path.finish_request("t7", "/demo", "200", 0.25)
+    server = start_dashboard(port=0)
+    base = f"http://{server.host}:{server.port}"
+    try:
+        with urllib.request.urlopen(base, timeout=10) as resp:
+            endpoints = json.loads(resp.read())["endpoints"]
+        assert "/api/slow_requests" in endpoints
+        assert "/api/debug/dump" in endpoints
+        with urllib.request.urlopen(f"{base}/api/slow_requests",
+                                    timeout=10) as resp:
+            body = json.loads(resp.read())
+        rows = body["slow_requests"]
+        assert rows and rows[0]["trace_id"] == "t7"
+        assert rows[0]["dominant_stage"] == "replica.execute"
+        assert body["attribution"]["/demo"]["replica.execute"]["count"] \
+            == 1
+        assert any(e["trace_id"] == "t7" for e in body["exemplars"])
+        with urllib.request.urlopen(f"{base}/api/debug/dump",
+                                    timeout=10) as resp:
+            dump = json.loads(resp.read())
+        assert dump["trigger"] == "api"
+        assert dump["nodes"]  # at least this process's rings
+        ring = next(iter(dump["nodes"].values()))
+        assert "spans" in ring and "samples" in ring
+        # No directory configured: inline payload only, nothing on disk.
+        assert "path" not in dump
+    finally:
+        shutdown_dashboard()
+
+
+def test_cli_slow_prints_waterfalls(ray_start_2_cpus, capsys):
+    from ray_tpu.scripts.cli import main as cli_main
+
+    critical_path.record_stage("t8", "llm.prefill", 0.3, route="/llm")
+    critical_path.finish_request("t8", "/llm", "200", 0.4)
+    cli_main(["slow", "-n", "5"])
+    out = capsys.readouterr().out
+    assert "t8" in out
+    assert "dominant=llm.prefill" in out
+    cli_main(["slow", "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["slow_requests"][0]["trace_id"] == "t8"
+    assert "/llm" in parsed["attribution"]
+
+
+def test_slo_flood_dumps_once_with_rings_from_every_node(
+        tmp_path, monkeypatch):
+    """Chaos leg: flood a route past its SLO target on a 2-node
+    cluster. The ok→degraded edge must produce EXACTLY one flight dump
+    whose verdict names slo_burn and whose rings cover every live
+    node; repeated degraded polls must not dump again."""
+    from ray_tpu._private.health import evaluate_health
+    from ray_tpu.cluster_utils import Cluster
+
+    route = "/flood"
+    monkeypatch.setattr(ray_config, "serve_slo_targets",
+                        f"{route}=0.05:0.9")
+    monkeypatch.setattr(ray_config, "flight_recorder_dir",
+                        str(tmp_path))
+    monkeypatch.setattr(ray_config, "flight_min_interval_s", 3600.0)
+    # Only the SLO signal may trip on a loaded CI box: park the other
+    # thresholds out of reach so the baseline verdict is "ok".
+    monkeypatch.setattr(ray_config, "health_memory_pressure_threshold",
+                        1.1)
+    monkeypatch.setattr(ray_config, "health_loop_lag_threshold_s", 60.0)
+    monkeypatch.setattr(ray_config, "health_backlog_threshold",
+                        10 ** 6)
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=1)
+        v0 = evaluate_health()
+        assert v0["status"] == "ok", v0["reasons"]
+
+        # The flood: 50 requests at 10x the 50ms target burn the whole
+        # error budget (objective 0.9 -> any >10% bad is >1x burn).
+        dist = perf_stats.dist(
+            "serve_request_seconds",
+            tags={"route": route, "status": "200"},
+            bounds=perf_stats.SERVE_LATENCY_BOUNDS)
+        for _ in range(50):
+            dist.record(0.5)
+
+        v1 = evaluate_health()
+        assert v1["status"] == "degraded"
+        assert any(r.startswith("slo_burn:") for r in v1["reasons"]), \
+            v1["reasons"]
+        # Still degraded on later polls: the edge fired once.
+        evaluate_health()
+        evaluate_health()
+
+        files = list(tmp_path.glob("FLIGHT_*.json"))
+        assert len(files) == 1, [f.name for f in files]
+        payload = json.loads(files[0].read_text())
+        assert payload["verdict"] == "degraded"
+        assert any("slo_burn:" in r for r in payload["reasons"])
+        # Rings from every live node: the head's own plus a
+        # flight_snapshot RPC answer from the added worker node.
+        rings = payload["nodes"]
+        assert len(rings) >= 2, list(rings)
+        for node_id, ring in rings.items():
+            assert "error" not in ring, (node_id, ring)
+            assert "spans" in ring and "samples" in ring, node_id
+    finally:
+        cluster.shutdown()

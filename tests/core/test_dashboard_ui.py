@@ -110,6 +110,11 @@ def test_grafana_dashboard_factory(tmp_path):
     assert "ray_tpu_llm_kv_cache_hits" in serve_exprs
     assert "ray_tpu_llm_kv_cache_bytes" in serve_exprs
     assert "ray_tpu_llm_model_swaps" in serve_exprs
+    # Request-anatomy row (PR 18): stage attribution + affinity rate.
+    assert "ray_tpu_request_stage_seconds_p50" in serve_exprs
+    assert "ray_tpu_request_stage_seconds_p99" in serve_exprs
+    assert "ray_tpu_serve_affinity_hits_total" in serve_exprs
+    assert "ray_tpu_serve_affinity_misses_total" in serve_exprs
     obj = next(p for p in paths if "object-plane" in p)
     with open(obj) as f:
         obj_exprs = " ".join(t["expr"]

@@ -29,6 +29,13 @@ DEFAULT_POLICY = [
         reason="debugger/REPL host threads are owned by the tool "
                "running the suite, not by the code under test"),
     Allow(
+        "leaks", r"thread leaked: 'critical-path-folder",
+        reason="the stage-span fold thread is process-lifetime by "
+               "design: hot paths pay one deque append and the folder "
+               "absorbs the accumulation off the request path; it is "
+               "started once on first record and parks in sleep() "
+               "between 100ms fold beats"),
+    Allow(
         "leaks", r"fd leaked: file fd=\d+ \(/dev/shm/ray_tpu",
         reason="SharedPlane.destroy(unmap=False) at cluster teardown "
                "unlinks the segment but DELIBERATELY leaves the "
