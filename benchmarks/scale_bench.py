@@ -785,6 +785,90 @@ def tenancy(n_flood=40, n_serve=60, hog_chunks=4):
     }
 
 
+# -- multi-process head leg (--sections head): SCALE_r19 ---------------------
+
+
+def head_leg(n_tasks=240, router_rows=4000):
+    """PR 19 control-plane dimension: a real cluster's remote task
+    flood at head_shards=1 vs =2 SAME-RUN (the lease + inflight +
+    directory mutation path riding the shard stream), an isolated
+    1-vs-2 durable-row flood, and a mid-run shard hard-kill with
+    supervised recovery — the failover path at scale-bench weight."""
+    import shutil
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu._private.config import ray_config
+    from ray_tpu.cluster_utils import Cluster
+
+    def cluster_side(shards):
+        old_shards = ray_config.head_shards
+        old_dir = ray_config.head_shard_db_dir
+        tmp = tempfile.mkdtemp(prefix="scale_head_")
+        ray_config.head_shards = shards
+        ray_config.head_shard_db_dir = tmp
+        # Zero-CPU head: every task rides lease dispatch to the node
+        # subprocess, so the control plane is ON the measured path.
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 0})
+        try:
+            cluster.add_node(num_cpus=2)
+
+            @ray_tpu.remote(num_cpus=1)
+            def sq(x):
+                return x * x
+
+            assert ray_tpu.get(sq.remote(3), timeout=120) == 9  # warm
+            t0 = time.perf_counter()
+            got = ray_tpu.get([sq.remote(i) for i in range(n_tasks)],
+                              timeout=600)
+            dt = time.perf_counter() - t0
+            assert got == [i * i for i in range(n_tasks)]
+            row = {"tasks_per_s": round(n_tasks / dt, 2)}
+            router = cluster.head.shard_router
+            if router is not None:
+                router.flush()
+                row["shard_rows"] = {
+                    t: len(router.fold_items(t))
+                    for t in ("objects", "sizes", "lease")}
+                # Chaos: hard-kill one shard, supervisor restarts it,
+                # the cluster keeps completing tasks end to end.
+                router.kill_shard(0)
+                restarted = cluster.head.poll_shards()
+                row["restarted_shards"] = restarted
+                got = ray_tpu.get(
+                    [sq.remote(i) for i in range(10)], timeout=300)
+                assert got == [i * i for i in range(10)]
+                row["post_failover_tasks_ok"] = True
+            return row
+        finally:
+            cluster.shutdown()
+            ray_config.head_shards = old_shards
+            ray_config.head_shard_db_dir = old_dir
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    from benchmarks.perf_bench import _head_router_side
+
+    single = cluster_side(1)
+    sharded = cluster_side(2)
+    router_1 = _head_router_side(1, rows=router_rows)
+    router_2 = _head_router_side(2, rows=router_rows)
+    return {
+        "cluster_head_shards_1": single,
+        "cluster_head_shards_2": sharded,
+        "cluster_parity_x": round(
+            sharded["tasks_per_s"] / max(single["tasks_per_s"], 0.01),
+            3),
+        "router_1shard": router_1,
+        "router_2shard": router_2,
+        "router_scaling_x": round(
+            router_2["rows_per_s"] / max(router_1["rows_per_s"], 0.1),
+            3),
+        "note": "single-core host: parity, not speedup, is the "
+                "honest expectation (see BENCH_HEAD_r19 fallback arm)",
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
@@ -851,6 +935,8 @@ def main():
                 lambda: chaos(broadcast_mb=args.broadcast_mb), out)
     if want("tenancy"):
         section("tenancy", tenancy, out)
+    if want("head"):
+        section("head", lambda: head_leg(), out)
     if want("sched"):
         section("sched",
                 lambda: sched(

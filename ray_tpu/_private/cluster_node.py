@@ -943,8 +943,14 @@ class NodeRuntime:
                 if self.worker.memory_store.contains(ObjectID(oid))]
         if oids:
             try:
+                # Sizes ride the re-report too: a head (or head SHARD)
+                # that lost its directory needs bytes back, not just
+                # locations — locality-aware placement and the sharded
+                # head's re-registration repair path both read them.
+                sizes = [self.worker.memory_store.entry_size(
+                    ObjectID(oid)) for oid in oids]
                 self.head.call("report_objects", oids=oids,
-                               address=self.address)
+                               address=self.address, sizes=sizes)
             except Exception:
                 pass
 

@@ -143,6 +143,20 @@ class RayTpuConfig:
     # object directory, lineage, lease grants): shard count (rounded up
     # to a power of two). 1 = effectively a single lock per table.
     sched_head_shards: int = 16
+    # Multi-PROCESS head control plane (distinct from the in-process
+    # lock partitioning above): the hot row tables — object directory +
+    # sizes, inflight, lineage edges, lease registrations — stream to N
+    # head shard PROCESSES by stable key hash, each owning its own
+    # group-commit durability window (_private/head_shards.py). 1 =
+    # no shard processes, today's single-process head byte-for-byte.
+    head_shards: int = 1
+    # Each shard's sqlite group-commit window (its durability loss
+    # bound on a hard crash). <= 0 means "inherit
+    # gcs_commit_interval_s".
+    head_shard_commit_interval_s: float = 0.0
+    # Directory for the per-shard sqlite dbs; empty = a temp dir per
+    # head (rows then survive shard restarts but not host cleanup).
+    head_shard_db_dir: str = ""
     # Lease cache: a granted (job, shape) lease is returned after this
     # long idle (reference: lease return on idle worker).
     sched_lease_idle_s: float = 2.0

@@ -126,6 +126,14 @@ class SqliteStoreClient(StoreClient):
         from ray_tpu._private import perf_stats
 
         self._stat_writes = perf_stats.counter("gcs_writes")
+        # Per-store commit accounting: the multi-process head reads
+        # these off each shard's own store (shard_stats ->
+        # ray_tpu_head_shard_commit_seconds) so per-shard group-commit
+        # latency — the shard's durability loss bound in time units —
+        # is observable without guessing from the global latency stat.
+        self.commit_count = 0
+        self.commit_seconds_total = 0.0
+        self.last_commit_s = 0.0
         self._interval = max(0.0, float(commit_interval_s or 0.0))
         self._dirty = threading.Event()
         self._closed = threading.Event()
@@ -224,6 +232,9 @@ class SqliteStoreClient(StoreClient):
             sanitize_hooks.crash_point("gcs.commit.after")
             self._commit_err_logged = False
             self._dirty.clear()
+            self.commit_count += 1
+            self.last_commit_s = time.monotonic() - t0
+            self.commit_seconds_total += self.last_commit_s
         perf_stats.latency("gcs_commit_seconds").record(
             time.monotonic() - t0)
 

@@ -255,6 +255,37 @@ def unregister_degraded_provider(key: str) -> None:
         _DEGRADED_PROVIDERS.pop(key, None)
 
 
+_SECTION_PROVIDERS: Dict[str, Any] = {}
+
+
+def register_section_provider(key: str, fn) -> None:
+    """Register a STRUCTURED healthz section: ``fn()`` returns plain
+    data that lands verbatim under ``key`` in the /api/healthz payload
+    (e.g. the multi-process head's per-shard verdict list). Same
+    contract as degraded providers: cheap, non-blocking, no RPC."""
+    with _PROVIDER_LOCK:
+        _SECTION_PROVIDERS[key] = fn
+
+
+def unregister_section_provider(key: str) -> None:
+    with _PROVIDER_LOCK:
+        _SECTION_PROVIDERS.pop(key, None)
+
+
+def provider_sections() -> Dict[str, Any]:
+    """Current structured sections from every registered provider; a
+    broken provider degrades to absent rather than failing healthz."""
+    with _PROVIDER_LOCK:
+        providers = dict(_SECTION_PROVIDERS)
+    sections = {}
+    for key, fn in providers.items():
+        try:
+            sections[key] = fn()
+        except Exception:
+            continue
+    return sections
+
+
 def provider_reasons() -> list:
     """Current reasons from every registered provider; a broken
     provider degrades to absent rather than failing the endpoint."""
@@ -494,6 +525,9 @@ def evaluate_health(worker=None) -> Dict[str, Any]:
            "reasons": reasons,
            "head": local,
            "nodes": nodes}
+    # Structured sections (e.g. "head_shards": per-shard verdicts from
+    # the multi-process head's coordinator) ride the payload verbatim.
+    out.update(provider_sections())
     # Flight recorder: the ok→degraded edge freezes every live node's
     # rings into one correlated FLIGHT_<ts>.json (no-op unless
     # flight_recorder_dir is configured; debounced inside).

@@ -1155,6 +1155,18 @@ class LocalBackend:
 
     def shutdown(self):
         self._shutdown.set()
+        # Head role with a sharded control plane: drain the write-behind
+        # replication stream first, so a GRACEFUL exit establishes the
+        # acked-durable boundary (crash exits intentionally skip this —
+        # their loss bound is each shard's open group-commit window).
+        head = getattr(self, "head", None)
+        router = getattr(head, "shard_router", None) \
+            if head is not None else None
+        if router is not None:
+            try:
+                router.flush()
+            except Exception:
+                pass
         for actor in list(self._actors.values()):
             actor.stop("node shutdown")
             if actor._proc is not None:

@@ -187,6 +187,12 @@ SCHED_POINTS = SPEC_POINTS | frozenset({
     # returns-False → re-register path)
     "head.node_report",
     "head.register",
+    # multi-process head: the coordinator's key->shard routing decision
+    # and a shard's row-table apply (the cross_shard raymc scenario's
+    # interleaving surface; the per-shard commit boundary reuses the
+    # gcs.commit.* crash points of the shard's own store)
+    "headshard.route",
+    "headshard.apply",
     # tenancy enforcement: quota check-and-charge / release and the
     # over-quota park (the quota_admission raymc scenario's
     # interleaving surface). Each fires ONLY for jobs with a

@@ -38,6 +38,24 @@ def round_up_pow2(n: int) -> int:
     return out
 
 
+def stable_shard_of(key: bytes, n_shards: int) -> int:
+    """Process-stable key -> shard partition (crc32, NOT the builtin
+    ``hash`` — that one is salted per process). This is the map the
+    multi-process head (``_private/head_shards.py``) routes by: the
+    same key must land on the same shard across coordinator restarts
+    so a failed-over head finds durable rows where its predecessor
+    left them. In-process ``ShardedTable`` partitioning keeps the
+    cheaper salted hash — its shards share one address space and never
+    outlive the process."""
+    if n_shards <= 1:
+        return 0
+    if not isinstance(key, (bytes, bytearray)):
+        key = repr(key).encode()
+    import zlib
+
+    return zlib.crc32(key) % n_shards
+
+
 def milli_add(acc: Dict[str, int], milli: Dict[str, int]) -> None:
     """Accumulate a milli-resource request into ``acc`` in place."""
     for k, v in milli.items():

@@ -11,6 +11,7 @@ The library builds on demand with g++ (`ensure_built`), cached under
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import mmap
 import os
@@ -139,6 +140,15 @@ class ShmObjectStore:
             self._handle = self._lib.shm_store_attach(name.encode())
         if not self._handle:
             raise OSError(f"failed to open shm store {name!r}")
+        # Close/op gate: every ctypes entry point runs under _op(),
+        # which refuses once closing starts; close() waits for in-
+        # flight calls to drain before freeing the C handle and the
+        # mapping. Without it, `contains()`/`put_bytes` racing
+        # `close()` on another thread dereferences a freed handle —
+        # a real observed SEGFAULT at publish-vs-teardown.
+        self._op_cv = threading.Condition()
+        self._op_inflight = 0
+        self._closing = False
         # Map the segment into this process for zero-copy access.
         size = self._lib.shm_store_mmap_size(self._handle)
         fd = os.open(f"/dev/shm{name}", os.O_RDWR)
@@ -182,25 +192,49 @@ class ShmObjectStore:
         except Exception:
             pass  # populate is an optimization; faults still work
 
+    @contextlib.contextmanager
+    def _op(self):
+        """Gate one native call against close(). Yields the live C
+        handle, or None when the store is closing/closed (callers
+        return a benign miss). The handle and mapping stay valid for
+        the whole `with` body — close() blocks on the drain."""
+        with self._op_cv:
+            if self._closing or not self._handle:
+                yield None
+                return
+            self._op_inflight += 1
+        try:
+            yield self._handle
+        finally:
+            with self._op_cv:
+                self._op_inflight -= 1
+                if self._op_inflight == 0:
+                    self._op_cv.notify_all()
+
     # -- raw bytes -------------------------------------------------------
 
     def put_bytes(self, object_id: bytes, payload: bytes) -> bool:
         assert len(object_id) == 20
-        off = self._lib.shm_obj_create(self._handle, object_id,
-                                       len(payload))
-        if off == 2**64 - 1:
-            return False
-        self._view[off:off + len(payload)] = payload
-        return bool(self._lib.shm_obj_seal(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return False
+            off = self._lib.shm_obj_create(h, object_id, len(payload))
+            if off == 2**64 - 1:
+                return False
+            self._view[off:off + len(payload)] = payload
+            return bool(self._lib.shm_obj_seal(h, object_id))
 
     def get_bytes(self, object_id: bytes) -> Optional[memoryview]:
         """Zero-copy view; call release(object_id) when done."""
         size = ctypes.c_uint64()
-        off = self._lib.shm_obj_get(self._handle, object_id,
-                                    ctypes.byref(size))
-        if off == 2**64 - 1:
-            return None
-        return self._view[off:off + size.value]
+        with self._op() as h:
+            if h is None:
+                return None
+            off = self._lib.shm_obj_get(h, object_id,
+                                        ctypes.byref(size))
+            if off == 2**64 - 1:
+                return None
+            return self._view[off:off + size.value]
 
     # -- numpy -----------------------------------------------------------
 
@@ -208,14 +242,17 @@ class ShmObjectStore:
         arr = np.ascontiguousarray(arr)
         header = _encode_header(arr)
         total = len(header) + arr.nbytes
-        off = self._lib.shm_obj_create(self._handle, object_id, total)
-        if off == 2**64 - 1:
-            return False
-        self._view[off:off + len(header)] = header
-        dst = np.frombuffer(self._view, np.uint8, arr.nbytes,
-                            off + len(header))
-        dst[:] = arr.view(np.uint8).reshape(-1)
-        return bool(self._lib.shm_obj_seal(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return False
+            off = self._lib.shm_obj_create(h, object_id, total)
+            if off == 2**64 - 1:
+                return False
+            self._view[off:off + len(header)] = header
+            dst = np.frombuffer(self._view, np.uint8, arr.nbytes,
+                                off + len(header))
+            dst[:] = arr.view(np.uint8).reshape(-1)
+            return bool(self._lib.shm_obj_seal(h, object_id))
 
     def get_numpy(self, object_id: bytes) -> Optional[np.ndarray]:
         """Zero-copy read-only array backed by shared memory."""
@@ -230,32 +267,50 @@ class ShmObjectStore:
     # -- lifecycle -------------------------------------------------------
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(self._lib.shm_obj_contains(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return False
+            return bool(self._lib.shm_obj_contains(h, object_id))
 
     def object_size(self, object_id: bytes) -> Optional[int]:
         """Payload size of a sealed object, or None if absent."""
         size = ctypes.c_uint64()
-        off = self._lib.shm_obj_get(self._handle, object_id,
-                                    ctypes.byref(size))
-        if off == 2**64 - 1:
-            return None
-        self.release(object_id)  # drop the pin Get took
-        return size.value
+        with self._op() as h:
+            if h is None:
+                return None
+            off = self._lib.shm_obj_get(h, object_id,
+                                        ctypes.byref(size))
+            if off == 2**64 - 1:
+                return None
+            self._lib.shm_obj_release(h, object_id)  # drop Get's pin
+            return size.value
 
     def release(self, object_id: bytes) -> bool:
-        return bool(self._lib.shm_obj_release(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return False
+            return bool(self._lib.shm_obj_release(h, object_id))
 
     def delete(self, object_id: bytes) -> bool:
-        return bool(self._lib.shm_obj_delete(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return False
+            return bool(self._lib.shm_obj_delete(h, object_id))
 
     def refcount(self, object_id: bytes) -> int:
         """Pin count of a sealed object across ALL attached processes,
         or -1 when absent/unsealed (spill victim selection)."""
-        return int(self._lib.shm_obj_refcount(self._handle, object_id))
+        with self._op() as h:
+            if h is None:
+                return -1
+            return int(self._lib.shm_obj_refcount(h, object_id))
 
     def stats(self) -> dict:
         st = StoreStats()
-        self._lib.shm_store_stats(self._handle, ctypes.byref(st))
+        with self._op() as h:
+            if h is None:
+                return {f[0]: 0 for f in StoreStats._fields_}
+            self._lib.shm_store_stats(h, ctypes.byref(st))
         return {f[0]: getattr(st, f[0]) for f in StoreStats._fields_}
 
     # -- transfer plane (node-to-node chunked pull; transfer.h) ---------
@@ -288,9 +343,12 @@ class ShmObjectStore:
         0 = pulled, -5 = already present, <0 = failure (transfer.h).
         ``allow_local=False`` forces the TCP stream even when the peer's
         segment is mappable on this machine (remote-host simulation)."""
-        return self._lib.shm_transfer_pull_opts(
-            self._handle, object_id, host.encode(), port,
-            1 if allow_local else 0)
+        with self._op() as h:
+            if h is None:
+                return -1
+            return self._lib.shm_transfer_pull_opts(
+                h, object_id, host.encode(), port,
+                1 if allow_local else 0)
 
     def pull_from_striped(self, object_id: bytes, host: str, port: int,
                           streams: int = 4,
@@ -299,22 +357,46 @@ class ShmObjectStore:
         chunked parallel pulls): `streams` connections each move a
         disjoint byte range. Wins on multi-core hosts / fast NICs;
         degrades to ~single-stream on one core."""
-        return self._lib.shm_transfer_pull_striped(
-            self._handle, object_id, host.encode(), port, streams,
-            1 if allow_local else 0)
+        with self._op() as h:
+            if h is None:
+                return -1
+            return self._lib.shm_transfer_pull_striped(
+                h, object_id, host.encode(), port, streams,
+                1 if allow_local else 0)
 
     def push_to(self, object_id: bytes, host: str, port: int) -> int:
         """Proactively stream a LOCAL object into a remote store
         (reference push_manager.h). 0 = pushed, -5 = remote already has
         it, -2 = missing locally, <0 = failure."""
-        return self._lib.shm_transfer_push(
-            self._handle, object_id, host.encode(), port)
+        with self._op() as h:
+            if h is None:
+                return -1
+            return self._lib.shm_transfer_push(
+                h, object_id, host.encode(), port)
 
     def close(self):
         self.stop_transfer_server()
-        if self._handle:
-            self._lib.shm_store_close(self._handle)
-            self._handle = None
+        # Drain the op gate BEFORE freeing anything: a publisher mid-
+        # `put_bytes`/`contains` on another thread still holds the C
+        # handle and writes through the mapping. Flag first (new ops
+        # turn into misses), then wait for in-flight ones. If a native
+        # call wedges past the deadline (a blocking transfer pull),
+        # LEAK the handle rather than free it under a live caller —
+        # an unreclaimed segment beats a segfault.
+        with self._op_cv:
+            self._closing = True
+            deadline = 10.0
+            while self._op_inflight:
+                before = self._op_inflight
+                self._op_cv.wait(timeout=deadline)
+                if self._op_inflight >= before:
+                    break  # wedged: give up, leak below
+            drained = self._op_inflight == 0
+            handle, self._handle = self._handle, None
+        if handle and drained:
+            self._lib.shm_store_close(handle)
+        if not drained:
+            return
         # Drop this process's own mapping too: the mmap holds a dup'd
         # fd on the segment, so an unlinked store otherwise pins its
         # tmpfs pages via a "(deleted)" descriptor for the process

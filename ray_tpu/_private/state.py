@@ -103,6 +103,29 @@ class GlobalState:
                     self._store.delete("named_actors",
                                        self._named_store_key(key))
 
+    # -- multi-process head fold ----------------------------------------
+
+    def head_shard_state(self) -> dict:
+        """Whole-table control-plane view folded across every head
+        shard process (the timeline/state-merge path for a sharded
+        head): row counts per durable table plus per-shard stats.
+        Empty dict when the head runs single-process
+        (``head_shards=1``)."""
+        head = getattr(self._worker.backend, "head", None)
+        router = getattr(head, "shard_router", None) \
+            if head is not None else None
+        if router is None:
+            return {}
+        from ray_tpu._private.head_shards import DURABLE_TABLES
+
+        return {
+            "shards": router.n_shards,
+            "restarts": router.restarts,
+            "tables": {t: len(router.fold_items(t))
+                       for t in DURABLE_TABLES},
+            "per_shard": router.stats(),
+        }
+
     # -- internal KV (reference: gcs_kv_manager.h) -----------------------
 
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,

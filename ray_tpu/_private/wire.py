@@ -361,6 +361,21 @@ class ObjectDescriptor:
     size: int = 0      # sealed payload bytes (pull sizing / stats)
 
 
+@message("head.ShardRow", version=1)
+class ShardRow:
+    """One row mutation streamed to a head shard process
+    (_private/head_shards.py): coalesced per-shard into shard_apply
+    frames by the coordinator's CoalescingBatcher. ``value`` is the
+    row payload (directory address tuple, size int, lineage edge
+    bytes, ...); primitives encode natively, anything else rides
+    Opaque like Request.kwargs values."""
+
+    op: str = "put"        # "put" | "del"
+    table: str = ""
+    key: bytes = b""
+    value: Any = None
+
+
 @message("task.Call", version=1)
 class TaskCall:
     """One task submission against an interned template: only the

@@ -364,6 +364,12 @@ class _NodeRecord:
         # subtracts this. Mutations under the head lock (creations are
         # rare next to tasks); racy reads see a momentarily-stale int.
         self.reserved_milli: Dict[str, int] = {}
+        # Head-shard epoch this node last converged with: when a shard
+        # process is restarted (its open commit window lost), the head
+        # bumps its epoch and the node's next report_resources returns
+        # False ONCE — the node re-registers and re-reports its actors
+        # and owned objects, repopulating the lost window's keys.
+        self.shard_epoch = 0
 
     def reserve(self, milli: Dict[str, int]) -> None:
         sched_state.milli_add(self.reserved_milli, milli)
@@ -527,6 +533,34 @@ class ClusterHead:
         self.node_logs: Dict[str, str] = {}
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # Multi-process head control plane (head_shards > 1): the hot
+        # row tables above stay as this coordinator's in-memory working
+        # copy (read paths never pay an RPC), while every mutation ALSO
+        # streams — coalesced per shard — to the owning head shard
+        # process, which group-commits it into its own sqlite store.
+        # Lease grants additionally consult the owning shard as the
+        # registration authority (_grant_lease). Default (1) spawns
+        # nothing: today's single-process head byte-for-byte.
+        self.shard_router = None
+        self._shard_epoch = 0
+        self._shard_db_dir = ""
+        if start_server and ray_config.head_shards > 1:
+            import tempfile
+
+            from ray_tpu._private import head_shards as _head_shards
+
+            self._shard_db_dir = ray_config.head_shard_db_dir or \
+                tempfile.mkdtemp(prefix="ray_tpu_head_shards_")
+            interval = ray_config.head_shard_commit_interval_s
+            self.shard_router = _head_shards.ShardRouter(
+                ray_config.head_shards, self._shard_db_dir,
+                commit_interval_s=interval if interval > 0 else None)
+            from ray_tpu._private import health as _health
+
+            _health.register_section_provider(
+                "head_shards", self.shard_health)
+            _health.register_degraded_provider(
+                "head_shards", self._shard_degraded_reasons)
 
     # -- registration / directory ---------------------------------------
 
@@ -534,8 +568,14 @@ class ClusterHead:
                        transfer=None, shm_name=None, labels=None):
         sanitize_hooks.sched_point("head.register")
         with self._lock:
-            self.nodes[node_id] = _NodeRecord(node_id, address, resources,
-                                              transfer, shm_name, labels)
+            record = _NodeRecord(node_id, address, resources,
+                                 transfer, shm_name, labels)
+            # A (re-)registration converges with the CURRENT shard
+            # epoch: the re-reports that follow it repopulate any
+            # restarted shard's lost window, so this node owes no
+            # further re-registration for it.
+            record.shard_epoch = self._shard_epoch
+            self.nodes[node_id] = record
         self.publisher.publish("node_events", {
             "event": "NODE_ADDED", "node_id": node_id,
             "address": tuple(address)})
@@ -557,6 +597,15 @@ class ClusterHead:
             record = self.nodes.get(node_id)
             if record is None:
                 return False  # unknown: node should re-register
+            if record.shard_epoch != self._shard_epoch:
+                # A head shard process was restarted since this node
+                # last converged: its open commit window died with it.
+                # Ride the existing re-register path — the node will
+                # re-register and re-report its actors and owned
+                # objects, restoring the lost window's keys on the
+                # restarted shard.
+                record.shard_epoch = self._shard_epoch
+                return False
             record.available = dict(available)
             if backlog is not None:
                 record.backlog = int(backlog)
@@ -587,15 +636,25 @@ class ClusterHead:
         # announces died with the node; recovery owns them now.
         if self._addr_dead(addr) and not self._addr_alive(addr):
             return True
+        router = self.shard_router
         for i, oid in enumerate(oids):
             self.object_locations[oid] = addr
+            if router is not None:
+                # Mirror the directory row to its owning shard process
+                # (streamed, coalesced per shard; the shard group-
+                # commits it — per-shard durability window).
+                router.put("objects", oid, addr)
             if sizes is not None and i < len(sizes) and sizes[i]:
                 self.object_sizes[oid] = int(sizes[i])
+                if router is not None:
+                    router.put("sizes", oid, int(sizes[i]))
             # Outputs landed: the producing task is no longer in
             # flight anywhere; its arg pins drop with it.
             tid = ObjectID(oid).task_id().binary()
             entry = self.inflight.pop(tid, None)
             if entry is not None:
+                if router is not None:
+                    router.delete("inflight", tid)
                 finished.append(entry[1])
                 if entry[1].kind == TaskKind.ACTOR_TASK:
                     # Exactly-once protocol tap (rayspec): the call's
@@ -683,12 +742,21 @@ class ClusterHead:
         # hang (see mark_node_dead's poison pass). Lineage writes are
         # shard-locked only: the lease submit path stops serializing
         # on the head lock here.
+        router = self.shard_router
         if spec.kind in (TaskKind.NORMAL_TASK,
                          TaskKind.ACTOR_CREATION) or \
                 (spec.kind == TaskKind.ACTOR_TASK
                  and spec.max_retries != 0):
             for oid in spec.return_ids:
                 self.lineage[oid.binary()] = spec
+                if router is not None:
+                    # Durable lineage EDGE (oid -> creating task id):
+                    # specs are code-bearing and stay coordinator-
+                    # resident; the edge is what a failed-over head
+                    # needs to tell "reconstructable" from "lost"
+                    # before node re-reports refill the spec tables.
+                    router.put("lineage", oid.binary(),
+                               spec.task_id.binary())
         if spec.kind == TaskKind.ACTOR_CREATION:
             with self._lock:
                 key = spec.actor_id.binary()
@@ -704,6 +772,13 @@ class ClusterHead:
                                      getattr(spec, "max_restarts", 0),
                                      used=getattr(spec, "restarts_used",
                                                   0))
+            if router is not None:
+                # Durable restart budget: a failed-over head seeds a
+                # fresh gate with the CONSUMED count (ROADMAP FT gap
+                # c) even when the re-reporting node itself is gone.
+                router.put("actors", spec.actor_id.binary(),
+                           (getattr(spec, "max_restarts", 0),
+                            getattr(spec, "restarts_used", 0)))
 
     def _unreserve_creation(self, node_id: str, spec) -> None:
         record = self.nodes.get(node_id)
@@ -728,6 +803,11 @@ class ClusterHead:
                 (tid, getattr(spec, "attempt", 0)))
             sanitize_hooks.spec_op("spec.call.invoke", "ret", self, tid)
         self.inflight[tid] = (node_id, spec)
+        if self.shard_router is not None:
+            # Durable in-flight row (tid -> node): what a failed-over
+            # head re-derives the QuotaLedger's outstanding charges
+            # from, keyed to survive on the owning shard alone.
+            self.shard_router.put("inflight", tid, node_id)
         if spec.kind == TaskKind.ACTOR_CREATION:
             # Creation reservation: charge the placement against the
             # head's availability view NOW — the node's next report is
@@ -755,6 +835,8 @@ class ClusterHead:
     def clear_inflight(self, spec) -> None:
         tid = spec.task_id.binary()
         entry = self.inflight.pop(tid, None)
+        if entry is not None and self.shard_router is not None:
+            self.shard_router.delete("inflight", tid)
         if entry is not None and spec.kind == TaskKind.ACTOR_CREATION:
             self._unreserve_creation(entry[0], spec)
         frees = []
@@ -869,6 +951,7 @@ class ClusterHead:
 
         failures: Dict[str, int] = {}
         while not self._health_stop.wait(ray_config.health_check_period_s):
+            self.poll_shards()
             with self._lock:
                 records = [n for n in self.nodes.values() if n.alive]
             fresh_window = ray_config.resource_report_period_s * \
@@ -889,8 +972,96 @@ class ClusterHead:
                         self.mark_node_dead(record.node_id,
                                             reason="health check failed")
 
+    def poll_shards(self) -> list:
+        """Supervise the head shard processes: restart any crashed one
+        from its own durable db (acked rows reload) and bump the shard
+        epoch so every node's next report returns False once — the
+        re-registration path repopulates the crashed shard's lost
+        commit window. Returns the restarted shard indices."""
+        router = self.shard_router
+        if router is None:
+            return []
+        restarted = router.poll()
+        try:
+            self._shard_stats_cache = {row["index"]: row
+                                       for row in router.stats()}
+            self._fold_shard_commit_stats(self._shard_stats_cache)
+        except Exception:
+            pass
+        if restarted:
+            from ray_tpu._private.events import record_event
+
+            with self._lock:
+                self._shard_epoch += 1
+            record_event(
+                "head", f"head shard(s) {restarted} restarted; nodes "
+                f"will re-register (epoch {self._shard_epoch})",
+                severity="WARNING", shards=list(restarted))
+        return restarted
+
+    def _fold_shard_commit_stats(self, cache: dict) -> None:
+        """Fold shard-side group-commit progress into the coordinator's
+        fast-path stats so runtime_metrics exports
+        ``ray_tpu_head_shard_commit_seconds_p50/_p95{shard}``: the
+        shard processes keep their own counters, so the supervisor's
+        poll records the mean window duration of the commits completed
+        since the previous poll."""
+        from ray_tpu._private import perf_stats
+
+        last = getattr(self, "_shard_commit_seen", None)
+        if last is None:
+            last = self._shard_commit_seen = {}
+        for index, row in cache.items():
+            commits = row.get("commits")
+            if commits is None:
+                continue
+            seen_n, seen_s = last.get(index, (0, 0.0))
+            total_s = row.get("commit_seconds_total", 0.0)
+            if commits > seen_n:
+                perf_stats.latency(
+                    "head_shard_commit_seconds",
+                    {"shard": str(index)}).record(
+                        (total_s - seen_s) / (commits - seen_n))
+            last[index] = (commits, total_s)
+
+    def shard_health(self) -> list:
+        """Per-shard verdicts for /api/healthz: liveness + streamed
+        backlog read locally (the provider contract forbids RPC here),
+        merged with the shard-side stats the supervisor's last poll
+        cached (rows held, group-commit count/latency)."""
+        router = self.shard_router
+        if router is None:
+            return []
+        cache = getattr(self, "_shard_stats_cache", {})
+        out = []
+        for row in router.local_stats():
+            verdict = "ok" if row.get("alive") else "dead"
+            if row.get("alive") and row.get("backlog", 0) > 4096:
+                verdict = "backlogged"
+            merged = {"shard": row.get("index"), "verdict": verdict,
+                      "backlog": row.get("backlog", 0)}
+            cached = cache.get(row.get("index"))
+            if cached:
+                merged.update({k: cached[k] for k in
+                               ("applied", "rows", "commits",
+                                "last_commit_s") if k in cached})
+            out.append(merged)
+        return out
+
+    def _shard_degraded_reasons(self) -> list:
+        return [f"head shard {row['shard']} {row['verdict']}"
+                for row in self.shard_health()
+                if row["verdict"] != "ok"]
+
     def stop(self):
         self._health_stop.set()
+        if self.shard_router is not None:
+            from ray_tpu._private import health as _health
+
+            _health.unregister_section_provider("head_shards")
+            _health.unregister_degraded_provider("head_shards")
+            self.shard_router.close()
+            self.shard_router = None
 
     # -- node death + recovery -------------------------------------------
 
@@ -920,13 +1091,19 @@ class ClusterHead:
                     if loc == addr]
             lost_bytes = sum(self.object_sizes.get(oid, 0)
                              for oid in lost)
+            router = self.shard_router
             for oid in lost:
                 self.object_locations.pop(oid, None)
                 self.object_sizes.pop(oid, None)
+                if router is not None:
+                    router.delete("objects", oid)
+                    router.delete("sizes", oid)
             resubmit = [spec for (nid, spec) in self.inflight.values()
                         if nid == node_id]
             for spec in resubmit:
                 self.inflight.pop(spec.task_id.binary(), None)
+                if router is not None:
+                    router.delete("inflight", spec.task_id.binary())
             from ray_tpu._private.events import record_event
 
             # The death event carries the damage assessment: what the
@@ -2059,6 +2236,20 @@ class ClusterBackendMixin:
         job = getattr(spec, "job_id", "") or ""
         if not self.quota_ledger.try_acquire_lease(job):
             return None
+        router = getattr(getattr(self, "head", None),
+                         "shard_router", None)
+        if router is not None:
+            # The (job, shape) key's OWNING shard is the registration
+            # authority: the grant is recorded there (durably, group-
+            # committed) before it exists anywhere else, so one key's
+            # grants can never be tracked on two shards and a crashed
+            # shard's key range stops granting — callers queue behind
+            # their held leases or retry — until the supervisor
+            # restarts it, while every other shard keeps granting.
+            if not router.lease_register(repr(key).encode(),
+                                         target.node_id):
+                self.quota_ledger.release_lease(job)
+                return None
         request = to_milli(spec.resources)
         slots = 1
         if request:
@@ -2068,7 +2259,7 @@ class ClusterBackendMixin:
         pipe = self._node_pipe(target)
         lease = {"node_id": target.node_id, "pipe": pipe,
                  "slots": slots, "last_used": time.monotonic(),
-                 "address": target.address, "job": job}
+                 "address": target.address, "job": job, "key": key}
         self._leases.setdefault(key, []).append(lease)
         return lease
 
@@ -2090,10 +2281,17 @@ class ClusterBackendMixin:
         """Release the lease-quota charge of every retired lease (any
         removal path: idle prune, dead node, broken pipe, drain)."""
         ledger = self.quota_ledger
+        # getattr on SELF with a default: `self.head` delegates through
+        # __getattr__ to local_backend, which harness-built mixins stub.
+        router = getattr(getattr(self, "head", None),
+                         "shard_router", None)
         for lease in leases:
             job = lease.get("job")
             if job is not None:
                 ledger.release_lease(job)
+            if router is not None and lease.get("key") is not None:
+                router.lease_retire(repr(lease["key"]).encode(),
+                                    lease["node_id"])
 
     def _arg_bytes_by_addr(self, spec) -> Dict[tuple, int]:
         """Resident argument bytes per owner address, from the head's

@@ -189,6 +189,18 @@ def generate_observability_dashboard() -> dict:
                     "events/s {{node}}"),
                    ("rate(ray_tpu_obs_ship_cycles_total[1m])",
                     "cycles/s {{node}}")]},
+        # -- head shards row (PR 19): the multi-process control plane --
+        {"title": "Head shard RPC frames",
+         "exprs": [("rate(ray_tpu_head_shard_rpcs_total[1m])",
+                    "frames/s shard {{shard}}")]},
+        {"title": "Head shard stream backlog",
+         "exprs": [("ray_tpu_head_shard_queue_depth_p95",
+                    "p95 shard {{shard}}")]},
+        {"title": "Head shard group-commit", "unit": "s",
+         "exprs": [("ray_tpu_head_shard_commit_seconds_p95",
+                    "p95 shard {{shard}}"),
+                   ("ray_tpu_head_shard_commit_seconds_p50",
+                    "p50 shard {{shard}}")]},
     ], uid="ray-tpu-observability")
 
 

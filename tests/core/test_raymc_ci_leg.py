@@ -69,7 +69,7 @@ def test_raymc_leg_clean_exhaustive_and_bounded():
                             "lineage_reconstruction", "actor_restart",
                             "head_crash_recovery", "quota_admission",
                             "dep_sweep", "replica_direct",
-                            "kv_cache_reuse"}
+                            "kv_cache_reuse", "cross_shard"}
     for name, scenario in by_name.items():
         assert scenario["findings"] == [], (
             f"{name} found protocol violations in REAL code:\n"

@@ -83,3 +83,51 @@ print("child-ok")
     view = store.get_bytes(_oid(8))
     assert bytes(view) == b"from-child"
     store.release(_oid(8))
+
+
+def test_publish_vs_close_stress():
+    """Regression: `contains()`/`put_bytes` racing `close()` on another
+    thread used to dereference the freed C handle (segfault at
+    publish-vs-teardown). The op gate must turn late calls into benign
+    misses and make close() wait for in-flight ones."""
+    import threading
+
+    for round_ in range(8):
+        s = ShmObjectStore(name=f"/raytpu_pytest_gate{round_}",
+                           capacity=8 * 2**20, max_objects=64)
+        stop = threading.Event()
+        errs = []
+
+        def publisher():
+            i = 0
+            try:
+                while not stop.is_set():
+                    oid = _oid(1000 + (i % 32))
+                    s.put_bytes(oid, b"p" * 512)
+                    s.contains(oid)
+                    s.object_size(oid)
+                    s.delete(oid)
+                    i += 1
+            except BaseException as e:  # noqa: BLE001 - record, don't die
+                errs.append(e)
+
+        threads = [threading.Thread(target=publisher) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # Close while publishers are mid-flight — the old code
+        # segfaulted here (no Python exception to catch: the process
+        # died). Surviving the loop IS the assertion.
+        s.close()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errs, errs
+        # Post-close calls are benign misses, not crashes.
+        assert s.contains(_oid(1)) is False
+        assert s.put_bytes(_oid(1), b"x") is False
+        assert s.get_bytes(_oid(1)) is None
+        assert s.refcount(_oid(1)) == -1
+        try:
+            s._lib.shm_store_destroy(s.name.encode())
+        except Exception:
+            pass

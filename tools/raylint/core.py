@@ -297,13 +297,26 @@ def analyze(paths: List[str], rules: Optional[List[Rule]] = None,
             root: Optional[str] = None) -> Report:
     from tools.raylint.rules import all_rules
 
+    import gc
+
     t0 = time.monotonic()
-    files = collect_files(paths, root=root)
-    active_rules = rules if rules is not None else all_rules()
-    violations = run_rules(files, active_rules)
-    ran = {r.id for r in active_rules}
-    stale = [v for v in stale_suppressions(files, violations)
-             if v.rule in ran]
+    # Bulk ast.parse allocates millions of container objects; with the
+    # cyclic GC live, every gen2 pass rescans the host interpreter's
+    # whole heap (inside a loaded test run that's 3-4x the standalone
+    # wall time). Nothing here creates reference cycles worth chasing
+    # mid-run — pause collection for the batch, restore after.
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        files = collect_files(paths, root=root)
+        active_rules = rules if rules is not None else all_rules()
+        violations = run_rules(files, active_rules)
+        ran = {r.id for r in active_rules}
+        stale = [v for v in stale_suppressions(files, violations)
+                 if v.rule in ran]
+    finally:
+        if gc_was_enabled:
+            gc.enable()
     return Report(violations=violations, files_checked=len(files),
                   elapsed_s=time.monotonic() - t0, stale=stale)
 

@@ -364,6 +364,244 @@ class GroupCommitDurabilityScenario(Scenario):
                 pass
 
 
+# -- multi-process head: cross-shard routing + per-shard commit windows ------
+
+
+class CrossShardScenario(Scenario):
+    name = "cross_shard"
+    description = ("two head shards, writers on both key ranges, a "
+                   "committer flushing per-shard windows, one shard "
+                   "crashing at a commit boundary: rows never land on "
+                   "a foreign shard, a cap-1 lease key is never "
+                   "double-granted (even from the other shard's "
+                   "writer), and a neighbor's acked rows survive the "
+                   "victim's crash")
+    # Route crossings only: the apply body runs under the shard lock
+    # right after its route decision, so route-level interleavings
+    # already cover every observable order while keeping the space
+    # drainable inside the tier-1 leg.
+    points = ("headshard.route",)
+    # Per-shard group commit reuses the store's commit crossings: a
+    # crash there is one shard PROCESS dying mid-window, the other
+    # shard's window untouched.
+    crash_points = ("gcs.commit.before", "gcs.commit.after")
+    crash_budget = 1
+    max_steps = 30
+    # Measured exhaustive sweep: 463 schedules (~5.5s standalone); the
+    # floor leaves headroom so the tier-1 `exhausted` claim stays
+    # honest.
+    max_schedules = 2000
+    block_grace_s = 0.02
+
+    def setup(self) -> None:
+        from ray_tpu._private.gcs_storage import SqliteStoreClient
+        from ray_tpu._private.head_shards import (HeadShardState,
+                                                  InprocRouter, shard_of)
+
+        self._store_cls = SqliteStoreClient
+        self.paths = []
+        states = []
+        for i in range(2):
+            fd, path = tempfile.mkstemp(prefix=f"raymc-shard{i}-",
+                                        suffix=".db")
+            os.close(fd)
+            os.unlink(path)
+            self.paths.append(path)
+            state = HeadShardState(i, 2, db_path=path,
+                                   commit_interval_s=0)
+            # Group-commit mode without the background flusher: the
+            # committer ACTION owns every commit boundary (same trick
+            # as gcs_durability).
+            state.store._interval = 3600.0
+            states.append(state)
+        self.router = InprocRouter(2, states=states)
+
+        def key_for(shard: int, prefix: bytes) -> bytes:
+            i = 0
+            while True:
+                k = prefix + b"-%d" % i
+                if shard_of(k, 2) == shard:
+                    return k
+                i += 1
+
+        self.obj_key = {i: key_for(i, b"obj") for i in range(2)}
+        self.lease_key = key_for(0, b"lease")  # shard 0 owns the cap
+        self.accepted = {0: [], 1: []}
+        self.acked = {0: set(), 1: set()}
+        self.durable = {0: set(), 1: set()}
+        self.present = {0: set(), 1: set()}
+        self.grant_results: List[bool] = []
+        self.flushing = -1
+        self.crashed = ""
+        self.victim = -1
+        # Both directory rows are seeded here, NOT concurrently: the
+        # put-vs-commit interleaving is gcs_durability's (per-store)
+        # property, already exhausted there — re-exploring it per shard
+        # multiplies this space past the tier-1 budget. What THIS
+        # scenario owns is the cross-shard surface: the routed cap-1
+        # grant race and a crash placement inside either shard's commit
+        # window while the neighbor's rows sit acked or open.
+        for i in range(2):
+            self.router.put("objects", self.obj_key[i],
+                            ("10.0.0.%d" % i, i))
+            self.accepted[i].append(self.obj_key[i])
+        # The NEIGHBOR's window commits deterministically up front: its
+        # row is acked before any explored crash, which is exactly the
+        # precondition the neighbor-durability invariant needs. Only
+        # the victim shard's window stays open for the explorer.
+        self.flushing = 1
+        self.router.shards[1].store.flush()
+        self.acked[1].update(self.accepted[1])
+        self.flushing = -1
+
+    def actions(self):
+        def grantor(node):
+            # Writers on BOTH shards' ranges contend for the SAME cap-1
+            # key: writer-b's attempt must cross shards to shard 0's
+            # single authority — the admission decision the tentpole
+            # moved OUT of the coordinator's memory.
+            def body():
+                try:
+                    ok = self.router.lease_register(self.lease_key,
+                                                    node, cap=1)
+                except Exception:
+                    ok = False
+                self.grant_results.append(ok)
+            return body
+
+        def committer():
+            # The victim shard's group-commit window: a crash at either
+            # commit crossing is shard 0's process dying mid-window —
+            # shard 1's acked rows (committed in setup) must survive it.
+            self.flushing = 0
+            snap = list(self.accepted[0])
+            try:
+                self.router.shards[0].store.flush()
+            except Exception:
+                return  # the shard crashed mid-window
+            self.acked[0].update(snap)
+
+        return [("writer-a", grantor("node-a")),
+                ("writer-b", grantor("node-b")),
+                ("committer", committer)]
+
+    def independent(self, a, b) -> bool:
+        if a[0] == b[0] or a[3] or b[3]:
+            return False
+        # A writer's start transition is PURE — the segment before its
+        # first route crossing executes nothing (router.put's crossing
+        # is its first statement), so it commutes with every other
+        # thread (same argument as gcs_durability's writers). Route
+        # crossings themselves keep full conflicts: the two writers
+        # share shard 0's lease authority.
+        if a[1].startswith("mc.start.writer") \
+                or b[1].startswith("mc.start.writer"):
+            return True
+        return super().independent(a, b)
+
+    def on_point(self, point: str, role: str) -> None:
+        if point == "gcs.commit.after" and self.flushing >= 0:
+            self.durable[self.flushing] = set(
+                self.accepted[self.flushing])
+
+    def on_crash(self, point: str) -> None:
+        victim = self.flushing if self.flushing >= 0 else 0
+        store = self.router.shards[victim].store
+        try:
+            # One shard process dies: its connection drops with the
+            # window open (sqlite rolls back). Under the store lock —
+            # same use-after-free discipline as gcs_durability.
+            with store._lock:
+                store._conn.close()
+        except Exception:
+            pass
+        # Read BOTH shards' dbs through fresh connections: what a
+        # restarted shard (and the untouched neighbor) would reload.
+        for i in range(2):
+            survivor = self._store_cls(self.paths[i],
+                                       commit_interval_s=0)
+            try:
+                self.present[i] = {k for k, _ in
+                                   survivor.get_all("objects")}
+            finally:
+                survivor.close()
+        self.victim = victim
+        self.crashed = point  # LAST: invariants key off it
+
+    def invariants(self):
+        def ownership(s):
+            for state in s.router.shards:
+                for table in ("objects", "lease"):
+                    for key in state.tables[table]:
+                        if not state.owns(key):
+                            return (f"shard {state.index} holds "
+                                    f"foreign key {key!r} in {table}")
+            return True
+
+        def single_grant(s):
+            wins = sum(1 for ok in s.grant_results if ok)
+            if wins > 1:
+                return (f"cap-1 lease key granted {wins} times across "
+                        f"shards")
+            if not s.crashed:
+                grants = [n for state in s.router.shards
+                          for n in state.tables["lease"].get(
+                              s.lease_key, ())]
+                if len(grants) > 1:
+                    return f"duplicate grant rows: {grants}"
+            return True
+
+        def neighbor_durability(s):
+            if not s.crashed:
+                return True
+            other = 1 - s.victim
+            lost = s.acked[other] - s.present[other]
+            return (not lost
+                    or f"neighbor shard {other} lost acked rows "
+                       f"{sorted(lost)} to shard {s.victim}'s crash")
+
+        def victim_loss_bound(s):
+            if not s.crashed:
+                return True
+            lost = s.acked[s.victim] - s.present[s.victim]
+            ghosts = s.present[s.victim] - s.durable[s.victim]
+            if lost:
+                return (f"victim shard {s.victim} lost ACKED rows "
+                        f"{sorted(lost)} (loss must stay inside the "
+                        f"open window)")
+            return (not ghosts
+                    or f"unflushed rows resurrected on shard "
+                       f"{s.victim}: {sorted(ghosts)}")
+
+        return [
+            Invariant("shard-single-ownership", ownership,
+                      description="rows live only on the owning shard"),
+            Invariant("shard-single-grant", single_grant,
+                      description="cap-1 key never double-granted "
+                                  "across shards"),
+            Invariant("shard-neighbor-durability", neighbor_durability,
+                      description="one shard's crash never loses a "
+                                  "neighbor's acked rows"),
+            Invariant("shard-victim-loss-bound", victim_loss_bound,
+                      description="victim loses at most its open "
+                                  "commit window, nothing acked"),
+        ]
+
+    def teardown(self) -> None:
+        try:
+            for i, state in enumerate(self.router.shards):
+                if not (self.crashed and i == self.victim):
+                    state.close()
+        except Exception:
+            pass
+        for path in self.paths:
+            for suffix in ("", "-wal", "-shm"):
+                try:
+                    os.unlink(path + suffix)
+                except OSError:
+                    pass
+
+
 # -- exactly-once resubmit across connection death ---------------------------
 
 
@@ -1926,7 +2164,7 @@ class HeadCrashRecoveryScenario(Scenario):
 SCENARIOS = {
     cls.name: cls
     for cls in (RouterCapScenario, PipelinedCloseScenario,
-                GroupCommitDurabilityScenario,
+                GroupCommitDurabilityScenario, CrossShardScenario,
                 ExactlyOnceResubmitScenario, LongPollRecoveryScenario,
                 SpillRaceScenario, LineageReconstructionScenario,
                 ActorRestartScenario, HeadCrashRecoveryScenario,
@@ -1943,7 +2181,7 @@ SCENARIOS = {
 # scan) up for the rest of the leg (run order matters — cheap
 # scenarios first).
 DEFAULT_SCENARIOS = ("dep_sweep", "kv_cache_reuse", "quota_admission",
-                     "replica_direct", "router_cap",
+                     "cross_shard", "replica_direct", "router_cap",
                      "gcs_durability", "pipelined_close", "spill_race",
                      "lineage_reconstruction", "actor_restart",
                      "head_crash_recovery")
