@@ -91,6 +91,13 @@ class RayTpuConfig:
     # -- rpc -------------------------------------------------------------
     rpc_connect_retries: int = 10
     rpc_retry_backoff_s: float = 0.5
+    # Pre-allocation bound on one framed RPC message. The u32 length
+    # prefix admits 4 GiB; without this cap the frame reader would
+    # allocate whatever a hostile or skewed peer claims BEFORE any
+    # byte of the body is validated. Over-cap frames raise
+    # rpc.FrameTooLarge and drop the connection (the stream cannot be
+    # resynchronized without reading the unread body).
+    rpc_max_frame_bytes: int = 64 * 1024 * 1024
     # Mutual-TLS for the control plane (reference: RAY_USE_TLS +
     # RAY_TLS_SERVER_CERT/KEY/CA_CERT, rpc/grpc_server TLS creds). All
     # three paths must be set when use_tls is on; both sides verify the

@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import perf_stats as _perf_stats
 from ray_tpu._private import sanitize_hooks
+from ray_tpu._private import wire
 from ray_tpu._private.sched_state import stable_shard_of
 
 # Tables persisted to the shard's sqlite store (group-committed).
@@ -117,17 +118,49 @@ class HeadShardState:
                     op, table, key, value = (item.op, item.table,
                                              item.key, item.value)
                 else:
-                    op, table, key, value = item
+                    # A skewed peer can hand this rpc method ANY
+                    # decodable value, not just row tuples — found by
+                    # raywire fuzzing (TypeError unpacking a Request
+                    # that arrived on the shard_apply seam).
+                    try:
+                        op, table, key, value = item
+                    except (TypeError, ValueError):
+                        raise wire.WireError(
+                            "shard frame item is neither a ShardRow "
+                            "nor an (op, table, key, value) row: "
+                            f"{type(item).__name__}") from None
                 sanitize_hooks.sched_point("headshard.apply")
-                rows = self.tables[table]
+                # Frames cross a version boundary during rolling
+                # restarts, so every field a row names is validated
+                # here and rejected TYPED: a skewed coordinator must
+                # degrade to an error reply at the rpc boundary, not a
+                # KeyError killing the shard's connection thread — and
+                # an op this shard doesn't know must never fall into
+                # the delete branch (silently destroying the row a
+                # newer op meant to transform). Items before the bad
+                # row stay applied; put/del are idempotent, so the
+                # coordinator's retry after repair re-applies safely.
+                rows = self.tables.get(table)
+                if rows is None:
+                    raise wire.WireError(
+                        f"shard frame names unknown table {table!r}; "
+                        f"known: {', '.join(DURABLE_TABLES)}")
+                if not isinstance(key, bytes):
+                    raise wire.WireError(
+                        f"shard frame key must be bytes, got "
+                        f"{type(key).__name__}")
                 if op == "put":
                     rows[key] = value
                     if self.store is not None:
                         self.store.put(table, key, pickle.dumps(value))
-                else:
+                elif op == "del":
                     rows.pop(key, None)
                     if self.store is not None:
                         self.store.delete(table, key)
+                else:
+                    raise wire.WireError(
+                        f"shard frame has unknown op {op!r} "
+                        "(known: put, del)")
                 self.applied += 1
         return len(items)
 

@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import sanitize_hooks, wire
+from ray_tpu._private.config import ray_config
 
 # Cap on the server-side TLS handshake so one stalled/half-open peer can
 # only pin its own connection thread, never the accept loop.
@@ -76,6 +77,14 @@ def routable_host(peer_address: Tuple[str, int]) -> str:
         return "127.0.0.1"
 
 
+class FrameTooLarge(wire.WireError):
+    """Frame length prefix exceeds ``rpc_max_frame_bytes``. Raised
+    BEFORE the body is read or its buffer allocated; the stream cannot
+    be resynchronized past the unread body, so the connection must be
+    dropped (unlike other :class:`wire.WireError` rejections, which
+    leave the frame boundary intact)."""
+
+
 def send_msg(sock: socket.socket, obj: Any) -> None:
     payload = wire.encode(obj)
     sock.sendall(_LEN.pack(len(payload)) + payload)
@@ -84,6 +93,11 @@ def send_msg(sock: socket.socket, obj: Any) -> None:
 def recv_msg(sock: socket.socket) -> Any:
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
+    cap = ray_config.rpc_max_frame_bytes
+    if length > cap:
+        raise FrameTooLarge(
+            f"frame of {length} bytes exceeds rpc_max_frame_bytes="
+            f"{cap}")
     return wire.decode(_recv_exact(sock, length))
 
 
@@ -152,10 +166,34 @@ class RpcServer:
                 while True:
                     try:
                         msg = recv_msg(self.request)
+                    except FrameTooLarge as e:
+                        # The body was never read: the stream is
+                        # desynced, so reject loudly and drop the
+                        # connection (best-effort reply — the peer may
+                        # be gone already).
+                        self._reject(str(e))
+                        return
+                    except wire.WireError as e:
+                        # The frame was length-delimited and fully
+                        # consumed before decode failed, so the stream
+                        # is still aligned: a skewed peer (unknown
+                        # message type, future schema version,
+                        # malformed body) degrades to a clean
+                        # per-message rejection, never a dead
+                        # connection.
+                        if not self._reject(str(e)):
+                            return
+                        continue
                     except (ConnectionError, OSError):
                         return
                     if not isinstance(msg, wire.Request):
-                        return  # typed-envelope violation: drop peer
+                        # Typed-envelope violation: same frame-aligned
+                        # rejection as a decode failure above.
+                        if not self._reject(
+                                "expected rpc.Request envelope, got "
+                                + type(msg).__name__):
+                            return
+                        continue
                     rid = msg.id or None
                     if msg.method not in server_self.dedupe_methods:
                         rid = None
@@ -210,6 +248,16 @@ class RpcServer:
                         send_msg(self.request, reply)
                     except (ConnectionError, OSError):
                         return
+
+            def _reject(self, detail: str) -> bool:
+                """Send the typed wire-rejection reply; False = the
+                peer is unreachable (caller should stop serving)."""
+                try:
+                    send_msg(self.request,
+                             wire.Reply(ok=False, error=f"wire: {detail}"))
+                    return True
+                except (ConnectionError, OSError):
+                    return False
 
             def finish(self):
                 with server_self._conns_lock:
@@ -408,6 +456,14 @@ class RpcClient:
                                                 kwargs=kwargs))
                     reply = recv_msg(sock)  # raylint: disable=R2 -- see above: reply must be read under the same hold that sent the request (TCP ordering is the match)
                     break
+                except wire.WireError as e:
+                    # Off-protocol reply frame: drop the socket and
+                    # surface typed — never a silent retry (the
+                    # request may have executed).
+                    self.close_locked()
+                    raise RemoteCallError(
+                        f"{method} on {self.address}: malformed "
+                        f"reply: {e}") from None
                 except (ConnectionError, OSError):
                     self.close_locked()
                     if attempt:
@@ -433,6 +489,12 @@ class RpcClient:
                                                 kwargs=kwargs))
                     reply = recv_msg(sock)  # raylint: disable=R2 -- see above: reply must be read under the same hold that sent the request (TCP ordering is the match)
                     break
+                except wire.WireError as e:
+                    # Same typed rejection as call_with_rid above.
+                    self.close_locked()
+                    raise RemoteCallError(
+                        f"{method} on {self.address}: malformed "
+                        f"reply: {e}") from None
                 except (ConnectionError, OSError):
                     self.close_locked()
                     if attempt:
@@ -746,6 +808,14 @@ class PipelinedClient:
                 break
             try:
                 reply = recv_msg(sock)
+            except wire.WireError:
+                # Malformed or oversized reply frame: the reader can
+                # no longer trust the stream — tear down exactly like
+                # a connection loss so every pending request surfaces
+                # through on_error, instead of the reader thread dying
+                # on the untyped escape with the orphans parked
+                # forever.
+                break
             except (ConnectionError, OSError):
                 break
             with self._pending_lock:

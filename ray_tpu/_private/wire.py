@@ -34,6 +34,13 @@ _I64 = struct.Struct("!q")
 _F64 = struct.Struct("!d")
 _U16 = struct.Struct("!H")
 
+# Container-nesting bound on decode. Real control messages nest a
+# handful of levels (envelope → kwargs → values); a hostile frame of
+# repeated list headers would otherwise drive the recursive decoder
+# into RecursionError — an untyped escape that kills the connection
+# thread instead of producing a clean typed rejection.
+_MAX_DEPTH = 64
+
 
 class WireError(ValueError):
     pass
@@ -206,10 +213,19 @@ def _tags_of(raw: bytes) -> bytes:
 
 
 class _Decoder:
+    """Recursive-descent decoder over one received frame.
+
+    Contract (the raywire fuzzer enforces it): ANY byte sequence either
+    decodes or raises :class:`WireError` — no other exception type may
+    escape, time is O(len(raw)), and nothing allocates beyond the bytes
+    already received (every length field bounds-checks against the
+    remaining buffer in ``_take`` before it is trusted)."""
+
     def __init__(self, raw: bytes, *, allow_opaque: bool = True,
                  collect: bytearray = None):
         self.raw = raw
         self.pos = 0
+        self.depth = 0
         self.allow_opaque = allow_opaque
         self.collect = collect
 
@@ -222,7 +238,17 @@ class _Decoder:
 
     def _str(self) -> str:
         (n,) = _U32.unpack(self._take(4))
-        return self._take(n).decode()
+        raw = self._take(n)
+        try:
+            return raw.decode()
+        except UnicodeDecodeError as e:
+            raise WireError(f"invalid utf-8 in string: {e}") from None
+
+    def _enter(self) -> None:
+        self.depth += 1
+        if self.depth > _MAX_DEPTH:
+            raise WireError(
+                f"container nesting exceeds {_MAX_DEPTH} levels")
 
     def value(self) -> Any:
         tag = self._take(1)
@@ -237,7 +263,14 @@ class _Decoder:
         if tag == b"i":
             return _I64.unpack(self._take(8))[0]
         if tag == b"I":
-            return int(self._str())
+            lit = self._str()
+            try:
+                return int(lit)
+            except ValueError:
+                # int() raises plain ValueError — WireError's BASE, so
+                # a `except WireError` caller would NOT catch it.
+                raise WireError(
+                    f"malformed bignum literal {lit[:32]!r}") from None
         if tag == b"d":
             return _F64.unpack(self._take(8))[0]
         if tag == b"s":
@@ -247,11 +280,25 @@ class _Decoder:
             return self._take(n)
         if tag in (b"l", b"t"):
             (n,) = _U32.unpack(self._take(4))
+            self._enter()
             items = [self.value() for _ in range(n)]
+            self.depth -= 1
             return items if tag == b"l" else tuple(items)
         if tag == b"m":
             (n,) = _U32.unpack(self._take(4))
-            return {self.value(): self.value() for _ in range(n)}
+            self._enter()
+            out = {}
+            for _ in range(n):
+                key = self.value()
+                val = self.value()
+                try:
+                    out[key] = val
+                except TypeError:
+                    raise WireError(
+                        "unhashable map key of type "
+                        f"{type(key).__name__}") from None
+            self.depth -= 1
+            return out
         if tag == b"O":
             (n,) = _U32.unpack(self._take(4))
             raw = self._take(n)
@@ -259,8 +306,18 @@ class _Decoder:
                 return None  # structural walk: don't unpickle
             if not self.allow_opaque:
                 raise WireError("opaque payload rejected by receiver")
-            return pickle.loads(raw)
+            try:
+                return pickle.loads(raw)
+            except Exception as e:
+                # Corrupt/hostile opaque sections raise the whole
+                # pickle exception zoo (UnpicklingError, EOFError,
+                # AttributeError, ImportError, ...): fold them into the
+                # typed rejection so transports need exactly one catch.
+                raise WireError(
+                    "opaque payload failed to unpickle: "
+                    f"{type(e).__name__}: {e}") from None
         if tag == b"M":
+            self._enter()
             name = self._str()
             (version,) = _U16.unpack(self._take(2))
             (nfields,) = _U16.unpack(self._take(2))
@@ -287,7 +344,14 @@ class _Decoder:
                     continue  # older receiver: skip newer fields
                 _check_field(cls, fname, entry, fval)
                 clean[fname] = fval
-            return cls(**clean)
+            self.depth -= 1
+            try:
+                return cls(**clean)
+            except TypeError as e:
+                # A frame omitting a field the receiver declares with
+                # no default (schema skew the compat gate classifies as
+                # breaking) must still reject as a typed wire failure.
+                raise WireError(f"{name}: {e}") from None
         raise WireError(f"bad wire tag {tag!r}")
 
 

@@ -40,9 +40,20 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
+# Sanity bound on one worker-pipe frame. The pipe is a parent↔child
+# socketpair on one host, but a corrupted length prefix must fail typed
+# (the callers' EOFError path) BEFORE the reader allocates what the
+# 8-byte prefix claims — up to 16 EiB.
+_MAX_FRAME_BYTES = 1 << 31
+
+
 def _recv_frame(sock: socket.socket) -> Any:
     header = _recv_exact(sock, 8)
     (n,) = struct.unpack("<Q", header)
+    if n > _MAX_FRAME_BYTES:
+        raise EOFError(
+            f"worker frame of {n} bytes exceeds the {_MAX_FRAME_BYTES}"
+            " sanity bound (corrupt pipe?)")
     return pickle.loads(_recv_exact(sock, n))
 
 

@@ -377,6 +377,11 @@ def _masked_crc(data: bytes) -> int:
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 
+# Upper bound on one TFRecord: the u64 length prefix of an untrusted
+# file must never size an allocation unchecked.
+_MAX_TFRECORD_BYTES = 1 << 31
+
+
 class TFRecordDatasource(FileDatasource):
     """Raw TFRecord records as a `bytes` column (reference
     `datasource/tfrecords_datasource.py`; tf.train.Example decoding is
@@ -401,6 +406,13 @@ class TFRecordDatasource(FileDatasource):
                 (len_crc,) = st.unpack("<I", header[8:12])
                 if validate and _masked_crc(header[:8]) != len_crc:
                     raise ValueError(f"bad length crc in {path}")
+                if length > _MAX_TFRECORD_BYTES:
+                    # The u64 prefix of a corrupt/hostile file must
+                    # not size the read() allocation (the crc guard
+                    # above is skippable via validate_crc=False).
+                    raise ValueError(
+                        f"TFRecord of {length} bytes in {path} "
+                        f"exceeds the {_MAX_TFRECORD_BYTES} bound")
                 data = f.read(length)
                 (data_crc,) = st.unpack("<I", f.read(4))
                 if validate and _masked_crc(data) != data_crc:

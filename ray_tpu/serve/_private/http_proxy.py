@@ -296,11 +296,22 @@ class _Conn(asyncio.Protocol):
                 self._fail_parse(400, b'{"error": "bad request"}')
                 return
             headers: Dict[str, str] = {}
+            cl_values = set()
             for ln in lines[1:]:
                 k, _, v = ln.partition(b":")
-                headers[k.strip().lower().decode("latin-1")] = \
-                    v.strip().decode("latin-1")
+                key = k.strip().lower().decode("latin-1")
+                headers[key] = v.strip().decode("latin-1")
+                if key == "content-length":
+                    cl_values.add(headers[key])
             req.headers = headers
+            if len(cl_values) > 1:
+                # Conflicting duplicate content-lengths: last-wins here
+                # vs first-wins at a front proxy is exactly the framing
+                # disagreement smuggling exploits (RFC 9110 §8.6 allows
+                # duplicates only when identical): hard 400.
+                self._fail_parse(400, b'{"error": "conflicting '
+                                 b'content-length"}')
+                return
             conn_hdr = headers.get("connection", "").lower()
             if req.version == "HTTP/1.0":
                 req.keep_alive = "keep-alive" in conn_hdr
@@ -314,10 +325,16 @@ class _Conn(asyncio.Protocol):
                 self.backlog.append(req)
                 self._halt_parse = True
                 return
-            try:
-                length = int(headers.get("content-length") or 0)
-            except ValueError:
-                length = -1
+            cl = headers.get("content-length", "")
+            if cl:
+                # RFC 9110: the value is DIGITs only. Bare int() is
+                # laxer ("+5", " 5 ", "1_0", non-ASCII decimal digits)
+                # and any laxity mismatch with a stricter front proxy
+                # is a smuggling vector, so validate before parsing.
+                length = int(cl) if cl.isascii() and cl.isdigit() \
+                    else -1
+            else:
+                length = 0
             if length < 0:
                 # A negative length would make the body slice swallow
                 # pipelined successors (request smuggling): hard 400.

@@ -982,3 +982,116 @@ def test_r9_cross_file_coverage_halves():
     vs = [v for v in run_rules([product_fi], [rule])
           if not v.suppressed]
     assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# R10 length-before-allocation
+# ---------------------------------------------------------------------------
+
+
+def test_r10_unguarded_exact_read_flagged():
+    vs = active(lint("""
+        import struct
+
+        _LEN = struct.Struct("!I")
+
+
+        def recv_msg(sock):
+            (length,) = _LEN.unpack(_recv_exact(sock, 4))
+            return _recv_exact(sock, length)
+    """, ["R10"]))
+    assert len(vs) == 1 and vs[0].rule == "R10"
+    assert "decoded off the wire" in vs[0].message
+    assert "a peer controls this allocation" in vs[0].message
+
+
+def test_r10_indexed_unpack_flagged():
+    # The canonical one-liner idiom binds through a Subscript, not the
+    # bare Call — the taint must see through the [0].
+    vs = active(lint("""
+        import struct
+
+
+        def read_frame(sock):
+            n = struct.unpack("!I", _recv_exact(sock, 4))[0]
+            return _recv_exact(sock, n)
+    """, ["R10"]))
+    assert len(vs) == 1 and vs[0].rule == "R10"
+    assert "`n`" in vs[0].message
+
+
+def test_r10_guarded_read_clean():
+    vs = active(lint("""
+        import struct
+
+        _LEN = struct.Struct("!I")
+
+
+        def recv_msg(sock, cap):
+            (length,) = _LEN.unpack(_recv_exact(sock, 4))
+            if length > cap:
+                raise ValueError("frame too large")
+            return _recv_exact(sock, length)
+    """, ["R10"]))
+    assert vs == []
+
+
+def test_r10_from_bytes_into_read_flagged():
+    vs = active(lint("""
+        def read_record(f):
+            n = int.from_bytes(f.read(8), "big")
+            return f.read(n)
+    """, ["R10"]))
+    assert len(vs) == 1
+    assert "`read()`" in vs[0].message
+
+
+def test_r10_multiplied_allocation_flagged():
+    vs = active(lint("""
+        import struct
+
+
+        def slab(sock):
+            count, = struct.unpack("!I", sock.recv(4))
+            return bytearray(count * 8)
+    """, ["R10"]))
+    assert len(vs) == 1
+    assert "multiplied allocation" in vs[0].message
+
+
+def test_r10_bytearray_after_compare_clean():
+    vs = active(lint("""
+        def read_record(f, limit):
+            n = int.from_bytes(f.read(8), "big")
+            if n >= limit:
+                raise ValueError("record too large")
+            return bytearray(n)
+    """, ["R10"]))
+    assert vs == []
+
+
+def test_r10_outside_package_exempt():
+    # Same unguarded source, but in tools/ (fi.package is None): the
+    # rule only patrols product code.
+    vs = active(lint("""
+        import struct
+
+
+        def recv_msg(sock):
+            (length,) = struct.unpack("!I", sock.recv(4))
+            return sock.recv(length)
+    """, ["R10"], module="tools.fixture_mod",
+        relpath="tools/fixture_mod.py"))
+    assert vs == []
+
+
+def test_r10_suppression_with_justification_honored():
+    vs = lint("""
+        import struct
+
+
+        def recv_msg(sock):
+            (length,) = struct.unpack("!I", sock.recv(4))
+            return sock.recv(length)  # raylint: disable=R10 -- trusted local pipe, bounded by the writer
+    """, ["R10"])
+    assert len(vs) == 1 and vs[0].suppressed

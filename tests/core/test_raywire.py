@@ -1,0 +1,340 @@
+"""raywire unit contracts: extraction, compat classification, the
+version-bump + migration-note gate, skew simulation, fuzz drivers, and
+the minimized fixture corpus replay.
+
+The CI leg (``test_raywire_ci_leg.py``) proves the rung runs green
+end-to-end; these tests prove each stage would actually catch the
+defect class it exists for — a gate that passes everything is
+indistinguishable from a gate that works, until someone reorders a
+frame's fields.
+"""
+
+import copy
+import os
+import random
+import struct
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:  # `tools` must resolve from the repo root
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from ray_tpu._private import wire  # noqa: E402
+from tools.raywire import compat, extract, fixtures, fuzz, gen  # noqa: E402
+
+_U32 = struct.Struct("!I")
+
+
+@pytest.fixture(scope="module")
+def extraction():
+    return extract.extract(REPO_ROOT)
+
+
+@pytest.fixture(scope="module")
+def schema(extraction):
+    return extraction.schema
+
+
+# -- extraction -------------------------------------------------------------
+
+
+def test_extraction_clean_and_complete(extraction):
+    assert extraction.problems == []
+    assert set(extraction.schema["messages"]) == set(wire._REGISTRY)
+    frame = extraction.schema["frame"]
+    assert frame["max_depth"] == wire._MAX_DEPTH
+    # Encoder and decoder agree on the tag alphabet, and every tag is
+    # in the rendered grammar.
+    assert set("NTFiIdsbltmMO") <= set(frame["tags"])
+
+
+def test_extraction_catches_ast_live_drift(schema, monkeypatch):
+    # A message registered behind the AST's back (monkeypatched into
+    # the live registry) must surface as an extraction problem.
+    monkeypatch.setitem(wire._REGISTRY, "ghost.Message",
+                        (wire.Reply, 1))
+    ex = extract.extract(REPO_ROOT)
+    assert any("ghost.Message" in p and "dynamic registration" in p
+               for p in ex.problems)
+
+
+def test_migration_note_grammar():
+    m = extract.MIGRATION_RE.search(
+        "# raywire: migration=rpc.Request -- method retired, "
+        "see head_shards rollout notes")
+    assert m and m.group(1) == "rpc.Request"
+    assert m.group("why").startswith("method retired")
+    assert extract.MIGRATION_RE.search("# raywire: migration=x") is None
+
+
+def test_render_schema_is_canonical(schema):
+    assert extract.render_schema(schema) \
+        == extract.render_schema(copy.deepcopy(schema))
+    assert extract.render_schema(schema).endswith("\n")
+
+
+def test_committed_baseline_matches_live_code(schema):
+    # The gate is only as good as the baseline's freshness: the
+    # committed RAYWIRE_SCHEMA.json must equal what extraction produces
+    # from the checked-out wire.py (regenerate with --write-baseline
+    # after any sanctioned schema change).
+    baseline = extract.load_baseline(
+        os.path.join(REPO_ROOT, "RAYWIRE_SCHEMA.json"))
+    assert baseline is not None, "RAYWIRE_SCHEMA.json missing"
+    assert baseline == schema, (
+        "committed baseline drifted from wire.py — run "
+        "`python -m tools.raywire --write-baseline` (the gate must "
+        "approve the diff first)")
+
+
+# -- compat classification + gate -------------------------------------------
+
+
+def _mutated(schema, message, fn):
+    new = copy.deepcopy(schema)
+    fn(new["messages"][message])
+    return new
+
+
+def test_identical_schemas_gate_clean(schema, extraction):
+    gate = compat.run_gate(schema, schema, extraction.migration_notes)
+    assert gate.ok and not gate.changes
+    for result in gate.skew.values():
+        assert result["classified"] == "compatible"
+        assert result["old_to_new"]["ok"]
+        assert result["new_to_old"]["ok"]
+        assert result["byte_identity"]
+
+
+def test_field_append_with_default_is_compatible(schema):
+    new = _mutated(schema, "rpc.Reply", lambda m: m["fields"].append(
+        {"name": "trace", "type": "str", "has_default": True}))
+    gate = compat.run_gate(schema, new, {})
+    assert gate.ok
+    kinds = {c.kind for c in gate.changes}
+    assert kinds == {"field_appended"}
+    # Old receivers drop the appended field — visible, not fatal.
+    assert gate.skew["rpc.Reply"]["new_to_old"]["skipped"] == ["trace"]
+
+
+def test_new_message_is_compatible(schema):
+    new = copy.deepcopy(schema)
+    new["messages"]["task.Cancel"] = {
+        "version": 1, "class": "TaskCancel",
+        "fields": [{"name": "task_id", "type": "bytes",
+                    "has_default": False}]}
+    gate = compat.run_gate(schema, new, {})
+    assert gate.ok
+    assert {c.kind for c in gate.changes} == {"message_added"}
+
+
+@pytest.mark.parametrize("kind,mutate", [
+    ("field_removed", lambda m: m["fields"].pop(1)),
+    ("field_type_changed",
+     lambda m: m["fields"][0].__setitem__("type", "bytes")),
+    ("field_appended_no_default", lambda m: m["fields"].append(
+        {"name": "extra", "type": "int", "has_default": False})),
+    ("field_reordered",
+     lambda m: m["fields"].reverse()),
+])
+def test_breaking_changes_fail_without_bump(schema, kind, mutate):
+    new = _mutated(schema, "rpc.Request", mutate)
+    gate = compat.run_gate(schema, new, {})
+    assert not gate.ok
+    assert kind in {c.kind for c in gate.changes if c.breaking}
+    assert any("version bump" in f for f in gate.failures)
+
+
+def test_rename_reported_as_one_breaking_change(schema):
+    def mutate(m):
+        m["fields"][0]["name"] = "request_id"
+    new = _mutated(schema, "rpc.Request", mutate)
+    gate = compat.run_gate(schema, new, {})
+    assert not gate.ok
+    assert "field_renamed" in {c.kind for c in gate.changes}
+
+
+def test_message_removed_is_breaking(schema):
+    new = copy.deepcopy(schema)
+    del new["messages"]["task.Call"]
+    gate = compat.run_gate(schema, new, {})
+    assert not gate.ok
+    assert {c.kind for c in gate.changes} == {"message_removed"}
+
+
+def test_version_bump_plus_migration_note_passes(schema):
+    def mutate(m):
+        m["fields"].pop(1)
+        m["version"] += 1
+    new = _mutated(schema, "rpc.Request", mutate)
+    # Bump without the note: still fails, naming what's missing.
+    gate = compat.run_gate(schema, new, {})
+    assert not gate.ok
+    assert any("no justified migration note" in f
+               for f in gate.failures)
+    # Bump + note: sanctioned.
+    gate = compat.run_gate(
+        schema, new,
+        {"rpc.Request": "field retired with the v2 envelope"})
+    assert gate.ok
+    assert any(c.kind == "version_changed" for c in gate.changes)
+
+
+def test_skew_simulator_proves_type_change_empirically(schema):
+    new = _mutated(
+        schema, "node.ResourceReport",
+        lambda m: m["fields"][0].__setitem__("type", "int"))
+    gate = compat.run_gate(schema, new, {})
+    skew = gate.skew["node.ResourceReport"]
+    assert skew["classified"] == "breaking"
+    assert not skew["new_to_old"]["ok"]
+    assert "expected" in skew["new_to_old"]["error"]
+
+
+def test_skew_simulator_detects_reorder_byte_divergence(schema):
+    new = _mutated(schema, "task.Template",
+                   lambda m: m["fields"].reverse())
+    gate = compat.run_gate(schema, new, {})
+    assert gate.skew["task.Template"]["byte_identity"] is False
+
+
+def test_compatible_classification_with_observed_failure_fails_gate(
+        schema, monkeypatch):
+    # Defense in depth: even if the diff logic mislabels a change as
+    # compatible, an observed skew decode failure still fails the
+    # gate. Force the blind spot by neutering BREAKING classification.
+    new = _mutated(schema, "rpc.Reply", lambda m: m["fields"].append(
+        {"name": "extra", "type": "int", "has_default": False}))
+    monkeypatch.setattr(
+        compat, "diff_schemas", lambda old, new_: [])
+    gate = compat.run_gate(schema, new, {})
+    assert not gate.ok
+    assert any("classified compatible but the skew simulator"
+               in f for f in gate.failures)
+
+
+# -- fuzz drivers + minimization --------------------------------------------
+
+
+def test_fuzz_clean_small_campaign(schema):
+    report = fuzz.run_fuzz(schema, n_inputs=1500, seed=7)
+    assert report["findings"] == []
+    assert report["slow"] == []
+    assert all(p["ok"] for p in report["alloc_probes"])
+    # Every target and mutator actually participated.
+    assert all(n > 0 for n in report["per_target"].values())
+    assert all(n > 0 for n in report["per_mutator"].values())
+
+
+def test_fuzz_campaign_is_deterministic(schema):
+    a = fuzz.run_fuzz(schema, n_inputs=300, seed=3)
+    b = fuzz.run_fuzz(schema, n_inputs=300, seed=3)
+    assert a["per_mutator"] == b["per_mutator"]
+    assert a["findings"] == b["findings"]
+
+
+def test_alloc_probes_bound_peak_memory():
+    for probe in fuzz.run_alloc_probes():
+        assert probe["ok"], (
+            f"{probe['probe']} peaked at {probe['peak_bytes']}B — a "
+            f"4-byte header bought a real allocation")
+
+
+def test_fuzzer_catches_a_seeded_decoder_regression(schema,
+                                                    monkeypatch):
+    # The campaign must actually be able to see a crash: re-open the
+    # historical utf-8 hole and the same seeds must surface it.
+    def leaky(self):
+        n, = wire._U32.unpack_from(self.raw, self.pos)
+        self.pos += 4
+        return self._take(n).decode()    # undoes the WireError wrap
+
+    monkeypatch.setattr(wire._Decoder, "_str", leaky)
+    report = fuzz.run_fuzz(schema, n_inputs=2000, seed=11)
+    assert any(f["exc_type"] == "UnicodeDecodeError"
+               for f in report["findings"])
+
+
+def test_minimizer_shrinks_reproducer():
+    # A bad tag buried in a long valid prefix minimizes to (nearly)
+    # just the crashing byte.
+    from ray_tpu._private import wire as w
+
+    def drive(data):
+        w.decode(data)
+
+    payload = w.encode([1, 2, 3]) + b"\xff" * 40
+    with pytest.raises(w.WireError):
+        drive(payload)
+    minimized = fuzz._minimize(payload, drive, w.WireError)
+    assert len(minimized) < len(payload)
+    with pytest.raises(w.WireError):
+        drive(minimized)
+
+
+def test_proxy_driver_handles_dribble_identically():
+    data = (b"POST /v1 HTTP/1.1\r\nHost: a\r\n"
+            b"Content-Length: 5\r\n\r\nhello")
+    conn = fuzz._fresh_conn()
+    conn.buf = data
+    conn._parse()
+    assert len(conn.backlog) == 1
+    assert conn.backlog[0].body == b"hello"
+    fuzz.drive_proxy(data)   # must not raise
+
+
+# -- fixture corpus ---------------------------------------------------------
+
+
+def test_fixture_corpus_present_and_replays_clean():
+    results = fixtures.replay_all(
+        os.path.join(REPO_ROOT, fixtures.FIXTURE_DIR))
+    assert len(results) >= 15, (
+        "the minimized fixture corpus shrank — fixtures are the "
+        "regression tests for every defect the fuzzer ever found")
+    failures = [r for r in results if not r["ok"]]
+    assert failures == [], failures
+
+
+def test_fixture_corpus_covers_every_target():
+    fxs = fixtures.load_fixtures(
+        os.path.join(REPO_ROOT, fixtures.FIXTURE_DIR))
+    assert {fx["target"] for fx in fxs} \
+        == {"wire", "rpc", "shard", "proxy"}
+    # Both polarity classes are pinned: typed rejections AND nominal
+    # accepts (guards must not over-reject).
+    assert {fx["expect"] for fx in fxs} == {"accept", "reject"}
+
+
+def test_fixture_replay_fails_loudly_on_untyped_escape(monkeypatch):
+    # If a fixed defect regresses (typed WireError back to a raw
+    # crash), replay must propagate the raw exception, not record a
+    # polite mismatch.
+    def exploding_decode(data, allow_opaque=True):
+        raise UnicodeDecodeError("utf-8", b"", 0, 1, "regressed")
+
+    monkeypatch.setattr(wire, "decode", exploding_decode)
+    fx = {"name": "wire-bad-utf8-str", "target": "wire",
+          "input_hex": "73000000002ff", "expect": "reject",
+          "exc_type": "WireError"}
+    fx["input_hex"] = (b"s" + _U32.pack(2) + b"\xff\xfe").hex()
+    with pytest.raises(UnicodeDecodeError):
+        fixtures.replay(fx)
+
+
+# -- shard apply hardening (the fuzz-found defect, pinned directly) ---------
+
+
+def test_shard_apply_rejects_non_row_items_typed():
+    from ray_tpu._private.head_shards import HeadShardState
+
+    state = HeadShardState(0, 1)
+    with pytest.raises(wire.WireError, match="neither a ShardRow"):
+        state.apply([wire.Request(id="r1", method="x", kwargs={})])
+    # Rows before the bad item stay applied (idempotent retry model).
+    with pytest.raises(wire.WireError):
+        state.apply([("put", "objects", b"k", 1), object()])
+    assert state.tables["objects"][b"k"] == 1

@@ -1,0 +1,139 @@
+"""Tier-1 wire-protocol leg: the fifth analysis rung runs green on
+every CI run, inside a hard wall-clock budget.
+
+What the leg pins (the ISSUE's acceptance criteria):
+
+- ``python -m tools.raywire`` exits 0 and writes the
+  ``RAYWIRE_REPORT.json`` artifact at the repo root;
+- extraction is clean (AST and live registry agree) and the committed
+  ``RAYWIRE_SCHEMA.json`` baseline matches the checked-out wire.py —
+  zero gate changes on an unchanged tree;
+- the grammar-derived fuzz campaign drives >= 10k seeded inputs across
+  all four targets (wire.decode, rpc framing, shard-row apply, proxy
+  parser) with ZERO findings, zero time-bound breaches, and every
+  allocation-bomb probe bounded;
+- the per-message round-trip byte-identity suite and the minimized
+  fixture corpus replay are folded into the same report and pass;
+- a synthetic breaking change (field removed from a doctored baseline)
+  makes the SAME command exit 1 naming the version-bump requirement —
+  the gate demonstrably gates;
+- the leg stays under 60s wall so it can live in tier-1 forever.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_LEG_BUDGET_S = 60.0
+_ARTIFACT = os.path.join(REPO_ROOT, "RAYWIRE_REPORT.json")
+_BASELINE = os.path.join(REPO_ROOT, "RAYWIRE_SCHEMA.json")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _run(*extra):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.raywire",
+         "--report", "json", *extra],
+        cwd=REPO_ROOT, env=_env(), capture_output=True, text=True,
+        timeout=_LEG_BUDGET_S + 60)
+
+
+def test_raywire_leg_clean_and_bounded():
+    t0 = time.monotonic()
+    out = _run("--fuzz", "10000", "--report-file", _ARTIFACT)
+    wall = time.monotonic() - t0
+
+    assert out.returncode == 0, (
+        f"raywire leg failed (rc={out.returncode}):\n"
+        f"{out.stdout}\n{out.stderr}")
+    assert wall < _LEG_BUDGET_S, (
+        f"raywire leg took {wall:.1f}s against a {_LEG_BUDGET_S:.0f}s "
+        f"budget; shrink the campaign before shrinking coverage")
+
+    report = json.loads(out.stdout)
+    assert report["pass"] is True
+
+    # Extraction: clean cross-check, the full registry covered.
+    assert report["extraction"]["ok"] is True
+    assert report["extraction"]["messages"] >= 7
+
+    # Gate: an unchanged tree diffs to zero against the committed
+    # baseline, and every message's skew simulation is compatible in
+    # both directions.
+    assert report["gate"]["ok"] is True
+    assert report["gate"]["changes"] == []
+    assert len(report["gate"]["skew"]) >= 7
+    for name, skew in report["gate"]["skew"].items():
+        assert skew["classified"] == "compatible", name
+        assert skew["old_to_new"]["ok"] and skew["new_to_old"]["ok"], \
+            name
+        assert skew["byte_identity"] is True, name
+
+    # Fuzz: the full seeded campaign, all targets and mutators
+    # exercised, nothing escaped typed rejection, nothing slow,
+    # allocation probes bounded.
+    fz = report["fuzz"]
+    assert fz["inputs"] >= 10000
+    assert fz["findings"] == []
+    assert fz["slow"] == []
+    assert all(n > 0 for n in fz["per_target"].values())
+    assert all(n > 0 for n in fz["per_mutator"].values())
+    assert all(p["ok"] for p in fz["alloc_probes"])
+
+    # Round-trip byte identity over every message; fixture corpus
+    # replayed in full.
+    assert report["roundtrip"]["ok"] is True
+    assert report["roundtrip"]["checked"] >= 7 * 25
+    assert report["fixtures"]["ok"] is True
+    assert report["fixtures"]["replayed"] >= 15
+
+    # The artifact the run wrote is the canonical committed form.
+    assert os.path.exists(_ARTIFACT)
+    with open(_ARTIFACT, "r", encoding="utf-8") as f:
+        artifact = json.load(f)
+    assert artifact["pass"] is True
+
+
+def test_breaking_change_fixture_fails_the_gate(tmp_path):
+    # Doctor the baseline so it carries a field the live code lacks —
+    # exactly what the tree looks like the day after a careless field
+    # removal ships. The same command must exit 1 naming the escape
+    # hatch (version bump + migration note).
+    with open(_BASELINE, "r", encoding="utf-8") as f:
+        baseline = json.load(f)
+    baseline["messages"]["rpc.Request"]["fields"].append(
+        {"name": "legacy_token", "type": "bytes",
+         "has_default": True})
+    doctored = tmp_path / "RAYWIRE_SCHEMA.json"
+    doctored.write_text(json.dumps(baseline))
+
+    out = _run("--fuzz", "0", "--baseline", str(doctored))
+    assert out.returncode == 1, out.stdout
+    report = json.loads(out.stdout)
+    assert report["gate"]["ok"] is False
+    assert report["gate"]["breaking"] == ["rpc.Request"]
+    kinds = {c["kind"] for c in report["gate"]["changes"]}
+    assert "field_removed" in kinds
+    assert any("version bump" in f for f in report["gate"]["failures"])
+    # The skew evidence names the silent dataloss: old frames carry
+    # legacy_token, the live receiver drops it.
+    skew = report["gate"]["skew"]["rpc.Request"]
+    assert skew["classified"] == "breaking"
+
+
+def test_missing_baseline_is_a_usage_error(tmp_path):
+    out = _run("--fuzz", "0",
+               "--baseline", str(tmp_path / "nope.json"))
+    assert out.returncode == 2
+    assert "--write-baseline" in out.stderr

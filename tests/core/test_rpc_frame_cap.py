@@ -1,0 +1,167 @@
+"""rpc framing hardening, regression-tested at the raw-socket level:
+the pre-allocation cap, typed skew rejection at server dispatch, and
+the frame-aligned keep-the-connection recovery path.
+
+Everything here drives a live ``RpcServer`` with hand-built byte
+streams — no client library in the request path — because the defects
+this guards against (allocation bombs, connection-killing on malformed
+frames, silent envelope confusion) live below the client abstraction.
+"""
+
+import os
+import socket
+import struct
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from ray_tpu._private import rpc, wire  # noqa: E402
+from ray_tpu._private.config import ray_config  # noqa: E402
+from ray_tpu._private.rpc import (FrameTooLarge, RpcClient,  # noqa: E402
+                                  RpcServer, recv_msg, send_msg)
+
+_LEN = struct.Struct("!I")
+
+
+@pytest.fixture()
+def server():
+    srv = RpcServer({"echo": lambda **kw: kw})
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+def _frame(payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + payload
+
+
+def _request(rid="r1", method="echo", **kwargs) -> bytes:
+    return _frame(wire.encode(
+        wire.Request(id=rid, method=method, kwargs=kwargs)))
+
+
+def _reply_of(sock) -> wire.Reply:
+    msg = recv_msg(sock)
+    assert isinstance(msg, wire.Reply)
+    return msg
+
+
+# -- the pre-allocation cap -------------------------------------------------
+
+
+def test_recv_msg_rejects_oversized_header_before_body():
+    class OneShot:
+        def __init__(self, data):
+            self.data = data
+            self.recv_calls = 0
+
+        def recv(self, n):
+            self.recv_calls += 1
+            chunk, self.data = self.data[:n], self.data[n:]
+            return chunk
+
+    sock = OneShot(_LEN.pack(0x7FFFFF00) + b"x" * 64)
+    with pytest.raises(FrameTooLarge, match="rpc_max_frame_bytes"):
+        recv_msg(sock)
+    # The reject happened off the 4-byte header alone — the claimed
+    # 2GiB body was never pulled from the socket.
+    assert sock.recv_calls <= 2
+
+
+def test_frame_cap_is_a_config_knob(monkeypatch):
+    monkeypatch.setattr(ray_config, "rpc_max_frame_bytes", 64)
+
+    class Buf:
+        def __init__(self, data):
+            self.data = data
+
+        def recv(self, n):
+            chunk, self.data = self.data[:n], self.data[n:]
+            return chunk
+
+    payload = wire.encode(b"x" * 256)
+    with pytest.raises(FrameTooLarge):
+        recv_msg(Buf(_frame(payload)))
+    small = wire.encode(b"x" * 8)
+    assert recv_msg(Buf(_frame(small))) == b"x" * 8
+
+
+def test_server_replies_frame_too_large_then_drops(server):
+    with socket.create_connection(server.address) as sock:
+        sock.sendall(_LEN.pack(1 << 31))
+        reply = _reply_of(sock)
+        assert not reply.ok and "rpc_max_frame_bytes" in reply.error
+        # After an oversized header the stream cannot resync (the
+        # server never read the claimed body) — connection closes.
+        sock.settimeout(5.0)
+        assert sock.recv(4) == b""
+
+
+# -- typed skew rejection at dispatch, frame-aligned recovery ---------------
+
+
+def test_malformed_frame_gets_error_reply_and_connection_survives(
+        server):
+    with socket.create_connection(server.address) as sock:
+        sock.sendall(_frame(b"\xff\xfe garbage"))
+        reply = _reply_of(sock)
+        assert not reply.ok and "wire:" in reply.error
+        # Framing is intact (the bad bytes were length-delimited), so
+        # the SAME connection serves the next request.
+        sock.sendall(_request(x=1))
+        reply = _reply_of(sock)
+        assert reply.ok and reply.result == {"x": 1}
+
+
+def test_future_version_request_rejected_typed(server):
+    raw = bytearray(wire.encode(
+        wire.Request(id="r9", method="echo", kwargs={})))
+    name_len = _LEN.unpack_from(raw, 1)[0]
+    struct.pack_into("!H", raw, 5 + name_len, 99)   # version u16
+    with socket.create_connection(server.address) as sock:
+        sock.sendall(_frame(bytes(raw)))
+        reply = _reply_of(sock)
+        assert not reply.ok
+        assert "newer than known" in reply.error
+        sock.sendall(_request(x=2))
+        assert _reply_of(sock).result == {"x": 2}
+
+
+def test_non_request_envelope_rejected_typed(server):
+    # A well-formed frame of the wrong TYPE (a skewed peer speaking a
+    # different protocol role) gets a typed rejection naming the type,
+    # and the connection keeps serving.
+    with socket.create_connection(server.address) as sock:
+        sock.sendall(_frame(wire.encode({"method": "echo"})))
+        reply = _reply_of(sock)
+        assert not reply.ok
+        assert "expected rpc.Request envelope, got dict" in reply.error
+        sock.sendall(_request(x=3))
+        assert _reply_of(sock).result == {"x": 3}
+
+
+def test_normal_client_unaffected_by_hardening(server):
+    client = RpcClient(server.address)
+    assert client.call("echo", a=1, b="two") == {"a": 1, "b": "two"}
+
+
+def test_client_closes_on_malformed_reply(server, monkeypatch):
+    # The client side of the same contract: a garbage reply must
+    # surface as RemoteCallError, not UnicodeDecodeError, and must
+    # tear the connection down (the stream is untrustworthy).
+    client = RpcClient(server.address)
+    assert client.call("echo", x=1) == {"x": 1}
+
+    def bad_recv(sock):
+        raise wire.WireError("malformed reply frame")
+
+    monkeypatch.setattr(rpc, "recv_msg", bad_recv)
+    with pytest.raises(rpc.RemoteCallError, match="malformed reply"):
+        client.call("echo", x=2)
+    assert client._sock is None

@@ -14,6 +14,7 @@ from tools.raylint.rules.r6_hygiene import HygieneRule
 from tools.raylint.rules.r7_ambient import AmbientStateRule
 from tools.raylint.rules.r8_yield_points import YieldPointHygieneRule
 from tools.raylint.rules.r9_spec_coverage import SpecCoverageRule
+from tools.raylint.rules.r10_length_alloc import LengthAllocationRule
 
 _RULE_CLASSES = (
     AsyncBlockingRule,
@@ -25,6 +26,7 @@ _RULE_CLASSES = (
     AmbientStateRule,
     YieldPointHygieneRule,
     SpecCoverageRule,
+    LengthAllocationRule,
 )
 
 

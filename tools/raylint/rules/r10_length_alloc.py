@@ -1,0 +1,139 @@
+"""R10 length-before-allocation: a length decoded off the wire must be
+bounds-checked before it sizes an allocation or a blocking read.
+
+The shape behind every allocation-bomb: a u32/u64 comes out of
+``struct.unpack`` (or ``int.from_bytes``), and the very next thing the
+code does is ``_recv_exact(sock, n)`` / ``f.read(n)`` /
+``bytearray(n)`` — handing a remote peer the right to demand a 4 GiB
+allocation with a 4-byte header. The rpc framing layer had exactly this
+hole (`recv_msg` pre-allocated whatever the prefix claimed) until the
+raywire rung added ``rpc_max_frame_bytes``; this rule keeps the next
+length-prefixed reader honest.
+
+Taint model, deliberately function-local and syntactic:
+
+- **source** — a variable bound (directly or by tuple-unpacking) from
+  ``<anything>.unpack(...)`` / ``.unpack_from(...)`` or
+  ``int.from_bytes(...)``;
+- **sink** — that variable sizing an allocation before any check:
+  an ``*exact``-style read call (``_recv_exact``/``recv_exact``/
+  ``read_exact``), ``.recv(n)``/``.read(n)``/``.recvfrom(n)``,
+  ``bytes(n)``/``bytearray(n)``, or a multiplication (``b"x" * n``);
+- **guard** — ANY comparison mentioning the variable between the
+  source and the sink (``if n > cap``, ``if n <= limit``, ``min(n,
+  cap)`` does not count — an explicit comparison is the audit point).
+
+A genuinely-bounded length (trusted file, checked upstream) is a
+``# raylint: disable=R10 -- why`` with the bound named in the why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from tools.raylint.core import FileInfo, Rule
+
+_EXACT_READ_FNS = {"_recv_exact", "recv_exact", "read_exact",
+                   "readexactly"}
+_SIZED_METHODS = {"recv", "read", "recvfrom", "recv_into"}
+_SIZED_BUILTINS = {"bytes", "bytearray"}
+
+
+def _is_length_source(node: ast.AST) -> bool:
+    """``X.unpack(...)`` / ``X.unpack_from(...)`` /
+    ``int.from_bytes(...)``, bare or behind an index
+    (``struct.unpack("!I", hdr)[0]`` is the canonical shape)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("unpack", "unpack_from"):
+            return True
+        if fn.attr == "from_bytes" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "int":
+            return True
+    return False
+
+
+def _bound_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for el in target.elts:
+            out.extend(_bound_names(el))
+        return out
+    return []
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class LengthAllocationRule(Rule):
+    id = "R10"
+    name = "length-before-allocation"
+    description = ("a wire-decoded length must be compared against a "
+                   "bound before it sizes a read or allocation")
+
+    def check_file(self, fi: FileInfo) -> Iterable[Tuple[int, str]]:
+        if fi.package is None:      # product code only
+            return
+        for node in fi.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(node)
+
+    def _check_function(self, fn: ast.AST):
+        # source var -> line it was decoded on
+        tainted: Dict[str, int] = {}
+        # var -> lines of comparisons mentioning it
+        guards: Dict[str, List[int]] = {}
+        sinks: List[Tuple[int, str, str]] = []   # (line, var, what)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and _is_length_source(node.value):
+                for tgt in node.targets:
+                    for name in _bound_names(tgt):
+                        tainted.setdefault(name, node.lineno)
+            elif isinstance(node, ast.Compare):
+                for name in _names_in(node):
+                    guards.setdefault(name, []).append(node.lineno)
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mult):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Name):
+                        sinks.append((node.lineno, side.id,
+                                      "a multiplied allocation size"))
+            elif isinstance(node, ast.Call):
+                callee = node.func
+                cname = callee.id if isinstance(callee, ast.Name) \
+                    else (callee.attr
+                          if isinstance(callee, ast.Attribute)
+                          else "")
+                sized = (cname in _EXACT_READ_FNS
+                         or cname in _SIZED_BUILTINS
+                         or (isinstance(callee, ast.Attribute)
+                             and cname in _SIZED_METHODS))
+                if not sized:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        sinks.append((node.lineno, arg.id,
+                                      f"`{cname}()`"))
+
+        for line, var, what in sorted(sinks):
+            src_line = tainted.get(var)
+            if src_line is None or line < src_line:
+                continue
+            if any(src_line <= g <= line
+                   for g in guards.get(var, ())):
+                continue
+            yield (line,
+                   f"`{var}` was decoded off the wire at line "
+                   f"{src_line} and sizes {what} with no bounds "
+                   f"check in between — a peer controls this "
+                   f"allocation; compare it against a cap first")

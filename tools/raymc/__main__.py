@@ -98,10 +98,30 @@ def main(argv=None) -> int:
         if gc_was_enabled:
             gc.enable()
 
+    # Seam-coverage audit: which registered sched/crash points did
+    # this run's scenarios actually cross? An uncovered point is a
+    # seam the model checker never schedules around — dead catalog
+    # weight, or (worse) a real interleaving seam with zero coverage.
+    # Advisory, not a failure: partial runs (--scenario x) legitimately
+    # cross few points, so the report records the gap instead of
+    # failing on it; the full-set numbers are what reviews read.
+    from ray_tpu._private.sanitize_hooks import (CRASH_POINTS,
+                                                 SCHED_POINTS)
+
+    catalog = set(SCHED_POINTS) | set(CRASH_POINTS)
+    crossed = set()
+    for r in results:
+        crossed.update(r.points_crossed)
+    crossed &= catalog      # "mc.*" harness gates are not seams
     report = {
         "schema_version": 1,
         "harness": "python -m tools.raymc",
         "scenarios": [r.to_dict() for r in results],
+        "seam_coverage": {
+            "catalog": len(catalog),
+            "crossed": sorted(crossed),
+            "uncovered": sorted(catalog - crossed),
+        },
         "pass": all(r.ok for r in results),
     }
     if args.report == "json":
@@ -117,6 +137,11 @@ def main(argv=None) -> int:
                   f"{r.elapsed_s:.2f}s")
             for f in r.findings:
                 print("  " + f.render().replace("\n", "\n  "))
+        cov = report["seam_coverage"]
+        print(f"raymc[seams]: {len(cov['crossed'])}/{cov['catalog']} "
+              f"registered points crossed"
+              + (f"; uncovered: {', '.join(cov['uncovered'])}"
+                 if cov["uncovered"] else ""))
     if args.report_file:
         # Deterministic artifact: wall-clock noise goes to the
         # .timing.json sidecar so back-to-back identical runs produce

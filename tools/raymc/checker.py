@@ -32,6 +32,9 @@ class CheckResult:
     elapsed_s: float = 0.0
     conformance_checks: int = 0
     findings: List[Finding] = dataclasses.field(default_factory=list)
+    # Union of every point name any execution crossed (pre-filter) —
+    # the seam-coverage audit diffs this against the full catalog.
+    points_crossed: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -49,6 +52,7 @@ class CheckResult:
             "elapsed_s": round(self.elapsed_s, 3),
             "conformance_checks": self.conformance_checks,
             "findings": [f.to_dict() for f in self.findings],
+            "points_crossed": list(self.points_crossed),
         }
 
     @classmethod
@@ -97,6 +101,8 @@ def check(scenario_factory: Callable[[], Scenario],
         result.steps_total += len(res.steps)
         result.pruned += res.sleep_leaves
         result.conformance_checks += res.conformance_checks
+        result.points_crossed = sorted(
+            set(result.points_crossed) | set(res.points_seen))
         if res.truncated:
             result.truncated += 1
         if res.status == "divergence":

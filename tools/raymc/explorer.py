@@ -130,6 +130,11 @@ class ExecutionResult:
     errors: List[str] = dataclasses.field(default_factory=list)
     sleep_leaves: int = 0
     conformance_checks: int = 0      # rayspec refinement checks run
+    # Every point NAME this execution crossed, recorded before the
+    # scenario-relevance filter: the raw material for the seam-coverage
+    # audit (a SCHED/CRASH point no scenario ever crosses is a seam
+    # the model checker never exercises).
+    points_seen: List[str] = dataclasses.field(default_factory=list)
 
 
 class ExplorerConfig:
@@ -182,6 +187,7 @@ class Execution:
         self._arrivals = 0
         self._crashes_used = 0
         self._crossings: List[_Cross] = []
+        self._points_seen: set = set()
         self._steps: List[_Step] = []
         self._errors: List[str] = []
         self._action_threads: List[threading.Thread] = []
@@ -206,6 +212,7 @@ class Execution:
                 or name.startswith("mc."))
 
     def _hook(self, name: str) -> None:
+        self._points_seen.add(name)
         if not self._relevant(name):
             return
         ident = threading.get_ident()
@@ -362,7 +369,8 @@ class Execution:
                 crossings=self._crossings, pending=pending,
                 violations=violations, truncated=self._truncated,
                 errors=self._errors, sleep_leaves=self.sleep_leaves,
-                conformance_checks=self._conf_checks)
+                conformance_checks=self._conf_checks,
+                points_seen=sorted(self._points_seen))
         finally:
             sanitize_hooks.install_sched_point(prev_sched)
             sanitize_hooks.install_crash_point(prev_crash)

@@ -1,0 +1,25 @@
+"""raywire: the wire-protocol analysis rung.
+
+The fifth rung of the analysis ladder (raylint proves structure, raysan
+replays one schedule, raymc exhausts interleavings, rayspec proves
+sequential refinement — raywire proves the wire):
+
+- ``extract``  — static schema extraction: wire.py's AST plus the live
+  ``_REGISTRY``, cross-checked, rendered into the canonical committed
+  baseline ``RAYWIRE_SCHEMA.json``;
+- ``compat``   — cross-version compatibility gate: diff extracted vs
+  baseline, classify every change against the actual decode semantics,
+  fail breaking changes unless the version literal was bumped with a
+  justified migration note, and prove the classification empirically
+  with a skew simulator (old-catalog frames under the new catalog and
+  vice versa);
+- ``fuzz``     — grammar-derived structure-aware fuzzing of
+  ``wire.decode``, the rpc length-prefix framing, ``head.ShardRow``
+  application, and the serve proxy's HTTP/1.1 parser: every input must
+  decode or reject TYPED within a time/allocation bound;
+- ``fixtures`` — ddmin-minimized hex-blob regression fixtures for every
+  defect the fuzzer ever surfaced (``tests/core/wire_fixtures/``).
+
+CLI: ``python -m tools.raywire`` (see __main__.py for the exit-code
+contract and the ``RAYWIRE_REPORT.json`` artifact).
+"""

@@ -1,0 +1,205 @@
+"""CLI: ``python -m tools.raywire [--fuzz N] [--report json] ...``
+
+One invocation runs the whole rung and reports the form CI archives as
+``RAYWIRE_REPORT.json``:
+
+1. **extract** — schema from wire.py's AST cross-checked against the
+   live registry (any disagreement is a failure on its own);
+2. **gate** — diff against the committed ``RAYWIRE_SCHEMA.json``
+   baseline, classify changes, enforce version-bump + migration-note
+   on breaking ones, and prove the classification with the skew
+   simulator;
+3. **fuzz** — the seeded grammar-derived campaign over wire.decode,
+   the rpc framing, shard-row application, and the proxy parser,
+   plus the allocation-bomb probes;
+4. **roundtrip** — byte-identity encode(decode(encode(x))) over
+   generated instances of every registered message;
+5. **fixtures** — replay the minimized regression corpus.
+
+Exit-code contract (raylint's):
+  0  clean
+  1  at least one finding/failure in any stage
+  2  usage error (no baseline without --write-baseline, bad args)
+
+``--write-baseline`` regenerates ``RAYWIRE_SCHEMA.json`` from the
+current wire.py — the one sanctioned way to accept a schema change
+(the gate still demands the version bump + migration note first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASELINE_NAME = "RAYWIRE_SCHEMA.json"
+
+
+def _roundtrip_suite(schema: dict, per_message: int,
+                     seed: int) -> dict:
+    """encode -> decode -> encode byte identity for every registered
+    message, natively-typed generated values."""
+    import random
+
+    from ray_tpu._private import wire
+    from tools.raywire import gen
+
+    rng = random.Random(seed)
+    failures = []
+    checked = 0
+    for name in sorted(schema["messages"]):
+        entry = schema["messages"][name]
+        for _ in range(per_message):
+            inst = gen.build_instance(name, entry, rng)
+            raw = wire.encode(inst)
+            back = wire.decode(raw)
+            checked += 1
+            if back != inst:
+                failures.append({"message": name,
+                                 "kind": "value_mismatch",
+                                 "input_hex": raw[:256].hex()})
+            elif wire.encode(back) != raw:
+                failures.append({"message": name,
+                                 "kind": "byte_identity",
+                                 "input_hex": raw[:256].hex()})
+    return {"checked": checked, "failures": failures,
+            "ok": not failures}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.raywire",
+        description="wire-schema extraction, compatibility gating, "
+                    "and grammar-derived decode fuzzing")
+    parser.add_argument("--fuzz", type=int, default=10000,
+                        metavar="N",
+                        help="fuzz inputs per run (0 disables)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--roundtrip-per-message", type=int,
+                        default=25, metavar="N")
+    parser.add_argument("--report", choices=("json", "pretty"),
+                        default="pretty")
+    parser.add_argument("--report-file", default="", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--baseline", default="", metavar="PATH",
+                        help=f"schema baseline (default: "
+                             f"{BASELINE_NAME} at the repo root)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from the "
+                             "current wire.py and exit")
+    parser.add_argument("--repo-root", default="", metavar="DIR")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.repo_root or os.getcwd())
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    from tools.raywire import compat, extract, fixtures, fuzz
+
+    t0 = time.monotonic()
+    ex = extract.extract(root)
+
+    if args.write_baseline:
+        if not ex.ok:
+            for p in ex.problems:
+                print(f"raywire: extraction problem: {p}",
+                      file=sys.stderr)
+            print("raywire: refusing to write a baseline from a "
+                  "schema the code disagrees with", file=sys.stderr)
+            return 1
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(extract.render_schema(ex.schema))
+        print(f"raywire: wrote {baseline_path} "
+              f"({len(ex.schema['messages'])} messages)")
+        return 0
+
+    baseline = extract.load_baseline(baseline_path)
+    if baseline is None:
+        print(f"raywire: no baseline at {baseline_path}; run "
+              "--write-baseline once and commit it", file=sys.stderr)
+        return 2
+
+    gate = compat.run_gate(baseline, ex.schema, ex.migration_notes,
+                           seed=args.seed)
+    fuzz_report = (fuzz.run_fuzz(ex.schema, n_inputs=args.fuzz,
+                                 seed=args.seed)
+                   if args.fuzz > 0 else None)
+    roundtrip = _roundtrip_suite(ex.schema,
+                                 args.roundtrip_per_message,
+                                 args.seed)
+    fixture_results = fixtures.replay_all(
+        os.path.join(root, fixtures.FIXTURE_DIR))
+    fixture_failures = [r for r in fixture_results if not r["ok"]]
+
+    fuzz_ok = (fuzz_report is None
+               or (not fuzz_report["findings"]
+                   and not fuzz_report["slow"]
+                   and all(p["ok"]
+                           for p in fuzz_report["alloc_probes"])))
+    report = {
+        "schema_version": 1,
+        "harness": "python -m tools.raywire",
+        "extraction": {"ok": ex.ok, "problems": ex.problems,
+                       "messages": len(ex.schema["messages"])},
+        "gate": gate.as_report(),
+        "fuzz": fuzz_report,
+        "roundtrip": roundtrip,
+        "fixtures": {"replayed": len(fixture_results),
+                     "failures": fixture_failures,
+                     "ok": not fixture_failures
+                     and bool(fixture_results)},
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+    report["pass"] = (ex.ok and gate.ok and fuzz_ok
+                      and roundtrip["ok"]
+                      and report["fixtures"]["ok"])
+
+    if args.report == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"raywire[extract]: "
+              f"{'ok' if ex.ok else 'PROBLEMS'} — "
+              f"{len(ex.schema['messages'])} messages")
+        for p in ex.problems:
+            print(f"  {p}")
+        print(f"raywire[gate]: {'ok' if gate.ok else 'FAIL'} — "
+              f"{len(gate.changes)} change(s), "
+              f"{len(gate.failures)} failure(s)")
+        for c in gate.changes:
+            marker = "BREAKING" if c.breaking else "compatible"
+            print(f"  [{marker}] {c.message}: {c.kind} — {c.detail}")
+        for f in gate.failures:
+            print(f"  FAIL: {f}")
+        if fuzz_report is not None:
+            print(f"raywire[fuzz]: "
+                  f"{'ok' if fuzz_ok else 'FINDINGS'} — "
+                  f"{fuzz_report['inputs']} inputs, "
+                  f"{len(fuzz_report['findings'])} finding(s), "
+                  f"{len(fuzz_report['slow'])} slow, alloc probes "
+                  f"{'ok' if all(p['ok'] for p in fuzz_report['alloc_probes']) else 'FAIL'}")
+            for f in fuzz_report["findings"][:20]:
+                print(f"  {f['target']}/{f['mutator']}: "
+                      f"{f['exc_type']}: {f['message']} "
+                      f"[{f['input_hex'][:80]}]")
+        print(f"raywire[roundtrip]: "
+              f"{'ok' if roundtrip['ok'] else 'FAIL'} — "
+              f"{roundtrip['checked']} instances")
+        print(f"raywire[fixtures]: "
+              f"{'ok' if report['fixtures']['ok'] else 'FAIL'} — "
+              f"{len(fixture_results)} replayed")
+        for r in fixture_failures:
+            print(f"  FAIL {r['name']}: got {r['got']}, "
+                  f"want {r['want']}")
+
+    if args.report_file:
+        from tools.reporting import write_report_artifact
+
+        write_report_artifact(args.report_file, report,
+                              volatile=("elapsed_s", "peak_bytes"))
+
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Cross-version compatibility gate + empirical skew simulator.
+
+The diff classifies every schema change against the DECODE semantics
+(wire._Decoder's M tag), not against intuition:
+
+compatible
+- ``message_added``      — old receivers never see it addressed to them
+  until they upgrade; new receivers decode it.
+- ``field_appended``     — appended WITH a default: old receivers skip
+  the unknown field; new receivers default it on old frames.
+
+breaking
+- ``message_removed``    — in-flight frames of a still-spoken version
+  become undecodable ("unknown message type").
+- ``field_removed``      — old frames still decode (the field is
+  silently skipped) but its DATA is dropped on the floor: silent loss,
+  not an error, which is worse.
+- ``field_renamed``      — removal + addition in one: the old name's
+  data drops silently AND the new name is absent from old frames.
+- ``field_type_changed`` — old-typed values fail the new isinstance
+  gate (or worse, pass by coincidence: int→float).
+- ``field_appended_no_default`` — every pre-change frame is missing a
+  field the receiver now requires: all old traffic rejects.
+- ``field_reordered``    — name-keyed decode still succeeds, but field
+  order IS the encode byte order: content hashes (template ids!) and
+  dedupe keys computed over encoded bytes diverge across the fleet.
+- ``version_changed``    — the escape hatch itself: new-version frames
+  reject on every not-yet-upgraded receiver, so it must ride with a
+  migration note (and is what LEGITIMIZES the other breaking changes).
+
+Gate: a message with breaking changes fails unless its version literal
+was bumped AND a ``# raywire: migration=<name> -- <why>`` note exists
+in wire.py (the raylint suppression contract, pointed at the schema).
+
+The skew simulator then PROVES the classification empirically for
+every message in both catalogs: generated old-catalog frames are
+decoded by the live decoder, generated new-catalog frames by a
+catalog-driven simulation of the old receiver (gen.simulate_decode).
+Every change classified compatible must decode cleanly in BOTH
+directions — a compatible-classified change with an observed decode
+failure fails the gate even if the diff logic has a blind spot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List
+
+from tools.raywire import gen
+
+BREAKING_KINDS = frozenset((
+    "message_removed", "field_removed", "field_renamed",
+    "field_type_changed", "field_appended_no_default",
+    "field_reordered",
+))
+
+
+@dataclasses.dataclass
+class Change:
+    message: str
+    kind: str
+    detail: str
+    breaking: bool
+
+
+def diff_schemas(old: dict, new: dict) -> List[Change]:
+    changes: List[Change] = []
+    old_msgs = old.get("messages", {})
+    new_msgs = new.get("messages", {})
+
+    for name in sorted(set(new_msgs) - set(old_msgs)):
+        changes.append(Change(name, "message_added",
+                              f"new message v{new_msgs[name]['version']}",
+                              breaking=False))
+    for name in sorted(set(old_msgs) - set(new_msgs)):
+        changes.append(Change(
+            name, "message_removed",
+            "in-flight frames of a still-spoken version become "
+            "undecodable", breaking=True))
+
+    for name in sorted(set(old_msgs) & set(new_msgs)):
+        o, n = old_msgs[name], new_msgs[name]
+        if o["version"] != n["version"]:
+            changes.append(Change(
+                name, "version_changed",
+                f"v{o['version']} -> v{n['version']}", breaking=False))
+        ofields = {f["name"]: f for f in o["fields"]}
+        nfields = {f["name"]: f for f in n["fields"]}
+        removed = [f for f in ofields if f not in nfields]
+        added = [f for f in nfields if f not in ofields]
+
+        # Rename heuristic: a removed and an added field at the same
+        # declared position with the same type is reported as one
+        # rename (clearer triage); both halves are breaking anyway.
+        opos = {f["name"]: i for i, f in enumerate(o["fields"])}
+        npos = {f["name"]: i for i, f in enumerate(n["fields"])}
+        renamed = set()
+        for rname in list(removed):
+            for aname in list(added):
+                if opos[rname] == npos.get(aname, -1) \
+                        and ofields[rname]["type"] == \
+                        nfields[aname]["type"]:
+                    changes.append(Change(
+                        name, "field_renamed",
+                        f"{rname} -> {aname}: the old name's data "
+                        "drops silently on new receivers",
+                        breaking=True))
+                    renamed.update((rname, aname))
+                    removed.remove(rname)
+                    added.remove(aname)
+                    break
+
+        for fname in removed:
+            changes.append(Change(
+                name, "field_removed",
+                f"{fname}: old frames decode but the value is "
+                "silently dropped", breaking=True))
+        for fname in added:
+            if nfields[fname]["has_default"]:
+                changes.append(Change(
+                    name, "field_appended",
+                    f"{fname} (defaulted): old receivers skip it, "
+                    "old frames default it", breaking=False))
+            else:
+                changes.append(Change(
+                    name, "field_appended_no_default",
+                    f"{fname}: every pre-change frame now rejects as "
+                    "missing a required field", breaking=True))
+
+        for fname in sorted(set(ofields) & set(nfields)):
+            if fname in renamed:
+                continue
+            if ofields[fname]["type"] != nfields[fname]["type"]:
+                changes.append(Change(
+                    name, "field_type_changed",
+                    f"{fname}: {ofields[fname]['type']} -> "
+                    f"{nfields[fname]['type']}", breaking=True))
+
+        shared_old = [f["name"] for f in o["fields"]
+                      if f["name"] in nfields and f["name"] not in renamed]
+        shared_new = [f["name"] for f in n["fields"]
+                      if f["name"] in ofields and f["name"] not in renamed]
+        if shared_old != shared_new:
+            changes.append(Change(
+                name, "field_reordered",
+                f"{shared_old} -> {shared_new}: encode byte order "
+                "changes, content hashes/dedupe keys diverge",
+                breaking=True))
+    return changes
+
+
+@dataclasses.dataclass
+class GateResult:
+    changes: List[Change]
+    failures: List[str]
+    skew: Dict[str, dict]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def as_report(self) -> dict:
+        return {
+            "changes": [dataclasses.asdict(c) for c in self.changes],
+            "breaking": sorted({c.message for c in self.changes
+                                if c.breaking}),
+            "failures": list(self.failures),
+            "skew": self.skew,
+            "ok": self.ok,
+        }
+
+
+def run_gate(old: dict, new: dict,
+             migration_notes: Dict[str, str],
+             seed: int = 0) -> GateResult:
+    changes = diff_schemas(old, new)
+    failures: List[str] = []
+
+    by_msg: Dict[str, List[Change]] = {}
+    for c in changes:
+        by_msg.setdefault(c.message, []).append(c)
+    for name, msg_changes in sorted(by_msg.items()):
+        breaking = [c for c in msg_changes if c.breaking]
+        if not breaking:
+            continue
+        old_v = old["messages"].get(name, {}).get("version")
+        new_v = new["messages"].get(name, {}).get("version")
+        bumped = (old_v is not None and new_v is not None
+                  and new_v > old_v)
+        note = (migration_notes.get(name) or "").strip()
+        what = "; ".join(f"{c.kind}: {c.detail}" for c in breaking)
+        if not bumped:
+            failures.append(
+                f"{name}: breaking change without a version bump "
+                f"({what}) — bump the @message version literal and "
+                f"add `# raywire: migration={name} -- <why>`")
+        elif not note:
+            failures.append(
+                f"{name}: version bumped v{old_v}->v{new_v} but no "
+                f"justified migration note ({what}) — add "
+                f"`# raywire: migration={name} -- <why>` to wire.py")
+
+    skew = simulate_skew(old, new, changes, seed=seed)
+    for name, result in sorted(skew.items()):
+        for direction in ("old_to_new", "new_to_old"):
+            r = result[direction]
+            if result["classified"] == "compatible" and not r["ok"]:
+                failures.append(
+                    f"{name}: classified compatible but the skew "
+                    f"simulator observed a {direction} decode "
+                    f"failure: {r['error']}")
+    return GateResult(changes=changes, failures=failures, skew=skew)
+
+
+def simulate_skew(old: dict, new: dict, changes: List[Change],
+                  seed: int = 0, trials: int = 3) -> Dict[str, dict]:
+    """Empirical both-direction decode of every message present in
+    both catalogs (plus byte-identity evidence for reorders).
+
+    old→new: frames built to the OLD shape, decoded by the LIVE
+    decoder (which speaks the new catalog). new→old: frames built to
+    the NEW shape, decoded by the catalog-driven simulation of the old
+    receiver. ``skipped`` names fields each side dropped — the silent
+    dataloss evidence behind the field_removed/renamed classification.
+    """
+    from ray_tpu._private import wire
+    from tools.raywire import extract as _extract
+
+    # Live decode is only meaningful for the receiver shape the code
+    # ACTUALLY speaks; for hypothetical catalogs (the gate's synthetic
+    # fixtures, or diffing two historical baselines) the receiver is
+    # simulated from catalog data on both sides.
+    live = _extract._live_catalog()
+
+    breaking_by_msg: Dict[str, bool] = {}
+    for c in changes:
+        if c.breaking:
+            breaking_by_msg[c.message] = True
+    out: Dict[str, dict] = {}
+    shared = sorted(set(old.get("messages", {}))
+                    & set(new.get("messages", {})))
+    for name in shared:
+        o, n = old["messages"][name], new["messages"][name]
+        n_is_live = live.get(name) is not None and (
+            live[name]["version"] == n["version"]
+            and live[name]["fields"] == n["fields"])
+        rng = random.Random(seed ^ hash(name) & 0xFFFFFFFF)
+        o2n = {"ok": True, "error": None, "skipped": []}
+        n2o = {"ok": True, "error": None, "skipped": []}
+        identity = True
+        for _ in range(trials):
+            # Old wire, new receiver.
+            ofields = gen.gen_fields(rng, o)
+            known = {f["name"] for f in n["fields"]}
+            sim = gen.simulate_decode(ofields, o["version"], n)
+            if not sim["ok"]:
+                o2n = {"ok": False, "error": sim["error"],
+                       "skipped": o2n["skipped"]}
+            else:
+                o2n["skipped"] = sorted(
+                    set(o2n["skipped"]) | set(sim["skipped"]))
+            if n_is_live and o2n["ok"]:
+                # Empirical confirmation against the real decoder.
+                frame = gen.build_frame(name, o["version"], ofields)
+                try:
+                    wire.decode(frame)
+                except wire.WireError as e:
+                    o2n = {"ok": False, "error": str(e),
+                           "skipped": o2n["skipped"]}
+            # New wire, old receiver (simulated from catalog data).
+            nfields = gen.gen_fields(rng, n)
+            sim = gen.simulate_decode(nfields, n["version"], o)
+            if not sim["ok"]:
+                n2o = {"ok": False, "error": sim["error"],
+                       "skipped": n2o["skipped"]}
+            else:
+                n2o["skipped"] = sorted(
+                    set(n2o["skipped"]) | set(sim["skipped"]))
+            # Byte-identity evidence for reorders: shared fields
+            # encoded in each catalog's order.
+            shared_names = [f["name"] for f in o["fields"]
+                            if f["name"] in known]
+            vals = dict(ofields)
+            frame_old_order = gen.build_frame(
+                name, o["version"],
+                [(fn, vals[fn]) for fn in shared_names])
+            new_order = [f["name"] for f in n["fields"]
+                         if f["name"] in vals
+                         and f["name"] in shared_names]
+            frame_new_order = gen.build_frame(
+                name, o["version"],
+                [(fn, vals[fn]) for fn in new_order])
+            if frame_old_order != frame_new_order:
+                identity = False
+        out[name] = {
+            "classified": ("breaking" if breaking_by_msg.get(name)
+                           else "compatible"),
+            "old_to_new": o2n,
+            "new_to_old": n2o,
+            "byte_identity": identity,
+        }
+    return out

@@ -1,0 +1,314 @@
+"""Wire-schema extraction: wire.py's AST + the live registry.
+
+Two independent views of the same contract, cross-checked:
+
+- the **AST pass** walks ``ray_tpu/_private/wire.py`` without importing
+  it: every ``@message("Name", version=N)`` class with its fields in
+  declared (= encode) order, the tag alphabet the encoder emits AND the
+  decoder accepts (a tag present on one side only is itself a finding),
+  and the decode nesting bound;
+- the **live pass** imports the module and reads ``_REGISTRY`` plus the
+  per-class decode plans.
+
+Any disagreement between the two (a message registered dynamically that
+the AST can't see, an AST class that never registered, version or field
+drift) is reported as an extraction problem — the schema the gate
+diffs must be the schema the code actually speaks.
+
+The rendered schema is canonical: sorted message names, fields in
+declared order (field order IS the encode byte order — reorders are
+visible), stable JSON. ``RAYWIRE_SCHEMA.json`` at the repo root is the
+committed baseline the compat gate diffs against.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+WIRE_RELPATH = "ray_tpu/_private/wire.py"
+
+# The escape hatch the compat gate honors for a breaking change:
+#   # raywire: migration=<wire.Name> -- <justification>
+# anywhere in wire.py (raylint's suppression grammar, pointed at the
+# schema instead of a rule).
+MIGRATION_RE = re.compile(
+    r"#\s*raywire:\s*migration=([\w.]+)\s*--\s*(?P<why>.+?)\s*$")
+
+WIRE_SCALARS = ("int", "float", "str", "bytes", "bool", "dict", "list",
+                "tuple")
+
+
+@dataclasses.dataclass
+class FieldSpec:
+    name: str
+    type: str            # a WIRE_SCALARS entry or "Any"
+    has_default: bool
+
+    def as_schema(self) -> dict:
+        return {"name": self.name, "type": self.type,
+                "has_default": self.has_default}
+
+
+@dataclasses.dataclass
+class MessageSpec:
+    name: str            # wire name ("rpc.Request")
+    version: int
+    pyclass: str
+    line: int
+    fields: List[FieldSpec]
+
+    def as_schema(self) -> dict:
+        return {"version": self.version, "class": self.pyclass,
+                "fields": [f.as_schema() for f in self.fields]}
+
+
+@dataclasses.dataclass
+class Extraction:
+    schema: dict
+    migration_notes: Dict[str, str]     # wire name -> justification
+    problems: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+
+def _annotation_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _annotation_name(node.value)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ast.dump(node)
+
+
+def _message_decorator(cls: ast.ClassDef) -> Optional[Tuple[str, int, int]]:
+    """(wire_name, version, lineno) when cls carries @message(...)."""
+    for dec in cls.decorator_list:
+        if not (isinstance(dec, ast.Call)
+                and isinstance(dec.func, ast.Name)
+                and dec.func.id == "message"):
+            continue
+        if not (dec.args and isinstance(dec.args[0], ast.Constant)
+                and isinstance(dec.args[0].value, str)):
+            return None
+        name = dec.args[0].value
+        version = 1
+        if len(dec.args) > 1 and isinstance(dec.args[1], ast.Constant):
+            version = dec.args[1].value
+        for kw in dec.keywords:
+            if kw.arg == "version" and isinstance(kw.value, ast.Constant):
+                version = kw.value.value
+        return name, version, dec.lineno
+    return None
+
+
+def _ast_messages(tree: ast.Module) -> List[MessageSpec]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        dec = _message_decorator(node)
+        if dec is None:
+            continue
+        wire_name, version, line = dec
+        fields = []
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                continue
+            tname = _annotation_name(stmt.annotation)
+            if tname not in WIRE_SCALARS:
+                tname = "Any" if tname == "Any" else tname
+            fields.append(FieldSpec(
+                name=stmt.target.id, type=tname,
+                has_default=stmt.value is not None))
+        out.append(MessageSpec(name=wire_name, version=version,
+                               pyclass=node.name, line=line,
+                               fields=fields))
+    return out
+
+
+def _byte_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes) \
+            and len(node.value) == 1:
+        return node.value.decode("latin-1")
+    return None
+
+
+def _encoder_tags(tree: ast.Module) -> set:
+    """Tags the encoder can emit: `out += b"X"` in _encode_value."""
+    tags = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "_encode_value":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.AugAssign):
+                    # The value may be a bare constant or a
+                    # conditional (`b"l" if isinstance(...) else
+                    # b"t"`): walk the whole value expression.
+                    for leaf in ast.walk(sub.value):
+                        t = _byte_const(leaf)
+                        if t is not None:
+                            tags.add(t)
+    return tags
+
+
+def _decoder_tags(tree: ast.Module) -> set:
+    """Tags the decoder accepts: comparisons against `tag` in
+    _Decoder.value (both `tag == b"X"` and `tag in (b"l", b"t")`)."""
+    tags = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "_Decoder"):
+            continue
+        for fn in node.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "value"):
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Compare):
+                    continue
+                for cmp_node in sub.comparators:
+                    t = _byte_const(cmp_node)
+                    if t is not None:
+                        tags.add(t)
+                    if isinstance(cmp_node, (ast.Tuple, ast.List)):
+                        for el in cmp_node.elts:
+                            t = _byte_const(el)
+                            if t is not None:
+                                tags.add(t)
+    return tags
+
+
+def _max_depth(tree: ast.Module) -> Optional[int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id == "_MAX_DEPTH" \
+                        and isinstance(node.value, ast.Constant):
+                    return node.value.value
+    return None
+
+
+def _live_catalog() -> Dict[str, dict]:
+    """The imported module's view: registry + decode plans."""
+    import dataclasses as dc
+
+    from ray_tpu._private import wire
+
+    out = {}
+    for name, (cls, version) in wire._REGISTRY.items():
+        plan = wire._declared_fields(cls)
+        fields = []
+        for f in dc.fields(cls):
+            base_name, _checks = plan[f.name]
+            has_default = (f.default is not dc.MISSING
+                           or f.default_factory is not dc.MISSING)
+            fields.append({"name": f.name, "type": base_name,
+                           "has_default": has_default})
+        out[name] = {"version": version, "class": cls.__name__,
+                     "fields": fields}
+    return out
+
+
+def extract(repo_root: Optional[str] = None) -> Extraction:
+    root = os.path.abspath(repo_root or os.getcwd())
+    path = os.path.join(root, WIRE_RELPATH)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    problems: List[str] = []
+
+    messages = _ast_messages(tree)
+    by_name: Dict[str, MessageSpec] = {}
+    for m in messages:
+        if m.name in by_name:
+            problems.append(
+                f"duplicate @message name {m.name!r} "
+                f"(classes {by_name[m.name].pyclass} and {m.pyclass})")
+        by_name[m.name] = m
+
+    enc_tags = _encoder_tags(tree)
+    dec_tags = _decoder_tags(tree)
+    if enc_tags - dec_tags:
+        problems.append(
+            "encoder emits tags the decoder does not accept: "
+            f"{sorted(enc_tags - dec_tags)}")
+    if dec_tags - enc_tags:
+        problems.append(
+            "decoder accepts tags the encoder never emits: "
+            f"{sorted(dec_tags - enc_tags)}")
+    depth = _max_depth(tree)
+    if depth is None:
+        problems.append("wire.py declares no _MAX_DEPTH nesting bound")
+
+    # Cross-check AST vs live registry.
+    live = _live_catalog()
+    for name in sorted(set(by_name) - set(live)):
+        problems.append(
+            f"@message class {name!r} in the AST never registered "
+            "(import-order or conditional registration?)")
+    for name in sorted(set(live) - set(by_name)):
+        problems.append(
+            f"registered message {name!r} has no @message class in "
+            f"{WIRE_RELPATH} (dynamic registration defeats review)")
+    for name in sorted(set(by_name) & set(live)):
+        a, lv = by_name[name], live[name]
+        if a.version != lv["version"]:
+            problems.append(
+                f"{name}: AST version {a.version} != live "
+                f"{lv['version']}")
+        ast_fields = [(f.name, f.type, f.has_default) for f in a.fields]
+        live_fields = [(f["name"], f["type"], f["has_default"])
+                       for f in lv["fields"]]
+        if ast_fields != live_fields:
+            problems.append(
+                f"{name}: AST fields {ast_fields} != live decode plan "
+                f"{live_fields}")
+
+    notes: Dict[str, str] = {}
+    for line in source.splitlines():
+        m = MIGRATION_RE.search(line)
+        if m:
+            notes[m.group(1)] = m.group("why")
+
+    schema = {
+        "schema_version": SCHEMA_VERSION,
+        "source": WIRE_RELPATH,
+        "frame": {
+            "tags": sorted(enc_tags | dec_tags),
+            "length_prefix": "u32 big-endian",
+            "message_header": "M tag, name:str, version:u16, "
+                              "nfields:u16, then nfields x "
+                              "(name:str, value)",
+            "max_depth": depth,
+        },
+        "messages": {name: by_name[name].as_schema()
+                     for name in sorted(by_name)},
+    }
+    return Extraction(schema=schema, migration_notes=notes,
+                      problems=problems)
+
+
+def render_schema(schema: dict) -> str:
+    """Canonical bytes for the committed baseline (stable ordering so
+    regeneration is diff-clean)."""
+    return json.dumps(schema, indent=2, sort_keys=True) + "\n"
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,138 @@
+"""Minimized fuzz-fixture corpus: load, classify, replay.
+
+Every defect the fuzzer ever surfaced lives on as a checked-in fixture
+under ``tests/core/wire_fixtures/`` — a small JSON file holding the
+ddmin-minimized input as a hex blob plus the outcome the fixed code
+must produce. The replay is the regression test: each input is driven
+against its live target and must land on a TYPED outcome (accept, or
+reject with the recorded exception family) with nothing else escaping.
+
+Fixture file shape (one JSON object per ``.json`` file):
+
+    {
+      "name": "wire-deep-nest",
+      "target": "wire" | "rpc" | "shard" | "proxy",
+      "input_hex": "4d…",
+      "expect": "accept" | "reject",
+      "exc_type": "WireError",          # when expect == "reject"
+      "note": "why this input exists (the defect it once triggered)"
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+FIXTURE_DIR = os.path.join("tests", "core", "wire_fixtures")
+
+
+def load_fixtures(dirpath: str = FIXTURE_DIR) -> List[Dict[str, Any]]:
+    out = []
+    if not os.path.isdir(dirpath):
+        return out
+    for fname in sorted(os.listdir(dirpath)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(dirpath, fname), "r",
+                  encoding="utf-8") as f:
+            fx = json.load(f)
+        fx["_file"] = fname
+        out.append(fx)
+    return out
+
+
+def save_fixture(fx: Dict[str, Any],
+                 dirpath: str = FIXTURE_DIR) -> str:
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, fx["name"] + ".json")
+    clean = {k: v for k, v in fx.items() if not k.startswith("_")}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(clean, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def classify(target: str, data: bytes) -> Dict[str, Any]:
+    """Drive ``data`` against the raw target operation and name the
+    outcome: {"outcome": "accept" | "reject", "exc_type", "detail"}.
+    Anything OTHER than the target's typed-rejection family
+    propagates to the caller — that is the regression."""
+    from ray_tpu._private import wire
+
+    if target == "wire":
+        try:
+            wire.decode(data)
+            return {"outcome": "accept", "exc_type": None,
+                    "detail": None}
+        except wire.WireError as e:
+            return {"outcome": "reject",
+                    "exc_type": type(e).__name__,
+                    "detail": str(e)[:200]}
+    if target == "rpc":
+        from ray_tpu._private import rpc
+        from tools.raywire.fuzz import _BufSock
+
+        try:
+            rpc.recv_msg(_BufSock(data))
+            return {"outcome": "accept", "exc_type": None,
+                    "detail": None}
+        except (wire.WireError, ConnectionError) as e:
+            return {"outcome": "reject",
+                    "exc_type": type(e).__name__,
+                    "detail": str(e)[:200]}
+    if target == "shard":
+        from ray_tpu._private.head_shards import HeadShardState
+
+        try:
+            msg = wire.decode(data)
+        except wire.WireError as e:
+            return {"outcome": "reject",
+                    "exc_type": type(e).__name__,
+                    "detail": str(e)[:200]}
+        state = HeadShardState(0, 1)
+        try:
+            state.apply([msg])
+            return {"outcome": "accept", "exc_type": None,
+                    "detail": None}
+        except wire.WireError as e:
+            return {"outcome": "reject",
+                    "exc_type": type(e).__name__,
+                    "detail": str(e)[:200]}
+    if target == "proxy":
+        from tools.raywire.fuzz import _fresh_conn
+
+        conn = _fresh_conn()
+        conn.buf = data
+        conn._parse()
+        errors = [r.error for r in conn.backlog
+                  if getattr(r, "error", None) is not None]
+        if errors:
+            status, body = errors[0]
+            return {"outcome": "reject", "exc_type": f"http_{status}",
+                    "detail": body.decode("utf-8", "replace")[:200]}
+        return {"outcome": "accept", "exc_type": None,
+                "detail": f"{len(conn.backlog)} request(s) parsed, "
+                          f"{len(conn.buf)} byte(s) pending"}
+    raise ValueError(f"unknown fixture target {target!r}")
+
+
+def replay(fx: Dict[str, Any]) -> Dict[str, Any]:
+    """Replay one fixture. Returns {"ok", "got", "want", "name"} —
+    ok means the outcome matched AND nothing untyped escaped (an
+    escaped exception propagates out of classify and fails the
+    caller loudly, which is the point)."""
+    data = bytes.fromhex(fx["input_hex"])
+    got = classify(fx["target"], data)
+    want_outcome = fx["expect"]
+    ok = got["outcome"] == want_outcome
+    if ok and want_outcome == "reject" and fx.get("exc_type"):
+        ok = got["exc_type"] == fx["exc_type"]
+    return {"ok": ok, "name": fx["name"], "got": got,
+            "want": {"outcome": want_outcome,
+                     "exc_type": fx.get("exc_type")}}
+
+
+def replay_all(dirpath: str = FIXTURE_DIR) -> List[Dict[str, Any]]:
+    return [replay(fx) for fx in load_fixtures(dirpath)]

@@ -1,0 +1,443 @@
+"""Grammar-derived structure-aware decode fuzzing.
+
+Random bytes barely scratch a tagged format — the first byte is an
+invalid tag 95% of the time and the run never gets past the header. So
+the generator starts from the extracted schema: every input begins life
+as a VALID frame (correct tags, correct length prefixes, registered
+message names, in-range versions) and is then broken in exactly one
+structured way (truncation, length-field inflation, future version,
+unknown name, nesting past the bound, oversized strings, tag swaps,
+byte flips). That lands inputs deep in the decoder where the interesting
+branches are.
+
+Four drive targets, one contract each:
+
+- ``wire``  — ``wire.decode(data)`` returns a value or raises
+  ``WireError``. Any other exception type is a finding.
+- ``rpc``   — ``recv_msg`` over a buffer-backed socket: a value,
+  ``WireError`` (``FrameTooLarge`` included), or ``ConnectionError``
+  (short stream). Nothing else.
+- ``shard`` — fuzzed frames that decode to ``head.ShardRow`` (plus raw
+  fuzzed tuples) fed to ``HeadShardState.apply``: applied or
+  ``WireError``. Unknown tables/ops/key types must reject, not corrupt.
+- ``proxy`` — mutated HTTP/1.1 request bytes through the serve proxy's
+  ``_Conn._parse``: requests land in the backlog, the parser waits for
+  more bytes, or it halts with an error pseudo-request. No exception.
+
+Every input also runs under a wall-time bound (decode must be O(input),
+never O(declared length)), and dedicated length-inflation probes run
+under ``tracemalloc`` to prove a 2 GiB length prefix costs bytes of
+allocation, not gigabytes.
+
+Crashing inputs are ddmin-minimized (``tools.raymc.minimize`` — the
+same delta debugger raymc uses on schedule traces, applied to byte
+positions) before being reported, so fixtures stay readable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import struct
+import time
+import tracemalloc
+from typing import Callable, Dict, List, Tuple
+
+from tools.raymc.minimize import ddmin
+from tools.raywire import gen
+
+_U32 = struct.Struct("!I")
+_U16 = struct.Struct("!H")
+
+# Generous per-input ceiling: a healthy decode of a <64KiB frame is
+# microseconds; blowing 250ms means super-linear work (e.g. decode
+# driven by a declared length instead of actual bytes).
+TIME_BOUND_S = 0.25
+
+# A length-inflation probe claims ~2GiB; decoding its <100 bytes must
+# allocate no more than this.
+ALLOC_BOUND_BYTES = 1 << 20
+
+
+@dataclasses.dataclass
+class Finding:
+    target: str
+    mutator: str
+    exc_type: str
+    message: str
+    input_hex: str           # ddmin-minimized reproducer
+    minimized_from: int      # original input length in bytes
+
+
+# -- seed-frame generation ---------------------------------------------------
+
+
+def gen_seed_frame(rng: random.Random, schema: dict) -> bytes:
+    """A fully valid frame: usually a registered message, sometimes a
+    bare scalar (the decoder accepts both at top level)."""
+    from ray_tpu._private import wire
+
+    messages = schema["messages"]
+    if rng.random() < 0.8 and messages:
+        name = rng.choice(sorted(messages))
+        entry = messages[name]
+        return gen.build_frame(name, entry["version"],
+                               gen.gen_fields(rng, entry))
+    return wire.encode(gen.gen_value(rng, "Any"))
+
+
+# -- structured mutators -----------------------------------------------------
+#
+# Each takes (rng, frame) -> bytes. "identity" keeps a slice of the
+# corpus valid so the nominal path stays covered too.
+
+
+def _mut_identity(rng: random.Random, frame: bytes) -> bytes:
+    return frame
+
+
+def _mut_truncate(rng: random.Random, frame: bytes) -> bytes:
+    if len(frame) <= 1:
+        return b""
+    return frame[:rng.randrange(len(frame))]
+
+
+def _mut_inflate_length(rng: random.Random, frame: bytes) -> bytes:
+    """Overwrite one plausible u32 length field with a huge value —
+    the canonical allocation-bomb shape."""
+    if len(frame) < 5:
+        return frame + _U32.pack(0xFFFFFFF0)
+    pos = rng.randrange(len(frame) - 4)
+    huge = rng.choice((0x7FFFFFFF, 0xFFFFFFFF, 1 << 30))
+    return frame[:pos] + _U32.pack(huge) + frame[pos + 4:]
+
+
+def _mut_future_version(rng: random.Random, frame: bytes) -> bytes:
+    """Bump the version u16 of an M frame (header: M, str name,
+    u16 version)."""
+    if not frame.startswith(b"M") or len(frame) < 7:
+        return frame
+    name_len = _U32.unpack_from(frame, 1)[0]
+    vpos = 5 + name_len
+    if vpos + 2 > len(frame):
+        return frame
+    return frame[:vpos] + _U16.pack(rng.choice((99, 2, 0xFFFF))) \
+        + frame[vpos + 2:]
+
+
+def _mut_unknown_name(rng: random.Random, frame: bytes) -> bytes:
+    if not frame.startswith(b"M"):
+        return frame
+    name = rng.choice((b"no.SuchMsg", b"", b"\xff\xfe bad utf8",
+                       b"rpc.Request2"))
+    name_len = _U32.unpack_from(frame, 1)[0] if len(frame) >= 5 else 0
+    rest = frame[5 + name_len:]
+    return b"M" + _U32.pack(len(name)) + name + rest
+
+
+def _mut_deep_nest(rng: random.Random, frame: bytes) -> bytes:
+    """Nesting past _MAX_DEPTH: 200 one-element-list shells."""
+    depth = rng.choice((70, 200, 1000))
+    return b"l" + _U32.pack(1) * 1 \
+        + (b"l" + _U32.pack(1)) * (depth - 1) + b"i" \
+        + struct.Struct("!q").pack(0)
+
+
+def _mut_oversized_string(rng: random.Random, frame: bytes) -> bytes:
+    """A string whose declared length exceeds the bytes present."""
+    claimed = rng.choice((10**6, 0x7FFFFFFF))
+    body = b"x" * rng.randrange(64)
+    return b"s" + _U32.pack(claimed) + body
+
+
+def _mut_tag_swap(rng: random.Random, frame: bytes) -> bytes:
+    if not frame:
+        return frame
+    pos = rng.randrange(len(frame))
+    tag = rng.choice(b"NTFiIdsbltmMO\xff\x00")
+    return frame[:pos] + bytes((tag,)) + frame[pos + 1:]
+
+
+def _mut_bit_flip(rng: random.Random, frame: bytes) -> bytes:
+    if not frame:
+        return b"\x00"
+    pos = rng.randrange(len(frame))
+    return frame[:pos] + bytes((frame[pos] ^ (1 << rng.randrange(8)),)) \
+        + frame[pos + 1:]
+
+
+def _mut_splice(rng: random.Random, frame: bytes) -> bytes:
+    """Concatenate a frame into itself at a random cut — misaligned
+    nested structures."""
+    if len(frame) < 2:
+        return frame + frame
+    cut = rng.randrange(len(frame))
+    return frame[:cut] + frame + frame[cut:]
+
+
+def _mut_http_dup_cl(rng: random.Random, frame: bytes) -> bytes:
+    """A second, conflicting Content-Length — the classic
+    request-smuggling shape the proxy must 400."""
+    cl = rng.choice((b"Content-Length: 0\r\n",
+                     b"Content-Length: 9999\r\n",
+                     b"content-length: 1\r\n"))
+    end = frame.find(b"\r\n\r\n")
+    if end < 0:
+        return cl + frame
+    return frame[:end + 2] + cl + frame[end + 2:]
+
+
+def _mut_http_bad_cl(rng: random.Random, frame: bytes) -> bytes:
+    """Content-Length values int() accepts but RFC 9110 does not."""
+    bad = rng.choice((b"+5", b" 7 ", b"1_0", b"-3", b"0x10",
+                      "٥".encode(),  # ARABIC-INDIC digit five
+                      b"99999999999999999999"))
+    end = frame.find(b"\r\n\r\n")
+    hdr = b"Content-Length: " + bad + b"\r\n"
+    if end < 0:
+        return hdr + frame
+    return frame[:end + 2] + hdr + frame[end + 2:]
+
+
+MUTATORS: List[Tuple[str, Callable[[random.Random, bytes], bytes]]] = [
+    ("identity", _mut_identity),
+    ("truncate", _mut_truncate),
+    ("inflate_length", _mut_inflate_length),
+    ("future_version", _mut_future_version),
+    ("unknown_name", _mut_unknown_name),
+    ("deep_nest", _mut_deep_nest),
+    ("oversized_string", _mut_oversized_string),
+    ("tag_swap", _mut_tag_swap),
+    ("bit_flip", _mut_bit_flip),
+    ("splice", _mut_splice),
+    ("http_dup_cl", _mut_http_dup_cl),
+    ("http_bad_cl", _mut_http_bad_cl),
+]
+
+
+# -- drive targets -----------------------------------------------------------
+
+
+class _BufSock:
+    """A socket whose recv() serves a fixed byte buffer, then EOF."""
+
+    def __init__(self, data: bytes):
+        self._buf = data
+
+    def recv(self, n: int) -> bytes:
+        chunk, self._buf = self._buf[:n], self._buf[n:]
+        return chunk
+
+
+def drive_wire(data: bytes) -> None:
+    from ray_tpu._private import wire
+
+    try:
+        wire.decode(data)
+    except wire.WireError:
+        pass
+
+
+def drive_rpc(data: bytes) -> None:
+    """The length-prefixed framing layer: the fuzz payload arrives as
+    the body of a well-formed frame AND as the raw stream itself (so
+    both the prefix parse and the body decode are exercised)."""
+    from ray_tpu._private import rpc, wire
+
+    for stream in (_U32.pack(len(data)) + data, data):
+        try:
+            rpc.recv_msg(_BufSock(stream))
+        except (wire.WireError, ConnectionError):
+            pass
+
+
+def drive_shard(data: bytes) -> None:
+    """Frames that decode into ShardRow (or anything else) go through
+    HeadShardState.apply — the skew seam where a newer/older peer's
+    rows enter this process's tables."""
+    from ray_tpu._private import wire
+    from ray_tpu._private.head_shards import HeadShardState
+
+    try:
+        msg = wire.decode(data)
+    except wire.WireError:
+        return
+    state = HeadShardState(0, 1)
+    try:
+        state.apply([msg])
+    except wire.WireError:
+        pass
+
+
+def _fresh_conn():
+    """A _Conn with only the parser's state, no event loop."""
+    from ray_tpu.serve._private.http_proxy import _Conn
+    from collections import deque
+
+    conn = _Conn.__new__(_Conn)
+    conn.buf = b""
+    conn.backlog = deque()
+    conn._need = None
+    conn._halt_parse = False
+    return conn
+
+
+def gen_http_request(rng: random.Random) -> bytes:
+    method = rng.choice(("GET", "POST", "PUT", "DELETE"))
+    path = rng.choice(("/", "/v1/chat", "/-/healthz", "/app/x%20y"))
+    body = bytes(rng.randrange(256) for _ in range(rng.randrange(48)))
+    headers = [f"Host: fuzz", f"X-Trace-Id: t{rng.randrange(999)}"]
+    if body or rng.random() < 0.5:
+        headers.append(f"Content-Length: {len(body)}")
+    if rng.random() < 0.3:
+        headers.append("Connection: " + rng.choice(("close",
+                                                    "keep-alive")))
+    head = f"{method} {path} HTTP/1.1\r\n" + "\r\n".join(headers)
+    return head.encode() + b"\r\n\r\n" + body
+
+
+def drive_proxy(data: bytes) -> None:
+    """Feed the bytes whole and in a 1..7-byte dribble (re-entrant
+    _parse with partial state) — outcomes are backlog entries, a wait
+    for more bytes, or a parse halt. Never an exception."""
+    conn = _fresh_conn()
+    conn.buf = data
+    conn._parse()
+    conn2 = _fresh_conn()
+    step = 1 + (len(data) % 7)
+    for i in range(0, len(data), step):
+        conn2.buf += data[i:i + step]
+        conn2._parse()
+
+
+TARGETS: Dict[str, Callable[[bytes], None]] = {
+    "wire": drive_wire,
+    "rpc": drive_rpc,
+    "shard": drive_shard,
+    "proxy": drive_proxy,
+}
+
+# Mutators for HTTP inputs (the wire mutators assume tag grammar).
+_HTTP_MUTATORS = ("identity", "truncate", "bit_flip", "splice",
+                  "oversized_string", "http_dup_cl", "http_bad_cl")
+_HTTP_ONLY = ("http_dup_cl", "http_bad_cl")
+
+
+def _minimize(data: bytes, drive: Callable[[bytes], None],
+              exc_type: type) -> bytes:
+    """ddmin over byte positions: the smallest subsequence that still
+    raises the same exception type out of the same driver."""
+
+    def fails(positions: List[int]) -> bool:
+        candidate = bytes(data[i] for i in positions)
+        try:
+            drive(candidate)
+        except exc_type:
+            return True
+        except Exception:
+            return False
+        return False
+
+    positions = list(range(len(data)))
+    if not fails(positions):      # flaky (timing-only) — keep as-is
+        return data
+    kept = ddmin(fails, positions, max_probes=128)
+    return bytes(data[i] for i in kept)
+
+
+def run_fuzz(schema: dict, n_inputs: int = 10000, seed: int = 0,
+             time_bound_s: float = TIME_BOUND_S) -> dict:
+    """The full campaign. Returns a report dict:
+    {"inputs", "per_target", "per_mutator", "slow", "findings"}."""
+    rng = random.Random(seed)
+    findings: List[Finding] = []
+    per_target: Dict[str, int] = {t: 0 for t in TARGETS}
+    per_mutator: Dict[str, int] = {m: 0 for m, _fn in MUTATORS}
+    slow: List[dict] = []
+    wire_targets = ("wire", "rpc", "shard")
+
+    for i in range(n_inputs):
+        if rng.random() < 0.25:
+            target = "proxy"
+            seed_input = gen_http_request(rng)
+            mut_name, mut = rng.choice(MUTATORS)
+            while mut_name not in _HTTP_MUTATORS:
+                mut_name, mut = rng.choice(MUTATORS)
+        else:
+            target = rng.choice(wire_targets)
+            seed_input = gen_seed_frame(rng, schema)
+            mut_name, mut = rng.choice(MUTATORS)
+            while mut_name in _HTTP_ONLY:
+                mut_name, mut = rng.choice(MUTATORS)
+        data = mut(rng, seed_input)
+        per_target[target] += 1
+        per_mutator[mut_name] += 1
+        drive = TARGETS[target]
+
+        t0 = time.monotonic()
+        try:
+            drive(data)
+        except Exception as e:
+            minimized = _minimize(data, drive, type(e))
+            findings.append(Finding(
+                target=target, mutator=mut_name,
+                exc_type=type(e).__name__, message=str(e)[:200],
+                input_hex=minimized.hex(),
+                minimized_from=len(data)))
+            continue
+        elapsed = time.monotonic() - t0
+        if elapsed > time_bound_s:
+            slow.append({"target": target, "mutator": mut_name,
+                         "elapsed_s": round(elapsed, 3),
+                         "input_hex": data[:256].hex(),
+                         "input_len": len(data)})
+
+    report = {
+        "inputs": n_inputs,
+        "seed": seed,
+        "per_target": per_target,
+        "per_mutator": per_mutator,
+        "slow": slow,
+        "alloc_probes": run_alloc_probes(),
+        "findings": [dataclasses.asdict(f) for f in findings],
+    }
+    return report
+
+
+def run_alloc_probes() -> List[dict]:
+    """Crafted allocation bombs under tracemalloc: each claims ~2GiB
+    in a length field; the decode/reject must stay under
+    ALLOC_BOUND_BYTES of peak allocation."""
+    from ray_tpu._private import rpc, wire
+
+    huge = 0x7FFFFF00
+    probes = [
+        ("wire_str", lambda: _swallow(
+            wire.decode, b"s" + _U32.pack(huge))),
+        ("wire_bytes", lambda: _swallow(
+            wire.decode, b"b" + _U32.pack(huge))),
+        ("wire_list_count", lambda: _swallow(
+            wire.decode, b"l" + _U32.pack(huge))),
+        ("rpc_frame_prefix", lambda: _swallow(
+            rpc.recv_msg, _BufSock(_U32.pack(huge) + b"x" * 64))),
+    ]
+    out = []
+    for name, fn in probes:
+        tracemalloc.start()
+        try:
+            fn()
+            _current, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        out.append({"probe": name, "peak_bytes": peak,
+                    "ok": peak < ALLOC_BOUND_BYTES})
+    return out
+
+
+def _swallow(fn, arg):
+    from ray_tpu._private import wire
+
+    try:
+        fn(arg)
+    except (wire.WireError, ConnectionError):
+        pass

@@ -1,0 +1,178 @@
+"""Schema-driven value/frame generation and catalog simulation.
+
+Three consumers share this module:
+
+- the **round-trip property suite** generates natively-encodable field
+  values for every registered message (no opaque sections — pickle
+  bytes are not canonical, so byte-for-byte identity is only promised
+  for the structural encoding);
+- the **fuzzer** builds valid frames straight from the extracted
+  schema, then mutates them;
+- the **skew simulator** builds frames for a catalog that no longer
+  (or does not yet) exist in code — ``build_frame`` is a standalone
+  encoder driven entirely by schema data, and ``simulate_decode``
+  replicates the decoder's semantics (version gate, unknown-field
+  skip, type checks, required-field check) against a catalog entry
+  given as data. That is what lets the gate decode "old wire under new
+  code" AND "new wire under old code" with only the new code present.
+
+Everything is seeded: same seed, same frames, byte for byte.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+_U32 = struct.Struct("!I")
+_U16 = struct.Struct("!H")
+
+# Mirrors wire._SCALAR_CHECKS: the isinstance gate decode applies per
+# declared field type (None always passes; int is acceptable where
+# float is declared).
+_TYPE_CHECKS = {
+    "int": int, "float": (int, float), "str": str, "bytes": bytes,
+    "bool": bool, "dict": dict, "list": list, "tuple": tuple,
+}
+
+_SAMPLE_STRS = ("", "a", "table", "spec.template", "节点", "x" * 40,
+                "key:with/punct", "éß")
+_SAMPLE_BYTES = (b"", b"\x00", b"oid-1234", b"\xff" * 16, b"k" * 33)
+
+
+def gen_value(rng: random.Random, type_name: str, depth: int = 0) -> Any:
+    """One generated value of the declared wire type."""
+    if type_name == "int":
+        return rng.choice((
+            0, 1, -1, 7, rng.randrange(-2**31, 2**31),
+            2**63 - 1, -(2**63), 2**80 + rng.randrange(1000)))
+    if type_name == "float":
+        return rng.choice((0.0, -0.5, 1e-9, 3.141592653589793,
+                           float(rng.randrange(10**6)),
+                           rng.uniform(-1e12, 1e12)))
+    if type_name == "str":
+        return rng.choice(_SAMPLE_STRS)
+    if type_name == "bytes":
+        return rng.choice(_SAMPLE_BYTES)
+    if type_name == "bool":
+        return rng.random() < 0.5
+    if type_name == "list":
+        if depth >= 2:
+            return [gen_value(rng, "int", depth + 1)]
+        return [gen_value(rng, rng.choice(("int", "str", "bytes")),
+                          depth + 1)
+                for _ in range(rng.randrange(4))]
+    if type_name == "tuple":
+        return tuple(gen_value(rng, "list", depth))
+    if type_name == "dict":
+        if depth >= 2:
+            return {"k": 1}
+        return {gen_value(rng, rng.choice(("str", "int", "bytes")),
+                          depth + 1):
+                gen_value(rng, rng.choice(
+                    ("int", "str", "float", "list")), depth + 1)
+                for _ in range(rng.randrange(4))}
+    # Any: anything natively encodable, nesting included.
+    return gen_value(rng, rng.choice(
+        ("int", "float", "str", "bytes", "bool", "list", "dict",
+         "tuple")), depth + 1)
+
+
+def gen_fields(rng: random.Random, entry: dict) -> List[Tuple[str, Any]]:
+    """Generated (name, value) pairs in the entry's declared order —
+    the encode order."""
+    out = []
+    for f in entry["fields"]:
+        v = gen_value(rng, f["type"])
+        # None is always decode-legal; exercise it occasionally.
+        if f["has_default"] and rng.random() < 0.1:
+            v = None
+        out.append((f["name"], v))
+    return out
+
+
+# -- catalog-driven encoding (no live classes needed) -----------------------
+
+
+def _enc_str(out: bytearray, s: str) -> None:
+    raw = s.encode()
+    out += _U32.pack(len(raw))
+    out += raw
+
+
+def build_frame(name: str, version: int,
+                fields: List[Tuple[str, Any]]) -> bytes:
+    """An M frame for an arbitrary (possibly historical) catalog shape.
+    Field VALUES ride the live scalar encoding — catalogs version
+    message shapes, not the scalar tag alphabet."""
+    from ray_tpu._private import wire
+
+    out = bytearray(b"M")
+    _enc_str(out, name)
+    out += _U16.pack(version)
+    out += _U16.pack(len(fields))
+    for fname, value in fields:
+        _enc_str(out, fname)
+        out += wire.encode(value)
+    return bytes(out)
+
+
+def build_instance(wire_name: str, entry: dict, rng: random.Random):
+    """A live dataclass instance with generated field values (for the
+    round-trip suite: encode must take the REAL encode path)."""
+    from ray_tpu._private import wire
+
+    cls, _version = wire._REGISTRY[wire_name]
+    kwargs = {f["name"]: gen_value(rng, f["type"])
+              for f in entry["fields"]}
+    return cls(**kwargs)
+
+
+# -- simulated decode against a catalog entry given as data -----------------
+
+
+def simulate_decode(frame_fields: List[Tuple[str, Any]],
+                    sender_version: int,
+                    entry: Optional[dict]) -> Dict[str, Any]:
+    """What a receiver speaking ``entry`` would do with a frame whose
+    header says ``sender_version`` and whose body carries
+    ``frame_fields``. Mirrors wire._Decoder's M-tag semantics exactly:
+    unknown name / newer version reject; unknown fields skip; declared
+    types check (None passes, int passes for float); fields the
+    receiver declares without a default must arrive.
+
+    Returns {"ok": bool, "error": str|None, "skipped": [names]}.
+    """
+    if entry is None:
+        return {"ok": False, "error": "unknown message type",
+                "skipped": []}
+    if sender_version > entry["version"]:
+        return {"ok": False,
+                "error": f"v{sender_version} newer than known "
+                         f"v{entry['version']}",
+                "skipped": []}
+    declared = {f["name"]: f for f in entry["fields"]}
+    skipped: List[str] = []
+    seen = set()
+    for fname, value in frame_fields:
+        spec = declared.get(fname)
+        if spec is None:
+            skipped.append(fname)
+            continue
+        seen.add(fname)
+        check = _TYPE_CHECKS.get(spec["type"])
+        if value is None or check is None:
+            continue
+        if not isinstance(value, check):
+            return {"ok": False,
+                    "error": f"{fname}: expected {spec['type']}, got "
+                             f"{type(value).__name__}",
+                    "skipped": skipped}
+    missing = [f["name"] for f in entry["fields"]
+               if f["name"] not in seen and not f["has_default"]]
+    if missing:
+        return {"ok": False,
+                "error": f"missing required field(s): {missing}",
+                "skipped": skipped}
+    return {"ok": True, "error": None, "skipped": skipped}
